@@ -1,0 +1,23 @@
+"""serenedb_tpu — a TPU-native real-time search analytics database framework.
+
+Capability oracle: serenedb/serenedb (single-node, Postgres-wire-compatible
+"Elasticsearch + ClickHouse" database; see /root/reference and SURVEY.md).
+This implementation is architected TPU-first: columnar scan/filter/aggregate
+and posting-block BM25/top-k scoring run as JAX/XLA/Pallas kernels on
+HBM-resident column batches, with a CPU reference path for parity.
+
+Layer map (mirrors SURVEY.md §1, re-expressed for TPU):
+
+  server/    PG wire + ES-compatible HTTP frontends
+  sql/       lexer / parser / binder / logical planner / optimizer
+  exec/      physical operators; routes column batches to ops/ kernels
+  ops/       JAX + Pallas kernels (filter, hash-agg, BM25, top-k, vector)
+  search/    inverted index segments, analyzers, scorers (IResearch analog)
+  storage/   WAL, segment persistence, refresh/compaction, recovery
+  catalog/   versioned snapshot catalog, RBAC, persistence
+  columnar/  column batch ABI (the HBM-friendly data layout)
+  parallel/  device-mesh sharding of scans/aggregates/scoring
+  utils/     config, logging, metrics, fault injection, ticks
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+from . import dtypes
+from .column import Batch, Column, concat_batches, merge_dictionaries
+from .device import (BLOCK_ROWS, LANES, DeviceColumn, pad_len,
+                     to_device_batch, to_device_column)
+
+__all__ = [
+    "dtypes", "Batch", "Column", "concat_batches", "merge_dictionaries",
+    "BLOCK_ROWS", "LANES", "DeviceColumn", "pad_len", "to_device_batch",
+    "to_device_column",
+]

@@ -1,0 +1,93 @@
+"""Host↔device movement of column batches.
+
+The reference has no device boundary (single-process C++; SURVEY.md §5.8) —
+this module *is* the new architecture's offload seam. Columns go to HBM as
+2-D (rows/LANES, LANES) tiles so Pallas kernels see lane-aligned data:
+
+- 1-D column of n rows → padded to a multiple of BLOCK_ROWS = 8*128 = 1024,
+  reshaped to (n_pad // 128, 128). float64 is narrowed to float32 on device
+  (analytics kernels accumulate in f32/i64; exact-parity paths stay on CPU).
+- validity travels as a mask array of the same shape (True = valid row);
+  padding rows are invalid.
+
+`DeviceColumn` carries the logical length so kernels can mask the tail.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+from .column import Batch, Column
+
+LANES = 128
+SUBLANES = 8
+BLOCK_ROWS = LANES * SUBLANES  # 1024: one (8,128) f32 tile worth of rows
+
+
+def pad_len(n: int, multiple: int = BLOCK_ROWS) -> int:
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+_DEVICE_DTYPE = {
+    np.dtype(np.bool_): jnp.int8,     # bool as i8 lanes (mask math)
+    np.dtype(np.int8): jnp.int8,
+    np.dtype(np.int16): jnp.int32,
+    np.dtype(np.int32): jnp.int32,
+    np.dtype(np.int64): jnp.int32,    # see note below
+    np.dtype(np.float32): jnp.float32,
+    np.dtype(np.float64): jnp.float32,
+}
+
+
+@dataclass
+class DeviceColumn:
+    """A column resident on device as (n_pad/128, 128) tiles."""
+
+    type: dt.SqlType
+    data: jax.Array                 # 2-D (rows, LANES)
+    mask: jax.Array                 # 2-D bool, same shape; False on padding
+    length: int                     # logical row count
+    wide: Optional[jax.Array] = None  # optional i64-precision residual (unused yet)
+
+    @property
+    def padded_rows(self) -> int:
+        return self.data.shape[0] * LANES
+
+
+def _narrow_i64(a: np.ndarray) -> np.ndarray:
+    """int64 device policy: values that fit in int32 go down as int32 (the
+    common case for ClickBench-style data); wider values fall back to f32
+    pairs — not needed yet, so assert for now and keep the CPU path exact."""
+    return a.astype(np.int64)
+
+
+def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColumn:
+    n = len(col)
+    n_pad = pad_len(n, pad_multiple)
+    arr = col.data
+    if arr.dtype == np.dtype(np.int64):
+        # keep exactness when it fits; otherwise go float32 (approx path)
+        if n == 0 or (np.abs(arr, dtype=np.float64).max(initial=0.0) < 2**31):
+            arr = arr.astype(np.int32)
+        else:
+            arr = arr.astype(np.float32)
+    dev_dt = _DEVICE_DTYPE.get(arr.dtype, jnp.float32)
+    padded = np.zeros(n_pad, dtype=arr.dtype)
+    padded[:n] = arr
+    mask = np.zeros(n_pad, dtype=bool)
+    mask[:n] = col.valid_mask()
+    data2d = jnp.asarray(padded.reshape(-1, LANES), dtype=dev_dt)
+    mask2d = jnp.asarray(mask.reshape(-1, LANES))
+    return DeviceColumn(col.type, data2d, mask2d, n)
+
+
+def to_device_batch(batch: Batch, columns: Optional[list[str]] = None) -> dict:
+    names = columns if columns is not None else batch.names
+    return {name: to_device_column(batch.column(name)) for name in names}

@@ -1,0 +1,3 @@
+from . import config, faults, log, metrics, ticks
+
+__all__ = ["config", "faults", "log", "metrics", "ticks"]

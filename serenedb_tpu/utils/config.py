@@ -1,0 +1,133 @@
+"""Three-tier config system.
+
+Reference analog (SURVEY.md §5.6): (1) process flags, (2) SQL-settable
+session/global settings (`SET name = value` / `sdb_settings` introspection;
+reference: server/query/config_variables.cpp), (3) per-object WITH options
+(carried in the catalog, not here).
+
+Settings are declared once in a registry with type/default/scope; sessions
+hold sparse overrides over the global store.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class Scope(enum.Enum):
+    SESSION = "session"   # settable per session (and globally as default)
+    GLOBAL = "global"     # process-wide only
+
+
+@dataclass
+class Setting:
+    name: str
+    default: Any
+    type: type
+    scope: Scope = Scope.SESSION
+    description: str = ""
+    validator: Optional[Callable[[Any], Any]] = None
+
+    def coerce(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            v = value.strip().lower()
+            if v in ("on", "true", "1", "yes"):
+                value = True
+            elif v in ("off", "false", "0", "no"):
+                value = False
+            else:
+                raise ValueError(f"invalid boolean: {value!r}")
+        else:
+            value = self.type(value)
+        if self.validator:
+            value = self.validator(value)
+        return value
+
+
+class SettingsRegistry:
+    def __init__(self):
+        self._defs: dict[str, Setting] = {}
+        self._global: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, s: Setting) -> Setting:
+        self._defs[s.name] = s
+        return s
+
+    def definition(self, name: str) -> Setting:
+        s = self._defs.get(name.lower())
+        if s is None:
+            raise KeyError(f'unrecognized configuration parameter "{name}"')
+        return s
+
+    def names(self) -> list[str]:
+        return sorted(self._defs)
+
+    def set_global(self, name: str, value: Any) -> None:
+        s = self.definition(name)
+        with self._lock:
+            self._global[s.name] = s.coerce(value)
+
+    def get_global(self, name: str) -> Any:
+        s = self.definition(name)
+        with self._lock:
+            return self._global.get(s.name, s.default)
+
+
+REGISTRY = SettingsRegistry()
+
+
+def declare(name: str, default: Any, typ: type, description: str = "",
+            scope: Scope = Scope.SESSION,
+            validator: Optional[Callable] = None) -> Setting:
+    return REGISTRY.register(
+        Setting(name.lower(), default, typ, scope, description, validator))
+
+
+class SessionSettings:
+    """Per-session sparse overrides over the global registry."""
+
+    def __init__(self, registry: SettingsRegistry = REGISTRY):
+        self._registry = registry
+        self._local: dict[str, Any] = {}
+
+    def get(self, name: str) -> Any:
+        s = self._registry.definition(name)
+        if s.name in self._local:
+            return self._local[s.name]
+        return self._registry.get_global(s.name)
+
+    def set(self, name: str, value: Any) -> None:
+        s = self._registry.definition(name)
+        if s.scope is Scope.GLOBAL:
+            raise ValueError(f'parameter "{name}" cannot be changed per session')
+        self._local[s.name] = s.coerce(value)
+
+    def reset(self, name: str) -> None:
+        s = self._registry.definition(name)
+        self._local.pop(s.name, None)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {n: self.get(n) for n in self._registry.names()}
+
+
+# -- core settings (mirroring the reference's knob names where they exist) --
+
+declare("application_name", "", str, "client-supplied application name")
+declare("extra_float_digits", 1, int, "float output precision adjustment")
+declare("statement_timeout", 0, int, "ms; 0 disables")
+declare("search_path", "main", str, "schema search path")
+declare("sdb_faults", "", str, "comma list of armed fault points (+name/-name)")
+declare("sdb_nprobe", 8, int, "IVF probes per vector query")
+declare("sdb_rerank_factor", 4, int, "ANN rerank multiplier")
+declare("sdb_scored_terms_limit", 128, int,
+        "max scored terms for multi-term expansion (wildcard/fuzzy)")
+declare("sdb_strict_ddl", False, bool, "reject unknown WITH options")
+declare("serene_device", "auto", str,
+        "compute device policy: auto|tpu|cpu (auto: TPU when available "
+        "and batch is large enough)")
+declare("serene_device_min_rows", 16384, int,
+        "below this row count the CPU path is used even when device=auto")

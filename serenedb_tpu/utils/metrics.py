@@ -1,0 +1,71 @@
+"""Fixed registry of atomic gauges, ClickHouse-CurrentMetrics style.
+
+Reference analog: libs/basics/metrics.h:27-71 — relaxed-atomic gauges bumped
+only at task/connection boundaries (never per row), surfaced via the
+`sdb_metrics` system view. Python ints under a lock are cheap enough at those
+boundaries.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class Gauge:
+    __slots__ = ("name", "description", "_value", "_lock")
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def sub(self, n: int = 1) -> None:
+        self.add(-n)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    @contextmanager
+    def scoped(self, n: int = 1):
+        self.add(n)
+        try:
+            yield
+        finally:
+            self.sub(n)
+
+
+class Registry:
+    def __init__(self):
+        self._gauges: dict[str, Gauge] = {}
+
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(name, description)
+        return g
+
+    def all(self) -> list[Gauge]:
+        return [self._gauges[k] for k in sorted(self._gauges)]
+
+
+REGISTRY = Registry()
+
+PG_CONNECTIONS = REGISTRY.gauge("PgConnections", "open PG wire connections")
+HTTP_CONNECTIONS = REGISTRY.gauge("HttpConnections", "open HTTP connections")
+QUERIES_ACTIVE = REGISTRY.gauge("QueriesActive", "queries currently executing")
+REFRESH_ACTIVE = REGISTRY.gauge("RefreshActive", "running refresh tasks")
+REFRESH_PENDING = REGISTRY.gauge("RefreshPending", "queued refresh tasks")
+COMPACTION_ACTIVE = REGISTRY.gauge("CompactionActive", "running compactions")
+COMPACTION_PENDING = REGISTRY.gauge("CompactionPending", "queued compactions")
+CLEANUP_ACTIVE = REGISTRY.gauge("CleanupActive", "running cleanup tasks")
+DEVICE_OFFLOADS = REGISTRY.gauge("DeviceOffloads", "batches dispatched to TPU")
+DEVICE_BYTES = REGISTRY.gauge("DeviceBytesMoved", "bytes copied host->device")
+WAL_COMMITS = REGISTRY.gauge("WalCommits", "search WAL commit records written")

@@ -1,0 +1,31 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Real TPU hardware is single-chip in this environment; multi-chip sharding is
+validated on virtual CPU devices (jax's xla_force_host_platform_device_count),
+matching how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+
+Must run before anything imports jax, hence top-of-conftest env mutation.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    from serenedb_tpu.utils import faults
+    faults.clear()

@@ -1,0 +1,62 @@
+import pytest
+
+from serenedb_tpu.utils import config, faults, log, metrics, ticks
+
+
+def test_settings_session_overrides_global():
+    s = config.SessionSettings()
+    assert s.get("sdb_nprobe") == 8
+    s.set("sdb_nprobe", "16")
+    assert s.get("sdb_nprobe") == 16
+    s.reset("sdb_nprobe")
+    assert s.get("sdb_nprobe") == 8
+    with pytest.raises(KeyError):
+        s.get("no_such_setting")
+
+
+def test_settings_bool_coercion():
+    s = config.SessionSettings()
+    s.set("sdb_strict_ddl", "on")
+    assert s.get("sdb_strict_ddl") is True
+    s.set("sdb_strict_ddl", "off")
+    assert s.get("sdb_strict_ddl") is False
+    with pytest.raises(ValueError):
+        s.set("sdb_strict_ddl", "maybe")
+
+
+def test_fault_arming_spec():
+    faults.arm_from_spec("a,b")
+    assert faults.armed("a") and faults.armed("b")
+    faults.arm_from_spec("-a")
+    assert not faults.armed("a") and faults.armed("b")
+    faults.arm_from_spec("+c")
+    assert faults.armed("b") and faults.armed("c")
+    faults.arm_from_spec("")
+    assert not faults.armed("b")
+    faults.arm_from_spec("x")
+    with pytest.raises(faults.FaultInjected):
+        faults.if_failure("x")
+    faults.if_failure("unarmed")  # no-op
+
+
+def test_gauge_scoped():
+    g = metrics.REGISTRY.gauge("TestGauge")
+    with g.scoped():
+        assert g.value == 1
+    assert g.value == 0
+
+
+def test_log_ring():
+    log.info("test", "hello")
+    recs = log.MANAGER.records()
+    assert any(r.message == "hello" and r.topic == "test" for r in recs)
+
+
+def test_tick_bands():
+    t = ticks.TickServer()
+    first = t.next(5)
+    assert first == 1
+    assert t.current() == 5
+    assert t.next() == 6
+    t.advance_to(100)
+    assert t.next() == 101
