@@ -1,0 +1,619 @@
+"""Engine facade: Database (catalog of tables) + Connection (session).
+
+Reference analog: the serened process + per-socket session driving one
+DuckDB connection (SURVEY.md §3.2). Here a Database owns the table
+namespace; Connections carry session settings and execute statements.
+The storage/catalog layers (WAL-backed search tables, versioned snapshots,
+RBAC) progressively replace the in-memory structures in this module.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from . import errors
+from .columnar import dtypes as dt
+from .columnar.column import Batch, Column, concat_batches
+from .exec.plan import ExecContext, PlanNode
+from .exec.tables import MemTable, ParquetTable, TableProvider
+from .sql import ast, parser
+from .sql.binder import ExprBinder, Scope, cast_column
+from .sql.planner import Planner, TableResolver
+from .utils import faults, log, metrics
+from .utils.config import SessionSettings
+
+
+@dataclass
+class QueryResult:
+    """One statement's result: rows (maybe empty) + a PG command tag."""
+    batch: Batch
+    command_tag: str
+
+    @property
+    def names(self) -> list[str]:
+        return self.batch.names
+
+    def rows(self) -> list[tuple]:
+        return self.batch.rows()
+
+    def scalar(self):
+        rs = self.rows()
+        return rs[0][0] if rs else None
+
+
+@dataclass
+class ViewDef:
+    name: str
+    query: ast.Select
+    sql: str
+
+
+class SchemaObj:
+    def __init__(self, name: str):
+        self.name = name
+        self.tables: dict[str, TableProvider] = {}
+        self.views: dict[str, ViewDef] = {}
+
+
+class Database(TableResolver):
+    """The process-wide database: schema → tables/views. Thread-safe for
+    DDL/DML via a coarse lock (fine-grained MVCC comes with the catalog
+    layer)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.lock = threading.RLock()
+        self.schemas: dict[str, SchemaObj] = {"main": SchemaObj("main")}
+        # parquet providers are cached by path so repeated queries reuse the
+        # provider's HBM column cache and compiled XLA programs
+        self._parquet_cache: dict[str, ParquetTable] = {}
+
+    # -- resolution (TableResolver) ---------------------------------------
+
+    def _split(self, parts: list[str]) -> tuple[str, str]:
+        if len(parts) == 1:
+            return "main", parts[0]
+        if len(parts) == 2:
+            return parts[0], parts[1]
+        # database.schema.table — single-database process, ignore the first
+        return parts[-2], parts[-1]
+
+    def resolve_table(self, parts: list[str]) -> TableProvider:
+        schema, name = self._split(parts)
+        with self.lock:
+            s = self.schemas.get(schema)
+            if s is None:
+                raise errors.SqlError(errors.UNDEFINED_TABLE,
+                                      f'schema "{schema}" does not exist')
+            t = s.tables.get(name.lower())
+            if t is not None:
+                return t
+            v = s.views.get(name.lower())
+            if v is not None:
+                raise _ViewRef(v)  # unwound by the planner wrapper below
+        from .pgcatalog import system_table
+        st = system_table(self, parts)
+        if st is not None:
+            return st
+        raise errors.SqlError(errors.UNDEFINED_TABLE,
+                              f'relation "{".".join(parts)}" does not exist')
+
+    def resolve_table_function(self, name: str, args: list) -> TableProvider:
+        if name in ("read_parquet", "parquet_scan"):
+            path = str(args[0])
+            with self.lock:
+                p = self._parquet_cache.get(path)
+                if p is None:
+                    p = self._parquet_cache[path] = ParquetTable(path)
+            return p
+        if name == "sdb_log":
+            from .pgcatalog import log_table
+            return log_table()
+        if name == "sdb_metrics":
+            from .pgcatalog import metrics_table
+            return metrics_table()
+        raise errors.SqlError(errors.UNDEFINED_FUNCTION,
+                              f"table function {name} does not exist")
+
+    # -- DDL ---------------------------------------------------------------
+
+    def create_schema(self, name: str, if_not_exists: bool):
+        with self.lock:
+            if name in self.schemas:
+                if if_not_exists:
+                    return
+                raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                                      f'schema "{name}" already exists')
+            self.schemas[name] = SchemaObj(name)
+
+    def create_table(self, schema: str, name: str, provider: TableProvider,
+                     if_not_exists: bool):
+        with self.lock:
+            s = self._schema(schema)
+            key = name.lower()
+            if key in s.tables or key in s.views:
+                if if_not_exists:
+                    return False
+                raise errors.SqlError(errors.DUPLICATE_TABLE,
+                                      f'relation "{name}" already exists')
+            s.tables[key] = provider
+            return True
+
+    def create_view(self, schema: str, name: str, view: ViewDef,
+                    or_replace: bool):
+        with self.lock:
+            s = self._schema(schema)
+            key = name.lower()
+            if key in s.tables:
+                raise errors.SqlError(errors.DUPLICATE_TABLE,
+                                      f'"{name}" is already a table')
+            if key in s.views and not or_replace:
+                raise errors.SqlError(errors.DUPLICATE_TABLE,
+                                      f'relation "{name}" already exists')
+            s.views[key] = view
+
+    def drop(self, kind: str, parts: list[str], if_exists: bool,
+             cascade: bool):
+        schema, name = self._split(parts)
+        with self.lock:
+            if kind == "schema":
+                target = parts[-1]
+                if target not in self.schemas:
+                    if if_exists:
+                        return
+                    raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                          f'schema "{target}" does not exist')
+                if target == "main":
+                    raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
+                                          "cannot drop schema main")
+                if self.schemas[target].tables and not cascade:
+                    raise errors.SqlError("2BP01",
+                                          f'schema "{target}" is not empty')
+                del self.schemas[target]
+                return
+            s = self._schema(schema, if_exists)
+            if s is None:
+                return
+            key = name.lower()
+            store = s.views if kind == "view" else s.tables
+            if key not in store:
+                if if_exists:
+                    return
+                raise errors.SqlError(errors.UNDEFINED_TABLE,
+                                      f'{kind} "{name}" does not exist')
+            del store[key]
+
+    def _schema(self, name: str, if_exists_ok: bool = False):
+        s = self.schemas.get(name)
+        if s is None and not if_exists_ok:
+            raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                  f'schema "{name}" does not exist')
+        return s
+
+    def table_list(self) -> list[tuple[str, str, str]]:
+        with self.lock:
+            out = []
+            for sname, s in self.schemas.items():
+                for t in s.tables:
+                    out.append((sname, t, "table"))
+                for v in s.views:
+                    out.append((sname, v, "view"))
+            return sorted(out)
+
+    def connect(self) -> "Connection":
+        return Connection(self)
+
+
+class _ViewRef(Exception):
+    def __init__(self, view: ViewDef):
+        self.view = view
+
+
+class _ResolverShim(TableResolver):
+    """Expands views inline during planning."""
+
+    def __init__(self, db: Database, planner_params):
+        self.db = db
+        self.params = planner_params
+
+    def resolve_table(self, parts: list[str]) -> TableProvider:
+        return self.db.resolve_table(parts)
+
+    def resolve_table_function(self, name, args):
+        return self.db.resolve_table_function(name, args)
+
+
+class Connection:
+    def __init__(self, db: Database):
+        self.db = db
+        self.settings = SessionSettings()
+        self.in_txn = False
+        self.txn_failed = False
+
+    # -- public API --------------------------------------------------------
+
+    def execute(self, sql: str, params: Optional[list] = None) -> QueryResult:
+        results = self.execute_all(sql, params)
+        return results[-1] if results else QueryResult(Batch([], []), "")
+
+    def execute_all(self, sql: str,
+                    params: Optional[list] = None) -> list[QueryResult]:
+        stmts = parser.parse(sql)
+        out = []
+        for st in stmts:
+            out.append(self.execute_statement(st, params or []))
+        return out
+
+    def execute_statement(self, st: ast.Statement,
+                          params: list) -> QueryResult:
+        if self.txn_failed and not isinstance(st, ast.Transaction):
+            raise errors.SqlError(
+                errors.IN_FAILED_TRANSACTION,
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block")
+        try:
+            with metrics.QUERIES_ACTIVE.scoped():
+                return self._dispatch(st, params)
+        except errors.SqlError:
+            if self.in_txn:
+                self.txn_failed = True
+            raise
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, st: ast.Statement, params: list) -> QueryResult:
+        if isinstance(st, ast.Select):
+            batch = self._run_select(st, params)
+            return QueryResult(batch, f"SELECT {batch.num_rows}")
+        if isinstance(st, ast.CreateTable):
+            return self._create_table(st, params)
+        if isinstance(st, ast.CreateSchema):
+            self.db.create_schema(st.name, st.if_not_exists)
+            return QueryResult(Batch([], []), "CREATE SCHEMA")
+        if isinstance(st, ast.CreateView):
+            schema, name = self.db._split(st.name)
+            self.db.create_view(schema, name,
+                                ViewDef(name, st.query, ""), st.or_replace)
+            return QueryResult(Batch([], []), "CREATE VIEW")
+        if isinstance(st, ast.CreateIndex):
+            return self._create_index(st)
+        if isinstance(st, ast.Drop):
+            self.db.drop(st.kind, st.name, st.if_exists, st.cascade)
+            return QueryResult(Batch([], []), f"DROP {st.kind.upper()}")
+        if isinstance(st, ast.Insert):
+            return self._insert(st, params)
+        if isinstance(st, ast.Delete):
+            return self._delete(st, params)
+        if isinstance(st, ast.Update):
+            return self._update(st, params)
+        if isinstance(st, ast.Truncate):
+            return self._truncate(st)
+        if isinstance(st, ast.SetStmt):
+            return self._set(st)
+        if isinstance(st, ast.ShowStmt):
+            return self._show(st)
+        if isinstance(st, ast.Transaction):
+            return self._txn(st)
+        if isinstance(st, ast.Explain):
+            return self._explain(st, params)
+        if isinstance(st, ast.VacuumStmt):
+            return self._vacuum(st)
+        if isinstance(st, ast.CopyStmt):
+            return self._copy(st, params)
+        raise errors.unsupported(f"statement {type(st).__name__}")
+
+    # -- SELECT ------------------------------------------------------------
+
+    def _plan(self, sel: ast.Select, params: list) -> PlanNode:
+        planner = Planner(_ResolverShim(self.db, params), params)
+        while True:
+            try:
+                return planner.plan_select(sel)
+            except _ViewRef as vr:
+                sel = _inline_view(sel, vr.view)
+
+    def _run_select(self, sel: ast.Select, params: list) -> Batch:
+        plan = self._plan(sel, params)
+        ctx = ExecContext(self.settings, params)
+        return plan.execute(ctx)
+
+    # -- DDL/DML -----------------------------------------------------------
+
+    def _create_table(self, st: ast.CreateTable, params: list) -> QueryResult:
+        schema, name = self.db._split(st.name)
+        if st.as_query is not None:
+            batch = self._run_select(st.as_query, params)
+            provider = MemTable(name, batch)
+        else:
+            cols = []
+            names = []
+            for cd in st.columns:
+                t = dt.type_from_name(cd.type_name)
+                names.append(cd.name)
+                cols.append(Column(t, np.empty(0, dtype=t.np_dtype), None,
+                                   np.empty(0, dtype=object)
+                                   if t.is_string else None))
+            provider = MemTable(name, Batch(names, cols))
+        provider.table_meta = {
+            "engine": st.engine,
+            "primary_key": st.primary_key,
+            "not_null": [c.name for c in st.columns if c.not_null],
+            "defaults": {c.name: c.default for c in st.columns if c.default},
+            "tokenizers": {c.name: c.tokenizer for c in st.columns
+                           if c.tokenizer},
+            "options": st.options,
+        }
+        created = self.db.create_table(schema, name, provider,
+                                       st.if_not_exists)
+        if st.as_query is not None and created:
+            return QueryResult(Batch([], []),
+                               f"SELECT {provider.row_count()}")
+        return QueryResult(Batch([], []), "CREATE TABLE")
+
+    def _create_index(self, st: ast.CreateIndex) -> QueryResult:
+        provider = self.db.resolve_table(st.table)
+        if not hasattr(provider, "indexes"):
+            provider.indexes = {}
+        idx_name = st.name or f"{st.table[-1]}_{'_'.join(st.columns)}_idx"
+        from .search.index import build_index_for_table
+        provider.indexes[idx_name] = build_index_for_table(
+            provider, st.columns, st.using, st.options)
+        return QueryResult(Batch([], []), "CREATE INDEX")
+
+    def _table_for_dml(self, parts: list[str]) -> MemTable:
+        provider = self.db.resolve_table(parts)
+        if not isinstance(provider, MemTable):
+            raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
+                                  "cannot modify this table")
+        return provider
+
+    def _insert(self, st: ast.Insert, params: list) -> QueryResult:
+        table = self._table_for_dml(st.table)
+        target_names = st.columns or table.column_names
+        for c in target_names:
+            if c not in table.column_names:
+                raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                      f'column "{c}" does not exist')
+        if st.query is not None:
+            incoming = self._run_select(st.query, params)
+        else:
+            binder = ExprBinder(Scope([]), params)
+            one = Batch(["__dummy"], [Column.from_pylist([0])])
+            cols_vals: list[list] = [[] for _ in target_names]
+            for row in st.values:
+                if len(row) != len(target_names):
+                    raise errors.SqlError(
+                        "42601", "INSERT has more expressions than columns"
+                        if len(row) > len(target_names)
+                        else "INSERT has more target columns than expressions")
+                for k, e in enumerate(row):
+                    b = binder.bind(e)
+                    cols_vals[k].append(b.eval(one).decode(0))
+            incoming = Batch(list(target_names),
+                             [Column.from_pylist(v) for v in cols_vals])
+        self._insert_batch(table, incoming)
+        return QueryResult(Batch([], []), f"INSERT 0 {incoming.num_rows}")
+
+    def _delete(self, st: ast.Delete, params: list) -> QueryResult:
+        table = self._table_for_dml(st.table)
+        with self.db.lock:
+            full = table.full_batch()
+            if st.where is None:
+                n = full.num_rows
+                table.replace(full.slice(0, 0))
+                return QueryResult(Batch([], []), f"DELETE {n}")
+            scope = Scope.of(list(full.names), [c.type for c in full.columns],
+                             st.table[-1])
+            pred = ExprBinder(scope, params).bind(st.where)
+            c = pred.eval(full)
+            mask = c.data.astype(bool) & c.valid_mask()
+            n = int(mask.sum())
+            table.replace(full.filter(~mask))
+        return QueryResult(Batch([], []), f"DELETE {n}")
+
+    def _update(self, st: ast.Update, params: list) -> QueryResult:
+        table = self._table_for_dml(st.table)
+        with self.db.lock:
+            full = table.full_batch()
+            scope = Scope.of(list(full.names), [c.type for c in full.columns],
+                             st.table[-1])
+            binder = ExprBinder(scope, params)
+            if st.where is not None:
+                c = binder.bind(st.where).eval(full)
+                mask = c.data.astype(bool) & c.valid_mask()
+            else:
+                mask = np.ones(full.num_rows, dtype=bool)
+            n = int(mask.sum())
+            new_cols = {}
+            for col_name, e in st.assignments:
+                if col_name not in full:
+                    raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                          f'column "{col_name}" does not exist')
+                target_t = full.column(col_name).type
+                val = _coerce(binder.bind(e).eval(full), target_t)
+                cur = full.column(col_name)
+                merged_vals = [
+                    val.decode(i) if mask[i] else cur.decode(i)
+                    for i in range(full.num_rows)]
+                new_cols[col_name] = Column.from_pylist(merged_vals, target_t)
+            cols = [new_cols.get(nm, c)
+                    for nm, c in zip(full.names, full.columns)]
+            table.replace(Batch(list(full.names), cols))
+        return QueryResult(Batch([], []), f"UPDATE {n}")
+
+    def _truncate(self, st: ast.Truncate) -> QueryResult:
+        table = self._table_for_dml(st.table)
+        with self.db.lock:
+            table.replace(table.full_batch().slice(0, 0))
+        return QueryResult(Batch([], []), "TRUNCATE TABLE")
+
+    # -- session statements ------------------------------------------------
+
+    def _set(self, st: ast.SetStmt) -> QueryResult:
+        if st.value == "DEFAULT":
+            self.settings.reset(st.name)
+        else:
+            self.settings.set(st.name, st.value)
+            if st.name == "sdb_faults":
+                faults.arm_from_spec(str(st.value))
+        return QueryResult(Batch([], []), "SET")
+
+    def _show(self, st: ast.ShowStmt) -> QueryResult:
+        if st.name == "tables":
+            rows = self.db.table_list()
+            b = Batch.from_pydict({
+                "schema": [r[0] for r in rows],
+                "name": [r[1] for r in rows],
+                "kind": [r[2] for r in rows]})
+            return QueryResult(b, f"SELECT {b.num_rows}")
+        if st.name == "all":
+            names = self.settings._registry.names()
+            b = Batch.from_pydict({
+                "name": names,
+                "setting": [str(self.settings.get(n)) for n in names]})
+            return QueryResult(b, f"SELECT {b.num_rows}")
+        v = self.settings.get(st.name)
+        b = Batch.from_pydict({st.name: [_setting_text(v)]})
+        return QueryResult(b, "SHOW")
+
+    def _txn(self, st: ast.Transaction) -> QueryResult:
+        # single-statement autocommit engine for now: BEGIN/COMMIT tracked
+        # for wire-protocol status; ROLLBACK clears failure state.
+        if st.action == "begin":
+            self.in_txn = True
+            self.txn_failed = False
+            return QueryResult(Batch([], []), "BEGIN")
+        self.in_txn = False
+        self.txn_failed = False
+        return QueryResult(Batch([], []),
+                           "COMMIT" if st.action == "commit" else "ROLLBACK")
+
+    def _explain(self, st: ast.Explain, params: list) -> QueryResult:
+        if not isinstance(st.inner, ast.Select):
+            raise errors.unsupported("EXPLAIN of non-SELECT")
+        plan = self._plan(st.inner, params)
+        lines = plan.explain()
+        b = Batch.from_pydict({"QUERY PLAN": lines})
+        return QueryResult(b, f"SELECT {len(lines)}")
+
+    def _vacuum(self, st: ast.VacuumStmt) -> QueryResult:
+        return QueryResult(Batch([], []), "VACUUM")
+
+    def _copy(self, st: ast.CopyStmt, params: list) -> QueryResult:
+        fmt = str(st.options.get("format", "csv")).lower()
+        if st.direction == "from":
+            table = self._table_for_dml(st.table)
+            if fmt == "parquet":
+                incoming = ParquetTable(st.target).full_batch()
+            elif fmt in ("csv", "text"):
+                incoming = _read_csv(st.target, table, st.options)
+            else:
+                raise errors.unsupported(f"COPY format {fmt}")
+            names = st.columns or list(incoming.names)
+            sub = Batch(names, [incoming.columns[i]
+                                for i in range(len(names))])
+            self._insert_batch(table, sub)
+            return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
+        # COPY TO
+        provider = self.db.resolve_table(st.table)
+        full = provider.full_batch(st.columns)
+        if fmt == "parquet":
+            _write_parquet(st.target, full)
+        else:
+            _write_csv(st.target, full, st.options)
+        return QueryResult(Batch([], []), f"COPY {full.num_rows}")
+
+    def _insert_batch(self, table: MemTable, incoming: Batch):
+        with self.db.lock:
+            current = table.full_batch()
+            new_cols = []
+            for name, cur in zip(table.column_names, current.columns):
+                if name in incoming.names:
+                    add = _coerce(incoming.column(name), cur.type)
+                else:
+                    add = Column.from_pylist([None] * incoming.num_rows,
+                                             cur.type)
+                merged = concat_batches(
+                    [Batch([name], [cur]), Batch([name], [add])]).columns[0]
+                new_cols.append(merged)
+            table.replace(Batch(list(table.column_names), new_cols))
+
+
+def _coerce(col: Column, target: dt.SqlType) -> Column:
+    if col.type == target or col.type.id is dt.TypeId.NULL:
+        if col.type.id is dt.TypeId.NULL and target.id is not dt.TypeId.NULL:
+            return Column.from_pylist([None] * len(col), target)
+        return col
+    return cast_column(col, target)
+
+
+def _setting_text(v) -> str:
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    return str(v)
+
+
+def _inline_view(sel: ast.Select, view: ViewDef) -> ast.Select:
+    """Replace references to the view with a subquery ref."""
+    def rewrite(ref: ast.TableRef) -> ast.TableRef:
+        if isinstance(ref, ast.NamedTable) and \
+                ref.parts[-1].lower() == view.name.lower():
+            return ast.SubqueryRef(view.query, ref.alias or view.name)
+        if isinstance(ref, ast.JoinRef):
+            ref.left = rewrite(ref.left)
+            ref.right = rewrite(ref.right)
+        return ref
+    import copy
+    sel2 = copy.deepcopy(sel)
+    if sel2.from_ is not None:
+        sel2.from_ = rewrite(sel2.from_)
+    return sel2
+
+
+def _read_csv(path: str, table: MemTable, options: dict) -> Batch:
+    import csv as _csv
+    delim = str(options.get("delimiter", ","))
+    header = str(options.get("header", "false")).lower() in ("true", "on", "1")
+    with open(path, newline="") as f:
+        rows = list(_csv.reader(f, delimiter=delim))
+    if header and rows:
+        rows = rows[1:]
+    names = table.column_names
+    cols = []
+    for k, (nm, t) in enumerate(zip(names, table.column_types)):
+        vals = []
+        for r in rows:
+            raw = r[k] if k < len(r) else ""
+            if raw == "" or raw == "\\N":
+                vals.append(None)
+            else:
+                from .sql.binder import _cast_text_to
+                vals.append(raw if t.is_string else _cast_text_to(raw, t))
+        cols.append(Column.from_pylist(vals, t))
+    return Batch(list(names), cols)
+
+
+def _write_csv(path: str, batch: Batch, options: dict):
+    import csv as _csv
+    delim = str(options.get("delimiter", ","))
+    header = str(options.get("header", "false")).lower() in ("true", "on", "1")
+    with open(path, "w", newline="") as f:
+        w = _csv.writer(f, delimiter=delim)
+        if header:
+            w.writerow(batch.names)
+        for row in batch.rows():
+            w.writerow(["" if v is None else v for v in row])
+
+
+def _write_parquet(path: str, batch: Batch):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    arrays = []
+    for c in batch.columns:
+        vals = c.to_pylist()
+        arrays.append(pa.array(vals))
+    pq.write_table(pa.table(dict(zip(batch.names, arrays))), path)

@@ -1,0 +1,44 @@
+"""PG error model: SQLSTATE-coded exceptions.
+
+Reference analog: libs/pg/{errcodes.h,sql_exception.h} + THROW_SQL_ERROR
+macros (SURVEY.md §2.3). Codes follow the PostgreSQL SQLSTATE space so the
+wire layer can emit proper ErrorResponse fields.
+"""
+
+from __future__ import annotations
+
+
+class SqlError(Exception):
+    def __init__(self, sqlstate: str, message: str, detail: str = "",
+                 hint: str = ""):
+        super().__init__(message)
+        self.sqlstate = sqlstate
+        self.message = message
+        self.detail = detail
+        self.hint = hint
+
+
+# common SQLSTATEs
+SYNTAX_ERROR = "42601"
+UNDEFINED_TABLE = "42P01"
+UNDEFINED_COLUMN = "42703"
+UNDEFINED_FUNCTION = "42883"
+DUPLICATE_TABLE = "42P07"
+DUPLICATE_OBJECT = "42710"
+AMBIGUOUS_COLUMN = "42702"
+DATATYPE_MISMATCH = "42804"
+INVALID_TEXT_REPRESENTATION = "22P02"
+DIVISION_BY_ZERO = "22012"
+NUMERIC_OUT_OF_RANGE = "22003"
+FEATURE_NOT_SUPPORTED = "0A000"
+INSUFFICIENT_PRIVILEGE = "42501"
+UNDEFINED_OBJECT = "42704"
+IN_FAILED_TRANSACTION = "25P02"
+
+
+def syntax(msg: str) -> SqlError:
+    return SqlError(SYNTAX_ERROR, msg)
+
+
+def unsupported(msg: str) -> SqlError:
+    return SqlError(FEATURE_NOT_SUPPORTED, msg)

@@ -1,0 +1,3 @@
+from . import device, plan, tables
+
+__all__ = ["device", "plan", "tables"]

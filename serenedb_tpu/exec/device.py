@@ -1,0 +1,271 @@
+"""Device (TPU) compilation of bound expressions over cached HBM columns.
+
+This is the offload seam the reference doesn't have (SURVEY.md §5.8): the
+planner's Scan→Filter→Aggregate chains compile to one jitted XLA program per
+(table, query) pair — predicate, mask logic, and reduction fuse into a single
+HBM pass. Strings participate as sorted-dictionary codes: literal
+comparisons are resolved to code thresholds on host at compile time
+(code order == string order, columnar/column.py).
+
+Expressions evaluate to (value, valid) pairs — SQL three-valued logic on
+device, matching the CPU oracle in sql/expr.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn
+from ..sql.binder import _expr_key
+from ..sql.expr import (BoundColumn, BoundExpr, BoundFunc, BoundLiteral)
+
+_NUMERIC_IDS = {dt.TypeId.BOOL, dt.TypeId.TINYINT, dt.TypeId.SMALLINT,
+                dt.TypeId.INT, dt.TypeId.BIGINT, dt.TypeId.FLOAT,
+                dt.TypeId.DOUBLE, dt.TypeId.TIMESTAMP, dt.TypeId.DATE}
+
+_CMP = {"op=", "op<>", "op!=", "op<", "op<=", "op>", "op>="}
+_ARITH = {"op+", "op-", "op*", "op/", "op%"}
+
+
+class NotCompilable(Exception):
+    pass
+
+
+class DeviceExpr:
+    """Compiled closure producing (value, valid) given the env of device
+    columns; env maps scan-column index → DeviceColumn."""
+
+    def __init__(self, fn: Callable, inputs: list[int]):
+        self.fn = fn          # (list of (data, mask)) -> (value, valid)
+        self.inputs = inputs  # scan column indices, order matches fn args
+
+
+def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
+                 dictionaries: dict[int, np.ndarray]) -> DeviceExpr:
+    """Compile a bound expression to a device closure.
+
+    dictionaries: scan column index → sorted dictionary (VARCHAR columns),
+    used to resolve string literals to code thresholds at compile time.
+    Raises NotCompilable for unsupported shapes (caller falls back to CPU).
+    """
+    inputs: list[int] = []
+    index_of: dict[int, int] = {}
+
+    def slot(col_index: int) -> int:
+        if col_index not in index_of:
+            index_of[col_index] = len(inputs)
+            inputs.append(col_index)
+        return index_of[col_index]
+
+    def rec(e: BoundExpr):
+        if isinstance(e, BoundLiteral):
+            if e.value is None:
+                return lambda env: (jnp.int32(0), False)
+            if isinstance(e.value, bool):
+                v = jnp.int32(1 if e.value else 0)
+            elif isinstance(e.value, int):
+                if not (-2**31 <= e.value < 2**31):
+                    raise NotCompilable("int64 literal")
+                v = jnp.int32(e.value)
+            elif isinstance(e.value, float):
+                v = jnp.float32(e.value)
+            else:
+                raise NotCompilable("string literal outside comparison")
+            return lambda env, _v=v: (_v, True)
+        if isinstance(e, BoundColumn):
+            if e.type.id not in _NUMERIC_IDS and not e.type.is_string:
+                raise NotCompilable(f"column type {e.type}")
+            s = slot(e.index)
+            return lambda env, _s=s: env[_s]
+        if isinstance(e, BoundFunc):
+            return rec_func(e)
+        raise NotCompilable(type(e).__name__)
+
+    def rec_func(e: BoundFunc):
+        name = e.name
+        if name in _CMP:
+            return compile_compare(e)
+        if name in _ARITH:
+            return compile_arith(e)
+        if name in ("and", "or"):
+            subs = [rec(a) for a in e.args]
+            is_and = name == "and"
+
+            def fn(env, _subs=subs, _and=is_and):
+                vals = [s(env) for s in _subs]
+                bools = [_as_bool(v) for v, _ in vals]
+                oks = [_m(ok) for _, ok in vals]
+                any_null = functools.reduce(jnp.logical_or,
+                                            [~ok for ok in oks])
+                if _and:
+                    any_false = functools.reduce(
+                        jnp.logical_or,
+                        [jnp.logical_and(ok, ~b) for b, ok in zip(bools, oks)])
+                    return ~any_false, jnp.logical_or(any_false, ~any_null)
+                any_true = functools.reduce(
+                    jnp.logical_or,
+                    [jnp.logical_and(ok, b) for b, ok in zip(bools, oks)])
+                return any_true, jnp.logical_or(any_true, ~any_null)
+            return fn
+        if name == "not":
+            sub = rec(e.args[0])
+
+            def fn(env, _sub=sub):
+                v, ok = _sub(env)
+                return ~_as_bool(v), ok
+            return fn
+        if name == "is_null":
+            sub = rec(e.args[0])
+
+            def fn(env, _sub=sub):
+                v, ok = _sub(env)
+                return ~_m(ok), True
+            return fn
+        if name == "cast":
+            sub = rec(e.args[0])
+            if e.type.is_float:
+                def fn(env, _sub=sub):
+                    v, ok = _sub(env)
+                    return v.astype(jnp.float32), ok
+                return fn
+            if e.type.is_integer:
+                def fn(env, _sub=sub):
+                    v, ok = _sub(env)
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        # PG: round half away from zero
+                        r = jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+                        return r.astype(jnp.int32), ok
+                    return v.astype(jnp.int32), ok
+                return fn
+            raise NotCompilable("cast target")
+        raise NotCompilable(f"function {name}")
+
+    def compile_compare(e: BoundFunc):
+        a, b = e.args
+        name = e.name
+        # string vs literal → code threshold
+        for col, lit, flip in ((a, b, False), (b, a, True)):
+            if isinstance(col, BoundColumn) and col.type.is_string and \
+                    isinstance(lit, BoundLiteral) and isinstance(lit.value, str):
+                d = dictionaries.get(col.index)
+                if d is None:
+                    raise NotCompilable("no dictionary for string column")
+                return compile_str_cmp(col, lit.value, name, flip, d)
+        if (isinstance(a, BoundColumn) and a.type.is_string) or \
+                (isinstance(b, BoundColumn) and b.type.is_string):
+            raise NotCompilable("string-string comparison on device")
+        fa, fb = rec(a), rec(b)
+        op = name[2:]
+
+        def fn(env, _fa=fa, _fb=fb, _op=op):
+            (va, oka), (vb, okb) = _fa(env), _fb(env)
+            va, vb = _unify(va, vb)
+            if _op == "=":
+                v = va == vb
+            elif _op in ("<>", "!="):
+                v = va != vb
+            elif _op == "<":
+                v = va < vb
+            elif _op == "<=":
+                v = va <= vb
+            elif _op == ">":
+                v = va > vb
+            else:
+                v = va >= vb
+            return v, jnp.logical_and(_m(oka), _m(okb))
+        return fn
+
+    def compile_str_cmp(col: BoundColumn, s: str, name: str, flip: bool,
+                        d: np.ndarray):
+        """col OP 'literal' on sorted dictionary codes."""
+        op = name[2:]
+        if flip:  # 'literal' OP col  →  col FLIP(OP) literal
+            op = {"=": "=", "<>": "<>", "!=": "<>", "<": ">", "<=": ">=",
+                  ">": "<", ">=": "<="}[op]
+        ds = d.astype(str)
+        lo = int(np.searchsorted(ds, s, side="left"))
+        hi = int(np.searchsorted(ds, s, side="right"))
+        exact = lo < len(ds) and ds[lo] == s
+        sl = slot(col.index)
+
+        def fn(env, _sl=sl, _op=op, _lo=lo, _hi=hi, _exact=exact):
+            codes, ok = env[_sl]
+            if _op == "=":
+                v = (codes == _lo) if _exact else jnp.zeros_like(codes, dtype=bool)
+            elif _op == "<>":
+                v = (codes != _lo) if _exact else jnp.ones_like(codes, dtype=bool)
+            elif _op == "<":
+                v = codes < _lo
+            elif _op == "<=":
+                v = codes < _hi
+            elif _op == ">":
+                v = codes >= _hi
+            else:
+                v = codes >= _lo
+            return v, _m(ok)
+        return fn
+
+    def compile_arith(e: BoundFunc):
+        fa, fb = rec(e.args[0]), rec(e.args[1])
+        op = e.name[2:]
+        int_result = e.type.is_integer
+
+        def fn(env, _fa=fa, _fb=fb, _op=op, _int=int_result):
+            (va, oka), (vb, okb) = _fa(env), _fb(env)
+            va, vb = _unify(va, vb)
+            ok = jnp.logical_and(_m(oka), _m(okb))
+            if _op == "+":
+                return va + vb, ok
+            if _op == "-":
+                return va - vb, ok
+            if _op == "*":
+                return va * vb, ok
+            raise NotCompilable("device division")  # PG trunc semantics: CPU
+        return fn
+
+    top = rec(expr)
+    return DeviceExpr(top, inputs)
+
+
+def _m(ok):
+    return ok if not isinstance(ok, bool) else jnp.bool_(ok)
+
+
+def _as_bool(v):
+    if v.dtype == jnp.bool_:
+        return v
+    return v != 0
+
+
+def _unify(va, vb):
+    fa = hasattr(va, "dtype") and jnp.issubdtype(va.dtype, jnp.floating)
+    fb = hasattr(vb, "dtype") and jnp.issubdtype(vb.dtype, jnp.floating)
+    if fa or fb:
+        return (va.astype(jnp.float32) if hasattr(va, "astype") else jnp.float32(va),
+                vb.astype(jnp.float32) if hasattr(vb, "astype") else jnp.float32(vb))
+    return va, vb
+
+
+# -- jitted program cache --------------------------------------------------
+
+_PROGRAM_CACHE: dict = {}
+
+
+def cached_jit(key: tuple, builder: Callable):
+    """Per-(provider, query-shape) jit cache so repeated queries reuse the
+    compiled XLA program (first TPU compile is ~seconds; steady-state is the
+    benchmark regime)."""
+    prog = _PROGRAM_CACHE.get(key)
+    if prog is None:
+        prog = _PROGRAM_CACHE[key] = jax.jit(builder)
+    return prog
+
+
+def expr_cache_key(provider, expr: Optional[BoundExpr]) -> tuple:
+    return (id(provider), _expr_key(expr) if expr is not None else "<none>")

@@ -1,0 +1,367 @@
+"""Device-offloaded Scan→Filter→Aggregate (the flagship TPU path).
+
+Mirrors the reference's hottest analytics loop (morsel-parallel filter +
+hash aggregate over the columnstore; ClickBench shapes in BASELINE.md) as a
+single jitted XLA program per (table, query) over HBM-cached columns:
+
+    mask   = predicate(cols) & validity          (fused elementwise)
+    counts = one-hot matmul / scatter over codes (ops/agg.py)
+    sums   = exact int64 via limb scatter        (ops/agg.py)
+
+Falls back to the CPU oracle (plan.AggregateNode._cpu_aggregate) whenever
+anything in the query shape isn't device-compilable — result parity between
+the two paths is asserted in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..ops import agg as ops_agg
+from ..sql.binder import _expr_key
+from ..sql.expr import AggSpec, BoundColumn, BoundExpr
+from ..utils import log, metrics
+from .device import DeviceExpr, NotCompilable, compile_expr
+from .tables import TableProvider
+
+MAX_GROUP_PRODUCT = 1 << 21   # combined-key code-space cap
+MAX_INT_KEY_RANGE = 1 << 20   # direct-coding range cap for integer keys
+
+_AGG_FUNCS = {"count_star", "count", "sum", "min", "max", "avg"}
+
+
+def try_device_aggregate(node, ctx) -> Optional[Batch]:
+    """Attempt device execution of an AggregateNode; None → CPU fallback."""
+    from .plan import FilterNode, ScanNode
+
+    device = ctx.settings.get("serene_device")
+    if device == "cpu":
+        return None
+    # unwrap Filter(Scan) / Scan
+    child = node.child
+    preds: list[BoundExpr] = []
+    while isinstance(child, FilterNode):
+        preds.append(child.pred)
+        child = child.child
+    if not isinstance(child, ScanNode):
+        return None
+    scan = child
+    if scan.filter is not None:
+        preds.append(scan.filter)
+    provider = scan.provider
+    if device == "auto" and \
+            provider.row_count() < ctx.settings.get("serene_device_min_rows"):
+        return None
+    for spec in node.aggs:
+        if spec.func not in _AGG_FUNCS or spec.distinct:
+            return None
+    try:
+        return _run(node, scan, provider, preds, ctx)
+    except NotCompilable as e:
+        log.debug("device", f"aggregate fell back to CPU: {e}")
+        return None
+
+
+def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Batch:
+    col_names = scan.columns
+
+    # only referenced string columns need their dictionary materialized
+    referenced: set[int] = set()
+    for e in preds + list(node.group_exprs) + \
+            [s.arg for s in node.aggs if s.arg is not None]:
+        for sub in e.walk():
+            if isinstance(sub, BoundColumn):
+                referenced.add(sub.index)
+    dictionaries: dict[int, np.ndarray] = {}
+    for i in sorted(referenced):
+        if provider.type_of(col_names[i]).is_string:
+            col = provider.host_column(col_names[i])
+            if col.dictionary is not None:
+                dictionaries[i] = col.dictionary
+
+    compiled_preds = [compile_expr(p, scan.types, dictionaries) for p in preds]
+
+    # group keys: direct coding only (dict codes / small-range ints)
+    key_plans = []
+    group_space = 1
+    for g in node.group_exprs:
+        if not isinstance(g, BoundColumn):
+            raise NotCompilable("group key must be a plain column (for now)")
+        t = scan.types[g.index]
+        if t.is_string:
+            d = dictionaries.get(g.index)
+            if d is None:
+                raise NotCompilable("string key without dictionary")
+            size = len(d) + 1      # +1: NULL group
+            key_plans.append(("dict", g.index, 0, size))
+        elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
+            col = provider.host_column(col_names[g.index])
+            if col.data.size == 0:
+                lo, hi = 0, 0
+            else:
+                lo, hi = int(col.data.min()), int(col.data.max())
+            rng = hi - lo + 1
+            if rng > MAX_INT_KEY_RANGE:
+                raise NotCompilable("integer key range too large for direct coding")
+            size = rng + 1
+            key_plans.append(("int", g.index, lo, size))
+        else:
+            raise NotCompilable(f"group key type {t}")
+        group_space *= size
+        if group_space > MAX_GROUP_PRODUCT:
+            raise NotCompilable("group code space too large")
+
+    agg_plans = []
+    for spec in node.aggs:
+        if spec.func == "count_star":
+            agg_plans.append((spec, None))
+        else:
+            if spec.arg.type.is_string and spec.func != "count":
+                raise NotCompilable(f"{spec.func} over strings")
+            agg_plans.append((spec, compile_expr(spec.arg, scan.types,
+                                                 dictionaries)))
+
+    # collect needed device columns
+    needed: set[int] = set()
+    for ce in compiled_preds:
+        needed.update(ce.inputs)
+    for kp in key_plans:
+        needed.add(kp[1])
+    for spec, ce in agg_plans:
+        if ce is not None:
+            needed.update(ce.inputs)
+    needed = sorted(needed) or [0]  # count(*)-only queries still need a shape
+    env_cols = {i: provider.device_column(col_names[i]) for i in needed}
+    metrics.DEVICE_OFFLOADS.add()
+
+    import jax.numpy as jnp
+
+    def env_for(ce: DeviceExpr, arrays):
+        return [arrays[i] for i in ce.inputs]
+
+    group_mode = bool(key_plans)
+
+    def program(*flat):
+        arrays = {}
+        for k, i in enumerate(needed):
+            arrays[i] = (flat[2 * k], flat[2 * k + 1])
+        rowmask = flat[-1]
+        mask = rowmask
+        for ce in compiled_preds:
+            v, ok = ce.fn(env_for(ce, arrays))
+            b = v if v.dtype == jnp.bool_ else (v != 0)
+            mask = jnp.logical_and(mask, jnp.logical_and(b, ok))
+        outputs = []
+        if group_mode:
+            codes = jnp.zeros_like(mask, dtype=jnp.int32)
+            for kind, idx, lo, size in key_plans:
+                data, ok = arrays[idx]
+                if kind == "dict":
+                    c = data.astype(jnp.int32)
+                else:
+                    c = (data.astype(jnp.int32) - jnp.int32(lo))
+                c = jnp.where(ok, c, jnp.int32(size - 1))
+                codes = codes * jnp.int32(size) + jnp.clip(c, 0, size - 1)
+            outputs.append(
+                ops_agg.group_count_scatter(codes, mask, group_space))
+            for spec, ce in agg_plans:
+                outputs.extend(
+                    _group_agg_device(spec, ce, arrays, codes, mask,
+                                      env_for, group_space))
+        else:
+            outputs.append(jnp.sum(mask, dtype=jnp.int32))
+            for spec, ce in agg_plans:
+                outputs.extend(
+                    _scalar_agg_device(spec, ce, arrays, mask, env_for))
+        return tuple(outputs)
+
+    key = (id(provider), provider.data_version,
+           tuple(_expr_key(p) for p in preds),
+           tuple(_expr_key(g) for g in node.group_exprs),
+           tuple((s.func, _expr_key(s.arg)) for s in node.aggs))
+    from .device import _PROGRAM_CACHE
+    jitted = _PROGRAM_CACHE.get(key)
+    if jitted is None:
+        jitted = _PROGRAM_CACHE[key] = jax.jit(program)
+
+    flat_args = []
+    for i in needed:
+        dc = env_cols[i]
+        flat_args.extend([dc.data, dc.mask])
+    # A column's device mask excludes padding but ALSO that column's NULLs —
+    # wrong as a row mask for count(*). Use a pure row-validity mask built
+    # from the logical length (cached on the provider: it's per-table state).
+    dc0 = env_cols[needed[0]]
+    rowmask_arr = getattr(provider, "_device_rowmask", None)
+    if rowmask_arr is None or rowmask_arr.shape != dc0.mask.shape:
+        nrows = provider.row_count()
+        rm = np.zeros(dc0.padded_rows, dtype=bool)
+        rm[:nrows] = True
+        rowmask_arr = jnp.asarray(rm.reshape(-1, 128))
+        provider._device_rowmask = rowmask_arr
+    results = jitted(*flat_args, rowmask_arr)
+
+    if group_mode:
+        return _build_group_batch(node, key_plans, agg_plans, results,
+                                  provider, col_names, dictionaries,
+                                  group_space)
+    return _build_scalar_batch(node, agg_plans, results)
+
+
+def _scalar_agg_device(spec: AggSpec, ce, arrays, mask, env_for):
+    import jax.numpy as jnp
+    if spec.func == "count_star":
+        return []  # uses the shared row count output
+    v, ok = ce.fn(env_for(ce, arrays))
+    m = jnp.logical_and(mask, ok)
+    if spec.func == "count":
+        return [jnp.sum(m, dtype=jnp.int32)]
+    is_float = jnp.issubdtype(v.dtype, jnp.floating)
+    if spec.func in ("sum", "avg"):
+        cnt = jnp.sum(m, dtype=jnp.int32)
+        if is_float:
+            s = jnp.sum(jnp.where(m, v, 0.0).astype(jnp.float32))
+            return [s, cnt]
+        return [ops_agg.masked_sum_int_partials(v, m), cnt]
+    if spec.func in ("min", "max"):
+        if is_float:
+            ident = jnp.inf if spec.func == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(v.dtype)
+            ident = info.max if spec.func == "min" else info.min
+        vv = jnp.where(m, v, ident)
+        red = jnp.min(vv) if spec.func == "min" else jnp.max(vv)
+        return [red, jnp.sum(m, dtype=jnp.int32)]
+    raise NotCompilable(spec.func)
+
+
+def _group_agg_device(spec: AggSpec, ce, arrays, codes, mask, env_for, g):
+    import jax.numpy as jnp
+    if spec.func == "count_star":
+        return []  # shared group counts output
+    v, ok = ce.fn(env_for(ce, arrays))
+    m = jnp.logical_and(mask, ok)
+    if spec.func == "count":
+        return [ops_agg.group_count_scatter(codes, m, g)]
+    is_float = jnp.issubdtype(v.dtype, jnp.floating)
+    if spec.func in ("sum", "avg"):
+        cnt = ops_agg.group_count_scatter(codes, m, g)
+        if is_float:
+            return [ops_agg.group_sum_float(codes, m, v, g), cnt]
+        if codes.shape[0] > ops_agg.SCATTER_CHUNK_TILES:
+            return [ops_agg.group_sum_int_limbs_chunked(codes, m, v, g), cnt]
+        return [ops_agg.group_sum_int_limbs(codes, m, v, g), cnt]
+    if spec.func in ("min", "max"):
+        return [ops_agg.group_min_max(codes, m, v, g, spec.func),
+                ops_agg.group_count_scatter(codes, m, g)]
+    raise NotCompilable(spec.func)
+
+
+def _build_scalar_batch(node, agg_plans, results) -> Batch:
+    ri = iter(results)
+    total = int(np.asarray(next(ri)))
+    cols = []
+    for spec, ce in agg_plans:
+        cols.append(_scalar_result_col(spec, ri, total))
+    return Batch(list(node.names), cols)
+
+
+def _scalar_result_col(spec: AggSpec, ri, total: int) -> Column:
+    t = spec.type
+    if spec.func == "count_star":
+        return Column.from_pylist([total], t)
+    if spec.func == "count":
+        return Column.from_pylist([int(np.asarray(next(ri)))], t)
+    if spec.func in ("sum", "avg"):
+        first = np.asarray(next(ri))
+        cnt = int(np.asarray(next(ri)))
+        if first.ndim == 0:
+            s = float(first)
+        else:
+            parts = first.astype(np.int64)
+            s = int((parts[:, 0].sum() << 16) + parts[:, 1].sum())
+        if cnt == 0:
+            return Column.from_pylist([None], t)
+        if spec.func == "avg":
+            return Column.from_pylist([s / cnt], t)
+        return Column.from_pylist([s if t.is_integer else float(s)], t)
+    if spec.func in ("min", "max"):
+        v = np.asarray(next(ri))
+        cnt = int(np.asarray(next(ri)))
+        if cnt == 0:
+            return Column.from_pylist([None], t)
+        out = v.item()
+        if t.is_integer:
+            out = int(out)
+        return Column.from_pylist([out], t)
+    raise NotCompilable(spec.func)
+
+
+def _build_group_batch(node, key_plans, agg_plans, results, provider,
+                       col_names, dictionaries, g) -> Batch:
+    ri = iter(results)
+    counts = np.asarray(next(ri)).astype(np.int64)
+    present = np.flatnonzero(counts > 0)
+    # decode combined codes back to per-key codes
+    sizes = [kp[3] for kp in key_plans]
+    rem = present.copy()
+    key_codes = []
+    for size in reversed(sizes):
+        key_codes.append(rem % size)
+        rem //= size
+    key_codes.reverse()
+    cols: list[Column] = []
+    for (kind, idx, lo, size), kc in zip(key_plans, key_codes):
+        null_mask = kc == (size - 1)
+        t = provider.type_of(col_names[idx])
+        if kind == "dict":
+            d = dictionaries[idx]
+            data = np.where(null_mask, 0, kc).astype(np.int32)
+            cols.append(Column(t, data,
+                               ~null_mask if null_mask.any() else None, d))
+        else:
+            data = (kc + lo).astype(t.np_dtype)
+            data = np.where(null_mask, 0, data).astype(t.np_dtype)
+            cols.append(Column(t, data,
+                               ~null_mask if null_mask.any() else None))
+    for spec, ce in agg_plans:
+        cols.append(_group_result_col(spec, ri, counts, present))
+    return Batch(list(node.names), cols)
+
+
+def _group_result_col(spec: AggSpec, ri, star_counts, present) -> Column:
+    t = spec.type
+    if spec.func == "count_star":
+        return Column(dt.BIGINT, star_counts[present])
+    if spec.func == "count":
+        c = np.asarray(next(ri)).astype(np.int64)
+        return Column(dt.BIGINT, c[present])
+    if spec.func in ("sum", "avg"):
+        first = np.asarray(next(ri))
+        cnt = np.asarray(next(ri)).astype(np.int64)[present]
+        if first.ndim >= 2:  # int limbs (G,5) or chunked (C,G,5)
+            sums = ops_agg.combine_sum_int_limbs(first)[present]
+        else:
+            sums = first.astype(np.float64)[present]
+        empty = cnt == 0
+        if spec.func == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                data = np.where(empty, 0.0, sums / np.maximum(cnt, 1))
+            return Column(dt.DOUBLE, data, ~empty if empty.any() else None)
+        if t.is_integer:
+            return Column(dt.BIGINT, sums.astype(np.int64),
+                          ~empty if empty.any() else None)
+        return Column(dt.DOUBLE, sums.astype(np.float64),
+                      ~empty if empty.any() else None)
+    if spec.func in ("min", "max"):
+        v = np.asarray(next(ri))[present]
+        cnt = np.asarray(next(ri)).astype(np.int64)[present]
+        empty = cnt == 0
+        data = np.where(empty, 0, v).astype(t.np_dtype)
+        return Column(t, data, ~empty if empty.any() else None)
+    raise NotCompilable(spec.func)

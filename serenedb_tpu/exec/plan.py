@@ -1,0 +1,585 @@
+"""Physical plan nodes and their (streaming, batch-at-a-time) execution.
+
+Reference analog: DuckDB physical operators driven by morsel pipelines
+(SURVEY.md §3.2 hot loop). Here nodes pull iterators of column batches;
+Scan→Filter→Aggregate chains are intercepted by the device offload
+(exec/device_agg.py) when compilable — the TPU analog of the reference's
+parallel pipeline sink.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column, concat_batches, merge_dictionaries
+from ..sql.expr import AggSpec, BoundExpr
+from ..utils.config import SessionSettings
+from .tables import TableProvider
+
+
+@dataclass
+class ExecContext:
+    settings: SessionSettings = field(default_factory=SessionSettings)
+    params: list = field(default_factory=list)
+
+
+class PlanNode:
+    names: list[str]
+    types: list[dt.SqlType]
+
+    def batches(self, ctx: ExecContext) -> Iterator[Batch]:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Batch:
+        return concat_batches(list(self.batches(ctx)))
+
+    def children(self) -> list["PlanNode"]:
+        return []
+
+    def explain(self, depth: int = 0) -> list[str]:
+        line = "  " * depth + self.label()
+        out = [line]
+        for c in self.children():
+            out.extend(c.explain(depth + 1))
+        return out
+
+    def label(self) -> str:
+        return type(self).__name__
+
+
+class ScanNode(PlanNode):
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, filter_expr: Optional[BoundExpr] = None):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.filter = filter_expr  # pushed-down predicate (bound to scan schema)
+        self.names = list(columns)
+        self.types = [provider.type_of(c) for c in columns]
+
+    def batches(self, ctx: ExecContext) -> Iterator[Batch]:
+        for b in self.provider.batches(self.columns):
+            if self.filter is not None:
+                mask_col = self.filter.eval(b)
+                mask = mask_col.data.astype(bool) & mask_col.valid_mask()
+                b = b.filter(mask)
+            yield b
+
+    def label(self) -> str:
+        f = " filter=yes" if self.filter is not None else ""
+        return f"Scan {self.provider.name} [{', '.join(self.columns)}]{f}"
+
+
+def _take_null_extended(batch: Batch, idx: np.ndarray) -> list[Column]:
+    """Row gather where idx == -1 yields a NULL row (outer-join extension)."""
+    nullmask = idx < 0
+    out = []
+    for c in batch.columns:
+        if batch.num_rows == 0:
+            out.append(Column.from_pylist([None] * len(idx), c.type))
+            continue
+        t = c.take(np.where(nullmask, 0, idx))
+        validity = t.valid_mask() & ~nullmask
+        out.append(Column(t.type, t.data,
+                          None if validity.all() else validity, t.dictionary))
+    return out
+
+
+class ValuesNode(PlanNode):
+    def __init__(self, batch: Batch):
+        self.batch = batch
+        self.names = list(batch.names)
+        self.types = [c.type for c in batch.columns]
+
+    def batches(self, ctx):
+        yield self.batch
+
+    def label(self):
+        return f"Values ({self.batch.num_rows} rows)"
+
+
+class FilterNode(PlanNode):
+    def __init__(self, child: PlanNode, pred: BoundExpr):
+        self.child = child
+        self.pred = pred
+        self.names = child.names
+        self.types = child.types
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        for b in self.child.batches(ctx):
+            c = self.pred.eval(b)
+            mask = c.data.astype(bool) & c.valid_mask()
+            yield b.filter(mask)
+
+    def label(self):
+        return "Filter"
+
+
+class ProjectNode(PlanNode):
+    def __init__(self, child: PlanNode, exprs: list[BoundExpr],
+                 names: list[str]):
+        self.child = child
+        self.exprs = exprs
+        self.names = names
+        self.types = [e.type for e in exprs]
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        for b in self.child.batches(ctx):
+            cols = [e.eval(b) for e in self.exprs]
+            yield Batch(list(self.names), cols)
+
+    def label(self):
+        return f"Project [{', '.join(self.names)}]"
+
+
+class LimitNode(PlanNode):
+    def __init__(self, child: PlanNode, limit: Optional[int], offset: int = 0):
+        self.child = child
+        self.limit = limit
+        self.offset = offset
+        self.names = child.names
+        self.types = child.types
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        skipped = 0
+        emitted = 0
+        for b in self.child.batches(ctx):
+            if self.offset and skipped < self.offset:
+                take = min(b.num_rows, self.offset - skipped)
+                skipped += take
+                b = b.slice(take, b.num_rows)
+            if b.num_rows == 0:
+                continue
+            if self.limit is not None:
+                remaining = self.limit - emitted
+                if remaining <= 0:
+                    return
+                if b.num_rows > remaining:
+                    b = b.slice(0, remaining)
+            emitted += b.num_rows
+            yield b
+
+    def label(self):
+        return f"Limit {self.limit} offset {self.offset}"
+
+
+class SortNode(PlanNode):
+    """Full materializing sort. keys are column indices into the child
+    output; PG default null ordering: NULLS LAST asc, NULLS FIRST desc."""
+
+    def __init__(self, child: PlanNode, key_indices: list[int],
+                 descs: list[bool], nulls_first: list[Optional[bool]]):
+        self.child = child
+        self.key_indices = key_indices
+        self.descs = descs
+        self.nulls_first = nulls_first
+        self.names = child.names
+        self.types = child.types
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        full = concat_batches(list(self.child.batches(ctx)))
+        if full.num_rows <= 1:
+            yield full
+            return
+        # np.lexsort: last key is primary. Keys are densified to int64 ranks
+        # (np.unique inverse) so DESC negation and NULL placement are exact
+        # for any dtype, including int64 beyond 2^53.
+        keys = []
+        for ki, desc, nf in zip(reversed(self.key_indices),
+                                reversed(self.descs),
+                                reversed(self.nulls_first)):
+            col = full.columns[ki]
+            null_first = nf if nf is not None else desc
+            _, ranks = np.unique(col.data, return_inverse=True)
+            ranks = ranks.astype(np.int64)
+            if desc:
+                ranks = -ranks
+            nulls = ~col.valid_mask()
+            nullkey = np.where(nulls, -1, 1) if null_first \
+                else np.where(nulls, 1, -1)
+            keys.append(np.where(nulls, 0, ranks))
+            keys.append(nullkey)
+        order = np.lexsort(tuple(keys))
+        yield full.take(order)
+
+    def label(self):
+        return f"Sort {list(zip(self.key_indices, self.descs))}"
+
+
+class DropColumnsNode(PlanNode):
+    """Drops hidden sort columns after Sort."""
+
+    def __init__(self, child: PlanNode, keep: int):
+        self.child = child
+        self.keep = keep
+        self.names = child.names[:keep]
+        self.types = child.types[:keep]
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        for b in self.child.batches(ctx):
+            yield Batch(list(self.names), b.columns[:self.keep])
+
+    def label(self):
+        return f"Project(keep {self.keep})"
+
+
+class JoinNode(PlanNode):
+    """CPU hash join (inner/left/cross). Equi-keys are extracted by the
+    planner; residual predicates run as a post-filter."""
+
+    def __init__(self, kind: str, left: PlanNode, right: PlanNode,
+                 left_keys: list[BoundExpr], right_keys: list[BoundExpr],
+                 residual: Optional[BoundExpr], names: list[str],
+                 types: list[dt.SqlType]):
+        self.kind = kind
+        self.left = left
+        self.right = right
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.residual = residual
+        self.names = names
+        self.types = types
+
+    def children(self):
+        return [self.left, self.right]
+
+    def batches(self, ctx):
+        lb = concat_batches(list(self.left.batches(ctx)))
+        rb = concat_batches(list(self.right.batches(ctx)))
+        li, ri = self._match_inner(lb, rb)
+        # ON-clause residual applies to *candidate pairs* (outer-join
+        # semantics: a pair failing the residual is unmatched, the left row
+        # survives null-extended — PG LEFT JOIN ... ON a AND b)
+        if self.residual is not None and len(li):
+            pair = Batch(list(self.names),
+                         lb.take(li).columns + rb.take(ri).columns)
+            c = self.residual.eval(pair)
+            keep = c.data.astype(bool) & c.valid_mask()
+            li, ri = li[keep], ri[keep]
+        if self.kind == "left":
+            matched = np.zeros(lb.num_rows, dtype=bool)
+            matched[li] = True
+            extra = np.flatnonzero(~matched)
+            li = np.concatenate([li, extra])
+            ri = np.concatenate([ri, np.full(len(extra), -1, dtype=np.int64)])
+        elif self.kind == "right":
+            matched = np.zeros(rb.num_rows, dtype=bool)
+            matched[ri] = True
+            extra = np.flatnonzero(~matched)
+            ri = np.concatenate([ri, extra])
+            li = np.concatenate([li, np.full(len(extra), -1, dtype=np.int64)])
+        lcols = _take_null_extended(lb, li)
+        rcols = _take_null_extended(rb, ri)
+        yield Batch(list(self.names), lcols + rcols)
+
+    def _match_inner(self, lb: Batch, rb: Batch) -> tuple[np.ndarray, np.ndarray]:
+        """Candidate (inner) pairs; left-join null extension happens later."""
+        if self.kind == "cross" or not self.left_keys:
+            li = np.repeat(np.arange(lb.num_rows), rb.num_rows)
+            ri = np.tile(np.arange(rb.num_rows), lb.num_rows)
+            return li, ri
+        lkeys = [k.eval(lb) for k in self.left_keys]
+        rkeys = [k.eval(rb) for k in self.right_keys]
+        lt = list(zip(*(c.to_pylist() for c in lkeys))) \
+            if lkeys else [()] * lb.num_rows
+        rt = list(zip(*(c.to_pylist() for c in rkeys))) \
+            if rkeys else [()] * rb.num_rows
+        table: dict = {}
+        for j, key in enumerate(rt):
+            if any(k is None for k in key):
+                continue  # NULL never joins
+            table.setdefault(key, []).append(j)
+        li, ri = [], []
+        for i, key in enumerate(lt):
+            if any(k is None for k in key):
+                continue
+            for j in table.get(key, ()):
+                li.append(i)
+                ri.append(j)
+        return (np.asarray(li, dtype=np.int64),
+                np.asarray(ri, dtype=np.int64))
+
+    def label(self):
+        return f"HashJoin {self.kind}"
+
+
+class AggregateNode(PlanNode):
+    def __init__(self, child: PlanNode, group_exprs: list[BoundExpr],
+                 aggs: list[AggSpec], names: list[str]):
+        self.child = child
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.names = names
+        self.types = ([g.type for g in group_exprs] +
+                      [a.type for a in aggs])
+
+    def children(self):
+        return [self.child]
+
+    def label(self):
+        return (f"Aggregate groups={len(self.group_exprs)} "
+                f"aggs=[{', '.join(a.func for a in self.aggs)}]")
+
+    def batches(self, ctx):
+        from .device_agg import try_device_aggregate
+        result = try_device_aggregate(self, ctx)
+        if result is not None:
+            yield result
+            return
+        yield self._cpu_aggregate(ctx)
+
+    # -- CPU reference aggregation ----------------------------------------
+
+    def _cpu_aggregate(self, ctx) -> Batch:
+        if not self.group_exprs:
+            return self._cpu_scalar_agg(ctx)
+        full = concat_batches(list(self.child.batches(ctx)))
+        from ..ops.agg import factorize_keys
+        key_cols = [g.eval(full) for g in self.group_exprs]
+        codes, uniq_vals, uniq_valid = factorize_keys(
+            [c.data for c in key_cols],
+            [c.validity for c in key_cols])
+        num_groups = len(uniq_vals[0]) if uniq_vals else 0
+        out_cols: list[Column] = []
+        for k, (kc, uv) in enumerate(zip(key_cols, uniq_vals)):
+            validity = uniq_valid[k] if uniq_valid.size else None
+            if validity is not None and validity.all():
+                validity = None
+            out_cols.append(Column(kc.type, uv, validity, kc.dictionary))
+        for spec in self.aggs:
+            out_cols.append(self._cpu_group_agg(spec, full, codes, num_groups))
+        return Batch(list(self.names), out_cols)
+
+    def _cpu_group_agg(self, spec: AggSpec, full: Batch, codes: np.ndarray,
+                       g: int) -> Column:
+        if spec.func == "count_star":
+            data = np.bincount(codes, minlength=g).astype(np.int64)
+            return Column(dt.BIGINT, data)
+        arg = spec.arg.eval(full)
+        valid = arg.valid_mask()
+        if spec.distinct:
+            return self._cpu_group_distinct(spec, arg, codes, g)
+        vc = codes[valid]
+        if spec.func == "count":
+            data = np.bincount(vc, minlength=g).astype(np.int64)
+            return Column(dt.BIGINT, data)
+        vals = arg.data[valid]
+        counts = np.bincount(vc, minlength=g)
+        empty = counts == 0
+        if spec.func == "sum":
+            if arg.type.is_integer or arg.type.id is dt.TypeId.BOOL:
+                data = np.bincount(vc, weights=vals.astype(np.float64),
+                                   minlength=g)
+                # exact: redo in int64 via add.at
+                acc = np.zeros(g, dtype=np.int64)
+                np.add.at(acc, vc, vals.astype(np.int64))
+                return Column(dt.BIGINT, acc, ~empty if empty.any() else None)
+            acc = np.zeros(g, dtype=np.float64)
+            np.add.at(acc, vc, vals.astype(np.float64))
+            return Column(dt.DOUBLE, acc, ~empty if empty.any() else None)
+        if spec.func == "avg":
+            acc = np.zeros(g, dtype=np.float64)
+            np.add.at(acc, vc, vals.astype(np.float64))
+            with np.errstate(invalid="ignore", divide="ignore"):
+                data = acc / counts
+            return Column(dt.DOUBLE, np.where(empty, 0.0, data),
+                          ~empty if empty.any() else None)
+        if spec.func in ("min", "max"):
+            if arg.type.is_string:
+                # operate on codes (sorted dictionary ⇒ order-preserving)
+                ident = np.iinfo(np.int64).max if spec.func == "min" else -1
+                acc = np.full(g, ident, dtype=np.int64)
+                ufunc = np.minimum if spec.func == "min" else np.maximum
+                ufunc.at(acc, vc, vals.astype(np.int64))
+                acc2 = np.where(empty, 0, acc).astype(np.int32)
+                return Column(dt.VARCHAR, acc2,
+                              ~empty if empty.any() else None, arg.dictionary)
+            if arg.type.is_float:
+                ident = np.inf if spec.func == "min" else -np.inf
+                acc = np.full(g, ident, dtype=np.float64)
+            else:
+                info = np.iinfo(np.int64)
+                ident = info.max if spec.func == "min" else info.min
+                acc = np.full(g, ident, dtype=np.int64)
+            ufunc = np.minimum if spec.func == "min" else np.maximum
+            ufunc.at(acc, vc, vals)
+            acc = np.where(empty, 0, acc).astype(arg.type.np_dtype)
+            return Column(arg.type, acc, ~empty if empty.any() else None)
+        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance"):
+            s1 = np.zeros(g)
+            s2 = np.zeros(g)
+            fv = vals.astype(np.float64)
+            np.add.at(s1, vc, fv)
+            np.add.at(s2, vc, fv * fv)
+            cnt = counts.astype(np.float64)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                var = (s2 - s1 * s1 / cnt) / (cnt - 1)
+            bad = counts < 2
+            data = np.sqrt(var) if spec.func.startswith("stddev") else var
+            return Column(dt.DOUBLE, np.where(bad, 0.0, data),
+                          ~bad if bad.any() else None)
+        if spec.func in ("bool_and", "bool_or"):
+            vb = vals.astype(bool)
+            if spec.func == "bool_and":
+                acc = np.ones(g, dtype=bool)
+                np.logical_and.at(acc, vc, vb)
+            else:
+                acc = np.zeros(g, dtype=bool)
+                np.logical_or.at(acc, vc, vb)
+            return Column(dt.BOOL, acc, ~empty if empty.any() else None)
+        if spec.func == "string_agg":
+            raise errors.unsupported("string_agg with GROUP BY")
+        raise errors.unsupported(f"aggregate {spec.func}")
+
+    def _cpu_group_distinct(self, spec: AggSpec, arg: Column,
+                            codes: np.ndarray, g: int) -> Column:
+        valid = arg.valid_mask()
+        vc = codes[valid]
+        vals = arg.data[valid]
+        if len(vc):
+            order = np.lexsort((vals, vc))
+            sc, sv = vc[order], vals[order]
+            keep = np.concatenate([[True], (sc[1:] != sc[:-1]) | (sv[1:] != sv[:-1])])
+            uc, uv = sc[keep], sv[keep]
+        else:
+            uc, uv = vc, vals
+        if spec.func == "count":
+            data = np.bincount(uc, minlength=g).astype(np.int64)
+            return Column(dt.BIGINT, data)
+        if spec.func == "sum":
+            if arg.type.is_integer:
+                acc = np.zeros(g, dtype=np.int64)
+                np.add.at(acc, uc, uv.astype(np.int64))
+                return Column(dt.BIGINT, acc)
+            acc = np.zeros(g, dtype=np.float64)
+            np.add.at(acc, uc, uv.astype(np.float64))
+            return Column(dt.DOUBLE, acc)
+        raise errors.unsupported(f"DISTINCT {spec.func}")
+
+    def _cpu_scalar_agg(self, ctx) -> Batch:
+        accs = [_ScalarAcc(spec) for spec in self.aggs]
+        for b in self.child.batches(ctx):
+            for acc in accs:
+                acc.update(b)
+        cols = [acc.result() for acc in accs]
+        return Batch(list(self.names), cols)
+
+
+class _ScalarAcc:
+    def __init__(self, spec: AggSpec):
+        self.spec = spec
+        self.count = 0
+        self.sum_i = 0
+        self.sum_f = 0.0
+        self.sum_sq = 0.0
+        self.min_v = None
+        self.max_v = None
+        self.distinct: Optional[set] = set() if spec.distinct else None
+        self.strings: list[str] = []
+        self.bool_acc = None
+
+    def update(self, b: Batch):
+        spec = self.spec
+        if spec.func == "count_star":
+            self.count += b.num_rows
+            return
+        col = spec.arg.eval(b)
+        valid = col.valid_mask()
+        n_valid = int(valid.sum())
+        if n_valid == 0:
+            return
+        if self.distinct is not None:
+            vals = col.to_pylist()
+            self.distinct.update(v for v in vals if v is not None)
+            return
+        self.count += n_valid
+        if spec.func in ("sum", "avg", "stddev", "stddev_samp", "var_samp",
+                         "variance"):
+            vals = col.data[valid]
+            if col.type.is_integer or col.type.id is dt.TypeId.BOOL:
+                self.sum_i += int(vals.astype(np.int64).sum())
+            self.sum_f += float(vals.astype(np.float64).sum())
+            self.sum_sq += float((vals.astype(np.float64) ** 2).sum())
+        elif spec.func in ("min", "max"):
+            if col.type.is_string:
+                vals = [v for v in col.to_pylist() if v is not None]
+                lo, hi = min(vals), max(vals)
+            else:
+                vals = col.data[valid]
+                lo, hi = vals.min(), vals.max()
+            self.min_v = lo if self.min_v is None else min(self.min_v, lo)
+            self.max_v = hi if self.max_v is None else max(self.max_v, hi)
+        elif spec.func in ("bool_and", "bool_or"):
+            vals = col.data[valid].astype(bool)
+            v = vals.all() if spec.func == "bool_and" else vals.any()
+            if self.bool_acc is None:
+                self.bool_acc = bool(v)
+            else:
+                self.bool_acc = (self.bool_acc and bool(v)) \
+                    if spec.func == "bool_and" else (self.bool_acc or bool(v))
+        elif spec.func == "string_agg":
+            self.strings.extend(v for v in col.to_pylist() if v is not None)
+        elif spec.func == "count":
+            pass
+        else:
+            raise errors.unsupported(f"aggregate {spec.func}")
+
+    def result(self) -> Column:
+        spec = self.spec
+        t = spec.type
+        if spec.func == "count_star":
+            return Column.from_pylist([self.count], t)
+        if self.distinct is not None:
+            if spec.func == "count":
+                return Column.from_pylist([len(self.distinct)], t)
+            if spec.func == "sum":
+                s = sum(self.distinct) if self.distinct else None
+                return Column.from_pylist([s], t)
+            raise errors.unsupported(f"DISTINCT {spec.func}")
+        if spec.func == "count":
+            return Column.from_pylist([self.count], t)
+        if self.count == 0 and spec.func != "count":
+            return Column.from_pylist([None], t)
+        if spec.func == "sum":
+            v = self.sum_i if t.is_integer else self.sum_f
+            return Column.from_pylist([v], t)
+        if spec.func == "avg":
+            return Column.from_pylist([self.sum_f / self.count], t)
+        if spec.func == "min":
+            v = self.min_v
+            return Column.from_pylist([v.item() if hasattr(v, "item") else v], t)
+        if spec.func == "max":
+            v = self.max_v
+            return Column.from_pylist([v.item() if hasattr(v, "item") else v], t)
+        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance"):
+            if self.count < 2:
+                return Column.from_pylist([None], t)
+            var = (self.sum_sq - self.sum_f ** 2 / self.count) / (self.count - 1)
+            v = math.sqrt(max(var, 0.0)) if spec.func.startswith("stddev") else var
+            return Column.from_pylist([v], t)
+        if spec.func in ("bool_and", "bool_or"):
+            return Column.from_pylist([self.bool_acc], t)
+        if spec.func == "string_agg":
+            return Column.from_pylist([",".join(self.strings) or None], t)
+        raise errors.unsupported(f"aggregate {spec.func}")

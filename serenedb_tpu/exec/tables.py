@@ -1,0 +1,217 @@
+"""Table providers: the scan sources the executor reads from.
+
+Reference analog: DuckDB table entries + the iresearch scan table function +
+remote-file index sources (SURVEY.md §2.5). Providers expose columnar
+batches, and cache *device-resident* columns — the HBM working set that the
+north-star design keeps hot between queries (BASELINE.json north_star:
+"column batches ship to HBM and run as Pallas kernels").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..columnar.device import DeviceColumn, to_device_column
+from ..utils import metrics
+
+DEFAULT_BATCH_ROWS = 1 << 17
+
+
+class TableProvider:
+    name: str
+    column_names: list[str]
+    column_types: list[dt.SqlType]
+
+    def row_count(self) -> int:
+        raise NotImplementedError
+
+    def full_batch(self, columns: Optional[list[str]] = None) -> Batch:
+        raise NotImplementedError
+
+    def batches(self, columns: Optional[list[str]] = None,
+                batch_rows: int = DEFAULT_BATCH_ROWS) -> Iterator[Batch]:
+        full = self.full_batch(columns)
+        n = full.num_rows
+        if n == 0:
+            yield full
+            return
+        for start in range(0, n, batch_rows):
+            yield full.slice(start, min(start + batch_rows, n))
+
+    # -- device cache ------------------------------------------------------
+
+    #: bumped on every data mutation; device program/column caches key on it
+    data_version: int = 0
+
+    def __init_device_cache(self):
+        if not hasattr(self, "_device_cache"):
+            self._device_cache: dict[str, DeviceColumn] = {}
+            self._device_lock = threading.Lock()
+
+    def device_column(self, name: str) -> DeviceColumn:
+        self.__init_device_cache()
+        with self._device_lock:
+            dc = self._device_cache.get(name)
+            if dc is None:
+                col = self.full_batch([name]).column(name)
+                dc = to_device_column(col)
+                metrics.DEVICE_BYTES.add(int(dc.data.size * dc.data.dtype.itemsize))
+                self._device_cache[name] = dc
+        return dc
+
+    def host_column(self, name: str) -> Column:
+        return self.full_batch([name]).column(name)
+
+    def invalidate_device_cache(self):
+        self.__init_device_cache()
+        with self._device_lock:
+            self.data_version += 1
+            self._device_cache.clear()
+            if hasattr(self, "_device_rowmask"):
+                del self._device_rowmask
+
+    def type_of(self, name: str) -> dt.SqlType:
+        return self.column_types[self.column_names.index(name)]
+
+
+class MemTable(TableProvider):
+    """In-memory columnar table (also the transactional-store table engine's
+    in-memory representation until the storage layer lands)."""
+
+    def __init__(self, name: str, batch: Batch):
+        self.name = name
+        self._batch = batch
+        self.column_names = list(batch.names)
+        self.column_types = [c.type for c in batch.columns]
+
+    def row_count(self) -> int:
+        return self._batch.num_rows
+
+    def full_batch(self, columns: Optional[list[str]] = None) -> Batch:
+        if columns is None:
+            return self._batch
+        missing = [c for c in columns if c not in self._batch]
+        if missing:
+            raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                  f"column {missing[0]} does not exist")
+        return Batch(list(columns), [self._batch.column(c) for c in columns])
+
+    def replace(self, batch: Batch):
+        self._batch = batch
+        self.column_names = list(batch.names)
+        self.column_types = [c.type for c in batch.columns]
+        self.invalidate_device_cache()
+
+
+_PA_TYPE_MAP = None
+
+
+def _arrow_to_column(arr) -> Column:
+    """pyarrow ChunkedArray/Array → Column (sorted-dictionary for strings)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    if pa.types.is_dictionary(t):
+        arr = arr.cast(t.value_type)
+        t = arr.type
+    null_mask = None
+    if arr.null_count:
+        null_mask = np.asarray(arr.is_valid())
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        if arr.null_count:
+            arr = arr.fill_null("")
+        enc = pc.dictionary_encode(arr)
+        if isinstance(enc, pa.ChunkedArray):
+            enc = enc.combine_chunks()
+        codes = np.asarray(enc.indices, dtype=np.int64)
+        dictionary = np.asarray(enc.dictionary.to_pylist(), dtype=object)
+        order = np.argsort(dictionary.astype(str), kind="stable")
+        remap = np.empty(len(order), dtype=np.int32)
+        remap[order] = np.arange(len(order), dtype=np.int32)
+        sorted_dict = dictionary[order]
+        return Column(dt.VARCHAR, remap[codes], null_mask, sorted_dict)
+    if pa.types.is_timestamp(t):
+        us = arr.cast(pa.timestamp("us"))
+        data = np.asarray(us.cast(pa.int64()).fill_null(0))
+        return Column(dt.TIMESTAMP, data.astype(np.int64), null_mask)
+    if pa.types.is_date32(t):
+        data = np.asarray(arr.cast(pa.int32()).fill_null(0))
+        return Column(dt.DATE, data.astype(np.int32), null_mask)
+    if pa.types.is_boolean(t):
+        data = np.asarray(arr.fill_null(False))
+        return Column(dt.BOOL, data.astype(np.bool_), null_mask)
+    if arr.null_count:
+        arr = arr.fill_null(0)
+    data = np.asarray(arr)
+    return Column(dt.type_of_numpy(data.dtype), data, null_mask)
+
+
+class ParquetTable(TableProvider):
+    """Zero-ETL parquet scan (reference analog: view-over-parquet fast path,
+    index_source_view_file.*, examples/demo0/demo.sql)."""
+
+    def __init__(self, path: str, name: Optional[str] = None):
+        import pyarrow.parquet as pq
+        self.path = path
+        self.name = name or path
+        self._pf = pq.ParquetFile(path)
+        schema = self._pf.schema_arrow
+        self.column_names = list(schema.names)
+        self.column_types = []
+        self._columns: dict[str, Column] = {}
+        self._lock = threading.Lock()
+        for f in schema:
+            self.column_types.append(_arrow_field_type(f.type))
+
+    def row_count(self) -> int:
+        return self._pf.metadata.num_rows
+
+    def full_batch(self, columns: Optional[list[str]] = None) -> Batch:
+        cols = columns if columns is not None else self.column_names
+        missing = [c for c in cols if c not in self.column_names]
+        if missing:
+            raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                  f"column {missing[0]} does not exist")
+        with self._lock:
+            to_read = [c for c in cols if c not in self._columns]
+            if to_read:
+                tbl = self._pf.read(columns=to_read)
+                for cname in to_read:
+                    self._columns[cname] = _arrow_to_column(tbl.column(cname))
+            return Batch(list(cols), [self._columns[c] for c in cols])
+
+
+def _arrow_field_type(t) -> dt.SqlType:
+    import pyarrow as pa
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    if pa.types.is_boolean(t):
+        return dt.BOOL
+    if pa.types.is_int8(t):
+        return dt.TINYINT
+    if pa.types.is_int16(t) or pa.types.is_uint8(t):
+        return dt.SMALLINT
+    if pa.types.is_int32(t) or pa.types.is_uint16(t):
+        return dt.INT
+    if pa.types.is_integer(t):
+        return dt.BIGINT
+    if pa.types.is_float32(t):
+        return dt.FLOAT
+    if pa.types.is_floating(t):
+        return dt.DOUBLE
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return dt.VARCHAR
+    if pa.types.is_timestamp(t):
+        return dt.TIMESTAMP
+    if pa.types.is_date(t):
+        return dt.DATE
+    return dt.VARCHAR

@@ -1,0 +1,708 @@
+"""Scalar function & operator library (CPU reference implementations).
+
+Reference analog: server/connector/functions/{math,string,array,json,...}.cpp
+(~8 kLoC of PG-compatible functions; SURVEY.md §2.5). Semantics follow
+PostgreSQL: strict NULL propagation unless noted, integer division truncates,
+division by zero raises 22012, 1-based string indexing.
+
+Each registry entry resolves (arg_types) -> (result_type, impl) where impl is
+(cols: list[Column], n_rows) -> Column.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+from ..sql.expr import make_string_column, propagate_nulls, string_values
+
+
+class FunctionResolution:
+    def __init__(self, result_type: dt.SqlType, impl: Callable):
+        self.result_type = result_type
+        self.impl = impl
+
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def resolve(name: str, arg_types: list[dt.SqlType]) -> FunctionResolution:
+    fn = _REGISTRY.get(name)
+    if fn is None:
+        raise errors.SqlError(errors.UNDEFINED_FUNCTION,
+                              f"function {name}({', '.join(map(str, arg_types))}) "
+                              "does not exist")
+    res = fn(arg_types)
+    if res is None:
+        raise errors.SqlError(errors.UNDEFINED_FUNCTION,
+                              f"function {name}({', '.join(map(str, arg_types))}) "
+                              "does not exist")
+    return res
+
+
+def exists(name: str) -> bool:
+    return name in _REGISTRY
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _num(col: Column) -> np.ndarray:
+    return col.data
+
+
+def _result(typ: dt.SqlType, data: np.ndarray, cols: list[Column],
+            extra_invalid: Optional[np.ndarray] = None) -> Column:
+    validity = propagate_nulls(cols)
+    if extra_invalid is not None and extra_invalid.any():
+        validity = (validity if validity is not None
+                    else np.ones(len(data), dtype=bool)) & ~extra_invalid
+    return Column(typ, np.ascontiguousarray(data, dtype=typ.np_dtype), validity)
+
+
+def _all_numeric(ts: list[dt.SqlType]) -> bool:
+    return all(t.is_numeric or t.id in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE)
+               or t.id is dt.TypeId.NULL for t in ts)
+
+
+# -- comparisons -----------------------------------------------------------
+
+_CMP_NP = {
+    "=": np.equal, "<>": np.not_equal, "!=": np.not_equal,
+    "<": np.less, "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
+}
+
+
+def _make_compare(op: str):
+    def resolver(ts: list[dt.SqlType]):
+        def impl(cols, n):
+            a, b = cols
+            if a.type.is_string or b.type.is_string:
+                av, bv = string_values(a), string_values(b)
+                data = _CMP_NP[op](av, bv)
+            else:
+                data = _CMP_NP[op](a.data, b.data)
+            return _result(dt.BOOL, data, cols)
+        return FunctionResolution(dt.BOOL, impl)
+    return resolver
+
+
+for _op in _CMP_NP:
+    _REGISTRY[f"op{_op}"] = _make_compare(_op)
+
+
+@register("is_distinct_from")
+def _is_distinct(ts):
+    def impl(cols, n):
+        a, b = cols
+        av, bv = a.valid_mask(), b.valid_mask()
+        if a.type.is_string or b.type.is_string:
+            eq = string_values(a) == string_values(b)
+        else:
+            eq = a.data == b.data
+        same = (av & bv & eq) | (~av & ~bv)
+        return Column(dt.BOOL, ~same)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+@register("is_not_distinct_from")
+def _is_not_distinct(ts):
+    inner = _is_distinct(ts)
+
+    def impl(cols, n):
+        c = inner.impl(cols, n)
+        return Column(dt.BOOL, ~c.data)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+# -- arithmetic ------------------------------------------------------------
+
+def _arith_type(op: str, a: dt.SqlType, b: dt.SqlType) -> dt.SqlType:
+    t = dt.common_numeric(a, b)
+    if t.id is dt.TypeId.BOOL:
+        raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                              f"operator {op} does not accept boolean")
+    return t
+
+
+def _make_arith(op: str):
+    def resolver(ts: list[dt.SqlType]):
+        if len(ts) != 2 or not _all_numeric(ts):
+            return None
+        t = _arith_type(op, ts[0], ts[1])
+        if op == "/" and t.is_integer:
+            pass  # PG: int/int truncates toward zero
+        def impl(cols, n):
+            a, b = cols[0].data, cols[1].data
+            extra_invalid = None
+            if op == "+":
+                data = a.astype(t.np_dtype) + b.astype(t.np_dtype)
+            elif op == "-":
+                data = a.astype(t.np_dtype) - b.astype(t.np_dtype)
+            elif op == "*":
+                data = a.astype(t.np_dtype) * b.astype(t.np_dtype)
+            elif op in ("/", "%"):
+                bb = b.astype(t.np_dtype)
+                zero = bb == 0
+                # only error on division by zero in non-NULL rows
+                pn = propagate_nulls(cols)
+                live_zero = zero if pn is None else (zero & pn)
+                if t.is_integer:
+                    if live_zero.any():
+                        raise errors.SqlError(errors.DIVISION_BY_ZERO,
+                                              "division by zero")
+                    aa = a.astype(np.int64)
+                    bb64 = b.astype(np.int64)
+                    if op == "/":
+                        data = (np.abs(aa) // np.abs(bb64)) * np.sign(aa) * np.sign(bb64)
+                    else:
+                        data = aa - (np.abs(aa) // np.abs(bb64)) * np.sign(aa) * np.sign(bb64) * bb64
+                    data = data.astype(t.np_dtype)
+                else:
+                    if live_zero.any():
+                        raise errors.SqlError(errors.DIVISION_BY_ZERO,
+                                              "division by zero")
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        data = (a.astype(t.np_dtype) / bb) if op == "/" \
+                            else np.fmod(a.astype(t.np_dtype), bb)
+            else:
+                raise AssertionError(op)
+            return _result(t, data, cols, extra_invalid)
+        return FunctionResolution(t, impl)
+    return resolver
+
+
+for _op in ("+", "-", "*", "/", "%"):
+    _REGISTRY[f"op{_op}"] = _make_arith(_op)
+
+
+# '+' and comparison registrations collide on name; re-dispatch by type:
+def _dispatch(name, arith, compare=None):
+    def resolver(ts):
+        r = arith(ts)
+        if r is not None:
+            return r
+        return compare(ts) if compare else None
+    return resolver
+
+
+_REGISTRY["op||"] = None  # set below
+
+
+@register("opneg")
+def _neg(ts):
+    t = ts[0] if ts[0].is_numeric else None
+    if t is None:
+        return None
+
+    def impl(cols, n):
+        return _result(t, -cols[0].data, cols)
+    return FunctionResolution(t, impl)
+
+
+# -- concat ----------------------------------------------------------------
+
+def _concat_resolver(ts):
+    def impl(cols, n):
+        parts = []
+        for c in cols:
+            if c.type.is_string:
+                parts.append(string_values(c))
+            else:
+                parts.append(np.asarray([_pg_text(v) for v in c.to_pylist()],
+                                        dtype=object).astype(str))
+        data = parts[0]
+        for p in parts[1:]:
+            data = np.char.add(data, p)
+        return make_string_column(data, propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["op||"] = _concat_resolver
+_REGISTRY["concat"] = _concat_resolver
+
+
+def _pg_text(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v)) if v == int(v) else str(v)
+    return str(v)
+
+
+# -- math functions --------------------------------------------------------
+
+def _unary_math(np_fn, out_type=None, domain_check=None):
+    def resolver(ts):
+        if len(ts) != 1 or not _all_numeric(ts):
+            return None
+        t = out_type or (ts[0] if ts[0].is_integer and np_fn in (np.abs,)
+                         else dt.DOUBLE)
+        def impl(cols, n):
+            x = cols[0].data.astype(np.float64 if t == dt.DOUBLE else t.np_dtype)
+            with np.errstate(all="ignore"):
+                data = np_fn(x)
+            return _result(t, data, cols)
+        return FunctionResolution(t, impl)
+    return resolver
+
+
+_REGISTRY["abs"] = _unary_math(np.abs)
+
+
+@register("round")
+def _round(ts):
+    t = dt.DOUBLE if ts[0].is_float else ts[0]
+    def impl(cols, n):
+        x = cols[0].data.astype(np.float64)
+        d = cols[1].data.astype(np.int64) if len(cols) > 1 else 0
+        # PG rounds half away from zero
+        scale = np.power(10.0, d)
+        data = np.sign(x) * np.floor(np.abs(x) * scale + 0.5) / scale
+        return _result(dt.DOUBLE if not ts[0].is_integer else ts[0], data, cols)
+    return FunctionResolution(dt.DOUBLE if not ts[0].is_integer else ts[0], impl)
+
+
+for name, fn in [("floor", np.floor), ("ceil", np.ceil), ("ceiling", np.ceil),
+                 ("sqrt", np.sqrt), ("ln", np.log), ("log10", np.log10),
+                 ("exp", np.exp), ("sin", np.sin), ("cos", np.cos),
+                 ("tan", np.tan), ("asin", np.arcsin), ("acos", np.arccos),
+                 ("atan", np.arctan), ("degrees", np.degrees),
+                 ("radians", np.radians), ("trunc", np.trunc)]:
+    _REGISTRY[name] = _unary_math(fn)
+
+
+@register("log")
+def _log(ts):
+    if len(ts) == 1:
+        return _unary_math(np.log10)(ts)
+    def impl(cols, n):
+        base = cols[0].data.astype(np.float64)
+        x = cols[1].data.astype(np.float64)
+        with np.errstate(all="ignore"):
+            data = np.log(x) / np.log(base)
+        return _result(dt.DOUBLE, data, cols)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("power")
+@register("pow")
+def _power(ts):
+    def impl(cols, n):
+        with np.errstate(all="ignore"):
+            data = np.power(cols[0].data.astype(np.float64),
+                            cols[1].data.astype(np.float64))
+        return _result(dt.DOUBLE, data, cols)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("mod")
+def _mod(ts):
+    return _make_arith("%")(ts)
+
+
+@register("sign")
+def _sign(ts):
+    def impl(cols, n):
+        return _result(dt.DOUBLE, np.sign(cols[0].data.astype(np.float64)), cols)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("pi")
+def _pi(ts):
+    def impl(cols, n):
+        return Column(dt.DOUBLE, np.full(n, math.pi))
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+# -- string functions ------------------------------------------------------
+
+def _str_fn(result_type):
+    def deco(fn):
+        def resolver(ts):
+            def impl(cols, n):
+                return fn(cols, n)
+            return FunctionResolution(result_type, impl)
+        return resolver
+    return deco
+
+
+@register("upper")
+def _upper(ts):
+    def impl(cols, n):
+        return make_string_column(np.char.upper(string_values(cols[0])),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("lower")
+def _lower(ts):
+    def impl(cols, n):
+        return make_string_column(np.char.lower(string_values(cols[0])),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("length")
+@register("char_length")
+def _length(ts):
+    def impl(cols, n):
+        data = np.char.str_len(string_values(cols[0])).astype(np.int64)
+        return _result(dt.BIGINT, data, cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("substr")
+@register("substring")
+def _substr(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        start = cols[1].data.astype(np.int64)
+        ln = cols[2].data.astype(np.int64) if len(cols) > 2 else None
+        out = np.empty(len(s), dtype=object)
+        for i in range(len(s)):
+            st = start[i] - 1  # PG 1-based
+            end = None if ln is None else max(st + ln[i], 0) if st >= 0 else max(start[i] - 1 + ln[i], 0)
+            if st < 0:
+                st2 = 0
+                end = None if ln is None else max(start[i] - 1 + ln[i], 0)
+            else:
+                st2 = st
+            out[i] = s[i][st2:end]
+        return make_string_column(out.astype(str), propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("replace")
+def _replace(ts):
+    def impl(cols, n):
+        s, old, new = (string_values(c) for c in cols)
+        out = np.asarray([a.replace(b, c) for a, b, c in zip(s, old, new)],
+                         dtype=object)
+        return make_string_column(out.astype(str), propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+def _make_trim(which):
+    def resolver(ts):
+        def impl(cols, n):
+            s = string_values(cols[0])
+            chars = None
+            if len(cols) > 1:
+                chars = string_values(cols[1])
+            out = []
+            for i, v in enumerate(s):
+                ch = None if chars is None else chars[i]
+                if which == "both":
+                    out.append(v.strip(ch))
+                elif which == "left":
+                    out.append(v.lstrip(ch))
+                else:
+                    out.append(v.rstrip(ch))
+            return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                      propagate_nulls(cols))
+        return FunctionResolution(dt.VARCHAR, impl)
+    return resolver
+
+
+_REGISTRY["trim"] = _make_trim("both")
+_REGISTRY["btrim"] = _make_trim("both")
+_REGISTRY["ltrim"] = _make_trim("left")
+_REGISTRY["rtrim"] = _make_trim("right")
+
+
+@register("starts_with")
+def _starts_with(ts):
+    def impl(cols, n):
+        a, b = string_values(cols[0]), string_values(cols[1])
+        data = np.asarray([x.startswith(y) for x, y in zip(a, b)])
+        return _result(dt.BOOL, data, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+@register("contains")
+def _contains(ts):
+    def impl(cols, n):
+        a, b = string_values(cols[0]), string_values(cols[1])
+        data = np.asarray([y in x for x, y in zip(a, b)])
+        return _result(dt.BOOL, data, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+@register("strpos")
+@register("position")
+def _strpos(ts):
+    def impl(cols, n):
+        a, b = string_values(cols[0]), string_values(cols[1])
+        data = np.asarray([x.find(y) + 1 for x, y in zip(a, b)], dtype=np.int64)
+        return _result(dt.BIGINT, data, cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("left")
+def _left(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        k = cols[1].data.astype(np.int64)
+        out = [v[:kk] if kk >= 0 else v[:len(v) + kk] for v, kk in zip(s, k)]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("right")
+def _right(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        k = cols[1].data.astype(np.int64)
+        out = [(v[-kk:] if kk > 0 else v[-(len(v) + kk):] if len(v) + kk > 0 else "")
+               if kk != 0 else "" for v, kk in zip(s, k)]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("reverse")
+def _reverse(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        out = [v[::-1] for v in s]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("repeat")
+def _repeat(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        k = cols[1].data.astype(np.int64)
+        out = [v * max(int(kk), 0) for v, kk in zip(s, k)]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("split_part")
+def _split_part(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        sep = string_values(cols[1])
+        k = cols[2].data.astype(np.int64)
+        out = []
+        for v, sp, kk in zip(s, sep, k):
+            parts = v.split(sp) if sp else [v]
+            idx = int(kk) - 1
+            out.append(parts[idx] if 0 <= idx < len(parts) else "")
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def like_impl(cols, n, negated=False, ci=False):
+    a = string_values(cols[0])
+    pats = string_values(cols[1])
+    flags = re.IGNORECASE | re.DOTALL if ci else re.DOTALL
+    if len(set(pats.tolist())) == 1 and len(pats) > 0:
+        rx = re.compile(_like_to_regex(pats[0]), flags)
+        data = np.asarray([bool(rx.match(x)) for x in a])
+    else:
+        data = np.asarray([bool(re.compile(_like_to_regex(p), flags).match(x))
+                           for x, p in zip(a, pats)])
+    if negated:
+        data = ~data
+    return _result(dt.BOOL, data, cols)
+
+
+def _make_regexp(ci, negated):
+    def resolver(ts):
+        def impl(cols, n):
+            a = string_values(cols[0])
+            pats = string_values(cols[1])
+            flags = re.IGNORECASE if ci else 0
+            data = np.asarray([bool(re.compile(p, flags).search(x))
+                               for x, p in zip(a, pats)])
+            if negated:
+                data = ~data
+            return _result(dt.BOOL, data, cols)
+        return FunctionResolution(dt.BOOL, impl)
+    return resolver
+
+
+_REGISTRY["regexp_match_op"] = _make_regexp(False, False)
+_REGISTRY["regexp_imatch_op"] = _make_regexp(True, False)
+_REGISTRY["regexp_not_match_op"] = _make_regexp(False, True)
+_REGISTRY["regexp_not_imatch_op"] = _make_regexp(True, True)
+
+
+@register("regexp_replace")
+def _regexp_replace(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        pat = string_values(cols[1])
+        rep = string_values(cols[2])
+        out = [re.sub(p, r.replace("\\", "\\\\"), v, count=1)
+               for v, p, r in zip(s, pat, rep)]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+# -- conditionals ----------------------------------------------------------
+
+@register("coalesce")
+def _coalesce(ts):
+    t = next((x for x in ts if x.id is not dt.TypeId.NULL), dt.NULLTYPE)
+    def impl(cols, n):
+        vals = [c.to_pylist() for c in cols]
+        out = []
+        for i in range(n):
+            v = None
+            for col_vals in vals:
+                if col_vals[i] is not None:
+                    v = col_vals[i]
+                    break
+            out.append(v)
+        return Column.from_pylist(out, t)
+    return FunctionResolution(t, impl)
+
+
+@register("nullif")
+def _nullif(ts):
+    t = ts[0]
+    def impl(cols, n):
+        a, b = cols
+        if a.type.is_string or b.type.is_string:
+            eq = string_values(a) == string_values(b)
+        else:
+            eq = a.data == b.data
+        both_valid = a.valid_mask() & b.valid_mask()
+        make_null = both_valid & eq
+        validity = a.valid_mask() & ~make_null
+        return Column(t, a.data, None if validity.all() else validity,
+                      a.dictionary)
+    return FunctionResolution(t, impl)
+
+
+def _make_extreme(is_greatest):
+    def resolver(ts):
+        t = ts[0]
+        for x in ts[1:]:
+            if x.is_numeric and t.is_numeric:
+                t = dt.common_numeric(t, x)
+        def impl(cols, n):
+            # NULLs are ignored (PG GREATEST/LEAST semantics)
+            vals = [c.to_pylist() for c in cols]
+            out = []
+            for i in range(n):
+                cand = [v[i] for v in vals if v[i] is not None]
+                out.append((max(cand) if is_greatest else min(cand)) if cand else None)
+            return Column.from_pylist(out, t)
+        return FunctionResolution(t, impl)
+    return resolver
+
+
+_REGISTRY["greatest"] = _make_extreme(True)
+_REGISTRY["least"] = _make_extreme(False)
+
+
+# -- date/time -------------------------------------------------------------
+
+_EXTRACT_FIELDS = {"year", "month", "day", "hour", "minute", "second", "dow",
+                   "doy", "epoch", "quarter", "week"}
+
+
+@register("extract")
+@register("date_part")
+def _extract(ts):
+    def impl(cols, n):
+        field = string_values(cols[0])[0] if n else "year"
+        micros = cols[1].data.astype("datetime64[us]") \
+            if cols[1].type.id is dt.TypeId.TIMESTAMP \
+            else cols[1].data.astype("datetime64[D]").astype("datetime64[us]")
+        dts = micros
+        Y = dts.astype("datetime64[Y]").astype(np.int64) + 1970
+        if field == "year":
+            data = Y.astype(np.float64)
+        elif field == "month":
+            data = (dts.astype("datetime64[M]").astype(np.int64) % 12 + 1).astype(np.float64)
+        elif field == "day":
+            data = ((dts.astype("datetime64[D]") -
+                     dts.astype("datetime64[M]").astype("datetime64[D]"))
+                    .astype(np.int64) + 1).astype(np.float64)
+        elif field == "hour":
+            data = ((dts.astype(np.int64) // 3_600_000_000) % 24).astype(np.float64)
+        elif field == "minute":
+            data = ((dts.astype(np.int64) // 60_000_000) % 60).astype(np.float64)
+        elif field == "second":
+            data = ((dts.astype(np.int64) % 60_000_000) / 1e6)
+        elif field == "epoch":
+            data = dts.astype(np.int64) / 1e6
+        elif field == "dow":
+            data = ((dts.astype("datetime64[D]").astype(np.int64) + 4) % 7).astype(np.float64)
+        elif field == "quarter":
+            m = dts.astype("datetime64[M]").astype(np.int64) % 12
+            data = (m // 3 + 1).astype(np.float64)
+        else:
+            raise errors.unsupported(f"extract field {field!r}")
+        return _result(dt.DOUBLE, data, cols[1:])
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("to_timestamp")
+def _to_timestamp(ts):
+    def impl(cols, n):
+        secs = cols[0].data.astype(np.float64)
+        return _result(dt.TIMESTAMP, (secs * 1e6).astype(np.int64), cols)
+    return FunctionResolution(dt.TIMESTAMP, impl)
+
+
+# -- system ----------------------------------------------------------------
+
+@register("version")
+def _version(ts):
+    def impl(cols, n):
+        from .. import __version__
+        v = f"PostgreSQL 16.0 (serenedb_tpu {__version__})"
+        return Column.from_pylist([v] * max(n, 1), dt.VARCHAR)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("current_schema")
+def _current_schema(ts):
+    def impl(cols, n):
+        return Column.from_pylist(["main"] * max(n, 1), dt.VARCHAR)
+    return FunctionResolution(dt.VARCHAR, impl)

@@ -1,0 +1,12 @@
+"""Device kernels (JAX/XLA + Pallas).
+
+Policy: elementwise predicate/projection chains and simple reductions are
+plain jnp — XLA already fuses them into single HBM passes, which is the win
+for scan/filter/aggregate. Pallas is reserved for the shapes XLA can't fuse
+well: posting-block BM25 scoring + top-k (ops/bm25.py), bitpacked posting
+decode, and IVF scan.
+"""
+
+from . import agg
+
+__all__ = ["agg"]

@@ -1,0 +1,268 @@
+"""Aggregation kernels: scalar reductions and hash GROUP BY.
+
+Reference analog: DuckDB's vectorized (perfect-)hash aggregate operators (the
+reference gets these from its DuckDB fork; SURVEY.md §1 L3). TPU re-design:
+
+- Scalar aggregates are XLA reductions over (rows, 128) tiles with the
+  validity mask folded in — XLA fuses predicate + mask + reduce into one HBM
+  pass, the ClickBench Q1 shape.
+- GROUP BY operates on *group codes* (dense ints in [0, G)). Dictionary
+  VARCHAR columns already carry dense codes; other keys are factorized
+  host-side per batch (np.unique-style).
+- Exactness policy (PG parity: SUM(int) is BIGINT): JAX x64 stays off and
+  TPU has no fast int64, so device kernels produce int32/f32 partials that
+  are provably exact for their shapes, and the host combines them in numpy
+  int64. Integer SUM scatters four 8-bit limbs into int32 group accumulators
+  (exact while each group sees < 2^31/255 ≈ 8.4M rows per call; the executor
+  chunks input below that). Small-G SUM/COUNT ride the MXU as one-hot f32
+  matmuls over row chunks small enough that every partial stays within f32's
+  exact-integer range.
+
+All device entry points are jit-compiled with static group counts/ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ONEHOT_MAX_GROUPS = 1024      # one-hot matmul path bound
+ONEHOT_CHUNK = 2048           # rows per matmul chunk (f32-exactness bound)
+SCATTER_SUM_MAX_ROWS = 4 << 20  # executor must chunk int-sum calls below this
+
+
+# -- scalar reductions -----------------------------------------------------
+
+@jax.jit
+def masked_count(mask: jax.Array) -> jax.Array:
+    return jnp.sum(mask, dtype=jnp.int32)
+
+
+@jax.jit
+def masked_sum_float(vals: jax.Array, mask: jax.Array) -> jax.Array:
+    return jnp.sum(jnp.where(mask, vals, 0.0).astype(jnp.float32))
+
+
+@jax.jit
+def masked_sum_int_partials(vals: jax.Array, mask: jax.Array) -> jax.Array:
+    """Per-tile-row int32 partial sums, split into 16-bit halves so each
+    128-lane partial is exact in int32 for any int32 input (lo ≤ 128·65535,
+    hi ≤ 128·2^15). Returns (rows, 2) [hi, lo]; host combines as
+    (Σhi << 16) + Σlo in int64."""
+    v = jnp.where(mask, vals, 0).astype(jnp.int32)
+    lo = (v & 0xFFFF).astype(jnp.int32)
+    hi = jnp.right_shift(v, 16)  # arithmetic shift: hi*2^16 + lo == v
+    return jnp.stack([jnp.sum(hi, axis=1, dtype=jnp.int32),
+                      jnp.sum(lo, axis=1, dtype=jnp.int32)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def masked_minmax(vals: jax.Array, mask: jax.Array, op: str) -> jax.Array:
+    ident = _identity(vals.dtype, op)
+    v = jnp.where(mask, vals, ident)
+    return jnp.min(v) if op == "min" else jnp.max(v)
+
+
+def masked_sum_int(vals: jax.Array, mask: jax.Array) -> int:
+    parts = np.asarray(masked_sum_int_partials(vals, mask)).astype(np.int64)
+    return int((parts[:, 0].sum() << 16) + parts[:, 1].sum())
+
+
+def _identity(dtype, op):
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return info.max if op == "min" else info.min
+    return jnp.inf if op == "min" else -jnp.inf
+
+
+# -- grouped aggregation ---------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def group_count_onehot(codes: jax.Array, mask: jax.Array, num_groups: int) -> jax.Array:
+    """(C-chunked one-hot matmul) per-group counts as f32 chunk partials
+    (chunk, G); each entry ≤ ONEHOT_CHUNK so exact. Host sums in int64."""
+    flat_codes = codes.reshape(-1)
+    flat_mask = mask.reshape(-1).astype(jnp.float32)
+    n = flat_codes.shape[0]
+    c = -(-n // ONEHOT_CHUNK)
+    pad = c * ONEHOT_CHUNK - n
+    flat_codes = jnp.pad(flat_codes, (0, pad))
+    flat_mask = jnp.pad(flat_mask, (0, pad))
+
+    def chunk(_, args):
+        cc, mm = args
+        oh = jax.nn.one_hot(cc, num_groups, dtype=jnp.float32)
+        return None, jnp.einsum("ng,n->g", oh, mm,
+                                preferred_element_type=jnp.float32)
+
+    _, ys = jax.lax.scan(
+        chunk, None,
+        (flat_codes.reshape(c, ONEHOT_CHUNK), flat_mask.reshape(c, ONEHOT_CHUNK)))
+    return ys  # (c, G) f32, each exact
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def group_count_scatter(codes: jax.Array, mask: jax.Array, num_groups: int) -> jax.Array:
+    flat_codes = codes.reshape(-1)
+    flat_mask = mask.reshape(-1)
+    safe = jnp.where(flat_mask, flat_codes, 0)
+    zero = jnp.zeros((num_groups,), dtype=jnp.int32)
+    return zero.at[safe].add(flat_mask.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def group_sum_float(codes: jax.Array, mask: jax.Array, vals: jax.Array,
+                    num_groups: int) -> jax.Array:
+    flat_codes = codes.reshape(-1)
+    flat_mask = mask.reshape(-1)
+    v = jnp.where(flat_mask, vals.reshape(-1), 0.0).astype(jnp.float32)
+    safe = jnp.where(flat_mask, flat_codes, 0)
+    return jnp.zeros((num_groups,), dtype=jnp.float32).at[safe].add(v)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def group_sum_int_limbs(codes: jax.Array, mask: jax.Array, vals: jax.Array,
+                        num_groups: int) -> jax.Array:
+    """Exact int sum via 8-bit limb scatter-adds of the two's-complement
+    representation: sum(v) = Σ_i (limb_sum_i << 8i) − (neg_count << 32).
+
+    Returns (G, 5) int32: four byte-limb sums + count of negative values.
+    Exact while each group sees < 2^31/255 ≈ 8.4M rows per call (the
+    executor chunks calls at SCATTER_SUM_MAX_ROWS).
+    """
+    flat_codes = codes.reshape(-1)
+    flat_mask = mask.reshape(-1)
+    v = vals.reshape(-1).astype(jnp.int32)
+    vu = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    safe = jnp.where(flat_mask, flat_codes, 0)
+    m32 = flat_mask.astype(jnp.int32)
+    out = jnp.zeros((num_groups, 5), dtype=jnp.int32)
+    for limb in range(4):
+        byte = (jnp.right_shift(vu, 8 * limb) & jnp.uint32(0xFF)).astype(jnp.int32)
+        out = out.at[safe, limb].add(byte * m32)
+    out = out.at[safe, 4].add((v < 0).astype(jnp.int32) * m32)
+    return out
+
+
+def combine_sum_int_limbs(limbs: np.ndarray) -> np.ndarray:
+    """(G,5) limb sums (+neg count) → exact int64 group sums. Accepts a
+    chunked (C,G,5) array too (summed in int64 first)."""
+    if limbs.ndim == 3:
+        limbs = limbs.astype(np.int64).sum(axis=0)
+    acc = np.zeros(limbs.shape[0], dtype=np.int64)
+    for limb in range(4):
+        acc += limbs[:, limb].astype(np.int64) << (8 * limb)
+    return acc - (limbs[:, 4].astype(np.int64) << 32)
+
+
+SCATTER_CHUNK_TILES = SCATTER_SUM_MAX_ROWS // 128
+
+
+def group_sum_int_limbs_chunked(codes: jax.Array, mask: jax.Array,
+                                vals: jax.Array, num_groups: int) -> jax.Array:
+    """Row-chunked variant of group_sum_int_limbs for inputs whose per-group
+    row count could exceed the int32 limb-accumulator bound (~8.4M rows).
+    Returns (C, G, 5); combine_sum_int_limbs handles the extra axis."""
+    r = codes.shape[0]
+    c = -(-r // SCATTER_CHUNK_TILES)
+    pad = c * SCATTER_CHUNK_TILES - r
+    codes = jnp.pad(codes, ((0, pad), (0, 0)))
+    mask = jnp.pad(mask, ((0, pad), (0, 0)))
+    vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    shape = (c, SCATTER_CHUNK_TILES, codes.shape[1])
+
+    def body(args):
+        cc, mm, vv = args
+        return group_sum_int_limbs(cc, mm, vv, num_groups)
+
+    return jax.lax.map(body, (codes.reshape(shape), mask.reshape(shape),
+                              vals.reshape(shape)))
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "op"))
+def group_min_max(codes: jax.Array, mask: jax.Array, vals: jax.Array,
+                  num_groups: int, op: str) -> jax.Array:
+    flat_codes = codes.reshape(-1)
+    flat_mask = mask.reshape(-1)
+    v = vals.reshape(-1)
+    ident = _identity(v.dtype, op)
+    v = jnp.where(flat_mask, v, ident)
+    safe = jnp.where(flat_mask, flat_codes, 0)
+    init = jnp.full((num_groups,), ident, dtype=v.dtype)
+    return init.at[safe].min(v) if op == "min" else init.at[safe].max(v)
+
+
+# -- host-facing grouped API ----------------------------------------------
+
+def group_count(codes: jax.Array, mask: jax.Array, num_groups: int) -> np.ndarray:
+    if num_groups <= ONEHOT_MAX_GROUPS:
+        ys = np.asarray(group_count_onehot(codes, mask, num_groups))
+        return ys.astype(np.int64).sum(axis=0)
+    return np.asarray(group_count_scatter(codes, mask, num_groups)).astype(np.int64)
+
+
+def group_sum_int(codes: jax.Array, mask: jax.Array, vals: jax.Array,
+                  num_groups: int) -> np.ndarray:
+    """Exact per-group int64 sums (limb decomposition, see
+    group_sum_int_limbs)."""
+    limbs = group_sum_int_limbs(codes, mask, vals, num_groups)
+    return combine_sum_int_limbs(np.asarray(limbs))
+
+
+def group_min(codes, mask, vals, num_groups) -> np.ndarray:
+    return np.asarray(group_min_max(codes, mask, vals, num_groups, "min"))
+
+
+def group_max(codes, mask, vals, num_groups) -> np.ndarray:
+    return np.asarray(group_min_max(codes, mask, vals, num_groups, "max"))
+
+
+# -- host-side key factorization ------------------------------------------
+
+def factorize_keys(key_arrays: list[np.ndarray],
+                   valids: list[Optional[np.ndarray]]) -> tuple[np.ndarray, list[np.ndarray], np.ndarray]:
+    """Composite GROUP BY keys → dense codes.
+
+    Returns (codes int32 [n], unique_key_value_columns, unique_valid (k, G)).
+    NULL keys group together (PG GROUP BY semantics). Host-side O(n log n).
+    """
+    n = len(key_arrays[0])
+    rows = []
+    for arr, valid in zip(key_arrays, valids):
+        a = np.asarray(arr)
+        if a.dtype == np.bool_:
+            a = a.astype(np.int8)
+        if valid is not None:
+            a = np.where(valid, a, np.zeros((), dtype=a.dtype))
+            rows.append((~valid).astype(a.dtype))
+        else:
+            rows.append(np.zeros(n, dtype=a.dtype))
+        rows.append(a)
+    composite = np.stack(rows) if rows else np.zeros((0, n))
+    first_idx, inverse = _unique_columns(composite)
+    codes = inverse.astype(np.int32)
+    uniq_cols = [np.asarray(arr)[first_idx] for arr in key_arrays]
+    uniq_valid = np.stack(
+        [v[first_idx] if v is not None else np.ones(len(first_idx), dtype=bool)
+         for v in valids]) if valids else np.ones((0, len(first_idx)), dtype=bool)
+    return codes, uniq_cols, uniq_valid
+
+
+def _unique_columns(composite: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unique over columns of a (k, n) matrix → (first-occurrence idx, inverse)."""
+    n = composite.shape[1]
+    if n == 0:
+        return np.array([], dtype=np.int64), np.array([], dtype=np.int64)
+    order = np.lexsort(composite[::-1])
+    sorted_cols = composite[:, order]
+    neq = np.any(sorted_cols[:, 1:] != sorted_cols[:, :-1], axis=0)
+    group_of_sorted = np.concatenate([[0], np.cumsum(neq)])
+    inverse = np.empty(n, dtype=np.int64)
+    inverse[order] = group_of_sorted
+    first_idx = np.empty(int(group_of_sorted[-1]) + 1, dtype=np.int64)
+    first_idx[group_of_sorted[::-1]] = order[::-1]
+    return first_idx, inverse

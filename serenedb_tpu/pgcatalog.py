@@ -1,0 +1,84 @@
+"""System catalog tables (pg_catalog emulation, sdb introspection).
+
+Reference analog: server/pg/pg_catalog/ (67 system tables materialized from
+catalog snapshots; SURVEY.md §2.3) + sdb_catalog (sdb_metrics, sdb_settings,
+sdb_log). Starts with the tables clients/tests actually touch; grows toward
+the full surface with the catalog layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .columnar.column import Batch
+from .exec.tables import MemTable, TableProvider
+from .utils import log as _log
+from .utils import metrics as _metrics
+from .utils.config import REGISTRY as _settings_registry
+
+
+def system_table(db, parts: list[str]) -> Optional[TableProvider]:
+    name = parts[-1].lower()
+    qualified = len(parts) >= 2 and parts[-2].lower() in ("pg_catalog",
+                                                          "information_schema",
+                                                          "sdb_catalog")
+    if len(parts) >= 2 and not qualified:
+        return None
+    if name == "pg_tables":
+        rows = db.table_list()
+        return MemTable("pg_tables", Batch.from_pydict({
+            "schemaname": [r[0] for r in rows if r[2] == "table"],
+            "tablename": [r[1] for r in rows if r[2] == "table"],
+            "tableowner": ["serene" for r in rows if r[2] == "table"],
+        }))
+    if name == "pg_views":
+        rows = db.table_list()
+        return MemTable("pg_views", Batch.from_pydict({
+            "schemaname": [r[0] for r in rows if r[2] == "view"],
+            "viewname": [r[1] for r in rows if r[2] == "view"],
+        }))
+    if name == "pg_namespace":
+        names = sorted(db.schemas)
+        return MemTable("pg_namespace", Batch.from_pydict({
+            "oid": list(range(1, len(names) + 1)),
+            "nspname": names,
+        }))
+    if name == "pg_class":
+        rows = db.table_list()
+        return MemTable("pg_class", Batch.from_pydict({
+            "oid": list(range(1, len(rows) + 1)),
+            "relname": [r[1] for r in rows],
+            "relkind": ["r" if r[2] == "table" else "v" for r in rows],
+        }))
+    if name == "sdb_settings":
+        names = _settings_registry.names()
+        return MemTable("sdb_settings", Batch.from_pydict({
+            "name": names,
+            "setting": [str(_settings_registry.get_global(n)) for n in names],
+            "description": [_settings_registry.definition(n).description
+                            for n in names],
+        }))
+    if name == "sdb_metrics":
+        return metrics_table()
+    if name == "sdb_log":
+        return log_table()
+    return None
+
+
+def metrics_table() -> TableProvider:
+    gs = _metrics.REGISTRY.all()
+    return MemTable("sdb_metrics", Batch.from_pydict({
+        "metric": [g.name for g in gs],
+        "value": [g.value for g in gs],
+        "description": [g.description for g in gs],
+    }))
+
+
+def log_table() -> TableProvider:
+    recs = _log.MANAGER.records()
+    return MemTable("sdb_log", Batch.from_pydict({
+        "ts": [r.ts for r in recs],
+        "level": [r.level.name for r in recs],
+        "topic": [r.topic for r in recs],
+        "message": [r.message for r in recs],
+    }))

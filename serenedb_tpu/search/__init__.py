@@ -1,0 +1,2 @@
+"""Search engine (IResearch analog): analyzers, inverted-index segments,
+posting-block scoring kernels, scorers, and the SQL full-text surface."""

@@ -1,0 +1,189 @@
+"""Text analyzers (tokenizer pipelines).
+
+Reference analog: libs/iresearch/analysis/ — 25+ analyzers (SURVEY.md §2.7).
+Analysis is pointer-chasing CPU work in any architecture; it stays on host
+here too (the reference's design point holds: term matching on CPU, scoring
+on the accelerator — SURVEY.md §7 hard part 5).
+
+Implemented: text (lowercase + unicode word split + stopwords + stemming),
+whitespace, keyword, ngram, edge_ngram, delimiter. The registry mirrors the
+reference's named-tokenizer catalog objects (CREATE ... TOKENIZER options).
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from .. import errors
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+# minimal english stopword list (reference text analyzer uses snowball lists)
+EN_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split())
+
+
+def _porter_light(token: str) -> str:
+    """Lightweight English stemmer (S-stemmer + common suffixes). The
+    reference uses snowball; this approximation keeps index/query symmetric
+    (both sides stem identically), which is what parity requires."""
+    t = token
+    for suf in ("ational", "iveness", "fulness", "ousness"):
+        if t.endswith(suf) and len(t) > len(suf) + 2:
+            return t[: -len(suf) + 3] if suf == "ational" else t[: -4]
+    for suf in ("ing", "edly", "ed", "ly", "ies", "ness"):
+        if t.endswith(suf) and len(t) - len(suf) >= 3:
+            t = t[: -len(suf)]
+            if suf == "ies":
+                t += "y"
+            return t
+    if t.endswith("es") and len(t) >= 5:
+        return t[:-2]
+    if t.endswith("s") and not t.endswith("ss") and len(t) >= 4:
+        return t[:-1]
+    return t
+
+
+@dataclass
+class Token:
+    term: str
+    position: int
+    start: int = 0
+    end: int = 0
+
+
+class Analyzer:
+    name = "keyword"
+
+    def tokenize(self, text: str) -> list[Token]:
+        raise NotImplementedError
+
+    def terms(self, text: str) -> list[str]:
+        return [t.term for t in self.tokenize(text)]
+
+
+class KeywordAnalyzer(Analyzer):
+    name = "keyword"
+
+    def tokenize(self, text: str) -> list[Token]:
+        return [Token(text, 0, 0, len(text))] if text else []
+
+
+class WhitespaceAnalyzer(Analyzer):
+    name = "whitespace"
+
+    def tokenize(self, text: str) -> list[Token]:
+        out = []
+        pos = 0
+        for m in re.finditer(r"\S+", text):
+            out.append(Token(m.group(), pos, m.start(), m.end()))
+            pos += 1
+        return out
+
+
+class TextAnalyzer(Analyzer):
+    """Locale text analyzer: NFC normalize, lowercase, word split, accent
+    fold, optional stopwords + stemming (reference: analysis/text_analyzer)."""
+
+    name = "text"
+
+    def __init__(self, stopwords: Optional[frozenset] = EN_STOPWORDS,
+                 stem: bool = True, accent_fold: bool = True):
+        self.stopwords = stopwords or frozenset()
+        self.stem = stem
+        self.accent_fold = accent_fold
+
+    def tokenize(self, text: str) -> list[Token]:
+        norm = unicodedata.normalize("NFC", text).lower()
+        out = []
+        pos = 0
+        for m in _WORD_RE.finditer(norm):
+            term = m.group()
+            if self.accent_fold:
+                term = "".join(c for c in unicodedata.normalize("NFD", term)
+                               if not unicodedata.combining(c))
+            if term in self.stopwords:
+                pos += 1
+                continue
+            if self.stem:
+                term = _porter_light(term)
+            out.append(Token(term, pos, m.start(), m.end()))
+            pos += 1
+        return out
+
+
+class SimpleTextAnalyzer(TextAnalyzer):
+    """text without stemming/stopwords — lowercase word split only."""
+
+    name = "simple"
+
+    def __init__(self):
+        super().__init__(stopwords=frozenset(), stem=False)
+
+
+class NgramAnalyzer(Analyzer):
+    name = "ngram"
+
+    def __init__(self, min_n: int = 2, max_n: int = 3, edge: bool = False):
+        self.min_n, self.max_n, self.edge = min_n, max_n, edge
+
+    def tokenize(self, text: str) -> list[Token]:
+        t = text.lower()
+        out = []
+        pos = 0
+        starts = [0] if self.edge else range(len(t))
+        for i in starts:
+            for n in range(self.min_n, self.max_n + 1):
+                if i + n <= len(t):
+                    out.append(Token(t[i:i + n], pos, i, i + n))
+                    pos += 1
+        return out
+
+
+class DelimiterAnalyzer(Analyzer):
+    name = "delimiter"
+
+    def __init__(self, delimiter: str = ","):
+        self.delimiter = delimiter
+
+    def tokenize(self, text: str) -> list[Token]:
+        out = []
+        start = 0
+        for pos, part in enumerate(text.split(self.delimiter)):
+            out.append(Token(part, pos, start, start + len(part)))
+            start += len(part) + len(self.delimiter)
+        return out
+
+
+_BUILTINS: dict[str, Callable[[], Analyzer]] = {
+    "keyword": KeywordAnalyzer,
+    "whitespace": WhitespaceAnalyzer,
+    "text": TextAnalyzer,
+    "text_en": TextAnalyzer,
+    "simple": SimpleTextAnalyzer,
+    "ngram": NgramAnalyzer,
+    "edge_ngram": lambda: NgramAnalyzer(edge=True),
+    "delimiter": DelimiterAnalyzer,
+}
+
+_cache: dict[str, Analyzer] = {}
+
+
+def get_analyzer(name: str) -> Analyzer:
+    key = (name or "text").lower()
+    a = _cache.get(key)
+    if a is None:
+        ctor = _BUILTINS.get(key)
+        if ctor is None:
+            raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                  f'tokenizer "{name}" does not exist')
+        a = _cache[key] = ctor()
+    return a
+
+
+def default_analyzer() -> Analyzer:
+    return get_analyzer("text")

@@ -1,0 +1,21 @@
+"""Inverted-index build entry point (placeholder until the segment layer).
+
+Reference analog: CREATE INDEX ... USING inverted backfill
+(server/connector/duckdb_physical_create_index.*). The real segmented index
+with posting blocks lands with the search core; this records index metadata
+so DDL round-trips."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class IndexDef:
+    columns: list[str]
+    using: str
+    options: dict = field(default_factory=dict)
+
+
+def build_index_for_table(provider, columns, using, options) -> IndexDef:
+    return IndexDef(list(columns), using, dict(options))

@@ -1,0 +1,197 @@
+"""Query-side text matching semantics.
+
+The brute-force matchers here define the *semantics contract* for un-indexed
+columns (and for parity tests against the indexed path): `##` is analyzed
+phrase match (consecutive positions), `@@` is an analyzed boolean query in a
+Lucene-lite syntax: terms (implicit AND... actually implicit OR per ES
+query_string → the reference's `@@` maps to to_tsquery semantics: & | ! and
+quoted phrases). Reference: server/connector/functions/ts_*.cpp,
+libs/iresearch/parser/lucene_*.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .analysis import default_analyzer
+
+
+def match_phrase_brute(texts: np.ndarray, phrases: np.ndarray) -> np.ndarray:
+    an = default_analyzer()
+    out = np.zeros(len(texts), dtype=bool)
+    # common case: constant phrase
+    cache: dict[str, list[str]] = {}
+    for i, (text, phrase) in enumerate(zip(texts, phrases)):
+        terms = cache.get(phrase)
+        if terms is None:
+            terms = cache[phrase] = [t.term for t in an.tokenize(phrase)]
+        out[i] = _phrase_in(an, text, terms)
+    return out
+
+
+def _phrase_in(an, text: str, terms: list[str]) -> bool:
+    if not terms:
+        return False
+    toks = an.tokenize(text)
+    if len(terms) == 1:
+        return any(t.term == terms[0] for t in toks)
+    # positions must be consecutive
+    pos_of: dict[str, list[int]] = {}
+    for t in toks:
+        pos_of.setdefault(t.term, []).append(t.position)
+    first = pos_of.get(terms[0], [])
+    for p in first:
+        if all((p + k) in pos_of.get(term, ()) for k, term in enumerate(terms[1:], 1)):
+            return True
+    return False
+
+
+# -- tsquery-style boolean query parsing ----------------------------------
+
+class QNode:
+    pass
+
+
+class QTerm(QNode):
+    def __init__(self, term):
+        self.term = term
+
+
+class QPhrase(QNode):
+    def __init__(self, terms):
+        self.terms = terms
+
+
+class QAnd(QNode):
+    def __init__(self, args):
+        self.args = args
+
+
+class QOr(QNode):
+    def __init__(self, args):
+        self.args = args
+
+
+class QNot(QNode):
+    def __init__(self, arg):
+        self.arg = arg
+
+
+class QPrefix(QNode):
+    def __init__(self, prefix):
+        self.prefix = prefix
+
+
+def parse_query(q: str, analyzer=None) -> QNode:
+    """`a & b`, `a | b`, `!a`, `"a phrase"`, `pre*`, parens. Bare terms
+    separated by whitespace are AND-ed (to_tsquery-ish)."""
+    an = analyzer or default_analyzer()
+    toks = _qlex(q)
+    node, rest = _parse_or(toks, an)
+    return node
+
+
+def _qlex(q: str) -> list[str]:
+    out = []
+    i = 0
+    while i < len(q):
+        c = q[i]
+        if c.isspace():
+            i += 1
+        elif c in "&|!()":
+            out.append(c)
+            i += 1
+        elif c == '"':
+            j = q.find('"', i + 1)
+            j = len(q) if j < 0 else j
+            out.append('"' + q[i + 1:j])
+            i = j + 1
+        else:
+            j = i
+            while j < len(q) and not q[j].isspace() and q[j] not in "&|!()":
+                j += 1
+            out.append(q[i:j])
+            i = j
+    return out
+
+
+def _parse_or(toks, an):
+    left, toks = _parse_and(toks, an)
+    args = [left]
+    while toks and toks[0] == "|":
+        nxt, toks = _parse_and(toks[1:], an)
+        args.append(nxt)
+    return (args[0] if len(args) == 1 else QOr(args)), toks
+
+
+def _parse_and(toks, an):
+    args = []
+    while toks and toks[0] not in ("|", ")"):
+        if toks[0] == "&":
+            toks = toks[1:]
+            continue
+        node, toks = _parse_unary(toks, an)
+        if node is not None:
+            args.append(node)
+    if not args:
+        return QAnd([]), toks
+    return (args[0] if len(args) == 1 else QAnd(args)), toks
+
+
+def _parse_unary(toks, an):
+    if not toks:
+        return None, toks
+    t = toks[0]
+    if t == "!":
+        node, rest = _parse_unary(toks[1:], an)
+        return QNot(node), rest
+    if t == "(":
+        node, rest = _parse_or(toks[1:], an)
+        if rest and rest[0] == ")":
+            rest = rest[1:]
+        return node, rest
+    if t.startswith('"'):
+        terms = [tok.term for tok in an.tokenize(t[1:])]
+        return QPhrase(terms), toks[1:]
+    if t.endswith("*") and len(t) > 1:
+        base = t[:-1].lower()
+        return QPrefix(base), toks[1:]
+    terms = [tok.term for tok in an.tokenize(t)]
+    if not terms:
+        return None, toks[1:]
+    if len(terms) == 1:
+        return QTerm(terms[0]), toks[1:]
+    return QPhrase(terms), toks[1:]
+
+
+def eval_query_on_text(node: QNode, an, text: str) -> bool:
+    toks = an.tokenize(text)
+    terms = {t.term for t in toks}
+
+    def ev(nd) -> bool:
+        if isinstance(nd, QTerm):
+            return nd.term in terms
+        if isinstance(nd, QPhrase):
+            return _phrase_in(an, text, nd.terms)
+        if isinstance(nd, QAnd):
+            return all(ev(a) for a in nd.args)
+        if isinstance(nd, QOr):
+            return any(ev(a) for a in nd.args)
+        if isinstance(nd, QNot):
+            return not ev(nd.arg)
+        if isinstance(nd, QPrefix):
+            return any(t.startswith(nd.prefix) for t in terms)
+        return False
+    return ev(node)
+
+
+def match_query_brute(texts: np.ndarray, queries: np.ndarray) -> np.ndarray:
+    an = default_analyzer()
+    out = np.zeros(len(texts), dtype=bool)
+    cache: dict[str, QNode] = {}
+    for i, (text, q) in enumerate(zip(texts, queries)):
+        node = cache.get(q)
+        if node is None:
+            node = cache[q] = parse_query(q, an)
+        out[i] = eval_query_on_text(node, an, text)
+    return out

@@ -1,0 +1,84 @@
+"""SQL surface of the search engine: ts_* functions and the ##/@@ operators.
+
+Reference analog: server/connector/functions/ts_*.cpp + search.cpp:149-330
+(phrase `##`, tsquery `@@`, scorer functions bm25()/tfidf(), ts_offsets,
+highlights) and the vector distance operators `<->`/`<#>`/`<=>`
+(functions/vector.cpp). Bound here; execution is CPU text-match for
+un-indexed columns and is *claimed by the index pushdown optimizer* when the
+scan has a search index (exec/pushdown.py), mirroring the reference's
+IResearchPushdownComplexFilter (optimizer/iresearch_plan.cpp:1068-1097).
+
+Phase-2 will replace the brute-force CPU fallbacks with segment scoring; the
+semantics defined here are the contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+
+_SEARCH_FUNCS = {"ts_match", "bm25", "tfidf", "to_tsquery", "ts_offsets"}
+
+
+def is_search_function(name: str) -> bool:
+    return name in _SEARCH_FUNCS
+
+
+def bind_operator(binder, e):
+    """Bind `col ## 'phrase'` (phrase match) and `col @@ 'query'`."""
+    from ..sql.expr import BoundFunc
+    from .analysis import default_analyzer
+    from .query import match_phrase_brute, match_query_brute
+
+    if e.op in ("<->", "<#>", "<=>"):
+        raise errors.unsupported("vector distance operators need an ivf index "
+                                 "(coming with the vector layer)")
+    left = binder.bind(e.left)
+    right = binder.bind(e.right)
+    if not left.type.is_string:
+        raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                              f"operator {e.op} requires a text column")
+    fn = match_phrase_brute if e.op == "##" else match_query_brute
+
+    def impl(cols, batch, _fn=fn):
+        hay, needle = cols
+        from ..sql.expr import propagate_nulls, string_values
+        texts = string_values(hay)
+        pats = string_values(needle)
+        data = _fn(texts, pats)
+        validity = propagate_nulls(cols)
+        return Column(dt.BOOL, data, validity)
+
+    name = "ts_phrase" if e.op == "##" else "ts_query"
+    return BoundFunc(name, [left, right], dt.BOOL, impl)
+
+
+def bind_function(binder, e):
+    from ..sql.expr import BoundFunc
+    name = e.name
+    if name == "ts_match":
+        rewritten = type(e)  # FuncCall
+        if len(e.args) != 2:
+            raise errors.syntax("ts_match(column, query) takes 2 arguments")
+        import dataclasses
+        from ..sql import ast as _ast
+        return bind_operator(binder, _ast.BinaryOp("@@", e.args[0], e.args[1]))
+    if name in ("bm25", "tfidf"):
+        # scorer over an indexed scan; meaningful only with pushdown — the
+        # optimizer replaces it with the scan's score column. Unpushed use
+        # yields 0.0 (reference: unscored context returns default score).
+        args = [binder.bind(a) for a in e.args]
+
+        def impl(cols, batch):
+            return Column(dt.FLOAT, np.zeros(batch.num_rows, dtype=np.float32))
+        return BoundFunc(name, args, dt.FLOAT, impl)
+    if name == "to_tsquery":
+        args = [binder.bind(a) for a in e.args]
+
+        def impl(cols, batch):
+            return cols[-1]
+        return BoundFunc(name, args, dt.VARCHAR, impl)
+    raise errors.unsupported(f"search function {name}")

@@ -1,0 +1,3 @@
+from . import ast, binder, expr, lexer, parser, planner
+
+__all__ = ["ast", "binder", "expr", "lexer", "parser", "planner"]

@@ -1,0 +1,288 @@
+"""Parsed (unbound) AST for SQL statements and expressions."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# -- expressions -----------------------------------------------------------
+
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: Any           # python value; None for NULL
+    type_hint: Optional[str] = None
+
+
+@dataclass
+class ColumnRef(Expr):
+    parts: list[str]     # possibly qualified: [table, column] or [column]
+
+
+@dataclass
+class Star(Expr):
+    table: Optional[str] = None
+
+
+@dataclass
+class Param(Expr):
+    index: int           # 1-based
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str              # '+', '-', '*', '/', '%', '||', '=', '<>', '<', ...
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str              # '-', 'NOT'
+    operand: Expr
+
+
+@dataclass
+class Logical(Expr):
+    op: str              # 'AND' | 'OR'
+    args: list[Expr]
+
+
+@dataclass
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    operand: Expr
+    items: list[Expr]
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class Like(Expr):
+    operand: Expr
+    pattern: Expr
+    negated: bool = False
+    case_insensitive: bool = False
+
+
+@dataclass
+class FuncCall(Expr):
+    name: str
+    args: list[Expr]
+    distinct: bool = False
+    star: bool = False   # count(*)
+
+
+@dataclass
+class Cast(Expr):
+    operand: Expr
+    type_name: str
+
+
+@dataclass
+class Case(Expr):
+    operand: Optional[Expr]          # CASE <operand> WHEN ... or searched CASE
+    branches: list[tuple[Expr, Expr]]
+    else_: Optional[Expr]
+
+
+@dataclass
+class Subquery(Expr):
+    query: "Select"
+    # EXISTS/IN-subquery support comes with joins
+
+
+# -- statements ------------------------------------------------------------
+
+class Statement:
+    pass
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableRef:
+    pass
+
+
+@dataclass
+class NamedTable(TableRef):
+    parts: list[str]                 # [schema, table] or [table]
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableFunction(TableRef):
+    name: str
+    args: list[Expr]
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef(TableRef):
+    query: "Select"
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinRef(TableRef):
+    kind: str                        # 'inner' | 'left' | 'right' | 'cross'
+    left: TableRef
+    right: TableRef
+    condition: Optional[Expr] = None
+    using: Optional[list[str]] = None
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem]
+    from_: Optional[TableRef] = None
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[Expr] = None
+    offset: Optional[Expr] = None
+    distinct: bool = False
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    not_null: bool = False
+    primary_key: bool = False
+    default: Optional[Expr] = None
+    tokenizer: Optional[str] = None   # search-table column analyzer
+
+
+@dataclass
+class CreateTable(Statement):
+    name: list[str]
+    columns: list[ColumnDef]
+    engine: str = "columnar"          # 'columnar' | 'search'  (reference: table_options.h:160)
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+    as_query: Optional[Select] = None
+    primary_key: list[str] = field(default_factory=list)
+
+
+@dataclass
+class CreateIndex(Statement):
+    name: Optional[str]
+    table: list[str]
+    columns: list[str]
+    using: str = "inverted"           # 'inverted' | 'btree' | 'ivf'
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class CreateSchema(Statement):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class Drop(Statement):
+    kind: str                         # 'table' | 'index' | 'schema' | 'view'
+    name: list[str]
+    if_exists: bool = False
+    cascade: bool = False
+
+
+@dataclass
+class Insert(Statement):
+    table: list[str]
+    columns: Optional[list[str]]
+    values: Optional[list[list[Expr]]]
+    query: Optional[Select] = None
+
+
+@dataclass
+class Delete(Statement):
+    table: list[str]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Update(Statement):
+    table: list[str]
+    assignments: list[tuple[str, Expr]]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class CreateView(Statement):
+    name: list[str]
+    query: Select
+    or_replace: bool = False
+
+
+@dataclass
+class SetStmt(Statement):
+    name: str
+    value: Any                        # python literal or 'DEFAULT'
+
+
+@dataclass
+class ShowStmt(Statement):
+    name: str                         # setting name or 'all' / 'tables'
+
+
+@dataclass
+class Transaction(Statement):
+    action: str                       # 'begin' | 'commit' | 'rollback'
+
+
+@dataclass
+class Explain(Statement):
+    inner: Statement
+    analyze: bool = False
+
+
+@dataclass
+class CopyStmt(Statement):
+    table: list[str]
+    columns: Optional[list[str]]
+    direction: str                    # 'from' | 'to'
+    target: str                       # filename or STDIN/STDOUT
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class VacuumStmt(Statement):
+    table: Optional[list[str]] = None
+    verbs: list[str] = field(default_factory=list)   # refresh/compact/cleanup
+
+
+@dataclass
+class Truncate(Statement):
+    table: list[str]

@@ -1,0 +1,380 @@
+"""Name resolution and type checking: AST → bound expressions.
+
+Reference analog: DuckDB's Binder (the reference's L3; SURVEY.md §3.2 —
+"binding pins a catalog::Snapshot"). Here binding resolves against a Scope
+of named/typed columns produced by the FROM clause, folds literals, resolves
+functions through the registry, and rewrites aggregate calls into AggSpec +
+BoundAggRef placeholders.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+from ..functions import scalar as fnlib
+from . import ast
+from .expr import (AggSpec, BoundAggRef, BoundCase, BoundColumn, BoundExpr,
+                   BoundFunc, BoundLiteral, kleene_and, kleene_or)
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_star",
+             "stddev", "stddev_samp", "var_samp", "variance",
+             "string_agg", "array_agg", "bool_and", "bool_or"}
+
+
+@dataclass
+class ScopeColumn:
+    table: Optional[str]
+    name: str
+    type: dt.SqlType
+    index: int
+
+
+@dataclass
+class Scope:
+    columns: list[ScopeColumn] = field(default_factory=list)
+
+    @staticmethod
+    def of(names: list[str], types: list[dt.SqlType],
+           table: Optional[str] = None) -> "Scope":
+        return Scope([ScopeColumn(table, n, t, i)
+                      for i, (n, t) in enumerate(zip(names, types))])
+
+    def resolve(self, parts: list[str]) -> ScopeColumn:
+        if len(parts) == 1:
+            name = parts[0]
+            matches = [c for c in self.columns if c.name.lower() == name.lower()]
+        elif len(parts) == 2:
+            tbl, name = parts
+            matches = [c for c in self.columns
+                       if c.name.lower() == name.lower()
+                       and c.table and c.table.lower() == tbl.lower()]
+        else:
+            tbl, name = parts[-2], parts[-1]
+            matches = [c for c in self.columns
+                       if c.name.lower() == name.lower()
+                       and c.table and c.table.lower() == tbl.lower()]
+        if not matches:
+            raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                  f'column "{".".join(parts)}" does not exist')
+        if len(matches) > 1:
+            raise errors.SqlError(errors.AMBIGUOUS_COLUMN,
+                                  f'column reference "{".".join(parts)}" is ambiguous')
+        return matches[0]
+
+    def star_columns(self, table: Optional[str] = None) -> list[ScopeColumn]:
+        if table is None:
+            return list(self.columns)
+        out = [c for c in self.columns
+               if c.table and c.table.lower() == table.lower()]
+        if not out:
+            raise errors.SqlError(errors.UNDEFINED_TABLE,
+                                  f'missing FROM-clause entry for table "{table}"')
+        return out
+
+
+_LIT_TYPE = {bool: dt.BOOL, int: dt.BIGINT, float: dt.DOUBLE, str: dt.VARCHAR}
+
+
+def literal_type(v) -> dt.SqlType:
+    if v is None:
+        return dt.NULLTYPE
+    if isinstance(v, bool):
+        return dt.BOOL
+    if isinstance(v, int):
+        return dt.INT if -2**31 <= v < 2**31 else dt.BIGINT
+    return _LIT_TYPE.get(type(v), dt.VARCHAR)
+
+
+class ExprBinder:
+    """Binds expressions in a scope; collects aggregates when allowed."""
+
+    def __init__(self, scope: Scope, params: Optional[list] = None,
+                 allow_aggs: bool = False):
+        self.scope = scope
+        self.params = params or []
+        self.allow_aggs = allow_aggs
+        self.aggs: list[AggSpec] = []
+        self._agg_keys: dict[str, int] = {}
+
+    def bind(self, e: ast.Expr) -> BoundExpr:
+        if isinstance(e, ast.Literal):
+            return BoundLiteral(e.value, literal_type(e.value))
+        if isinstance(e, ast.Param):
+            if e.index > len(self.params):
+                raise errors.SqlError("08P01",
+                                      f"no value for parameter ${e.index}")
+            v = self.params[e.index - 1]
+            return BoundLiteral(v, literal_type(v))
+        if isinstance(e, ast.ColumnRef):
+            c = self.scope.resolve(e.parts)
+            return BoundColumn(c.index, c.type, c.name)
+        if isinstance(e, ast.BinaryOp):
+            return self._bind_binary(e)
+        if isinstance(e, ast.UnaryOp):
+            if e.op == "NOT":
+                arg = self.bind(e.operand)
+                return self._call("opnot", [arg])
+            if e.op == "-":
+                return self._call("opneg", [self.bind(e.operand)])
+            raise errors.unsupported(f"unary {e.op}")
+        if isinstance(e, ast.Logical):
+            args = [self.bind(a) for a in e.args]
+            fn = kleene_and if e.op == "AND" else kleene_or
+            def impl(cols, n, _fn=fn):
+                return _fn(cols)
+            return BoundFunc(e.op.lower(), args, dt.BOOL,
+                             lambda cols, b, _fn=fn: _fn(cols))
+        if isinstance(e, ast.IsNull):
+            arg = self.bind(e.operand)
+            neg = e.negated
+
+            def impl(cols, batch, _neg=neg):
+                c = cols[0]
+                data = c.valid_mask() if _neg else ~c.valid_mask()
+                return Column(dt.BOOL, data)
+            return BoundFunc("is_null", [arg], dt.BOOL, impl)
+        if isinstance(e, ast.InList):
+            return self._bind_in(e)
+        if isinstance(e, ast.Between):
+            lo = ast.BinaryOp(">=", e.operand, e.low)
+            hi = ast.BinaryOp("<=", e.operand, e.high)
+            both: ast.Expr = ast.Logical("AND", [lo, hi])
+            if e.negated:
+                both = ast.UnaryOp("NOT", both)
+            return self.bind(both)
+        if isinstance(e, ast.Like):
+            args = [self.bind(e.operand), self.bind(e.pattern)]
+            negated, ci = e.negated, e.case_insensitive
+
+            def impl(cols, batch, _n=negated, _ci=ci):
+                return fnlib.like_impl(cols, batch.num_rows, _n, _ci)
+            return BoundFunc("like", args, dt.BOOL, impl)
+        if isinstance(e, ast.FuncCall):
+            return self._bind_func(e)
+        if isinstance(e, ast.Cast):
+            return self._bind_cast(e)
+        if isinstance(e, ast.Case):
+            return self._bind_case(e)
+        if isinstance(e, ast.Subquery):
+            raise errors.unsupported("scalar subqueries not supported yet")
+        if isinstance(e, ast.Star):
+            raise errors.syntax("* not allowed here")
+        raise errors.unsupported(f"expression {type(e).__name__}")
+
+    def _bind_binary(self, e: ast.BinaryOp) -> BoundExpr:
+        if e.op in ("##", "@@", "<->", "<#>", "<=>"):
+            # full-text / vector operators — bound by the search layer
+            from ..search import sqlfuncs
+            return sqlfuncs.bind_operator(self, e)
+        left = self.bind(e.left)
+        right = self.bind(e.right)
+        return self._call(f"op{e.op}", [left, right])
+
+    def _bind_in(self, e: ast.InList) -> BoundExpr:
+        operand = self.bind(e.operand)
+        items = [self.bind(x) for x in e.items]
+        # x IN (a,b,c) == (x=a OR x=b OR x=c) with PG null semantics
+        cmps = [self._call("op=", [operand, it]) for it in items]
+        if len(cmps) == 1:
+            result = cmps[0]
+        else:
+            result = BoundFunc("or", cmps, dt.BOOL,
+                               lambda cols, b: kleene_or(cols))
+        if e.negated:
+            result = self._call("opnot", [result])
+        return result
+
+    def _bind_func(self, e: ast.FuncCall) -> BoundExpr:
+        name = e.name
+        if name in AGG_FUNCS or (name == "count" and e.star):
+            if not self.allow_aggs:
+                raise errors.SqlError("42803",
+                                      f"aggregate function {name} not allowed here")
+            return self._bind_agg(e)
+        from ..search import sqlfuncs
+        if sqlfuncs.is_search_function(name):
+            return sqlfuncs.bind_function(self, e)
+        args = [self.bind(a) for a in e.args]
+        return self._call(name, args)
+
+    def _bind_agg(self, e: ast.FuncCall) -> BoundExpr:
+        name = e.name
+        if e.star or (name == "count" and not e.args):
+            spec = AggSpec("count_star", None, False, dt.BIGINT)
+        else:
+            if len(e.args) != 1:
+                raise errors.unsupported(f"{name} with {len(e.args)} args")
+            arg = self.bind(e.args[0])
+            out_t = _agg_result_type(name, arg.type)
+            spec = AggSpec(name, arg, e.distinct, out_t)
+        key = repr((spec.func, _expr_key(spec.arg), spec.distinct))
+        if key in self._agg_keys:
+            idx = self._agg_keys[key]
+            return BoundAggRef(idx, self.aggs[idx].type)
+        self.aggs.append(spec)
+        idx = len(self.aggs) - 1
+        self._agg_keys[key] = idx
+        return BoundAggRef(idx, spec.type)
+
+    def _bind_cast(self, e: ast.Cast) -> BoundExpr:
+        arg = self.bind(e.operand)
+        target = dt.type_from_name(e.type_name)
+
+        def impl(cols, batch, _t=target):
+            return cast_column(cols[0], _t)
+        return BoundFunc("cast", [arg], target, impl)
+
+    def _bind_case(self, e: ast.Case) -> BoundExpr:
+        if e.operand is not None:
+            branches = [(ast.BinaryOp("=", e.operand, cond), val)
+                        for cond, val in e.branches]
+        else:
+            branches = e.branches
+        bound = [(self.bind(c), self.bind(v)) for c, v in branches]
+        else_b = self.bind(e.else_) if e.else_ is not None else None
+        t = dt.NULLTYPE
+        for _, v in bound:
+            if v.type.id is not dt.TypeId.NULL:
+                t = v.type if t.id is dt.TypeId.NULL else (
+                    dt.common_numeric(t, v.type) if t.is_numeric and v.type.is_numeric
+                    else t)
+        if t.id is dt.TypeId.NULL and else_b is not None:
+            t = else_b.type
+        return BoundCase(bound, else_b, t)
+
+    def _call(self, name: str, args: list[BoundExpr]) -> BoundExpr:
+        if name == "opnot":
+            def impl(cols, batch):
+                c = cols[0]
+                return Column(dt.BOOL, ~c.data.astype(bool), c.validity)
+            return BoundFunc("not", args, dt.BOOL, impl)
+        res = fnlib.resolve(name, [a.type for a in args])
+
+        def impl2(cols, batch, _impl=res.impl):
+            return _impl(cols, batch.num_rows)
+        f = BoundFunc(name, args, res.result_type, impl2)
+        return _fold_if_const(f)
+
+
+def _fold_if_const(f: BoundFunc) -> BoundExpr:
+    if all(isinstance(a, BoundLiteral) for a in f.args):
+        from ..columnar.column import Batch
+        try:
+            col = f.eval(Batch(["__one"], [Column.from_pylist([0])]))
+            return BoundLiteral(col.decode(0), f.type)
+        except errors.SqlError:
+            raise
+        except Exception:
+            return f
+    return f
+
+
+def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
+    if name == "count":
+        return dt.BIGINT
+    if name in ("sum",):
+        if arg_t.is_integer:
+            return dt.BIGINT
+        return dt.DOUBLE if arg_t.id is not dt.TypeId.NULL else dt.DOUBLE
+    if name in ("avg", "stddev", "stddev_samp", "var_samp", "variance"):
+        return dt.DOUBLE
+    if name in ("min", "max"):
+        return arg_t
+    if name in ("bool_and", "bool_or"):
+        return dt.BOOL
+    if name in ("string_agg",):
+        return dt.VARCHAR
+    raise errors.unsupported(f"aggregate {name}")
+
+
+def _expr_key(e: Optional[BoundExpr]) -> str:
+    if e is None:
+        return "<star>"
+    parts = []
+    for node in e.walk():
+        if isinstance(node, BoundColumn):
+            parts.append(f"col{node.index}")
+        elif isinstance(node, BoundLiteral):
+            parts.append(f"lit{node.value!r}")
+        elif isinstance(node, BoundFunc):
+            parts.append(f"fn{node.name}")
+        else:
+            parts.append(type(node).__name__)
+    return "/".join(parts)
+
+
+def cast_column(col: Column, target: dt.SqlType) -> Column:
+    """PG-style CAST between supported types."""
+    src = col.type
+    if src == target:
+        return col
+    validity = col.validity
+    if target.is_string:
+        vals = col.to_pylist()
+        out = ["" if v is None else _cast_to_text(v, src) for v in vals]
+        from .expr import make_string_column
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  validity)
+    if src.is_string:
+        vals = col.to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            else:
+                out.append(_cast_text_to(v, target))
+        return Column.from_pylist(out, target)
+    if target.id is dt.TypeId.BOOL:
+        return Column(target, col.data.astype(bool), validity)
+    if target.is_integer:
+        if src.is_float:
+            # PG rounds half away from zero (np.round is half-to-even)
+            x = col.data
+            data = (np.sign(x) * np.floor(np.abs(x) + 0.5)).astype(target.np_dtype)
+        else:
+            data = col.data.astype(target.np_dtype)
+        return Column(target, data, validity)
+    if target.is_float:
+        return Column(target, col.data.astype(target.np_dtype), validity)
+    if target.id in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE):
+        return Column(target, col.data.astype(target.np_dtype), validity)
+    raise errors.unsupported(f"cast {src} -> {target}")
+
+
+def _cast_to_text(v, src: dt.SqlType) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return f"{v:.1f}" if "." not in repr(v) else repr(v)
+        return repr(v)
+    return str(v)
+
+
+def _cast_text_to(v: str, target: dt.SqlType):
+    s = v.strip()
+    try:
+        if target.id is dt.TypeId.BOOL:
+            if s.lower() in ("t", "true", "yes", "on", "1"):
+                return True
+            if s.lower() in ("f", "false", "no", "off", "0"):
+                return False
+            raise ValueError(s)
+        if target.is_integer:
+            return int(float(s)) if ("." in s or "e" in s.lower()) else int(s)
+        if target.is_float:
+            return float(s)
+        if target.id is dt.TypeId.TIMESTAMP:
+            return int(np.datetime64(s).astype("datetime64[us]").astype(np.int64))
+        if target.id is dt.TypeId.DATE:
+            return int(np.datetime64(s, "D").astype(np.int64))
+    except ValueError:
+        raise errors.SqlError(errors.INVALID_TEXT_REPRESENTATION,
+                              f'invalid input syntax for type {target}: "{v}"')
+    raise errors.unsupported(f"cast text -> {target}")

@@ -1,0 +1,835 @@
+"""Recursive-descent SQL parser.
+
+Covers the statement surface the engine executes (SELECT with joins/group/
+order/limit, DDL for tables/indexes/schemas/views, INSERT/UPDATE/DELETE,
+SET/SHOW, COPY, EXPLAIN, VACUUM, transactions) plus the SereneDB full-text
+operators: `col ## 'phrase'` (phrase match) and `col @@ 'query'` (ts query),
+mirroring the reference's SQL search surface
+(reference: server/connector/functions/ts_*.cpp, examples/demo0/README.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import errors
+from ..errors import SqlError
+from . import ast
+from .lexer import T, Token, tokenize
+
+_KEYWORDS_STOP_ALIAS = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "EXCEPT", "INTERSECT", "ON", "USING", "JOIN", "INNER", "LEFT", "RIGHT",
+    "FULL", "CROSS", "AS", "AND", "OR", "NOT", "SET", "WITH", "ASC", "DESC",
+    "NULLS", "INTO", "VALUES", "RETURNING", "THEN", "ELSE", "END", "WHEN",
+    "CASE", "IS", "IN", "BETWEEN", "LIKE", "ILIKE", "BY",
+}
+
+_COMPARE_OPS = {"=", "<>", "!=", "<", "<=", ">", ">=", "##", "@@",
+                "<->", "<#>", "<=>"}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind is not T.EOF:
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind is T.IDENT and t.value.upper() in words
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            raise errors.syntax(
+                f"expected {word} near {self.peek().value!r}")
+
+    def at_op(self, op: str) -> bool:
+        t = self.peek()
+        return t.kind is T.OP and t.value == op
+
+    def accept_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise errors.syntax(f"expected {op!r} near {self.peek().value!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind is not T.IDENT:
+            raise errors.syntax(f"expected identifier near {t.value!r}")
+        self.next()
+        return t.value
+
+    # -- entry points ------------------------------------------------------
+
+    def parse_statements(self) -> list[ast.Statement]:
+        stmts = []
+        while self.peek().kind is not T.EOF:
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.parse_statement())
+            if self.peek().kind is not T.EOF:
+                self.expect_op(";")
+        return stmts
+
+    def parse_statement(self) -> ast.Statement:
+        if self.at_kw("SELECT", "WITH"):
+            return self.parse_select()
+        if self.at_kw("CREATE"):
+            return self.parse_create()
+        if self.at_kw("DROP"):
+            return self.parse_drop()
+        if self.at_kw("INSERT"):
+            return self.parse_insert()
+        if self.at_kw("DELETE"):
+            return self.parse_delete()
+        if self.at_kw("UPDATE"):
+            return self.parse_update()
+        if self.at_kw("SET"):
+            return self.parse_set()
+        if self.at_kw("RESET"):
+            self.next()
+            name = self.ident()
+            return ast.SetStmt(name.lower(), "DEFAULT")
+        if self.at_kw("SHOW"):
+            self.next()
+            parts = [self.ident()]
+            while self.accept_op("."):
+                parts.append(self.ident())
+            return ast.ShowStmt(".".join(parts).lower())
+        if self.at_kw("BEGIN", "START"):
+            self.next()
+            self.accept_kw("TRANSACTION") or self.accept_kw("WORK")
+            return ast.Transaction("begin")
+        if self.at_kw("COMMIT", "END"):
+            self.next()
+            self.accept_kw("TRANSACTION") or self.accept_kw("WORK")
+            return ast.Transaction("commit")
+        if self.at_kw("ROLLBACK", "ABORT"):
+            self.next()
+            self.accept_kw("TRANSACTION") or self.accept_kw("WORK")
+            return ast.Transaction("rollback")
+        if self.at_kw("EXPLAIN"):
+            self.next()
+            analyze = self.accept_kw("ANALYZE")
+            return ast.Explain(self.parse_statement(), analyze)
+        if self.at_kw("COPY"):
+            return self.parse_copy()
+        if self.at_kw("VACUUM"):
+            return self.parse_vacuum()
+        if self.at_kw("TRUNCATE"):
+            self.next()
+            self.accept_kw("TABLE")
+            return ast.Truncate(self.qualified_name())
+        if self.at_kw("VALUES"):
+            return self.parse_select()
+        raise errors.syntax(f"unsupported statement near {self.peek().value!r}")
+
+    # -- SELECT ------------------------------------------------------------
+
+    def parse_select(self) -> ast.Select:
+        if self.at_kw("WITH"):
+            raise errors.unsupported("WITH (CTEs) not supported yet")
+        if self.at_kw("VALUES"):
+            return self._parse_values_select()
+        self.expect_kw("SELECT")
+        distinct = False
+        if self.accept_kw("DISTINCT"):
+            distinct = True
+        else:
+            self.accept_kw("ALL")
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        from_ = None
+        if self.accept_kw("FROM"):
+            from_ = self.parse_from()
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        group_by: list[ast.Expr] = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept_kw("HAVING") else None
+        order_by: list[ast.OrderItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = offset = None
+        while self.at_kw("LIMIT", "OFFSET"):
+            if self.accept_kw("LIMIT"):
+                if not self.accept_kw("ALL"):
+                    limit = self.parse_expr()
+            elif self.accept_kw("OFFSET"):
+                offset = self.parse_expr()
+                self.accept_kw("ROWS") or self.accept_kw("ROW")
+        if self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+            raise errors.unsupported("set operations not supported yet")
+        return ast.Select(items, from_, where, group_by, having, order_by,
+                          limit, offset, distinct)
+
+    def _parse_values_select(self) -> ast.Select:
+        self.expect_kw("VALUES")
+        rows = [self._parse_paren_exprs()]
+        while self.accept_op(","):
+            rows.append(self._parse_paren_exprs())
+        width = len(rows[0])
+        if any(len(r) != width for r in rows):
+            raise errors.syntax("VALUES lists must all be the same length")
+        items = [ast.SelectItem(ast.ColumnRef([f"col{k}"])) for k in range(width)]
+        sel = ast.Select(items)
+        sel.values_rows = rows  # type: ignore[attr-defined]
+        return sel
+
+    def _parse_paren_exprs(self) -> list[ast.Expr]:
+        self.expect_op("(")
+        exprs = [self.parse_expr()]
+        while self.accept_op(","):
+            exprs.append(self.parse_expr())
+        self.expect_op(")")
+        return exprs
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        start = self.i
+        expr = self.parse_expr()
+        # tbl.* comes back as ColumnRef with trailing '*' handled in primary
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind is T.IDENT and \
+                self.peek().value.upper() not in _KEYWORDS_STOP_ALIAS:
+            alias = self.ident()
+        del start
+        return ast.SelectItem(expr, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("DESC"):
+            desc = True
+        else:
+            self.accept_kw("ASC")
+        nulls_first = None
+        if self.accept_kw("NULLS"):
+            if self.accept_kw("FIRST"):
+                nulls_first = True
+            else:
+                self.expect_kw("LAST")
+                nulls_first = False
+        return ast.OrderItem(e, desc, nulls_first)
+
+    def parse_from(self) -> ast.TableRef:
+        ref = self.parse_table_ref()
+        while True:
+            if self.accept_op(","):
+                right = self.parse_table_ref()
+                ref = ast.JoinRef("cross", ref, right)
+                continue
+            kind = None
+            if self.accept_kw("CROSS"):
+                self.expect_kw("JOIN")
+                ref = ast.JoinRef("cross", ref, self.parse_table_ref())
+                continue
+            if self.accept_kw("INNER"):
+                kind = "inner"
+                self.expect_kw("JOIN")
+            elif self.accept_kw("LEFT"):
+                kind = "left"
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+            elif self.accept_kw("RIGHT"):
+                kind = "right"
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+            elif self.accept_kw("FULL"):
+                raise errors.unsupported("FULL JOIN not supported yet")
+            elif self.accept_kw("JOIN"):
+                kind = "inner"
+            else:
+                break
+            right = self.parse_table_ref()
+            if self.accept_kw("ON"):
+                cond = self.parse_expr()
+                ref = ast.JoinRef(kind, ref, right, condition=cond)
+            elif self.accept_kw("USING"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                ref = ast.JoinRef(kind, ref, right, using=cols)
+            else:
+                raise errors.syntax("JOIN requires ON or USING")
+        return ref
+
+    def parse_table_ref(self) -> ast.TableRef:
+        if self.accept_op("("):
+            inner = self.parse_select()
+            self.expect_op(")")
+            alias = self._table_alias()
+            return ast.SubqueryRef(inner, alias)
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        if self.at_op("("):
+            self.next()
+            args = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            alias = self._table_alias()
+            return ast.TableFunction(".".join(parts).lower(), args, alias)
+        alias = self._table_alias()
+        return ast.NamedTable(parts, alias)
+
+    def _table_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self.ident()
+        if self.peek().kind is T.IDENT and \
+                self.peek().value.upper() not in _KEYWORDS_STOP_ALIAS:
+            return self.ident()
+        return None
+
+    # -- expressions (precedence climbing) ---------------------------------
+
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Expr:
+        left = self.parse_and()
+        if not self.at_kw("OR"):
+            return left
+        args = [left]
+        while self.accept_kw("OR"):
+            args.append(self.parse_and())
+        return ast.Logical("OR", args)
+
+    def parse_and(self) -> ast.Expr:
+        left = self.parse_not()
+        if not self.at_kw("AND"):
+            return left
+        args = [left]
+        while self.accept_kw("AND"):
+            args.append(self.parse_not())
+        return ast.Logical("AND", args)
+
+    def parse_not(self) -> ast.Expr:
+        if self.accept_kw("NOT"):
+            return ast.UnaryOp("NOT", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ast.Expr:
+        left = self.parse_additive_chain()
+        while True:
+            if self.accept_kw("IS"):
+                negated = bool(self.accept_kw("NOT"))
+                if self.accept_kw("NULL"):
+                    left = ast.IsNull(left, negated)
+                elif self.accept_kw("TRUE"):
+                    cmp = ast.BinaryOp("=", left, ast.Literal(True))
+                    left = ast.UnaryOp("NOT", cmp) if negated else cmp
+                elif self.accept_kw("FALSE"):
+                    cmp = ast.BinaryOp("=", left, ast.Literal(False))
+                    left = ast.UnaryOp("NOT", cmp) if negated else cmp
+                elif self.accept_kw("DISTINCT"):
+                    self.expect_kw("FROM")
+                    right = self.parse_additive_chain()
+                    left = ast.FuncCall(
+                        "is_not_distinct_from" if negated else "is_distinct_from",
+                        [left, right])
+                else:
+                    raise errors.syntax("expected NULL after IS")
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negated = True
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT"):
+                    raise errors.unsupported("IN (subquery) not supported yet")
+                items = [self.parse_expr()]
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op(")")
+                left = ast.InList(left, items, negated)
+                continue
+            if self.accept_kw("BETWEEN"):
+                low = self.parse_additive_chain()
+                self.expect_kw("AND")
+                high = self.parse_additive_chain()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("LIKE"):
+                left = ast.Like(left, self.parse_additive_chain(), negated, False)
+                continue
+            if self.accept_kw("ILIKE"):
+                left = ast.Like(left, self.parse_additive_chain(), negated, True)
+                continue
+            if negated:
+                self.i = save
+                break
+            t = self.peek()
+            if t.kind is T.OP and t.value in _COMPARE_OPS:
+                self.next()
+                right = self.parse_additive_chain()
+                left = ast.BinaryOp(t.value, left, right)
+                continue
+            if t.kind is T.OP and t.value in ("~", "~*", "!~", "!~*"):
+                self.next()
+                right = self.parse_additive_chain()
+                fn = {"~": "regexp_match_op", "~*": "regexp_imatch_op",
+                      "!~": "regexp_not_match_op", "!~*": "regexp_not_imatch_op"}[t.value]
+                left = ast.FuncCall(fn, [left, right])
+                continue
+            break
+        return left
+
+    def parse_additive_chain(self) -> ast.Expr:
+        left = self.parse_multiplicative()
+        while True:
+            if self.at_op("+") or self.at_op("-") or self.at_op("||"):
+                op = self.next().value
+                left = ast.BinaryOp(op, left, self.parse_multiplicative())
+            else:
+                return left
+
+    def parse_multiplicative(self) -> ast.Expr:
+        left = self.parse_unary()
+        while True:
+            if self.at_op("*") or self.at_op("/") or self.at_op("%"):
+                op = self.next().value
+                left = ast.BinaryOp(op, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> ast.Expr:
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self.parse_unary())
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> ast.Expr:
+        e = self.parse_primary()
+        while True:
+            if self.accept_op("::"):
+                e = ast.Cast(e, self._type_name())
+            elif self.at_op("["):
+                raise errors.unsupported("array subscripts not supported yet")
+            else:
+                return e
+
+    def _type_name(self) -> str:
+        name = self.ident()
+        if name.upper() == "DOUBLE" and self.at_kw("PRECISION"):
+            self.next()
+            name = "DOUBLE"
+        if self.accept_op("("):  # VARCHAR(n), DECIMAL(p,s) — swallow params
+            while not self.at_op(")"):
+                self.next()
+            self.expect_op(")")
+        return name
+
+    def parse_primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind is T.NUMBER:
+            self.next()
+            text = t.value
+            if "." in text or "e" in text or "E" in text:
+                return ast.Literal(float(text))
+            v = int(text)
+            return ast.Literal(v)
+        if t.kind is T.STRING:
+            self.next()
+            return ast.Literal(t.value)
+        if t.kind is T.PARAM:
+            self.next()
+            return ast.Param(int(t.value))
+        if self.accept_op("("):
+            if self.at_kw("SELECT"):
+                inner = self.parse_select()
+                self.expect_op(")")
+                return ast.Subquery(inner)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind is not T.IDENT:
+            raise errors.syntax(f"unexpected token {t.value!r}")
+        upper = t.value.upper()
+        if upper == "NULL":
+            self.next()
+            return ast.Literal(None)
+        if upper == "TRUE":
+            self.next()
+            return ast.Literal(True)
+        if upper == "FALSE":
+            self.next()
+            return ast.Literal(False)
+        if upper == "CASE":
+            return self.parse_case()
+        if upper == "CAST":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("AS")
+            tn = self._type_name()
+            self.expect_op(")")
+            return ast.Cast(e, tn)
+        if upper == "EXTRACT":
+            self.next()
+            self.expect_op("(")
+            fld = self.ident()
+            self.expect_kw("FROM")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall("extract", [ast.Literal(fld.lower()), e])
+        if upper in ("INTERVAL",):
+            raise errors.unsupported("INTERVAL literals not supported yet")
+        if upper in ("DATE", "TIMESTAMP") and self.peek(1).kind is T.STRING:
+            self.next()
+            lit = self.next()
+            return ast.Cast(ast.Literal(lit.value), upper)
+        # identifier: column ref or function call
+        parts = [self.ident()]
+        while self.accept_op("."):
+            if self.at_op("*"):
+                self.next()
+                return ast.Star(table=parts[-1])
+            parts.append(self.ident())
+        if self.at_op("("):
+            self.next()
+            name = ".".join(parts).lower()
+            distinct = False
+            star = False
+            args: list[ast.Expr] = []
+            if self.at_op("*"):
+                self.next()
+                star = True
+            elif not self.at_op(")"):
+                if self.accept_kw("DISTINCT"):
+                    distinct = True
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall(name, args, distinct, star)
+        return ast.ColumnRef(parts)
+
+    def parse_case(self) -> ast.Expr:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        branches = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            branches.append((cond, self.parse_expr()))
+        else_ = self.parse_expr() if self.accept_kw("ELSE") else None
+        self.expect_kw("END")
+        return ast.Case(operand, branches, else_)
+
+    # -- DDL/DML -----------------------------------------------------------
+
+    def qualified_name(self) -> list[str]:
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        return parts
+
+    def parse_create(self) -> ast.Statement:
+        self.expect_kw("CREATE")
+        or_replace = False
+        if self.accept_kw("OR"):
+            self.expect_kw("REPLACE")
+            or_replace = True
+        if self.accept_kw("SCHEMA"):
+            ine = self._if_not_exists()
+            return ast.CreateSchema(self.ident(), ine)
+        if self.accept_kw("VIEW"):
+            name = self.qualified_name()
+            self.expect_kw("AS")
+            return ast.CreateView(name, self.parse_select(), or_replace)
+        if self.accept_kw("INDEX"):
+            ine = self._if_not_exists()
+            idx_name = None
+            if not self.at_kw("ON"):
+                idx_name = self.ident()
+            self.expect_kw("ON")
+            table = self.qualified_name()
+            using = "inverted"
+            if self.accept_kw("USING"):
+                using = self.ident().lower()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            opts = self._with_options()
+            return ast.CreateIndex(idx_name, table, cols, using, ine, opts)
+        if self.accept_kw("SEQUENCE"):
+            raise errors.unsupported("CREATE SEQUENCE not supported yet")
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        name = self.qualified_name()
+        if self.at_kw("AS") or (self.at_kw("USING", "WITH") and False):
+            pass
+        columns: list[ast.ColumnDef] = []
+        pk: list[str] = []
+        if self.accept_op("("):
+            while True:
+                if self.accept_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    self.expect_op("(")
+                    pk = [self.ident()]
+                    while self.accept_op(","):
+                        pk.append(self.ident())
+                    self.expect_op(")")
+                else:
+                    columns.append(self._column_def())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        engine = "columnar"
+        if self.accept_kw("USING"):
+            engine = self.ident().lower()
+        opts = self._with_options()
+        if "engine" in opts:
+            engine = str(opts.pop("engine")).lower()
+        as_query = None
+        if self.accept_kw("AS"):
+            as_query = self.parse_select()
+        pk = pk or [c.name for c in columns if c.primary_key]
+        return ast.CreateTable(name, columns, engine, ine, opts, as_query, pk)
+
+    def _column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        type_name = self._type_name()
+        d = ast.ColumnDef(name, type_name)
+        while True:
+            if self.accept_kw("NOT"):
+                self.expect_kw("NULL")
+                d.not_null = True
+            elif self.accept_kw("NULL"):
+                pass
+            elif self.accept_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                d.primary_key = True
+                d.not_null = True
+            elif self.accept_kw("DEFAULT"):
+                d.default = self.parse_expr()
+            elif self.accept_kw("TOKENIZER"):  # search-table column analyzer
+                d.tokenizer = self.next().value
+            else:
+                break
+        return d
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _with_options(self) -> dict:
+        opts: dict = {}
+        if self.accept_kw("WITH"):
+            self.expect_op("(")
+            while True:
+                key = self.ident().lower()
+                self.expect_op("=")
+                t = self.next()
+                if t.kind is T.NUMBER:
+                    opts[key] = float(t.value) if "." in t.value else int(t.value)
+                else:
+                    opts[key] = t.value
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return opts
+
+    def parse_drop(self) -> ast.Drop:
+        self.expect_kw("DROP")
+        if self.accept_kw("TABLE"):
+            kind = "table"
+        elif self.accept_kw("INDEX"):
+            kind = "index"
+        elif self.accept_kw("SCHEMA"):
+            kind = "schema"
+        elif self.accept_kw("VIEW"):
+            kind = "view"
+        else:
+            raise errors.unsupported("DROP of that object kind")
+        if_exists = False
+        if self.accept_kw("IF"):
+            self.expect_kw("EXISTS")
+            if_exists = True
+        name = self.qualified_name()
+        cascade = bool(self.accept_kw("CASCADE"))
+        self.accept_kw("RESTRICT")
+        return ast.Drop(kind, name, if_exists, cascade)
+
+    def parse_insert(self) -> ast.Insert:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        table = self.qualified_name()
+        columns = None
+        if self.accept_op("("):
+            columns = [self.ident()]
+            while self.accept_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.at_kw("VALUES"):
+            self.next()
+            rows = [self._parse_paren_exprs()]
+            while self.accept_op(","):
+                rows.append(self._parse_paren_exprs())
+            return ast.Insert(table, columns, rows)
+        if self.at_kw("SELECT"):
+            return ast.Insert(table, columns, None, self.parse_select())
+        raise errors.syntax("expected VALUES or SELECT in INSERT")
+
+    def parse_delete(self) -> ast.Delete:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.qualified_name()
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.Delete(table, where)
+
+    def parse_update(self) -> ast.Update:
+        self.expect_kw("UPDATE")
+        table = self.qualified_name()
+        self.expect_kw("SET")
+        assigns = []
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            assigns.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.Update(table, assigns, where)
+
+    def parse_set(self) -> ast.Statement:
+        self.expect_kw("SET")
+        self.accept_kw("SESSION") or self.accept_kw("LOCAL")
+        name = self.ident().lower()
+        if not (self.accept_op("=") or self.accept_kw("TO")):
+            raise errors.syntax("expected = or TO in SET")
+        t = self.peek()
+        if t.kind is T.IDENT and t.value.upper() == "DEFAULT":
+            self.next()
+            return ast.SetStmt(name, "DEFAULT")
+        if t.kind is T.STRING:
+            self.next()
+            return ast.SetStmt(name, t.value)
+        if t.kind is T.NUMBER:
+            self.next()
+            return ast.SetStmt(name, float(t.value) if "." in t.value else int(t.value))
+        if t.kind is T.IDENT:
+            self.next()
+            v = t.value
+            if v.upper() in ("ON", "TRUE"):
+                return ast.SetStmt(name, True)
+            if v.upper() in ("OFF", "FALSE"):
+                return ast.SetStmt(name, False)
+            return ast.SetStmt(name, v)
+        raise errors.syntax("bad SET value")
+
+    def parse_copy(self) -> ast.CopyStmt:
+        self.expect_kw("COPY")
+        table = self.qualified_name()
+        columns = None
+        if self.accept_op("("):
+            columns = [self.ident()]
+            while self.accept_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.accept_kw("FROM"):
+            direction = "from"
+        else:
+            self.expect_kw("TO")
+            direction = "to"
+        t = self.peek()
+        if t.kind is T.STRING:
+            target = self.next().value
+        elif self.accept_kw("STDIN"):
+            target = "STDIN"
+        elif self.accept_kw("STDOUT"):
+            target = "STDOUT"
+        else:
+            raise errors.syntax("expected filename, STDIN or STDOUT")
+        opts: dict = {}
+        if self.accept_op("("):
+            while True:
+                key = self.ident().lower()
+                if self.peek().kind in (T.IDENT, T.STRING, T.NUMBER):
+                    opts[key] = self.next().value
+                else:
+                    opts[key] = True
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        elif self.accept_kw("WITH"):
+            if self.accept_op("("):
+                while True:
+                    key = self.ident().lower()
+                    if self.peek().kind in (T.IDENT, T.STRING, T.NUMBER):
+                        opts[key] = self.next().value
+                    else:
+                        opts[key] = True
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+        return ast.CopyStmt(table, columns, direction, target, opts)
+
+    def parse_vacuum(self) -> ast.VacuumStmt:
+        self.expect_kw("VACUUM")
+        verbs = []
+        while self.at_kw("REFRESH", "COMPACT", "CLEANUP", "FULL", "ANALYZE"):
+            verbs.append(self.ident().lower())
+        table = None
+        if self.peek().kind is T.IDENT:
+            table = self.qualified_name()
+        return ast.VacuumStmt(table, verbs)
+
+
+def parse(sql: str) -> list[ast.Statement]:
+    return Parser(sql).parse_statements()
+
+
+def parse_one(sql: str) -> ast.Statement:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise errors.syntax("expected a single statement")
+    return stmts[0]

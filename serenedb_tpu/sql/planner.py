@@ -1,0 +1,438 @@
+"""Logical planning: bound SELECT → physical plan tree.
+
+Reference analog: DuckDB planner/optimizer plus SereneDB's optimizer
+extensions that claim WHERE conjuncts into the scan
+(IResearchPushdownComplexFilter, reference:
+server/connector/optimizer/iresearch_plan.cpp:1016-1058). Re-expressed here:
+filter conjuncts land in ScanNode.filter (device compilation fuses them into
+the scan program), projection pruning keeps the HBM working set minimal, and
+ORDER BY / GROUP BY resolve select aliases and positions per PG scoping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..exec.plan import (AggregateNode, DropColumnsNode, FilterNode, JoinNode,
+                         LimitNode, PlanNode, ProjectNode, ScanNode, SortNode,
+                         ValuesNode)
+from ..exec.tables import TableProvider
+from . import ast
+from .binder import AGG_FUNCS, ExprBinder, Scope, ScopeColumn
+from .expr import (BoundAggRef, BoundCase, BoundColumn, BoundExpr, BoundFunc,
+                   BoundLiteral, kleene_and)
+
+
+class TableResolver:
+    """Interface the planner uses to find tables/table functions."""
+
+    def resolve_table(self, parts: list[str]) -> TableProvider:
+        raise NotImplementedError
+
+    def resolve_table_function(self, name: str, args: list) -> TableProvider:
+        raise NotImplementedError
+
+
+@dataclass
+class _GroupRef(BoundExpr):
+    """Placeholder for a group-key column in post-aggregation expressions."""
+    slot: int
+    type: dt.SqlType
+
+
+class PostAggBinder(ExprBinder):
+    """Binds post-aggregation expressions (select items, HAVING, ORDER BY):
+    group-expression matches become _GroupRef, aggregate calls become
+    BoundAggRef (collected), any other bare column is a PG 42803 error."""
+
+    def __init__(self, scope: Scope, params, group_asts: list[ast.Expr],
+                 group_types: list[dt.SqlType]):
+        super().__init__(scope, params, allow_aggs=True)
+        self.group_asts = group_asts
+        self.group_types = group_types
+        self._in_agg = False
+
+    def bind(self, e: ast.Expr) -> BoundExpr:
+        if self._in_agg:
+            # inside an aggregate argument: plain base-scope binding
+            if isinstance(e, ast.FuncCall) and (e.name in AGG_FUNCS or e.star):
+                raise errors.SqlError(
+                    "42803", "aggregate function calls cannot be nested")
+            return super().bind(e)
+        for k, g in enumerate(self.group_asts):
+            if _ast_eq(e, g):
+                return _GroupRef(k, self.group_types[k])
+        if isinstance(e, ast.FuncCall) and (e.name in AGG_FUNCS or e.star):
+            self._in_agg = True
+            try:
+                return self._bind_agg(e)
+            finally:
+                self._in_agg = False
+        if isinstance(e, ast.ColumnRef):
+            raise errors.SqlError(
+                "42803",
+                f'column "{".".join(e.parts)}" must appear in the GROUP BY '
+                "clause or be used in an aggregate function")
+        return super().bind(e)
+
+
+def _resolve_post(e: BoundExpr, n_groups: int,
+                  out_types: list[dt.SqlType]) -> BoundExpr:
+    """Rewrite _GroupRef/BoundAggRef placeholders into BoundColumns over the
+    aggregate node's output (groups first, then aggs)."""
+    if isinstance(e, _GroupRef):
+        return BoundColumn(e.slot, e.type, f"#g{e.slot}")
+    if isinstance(e, BoundAggRef):
+        return BoundColumn(n_groups + e.index, e.type, f"#agg{e.index}")
+    if isinstance(e, BoundFunc):
+        e.args = [_resolve_post(a, n_groups, out_types) for a in e.args]
+        return e
+    if isinstance(e, BoundCase):
+        e.branches = [(_resolve_post(c, n_groups, out_types),
+                       _resolve_post(v, n_groups, out_types))
+                      for c, v in e.branches]
+        if e.else_ is not None:
+            e.else_ = _resolve_post(e.else_, n_groups, out_types)
+        return e
+    return e
+
+
+class Planner:
+    def __init__(self, resolver: TableResolver, params: Optional[list] = None):
+        self.resolver = resolver
+        self.params = params or []
+
+    # -- FROM --------------------------------------------------------------
+
+    def plan_select(self, sel: ast.Select) -> PlanNode:
+        values_rows = getattr(sel, "values_rows", None)
+        if values_rows is not None:
+            return self._plan_values(values_rows)
+        if sel.from_ is None:
+            plan: PlanNode = ValuesNode(
+                Batch(["__dummy"], [Column.from_pylist([0])]))
+            scope = Scope([])
+        else:
+            plan, scope = self._plan_from(sel.from_)
+        return self._plan_body(sel, plan, scope)
+
+    def _plan_values(self, rows: list[list[ast.Expr]]) -> PlanNode:
+        binder = ExprBinder(Scope([]), self.params)
+        cols = []
+        width = len(rows[0])
+        one = Batch(["__dummy"], [Column.from_pylist([0])])
+        for k in range(width):
+            exprs = [binder.bind(r[k]) for r in rows]
+            vals = [e.eval(one).decode(0) for e in exprs]
+            t = next((e.type for e in exprs if e.type.id is not dt.TypeId.NULL),
+                     dt.NULLTYPE)
+            cols.append(Column.from_pylist(vals, t))
+        return ValuesNode(Batch([f"col{k}" for k in range(width)], cols))
+
+    def _scan_scope(self, provider: TableProvider, alias: str):
+        scan = ScanNode(provider, list(provider.column_names), alias)
+        scope = Scope([ScopeColumn(alias, n, t, i)
+                       for i, (n, t) in enumerate(zip(scan.names, scan.types))])
+        return scan, scope
+
+    def _plan_from(self, ref: ast.TableRef) -> tuple[PlanNode, Scope]:
+        if isinstance(ref, ast.NamedTable):
+            provider = self.resolver.resolve_table(ref.parts)
+            return self._scan_scope(provider, ref.alias or ref.parts[-1])
+        if isinstance(ref, ast.TableFunction):
+            binder = ExprBinder(Scope([]), self.params)
+            args = []
+            for a in ref.args:
+                b = binder.bind(a)
+                if not isinstance(b, BoundLiteral):
+                    raise errors.unsupported(
+                        "table function arguments must be constants")
+                args.append(b.value)
+            provider = self.resolver.resolve_table_function(ref.name, args)
+            return self._scan_scope(provider,
+                                    ref.alias or ref.name.split(".")[-1])
+        if isinstance(ref, ast.SubqueryRef):
+            inner = self.plan_select(ref.query)
+            alias = ref.alias or "subquery"
+            scope = Scope([ScopeColumn(alias, n, t, i)
+                           for i, (n, t) in enumerate(
+                               zip(inner.names, inner.types))])
+            return inner, scope
+        if isinstance(ref, ast.JoinRef):
+            return self._plan_join(ref)
+        raise errors.unsupported(f"FROM {type(ref).__name__}")
+
+    def _plan_join(self, ref: ast.JoinRef) -> tuple[PlanNode, Scope]:
+        left, lscope = self._plan_from(ref.left)
+        right, rscope = self._plan_from(ref.right)
+        n_left = len(lscope.columns)
+        combined = Scope(
+            list(lscope.columns) +
+            [ScopeColumn(c.table, c.name, c.type, c.index + n_left)
+             for c in rscope.columns])
+        names = _dedup_names([c.name for c in combined.columns])
+        types = [c.type for c in combined.columns]
+        left_keys: list[BoundExpr] = []
+        right_keys: list[BoundExpr] = []
+        residual: Optional[BoundExpr] = None
+        if ref.using:
+            for col in ref.using:
+                lc = lscope.resolve([col])
+                rc = rscope.resolve([col])
+                left_keys.append(BoundColumn(lc.index, lc.type, lc.name))
+                right_keys.append(BoundColumn(rc.index, rc.type, rc.name))
+        elif ref.condition is not None:
+            residual_parts = []
+            for c in _split_conjuncts(ref.condition):
+                pair = self._try_equi_key(c, lscope, rscope)
+                if pair is not None:
+                    left_keys.append(pair[0])
+                    right_keys.append(pair[1])
+                else:
+                    residual_parts.append(c)
+            if residual_parts:
+                binder = ExprBinder(combined, self.params)
+                bound = [binder.bind(p) for p in residual_parts]
+                residual = bound[0] if len(bound) == 1 else BoundFunc(
+                    "and", bound, dt.BOOL, lambda cols, b: kleene_and(cols))
+        node = JoinNode(ref.kind, left, right, left_keys, right_keys,
+                        residual, names, types)
+        return node, combined
+
+    def _try_equi_key(self, e: ast.Expr, lscope: Scope, rscope: Scope):
+        if not (isinstance(e, ast.BinaryOp) and e.op == "="):
+            return None
+        for a, b in ((e.left, e.right), (e.right, e.left)):
+            try:
+                lb = ExprBinder(lscope, self.params).bind(a)
+                rb = ExprBinder(rscope, self.params).bind(b)
+                return (lb, rb)
+            except errors.SqlError:
+                continue
+        return None
+
+    # -- SELECT body -------------------------------------------------------
+
+    def _plan_body(self, sel: ast.Select, plan: PlanNode,
+                   scope: Scope) -> PlanNode:
+        if sel.where is not None:
+            binder = ExprBinder(scope, self.params)
+            pred = binder.bind(sel.where)
+            plan = self._push_filter(plan, pred)
+
+        # expand stars
+        items: list[ast.SelectItem] = []
+        for it in sel.items:
+            if isinstance(it.expr, ast.Star):
+                for c in scope.star_columns(it.expr.table):
+                    items.append(ast.SelectItem(
+                        ast.ColumnRef([c.table, c.name] if c.table else [c.name]),
+                        c.name))
+            else:
+                items.append(it)
+        out_names = _dedup_names(
+            [it.alias or _default_name(it.expr) for it in items])
+
+        has_aggs = bool(sel.group_by) or sel.having is not None or \
+            any(_contains_agg(it.expr) for it in items)
+
+        if has_aggs:
+            plan, exprs, bind_order = self._plan_aggregate(sel, items, plan,
+                                                           scope)
+        else:
+            binder = ExprBinder(scope, self.params)
+            exprs = [binder.bind(it.expr) for it in items]
+
+            def bind_order(e: ast.Expr) -> BoundExpr:
+                return ExprBinder(scope, self.params).bind(e)
+
+        # ORDER BY: positions, select aliases, then arbitrary expressions
+        sort_exprs: list[BoundExpr] = []
+        descs: list[bool] = []
+        nfs: list[Optional[bool]] = []
+        for oi in sel.order_by:
+            descs.append(oi.desc)
+            nfs.append(oi.nulls_first)
+            e = oi.expr
+            if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                pos = e.value
+                if not (1 <= pos <= len(exprs)):
+                    raise errors.SqlError(
+                        "42P10", f"ORDER BY position {pos} is out of range")
+                sort_exprs.append(exprs[pos - 1])
+                continue
+            if isinstance(e, ast.ColumnRef) and len(e.parts) == 1:
+                matches = [k for k, it in enumerate(items)
+                           if it.alias and it.alias.lower() == e.parts[0].lower()]
+                if matches:
+                    sort_exprs.append(exprs[matches[0]])
+                    continue
+            # expression over select items (e.g. ORDER BY the same expr text)
+            matched = None
+            for k, it in enumerate(items):
+                if _ast_eq(e, it.expr):
+                    matched = exprs[k]
+                    break
+            sort_exprs.append(matched if matched is not None else bind_order(e))
+
+        proj_exprs = list(exprs)
+        proj_names = list(out_names)
+        hidden = 0
+        sort_indices = []
+        for se in sort_exprs:
+            found = next((k for k, pe in enumerate(proj_exprs) if pe is se),
+                         None)
+            if found is None:
+                proj_exprs.append(se)
+                proj_names.append(f"#sort{hidden}")
+                found = len(proj_exprs) - 1
+                hidden += 1
+            sort_indices.append(found)
+
+        plan = ProjectNode(plan, proj_exprs, _dedup_names(proj_names))
+        if sel.distinct:
+            if hidden:
+                raise errors.unsupported(
+                    "SELECT DISTINCT with ORDER BY on non-selected expression")
+            plan = _distinct_node(plan, keep=len(out_names))
+        if sort_indices:
+            plan = SortNode(plan, sort_indices, descs, nfs)
+        if hidden:
+            plan = DropColumnsNode(plan, len(out_names))
+
+        if sel.limit is not None or sel.offset is not None:
+            limit = _const_int(sel.limit, self.params) \
+                if sel.limit is not None else None
+            offset = _const_int(sel.offset, self.params) \
+                if sel.offset is not None else 0
+            plan = LimitNode(plan, limit, offset)
+        return plan
+
+    def _push_filter(self, plan: PlanNode, pred: BoundExpr) -> PlanNode:
+        """Claim the predicate into the scan when the input is a bare scan
+        (the pushdown the reference does in its pre-optimizer pass)."""
+        if isinstance(plan, ScanNode) and plan.filter is None:
+            plan.filter = pred
+            return plan
+        return FilterNode(plan, pred)
+
+    def _plan_aggregate(self, sel: ast.Select, items: list[ast.SelectItem],
+                        plan: PlanNode, scope: Scope):
+        base = ExprBinder(scope, self.params, allow_aggs=True)
+        group_asts: list[ast.Expr] = []
+        group_bound: list[BoundExpr] = []
+        for g in sel.group_by:
+            if isinstance(g, ast.Literal) and isinstance(g.value, int):
+                pos = g.value
+                if not (1 <= pos <= len(items)):
+                    raise errors.SqlError("42P10",
+                                          f"GROUP BY position {pos} out of range")
+                g = items[pos - 1].expr
+            elif isinstance(g, ast.ColumnRef) and len(g.parts) == 1:
+                for it in items:
+                    if it.alias and it.alias.lower() == g.parts[0].lower():
+                        g = it.expr
+                        break
+            group_asts.append(g)
+            group_bound.append(base.bind(g))
+
+        post = PostAggBinder(scope, self.params, group_asts,
+                             [b.type for b in group_bound])
+        bound_items = [post.bind(it.expr) for it in items]
+        having_b = post.bind(sel.having) if sel.having is not None else None
+
+        ng = len(group_bound)
+        agg_names = [f"#g{k}" for k in range(ng)] + \
+                    [f"#agg{k}" for k in range(len(post.aggs))]
+        agg_node: PlanNode = AggregateNode(plan, group_bound, post.aggs,
+                                           agg_names)
+        out_types = agg_node.types
+        exprs = [_resolve_post(e, ng, out_types) for e in bound_items]
+        if having_b is not None:
+            agg_node = FilterNode(agg_node,
+                                  _resolve_post(having_b, ng, out_types))
+
+        def bind_order(e: ast.Expr) -> BoundExpr:
+            return _resolve_post(post.bind(e), ng, out_types)
+
+        return agg_node, exprs, bind_order
+
+
+def _ast_eq(a: ast.Expr, b: ast.Expr) -> bool:
+    return type(a) is type(b) and repr(a) == repr(b)
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name in AGG_FUNCS or e.star:
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    for attr in ("left", "right", "operand", "low", "high", "pattern"):
+        v = getattr(e, attr, None)
+        if isinstance(v, ast.Expr) and _contains_agg(v):
+            return True
+    if isinstance(e, ast.Logical):
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, ast.InList):
+        return _contains_agg(e.operand) or any(_contains_agg(i) for i in e.items)
+    if isinstance(e, ast.Case):
+        parts = [x for br in e.branches for x in br]
+        if e.operand:
+            parts.append(e.operand)
+        if e.else_:
+            parts.append(e.else_)
+        return any(_contains_agg(p) for p in parts)
+    if isinstance(e, ast.Cast):
+        return _contains_agg(e.operand)
+    return False
+
+
+def _default_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.ColumnRef):
+        return e.parts[-1]
+    if isinstance(e, ast.FuncCall):
+        return e.name
+    if isinstance(e, ast.Cast):
+        return _default_name(e.operand)
+    return "?column?"
+
+
+def _dedup_names(names: list[str]) -> list[str]:
+    seen: dict[str, int] = {}
+    out = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            out.append(f"{n}_{seen[n]}")
+        else:
+            seen[n] = 0
+            out.append(n)
+    return out
+
+
+def _split_conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.Logical) and e.op == "AND":
+        out = []
+        for a in e.args:
+            out.extend(_split_conjuncts(a))
+        return out
+    return [e]
+
+
+def _const_int(e: ast.Expr, params: list) -> int:
+    binder = ExprBinder(Scope([]), params)
+    b = binder.bind(e)
+    if not isinstance(b, BoundLiteral) or not isinstance(b.value, (int, float)):
+        raise errors.syntax("LIMIT/OFFSET must be a constant")
+    return int(b.value)
+
+
+def _distinct_node(plan: PlanNode, keep: int) -> PlanNode:
+    """DISTINCT = group by all output columns, no aggregates."""
+    exprs = [BoundColumn(i, t, n)
+             for i, (n, t) in enumerate(zip(plan.names, plan.types))]
+    return AggregateNode(plan, exprs[:keep], [], list(plan.names[:keep]))

@@ -1,0 +1,93 @@
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import Column, to_device_column
+from serenedb_tpu.ops import agg
+
+
+def dev(vals, validity=None):
+    c = Column.from_numpy(np.asarray(vals), validity=validity)
+    return to_device_column(c)
+
+
+def test_masked_count_and_sum_int():
+    dc = dev(np.arange(1000, dtype=np.int64))
+    assert int(agg.masked_count(dc.mask)) == 1000
+    assert agg.masked_sum_int(dc.data, dc.mask) == 499500
+
+
+def test_masked_sum_int_negative_and_large():
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-2**30, 2**30, size=5000, dtype=np.int64)
+    dc = dev(vals)
+    assert agg.masked_sum_int(dc.data, dc.mask) == int(vals.sum())
+
+
+def test_masked_sum_float_and_minmax():
+    vals = np.array([1.5, -2.0, 3.25, 100.0], dtype=np.float64)
+    dc = dev(vals)
+    assert float(agg.masked_sum_float(dc.data, dc.mask)) == pytest.approx(102.75)
+    assert float(agg.masked_minmax(dc.data, dc.mask, "min")) == -2.0
+    assert float(agg.masked_minmax(dc.data, dc.mask, "max")) == 100.0
+
+
+def test_nulls_excluded():
+    validity = np.array([True, False, True, True])
+    dc = dev(np.array([10, 99, 20, 30], dtype=np.int64), validity)
+    assert int(agg.masked_count(dc.mask)) == 3
+    assert agg.masked_sum_int(dc.data, dc.mask) == 60
+
+
+@pytest.mark.parametrize("num_groups", [3, 2000])  # onehot path and scatter path
+def test_group_count_paths(num_groups):
+    rng = np.random.default_rng(1)
+    codes_np = rng.integers(0, num_groups, size=4000).astype(np.int64)
+    dc = dev(codes_np)
+    counts = agg.group_count(dc.data, dc.mask, num_groups)
+    expected = np.bincount(codes_np, minlength=num_groups)
+    np.testing.assert_array_equal(counts, expected)
+
+
+def test_group_sum_int_exact_with_negatives():
+    rng = np.random.default_rng(2)
+    g = 17
+    codes_np = rng.integers(0, g, size=3000).astype(np.int64)
+    vals_np = rng.integers(-2**30, 2**30, size=3000, dtype=np.int64)
+    dcodes, dvals = dev(codes_np), dev(vals_np)
+    sums = agg.group_sum_int(dcodes.data, dcodes.mask, dvals.data, g)
+    expected = np.zeros(g, dtype=np.int64)
+    np.add.at(expected, codes_np, vals_np)
+    np.testing.assert_array_equal(sums, expected)
+
+
+def test_group_min_max_and_float_sum():
+    codes_np = np.array([0, 1, 0, 1, 2], dtype=np.int64)
+    vals_np = np.array([5.0, -1.0, 3.0, 7.0, 0.5])
+    dcodes, dvals = dev(codes_np), dev(vals_np)
+    mn = agg.group_min(dcodes.data, dcodes.mask, dvals.data, 3)
+    mx = agg.group_max(dcodes.data, dcodes.mask, dvals.data, 3)
+    s = np.asarray(agg.group_sum_float(dcodes.data, dcodes.mask, dvals.data, 3))
+    assert mn[:3].tolist() == [3.0, -1.0, 0.5]
+    assert mx[:3].tolist() == [5.0, 7.0, 0.5]
+    np.testing.assert_allclose(s[:3], [8.0, 6.0, 0.5])
+
+
+def test_factorize_composite_keys_with_nulls():
+    a = np.array([1, 1, 2, 1], dtype=np.int64)
+    b = np.array([7, 7, 7, 8], dtype=np.int64)
+    valid_b = np.array([True, True, True, False])
+    codes, uniq, uniq_valid = agg.factorize_keys([a, b], [None, valid_b])
+    # groups: (1,7), (1,7), (2,7), (1,NULL) → 3 groups
+    assert codes[0] == codes[1]
+    assert len(set(codes.tolist())) == 3
+    assert len(uniq[0]) == 3
+    # the NULL group's b-validity is False
+    null_group = codes[3]
+    assert not uniq_valid[1][null_group]
+
+
+def test_factorize_empty():
+    codes, uniq, uniq_valid = agg.factorize_keys(
+        [np.array([], dtype=np.int64)], [None])
+    assert len(codes) == 0
+    assert len(uniq[0]) == 0

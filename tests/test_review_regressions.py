@@ -1,0 +1,76 @@
+"""Regression tests for review findings (stale device cache, outer-join
+semantics, lexer hang, sort precision, PG rounding)."""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+
+
+def test_device_cache_invalidated_on_insert():
+    db = Database()
+    c = db.connect()
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("CREATE TABLE t (k INT, v INT)")
+    c.execute("INSERT INTO t VALUES (10,1),(11,2),(12,3)")
+    r1 = c.execute("SELECT k, sum(v) FROM t GROUP BY k ORDER BY k").rows()
+    assert r1 == [(10, 1), (11, 2), (12, 3)]
+    c.execute("INSERT INTO t VALUES (5, 100)")
+    r2 = c.execute("SELECT k, sum(v) FROM t GROUP BY k ORDER BY k").rows()
+    assert r2 == [(5, 100), (10, 1), (11, 2), (12, 3)]
+
+
+def test_left_join_on_extra_condition_stays_outer():
+    c = Database().connect()
+    c.execute("CREATE TABLE a (id INT, x INT)")
+    c.execute("CREATE TABLE b (id INT, y INT)")
+    c.execute("INSERT INTO a VALUES (1,10),(2,20)")
+    c.execute("INSERT INTO b VALUES (1,5)")
+    rows = c.execute("SELECT a.id, b.y FROM a LEFT JOIN b "
+                     "ON a.id = b.id AND b.y > 100 ORDER BY a.id").rows()
+    assert rows == [(1, None), (2, None)]
+
+
+def test_left_join_empty_right():
+    c = Database().connect()
+    c.execute("CREATE TABLE a (id INT)")
+    c.execute("CREATE TABLE b (id INT, y INT)")
+    c.execute("INSERT INTO a VALUES (1),(2)")
+    rows = c.execute("SELECT a.id, b.y FROM a LEFT JOIN b ON a.id = b.id "
+                     "ORDER BY a.id").rows()
+    assert rows == [(1, None), (2, None)]
+
+
+def test_right_join():
+    c = Database().connect()
+    c.execute("CREATE TABLE a (id INT, x TEXT)")
+    c.execute("CREATE TABLE b (id INT, y TEXT)")
+    c.execute("INSERT INTO a VALUES (1,'a')")
+    c.execute("INSERT INTO b VALUES (1,'A'),(2,'B')")
+    rows = c.execute("SELECT a.x, b.y FROM a RIGHT JOIN b ON a.id = b.id "
+                     "ORDER BY b.y").rows()
+    assert rows == [("a", "A"), (None, "B")]
+
+
+def test_unterminated_dollar_quote_errors_not_hangs():
+    c = Database().connect()
+    with pytest.raises(SqlError) as e:
+        c.execute("select $abc")
+    assert e.value.sqlstate == "42601"
+
+
+def test_order_by_bigint_beyond_2_53():
+    c = Database().connect()
+    c.execute("CREATE TABLE t (v BIGINT)")
+    c.execute("INSERT INTO t VALUES (9007199254740993), (9007199254740992)")
+    rows = c.execute("SELECT v FROM t ORDER BY v").rows()
+    assert rows == [(9007199254740992,), (9007199254740993,)]
+
+
+def test_cast_rounds_half_away_from_zero():
+    c = Database().connect()
+    assert c.execute("SELECT CAST(0.5 AS INT)").scalar() == 1
+    assert c.execute("SELECT CAST(1.5 AS INT)").scalar() == 2
+    assert c.execute("SELECT CAST(2.5 AS INT)").scalar() == 3
+    assert c.execute("SELECT CAST(-0.5 AS INT)").scalar() == -1

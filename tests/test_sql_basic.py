@@ -1,0 +1,266 @@
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+
+
+@pytest.fixture
+def conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT, b DOUBLE, s TEXT)")
+    c.execute("INSERT INTO t VALUES (1, 1.5, 'x'), (2, 2.5, 'y'), "
+              "(3, 3.5, 'x'), (NULL, NULL, NULL)")
+    return c
+
+
+def test_select_literal():
+    c = Database().connect()
+    assert c.execute("SELECT 1 + 2").scalar() == 3
+    assert c.execute("SELECT 'a' || 'b'").scalar() == "ab"
+    assert c.execute("SELECT NULL").scalar() is None
+
+
+def test_select_star(conn):
+    r = conn.execute("SELECT * FROM t")
+    assert r.names == ["a", "b", "s"]
+    assert len(r.rows()) == 4
+
+
+def test_where_filter(conn):
+    r = conn.execute("SELECT a FROM t WHERE a > 1")
+    assert sorted(x[0] for x in r.rows()) == [2, 3]
+
+
+def test_where_null_semantics(conn):
+    # NULL comparisons never match
+    r = conn.execute("SELECT a FROM t WHERE a <> 2")
+    assert sorted(x[0] for x in r.rows()) == [1, 3]
+    r = conn.execute("SELECT a FROM t WHERE a IS NULL")
+    assert [x[0] for x in r.rows()] == [None]
+
+
+def test_order_by_nulls(conn):
+    r = conn.execute("SELECT a FROM t ORDER BY a")
+    assert [x[0] for x in r.rows()] == [1, 2, 3, None]  # nulls last asc
+    r = conn.execute("SELECT a FROM t ORDER BY a DESC")
+    assert [x[0] for x in r.rows()] == [None, 3, 2, 1]  # nulls first desc
+    r = conn.execute("SELECT a FROM t ORDER BY a DESC NULLS LAST")
+    assert [x[0] for x in r.rows()] == [3, 2, 1, None]
+
+
+def test_limit_offset(conn):
+    r = conn.execute("SELECT a FROM t ORDER BY a LIMIT 2 OFFSET 1")
+    assert [x[0] for x in r.rows()] == [2, 3]
+
+
+def test_scalar_aggregates(conn):
+    r = conn.execute("SELECT count(*), count(a), sum(a), avg(b), min(s), "
+                     "max(s) FROM t")
+    row = r.rows()[0]
+    assert row[0] == 4
+    assert row[1] == 3
+    assert row[2] == 6
+    assert row[3] == pytest.approx(2.5)
+    assert row[4] == "x"
+    assert row[5] == "y"
+
+
+def test_empty_aggregate():
+    c = Database().connect()
+    c.execute("CREATE TABLE e (a INT)")
+    r = c.execute("SELECT count(*), sum(a), min(a) FROM e")
+    assert r.rows()[0] == (0, None, None)
+
+
+def test_group_by(conn):
+    r = conn.execute(
+        "SELECT s, count(*), sum(a) FROM t GROUP BY s ORDER BY s NULLS LAST")
+    assert r.rows() == [("x", 2, 4), ("y", 1, 2), (None, 1, None)]
+
+
+def test_group_by_alias_and_position(conn):
+    r = conn.execute("SELECT s AS k, count(*) FROM t GROUP BY k ORDER BY 1 "
+                     "NULLS LAST")
+    assert [x[0] for x in r.rows()] == ["x", "y", None]
+    r2 = conn.execute("SELECT s, count(*) FROM t GROUP BY 1 ORDER BY 1 NULLS LAST")
+    assert [x[0] for x in r2.rows()] == ["x", "y", None]
+
+
+def test_having(conn):
+    r = conn.execute("SELECT s, count(*) AS c FROM t GROUP BY s "
+                     "HAVING count(*) > 1")
+    assert r.rows() == [("x", 2)]
+
+
+def test_group_expr_in_select(conn):
+    r = conn.execute("SELECT a % 2, count(*) FROM t WHERE a IS NOT NULL "
+                     "GROUP BY a % 2 ORDER BY 1")
+    assert r.rows() == [(0, 1), (1, 2)]
+
+
+def test_ungrouped_column_rejected(conn):
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT a, count(*) FROM t GROUP BY s")
+    assert e.value.sqlstate == "42803"
+
+
+def test_distinct(conn):
+    r = conn.execute("SELECT DISTINCT s FROM t ORDER BY s NULLS LAST")
+    assert [x[0] for x in r.rows()] == ["x", "y", None]
+
+
+def test_count_distinct(conn):
+    assert conn.execute("SELECT count(DISTINCT s) FROM t").scalar() == 2
+
+
+def test_case(conn):
+    r = conn.execute("SELECT CASE WHEN a > 2 THEN 'big' WHEN a > 1 THEN 'mid' "
+                     "ELSE 'small' END FROM t WHERE a IS NOT NULL ORDER BY a")
+    assert [x[0] for x in r.rows()] == ["small", "mid", "big"]
+
+
+def test_in_between_like(conn):
+    assert conn.execute(
+        "SELECT count(*) FROM t WHERE a IN (1, 3)").scalar() == 2
+    assert conn.execute(
+        "SELECT count(*) FROM t WHERE a BETWEEN 2 AND 3").scalar() == 2
+    assert conn.execute(
+        "SELECT count(*) FROM t WHERE s LIKE 'x%'").scalar() == 2
+    assert conn.execute(
+        "SELECT count(*) FROM t WHERE s NOT LIKE 'x%'").scalar() == 1
+
+
+def test_string_functions():
+    c = Database().connect()
+    assert c.execute("SELECT upper('ab')").scalar() == "AB"
+    assert c.execute("SELECT length('hello')").scalar() == 5
+    assert c.execute("SELECT substr('hello', 2, 3)").scalar() == "ell"
+    assert c.execute("SELECT replace('aaa', 'a', 'b')").scalar() == "bbb"
+    assert c.execute("SELECT split_part('a,b,c', ',', 2)").scalar() == "b"
+    assert c.execute("SELECT coalesce(NULL, 'x')").scalar() == "x"
+
+
+def test_math_and_division():
+    c = Database().connect()
+    assert c.execute("SELECT 7 / 2").scalar() == 3       # PG int division
+    assert c.execute("SELECT -7 / 2").scalar() == -3     # trunc toward zero
+    assert c.execute("SELECT 7.0 / 2").scalar() == 3.5
+    assert c.execute("SELECT 7 % 3").scalar() == 1
+    assert c.execute("SELECT abs(-5)").scalar() == 5
+    with pytest.raises(SqlError) as e:
+        c.execute("SELECT 1 / 0")
+    assert e.value.sqlstate == "22012"
+
+
+def test_cast():
+    c = Database().connect()
+    assert c.execute("SELECT '42'::INT").scalar() == 42
+    assert c.execute("SELECT CAST(1.7 AS INT)").scalar() == 2
+    assert c.execute("SELECT 1::BOOLEAN").scalar() is True
+    with pytest.raises(SqlError) as e:
+        c.execute("SELECT 'xyz'::INT")
+    assert e.value.sqlstate == "22P02"
+
+
+def test_update_delete(conn):
+    conn.execute("UPDATE t SET b = 0.0 WHERE a = 2")
+    assert conn.execute("SELECT b FROM t WHERE a = 2").scalar() == 0.0
+    conn.execute("DELETE FROM t WHERE a = 1")
+    assert conn.execute("SELECT count(*) FROM t").scalar() == 3
+
+
+def test_join():
+    c = Database().connect()
+    c.execute("CREATE TABLE l (id INT, v TEXT)")
+    c.execute("CREATE TABLE r (id INT, w TEXT)")
+    c.execute("INSERT INTO l VALUES (1,'a'), (2,'b'), (3,'c')")
+    c.execute("INSERT INTO r VALUES (2,'B'), (3,'C'), (4,'D')")
+    rows = c.execute("SELECT l.v, r.w FROM l JOIN r ON l.id = r.id "
+                     "ORDER BY l.id").rows()
+    assert rows == [("b", "B"), ("c", "C")]
+    rows = c.execute("SELECT l.v, r.w FROM l LEFT JOIN r ON l.id = r.id "
+                     "ORDER BY l.id").rows()
+    assert rows == [("a", None), ("b", "B"), ("c", "C")]
+
+
+def test_subquery_from(conn):
+    r = conn.execute("SELECT s, c FROM (SELECT s, count(*) AS c FROM t "
+                     "GROUP BY s) sub WHERE c > 1")
+    assert r.rows() == [("x", 2)]
+
+
+def test_views(conn):
+    conn.execute("CREATE VIEW v AS SELECT a FROM t WHERE a > 1")
+    assert conn.execute("SELECT count(*) FROM v").scalar() == 2
+    conn.execute("DROP VIEW v")
+    with pytest.raises(SqlError):
+        conn.execute("SELECT * FROM v")
+
+
+def test_create_table_as(conn):
+    conn.execute("CREATE TABLE t2 AS SELECT a, b FROM t WHERE a IS NOT NULL")
+    assert conn.execute("SELECT count(*) FROM t2").scalar() == 3
+
+
+def test_set_show(conn):
+    conn.execute("SET sdb_nprobe = 32")
+    assert conn.execute("SHOW sdb_nprobe").rows()[0][0] == "32"
+    conn.execute("RESET sdb_nprobe")
+    assert conn.execute("SHOW sdb_nprobe").rows()[0][0] == "8"
+
+
+def test_error_codes(conn):
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT * FROM no_such_table")
+    assert e.value.sqlstate == "42P01"
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT no_such_col FROM t")
+    assert e.value.sqlstate == "42703"
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT no_such_fn(a) FROM t")
+    assert e.value.sqlstate == "42883"
+
+
+def test_explain(conn):
+    r = conn.execute("EXPLAIN SELECT s, count(*) FROM t WHERE a > 1 GROUP BY s")
+    text = "\n".join(x[0] for x in r.rows())
+    assert "Aggregate" in text and "Scan" in text
+
+
+def test_system_tables(conn):
+    r = conn.execute("SELECT tablename FROM pg_tables")
+    assert ("t",) in r.rows()
+    r = conn.execute("SELECT count(*) FROM sdb_settings")
+    assert r.scalar() > 5
+
+
+def test_multi_statement(conn):
+    rs = conn.execute_all("SELECT 1; SELECT 2;")
+    assert [r.scalar() for r in rs] == [1, 2]
+
+
+def test_values_clause():
+    c = Database().connect()
+    r = c.execute("VALUES (1, 'a'), (2, 'b')")
+    assert r.rows() == [(1, "a"), (2, "b")]
+
+
+def test_full_text_operators(conn):
+    c = Database().connect()
+    c.execute("CREATE TABLE docs (body TEXT)")
+    c.execute("INSERT INTO docs VALUES ('The quick brown fox'), "
+              "('a lazy dog sleeps'), ('quick dogs run')")
+    assert c.execute(
+        "SELECT count(*) FROM docs WHERE body ## 'quick'").scalar() == 2
+    # phrase: consecutive terms
+    assert c.execute(
+        "SELECT count(*) FROM docs WHERE body ## 'brown fox'").scalar() == 1
+    assert c.execute(
+        "SELECT count(*) FROM docs WHERE body ## 'quick fox'").scalar() == 0
+    # boolean query
+    assert c.execute(
+        "SELECT count(*) FROM docs WHERE body @@ 'quick & dog'").scalar() == 1
+    assert c.execute(
+        "SELECT count(*) FROM docs WHERE body @@ 'fox | dog'").scalar() == 3
