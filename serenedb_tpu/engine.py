@@ -309,10 +309,11 @@ class Connection:
     # -- SELECT ------------------------------------------------------------
 
     def _plan(self, sel: ast.Select, params: list) -> PlanNode:
+        from .sql.search_rewrite import rewrite_search
         planner = Planner(_ResolverShim(self.db, params), params)
         while True:
             try:
-                return planner.plan_select(sel)
+                return rewrite_search(planner.plan_select(sel))
             except _ViewRef as vr:
                 sel = _inline_view(sel, vr.view)
 

@@ -29,6 +29,13 @@ class ExecContext:
     params: list = field(default_factory=list)
 
 
+def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
+    cols = [Column(t, np.empty(0, dtype=t.np_dtype), None,
+                   np.empty(0, dtype=object) if t.is_string else None)
+            for t in types]
+    return Batch(list(names), cols)
+
+
 class PlanNode:
     names: list[str]
     types: list[dt.SqlType]
@@ -37,7 +44,10 @@ class PlanNode:
         raise NotImplementedError
 
     def execute(self, ctx: ExecContext) -> Batch:
-        return concat_batches(list(self.batches(ctx)))
+        bs = list(self.batches(ctx))
+        if not bs:
+            return empty_batch(self.names, self.types)
+        return concat_batches(bs)
 
     def children(self) -> list["PlanNode"]:
         return []

@@ -1,0 +1,98 @@
+"""Index-driven scan: the TPU analog of the reference's IResearch scan modes.
+
+Reference analog: IResearchScanInitGlobal / DecideScanMode — Stream (filter
+→ doc ids → materialize) and TopK (scored collectors)
+(reference: server/connector/duckdb_search_full_scan.hpp:54-76).
+
+Two modes:
+- filter: evaluate the ts-predicate on the index (CPU doc-set algebra with
+  device disjunction bitmaps), materialize matching rows, apply residual
+  predicates.
+- topk: BM25 block scoring + top-k on device (ops/bm25.py); emits rows in
+  score order plus a `#score` float column the planner wires into bm25()
+  calls and ORDER BY.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..search.query import QNode
+from ..sql.expr import BoundExpr
+from .plan import PlanNode
+from .tables import TableProvider
+
+SCORE_COL = "#score"
+
+
+class SearchScanNode(PlanNode):
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, search_column: str, qnode: QNode,
+                 residual: Optional[BoundExpr], topk: Optional[int],
+                 with_score: bool):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.search_column = search_column
+        self.qnode = qnode
+        self.residual = residual
+        self.topk = topk
+        self.with_score = with_score
+        self.names = list(columns) + ([SCORE_COL] if with_score else [])
+        self.types = [provider.type_of(c) for c in columns] + \
+            ([dt.FLOAT] if with_score else [])
+
+    def children(self):
+        return []
+
+    def label(self):
+        mode = f"TopK k={self.topk}" if self.topk is not None else "Stream"
+        return (f"SearchScan {self.provider.name}.{self.search_column} "
+                f"mode={mode}")
+
+    def _searcher(self):
+        from ..search.index import find_index
+        idx = find_index(self.provider, self.search_column)
+        if idx is None:
+            return None
+        return idx.searcher(self.search_column)
+
+    def batches(self, ctx):
+        searcher = self._searcher()
+        if searcher is None:
+            raise RuntimeError("search index disappeared under the plan "
+                               "(stale rewrite)")
+        full = self.provider.full_batch(self.columns)
+        if self.topk is not None:
+            scores, docs = searcher.topk(self.qnode, self.topk)
+            out = full.take(docs.astype(np.int64))
+            if self.with_score:
+                out = Batch(list(self.names),
+                            out.columns + [Column(dt.FLOAT,
+                                                  scores.astype(np.float32))])
+            if self.residual is not None:
+                c = self.residual.eval(out)
+                out = out.filter(c.data.astype(bool) & c.valid_mask())
+            yield out
+            return
+        docs = searcher.eval_filter(self.qnode)
+        # PG semantics: a predicate over a NULL text value is NULL, never
+        # true — negation queries must not surface NULL rows
+        col = full.column(self.search_column)
+        if col.validity is not None:
+            docs = docs[col.validity[docs]]
+        out = full.take(docs.astype(np.int64))
+        if self.with_score:
+            scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1))
+            smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
+            smap[sdocs] = scores
+            out = Batch(list(self.names),
+                        out.columns + [Column(dt.FLOAT, smap[docs])])
+        if self.residual is not None:
+            c = self.residual.eval(out)
+            out = out.filter(c.data.astype(bool) & c.valid_mask())
+        yield out

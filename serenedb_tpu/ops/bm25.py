@@ -1,0 +1,287 @@
+"""BM25 block scoring + top-k on device — the search-side flagship kernel.
+
+Reference analog: the hot loop of SURVEY.md §3.3 — block_disjunction over
+block_128 postings with BM25 ScoreFunction per 128-doc block and WAND
+block-max skipping (libs/iresearch/search/bm25.hpp, block_disjunction.hpp,
+formats/posting/wand_writer.hpp).
+
+TPU re-formulation (zero per-query posting transfers):
+
+- At index-build time, postings of *heavy* terms (df ≥ HEAVY_DF) are packed
+  into device-resident (n_blocks, 128) doc/tf tiles — the block_128 layout
+  is exactly one TPU lane row. Light terms stay in the flat arrays.
+- A query ships only: the block-row indices of its heavy terms (a few KB),
+  a gathered tail array for its light terms, and per-term idf weights.
+- One fused XLA program gathers the tiles, computes BM25 contributions,
+  scatter-adds into a dense per-doc accumulator, and takes top-k.
+
+Block-max pruning re-enters as *masking* (drop block rows whose upper bound
+can't reach a threshold) rather than branching; the dense pass is exact.
+
+Scoring follows the Lucene/IResearch BM25 ("k1=1.2, b=0.75", reference
+bm25.hpp:30-80): idf = ln(1 + (N - df + 0.5)/(df + 0.5)),
+score = Σ_t idf_t · (k1 + 1) · tf/(tf + k1·(1 − b + b·dl/avgdl)).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK = 128
+HEAVY_DF = 32     # terms with at least this many postings get block tiles
+
+
+def idf_lucene(n_docs: int, doc_freq: np.ndarray) -> np.ndarray:
+    df = doc_freq.astype(np.float64)
+    return np.log(1.0 + (n_docs - df + 0.5) / (df + 0.5)).astype(np.float32)
+
+
+@dataclass
+class BlockStore:
+    """Device-resident posting tiles for one field index."""
+
+    block_docs: jax.Array      # (NB_total+1, 128) int32, -1 padding; last row all pad
+    block_tfs: jax.Array       # (NB_total+1, 128) int32
+    norms: jax.Array           # (ndocs_pad,) int32
+    block_offsets: np.ndarray  # (T+1,) int64 — heavy terms' block-row spans
+    heavy: np.ndarray          # (T,) bool
+    flat_docs: np.ndarray      # host copies for the light-term tail
+    flat_tfs: np.ndarray
+    offsets: np.ndarray
+    ndocs_pad: int
+    pad_row: int               # index of the all-padding block row
+
+
+def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
+                      post_tfs: np.ndarray, doc_freq: np.ndarray,
+                      norms: np.ndarray, num_docs: int) -> BlockStore:
+    T = len(doc_freq)
+    heavy = doc_freq >= HEAVY_DF
+    nb_per = np.where(heavy, -(-doc_freq.astype(np.int64) // BLOCK), 0)
+    block_offsets = np.zeros(T + 1, dtype=np.int64)
+    np.cumsum(nb_per, out=block_offsets[1:])
+    nb_total = int(block_offsets[-1])
+    bdocs = np.full((nb_total + 1, BLOCK), -1, dtype=np.int32)
+    btfs = np.zeros((nb_total + 1, BLOCK), dtype=np.int32)
+    for t in np.flatnonzero(heavy):
+        s, e = int(offsets[t]), int(offsets[t + 1])
+        n = e - s
+        b0 = int(block_offsets[t])
+        nb = int(nb_per[t])
+        pad = nb * BLOCK - n
+        d = np.concatenate([post_docs[s:e],
+                            np.full(pad, -1, dtype=np.int32)])
+        f = np.concatenate([post_tfs[s:e], np.zeros(pad, dtype=np.int32)])
+        bdocs[b0:b0 + nb] = d.reshape(nb, BLOCK)
+        btfs[b0:b0 + nb] = f.reshape(nb, BLOCK)
+    nd_pad = max(1024, ((num_docs + 1023) // 1024) * 1024)
+    norms_pad = np.zeros(nd_pad, dtype=np.int32)
+    norms_pad[:num_docs] = norms[:num_docs]
+    return BlockStore(
+        block_docs=jnp.asarray(bdocs),
+        block_tfs=jnp.asarray(btfs),
+        norms=jnp.asarray(norms_pad),
+        block_offsets=block_offsets,
+        heavy=heavy,
+        flat_docs=post_docs,
+        flat_tfs=post_tfs,
+        offsets=offsets,
+        ndocs_pad=nd_pad,
+        pad_row=nb_total,
+    )
+
+
+@dataclass
+class QueryBatch:
+    """Host-assembled inputs for one scoring dispatch covering B queries.
+    All arrays are tiny relative to the posting store (KBs per query)."""
+
+    row_idx: np.ndarray    # (NB,) int32 block-row gather indices
+    row_w: np.ndarray      # (NB,) f32 idf weight of the row's term
+    row_qid: np.ndarray    # (NB,) int32 query index of the row
+    tail_docs: np.ndarray  # (TT,) int32 light-term postings (docs)
+    tail_tfs: np.ndarray   # (TT,) int32
+    tail_w: np.ndarray     # (TT,) f32
+    tail_qid: np.ndarray   # (TT,) int32
+    require: np.ndarray    # (B,) int32 — 0 = disjunction, else min hits
+    n_queries: int         # logical B before pow2 padding
+
+
+def assemble_query_batch(store: BlockStore, n_docs: int,
+                         queries: list[tuple[np.ndarray, int]],
+                         doc_freq: np.ndarray) -> QueryBatch:
+    """queries: list of (term_ids, require_all) per query. Weights are the
+    Lucene idf of each term (computed here so one dispatch covers all)."""
+    rows, row_w, row_q = [], [], []
+    tails_d, tails_f, tails_w, tails_q = [], [], [], []
+    require = []
+    for qi, (term_ids, req) in enumerate(queries):
+        require.append(req)
+        idf = idf_lucene(n_docs, doc_freq[np.asarray(term_ids, dtype=np.int64)]) \
+            if len(term_ids) else np.empty(0, dtype=np.float32)
+        for k, tid in enumerate(term_ids):
+            tid = int(tid)
+            w = float(idf[k])
+            if store.heavy[tid]:
+                b0 = int(store.block_offsets[tid])
+                b1 = int(store.block_offsets[tid + 1])
+                rows.append(np.arange(b0, b1, dtype=np.int32))
+                row_w.append(np.full(b1 - b0, w, dtype=np.float32))
+                row_q.append(np.full(b1 - b0, qi, dtype=np.int32))
+            else:
+                s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+                tails_d.append(store.flat_docs[s:e])
+                tails_f.append(store.flat_tfs[s:e])
+                tails_w.append(np.full(e - s, w, dtype=np.float32))
+                tails_q.append(np.full(e - s, qi, dtype=np.int32))
+
+    def cat(parts, dtype):
+        return np.concatenate(parts).astype(dtype, copy=False) if parts \
+            else np.empty(0, dtype=dtype)
+
+    row_idx = cat(rows, np.int32)
+    nb_pad = _pow2(len(row_idx), 8)
+    tail_docs = cat(tails_d, np.int32)
+    tt_pad = _pow2(len(tail_docs), BLOCK)
+    return QueryBatch(
+        row_idx=_pad_to(row_idx, nb_pad, store.pad_row),
+        row_w=_pad_to(cat(row_w, np.float32), nb_pad, 0.0),
+        row_qid=_pad_to(cat(row_q, np.int32), nb_pad, 0),
+        tail_docs=_pad_to(tail_docs, tt_pad, -1),
+        tail_tfs=_pad_to(cat(tails_f, np.int32), tt_pad, 0),
+        tail_w=_pad_to(cat(tails_w, np.float32), tt_pad, 0.0),
+        tail_qid=_pad_to(cat(tails_q, np.int32), tt_pad, 0),
+        require=np.asarray(require, dtype=np.int32),
+        n_queries=len(queries),
+    )
+
+
+def pack_query_batch(qb: QueryBatch) -> tuple[np.ndarray, np.ndarray,
+                                              int, int, int]:
+    """Pack the per-query arrays into ONE int32 + ONE f32 buffer so a
+    dispatch costs two host→device transfers instead of eleven (each
+    transfer pays full RTT on tunneled TPUs).
+
+    ints: [row_idx | row_qid | tail_docs | tail_tfs | tail_qid | require]
+    floats: [row_w | tail_w]
+    """
+    ints = np.concatenate([qb.row_idx, qb.row_qid, qb.tail_docs, qb.tail_tfs,
+                           qb.tail_qid, qb.require]).astype(np.int32)
+    floats = np.concatenate([qb.row_w, qb.tail_w]).astype(np.float32)
+    return ints, floats, len(qb.row_idx), len(qb.tail_docs), qb.n_queries
+
+
+def _pow2(n: int, floor: int) -> int:
+    return max(floor, 1 << max(n - 1, 0).bit_length())
+
+
+def _pad_to(a: np.ndarray, n: int, fill) -> np.ndarray:
+    out = np.full(n, fill, dtype=a.dtype if len(a) else np.int32)
+    out[:len(a)] = a
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nb", "tt", "ndocs_pad", "k",
+                                    "n_queries", "any_require"))
+def score_topk_packed(block_docs: jax.Array, block_tfs: jax.Array,
+                      norms: jax.Array, ints: jax.Array, floats: jax.Array,
+                      nb: int, tt: int, ndocs_pad: int, k: int,
+                      n_queries: int, any_require: bool, k1: float,
+                      b: float, avgdl: float) -> tuple[jax.Array, jax.Array]:
+    """Packed-argument entry (2 transfers): unpack then score."""
+    row_idx = ints[:nb]
+    row_qid = ints[nb:2 * nb]
+    tail_docs = ints[2 * nb:2 * nb + tt]
+    tail_tfs = ints[2 * nb + tt:2 * nb + 2 * tt]
+    tail_qid = ints[2 * nb + 2 * tt:2 * nb + 3 * tt]
+    require = ints[2 * nb + 3 * tt:2 * nb + 3 * tt + n_queries]
+    row_w = floats[:nb]
+    tail_w = floats[nb:nb + tt]
+    return _score_topk(block_docs, block_tfs, norms, row_idx, row_w,
+                       row_qid, tail_docs, tail_tfs, tail_w, tail_qid,
+                       require, ndocs_pad, k, n_queries, any_require,
+                       k1, b, avgdl)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ndocs_pad", "k", "n_queries",
+                                    "any_require"))
+def score_topk_batch(block_docs: jax.Array, block_tfs: jax.Array,
+                     norms: jax.Array, row_idx: jax.Array, row_w: jax.Array,
+                     row_qid: jax.Array, tail_docs: jax.Array,
+                     tail_tfs: jax.Array, tail_w: jax.Array,
+                     tail_qid: jax.Array, require: jax.Array,
+                     ndocs_pad: int, k: int, n_queries: int,
+                     any_require: bool, k1: float, b: float,
+                     avgdl: float) -> tuple[jax.Array, jax.Array]:
+    return _score_topk(block_docs, block_tfs, norms, row_idx, row_w,
+                       row_qid, tail_docs, tail_tfs, tail_w, tail_qid,
+                       require, ndocs_pad, k, n_queries, any_require,
+                       k1, b, avgdl)
+
+
+def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
+                tail_docs, tail_tfs, tail_w, tail_qid, require,
+                ndocs_pad: int, k: int, n_queries: int, any_require: bool,
+                k1: float, b: float, avgdl: float):
+    """One dispatch scoring B queries: fused gather → BM25 → batched
+    scatter-accumulate into (B, ndocs) → per-query top-k. Batching amortizes
+    host↔device dispatch latency — the QPS regime of the benchmark game."""
+    avg = jnp.maximum(jnp.float32(avgdl), 1e-9)
+
+    def contrib_of(docs, tfs, w):
+        valid = docs >= 0
+        safe_docs = jnp.where(valid, docs, 0)
+        tfsf = tfs.astype(jnp.float32)
+        dl = norms[safe_docs].astype(jnp.float32)
+        denom = tfsf + k1 * (1.0 - b + b * dl / avg)
+        c = w * (k1 + 1.0) * tfsf / jnp.maximum(denom, 1e-9)
+        return jnp.where(valid, c, 0.0), valid, safe_docs
+
+    rdocs = block_docs[row_idx]            # (NB, 128)
+    rtfs = block_tfs[row_idx]
+    wc, valid_b, safe_b = contrib_of(rdocs, rtfs, row_w[:, None])
+    bidx = (row_qid[:, None] * ndocs_pad + safe_b).reshape(-1)
+    scores = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.float32)
+    scores = scores.at[bidx].add(wc.reshape(-1))
+    tc, valid_t, safe_t = contrib_of(tail_docs, tail_tfs, tail_w)
+    tidx = tail_qid * ndocs_pad + safe_t
+    scores = scores.at[tidx].add(tc)
+    scores = scores.reshape(n_queries, ndocs_pad)
+    if any_require:
+        hits = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.int32)
+        hits = hits.at[bidx].add(valid_b.reshape(-1).astype(jnp.int32))
+        hits = hits.at[tidx].add(valid_t.astype(jnp.int32))
+        hits = hits.reshape(n_queries, ndocs_pad)
+        need = require[:, None]
+        scores = jnp.where(jnp.logical_or(need <= 0, hits >= need),
+                           scores, 0.0)
+    vals, docs = jax.lax.top_k(scores, k)
+    return vals, docs
+
+
+
+
+@functools.partial(jax.jit, static_argnames=("ndocs_pad",))
+def match_bitmap(block_docs: jax.Array, row_idx: jax.Array,
+                 tail_docs: jax.Array, ndocs_pad: int) -> jax.Array:
+    """Disjunctive match bitmap (unscored filter pushdown)."""
+    rdocs = block_docs[row_idx].reshape(-1)
+    m = jnp.zeros((ndocs_pad,), dtype=jnp.bool_)
+    m = m.at[jnp.where(rdocs >= 0, rdocs, 0)].max(rdocs >= 0)
+    m = m.at[jnp.where(tail_docs >= 0, tail_docs, 0)].max(tail_docs >= 0)
+    return m
+
+
+def pad_k(k: int) -> int:
+    """Bucket k so jit caches stay small: 10 / 100 / 1000 / next pow2."""
+    for bucket in (10, 100, 1000):
+        if k <= bucket:
+            return bucket
+    return 1 << (k - 1).bit_length()

@@ -1,0 +1,5 @@
+from .mesh import (combine_agg_partials, make_mesh, sharded_agg_step,
+                   sharded_bm25_topk, sharded_query_step, shard_rows)
+
+__all__ = ["combine_agg_partials", "make_mesh", "sharded_agg_step",
+           "sharded_bm25_topk", "sharded_query_step", "shard_rows"]

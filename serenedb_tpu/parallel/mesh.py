@@ -1,0 +1,140 @@
+"""Device-mesh parallel execution of scans, aggregates, and scoring.
+
+Reference analog: the reference's intra-node parallelism (morsel-driven
+pipelines, parallel top-k collectors, parallel sinks — SURVEY.md §2.11) has
+no cross-device component; on TPU the same roles map onto a
+`jax.sharding.Mesh`: row blocks shard across devices ("data parallel" scan),
+per-device partial aggregates combine with psum over ICI, and per-device
+top-k merges via all_gather — XLA inserts the collectives.
+
+The mesh axis is named "shard". Multi-host scaling uses the same programs
+over a larger mesh (jax handles DCN vs ICI placement).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..columnar.device import LANES
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+def shard_rows(arr: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Pad the leading (row-block) axis to a multiple of the mesh size."""
+    n = mesh.shape[AXIS]
+    rows = arr.shape[0]
+    pad = (-rows) % n
+    if pad:
+        padding = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, padding)
+    return arr
+
+
+def sharded_agg_step(mesh: Mesh):
+    """Build a jitted sharded filter+aggregate step:
+    (vals (R,128) i32, mask (R,128) bool, lo, hi) →
+    (total count, per-row-block [hi16, lo16] int32 partial sums (R, 2)).
+
+    Each 128-lane partial is exact in int32 (lo ≤ 128·65535, hi ≤ 128·2^15);
+    the caller combines them on host as (Σhi << 16) + Σlo in int64 —
+    device-side whole-shard int32 accumulation would wrap (int64 reductions
+    are emulated on TPU, so the exact combine stays on host)."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), P(), P()),
+        out_specs=(P(), P(AXIS, None)))
+    def step(vals, mask, lo, hi):
+        sel = jnp.logical_and(mask,
+                              jnp.logical_and(vals >= lo, vals < hi))
+        cnt = jnp.sum(sel, dtype=jnp.int32)
+        v = jnp.where(sel, vals, 0).astype(jnp.int32)
+        loh = (v & 0xFFFF).astype(jnp.int32)
+        hih = jnp.right_shift(v, 16)
+        partials = jnp.stack([jnp.sum(hih, axis=1, dtype=jnp.int32),
+                              jnp.sum(loh, axis=1, dtype=jnp.int32)], axis=1)
+        return jax.lax.psum(cnt, AXIS), partials
+
+    return jax.jit(step)
+
+
+def combine_agg_partials(partials: np.ndarray) -> int:
+    """(R, 2) int32 [hi16, lo16] row partials → exact int64 total."""
+    p = np.asarray(partials).astype(np.int64)
+    return int((p[:, 0].sum() << 16) + p[:, 1].sum())
+
+
+def sharded_bm25_topk(mesh: Mesh, ndocs_pad: int, k: int,
+                      k1: float = 1.2, b: float = 0.75):
+    """Build a jitted sharded BM25 top-k: posting blocks shard across
+    devices; each scores its blocks into a local dense accumulator; psum
+    merges accumulators (doc space is replicated), then one top-k."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(), P(AXIS, None), P(AXIS), P(), P()),
+        out_specs=(P(), P()))
+    def step(flat_docs, flat_tfs, norms, gidx, block_term, idf, avgdl):
+        valid = gidx >= 0
+        safe = jnp.where(valid, gidx, 0)
+        docs = flat_docs[safe]
+        tfs = flat_tfs[safe].astype(jnp.float32)
+        dl = norms[docs].astype(jnp.float32)
+        w = idf[block_term][:, None]
+        denom = tfs + k1 * (1.0 - b + b * dl / jnp.maximum(avgdl, 1e-9))
+        contrib = jnp.where(valid, w * (k1 + 1.0) * tfs /
+                            jnp.maximum(denom, 1e-9), 0.0)
+        local = jnp.zeros((ndocs_pad,), dtype=jnp.float32)
+        local = local.at[docs.reshape(-1)].add(contrib.reshape(-1))
+        scores = jax.lax.psum(local, AXIS)
+        return tuple(jax.lax.top_k(scores, k))
+
+    return jax.jit(step)
+
+
+def sharded_query_step(mesh: Mesh, num_groups: int):
+    """The full "training step" equivalent: one sharded query combining a
+    filtered grouped aggregate with BM25 scoring — exercises scatter, matmul
+    one-hot, and psum/all-reduce over the mesh in a single jitted program."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS, None),
+                  P(), P(), P(AXIS, None), P(AXIS)),
+        out_specs=(P(), P(), P()))
+    def step(vals, mask, codes, flat_docs, flat_tfs, gidx, block_term):
+        # grouped count + sum over the row shard
+        sel = jnp.logical_and(mask, vals >= 0)
+        oh = jax.nn.one_hot(jnp.clip(codes, 0, num_groups - 1), num_groups,
+                            dtype=jnp.float32)
+        oh = oh * sel.astype(jnp.float32)[..., None]
+        counts = jax.lax.psum(jnp.einsum("rbg->g", oh), AXIS)
+        sums = jax.lax.psum(
+            jnp.einsum("rbg,rb->g", oh,
+                       jnp.where(sel, vals, 0).astype(jnp.float32)), AXIS)
+        # BM25-ish scoring over the posting shard
+        valid = gidx >= 0
+        safe = jnp.where(valid, gidx, 0)
+        docs = flat_docs[safe]
+        tfs = flat_tfs[safe].astype(jnp.float32)
+        contrib = jnp.where(valid, tfs / (tfs + 1.2), 0.0)
+        local = jnp.zeros_like(flat_docs, dtype=jnp.float32)
+        local = local.at[docs.reshape(-1)].add(contrib.reshape(-1))
+        scores = jax.lax.psum(local, AXIS)
+        return counts, sums, scores
+
+    return jax.jit(step)

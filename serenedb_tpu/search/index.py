@@ -1,21 +1,47 @@
-"""Inverted-index build entry point (placeholder until the segment layer).
+"""Index build: CREATE INDEX ... USING inverted backfill.
 
-Reference analog: CREATE INDEX ... USING inverted backfill
-(server/connector/duckdb_physical_create_index.*). The real segmented index
-with posting blocks lands with the search core; this records index metadata
-so DDL round-trips."""
+Reference analog: duckdb_physical_create_index.* (backfill scan feeding an
+irs::IndexWriter; SURVEY.md §2.5). V1 builds one segment over the current
+table contents; the storage layer adds incremental segments + WAL.
+"""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import numpy as np
+
+from .. import errors
+from .analysis import get_analyzer
+from .searcher import SearchIndex, SegmentSearcher
+from .segment import build_field_index
 
 
-@dataclass
-class IndexDef:
-    columns: list[str]
-    using: str
-    options: dict = field(default_factory=dict)
+def build_index_for_table(provider, columns, using, options) -> SearchIndex:
+    if using not in ("inverted", "btree", "secondary", "ivf"):
+        raise errors.unsupported(f"index type {using}")
+    analyzer_name = str(options.get("tokenizer", options.get("analyzer",
+                                                             "text")))
+    searchers = {}
+    if using == "inverted":
+        an = get_analyzer(analyzer_name)
+        for col_name in columns:
+            col = provider.full_batch([col_name]).column(col_name)
+            if not col.type.is_string:
+                raise errors.SqlError(
+                    errors.DATATYPE_MISMATCH,
+                    f'inverted index requires a text column, "{col_name}" '
+                    f"is {col.type}")
+            texts = col.to_pylist()
+            fi = build_field_index(texts, an)
+            searchers[col_name] = SegmentSearcher(fi, an, len(texts))
+    return SearchIndex(list(columns), using, dict(options), analyzer_name,
+                       searchers, provider.data_version)
 
 
-def build_index_for_table(provider, columns, using, options) -> IndexDef:
-    return IndexDef(list(columns), using, dict(options))
+def find_index(provider, column: str):
+    """The freshest inverted index covering `column`, or None (stale indexes
+    — data_version behind the provider — are skipped, not used wrongly)."""
+    for idx in getattr(provider, "indexes", {}).values():
+        if idx.using == "inverted" and column in idx.columns and \
+                idx.data_version == provider.data_version:
+            return idx
+    return None

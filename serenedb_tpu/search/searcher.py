@@ -1,0 +1,278 @@
+"""Index-backed query evaluation: filters on CPU set algebra, scoring on TPU.
+
+Reference analog: prepared queries over a DirectoryReader snapshot —
+ScanMode::Stream (filter → doc iterator) and ScanMode::TopK (parallel scored
+collectors) (reference: server/connector/duckdb_search_full_scan.hpp:54-76).
+
+Split of labor (SURVEY.md §7 phase 2): term dictionary lookups and boolean
+doc-set algebra stay on CPU (pointer-chasing), BM25 scoring + top-k runs as
+the dense block kernel in ops/bm25.py. Results must match the brute-force
+semantics contract in search/query.py — asserted by parity tests.
+
+Scoring semantics: a document's score is the sum of BM25 contributions of
+every positive leaf term of the query (phrase members and prefix expansions
+included); NOT-subtrees and phrase adjacency affect *matching* only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.device import pad_len
+from ..ops import bm25 as bm25_ops
+from .analysis import Analyzer
+from .query import (QAnd, QNode, QNot, QOr, QPhrase, QPrefix, QTerm,
+                    parse_query)
+from .segment import BLOCK, FieldIndex
+
+K1 = 1.2
+B = 0.75  # reference defaults: libs/iresearch/search/bm25.hpp
+
+
+class SegmentSearcher:
+    def __init__(self, index: FieldIndex, analyzer: Analyzer, num_docs: int):
+        self.index = index
+        self.analyzer = analyzer
+        self.num_docs = num_docs
+        self._dev = None
+
+    # -- device posting store (lazy, cached) ------------------------------
+
+    def _device_store(self) -> bm25_ops.BlockStore:
+        if self._dev is None:
+            self._dev = bm25_ops.build_block_store(
+                self.index.offsets, self.index.post_docs,
+                self.index.post_tfs, self.index.doc_freq,
+                self.index.norms, self.num_docs)
+        return self._dev
+
+    # -- filter evaluation (CPU doc-set algebra) --------------------------
+
+    def eval_filter(self, node: QNode) -> np.ndarray:
+        """Sorted doc ids matching the query node."""
+        if isinstance(node, QTerm):
+            tid = self.index.term_id(node.term)
+            if tid < 0:
+                return np.empty(0, dtype=np.int32)
+            return self.index.postings(tid)[0]
+        if isinstance(node, QPrefix):
+            tids = self.index.prefix_term_ids(node.prefix)
+            if len(tids) == 0:
+                return np.empty(0, dtype=np.int32)
+            parts = [self.index.postings(t)[0] for t in tids]
+            return np.unique(np.concatenate(parts))
+        if isinstance(node, QPhrase):
+            return self._eval_phrase(node.terms)
+        if isinstance(node, QAnd):
+            if not node.args:
+                return np.empty(0, dtype=np.int32)
+            pos = [a for a in node.args if not isinstance(a, QNot)]
+            neg = [a for a in node.args if isinstance(a, QNot)]
+            if pos:
+                acc = self.eval_filter(pos[0])
+                for a in pos[1:]:
+                    acc = np.intersect1d(acc, self.eval_filter(a),
+                                         assume_unique=True)
+            else:
+                acc = np.arange(self.num_docs, dtype=np.int32)
+            for a in neg:
+                acc = np.setdiff1d(acc, self.eval_filter(a.arg),
+                                   assume_unique=True)
+            return acc
+        if isinstance(node, QOr):
+            parts = [self.eval_filter(a) for a in node.args]
+            return np.unique(np.concatenate(parts)) if parts \
+                else np.empty(0, dtype=np.int32)
+        if isinstance(node, QNot):
+            inner = self.eval_filter(node.arg)
+            return np.setdiff1d(np.arange(self.num_docs, dtype=np.int32),
+                                inner, assume_unique=True)
+        return np.empty(0, dtype=np.int32)
+
+    def _eval_phrase(self, terms: list[str]) -> np.ndarray:
+        if not terms:
+            return np.empty(0, dtype=np.int32)
+        tids = [self.index.term_id(t) for t in terms]
+        if any(t < 0 for t in tids):
+            return np.empty(0, dtype=np.int32)
+        cand = self.index.postings(tids[0])[0]
+        for t in tids[1:]:
+            cand = np.intersect1d(cand, self.index.postings(t)[0],
+                                  assume_unique=True)
+        if len(terms) == 1 or len(cand) == 0:
+            return cand
+        pos_maps = [self.index.positions_of(t, cand) for t in tids]
+        out = []
+        for d in cand:
+            first = pos_maps[0].get(int(d))
+            if first is None:
+                continue
+            ok = False
+            rest = [pm.get(int(d)) for pm in pos_maps[1:]]
+            if any(r is None for r in rest):
+                continue
+            rest_sets = [set(r.tolist()) for r in rest]
+            for p in first:
+                if all((int(p) + k1) in rs
+                       for k1, rs in enumerate(rest_sets, 1)):
+                    ok = True
+                    break
+            if ok:
+                out.append(int(d))
+        return np.asarray(out, dtype=np.int32)
+
+    # -- scoring (device) --------------------------------------------------
+
+    def scoring_terms(self, node: QNode) -> list[int]:
+        """Positive leaf term ids contributing to the score."""
+        out: list[int] = []
+
+        def rec(nd):
+            if isinstance(nd, QTerm):
+                t = self.index.term_id(nd.term)
+                if t >= 0:
+                    out.append(t)
+            elif isinstance(nd, QPhrase):
+                for term in nd.terms:
+                    t = self.index.term_id(term)
+                    if t >= 0:
+                        out.append(t)
+            elif isinstance(nd, QPrefix):
+                out.extend(int(t) for t in
+                           self.index.prefix_term_ids(nd.prefix))
+            elif isinstance(nd, (QAnd, QOr)):
+                for a in nd.args:
+                    rec(a)
+            # QNot: no score contribution
+        rec(node)
+        seen = set()
+        uniq = []
+        for t in out:
+            if t not in seen:
+                seen.add(t)
+                uniq.append(t)
+        return uniq
+
+    def _query_shape(self, node: QNode) -> tuple[list[int], int, bool, bool]:
+        """(scoring term ids, require_all, needs_exact_mask, always_empty).
+
+        always_empty: a pure conjunction containing a term absent from the
+        index can never match (scoring_terms silently drops absent terms, so
+        require_all alone would degrade the AND)."""
+        tids = self.scoring_terms(node)
+        require_all = 0
+        needs_mask = False
+        empty = False
+        if isinstance(node, (QTerm, QPrefix)):
+            pass
+        elif isinstance(node, QOr) and all(
+                isinstance(a, QTerm) for a in node.args):
+            pass
+        elif isinstance(node, QAnd) and all(
+                isinstance(a, QTerm) for a in node.args):
+            require_all = len(tids)
+            if any(self.index.term_id(a.term) < 0 for a in node.args):
+                empty = True
+        else:
+            needs_mask = True
+        return tids, require_all, needs_mask, empty
+
+    def topk(self, node: QNode, k: int) -> tuple[np.ndarray, np.ndarray]:
+        return self.topk_batch([node], k)[0]
+
+    def topk_batch(self, nodes: list[QNode], k: int,
+                   ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Top-k (scores, doc ids) for a batch of queries in ONE device
+        dispatch (amortizes dispatch latency — the QPS regime). Pure term
+        disjunctions/conjunctions run fully on device; other shapes get an
+        exact-match CPU mask applied to the device scores."""
+        if self.num_docs == 0:
+            return [(np.empty(0, dtype=np.float32),
+                     np.empty(0, dtype=np.int32))] * len(nodes)
+        store = self._device_store()
+        nd_pad = store.ndocs_pad
+        shapes = [self._query_shape(n) for n in nodes]
+        queries = [(np.asarray(tids, dtype=np.int64) if not empty
+                    else np.empty(0, dtype=np.int64), req)
+                   for tids, req, _, empty in shapes]
+        qb = bm25_ops.assemble_query_batch(store, self.num_docs, queries,
+                                           self.index.doc_freq)
+        kk = bm25_ops.pad_k(min(max(k, 1), max(self.num_docs, 1)))
+        kk = min(kk, nd_pad)
+        ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
+        vals, docs = bm25_ops.score_topk_packed(
+            store.block_docs, store.block_tfs, store.norms,
+            jnp.asarray(ints), jnp.asarray(floats), nb, tt,
+            nd_pad, kk, nq, bool(qb.require.any()),
+            K1, B, self.index.avgdl)
+        vals, docs = jax.device_get((vals, docs))
+        out = []
+        for qi, (node, (tids, req, needs_mask, empty)) in enumerate(
+                zip(nodes, shapes)):
+            scores, dd = vals[qi], docs[qi]
+            if empty:
+                out.append((np.empty(0, dtype=np.float32),
+                            np.empty(0, dtype=np.int32)))
+                continue
+            if not tids:
+                # no scoring terms (e.g. pure negation): matches exist but
+                # all score 0 — return the first k matches with zero scores
+                match = self.eval_filter(node)[:k]
+                out.append((np.zeros(len(match), dtype=np.float32),
+                            match.astype(np.int32)))
+                continue
+            if needs_mask:
+                match = self.eval_filter(node)
+                mset = np.zeros(nd_pad, dtype=bool)
+                mset[match] = True
+                ok = mset[dd]
+                if (~ok[scores > 0.0]).any() and len(match) > 0:
+                    # a non-match made device top-k → the survivors may not
+                    # be the true top-k of the match set; exact CPU rescore
+                    scores, dd = self._cpu_score(match, tids, k)
+                else:
+                    scores, dd = scores[ok], dd[ok]
+            keep = scores > 0.0
+            scores, dd = scores[keep], dd[keep]
+            out.append((scores[:k], dd[:k]))
+        return out
+
+    def _cpu_score(self, docs: np.ndarray, tids: list[int],
+                   k: int) -> tuple[np.ndarray, np.ndarray]:
+        scores = np.zeros(len(docs), dtype=np.float64)
+        idf = bm25_ops.idf_lucene(self.num_docs,
+                                  self.index.doc_freq[np.asarray(tids)])
+        dl = self.index.norms[docs].astype(np.float64)
+        avgdl = max(self.index.avgdl, 1e-9)
+        for qi, tid in enumerate(tids):
+            pd, pt = self.index.postings(tid)
+            ix = np.searchsorted(pd, docs)
+            ix = np.clip(ix, 0, max(len(pd) - 1, 0))
+            hit = (len(pd) > 0) & (pd[ix] == docs)
+            tf = np.where(hit, pt[np.clip(ix, 0, max(len(pd) - 1, 0))],
+                          0).astype(np.float64)
+            denom = tf + K1 * (1 - B + B * dl / avgdl)
+            scores += idf[qi] * (K1 + 1) * tf / np.maximum(denom, 1e-9)
+        order = np.argsort(-scores, kind="stable")[:k]
+        return (scores[order].astype(np.float32),
+                docs[order].astype(np.int32))
+
+
+@dataclass
+class SearchIndex:
+    """A built index over one or more text columns of a table provider."""
+
+    columns: list[str]
+    using: str
+    options: dict
+    analyzer_name: str
+    searchers: dict[str, SegmentSearcher]   # column → searcher
+    data_version: int
+
+    def searcher(self, column: str) -> Optional[SegmentSearcher]:
+        return self.searchers.get(column)

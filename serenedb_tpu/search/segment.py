@@ -1,0 +1,196 @@
+"""Immutable inverted-index segments, TPU-shaped.
+
+Reference analog: IResearch segments — postings in 128-doc blocks with
+block-max (WAND) metadata, columnstore for stored fields, norms for scoring
+(reference: libs/iresearch/formats/posting/format_block_128.cpp,
+wand_writer.hpp; SURVEY.md §2.7). The 128-doc block granularity is kept —
+it is exactly one TPU lane row — but postings live as flat HBM arrays with
+per-term offsets; queries gather (n_blocks, 128) tiles by index matrix and
+score them on the MXU/VPU (ops/bm25.py).
+
+A segment is immutable once built; deletes are a live-docs bitmap owned by
+the enclosing shard (storage layer); merges rebuild segments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .analysis import Analyzer, get_analyzer
+
+BLOCK = 128
+
+
+@dataclass
+class FieldIndex:
+    """Inverted index of one text field within a segment."""
+
+    terms: np.ndarray          # (T,) object, sorted unique terms
+    doc_freq: np.ndarray       # (T,) int32
+    offsets: np.ndarray        # (T+1,) int64 into postings arrays
+    post_docs: np.ndarray      # (P,) int32 doc ids, ascending per term
+    post_tfs: np.ndarray       # (P,) int32 term frequencies
+    pos_offsets: np.ndarray    # (P+1,) int64 into positions
+    positions: np.ndarray      # (PP,) int32 token positions (phrase queries)
+    norms: np.ndarray          # (ndocs,) int32 tokens per document
+    block_max_tf: np.ndarray   # (NB_total,) int32 — per 128-block max tf
+    block_offsets: np.ndarray  # (T+1,) int64 into block_max_tf
+    total_tokens: int
+
+    @property
+    def num_terms(self) -> int:
+        return len(self.terms)
+
+    @property
+    def avgdl(self) -> float:
+        n = len(self.norms)
+        return (self.total_tokens / n) if n else 0.0
+
+    @property
+    def terms_str(self) -> np.ndarray:
+        """str-dtype view of the term dictionary, materialized once (term
+        lookups are the hot path — no per-query O(T) copies)."""
+        ts = getattr(self, "_terms_str", None)
+        if ts is None:
+            ts = self._terms_str = self.terms.astype(str)
+        return ts
+
+    def term_id(self, term: str) -> int:
+        """-1 if absent."""
+        ts = self.terms_str
+        i = int(np.searchsorted(ts, term))
+        if i < len(ts) and ts[i] == term:
+            return i
+        return -1
+
+    def term_range(self, lo: str, hi: str) -> np.ndarray:
+        """Term ids with lo <= term < hi (prefix/range expansion)."""
+        ts = self.terms_str
+        a = int(np.searchsorted(ts, lo, side="left"))
+        b = int(np.searchsorted(ts, hi, side="left"))
+        return np.arange(a, b, dtype=np.int64)
+
+    def prefix_term_ids(self, prefix: str) -> np.ndarray:
+        return self.term_range(prefix, prefix + "￿")
+
+    def postings(self, tid: int) -> tuple[np.ndarray, np.ndarray]:
+        s, e = int(self.offsets[tid]), int(self.offsets[tid + 1])
+        return self.post_docs[s:e], self.post_tfs[s:e]
+
+    def positions_of(self, tid: int, within_docs: np.ndarray) -> dict[int, np.ndarray]:
+        """doc id → positions array, for the given docs (phrase check)."""
+        s, e = int(self.offsets[tid]), int(self.offsets[tid + 1])
+        docs = self.post_docs[s:e]
+        idx = np.searchsorted(docs, within_docs)
+        out = {}
+        for k, d in zip(idx, within_docs):
+            if k < len(docs) and docs[k] == d:
+                p = s + k
+                out[int(d)] = self.positions[
+                    int(self.pos_offsets[p]):int(self.pos_offsets[p + 1])]
+        return out
+
+
+@dataclass
+class Segment:
+    """One immutable segment: per-field inverted indexes + doc count.
+    Stored fields live in the enclosing table's columnstore (the provider's
+    Batch), addressed by this segment's base row offset."""
+
+    fields: dict[str, FieldIndex]
+    num_docs: int
+    base_row: int = 0           # offset of doc 0 in the table's row space
+
+    def field(self, name: str) -> Optional[FieldIndex]:
+        return self.fields.get(name)
+
+
+def build_field_index(texts: Iterable[Optional[str]],
+                      analyzer: Analyzer) -> FieldIndex:
+    """Tokenize a column of documents into a FieldIndex (host-side; analysis
+    is CPU work by design — SURVEY.md §7 hard part 5)."""
+    term_postings: dict[str, list] = {}
+    norms = []
+    total_tokens = 0
+    for doc_id, text in enumerate(texts):
+        if text is None:
+            norms.append(0)
+            continue
+        toks = analyzer.tokenize(text)
+        norms.append(len(toks))
+        total_tokens += len(toks)
+        per_term: dict[str, list[int]] = {}
+        for t in toks:
+            per_term.setdefault(t.term, []).append(t.position)
+        for term, poss in per_term.items():
+            term_postings.setdefault(term, []).append((doc_id, poss))
+    terms_sorted = sorted(term_postings)
+    T = len(terms_sorted)
+    doc_freq = np.zeros(T, dtype=np.int32)
+    offsets = np.zeros(T + 1, dtype=np.int64)
+    post_docs_l: list[int] = []
+    post_tfs_l: list[int] = []
+    pos_offsets_l: list[int] = [0]
+    positions_l: list[int] = []
+    block_max_l: list[int] = []
+    block_offsets = np.zeros(T + 1, dtype=np.int64)
+    for ti, term in enumerate(terms_sorted):
+        plist = term_postings[term]
+        doc_freq[ti] = len(plist)
+        for doc_id, poss in plist:
+            post_docs_l.append(doc_id)
+            post_tfs_l.append(len(poss))
+            positions_l.extend(poss)
+            pos_offsets_l.append(len(positions_l))
+        offsets[ti + 1] = len(post_docs_l)
+        # per-128-block max tf (WAND metadata)
+        tfs = np.asarray(post_tfs_l[offsets[ti]:offsets[ti + 1]],
+                         dtype=np.int32)
+        nb = -(-len(tfs) // BLOCK) if len(tfs) else 0
+        for bi in range(nb):
+            block_max_l.append(int(tfs[bi * BLOCK:(bi + 1) * BLOCK].max()))
+        block_offsets[ti + 1] = len(block_max_l)
+    return FieldIndex(
+        terms=np.asarray(terms_sorted, dtype=object),
+        doc_freq=doc_freq,
+        offsets=offsets,
+        post_docs=np.asarray(post_docs_l, dtype=np.int32),
+        post_tfs=np.asarray(post_tfs_l, dtype=np.int32),
+        pos_offsets=np.asarray(pos_offsets_l, dtype=np.int64),
+        positions=np.asarray(positions_l, dtype=np.int32),
+        norms=np.asarray(norms, dtype=np.int32),
+        block_max_tf=np.asarray(block_max_l, dtype=np.int32),
+        block_offsets=block_offsets,
+        total_tokens=total_tokens,
+    )
+
+
+def build_segment(columns: dict[str, Iterable[Optional[str]]],
+                  analyzers: dict[str, str],
+                  num_docs: int, base_row: int = 0) -> Segment:
+    fields = {}
+    for name, texts in columns.items():
+        an = get_analyzer(analyzers.get(name, "text"))
+        fields[name] = build_field_index(texts, an)
+    return Segment(fields, num_docs, base_row)
+
+
+def merge_segments(segments: list[Segment], live_masks: list[np.ndarray],
+                   columns_of, analyzers: dict[str, str]) -> Segment:
+    """Compaction: rebuild one segment from the live docs of many.
+    `columns_of(seg) -> dict[field, list[str]]` re-reads stored text (the
+    reference's merge_writer reads the columnstore the same way)."""
+    all_cols: dict[str, list] = {}
+    total = 0
+    for seg, live in zip(segments, live_masks):
+        cols = columns_of(seg)
+        keep = np.flatnonzero(live[:seg.num_docs])
+        for name, texts in cols.items():
+            all_cols.setdefault(name, []).extend(
+                [texts[i] for i in keep])
+        total += len(keep)
+    return build_segment(all_cols, analyzers, total,
+                         segments[0].base_row if segments else 0)

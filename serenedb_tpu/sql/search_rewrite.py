@@ -1,0 +1,197 @@
+"""Post-planning rewrite: claim full-text predicates into index scans.
+
+Reference analog: the pre-optimizer pass that claims WHERE conjuncts for
+iresearch and pushes scorer calls into virtual score columns
+(IResearchPushdownComplexFilter / PushdownScorerCall / score-column reuse in
+ORDER BY — reference: server/connector/optimizer/iresearch_plan.cpp:
+927-1108). Patterns:
+
+1. Scan(filter with ts conjuncts on an indexed column) → SearchScanNode
+   (Stream mode), remaining conjuncts as residual.
+2. Limit(Sort desc by bm25(col))(Project(Scan(ts-only filter))) →
+   SearchScanNode (TopK mode) with a #score output column; bm25()/tfidf()
+   calls in the projection are rewired to that column.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..columnar import dtypes as dt
+from ..exec.plan import (AggregateNode, DropColumnsNode, FilterNode, JoinNode,
+                         LimitNode, PlanNode, ProjectNode, ScanNode, SortNode)
+from ..exec.search_scan import SCORE_COL, SearchScanNode
+from ..search.index import find_index
+from ..search.query import QAnd, QNode, QPhrase, QTerm, parse_query
+from .expr import BoundColumn, BoundExpr, BoundFunc, kleene_and
+
+_TS_FUNCS = {"ts_phrase", "ts_query"}
+_SCORER_FUNCS = {"bm25", "tfidf"}
+
+
+def rewrite_search(plan: PlanNode) -> PlanNode:
+    topk = _match_topk(plan)
+    if topk is not None:
+        return topk
+    # Project-over-Scan must be matched BEFORE recursing, or the generic
+    # ScanNode branch claims the scan without score wiring
+    if isinstance(plan, ProjectNode) and isinstance(plan.child, ScanNode):
+        new_child = _try_search_scan(plan.child,
+                                     want_score=_has_scorer(plan.exprs))
+        if new_child is not None:
+            plan.child = new_child
+            if new_child.with_score:
+                _rewire_scorers(plan.exprs, new_child)
+            return plan
+    _rewrite_children(plan)
+    if isinstance(plan, ScanNode):
+        replaced = _try_search_scan(plan, want_score=False)
+        if replaced is not None:
+            return replaced
+    return plan
+
+
+def _rewrite_children(plan: PlanNode) -> None:
+    for attr in ("child", "left", "right"):
+        c = getattr(plan, attr, None)
+        if isinstance(c, PlanNode):
+            setattr(plan, attr, rewrite_search(c))
+
+
+# -- pattern 2: scored top-k ----------------------------------------------
+
+def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
+    limit = plan if isinstance(plan, LimitNode) else None
+    if limit is None or limit.limit is None:
+        return None
+    inner = limit.child
+    drop = None
+    if isinstance(inner, DropColumnsNode):
+        drop = inner
+        inner = inner.child
+    if not isinstance(inner, SortNode):
+        return None
+    sort = inner
+    if len(sort.key_indices) != 1 or not sort.descs[0]:
+        return None
+    if not isinstance(sort.child, ProjectNode):
+        return None
+    proj = sort.child
+    key_expr = proj.exprs[sort.key_indices[0]]
+    if not (isinstance(key_expr, BoundFunc) and
+            key_expr.name in _SCORER_FUNCS and key_expr.args and
+            isinstance(key_expr.args[0], BoundColumn)):
+        return None
+    if not isinstance(proj.child, ScanNode):
+        return None
+    scan = proj.child
+    search_col_idx = key_expr.args[0].index
+    search_col = scan.columns[search_col_idx]
+    qnode, residual = _claim_ts(scan, search_col)
+    if qnode is None or residual is not None:
+        # residual conjuncts would filter *after* top-k and break LIMIT
+        return None
+    k = limit.limit + limit.offset
+    node = SearchScanNode(scan.provider, scan.columns, scan.alias,
+                          search_col, qnode, None, k, with_score=True)
+    _rewire_scorers(proj.exprs, node)
+    proj.child = node
+    return plan
+
+
+def _has_scorer(exprs: list[BoundExpr]) -> bool:
+    return any(isinstance(s, BoundFunc) and s.name in _SCORER_FUNCS
+               for e in exprs for s in e.walk())
+
+
+def _rewire_scorers(exprs: list[BoundExpr], node: SearchScanNode) -> None:
+    """Replace scorer calls over the *searched column* with the scan's
+    #score output; scorers over other columns keep their default (0.0)."""
+    score_ref = BoundColumn(len(node.columns), dt.FLOAT, SCORE_COL)
+    search_idx = node.columns.index(node.search_column)
+
+    def rec(e: BoundExpr) -> BoundExpr:
+        if isinstance(e, BoundFunc):
+            if e.name in _SCORER_FUNCS and e.args and \
+                    isinstance(e.args[0], BoundColumn) and \
+                    e.args[0].index == search_idx:
+                return score_ref
+            e.args = [rec(a) for a in e.args]
+        return e
+
+    for i in range(len(exprs)):
+        exprs[i] = rec(exprs[i])
+
+
+# -- pattern 1: filter pushdown -------------------------------------------
+
+def _try_search_scan(scan: ScanNode,
+                     want_score: bool) -> Optional[SearchScanNode]:
+    if scan.filter is None:
+        return None
+    # find an indexed column among the ts conjuncts
+    for col_name in scan.columns:
+        if find_index(scan.provider, col_name) is None:
+            continue
+        qnode, residual = _claim_ts(scan, col_name)
+        if qnode is not None:
+            return SearchScanNode(scan.provider, scan.columns, scan.alias,
+                                  col_name, qnode, residual, None,
+                                  with_score=want_score)
+    return None
+
+
+def _claim_ts(scan: ScanNode, col_name: str,
+              ) -> tuple[Optional[QNode], Optional[BoundExpr]]:
+    """Claim ts conjuncts on col_name from the scan filter. Returns
+    (query node, residual predicate)."""
+    if scan.filter is None:
+        return None, None
+    idx = find_index(scan.provider, col_name)
+    if idx is None:
+        return None, None
+    col_idx = scan.columns.index(col_name)
+    from ..search.analysis import get_analyzer
+    an = get_analyzer(idx.analyzer_name)
+    claimed: list[QNode] = []
+    residual: list[BoundExpr] = []
+    for c in _conjuncts(scan.filter):
+        q = _to_qnode(c, col_idx, an)
+        if q is not None:
+            claimed.append(q)
+        else:
+            residual.append(c)
+    if not claimed:
+        return None, None
+    qnode = claimed[0] if len(claimed) == 1 else QAnd(claimed)
+    res: Optional[BoundExpr] = None
+    if residual:
+        res = residual[0] if len(residual) == 1 else BoundFunc(
+            "and", residual, dt.BOOL, lambda cols, b: kleene_and(cols))
+    return qnode, res
+
+
+def _conjuncts(e: BoundExpr) -> list[BoundExpr]:
+    if isinstance(e, BoundFunc) and e.name == "and":
+        out = []
+        for a in e.args:
+            out.extend(_conjuncts(a))
+        return out
+    return [e]
+
+
+def _to_qnode(e: BoundExpr, col_idx: int, analyzer) -> Optional[QNode]:
+    from .expr import BoundLiteral
+    if not (isinstance(e, BoundFunc) and e.name in _TS_FUNCS and
+            len(e.args) == 2):
+        return None
+    col, lit = e.args
+    if not (isinstance(col, BoundColumn) and col.index == col_idx and
+            isinstance(lit, BoundLiteral) and isinstance(lit.value, str)):
+        return None
+    if e.name == "ts_phrase":
+        terms = [t.term for t in analyzer.tokenize(lit.value)]
+        if not terms:
+            return None
+        return QTerm(terms[0]) if len(terms) == 1 else QPhrase(terms)
+    return parse_query(lit.value, analyzer)

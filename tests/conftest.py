@@ -9,11 +9,19 @@ Must run before anything imports jax, hence top-of-conftest env mutation.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# This environment preloads jax via sitecustomize and pins
+# jax_platforms='axon,cpu' (the tunneled TPU), which silently overrides
+# JAX_PLATFORMS env vars — tests must force the config back to the virtual
+# 8-device CPU mesh BEFORE any backend initializes.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, "virtual CPU mesh not active"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

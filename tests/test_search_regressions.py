@@ -1,0 +1,60 @@
+"""Regressions for search review findings: absent-term conjunctions,
+pure-negation top-k, per-column scorer wiring, stream-mode scores."""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+
+
+@pytest.fixture
+def conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT, title TEXT)")
+    c.execute("INSERT INTO docs VALUES "
+              "(1, 'apple pie recipe', 'cooking'),"
+              "(2, 'apple orchard tour', 'travel'),"
+              "(3, 'banana bread', 'cooking')")
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    return c
+
+
+def test_conjunction_with_absent_term_matches_nothing(conn):
+    rows = conn.execute(
+        "SELECT id, bm25(body) AS s FROM docs WHERE body @@ "
+        "'apple & zzznothere' ORDER BY s DESC LIMIT 5").rows()
+    assert rows == []
+    assert conn.execute("SELECT count(*) FROM docs WHERE body @@ "
+                        "'apple & zzznothere'").scalar() == 0
+
+
+def test_pure_negation_topk(conn):
+    rows = conn.execute(
+        "SELECT id, bm25(body) AS s FROM docs WHERE body @@ '!apple' "
+        "ORDER BY s DESC LIMIT 5").rows()
+    assert [r[0] for r in rows] == [3]
+    assert rows[0][1] == 0.0
+
+
+def test_scorer_of_other_column_not_rewired(conn):
+    rows = conn.execute(
+        "SELECT id, bm25(body) AS s, bm25(title) AS t FROM docs "
+        "WHERE body @@ 'apple' ORDER BY s DESC LIMIT 5").rows()
+    assert len(rows) == 2
+    for _, s, t in rows:
+        assert s > 0.0
+        assert t == 0.0  # title has no index/pushdown → default score
+
+
+def test_stream_mode_scores_nonzero(conn):
+    # no ORDER BY/LIMIT: scores must still be real, consistent with top-k
+    rows = dict((r[0], r[1]) for r in conn.execute(
+        "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple'").rows())
+    topk = dict((r[0], r[1]) for r in conn.execute(
+        "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple' "
+        "ORDER BY s DESC LIMIT 10").rows())
+    assert rows.keys() == topk.keys()
+    for k in rows:
+        assert rows[k] == pytest.approx(topk[k], rel=1e-5)
+        assert rows[k] > 0.0
