@@ -1,0 +1,68 @@
+"""Batch ⇄ Arrow IPC bytes (WAL payloads, parquet snapshots).
+
+Reference analog: DataChunk zstd-1 serde inside WAL INLINE ops
+(reference: server/search/search_db_wal.h:50-205). Arrow IPC gives a
+well-defined binary frame with zero-copy numeric columns; zstd applied by
+the WAL layer."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import pyarrow as pa
+
+from . import dtypes as dt
+from .column import Batch, Column
+
+
+def batch_to_arrow(batch: Batch) -> pa.RecordBatch:
+    arrays = []
+    fields = []
+    for name, col in zip(batch.names, batch.columns):
+        mask = ~col.validity if col.validity is not None else None
+        if col.type.is_string:
+            strs = col.dictionary.astype(str)[col.data] if \
+                col.dictionary is not None else col.data.astype(str)
+            arr = pa.array(strs, type=pa.string(), mask=mask)
+        elif col.type.id is dt.TypeId.TIMESTAMP:
+            arr = pa.array(col.data, type=pa.timestamp("us"), mask=mask)
+        elif col.type.id is dt.TypeId.DATE:
+            arr = pa.array(col.data, type=pa.date32(), mask=mask)
+        else:
+            arr = pa.array(col.data, mask=mask)
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def batch_to_bytes(batch: Batch) -> bytes:
+    rb = batch_to_arrow(batch)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def bytes_to_batch(data: bytes) -> Batch:
+    from ..exec.tables import _arrow_to_column
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        tbl = r.read_all()
+    names = list(tbl.schema.names)
+    cols = [_arrow_to_column(tbl.column(n)) for n in names]
+    return Batch(names, cols)
+
+
+def write_parquet_snapshot(path: str, batch: Batch) -> None:
+    import pyarrow.parquet as pq
+    rb = batch_to_arrow(batch)
+    pq.write_table(pa.Table.from_batches([rb]), path)
+
+
+def read_parquet_snapshot(path: str) -> Batch:
+    import pyarrow.parquet as pq
+    from ..exec.tables import _arrow_to_column
+    tbl = pq.read_table(path)
+    names = list(tbl.schema.names)
+    cols = [_arrow_to_column(tbl.column(n)) for n in names]
+    return Batch(names, cols)

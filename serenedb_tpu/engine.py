@@ -59,10 +59,22 @@ class SchemaObj:
         self.views: dict[str, ViewDef] = {}
 
 
+class StoredTable(MemTable):
+    """A durable columnar table: in-memory working set + WAL write-through +
+    parquet checkpoint snapshots (reference analog: a Search-engine table's
+    columnstore + SearchDbWal leg, SURVEY.md §2.6)."""
+
+    def __init__(self, name: str, batch: Batch, key: str, table_id: int):
+        super().__init__(name, batch)
+        self.key = key
+        self.table_id = table_id
+
+
 class Database(TableResolver):
     """The process-wide database: schema → tables/views. Thread-safe for
     DDL/DML via a coarse lock (fine-grained MVCC comes with the catalog
-    layer)."""
+    layer). With `path`, all DDL/DML is durable: definitions in
+    catalog.json, data as parquet snapshots + WAL delta (storage/)."""
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
@@ -71,6 +83,105 @@ class Database(TableResolver):
         # parquet providers are cached by path so repeated queries reuse the
         # provider's HBM column cache and compiled XLA programs
         self._parquet_cache: dict[str, ParquetTable] = {}
+        self.store = None
+        self.maintenance = None
+        if path is not None:
+            from .storage.store import Store
+            self.store = Store(path)
+            self._boot()
+            from .storage.maintenance import MaintenanceManager
+            self.maintenance = MaintenanceManager(self)
+            self.maintenance.start()
+
+    def close(self):
+        if self.maintenance is not None:
+            self.maintenance.stop()
+        if self.store is not None:
+            self.store.release()
+
+    # -- boot / recovery ---------------------------------------------------
+
+    def _boot(self):
+        """Load definitions, table snapshots, then WAL delta replay
+        (reference startup order: store → catalog → search recovery,
+        serened.cpp:133-150)."""
+        from .sql import parser as _parser
+        meta = self.store.load_meta()
+        for s in meta.get("schemas", ["main"]):
+            self.schemas.setdefault(s, SchemaObj(s))
+        for key, tdef in meta.get("tables", {}).items():
+            schema, name = key.split(".", 1)
+            names = [c["name"] for c in tdef["columns"]]
+            types = [dt.type_from_name(c["type"]) for c in tdef["columns"]]
+            batch = self.store.read_snapshot(tdef["id"], names, types)
+            t = StoredTable(name, batch, key, tdef["id"])
+            t.table_meta = {
+                "engine": tdef.get("engine", "columnar"),
+                "primary_key": tdef.get("primary_key", []),
+                "not_null": tdef.get("not_null", []),
+                "defaults": {},
+                "tokenizers": tdef.get("tokenizers", {}),
+                "options": tdef.get("options", {}),
+            }
+            self.schemas[schema].tables[name.lower()] = t
+        for key, vdef in meta.get("views", {}).items():
+            schema, name = key.split(".", 1)
+            import base64
+            import pickle
+            q = pickle.loads(base64.b64decode(vdef["ast_b64"]))
+            self.schemas[schema].views[name.lower()] = ViewDef(name, q, "")
+
+        def committed_of(key: str) -> int:
+            tdef = meta.get("tables", {}).get(key)
+            if tdef is None:
+                return 1 << 62  # dropped table: skip its records
+            return tdef.get("checkpoint_tick", 0)
+
+        max_tick = self.store.wal.recover(committed_of, self._apply_wal_op)
+        # checkpoint cursors can be ahead of every surviving WAL record
+        # (post-GC); ticks must never restart below them or fresh commits
+        # would be skipped by a later delta replay
+        cursor_ticks = [t.get("checkpoint_tick", 0)
+                        for t in meta.get("tables", {}).values()]
+        self.store.ticks.advance_to(max(max_tick, *cursor_ticks)
+                                    if cursor_ticks else max_tick)
+        # rebuild persisted index definitions (backfill from recovered data)
+        from .search.index import build_index_for_table
+        for idx_name, idef in meta.get("indexes", {}).items():
+            t = self._table_by_key(idef["table"])
+            if t is None:
+                continue
+            if not hasattr(t, "indexes"):
+                t.indexes = {}
+            try:
+                t.indexes[idx_name] = build_index_for_table(
+                    t, idef["columns"], idef["using"], idef["options"])
+            except errors.SqlError:
+                log.warn("boot", f"index {idx_name} rebuild failed")
+
+    def _table_by_key(self, key: str):
+        schema, name = key.split(".", 1)
+        s = self.schemas.get(schema)
+        return s.tables.get(name.lower()) if s else None
+
+    def _apply_wal_op(self, tick: int, op) -> None:
+        t = self._table_by_key(op.table)
+        if t is None:
+            return
+        if op.kind == "insert":
+            _append_rows(t, op.batch)
+        elif op.kind == "delete":
+            full = t.full_batch()
+            mask = np.ones(full.num_rows, dtype=bool)
+            rows = op.rows[op.rows < full.num_rows]
+            mask[rows] = False
+            t.replace(full.filter(mask))
+        elif op.kind == "truncate":
+            t.replace(t.full_batch().slice(0, 0))
+
+    def _persist_catalog(self):
+        if self.store is not None:
+            self.store.save_meta()
 
     # -- resolution (TableResolver) ---------------------------------------
 
@@ -273,16 +384,65 @@ class Connection:
             return self._create_table(st, params)
         if isinstance(st, ast.CreateSchema):
             self.db.create_schema(st.name, st.if_not_exists)
+            if self.db.store is not None:
+                self.db.store.update_meta(
+                    lambda m: None if st.name in m["schemas"]
+                    else m["schemas"].append(st.name))
             return QueryResult(Batch([], []), "CREATE SCHEMA")
         if isinstance(st, ast.CreateView):
             schema, name = self.db._split(st.name)
             self.db.create_view(schema, name,
                                 ViewDef(name, st.query, ""), st.or_replace)
+            if self.db.store is not None:
+                import base64
+                import pickle
+                blob = base64.b64encode(pickle.dumps(st.query)).decode()
+                self.db.store.update_meta(
+                    lambda m: m["views"].__setitem__(
+                        f"{schema}.{name.lower()}", {"ast_b64": blob}))
             return QueryResult(Batch([], []), "CREATE VIEW")
         if isinstance(st, ast.CreateIndex):
             return self._create_index(st)
         if isinstance(st, ast.Drop):
             self.db.drop(st.kind, st.name, st.if_exists, st.cascade)
+            if self.db.store is not None:
+                schema, name = self.db._split(st.name)
+                key = f"{schema}.{name.lower()}"
+                store = self.db.store
+
+                def mutate(meta):
+                    if st.kind == "table" and key in meta["tables"]:
+                        dropped_ids.append(meta["tables"][key]["id"])
+                        del meta["tables"][key]
+                        meta["indexes"] = {k: v for k, v in
+                                           meta["indexes"].items()
+                                           if v["table"] != key}
+                    elif st.kind == "view":
+                        meta["views"].pop(key, None)
+                    elif st.kind == "schema":
+                        target = st.name[-1]
+                        if target in meta["schemas"]:
+                            meta["schemas"].remove(target)
+                        # cascade: purge the schema's persisted objects too,
+                        # or the datadir becomes unopenable on restart
+                        prefix = f"{target}."
+                        for k in [k for k in meta["tables"]
+                                  if k.startswith(prefix)]:
+                            dropped_ids.append(meta["tables"][k]["id"])
+                            del meta["tables"][k]
+                        for k in [k for k in meta["views"]
+                                  if k.startswith(prefix)]:
+                            del meta["views"][k]
+                        meta["indexes"] = {
+                            k: v for k, v in meta["indexes"].items()
+                            if not v["table"].startswith(prefix)}
+                    elif st.kind == "index":
+                        meta["indexes"].pop(st.name[-1], None)
+
+                dropped_ids: list[int] = []
+                store.update_meta(mutate)
+                for tid in dropped_ids:
+                    store.drop_snapshot(tid)
             return QueryResult(Batch([], []), f"DROP {st.kind.upper()}")
         if isinstance(st, ast.Insert):
             return self._insert(st, params)
@@ -328,7 +488,6 @@ class Connection:
         schema, name = self.db._split(st.name)
         if st.as_query is not None:
             batch = self._run_select(st.as_query, params)
-            provider = MemTable(name, batch)
         else:
             cols = []
             names = []
@@ -338,7 +497,13 @@ class Connection:
                 cols.append(Column(t, np.empty(0, dtype=t.np_dtype), None,
                                    np.empty(0, dtype=object)
                                    if t.is_string else None))
-            provider = MemTable(name, Batch(names, cols))
+            batch = Batch(names, cols)
+        key = f"{schema}.{name.lower()}"
+        if self.db.store is not None:
+            table_id = self.db.store.new_table_id()
+            provider: MemTable = StoredTable(name, batch, key, table_id)
+        else:
+            provider = MemTable(name, batch)
         provider.table_meta = {
             "engine": st.engine,
             "primary_key": st.primary_key,
@@ -350,6 +515,16 @@ class Connection:
         }
         created = self.db.create_table(schema, name, provider,
                                        st.if_not_exists)
+        if created and self.db.store is not None:
+            from .storage.store import table_def
+            start_tick = self.db.store.ticks.current()
+            tdef = table_def(key, provider.table_id, provider.column_names,
+                             provider.column_types, provider.table_meta,
+                             start_tick)
+            if batch.num_rows:
+                self.db.store.write_snapshot(provider.table_id, batch)
+            self.db.store.update_meta(
+                lambda m: m["tables"].__setitem__(key, tdef))
         if st.as_query is not None and created:
             return QueryResult(Batch([], []),
                                f"SELECT {provider.row_count()}")
@@ -363,6 +538,11 @@ class Connection:
         from .search.index import build_index_for_table
         provider.indexes[idx_name] = build_index_for_table(
             provider, st.columns, st.using, st.options)
+        if self.db.store is not None and isinstance(provider, StoredTable):
+            idef = {"table": provider.key, "columns": list(st.columns),
+                    "using": st.using, "options": dict(st.options)}
+            self.db.store.update_meta(
+                lambda m: m["indexes"].__setitem__(idx_name, idef))
         return QueryResult(Batch([], []), "CREATE INDEX")
 
     def _table_for_dml(self, parts: list[str]) -> MemTable:
@@ -404,19 +584,26 @@ class Connection:
         with self.db.lock:
             full = table.full_batch()
             if st.where is None:
-                n = full.num_rows
-                table.replace(full.slice(0, 0))
-                return QueryResult(Batch([], []), f"DELETE {n}")
-            scope = Scope.of(list(full.names), [c.type for c in full.columns],
-                             st.table[-1])
-            pred = ExprBinder(scope, params).bind(st.where)
-            c = pred.eval(full)
-            mask = c.data.astype(bool) & c.valid_mask()
-            n = int(mask.sum())
-            table.replace(full.filter(~mask))
+                rows = np.arange(full.num_rows, dtype=np.int64)
+            else:
+                scope = Scope.of(list(full.names),
+                                 [c.type for c in full.columns],
+                                 st.table[-1])
+                pred = ExprBinder(scope, params).bind(st.where)
+                c = pred.eval(full)
+                rows = np.flatnonzero(c.data.astype(bool) & c.valid_mask())
+            n = len(rows)
+            self._wal_commit(table, [("delete", None, rows)])
+            mask = np.ones(full.num_rows, dtype=bool)
+            mask[rows] = False
+            table.replace(full.filter(mask))
         return QueryResult(Batch([], []), f"DELETE {n}")
 
     def _update(self, st: ast.Update, params: list) -> QueryResult:
+        """UPDATE = delete + re-append of the affected rows (matching the
+        WAL replay transformation exactly, so recovered row order equals
+        live row order — the reference does the same remove+insert in its
+        search DML, duckdb_physical_search_update.*)."""
         table = self._table_for_dml(st.table)
         with self.db.lock:
             full = table.full_batch()
@@ -428,7 +615,11 @@ class Connection:
                 mask = c.data.astype(bool) & c.valid_mask()
             else:
                 mask = np.ones(full.num_rows, dtype=bool)
-            n = int(mask.sum())
+            rows = np.flatnonzero(mask)
+            n = len(rows)
+            if n == 0:
+                return QueryResult(Batch([], []), "UPDATE 0")
+            updated = full.take(rows)
             new_cols = {}
             for col_name, e in st.assignments:
                 if col_name not in full:
@@ -436,19 +627,22 @@ class Connection:
                                           f'column "{col_name}" does not exist')
                 target_t = full.column(col_name).type
                 val = _coerce(binder.bind(e).eval(full), target_t)
-                cur = full.column(col_name)
-                merged_vals = [
-                    val.decode(i) if mask[i] else cur.decode(i)
-                    for i in range(full.num_rows)]
-                new_cols[col_name] = Column.from_pylist(merged_vals, target_t)
-            cols = [new_cols.get(nm, c)
-                    for nm, c in zip(full.names, full.columns)]
-            table.replace(Batch(list(full.names), cols))
+                new_cols[col_name] = val.take(rows)
+            upd_cols = [new_cols.get(nm, c)
+                        for nm, c in zip(updated.names, updated.columns)]
+            updated = Batch(list(updated.names), upd_cols)
+            self._wal_commit(table, [("delete", None, rows),
+                                     ("insert", updated, None)])
+            mask_keep = np.ones(full.num_rows, dtype=bool)
+            mask_keep[rows] = False
+            table.replace(full.filter(mask_keep))
+            _append_rows(table, updated)
         return QueryResult(Batch([], []), f"UPDATE {n}")
 
     def _truncate(self, st: ast.Truncate) -> QueryResult:
         table = self._table_for_dml(st.table)
         with self.db.lock:
+            self._wal_commit(table, [("truncate", None, None)])
             table.replace(table.full_batch().slice(0, 0))
         return QueryResult(Batch([], []), "TRUNCATE TABLE")
 
@@ -502,6 +696,29 @@ class Connection:
         return QueryResult(b, f"SELECT {len(lines)}")
 
     def _vacuum(self, st: ast.VacuumStmt) -> QueryResult:
+        """VACUUM verbs (reference: SearchTable VACUUM refresh/compact/
+        cleanup ops): checkpoint = snapshot + WAL GC; refresh = rebuild
+        stale search indexes now."""
+        targets: list[MemTable] = []
+        if st.table is not None:
+            t = self.db.resolve_table(st.table)
+            if isinstance(t, MemTable):
+                targets.append(t)
+        else:
+            with self.db.lock:
+                for s in self.db.schemas.values():
+                    targets.extend(t for t in s.tables.values()
+                                   if isinstance(t, MemTable))
+        verbs = set(st.verbs) or {"refresh"}
+        for t in targets:
+            if isinstance(t, StoredTable) and self.db.store is not None:
+                with self.db.lock:  # batch+tick must be captured atomically
+                    batch = t.full_batch()
+                    tick = self.db.store.ticks.current()
+                self.db.store.checkpoint_table(t.key, t.table_id, batch,
+                                               tick)
+            if verbs & {"refresh", "full"}:
+                _refresh_indexes(self.db, t)
         return QueryResult(Batch([], []), "VACUUM")
 
     def _copy(self, st: ast.CopyStmt, params: list) -> QueryResult:
@@ -530,18 +747,53 @@ class Connection:
 
     def _insert_batch(self, table: MemTable, incoming: Batch):
         with self.db.lock:
-            current = table.full_batch()
-            new_cols = []
-            for name, cur in zip(table.column_names, current.columns):
-                if name in incoming.names:
-                    add = _coerce(incoming.column(name), cur.type)
-                else:
-                    add = Column.from_pylist([None] * incoming.num_rows,
-                                             cur.type)
-                merged = concat_batches(
-                    [Batch([name], [cur]), Batch([name], [add])]).columns[0]
-                new_cols.append(merged)
-            table.replace(Batch(list(table.column_names), new_cols))
+            aligned = _align_to_schema(table, incoming)
+            self._wal_commit(table, [("insert", aligned, None)])
+            _append_rows(table, aligned)
+
+    def _wal_commit(self, table: MemTable, ops: list[tuple]):
+        """Durably log (kind, batch, rows) ops for a stored table before the
+        in-memory publish (WAL-then-apply, reference §3.4)."""
+        if self.db.store is None or not isinstance(table, StoredTable):
+            return
+        from .storage.wal import WalOp
+        wal_ops = [WalOp(table.key, kind, batch, rows)
+                   for kind, batch, rows in ops]
+        self.db.store.commit(wal_ops)
+
+
+def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:
+    """Project incoming rows onto the table schema: coerce types, fill
+    missing columns with NULL. The aligned batch is what goes to the WAL, so
+    replay needs no re-coercion."""
+    cols = []
+    for name, t in zip(table.column_names, table.column_types):
+        if name in incoming.names:
+            cols.append(_coerce(incoming.column(name), t))
+        else:
+            cols.append(Column.from_pylist([None] * incoming.num_rows, t))
+    return Batch(list(table.column_names), cols)
+
+
+def _append_rows(table: MemTable, aligned: Batch) -> None:
+    current = table.full_batch()
+    new_cols = []
+    for i, name in enumerate(table.column_names):
+        merged = concat_batches(
+            [Batch([name], [current.columns[i]]),
+             Batch([name], [aligned.columns[i]])]).columns[0]
+        new_cols.append(merged)
+    table.replace(Batch(list(table.column_names), new_cols))
+
+
+def _refresh_indexes(db: Database, table: MemTable) -> None:
+    """Rebuild any index whose data_version is stale (the refresh leg of the
+    reference's RefreshLoop, task.cpp:237-343)."""
+    from .search.index import build_index_for_table
+    for name, idx in list(getattr(table, "indexes", {}).items()):
+        if idx.data_version != table.data_version:
+            table.indexes[name] = build_index_for_table(
+                table, idx.columns, idx.using, idx.options)
 
 
 def _coerce(col: Column, target: dt.SqlType) -> Column:

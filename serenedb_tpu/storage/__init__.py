@@ -1,0 +1,6 @@
+"""Durability layer: WAL, store (snapshots + catalog persistence), and
+background maintenance loops."""
+
+from . import maintenance, store, wal
+
+__all__ = ["maintenance", "store", "wal"]

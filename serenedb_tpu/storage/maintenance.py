@@ -1,0 +1,130 @@
+"""Background maintenance: refresh + checkpoint loops.
+
+Reference analog: per-target RefreshLoop + CompactionCoordinator coroutines
+on the background pool, with a global compaction-slot semaphore
+max(1, min(4, cores/2)) and idle backoff ×1.5 up to 5× (reference:
+server/storage_engine/search_engine.h:46-123, server/search/task.cpp:85-380).
+
+Here: a refresh thread rebuilds stale search indexes (publish = atomic dict
+swap), and a checkpoint thread snapshots dirty stored tables so WAL segments
+can be garbage-collected. Heavy rebuilds take a global slot, mirroring the
+compaction cap. `run_once()` gives tests a deterministic handle."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils import log, metrics
+
+MAX_SLOTS = max(1, min(4, (os.cpu_count() or 2) // 2))
+
+
+class MaintenanceManager:
+    def __init__(self, db, refresh_interval: float = 0.25,
+                 checkpoint_interval: float = 30.0,
+                 checkpoint_wal_bytes: int = 8 << 20):
+        self.db = db
+        self.refresh_interval = refresh_interval
+        self.checkpoint_interval = checkpoint_interval
+        self.checkpoint_wal_bytes = checkpoint_wal_bytes
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._slots = threading.Semaphore(MAX_SLOTS)
+        self._checkpointed_version: dict[str, int] = {}
+        self._last_checkpoint = time.monotonic()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        t = threading.Thread(target=self._loop, name="serene-maintenance",
+                             daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def stop(self):
+        """Join loops before teardown (the reference's stop protocol joins
+        search loops before the pool dies, serened.cpp:86-130)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    # -- loops -------------------------------------------------------------
+
+    def _loop(self):
+        idle = self.refresh_interval
+        while not self._stop.is_set():
+            did_work = False
+            try:
+                did_work = self.run_once()
+            except Exception as e:  # maintenance must never die
+                log.error("maintenance", f"loop error: {e!r}")
+            if did_work:
+                idle = self.refresh_interval
+            else:
+                # idle stretch ×1.5 capped at 5× (reference task.cpp:85-95)
+                idle = min(idle * 1.5, self.refresh_interval * 5)
+            self._stop.wait(idle)
+
+    def run_once(self) -> bool:
+        """One maintenance pass; returns True if any work was done."""
+        did = self._refresh_pass()
+        did = self._checkpoint_pass() or did
+        return did
+
+    def _refresh_pass(self) -> bool:
+        from ..engine import _refresh_indexes
+        did = False
+        with self.db.lock:
+            tables = [t for s in self.db.schemas.values()
+                      for t in s.tables.values()]
+        for t in tables:
+            idxs = getattr(t, "indexes", {})
+            if any(ix.data_version != t.data_version
+                   for ix in idxs.values()):
+                with self._slots:
+                    with metrics.REFRESH_ACTIVE.scoped():
+                        _refresh_indexes(self.db, t)
+                did = True
+        return did
+
+    def _checkpoint_pass(self) -> bool:
+        store = self.db.store
+        if store is None:
+            return False
+        due = (time.monotonic() - self._last_checkpoint
+               >= self.checkpoint_interval) or \
+            self._wal_bytes() >= self.checkpoint_wal_bytes
+        if not due:
+            return False
+        from ..engine import StoredTable
+        did = False
+        with self.db.lock:
+            tables = [t for s in self.db.schemas.values()
+                      for t in s.tables.values()
+                      if isinstance(t, StoredTable)]
+        for t in tables:
+            if self._checkpointed_version.get(t.key) == t.data_version:
+                continue
+            with self.db.lock:  # batch + tick captured atomically vs DML
+                batch = t.full_batch()
+                version = t.data_version
+                tick = store.ticks.current()
+            with metrics.COMPACTION_ACTIVE.scoped():
+                store.checkpoint_table(t.key, t.table_id, batch, tick)
+            self._checkpointed_version[t.key] = version
+            did = True
+        self._last_checkpoint = time.monotonic()
+        return did
+
+    def _wal_bytes(self) -> int:
+        store = self.db.store
+        total = 0
+        try:
+            for name in os.listdir(store.wal.dir):
+                if name.endswith(".wal"):
+                    total += os.path.getsize(os.path.join(store.wal.dir, name))
+        except OSError:
+            pass
+        return total

@@ -1,0 +1,194 @@
+"""Durable store: data directory, catalog persistence, table snapshots.
+
+Reference analog: server/catalog/store/ (definitions persisted transactional
+via the __sdb_store DuckDB file; SURVEY.md §2.4) + the checkpoint/WAL split
+of §5.4: two durability domains — (1) catalog + table *snapshots*
+(parquet files + an atomically-replaced catalog.json), and (2) the
+per-database WAL (storage/wal.py) holding everything since each table's
+checkpoint tick. Recovery = snapshots + delta replay.
+
+Layout:
+    <datadir>/catalog.json        definitions + per-table checkpoint ticks
+    <datadir>/tables/<id>.parquet table snapshots (written at checkpoint)
+    <datadir>/wal/*.wal           commit records since the checkpoints
+    <datadir>/LOCK                single-process lockfile
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.arrow_io import (read_parquet_snapshot,
+                                 write_parquet_snapshot)
+from ..columnar.column import Batch, Column
+from ..utils import faults, log
+from ..utils.ticks import TickServer
+from .wal import CommitRecord, SearchDbWal, WalOp
+
+
+class Store:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        os.makedirs(os.path.join(path, "tables"), exist_ok=True)
+        self._lockfile = os.path.join(path, "LOCK")
+        self._acquire_lock()
+        self.catalog_path = os.path.join(path, "catalog.json")
+        self.wal = SearchDbWal(os.path.join(path, "wal"))
+        self.ticks = TickServer()
+        # RLock: meta mutations + save_meta happen from connection threads
+        # AND the maintenance thread; all must serialize on this lock
+        self._lock = threading.RLock()
+        self.meta: dict = {"next_table_id": 1, "schemas": ["main"],
+                           "tables": {}, "views": {}, "indexes": {}}
+
+    def _acquire_lock(self):
+        # datadir lockfile (reference: libs/basics lockfile)
+        if os.path.exists(self._lockfile):
+            try:
+                pid = int(open(self._lockfile).read().strip() or 0)
+            except ValueError:
+                pid = 0
+            if pid and _pid_alive(pid):
+                raise errors.SqlError(
+                    "55000", f"data directory {self.path} is locked by "
+                             f"running process {pid}")
+        with open(self._lockfile, "w") as f:
+            f.write(str(os.getpid()))
+
+    def release(self):
+        self.wal.close()
+        try:
+            os.remove(self._lockfile)
+        except OSError:
+            pass
+
+    # -- catalog persistence ------------------------------------------------
+
+    def load_meta(self) -> dict:
+        if os.path.exists(self.catalog_path):
+            with open(self.catalog_path) as f:
+                self.meta = json.load(f)
+        return self.meta
+
+    def save_meta(self) -> None:
+        """Atomic catalog write: tmp + fsync + rename (the definitions
+        equivalent of the reference's transactional WriteContext batches)."""
+        faults.if_failure("catalog_write_error")
+        with self._lock:
+            tmp = self.catalog_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.meta, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.catalog_path)
+        faults.crash_if_armed("crash_after_catalog_write")
+
+    def update_meta(self, mutator) -> None:
+        """Serialize a meta mutation + save against concurrent writers
+        (connection DDL vs. the maintenance checkpoint thread)."""
+        with self._lock:
+            mutator(self.meta)
+            self.save_meta()
+
+    def new_table_id(self) -> int:
+        with self._lock:
+            tid = self.meta["next_table_id"]
+            self.meta["next_table_id"] = tid + 1
+            return tid
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot_path(self, table_id: int) -> str:
+        return os.path.join(self.path, "tables", f"{table_id}.parquet")
+
+    def write_snapshot(self, table_id: int, batch: Batch) -> None:
+        path = self.snapshot_path(table_id)
+        tmp = path + ".tmp"
+        write_parquet_snapshot(tmp, batch)
+        os.replace(tmp, path)
+
+    def read_snapshot(self, table_id: int,
+                      names: list[str],
+                      types: list[dt.SqlType]) -> Batch:
+        path = self.snapshot_path(table_id)
+        if not os.path.exists(path):
+            from ..exec.plan import empty_batch
+            return empty_batch(names, types)
+        return read_parquet_snapshot(path)
+
+    def drop_snapshot(self, table_id: int) -> None:
+        try:
+            os.remove(self.snapshot_path(table_id))
+        except OSError:
+            pass
+
+    # -- commit / checkpoint --------------------------------------------------
+
+    def commit(self, ops: list[WalOp]) -> int:
+        """Durably log one commit; returns its tick. The caller applies the
+        ops to memory AFTER this returns (WAL-then-publish, §3.4)."""
+        tick = self.ticks.next()
+        self.wal.append_commit(CommitRecord(tick, ops))
+        return tick
+
+    def checkpoint_table(self, key: str, table_id: int, batch: Batch,
+                         tick: int) -> None:
+        """Snapshot a table and advance its checkpoint cursor to `tick`.
+        The caller must capture (batch, tick) atomically under the database
+        DML lock — a tick read after the batch would let a concurrent commit
+        land in the gap and be skipped on recovery. Sealed WAL segments
+        below the min cursor become garbage."""
+        self.write_snapshot(table_id, batch)
+        with self._lock:
+            entry = self.meta["tables"].get(key)
+            if entry is not None:
+                entry["checkpoint_tick"] = tick
+            self.save_meta()
+        self.gc()
+
+    def gc(self) -> int:
+        with self._lock:
+            ticks = [t.get("checkpoint_tick", 0)
+                     for t in self.meta["tables"].values()]
+        if not ticks:
+            return 0
+        return self.wal.gc(min(ticks))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def serialize_type(t: dt.SqlType) -> str:
+    return t.id.value
+
+
+def table_def(name_key: str, table_id: int, names: list[str],
+              types: list[dt.SqlType], meta: dict, start_tick: int) -> dict:
+    """start_tick must be the store's current tick at creation: a freshly
+    created table must never replay WAL records of an earlier same-named
+    (dropped) table."""
+    return {
+        "id": table_id,
+        "columns": [{"name": n, "type": serialize_type(t)}
+                    for n, t in zip(names, types)],
+        "engine": meta.get("engine", "columnar"),
+        "options": meta.get("options", {}),
+        "primary_key": meta.get("primary_key", []),
+        "not_null": meta.get("not_null", []),
+        "tokenizers": meta.get("tokenizers", {}),
+        "checkpoint_tick": start_tick,
+    }

@@ -1,0 +1,249 @@
+"""Per-database write-ahead log with tick-banded commits and delta replay.
+
+Reference analog: SearchDbWal — ONE WAL per database shared by all tables so
+cross-table commits are atomic; tick-banded records; zstd-compressed inline
+chunks; 16 MB segment seal; GC by min committed tick; delta replay on boot
+(reference: server/search/search_db_wal.h:50-205, .cpp, SURVEY.md §3.4/§3.5).
+
+Record frame: [u32 len][u32 crc32(payload)][payload]; payload is a zstd-1
+compressed msgpack-less JSON header + arrow-IPC chunk blobs:
+
+    {tick, ops: [{table, kind: insert|delete|truncate, ...}]}
+
+Commit protocol (mirrors SearchTableTransaction::Commit,
+search_table_transaction.cpp:117-211):
+    1. fault point  crash_before_search_wal_commit
+    2. append record, flush, fsync          ← durability point
+    3. fault point  crash_after_search_wal_commit
+    4. apply to in-memory tables (memory-only publish)
+Recovery replays records with tick > the table's checkpointed tick.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+import zstandard
+
+from .. import errors
+from ..columnar.arrow_io import batch_to_bytes, bytes_to_batch
+from ..columnar.column import Batch
+from ..utils import faults, log, metrics
+
+SEGMENT_SEAL_BYTES = 16 << 20   # reference: 16 MB segment seal
+_HDR = struct.Struct("<II")
+
+
+@dataclass
+class WalOp:
+    table: str
+    kind: str                       # insert | delete | truncate
+    batch: Optional[Batch] = None   # insert payload
+    rows: Optional[np.ndarray] = None  # delete: row keys (engine-defined)
+
+
+@dataclass
+class CommitRecord:
+    tick: int
+    ops: list[WalOp]
+
+
+def _encode_record(rec: CommitRecord) -> bytes:
+    header = {"tick": rec.tick, "ops": []}
+    blobs: list[bytes] = []
+    for op in rec.ops:
+        entry = {"table": op.table, "kind": op.kind}
+        if op.batch is not None:
+            blob = batch_to_bytes(op.batch)
+            entry["blob"] = len(blobs)
+            blobs.append(blob)
+        if op.rows is not None:
+            entry["rows"] = np.asarray(op.rows, dtype=np.int64).tolist()
+        header["ops"].append(entry)
+    hj = json.dumps(header).encode()
+    parts = [struct.pack("<I", len(hj)), hj,
+             struct.pack("<I", len(blobs))]
+    for b in blobs:
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    raw = b"".join(parts)
+    return zstandard.ZstdCompressor(level=1).compress(raw)
+
+
+def _decode_record(payload: bytes) -> CommitRecord:
+    raw = zstandard.ZstdDecompressor().decompress(payload)
+    off = 0
+    (hlen,) = struct.unpack_from("<I", raw, off)
+    off += 4
+    header = json.loads(raw[off:off + hlen].decode())
+    off += hlen
+    (nblobs,) = struct.unpack_from("<I", raw, off)
+    off += 4
+    blobs = []
+    for _ in range(nblobs):
+        (blen,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        blobs.append(raw[off:off + blen])
+        off += blen
+    ops = []
+    for entry in header["ops"]:
+        batch = bytes_to_batch(blobs[entry["blob"]]) \
+            if "blob" in entry else None
+        rows = np.asarray(entry["rows"], dtype=np.int64) \
+            if "rows" in entry else None
+        ops.append(WalOp(entry["table"], entry["kind"], batch, rows))
+    return CommitRecord(header["tick"], ops)
+
+
+class SearchDbWal:
+    """Append-only segmented WAL for one database directory."""
+
+    def __init__(self, wal_dir: str):
+        self.dir = wal_dir
+        os.makedirs(wal_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._gen = 0
+        self._bytes = 0
+        # per-segment max tick, maintained on append so GC doesn't re-read
+        # sealed segments; lazily scanned for segments found at boot
+        self._seg_max_tick: dict[int, int] = {}
+        gens = self._generations()
+        self._gen = (gens[-1] if gens else 0)
+
+    # -- segment files -----------------------------------------------------
+
+    def _seg_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"{gen:012d}.wal")
+
+    def _generations(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.endswith(".wal"):
+                try:
+                    out.append(int(name[:-4]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _open_for_append(self):
+        if self._fh is None:
+            path = self._seg_path(self._gen)
+            self._fh = open(path, "ab")
+            self._bytes = self._fh.tell()
+
+    def _seal_if_needed(self):
+        if self._bytes >= SEGMENT_SEAL_BYTES:
+            self._fh.close()
+            self._fh = None
+            self._gen += 1
+            self._open_for_append()
+
+    # -- commit ------------------------------------------------------------
+
+    def append_commit(self, rec: CommitRecord) -> None:
+        """Durably append one commit record (fsync before returning)."""
+        faults.if_failure("search_wal_append_error")
+        faults.crash_if_armed("crash_before_search_wal_commit")
+        payload = _encode_record(rec)
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._open_for_append()
+            self._fh.write(frame)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._bytes += len(frame)
+            self._seg_max_tick[self._gen] = max(
+                self._seg_max_tick.get(self._gen, 0), rec.tick)
+            self._seal_if_needed()
+        metrics.WAL_COMMITS.add()
+        faults.crash_if_armed("crash_after_search_wal_commit")
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self, committed_of: Callable[[str], int],
+                apply_op: Callable[[int, WalOp], None]) -> int:
+        """Delta replay: for every record, ops whose table's committed tick
+        is below the record tick are re-applied (reference:
+        SearchDbWal::Recover, search_db_wal.h:175-179). A torn/corrupt frame
+        in the LAST segment is the uncommitted tail: it is truncated away so
+        later appends never land behind garbage (which would make them
+        unreachable on the next recovery). Corruption in an earlier, sealed
+        segment aborts replay loudly. Returns the highest tick seen."""
+        max_tick = 0
+        gens = self._generations()
+        for gi, gen in enumerate(gens):
+            path = self._seg_path(gen)
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            seg_max = 0
+            while off + _HDR.size <= len(data):
+                ln, crc = _HDR.unpack_from(data, off)
+                start = off + _HDR.size
+                end = start + ln
+                torn = end > len(data)
+                if not torn:
+                    payload = data[start:end]
+                    torn = zlib.crc32(payload) != crc
+                if torn:
+                    if gi != len(gens) - 1:
+                        raise errors.SqlError(
+                            "58030",
+                            f"WAL corruption in sealed segment {path}")
+                    log.warn("wal", f"torn tail in {path}: truncating at "
+                                    f"{off}")
+                    with open(path, "r+b") as f:
+                        f.truncate(off)
+                    self._seg_max_tick[gen] = seg_max
+                    return max_tick
+                rec = _decode_record(payload)
+                max_tick = max(max_tick, rec.tick)
+                seg_max = max(seg_max, rec.tick)
+                for op in rec.ops:
+                    if committed_of(op.table) < rec.tick:
+                        apply_op(rec.tick, op)
+                off = end
+            # trailing partial header bytes (fewer than a frame header)
+            if off < len(data):
+                if gi != len(gens) - 1:
+                    raise errors.SqlError(
+                        "58030", f"WAL corruption in sealed segment {path}")
+                log.warn("wal", f"partial tail header in {path}: truncating")
+                with open(path, "r+b") as f:
+                    f.truncate(off)
+            self._seg_max_tick[gen] = seg_max
+        return max_tick
+
+    # -- GC ----------------------------------------------------------------
+
+    def gc(self, min_committed_tick: int) -> int:
+        """Drop sealed segments whose every record tick ≤ min committed tick
+        across tables. Returns number of segments removed. Uses the
+        in-memory per-segment max-tick map (maintained on append / replay)
+        instead of re-reading segment contents."""
+        removed = 0
+        with self._lock:
+            gens = self._generations()
+            for gen in gens[:-1] if self._fh else gens:  # never the open one
+                max_tick = self._seg_max_tick.get(gen)
+                if max_tick is not None and max_tick <= min_committed_tick:
+                    os.remove(self._seg_path(gen))
+                    self._seg_max_tick.pop(gen, None)
+                    removed += 1
+                else:
+                    break
+        return removed
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
