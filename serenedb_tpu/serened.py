@@ -1,0 +1,62 @@
+"""serened — the server process entry point.
+
+Reference analog: server/rest_server/serened.cpp (flag parsing, engine boot,
+listener bring-up, signal-driven shutdown with ordered teardown;
+SURVEY.md §3.1).
+
+    python -m serenedb_tpu.serened <datadir> \
+        --pg-port 5432 --http-port 9200 [--password secret]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from .engine import Database
+from .server.http_server import HttpServer
+from .server.pgwire import PgServer
+from .utils import log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="serened")
+    ap.add_argument("datadir", nargs="?", default=None,
+                    help="data directory (omit for in-memory)")
+    ap.add_argument("--pg-port", type=int, default=5432)
+    ap.add_argument("--http-port", type=int, default=9200)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--password", default=None)
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    log.MANAGER.stdout = True
+    db = Database(args.datadir)
+    http = HttpServer(db, args.host, args.http_port)
+    http.start()
+    pg = PgServer(db, args.host, args.pg_port, args.password)
+
+    async def run():
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await pg.start()
+        print(f"serened ready: pg={pg.port} http={http.port}",
+              flush=True)
+        await stop.wait()
+        # teardown order mirrors the reference: listeners → loops → store
+        await pg.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        http.stop()
+        db.close()
+        log.info("serened", "shutdown complete")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

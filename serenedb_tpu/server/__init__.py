@@ -1,0 +1,5 @@
+from .es_api import EsApi
+from .http_server import HttpServer
+from .pgwire import PgServer
+
+__all__ = ["EsApi", "HttpServer", "PgServer"]

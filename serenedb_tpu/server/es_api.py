@@ -1,0 +1,482 @@
+"""Elasticsearch-compatible REST API.
+
+Reference analog: server/network/http/es/ — `_bulk`, `_doc`, `_search`
+(+DSL→engine translation), `_count`, `_cat/*`, `_cluster/*`, `_mapping`,
+`_refresh` (handlers.cpp:1383-1458, dsl.cpp; SURVEY.md §2.2).
+
+Model: an ES index is a table whose columns grow dynamically from indexed
+documents (`_id` TEXT + `_source` TEXT + one column per scalar field);
+text fields get inverted indexes and the DSL translates onto the engine's
+search surface (match → `@@` OR-query, match_phrase → `##`, bool →
+AND/OR/NOT, range/term → SQL predicates) with BM25 scores.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..engine import Connection, Database, MemTable, StoredTable
+
+
+class EsError(Exception):
+    def __init__(self, status: int, kind: str, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.kind = kind
+        self.reason = reason
+
+    def body(self) -> dict:
+        return {"error": {"type": self.kind, "reason": self.reason},
+                "status": self.status}
+
+
+class EsApi:
+    def __init__(self, db: Database):
+        self.db = db
+        self.conn = db.connect()
+        self._lock = threading.Lock()
+
+    # -- index management --------------------------------------------------
+
+    def _table(self, index: str, create: bool = False) -> MemTable:
+        key = index.lower()
+        with self.db.lock:
+            t = self.db.schemas["main"].tables.get(key)
+        if t is None:
+            if not create:
+                raise EsError(404, "index_not_found_exception",
+                              f"no such index [{index}]")
+            self.create_index(index)
+            with self.db.lock:
+                t = self.db.schemas["main"].tables.get(key)
+        return t
+
+    def create_index(self, index: str, body: Optional[dict] = None) -> dict:
+        if not re.match(r"^[a-z][a-z0-9_\-]*$", index):
+            raise EsError(400, "invalid_index_name_exception",
+                          f"invalid index name [{index}]")
+        with self._lock:
+            try:
+                self.conn.execute(
+                    f'CREATE TABLE "{index}" ("_id" TEXT, "_source" TEXT)')
+            except errors.SqlError as e:
+                if e.sqlstate == errors.DUPLICATE_TABLE:
+                    raise EsError(400, "resource_already_exists_exception",
+                                  f"index [{index}] already exists")
+                raise
+            props = ((body or {}).get("mappings", {}) or {}) \
+                .get("properties", {}) or {}
+            t = self._table(index)
+            for fname, fdef in props.items():
+                ftype = (fdef or {}).get("type", "text")
+                self._ensure_column(t, fname, _es_type_to_sql(ftype))
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": index}
+
+    def delete_index(self, index: str) -> dict:
+        self._table(index)
+        self.conn.execute(f'DROP TABLE "{index}"')
+        return {"acknowledged": True}
+
+    def exists(self, index: str) -> bool:
+        try:
+            self._table(index)
+            return True
+        except EsError:
+            return False
+
+    def mapping(self, index: str) -> dict:
+        t = self._table(index)
+        props = {}
+        for name, typ in zip(t.column_names, t.column_types):
+            if name.startswith("_"):
+                continue
+            props[name] = {"type": _sql_type_to_es(typ)}
+        return {index: {"mappings": {"properties": props}}}
+
+    def _ensure_column(self, t: MemTable, name: str, typ: dt.SqlType):
+        if name in t.column_names:
+            return
+        with self.db.lock:
+            full = t.full_batch()
+            col = Column.from_pylist([None] * full.num_rows, typ)
+            t.replace(Batch(list(full.names) + [name],
+                            list(full.columns) + [col]))
+        if typ.is_string and not name.startswith("_"):
+            # text fields get inverted indexes so match/bm25 use the TPU
+            # scoring path (refreshed by maintenance / _refresh)
+            try:
+                self.conn.execute(
+                    f'CREATE INDEX ON "{t.name}" USING inverted ("{name}")')
+            except errors.SqlError:
+                pass
+            if isinstance(t, StoredTable) and self.db.store is not None:
+                from ..storage.store import table_def
+                key = t.key
+                tdef = table_def(key, t.table_id, t.column_names,
+                                 t.column_types, getattr(t, "table_meta", {}),
+                                 self.db.store.ticks.current())
+                self.db.store.write_snapshot(t.table_id, t.full_batch())
+                tdef["checkpoint_tick"] = self.db.store.ticks.current()
+                self.db.store.update_meta(
+                    lambda m: m["tables"].__setitem__(key, tdef))
+
+    # -- document indexing -------------------------------------------------
+
+    def index_doc(self, index: str, doc: dict,
+                  doc_id: Optional[str] = None) -> dict:
+        t = self._table(index, create=True)
+        doc_id = doc_id or _gen_id()
+        with self._lock:
+            self._delete_by_id(t, doc_id)
+            row = {"_id": doc_id, "_source": json.dumps(doc)}
+            for k, v in doc.items():
+                if isinstance(v, (dict, list)):
+                    continue  # objects/arrays live in _source only (v1)
+                self._ensure_column(t, k, _value_sql_type(v))
+                row[k] = v
+            incoming = Batch.from_pydict(
+                {name: [row.get(name)] for name in t.column_names})
+            self.conn._insert_batch(t, incoming)
+        return {"_index": index, "_id": doc_id, "result": "created",
+                "_version": 1, "_shards": {"total": 1, "successful": 1,
+                                           "failed": 0}}
+
+    def get_doc(self, index: str, doc_id: str) -> dict:
+        t = self._table(index)
+        full = t.full_batch(["_id", "_source"])
+        ids = full.column("_id").to_pylist()
+        try:
+            i = ids.index(doc_id)
+        except ValueError:
+            return {"_index": index, "_id": doc_id, "found": False}
+        return {"_index": index, "_id": doc_id, "found": True,
+                "_source": json.loads(full.column("_source").decode(i))}
+
+    def delete_doc(self, index: str, doc_id: str) -> dict:
+        t = self._table(index)
+        with self._lock:
+            n = self._delete_by_id(t, doc_id)
+        return {"_index": index, "_id": doc_id,
+                "result": "deleted" if n else "not_found"}
+
+    def _delete_by_id(self, t: MemTable, doc_id: str) -> int:
+        esc = doc_id.replace("'", "''")
+        res = self.conn.execute(
+            f'DELETE FROM "{t.name}" WHERE "_id" = \'{esc}\'')
+        return int(res.command_tag.split()[-1])
+
+    def bulk(self, body: str) -> dict:
+        lines = [ln for ln in body.split("\n") if ln.strip()]
+        items = []
+        had_errors = False
+        i = 0
+        while i < len(lines):
+            action = json.loads(lines[i])
+            i += 1
+            op = next(iter(action))
+            meta = action[op] if isinstance(action[op], dict) else {}
+            index = meta.get("_index")
+            doc_id = meta.get("_id")
+            # consume the doc line BEFORE validation so a failed item never
+            # desyncs the ndjson stream
+            doc_line = None
+            if op in ("index", "create", "update") and i < len(lines):
+                doc_line = lines[i]
+                i += 1
+            try:
+                if index is not None and \
+                        not re.match(r"^[a-z][a-z0-9_\-]*$", str(index)):
+                    raise EsError(400, "invalid_index_name_exception",
+                                  f"invalid index name [{index}]")
+                if op in ("index", "create"):
+                    doc = json.loads(doc_line)
+                    r = self.index_doc(index, doc, doc_id)
+                    items.append({op: {**r, "status": 201}})
+                elif op == "delete":
+                    r = self.delete_doc(index, doc_id)
+                    items.append({op: {**r, "status": 200}})
+                elif op == "update":
+                    body_doc = json.loads(doc_line)
+                    doc = body_doc.get("doc", {})
+                    existing = self.get_doc(index, doc_id)
+                    merged = {**existing.get("_source", {}), **doc}
+                    r = self.index_doc(index, merged, doc_id)
+                    items.append({op: {**r, "status": 200}})
+                else:
+                    raise EsError(400, "illegal_argument_exception",
+                                  f"unknown bulk op [{op}]")
+            except EsError as e:
+                had_errors = True
+                items.append({op: {"_index": index, "_id": doc_id,
+                                   "status": e.status,
+                                   "error": e.body()["error"]}})
+            except errors.SqlError as e:
+                # per-item failure, never abort a partially-applied batch
+                had_errors = True
+                items.append({op: {"_index": index, "_id": doc_id,
+                                   "status": 400,
+                                   "error": {"type": "mapper_parsing_exception",
+                                             "reason": e.message}}})
+        return {"took": 1, "errors": had_errors, "items": items}
+
+    # -- search ------------------------------------------------------------
+
+    def refresh(self, index: Optional[str] = None) -> dict:
+        self.conn.execute(f'VACUUM REFRESH "{index}"' if index
+                          else "VACUUM REFRESH")
+        return {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def count(self, index: str, body: Optional[dict] = None) -> dict:
+        self._table(index)  # 404 for unknown index, not a SQL error
+        where, _ = self._translate_query((body or {}).get("query"))
+        sql = f'SELECT count(*) FROM "{index}"'
+        if where:
+            sql += f" WHERE {where}"
+        n = self.conn.execute(sql).scalar()
+        return {"count": int(n),
+                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def search(self, index: str, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        t = self._table(index)
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        where, score_col = self._translate_query(body.get("query"))
+        cols = '"_id", "_source"'
+        order = ""
+        if score_col:
+            cols += f", {score_col} AS _score"
+            order = " ORDER BY _score DESC"
+        sort = body.get("sort")
+        if sort:
+            order = " ORDER BY " + ", ".join(_sort_clause(s) for s in sort)
+        sql = f'SELECT {cols} FROM "{index}"'
+        if where:
+            sql += f" WHERE {where}"
+        sql += order + f" LIMIT {size} OFFSET {from_}"
+        res = self.conn.execute(sql)
+        total_sql = f'SELECT count(*) FROM "{index}"'
+        if where:
+            total_sql += f" WHERE {where}"
+        total = int(self.conn.execute(total_sql).scalar())
+        hits = []
+        max_score = 0.0
+        for row in res.rows():
+            score = float(row[2]) if score_col and len(row) > 2 and \
+                row[2] is not None else 1.0
+            max_score = max(max_score, score)
+            hits.append({"_index": index, "_id": row[0], "_score": score,
+                         "_source": json.loads(row[1]) if row[1] else {}})
+        return {
+            "took": 1, "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score if hits else None,
+                     "hits": hits},
+        }
+
+    def cat_indices(self) -> list[dict]:
+        out = []
+        with self.db.lock:
+            tables = list(self.db.schemas["main"].tables.items())
+        for name, t in tables:
+            if "_id" not in t.column_names:
+                continue
+            out.append({"health": "green", "status": "open", "index": name,
+                        "pri": "1", "rep": "0",
+                        "docs.count": str(t.row_count())})
+        return out
+
+    def cluster_health(self) -> dict:
+        return {"cluster_name": "serenedb_tpu", "status": "green",
+                "timed_out": False, "number_of_nodes": 1,
+                "number_of_data_nodes": 1, "active_primary_shards": 1,
+                "active_shards": 1, "unassigned_shards": 0}
+
+    # -- query DSL ---------------------------------------------------------
+
+    def _translate_query(self, q: Optional[dict],
+                         ) -> tuple[str, Optional[str]]:
+        """DSL → (SQL where clause, score expression or None). Stateless
+        per call: concurrent searches on server threads must not share
+        translation state."""
+        if q is None:
+            return "", None
+        score_fields: list[str] = []
+        where = self._tr(q, score_fields)
+        score = f'bm25({_ident(score_fields[0])})' if score_fields else None
+        return where, score
+
+    def _tr(self, q: dict, score_fields: list[str]) -> str:
+        if not isinstance(q, dict) or len(q) != 1:
+            raise EsError(400, "parsing_exception", "malformed query")
+        kind, body = next(iter(q.items()))
+        if kind == "match_all":
+            return "TRUE"
+        if kind == "match":
+            field, spec = next(iter(body.items()))
+            text = spec.get("query") if isinstance(spec, dict) else spec
+            op = (spec.get("operator", "or") if isinstance(spec, dict)
+                  else "or").lower()
+            terms = [w for w in re.findall(r"\w+", str(text))]
+            joiner = " & " if op == "and" else " | "
+            score_fields.append(field)
+            return _ts_query(field, joiner.join(terms) or '""')
+        if kind == "match_phrase":
+            field, spec = next(iter(body.items()))
+            text = spec.get("query") if isinstance(spec, dict) else spec
+            score_fields.append(field)
+            return f'{_ident(field)} ## {_sql_str(str(text))}'
+        if kind == "query_string":
+            field = body.get("default_field", "_all")
+            query = body.get("query", "")
+            lucene = _lucene_to_tsquery(str(query))
+            if field == "_all":
+                raise EsError(400, "parsing_exception",
+                              "query_string requires default_field (v1)")
+            score_fields.append(field)
+            return _ts_query(field, lucene)
+        if kind == "term":
+            field, spec = next(iter(body.items()))
+            value = spec.get("value") if isinstance(spec, dict) else spec
+            return f'{_ident(field)} = {_sql_lit(value)}'
+        if kind == "terms":
+            field, values = next(iter(body.items()))
+            lits = ", ".join(_sql_lit(v) for v in values)
+            return f'{_ident(field)} IN ({lits})'
+        if kind == "range":
+            field, spec = next(iter(body.items()))
+            parts = []
+            for op_name, sym in (("gt", ">"), ("gte", ">="), ("lt", "<"),
+                                 ("lte", "<=")):
+                if op_name in spec:
+                    parts.append(f'{_ident(field)} {sym} {_sql_lit(spec[op_name])}')
+            return "(" + " AND ".join(parts) + ")" if parts else "TRUE"
+        if kind == "exists":
+            return f'{_ident(body.get("field"))} IS NOT NULL'
+        if kind == "bool":
+            clauses = []
+            for must in _as_list(body.get("must")) + \
+                    _as_list(body.get("filter")):
+                clauses.append(self._tr(must, score_fields))
+            shoulds = [self._tr(s, score_fields) for s in _as_list(body.get("should"))]
+            if shoulds:
+                clauses.append("(" + " OR ".join(shoulds) + ")")
+            for must_not in _as_list(body.get("must_not")):
+                clauses.append(f"NOT ({self._tr(must_not, score_fields)})")
+            return "(" + " AND ".join(clauses) + ")" if clauses else "TRUE"
+        if kind == "prefix":
+            field, spec = next(iter(body.items()))
+            value = spec.get("value") if isinstance(spec, dict) else spec
+            score_fields.append(field)
+            return _ts_query(field, f"{value}*")
+        if kind == "ids":
+            lits = ", ".join(_sql_lit(v) for v in body.get("values", []))
+            return f'"_id" IN ({lits})'
+        raise EsError(400, "parsing_exception",
+                      f"unsupported query type [{kind}]")
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _ident(name) -> str:
+    """Validated, quoted SQL identifier — ES field names come from untrusted
+    request bodies and must never inject SQL."""
+    s = str(name)
+    if not re.match(r"^[A-Za-z_][A-Za-z0-9_\-.]*$", s) or len(s) > 255:
+        raise EsError(400, "illegal_argument_exception",
+                      f"invalid field name [{s[:64]}]")
+    return '"' + s + '"'
+
+
+def _ts_query(field: str, q: str) -> str:
+    return f"{_ident(field)} @@ {_sql_str(q)}"
+
+
+def _sql_str(s: str) -> str:
+    return "'" + s.replace("'", "''") + "'"
+
+
+def _sql_lit(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return str(v)
+    return _sql_str(str(v))
+
+
+def _sort_clause(s) -> str:
+    if isinstance(s, str):
+        return _ident(s)
+    field, spec = next(iter(s.items()))
+    order = spec.get("order", "asc") if isinstance(spec, dict) else spec
+    if str(order).lower() not in ("asc", "desc"):
+        raise EsError(400, "illegal_argument_exception",
+                      f"invalid sort order [{order}]")
+    return f'{_ident(field)} {str(order).upper()}'
+
+
+def _lucene_to_tsquery(q: str) -> str:
+    """Lucene-ish query string → our tsquery syntax (AND/OR/NOT keywords)."""
+    out = q
+    out = re.sub(r"\bAND\b", "&", out)
+    out = re.sub(r"\bOR\b", "|", out)
+    out = re.sub(r"\bNOT\b", "!", out)
+    out = out.replace("+", "").replace("-", "!")
+    return out
+
+
+def _value_sql_type(v) -> dt.SqlType:
+    if isinstance(v, bool):
+        return dt.BOOL
+    if isinstance(v, int):
+        return dt.BIGINT
+    if isinstance(v, float):
+        return dt.DOUBLE
+    return dt.VARCHAR
+
+
+def _es_type_to_sql(es_type: str) -> dt.SqlType:
+    return {
+        "text": dt.VARCHAR, "keyword": dt.VARCHAR, "long": dt.BIGINT,
+        "integer": dt.INT, "short": dt.SMALLINT, "byte": dt.TINYINT,
+        "double": dt.DOUBLE, "float": dt.FLOAT, "boolean": dt.BOOL,
+        "date": dt.TIMESTAMP,
+    }.get(es_type, dt.VARCHAR)
+
+
+def _sql_type_to_es(t: dt.SqlType) -> str:
+    return {
+        dt.TypeId.VARCHAR: "text", dt.TypeId.BIGINT: "long",
+        dt.TypeId.INT: "integer", dt.TypeId.SMALLINT: "short",
+        dt.TypeId.TINYINT: "byte", dt.TypeId.DOUBLE: "double",
+        dt.TypeId.FLOAT: "float", dt.TypeId.BOOL: "boolean",
+        dt.TypeId.TIMESTAMP: "date", dt.TypeId.DATE: "date",
+    }.get(t.id, "text")
+
+
+_id_counter = [0]
+_id_lock = threading.Lock()
+
+
+def _gen_id() -> str:
+    import time
+    with _id_lock:
+        _id_counter[0] += 1
+        return f"{int(time.time() * 1000):x}-{_id_counter[0]:x}"

@@ -1,0 +1,220 @@
+"""HTTP/1.1 server exposing the ES-compatible API (+ /_sql and health).
+
+Reference analog: server/network/http/ (h1 codec + router with :param
+patterns; SURVEY.md §2.2). stdlib ThreadingHTTPServer carries the protocol;
+routing lives here.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import errors
+from ..engine import Database
+from ..utils import log, metrics
+from .es_api import EsApi, EsError
+
+
+class Handler(BaseHTTPRequestHandler):
+    server_version = "serenedb-tpu/0.1"
+    protocol_version = "HTTP/1.1"
+    es: EsApi = None  # class attr set by serve()
+
+    def log_message(self, fmt, *args):
+        log.debug("http", fmt % args)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _body(self) -> str:
+        ln = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(ln).decode() if ln else ""
+
+    def _json_body(self) -> Optional[dict]:
+        raw = self._body()
+        if not raw.strip():
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise EsError(400, "parsing_exception", f"invalid JSON: {e}")
+
+    def _send(self, status: int, payload, content_type="application/json"):
+        data = (json.dumps(payload) if not isinstance(payload, (str, bytes))
+                else payload)
+        if isinstance(data, str):
+            data = data.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-Elastic-Product", "Elasticsearch")
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _dispatch(self, method: str):
+        with metrics.HTTP_CONNECTIONS.scoped():
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            try:
+                self._route(method, parts, parse_qs(url.query))
+            except EsError as e:
+                self._send(e.status, e.body())
+            except errors.SqlError as e:
+                self._send(400, {"error": {
+                    "type": "sql_exception", "reason": e.message,
+                    "sqlstate": e.sqlstate}, "status": 400})
+            except Exception as e:  # pragma: no cover
+                log.error("http", f"internal error: {e!r}")
+                self._send(500, {"error": {"type": "internal_error",
+                                           "reason": str(e)}, "status": 500})
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, method: str, p: list[str], q: dict):
+        es = self.es
+        if not p:
+            self._send(200, {"name": "serenedb_tpu", "cluster_name":
+                             "serenedb_tpu", "version": {"number": "8.0.0"},
+                             "tagline": "You Know, for Search"})
+            return
+        if p[0] == "_cluster" and len(p) > 1 and p[1] == "health":
+            self._send(200, es.cluster_health())
+            return
+        if p[0] == "_cat" and len(p) > 1 and p[1] == "indices":
+            rows = es.cat_indices()
+            if "format" in q and q["format"][0] == "json":
+                self._send(200, rows)
+            else:
+                text = "\n".join(
+                    f"{r['health']} {r['status']} {r['index']} "
+                    f"{r['docs.count']}" for r in rows) + "\n"
+                self._send(200, text, "text/plain")
+            return
+        if p[0] == "_bulk" and method == "POST":
+            self._send(200, es.bulk(self._body()))
+            return
+        if p[0] == "_sql" and method == "POST":
+            body = self._json_body() or {}
+            # fresh connection per request: /_sql session state (BEGIN,
+            # SET, failed-txn) must never poison the shared API connection
+            conn = es.db.connect()
+            res = conn.execute(body.get("query", ""))
+            self._send(200, {
+                "columns": [{"name": n} for n in res.names],
+                "rows": [list(r) for r in res.rows()]})
+            return
+        if p[0] == "_test" and len(p) > 1:
+            self._test_endpoint(method, p[1:])
+            return
+        if p[0].startswith("_"):
+            raise EsError(400, "illegal_argument_exception",
+                          f"unknown endpoint [{p[0]}]")
+
+        index = p[0]
+        rest = p[1:]
+        if not rest:
+            if method == "PUT":
+                self._send(200, es.create_index(index, self._json_body()))
+            elif method == "DELETE":
+                self._send(200, es.delete_index(index))
+            elif method == "HEAD":
+                self._send(200 if es.exists(index) else 404, "")
+            elif method == "GET":
+                self._send(200, es.mapping(index))
+            else:
+                raise EsError(405, "method_not_allowed",
+                              f"{method} not allowed on /{index}")
+            return
+        verb = rest[0]
+        if verb == "_doc":
+            if method in ("PUT", "POST"):
+                doc = self._json_body() or {}
+                doc_id = rest[1] if len(rest) > 1 else None
+                self._send(201, es.index_doc(index, doc, doc_id))
+            elif method == "GET" and len(rest) > 1:
+                r = es.get_doc(index, rest[1])
+                self._send(200 if r.get("found") else 404, r)
+            elif method == "DELETE" and len(rest) > 1:
+                self._send(200, es.delete_doc(index, rest[1]))
+            else:
+                raise EsError(405, "method_not_allowed",
+                              f"{method} on _doc requires an id")
+            return
+        if verb == "_search":
+            self._send(200, es.search(index, self._json_body()))
+            return
+        if verb == "_count":
+            self._send(200, es.count(index, self._json_body()))
+            return
+        if verb == "_refresh":
+            self._send(200, es.refresh(index))
+            return
+        if verb == "_mapping":
+            self._send(200, es.mapping(index))
+            return
+        if verb == "_bulk" and method == "POST":
+            # index-scoped bulk: inject default _index
+            lines = []
+            for ln in self._body().split("\n"):
+                if not ln.strip():
+                    continue
+                obj = json.loads(ln)
+                op = next(iter(obj))
+                if op in ("index", "create", "delete", "update") and \
+                        isinstance(obj[op], dict) and "_index" not in obj[op]:
+                    obj[op]["_index"] = index
+                lines.append(json.dumps(obj))
+            self._send(200, es.bulk("\n".join(lines)))
+            return
+        raise EsError(400, "illegal_argument_exception",
+                      f"unknown verb [{verb}]")
+
+    def _test_endpoint(self, method: str, parts: list[str]):
+        """Transport test endpoints (reference:
+        server/network/http/test/handlers.h: /_test/{echo,ping,...})."""
+        if parts[0] == "ping":
+            self._send(200, {"ok": True})
+        elif parts[0] == "echo":
+            self._send(200, self._body() or "{}")
+        else:
+            raise EsError(404, "not_found", f"unknown test [{parts[0]}]")
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def do_HEAD(self):
+        self._dispatch("HEAD")
+
+
+class HttpServer:
+    def __init__(self, db: Database, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        handler = type("BoundHandler", (Handler,), {"es": EsApi(db)})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="serene-http", daemon=True)
+        self._thread.start()
+        log.info("http", f"listening on port {self.port}")
+
+    def stop(self):
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.httpd.server_close()

@@ -1,0 +1,612 @@
+"""PostgreSQL wire protocol (v3) server.
+
+Reference analog: server/network/pg/pg_wire_session.{h,cpp} (3.4 kLoC C++ —
+startup/TLS negotiation, auth, simple+extended protocol, portals, COPY;
+SURVEY.md §2.2). This asyncio implementation covers the surface drivers
+need: startup + cleartext/trust auth, ParameterStatus, simple queries,
+extended protocol (Parse/Bind/Describe/Execute/Close/Sync/Flush) with named
+statements and portals, text-format results, SQLSTATE error responses,
+implicit transaction status, and CancelRequest keys.
+
+Message framing: [type:1][len:4 incl itself][payload]; startup has no type.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import errors
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch
+from ..engine import Connection, Database, QueryResult
+from ..sql import ast, parser
+from ..utils import log, metrics
+
+PROTOCOL_VERSION = 196608          # 3.0
+SSL_REQUEST = 80877103
+GSS_REQUEST = 80877104
+CANCEL_REQUEST = 80877102
+
+# PG type OIDs
+_OID = {
+    dt.TypeId.BOOL: 16, dt.TypeId.TINYINT: 21, dt.TypeId.SMALLINT: 21,
+    dt.TypeId.INT: 23, dt.TypeId.BIGINT: 20, dt.TypeId.FLOAT: 700,
+    dt.TypeId.DOUBLE: 701, dt.TypeId.VARCHAR: 25,
+    dt.TypeId.TIMESTAMP: 1114, dt.TypeId.DATE: 1082, dt.TypeId.NULL: 25,
+}
+_TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
+           1082: 4}
+
+
+def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
+    """PG text-format encoding (reference: server/pg/serialize.cpp)."""
+    if value is None:
+        return None
+    tid = typ.id
+    if tid is dt.TypeId.BOOL:
+        return b"t" if value else b"f"
+    if tid is dt.TypeId.TIMESTAMP:
+        import numpy as np
+        s = str(np.datetime64(int(value), "us")).replace("T", " ")
+        return s.encode()
+    if tid is dt.TypeId.DATE:
+        import numpy as np
+        return str(np.datetime64(int(value), "D")).encode()
+    if isinstance(value, float):
+        import math
+        if math.isnan(value):
+            return b"NaN"
+        if math.isinf(value):
+            return b"Infinity" if value > 0 else b"-Infinity"
+        return repr(value).encode()
+    return str(value).encode()
+
+
+class Writer:
+    def __init__(self, transport: asyncio.StreamWriter):
+        self.t = transport
+        self._buf = bytearray()
+
+    def msg(self, kind: bytes, payload: bytes = b""):
+        self._buf += kind + struct.pack("!I", len(payload) + 4) + payload
+
+    async def flush(self):
+        if self._buf:
+            self.t.write(bytes(self._buf))
+            self._buf.clear()
+            await self.t.drain()
+
+    # -- common messages ---------------------------------------------------
+
+    def auth_ok(self):
+        self.msg(b"R", struct.pack("!I", 0))
+
+    def auth_cleartext(self):
+        self.msg(b"R", struct.pack("!I", 3))
+
+    def parameter_status(self, k: str, v: str):
+        self.msg(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
+
+    def backend_key(self, pid: int, key: int):
+        self.msg(b"K", struct.pack("!II", pid, key))
+
+    def ready(self, status: bytes):
+        self.msg(b"Z", status)
+
+    def row_description(self, names: list[str], types: list[dt.SqlType]):
+        out = [struct.pack("!H", len(names))]
+        for name, t in zip(names, types):
+            oid = _OID.get(t.id, 25)
+            out.append(name.encode() + b"\x00")
+            out.append(struct.pack("!IHIhih", 0, 0, oid,
+                                   _TYPLEN.get(oid, -1), -1, 0))
+        self.msg(b"T", b"".join(out))
+
+    def data_rows(self, batch: Batch):
+        types = [c.type for c in batch.columns]
+        cols_text = []
+        for col, t in zip(batch.columns, types):
+            vals = col.to_pylist()
+            cols_text.append([pg_text(v, t) for v in vals])
+        for i in range(batch.num_rows):
+            parts = [struct.pack("!H", len(types))]
+            for ci in range(len(types)):
+                v = cols_text[ci][i]
+                if v is None:
+                    parts.append(struct.pack("!i", -1))
+                else:
+                    parts.append(struct.pack("!i", len(v)) + v)
+            self.msg(b"D", b"".join(parts))
+
+    def command_complete(self, tag: str):
+        self.msg(b"C", tag.encode() + b"\x00")
+
+    def empty_query(self):
+        self.msg(b"I")
+
+    def parse_complete(self):
+        self.msg(b"1")
+
+    def bind_complete(self):
+        self.msg(b"2")
+
+    def close_complete(self):
+        self.msg(b"3")
+
+    def no_data(self):
+        self.msg(b"n")
+
+    def param_description(self, n: int):
+        self.msg(b"t", struct.pack("!H", n) + struct.pack("!I", 25) * n)
+
+    def error(self, e: errors.SqlError):
+        fields = [b"SERROR", b"VERROR",
+                  b"C" + e.sqlstate.encode(),
+                  b"M" + e.message.encode()]
+        if e.detail:
+            fields.append(b"D" + e.detail.encode())
+        if e.hint:
+            fields.append(b"H" + e.hint.encode())
+        self.msg(b"E", b"\x00".join(fields) + b"\x00\x00")
+
+
+@dataclass
+class Prepared:
+    sql: str
+    statements: list[ast.Statement]
+    n_params: int
+    param_oids: tuple = ()   # client-declared OIDs from Parse (may be 0s)
+
+
+@dataclass
+class Portal:
+    prepared: Prepared
+    params: list
+
+
+class PgSession:
+    def __init__(self, server: "PgServer", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.w = Writer(writer)
+        self.conn: Optional[Connection] = None
+        self.prepared: dict[str, Prepared] = {}
+        self.portals: dict[str, Portal] = {}
+        self.pid = os.getpid()
+        self.secret = secrets.randbits(31)
+        self.ignore_till_sync = False
+
+    # -- startup -----------------------------------------------------------
+
+    async def run(self):
+        with metrics.PG_CONNECTIONS.scoped():
+            try:
+                if not await self._startup():
+                    return
+                await self._command_loop()
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                pass
+            finally:
+                self.server.unregister_cancel(self.pid, self.secret)
+                self.w.t.close()
+
+    async def _startup(self) -> bool:
+        while True:
+            raw = await self.reader.readexactly(4)
+            (ln,) = struct.unpack("!I", raw)
+            body = await self.reader.readexactly(ln - 4)
+            (code,) = struct.unpack("!I", body[:4])
+            if code == SSL_REQUEST or code == GSS_REQUEST:
+                self.w.t.write(b"N")   # no TLS on this listener
+                await self.w.t.drain()
+                continue
+            if code == CANCEL_REQUEST:
+                pid, key = struct.unpack("!II", body[4:12])
+                self.server.cancel(pid, key)
+                return False
+            if code != PROTOCOL_VERSION:
+                self.w.error(errors.SqlError(
+                    "08P01", f"unsupported protocol version {code >> 16}"))
+                await self.w.flush()
+                return False
+            break
+        params = {}
+        parts = body[4:].split(b"\x00")
+        for k, v in zip(parts[::2], parts[1::2]):
+            if k:
+                params[k.decode()] = v.decode()
+        user = params.get("user", "serene")
+        if self.server.password is not None:
+            self.w.auth_cleartext()
+            await self.w.flush()
+            kind, payload = await self._read_msg()
+            if kind != b"p" or payload[:-1].decode() != self.server.password:
+                self.w.error(errors.SqlError(
+                    "28P01",
+                    f'password authentication failed for user "{user}"'))
+                await self.w.flush()
+                return False
+        self.conn = self.server.db.connect()
+        for k, v in params.items():
+            if k in ("user", "database", "options", "replication"):
+                continue
+            try:
+                self.conn.settings.set(k, v)
+            except (KeyError, ValueError):
+                pass
+        self.w.auth_ok()
+        for k, v in [("server_version", "16.0 (serenedb_tpu)"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO, MDY"),
+                     ("TimeZone", "UTC"),
+                     ("integer_datetimes", "on"),
+                     ("standard_conforming_strings", "on"),
+                     ("application_name",
+                      params.get("application_name", ""))]:
+            self.w.parameter_status(k, v)
+        self.w.backend_key(self.pid, self.secret)
+        self.server.register_cancel(self.pid, self.secret, self)
+        self.w.ready(self._txn_status())
+        await self.w.flush()
+        return True
+
+    def _txn_status(self) -> bytes:
+        if self.conn is None:
+            return b"I"
+        if self.conn.txn_failed:
+            return b"E"
+        return b"T" if self.conn.in_txn else b"I"
+
+    async def _read_msg(self) -> tuple[bytes, bytes]:
+        kind = await self.reader.readexactly(1)
+        (ln,) = struct.unpack("!I", await self.reader.readexactly(4))
+        payload = await self.reader.readexactly(ln - 4)
+        return kind, payload
+
+    # -- command loop ------------------------------------------------------
+
+    async def _command_loop(self):
+        while True:
+            kind, payload = await self._read_msg()
+            if kind == b"X":
+                return
+            if self.ignore_till_sync and kind not in (b"S",):
+                continue
+            handler = {
+                b"Q": self._on_query,
+                b"P": self._on_parse,
+                b"B": self._on_bind,
+                b"D": self._on_describe,
+                b"E": self._on_execute,
+                b"C": self._on_close,
+                b"S": self._on_sync,
+                b"H": self._on_flush,
+            }.get(kind)
+            if handler is None:
+                self.w.error(errors.SqlError(
+                    "08P01", f"unknown message type {kind!r}"))
+                self.ignore_till_sync = True
+                await self.w.flush()
+                continue
+            await handler(payload)
+
+    async def _on_query(self, payload: bytes):
+        sql = payload[:-1].decode()
+        loop = asyncio.get_running_loop()
+        try:
+            stmts = parser.parse(sql)
+            if not stmts:
+                self.w.empty_query()
+            for st in stmts:
+                res = await loop.run_in_executor(
+                    self.server.pool, self.conn.execute_statement, st, [])
+                self._send_result(res, describe=True)
+        except errors.SqlError as e:
+            self._note_error()
+            self.w.error(e)
+        except Exception as e:  # engine bug: surface as internal error
+            log.error("pg", f"internal error: {e!r}")
+            self._note_error()
+            self.w.error(errors.SqlError("XX000", f"internal error: {e}"))
+        self.w.ready(self._txn_status())
+        await self.w.flush()
+
+    def _note_error(self):
+        """Any error inside an explicit transaction block aborts it (the
+        engine only marks this for errors it raises during execution)."""
+        if self.conn is not None and self.conn.in_txn:
+            self.conn.txn_failed = True
+
+    def _send_result(self, res: QueryResult, describe: bool,
+                     max_rows: int = 0):
+        if res.batch.num_columns:
+            if describe:
+                self.w.row_description(
+                    res.batch.names, [c.type for c in res.batch.columns])
+            self.w.data_rows(res.batch)
+        self.w.command_complete(res.command_tag or "OK")
+
+    # -- extended protocol -------------------------------------------------
+
+    async def _on_parse(self, payload: bytes):
+        try:
+            name_end = payload.index(b"\x00")
+            name = payload[:name_end].decode()
+            sql_end = payload.index(b"\x00", name_end + 1)
+            sql = payload[name_end + 1:sql_end].decode()
+            (n_oids,) = struct.unpack_from("!H", payload, sql_end + 1)
+            oids = struct.unpack_from(f"!{n_oids}I", payload, sql_end + 3)
+            stmts = parser.parse(sql)
+            if len(stmts) > 1:
+                raise errors.syntax(
+                    "cannot insert multiple commands into a prepared "
+                    "statement")
+            n_params = _count_params(stmts[0]) if stmts else 0
+            self.prepared[name] = Prepared(sql, stmts, n_params, oids)
+            self.w.parse_complete()
+        except errors.SqlError as e:
+            self._note_error()
+            self.w.error(e)
+            self.ignore_till_sync = True
+        await self.w.flush()
+
+    async def _on_bind(self, payload: bytes):
+        try:
+            off = 0
+            pend = payload.index(b"\x00", off)
+            portal = payload[off:pend].decode()
+            send = payload.index(b"\x00", pend + 1)
+            stmt_name = payload[pend + 1:send].decode()
+            off = send + 1
+            (n_fmt,) = struct.unpack_from("!H", payload, off)
+            off += 2
+            fmts = struct.unpack_from(f"!{n_fmt}h", payload, off)
+            off += 2 * n_fmt
+            prep = self.prepared.get(stmt_name)
+            if prep is None:
+                raise errors.SqlError(
+                    "26000", f'prepared statement "{stmt_name}" does not '
+                             "exist")
+            (n_params,) = struct.unpack_from("!H", payload, off)
+            off += 2
+            params = []
+            for i in range(n_params):
+                (ln,) = struct.unpack_from("!i", payload, off)
+                off += 4
+                if ln < 0:
+                    params.append(None)
+                else:
+                    raw = payload[off:off + ln]
+                    off += ln
+                    fmt = fmts[i] if i < len(fmts) else \
+                        (fmts[0] if len(fmts) == 1 else 0)
+                    oid = prep.param_oids[i] if i < len(prep.param_oids) \
+                        else 0
+                    params.append(_decode_param(raw, fmt, oid))
+            self.portals[portal] = Portal(prep, params)
+            self.w.bind_complete()
+        except errors.SqlError as e:
+            self._note_error()
+            self.w.error(e)
+            self.ignore_till_sync = True
+        await self.w.flush()
+
+    async def _on_describe(self, payload: bytes):
+        kind = payload[:1]
+        name = payload[1:-1].decode()
+        try:
+            if kind == b"S":
+                prep = self.prepared.get(name)
+                if prep is None:
+                    raise errors.SqlError(
+                        "26000", f'prepared statement "{name}" does not exist')
+                self.w.param_description(prep.n_params)
+                self._describe_statement(prep)
+            else:
+                portal = self.portals.get(name)
+                if portal is None:
+                    raise errors.SqlError(
+                        "34000", f'portal "{name}" does not exist')
+                self._describe_statement(portal.prepared)
+        except errors.SqlError as e:
+            self._note_error()
+            self.w.error(e)
+            self.ignore_till_sync = True
+        await self.w.flush()
+
+    def _describe_statement(self, prep: Prepared):
+        st = prep.statements[0] if prep.statements else None
+        if isinstance(st, (ast.Select, ast.ShowStmt, ast.Explain)):
+            try:
+                if isinstance(st, ast.Select):
+                    plan = self.conn._plan(st, [None] * prep.n_params)
+                    self.w.row_description(plan.names, plan.types)
+                    return
+            except errors.SqlError:
+                pass
+            self.w.no_data()
+        else:
+            self.w.no_data()
+
+    async def _on_execute(self, payload: bytes):
+        end = payload.index(b"\x00")
+        name = payload[:end].decode()
+        loop = asyncio.get_running_loop()
+        try:
+            portal = self.portals.get(name)
+            if portal is None:
+                raise errors.SqlError("34000",
+                                      f'portal "{name}" does not exist')
+            if not portal.prepared.statements:
+                self.w.empty_query()
+                return
+            st = portal.prepared.statements[0]
+            res = await loop.run_in_executor(
+                self.server.pool, self.conn.execute_statement, st,
+                portal.params)
+            self._send_result(res, describe=False)
+        except errors.SqlError as e:
+            self._note_error()
+            self.w.error(e)
+            self.ignore_till_sync = True
+        except Exception as e:
+            log.error("pg", f"internal error: {e!r}")
+            self._note_error()
+            self.w.error(errors.SqlError("XX000", f"internal error: {e}"))
+            self.ignore_till_sync = True
+        await self.w.flush()
+
+    async def _on_close(self, payload: bytes):
+        kind = payload[:1]
+        name = payload[1:-1].decode()
+        if kind == b"S":
+            self.prepared.pop(name, None)
+        else:
+            self.portals.pop(name, None)
+        self.w.close_complete()
+        await self.w.flush()
+
+    async def _on_sync(self, payload: bytes):
+        self.ignore_till_sync = False
+        self.w.ready(self._txn_status())
+        await self.w.flush()
+
+    async def _on_flush(self, payload: bytes):
+        await self.w.flush()
+
+
+def _decode_param(raw: bytes, fmt: int, oid: int = 0):
+    if fmt == 1:
+        # binary params: the Parse-declared OID disambiguates same-width
+        # types (float8 vs int8); length alone is a fallback for OID 0
+        if oid == 700:
+            return struct.unpack("!f", raw)[0]
+        if oid == 701:
+            return struct.unpack("!d", raw)[0]
+        if oid == 16:
+            return raw != b"\x00"
+        if oid == 25 or oid == 1043:
+            return raw.decode()
+        if len(raw) == 4:
+            return struct.unpack("!i", raw)[0]
+        if len(raw) == 8:
+            return struct.unpack("!q", raw)[0]
+        if len(raw) == 2:
+            return struct.unpack("!h", raw)[0]
+        raise errors.unsupported("binary parameter format for this type")
+    text = raw.decode()
+    # the wire gives no context for parameter typing here (the reference
+    # resolves param types at bind through the planner); numeric-looking
+    # text coerces to numbers, and _coerce casts on insert fix up the rest
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    if text.lower() in ("true", "false"):
+        return text.lower() == "true"
+    return text
+
+
+def _count_params(st: ast.Statement) -> int:
+    mx = 0
+
+    def walk_expr(e):
+        nonlocal mx
+        if isinstance(e, ast.Param):
+            mx = max(mx, e.index)
+        for attr in ("left", "right", "operand", "low", "high", "pattern",
+                     "else_"):
+            v = getattr(e, attr, None)
+            if isinstance(v, ast.Expr):
+                walk_expr(v)
+        for attr in ("args", "items"):
+            for v in getattr(e, attr, []) or []:
+                if isinstance(v, ast.Expr):
+                    walk_expr(v)
+        if isinstance(e, ast.Case):
+            for c, v in e.branches:
+                walk_expr(c)
+                walk_expr(v)
+
+    def walk_stmt(s):
+        if isinstance(s, ast.Select):
+            for it in s.items:
+                walk_expr(it.expr)
+            for e in ([s.where] if s.where else []) + s.group_by + \
+                    ([s.having] if s.having else []):
+                walk_expr(e)
+            for oi in s.order_by:
+                walk_expr(oi.expr)
+        elif isinstance(s, ast.Insert):
+            for row in s.values or []:
+                for e in row:
+                    walk_expr(e)
+            if s.query:
+                walk_stmt(s.query)
+        elif isinstance(s, (ast.Delete, ast.Update)):
+            if s.where:
+                walk_expr(s.where)
+            if isinstance(s, ast.Update):
+                for _, e in s.assignments:
+                    walk_expr(e)
+
+    walk_stmt(st)
+    return mx
+
+
+class PgServer:
+    def __init__(self, db: Database, host: str = "127.0.0.1",
+                 port: int = 5432, password: Optional[str] = None):
+        self.db = db
+        self.host = host
+        self.port = port
+        self.password = password
+        self._cancel_keys: dict[tuple[int, int], PgSession] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        import concurrent.futures
+        self.pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, (os.cpu_count() or 4)))
+
+    def register_cancel(self, pid: int, key: int, session: PgSession):
+        self._cancel_keys[(pid, key)] = session
+
+    def unregister_cancel(self, pid: int, key: int):
+        self._cancel_keys.pop((pid, key), None)
+
+    def cancel(self, pid: int, key: int):
+        # cancellation is registered; in-flight interruption lands with the
+        # native runtime (reference: CancelRegistry, cancel_registry.h)
+        log.info("pg", f"cancel request for {pid}/{key}")
+
+    async def _client(self, reader, writer):
+        await PgSession(self, reader, writer).run()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        log.info("pg", f"listening on {addr[0]}:{addr[1]}")
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.pool.shutdown(wait=False)
+
+    def run_forever(self):
+        async def main():
+            await self.start()
+            await asyncio.Event().wait()
+        asyncio.run(main())

@@ -1,0 +1,219 @@
+"""ES-compatible HTTP API tests via urllib against a live HttpServer."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.server.http_server import HttpServer
+
+
+@pytest.fixture(scope="module")
+def srv():
+    db = Database()
+    s = HttpServer(db, port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def req(srv, method, path, body=None, raw=False):
+    data = None
+    headers = {}
+    if body is not None:
+        data = body.encode() if isinstance(body, str) else \
+            json.dumps(body).encode()
+        headers["Content-Type"] = "application/json" if not raw else \
+            "application/x-ndjson"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, headers=headers,
+        method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            raw_body = resp.read().decode()
+            return resp.status, (json.loads(raw_body)
+                                 if "json" in ct else raw_body)
+    except urllib.error.HTTPError as e:
+        raw_body = e.read().decode()
+        try:
+            return e.code, json.loads(raw_body)
+        except json.JSONDecodeError:
+            return e.code, raw_body
+
+
+def test_root_and_health(srv):
+    status, body = req(srv, "GET", "/")
+    assert status == 200 and body["tagline"] == "You Know, for Search"
+    status, body = req(srv, "GET", "/_cluster/health")
+    assert body["status"] == "green"
+
+
+def test_index_lifecycle_and_docs(srv):
+    status, body = req(srv, "PUT", "/books")
+    assert status == 200 and body["acknowledged"]
+    status, body = req(srv, "PUT", "/books")
+    assert status == 400  # already exists
+    status, body = req(srv, "PUT", "/books/_doc/1",
+                       {"title": "The quick brown fox", "pages": 120})
+    assert status == 201 and body["result"] == "created"
+    req(srv, "PUT", "/books/_doc/2",
+        {"title": "lazy dogs sleeping", "pages": 300})
+    req(srv, "POST", "/books/_doc", {"title": "quick reference", "pages": 50})
+    status, body = req(srv, "GET", "/books/_doc/1")
+    assert status == 200 and body["_source"]["pages"] == 120
+    status, body = req(srv, "GET", "/books/_doc/404")
+    assert status == 404 and body["found"] is False
+
+    status, body = req(srv, "GET", "/books/_count")
+    assert body["count"] == 3
+
+    # match query with scoring
+    status, body = req(srv, "POST", "/books/_search",
+                       {"query": {"match": {"title": "quick"}}})
+    assert status == 200
+    hits = body["hits"]["hits"]
+    assert body["hits"]["total"]["value"] == 2
+    assert {h["_id"] for h in hits} == {"1", hits[1]["_id"]}
+
+    # range + bool
+    status, body = req(srv, "POST", "/books/_search", {
+        "query": {"bool": {
+            "must": [{"match": {"title": "quick"}}],
+            "filter": [{"range": {"pages": {"gte": 100}}}]}}})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["1"]
+
+    # match_phrase
+    status, body = req(srv, "POST", "/books/_search",
+                       {"query": {"match_phrase": {"title": "quick brown"}}})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["1"]
+
+    # delete doc
+    status, body = req(srv, "DELETE", "/books/_doc/2")
+    assert body["result"] == "deleted"
+    status, body = req(srv, "GET", "/books/_count")
+    assert body["count"] == 2
+
+
+def test_bulk_and_cat(srv):
+    ndjson = "\n".join([
+        json.dumps({"index": {"_index": "logs", "_id": "a"}}),
+        json.dumps({"msg": "disk error on node1", "level": "error"}),
+        json.dumps({"index": {"_index": "logs", "_id": "b"}}),
+        json.dumps({"msg": "all systems normal", "level": "info"}),
+        json.dumps({"delete": {"_index": "logs", "_id": "missing"}}),
+    ]) + "\n"
+    status, body = req(srv, "POST", "/_bulk", ndjson, raw=True)
+    assert status == 200
+    assert len(body["items"]) == 3
+    status, body = req(srv, "GET", "/_cat/indices?format=json")
+    names = {r["index"] for r in body}
+    assert "logs" in names
+    status, body = req(srv, "POST", "/logs/_search",
+                       {"query": {"term": {"level": "error"}}})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["a"]
+
+
+def test_search_sort_and_pagination(srv):
+    req(srv, "PUT", "/nums")
+    for i in range(5):
+        req(srv, "PUT", f"/nums/_doc/{i}", {"v": i})
+    status, body = req(srv, "POST", "/nums/_search", {
+        "query": {"match_all": {}}, "size": 2, "from": 1,
+        "sort": [{"v": {"order": "desc"}}]})
+    assert [h["_source"]["v"] for h in body["hits"]["hits"]] == [3, 2]
+    assert body["hits"]["total"]["value"] == 5
+
+
+def test_mapping_reflects_fields(srv):
+    req(srv, "PUT", "/m1")
+    req(srv, "PUT", "/m1/_doc/1", {"name": "x", "n": 3, "f": 1.5, "b": True})
+    status, body = req(srv, "GET", "/m1/_mapping")
+    props = body["m1"]["mappings"]["properties"]
+    assert props["name"]["type"] == "text"
+    assert props["n"]["type"] == "long"
+    assert props["f"]["type"] == "double"
+    assert props["b"]["type"] == "boolean"
+
+
+def test_sql_endpoint(srv):
+    status, body = req(srv, "POST", "/_sql", {"query": "SELECT 1 + 1 AS two"})
+    assert status == 200
+    assert body["columns"] == [{"name": "two"}]
+    assert body["rows"] == [[2]]
+
+
+def test_error_shapes(srv):
+    status, body = req(srv, "GET", "/missing_index/_search")
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+    status, body = req(srv, "POST", "/_sql", {"query": "SELECT FROM"})
+    assert status == 400 and body["error"]["type"] == "sql_exception"
+
+
+def test_refresh_enables_index_scoring(srv):
+    req(srv, "PUT", "/scored")
+    # equal doc lengths so tf dominates (BM25 length normalization would
+    # otherwise favor the shorter doc)
+    req(srv, "PUT", "/scored/_doc/1", {"body": "alpha alpha beta"})
+    req(srv, "PUT", "/scored/_doc/2", {"body": "alpha beta gamma"})
+    status, body = req(srv, "POST", "/scored/_refresh")
+    assert status == 200
+    status, body = req(srv, "POST", "/scored/_search",
+                       {"query": {"match": {"body": "alpha"}}})
+    hits = body["hits"]["hits"]
+    assert len(hits) == 2
+    assert hits[0]["_id"] == "1"           # higher tf ranks first
+    assert hits[0]["_score"] > hits[1]["_score"] > 0
+
+
+def test_sql_injection_via_sort_and_fields_rejected(srv):
+    req(srv, "PUT", "/inj")
+    req(srv, "PUT", "/inj/_doc/1", {"v": 1})
+    # injection through sort order
+    status, body = req(srv, "POST", "/inj/_search", {
+        "query": {"match_all": {}},
+        "sort": [{"v": "asc; DROP TABLE inj; SELECT 1"}]})
+    assert status == 400
+    # injection through field names
+    status, body = req(srv, "POST", "/inj/_search", {
+        "query": {"term": {'v" = 1; DROP TABLE inj; --': 1}}})
+    assert status == 400
+    # table still there
+    status, body = req(srv, "GET", "/inj/_count")
+    assert status == 200 and body["count"] == 1
+
+
+def test_unmatched_routes_respond(srv):
+    req(srv, "PUT", "/resp")
+    status, _ = req(srv, "POST", "/resp")      # no verb, POST
+    assert status == 405
+    status, _ = req(srv, "GET", "/resp/_doc")  # _doc without id
+    assert status == 405
+
+
+def test_sql_endpoint_does_not_poison_shared_state(srv):
+    req(srv, "PUT", "/iso")
+    req(srv, "PUT", "/iso/_doc/1", {"v": 1})
+    req(srv, "POST", "/_sql", {"query": "BEGIN"})
+    req(srv, "POST", "/_sql", {"query": "SELECT broken FROM nowhere"})
+    status, body = req(srv, "GET", "/iso/_count")
+    assert status == 200 and body["count"] == 1
+
+
+def test_bulk_partial_failure_reports_per_item(srv):
+    req(srv, "PUT", "/pb")
+    req(srv, "PUT", "/pb/_doc/1", {"n": 5})
+    ndjson = "\n".join([
+        json.dumps({"index": {"_index": "pb", "_id": "2"}}),
+        json.dumps({"n": 7}),
+        json.dumps({"index": {"_index": "DROP TABLE pb", "_id": "3"}}),
+        json.dumps({"n": 9}),
+    ]) + "\n"
+    status, body = req(srv, "POST", "/_bulk", ndjson, raw=True)
+    assert status == 200
+    assert body["errors"] is True
+    assert body["items"][0]["index"]["status"] == 201
+    assert body["items"][1]["index"]["status"] == 400

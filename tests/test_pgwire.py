@@ -1,0 +1,279 @@
+"""PG wire protocol tests with a minimal raw-socket client (no driver deps —
+the reference tests this with real drivers; a raw client checks framing)."""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.server.pgwire import PgServer
+
+
+class RawPg:
+    def __init__(self, port, user="tester", password=None):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=15)
+        self.buf = b""
+        params = f"user\x00{user}\x00\x00".encode()
+        body = struct.pack("!I", 196608) + params
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self.params = {}
+        self.backend_key = None
+        while True:
+            kind, payload = self.read_msg()
+            if kind == b"R":
+                (code,) = struct.unpack("!I", payload[:4])
+                if code == 3:
+                    assert password is not None, "server demands password"
+                    pw = password.encode() + b"\x00"
+                    self.send(b"p", pw)
+                elif code == 0:
+                    pass
+                else:
+                    raise AssertionError(f"unexpected auth {code}")
+            elif kind == b"S":
+                k, v = payload.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            elif kind == b"K":
+                self.backend_key = struct.unpack("!II", payload)
+            elif kind == b"Z":
+                self.status = payload
+                return
+            elif kind == b"E":
+                raise AssertionError(f"error in startup: {payload}")
+
+    def send(self, kind, payload=b""):
+        self.sock.sendall(kind + struct.pack("!I", len(payload) + 4) + payload)
+
+    def read_msg(self):
+        while len(self.buf) < 5:
+            data = self.sock.recv(65536)
+            if not data:
+                raise ConnectionError("closed")
+            self.buf += data
+        kind = self.buf[:1]
+        (ln,) = struct.unpack("!I", self.buf[1:5])
+        while len(self.buf) < 1 + ln:
+            self.buf += self.sock.recv(65536)
+        payload = self.buf[5:1 + ln]
+        self.buf = self.buf[1 + ln:]
+        return kind, payload
+
+    def query(self, sql):
+        """Simple query; returns (columns, rows, tags, errors)."""
+        self.send(b"Q", sql.encode() + b"\x00")
+        cols, rows, tags, errs = [], [], [], []
+        while True:
+            kind, payload = self.read_msg()
+            if kind == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                cols = []
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif kind == b"C":
+                tags.append(payload[:-1].decode())
+            elif kind == b"E":
+                errs.append(_parse_err(payload))
+            elif kind == b"Z":
+                self.status = payload
+                return cols, rows, tags, errs
+
+    def extended(self, sql, params=()):
+        """Parse/Bind/Describe/Execute/Sync round."""
+        self.send(b"P", b"\x00" + sql.encode() + b"\x00" + b"\x00\x00")
+        parts = [b"\x00", b"\x00", struct.pack("!H", 0),
+                 struct.pack("!H", len(params))]
+        for p in params:
+            if p is None:
+                parts.append(struct.pack("!i", -1))
+            else:
+                enc = str(p).encode()
+                parts.append(struct.pack("!i", len(enc)) + enc)
+        parts.append(struct.pack("!H", 0))
+        self.send(b"B", b"".join(parts))
+        self.send(b"D", b"P\x00")
+        self.send(b"E", b"\x00" + struct.pack("!I", 0))
+        self.send(b"S")
+        cols, rows, tags, errs = [], [], [], []
+        while True:
+            kind, payload = self.read_msg()
+            if kind == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif kind == b"C":
+                tags.append(payload[:-1].decode())
+            elif kind == b"E":
+                errs.append(_parse_err(payload))
+            elif kind == b"Z":
+                return cols, rows, tags, errs
+
+    def close(self):
+        try:
+            self.send(b"X")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def _parse_err(payload):
+    fields = {}
+    for part in payload.split(b"\x00"):
+        if part:
+            fields[chr(part[0])] = part[1:].decode()
+    return fields
+
+
+@pytest.fixture(scope="module")
+def server():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT, s TEXT)")
+    c.execute("INSERT INTO t VALUES (1, 'x'), (2, NULL)")
+    srv = PgServer(db, port=0)
+    loop = asyncio.new_event_loop()
+    import threading
+
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        try:
+            loop.run_until_complete(go())
+        except RuntimeError:
+            pass
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_startup_and_simple_query(server):
+    c = RawPg(server.port)
+    assert c.params.get("server_encoding") == "UTF8"
+    cols, rows, tags, errs = c.query("SELECT a, s FROM t ORDER BY a")
+    assert cols == ["a", "s"]
+    assert rows == [("1", "x"), ("2", None)]
+    assert tags == ["SELECT 2"]
+    assert not errs
+    c.close()
+
+
+def test_multi_statement_and_tags(server):
+    c = RawPg(server.port)
+    cols, rows, tags, errs = c.query("SELECT 1; SELECT 2;")
+    assert tags == ["SELECT 1", "SELECT 1"]
+    assert rows == [("1",), ("2",)]
+    c.close()
+
+
+def test_error_has_sqlstate(server):
+    c = RawPg(server.port)
+    _, _, _, errs = c.query("SELECT * FROM missing_table")
+    assert errs and errs[0]["C"] == "42P01"
+    # session still usable after error
+    _, rows, _, _ = c.query("SELECT 42")
+    assert rows == [("42",)]
+    c.close()
+
+
+def test_extended_protocol_with_params(server):
+    c = RawPg(server.port)
+    cols, rows, tags, errs = c.extended(
+        "SELECT a, s FROM t WHERE a > $1 ORDER BY a", (0,))
+    assert not errs, errs
+    assert rows == [("1", "x"), ("2", None)]
+    cols, rows, tags, errs = c.extended(
+        "SELECT a FROM t WHERE s = $1", ("x",))
+    assert rows == [("1",)]
+    c.close()
+
+
+def test_extended_error_then_sync_recovers(server):
+    c = RawPg(server.port)
+    _, _, _, errs = c.extended("SELECT * FROM nope")
+    assert errs and errs[0]["C"] == "42P01"
+    _, rows, _, errs = c.extended("SELECT 7")
+    assert rows == [("7",)] and not errs
+    c.close()
+
+
+def test_password_auth():
+    db = Database()
+    srv = PgServer(db, port=0, password="sesame")
+    loop = asyncio.new_event_loop()
+    import threading
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        try:
+            loop.run_until_complete(go())
+        except RuntimeError:
+            pass
+    threading.Thread(target=run, daemon=True).start()
+    started.wait(10)
+    c = RawPg(srv.port, password="sesame")
+    _, rows, _, _ = c.query("SELECT 1")
+    assert rows == [("1",)]
+    c.close()
+    with pytest.raises(AssertionError):
+        RawPg(srv.port, password=None)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_transaction_status_bytes(server):
+    c = RawPg(server.port)
+    c.query("BEGIN")
+    assert c.status == b"T"
+    c.query("SELECT broken syntax here from")
+    assert c.status == b"E"   # failed transaction block
+    _, _, _, errs = c.query("SELECT 1")
+    assert errs and errs[0]["C"] == "25P02"
+    c.query("ROLLBACK")
+    assert c.status == b"I"
+    c.close()
