@@ -96,3 +96,45 @@ class SearchScanNode(PlanNode):
             c = self.residual.eval(out)
             out = out.filter(c.data.astype(bool) & c.valid_mask())
         yield out
+
+
+class IvfScanNode(PlanNode):
+    """ANN top-k scan: rows in ascending distance order + a `#dist` column.
+
+    Reference analog: the ANN claim path (TryClaimAnnRange,
+    optimizer/iresearch_plan.cpp:927-1015) feeding the IVF index."""
+
+    DIST_COL = "#dist"
+
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, vector_column: str, query_vec, topk: int):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.vector_column = vector_column
+        self.query_vec = query_vec
+        self.topk = topk
+        self.names = list(columns) + [self.DIST_COL]
+        self.types = [provider.type_of(c) for c in columns] + [dt.DOUBLE]
+
+    def children(self):
+        return []
+
+    def label(self):
+        return (f"IvfScan {self.provider.name}.{self.vector_column} "
+                f"k={self.topk}")
+
+    def batches(self, ctx):
+        from ..search.ivf import find_ivf_index
+        idx = find_ivf_index(self.provider, self.vector_column)
+        if idx is None:
+            raise RuntimeError("ivf index disappeared under the plan")
+        nprobe = int(ctx.settings.get("sdb_nprobe"))
+        dists, rows = idx.search(self.query_vec[None, :], self.topk, nprobe)
+        d, r = dists[0], rows[0]
+        keep = np.isfinite(d)
+        d, r = d[keep], r[keep]
+        full = self.provider.full_batch(self.columns)
+        out = full.take(r.astype(np.int64))
+        yield Batch(list(self.names),
+                    out.columns + [Column(dt.DOUBLE, d.astype(np.float64))])

@@ -706,3 +706,58 @@ def _current_schema(ts):
     def impl(cols, n):
         return Column.from_pylist(["main"] * max(n, 1), dt.VARCHAR)
     return FunctionResolution(dt.VARCHAR, impl)
+
+
+# -- vector functions (CPU oracle; reference: functions/vector.cpp) --------
+
+def _make_vec_fn(metric):
+    def resolver(ts):
+        def impl(cols, n):
+            # strict NULL propagation: never parse rows where either side is
+            # NULL ('' placeholders would raise)
+            from ..search.ivf import parse_vector
+            a = string_values(cols[0])
+            b = string_values(cols[1])
+            valid = propagate_nulls(cols)
+            out = np.zeros(n, dtype=np.float64)
+            for i in range(n):
+                if valid is not None and not valid[i]:
+                    continue
+                x = parse_vector(a[i])
+                y = parse_vector(b[i])
+                if len(x) != len(y):
+                    raise errors.SqlError(
+                        errors.DATATYPE_MISMATCH,
+                        f"vector dims differ: {len(x)} vs {len(y)}")
+                if metric == "l2":
+                    d = x.astype(np.float64) - y.astype(np.float64)
+                    out[i] = float(np.dot(d, d))
+                elif metric == "ip":
+                    out[i] = -float(np.dot(x.astype(np.float64),
+                                           y.astype(np.float64)))
+                else:
+                    nx = np.linalg.norm(x)
+                    ny = np.linalg.norm(y)
+                    out[i] = 1.0 - float(np.dot(x, y)) / max(nx * ny, 1e-9)
+            return _result(dt.DOUBLE, out, cols)
+        return FunctionResolution(dt.DOUBLE, impl)
+    return resolver
+
+
+_REGISTRY["vec_l2"] = _make_vec_fn("l2")
+_REGISTRY["vec_ip"] = _make_vec_fn("ip")
+_REGISTRY["vec_cos"] = _make_vec_fn("cos")
+
+
+@register("vec_dims")
+def _vec_dims(ts):
+    def impl(cols, n):
+        from ..search.ivf import parse_vector
+        vals = string_values(cols[0])
+        valid = propagate_nulls(cols)
+        out = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            if valid is None or valid[i]:
+                out[i] = len(parse_vector(vals[i]))
+        return _result(dt.BIGINT, out, cols)
+    return FunctionResolution(dt.BIGINT, impl)

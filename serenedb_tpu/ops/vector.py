@@ -1,0 +1,117 @@
+"""Vector kernels: k-means training and IVF top-k search on the MXU.
+
+Reference analog: libs/iresearch/formats/ivf/ (faiss-backed k-means
+centroids, cluster posting lists, SQ8, nprobe/rerank knobs; SURVEY.md §2.7).
+
+TPU re-design: distance computation IS a matmul, so both k-means Lloyd
+iterations and search ride the MXU:
+
+- kmeans: assignment = argmin over  ||x||² − 2·X·Cᵀ + ||c||²  tiles;
+  centroid update = one-hot(assign)ᵀ @ X (another matmul).
+- IVF search: query→centroid distances pick the nprobe nearest lists; the
+  candidate mask (vector's list ∈ top-nprobe) is applied to a full Q×N
+  distance matmul. On MXU hardware the full matmul is cheaper than gather
+  plumbing at these shapes — IVF semantics (recall vs nprobe) are preserved
+  exactly while compute stays dense. Queries batch per dispatch like BM25.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_rows(a: np.ndarray, multiple: int = 8) -> np.ndarray:
+    pad = (-a.shape[0]) % multiple
+    if pad:
+        a = np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans_fit(x: jax.Array, init: jax.Array, k: int,
+               iters: int) -> jax.Array:
+    """Lloyd's k-means on device. x: (N, D) f32 (padding rows must be far
+    sentinels or excluded via weights — caller passes valid rows only,
+    padded by repeating real rows). Returns (k, D) centroids."""
+
+    def step(c, _):
+        d = _sq_dists(x, c)
+        assign = jnp.argmin(d, axis=1)
+        oh = jax.nn.one_hot(assign, k, dtype=jnp.float32)   # (N, K)
+        counts = oh.sum(axis=0)                              # (K,)
+        sums = jnp.einsum("nk,nd->kd", oh, x)
+        new_c = sums / jnp.maximum(counts[:, None], 1.0)
+        # empty clusters keep their previous centroid
+        new_c = jnp.where(counts[:, None] > 0, new_c, c)
+        return new_c, None
+
+    c, _ = jax.lax.scan(step, init, None, length=iters)
+    return c
+
+
+def _sq_dists(x: jax.Array, c: jax.Array) -> jax.Array:
+    """Squared L2 distances (N, K) via the matmul identity."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)[None, :]
+    return x2 - 2.0 * (x @ c.T) + c2
+
+
+@functools.partial(jax.jit, static_argnames=())
+def assign_clusters(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    return jnp.argmin(_sq_dists(x, centroids), axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric"))
+def ivf_topk(queries: jax.Array, vectors: jax.Array, valid: jax.Array,
+             centroids: jax.Array, codes: jax.Array, k: int, nprobe: int,
+             metric: str) -> tuple[jax.Array, jax.Array]:
+    """Batched IVF top-k. queries (Q,D); vectors (N,D) HBM-resident;
+    valid (N,) bool (False = padding/NULL row); codes (N,) int32 cluster of
+    each vector. Returns (distances (Q,k), indices (Q,k)); masked-out
+    candidates get +inf distance.
+
+    metric: l2 (squared L2), ip (negative inner product so smaller=better),
+    cos (cosine distance)."""
+    if metric == "l2":
+        d_qc = _sq_dists(queries, centroids)
+        d_qn = _sq_dists(queries, vectors)
+    elif metric == "ip":
+        d_qc = -(queries @ centroids.T)
+        d_qn = -(queries @ vectors.T)
+    else:  # cosine distance
+        qn = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+        cn = centroids / jnp.maximum(
+            jnp.linalg.norm(centroids, axis=1, keepdims=True), 1e-9)
+        vn = vectors / jnp.maximum(
+            jnp.linalg.norm(vectors, axis=1, keepdims=True), 1e-9)
+        d_qc = 1.0 - qn @ cn.T
+        d_qn = 1.0 - qn @ vn.T
+    # top-nprobe clusters per query → candidate mask over vectors
+    # (via a (Q, K) probe bitmap gathered by vector code — never a
+    # (Q, nprobe, N) broadcast)
+    _, probe = jax.lax.top_k(-d_qc, nprobe)                 # (Q, nprobe)
+    q_count = queries.shape[0]
+    probemask = jnp.zeros((q_count, centroids.shape[0]), dtype=jnp.bool_)
+    probemask = probemask.at[jnp.arange(q_count)[:, None], probe].set(True)
+    in_probe = probemask[:, codes]                          # (Q, N)
+    masked = jnp.where(jnp.logical_and(in_probe, valid[None, :]),
+                       d_qn, jnp.inf)
+    neg, idx = jax.lax.top_k(-masked, k)
+    return -neg, idx
+
+
+def init_centroids(x: np.ndarray, k: int, seed: int = 0) -> np.ndarray:
+    """k-means++-lite init on host: random distinct samples."""
+    rng = np.random.default_rng(seed)
+    n = len(x)
+    if n >= k:
+        idx = rng.choice(n, k, replace=False)
+    else:
+        idx = rng.choice(max(n, 1), k, replace=True)
+    return np.ascontiguousarray(x[idx], dtype=np.float32)

@@ -20,6 +20,11 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
         raise errors.unsupported(f"index type {using}")
     analyzer_name = str(options.get("tokenizer", options.get("analyzer",
                                                              "text")))
+    if using == "ivf":
+        from .ivf import build_ivf_index
+        if len(columns) != 1:
+            raise errors.unsupported("ivf index over multiple columns")
+        return build_ivf_index(provider, columns[0], options)
     searchers = {}
     if using == "inverted":
         an = get_analyzer(analyzer_name)

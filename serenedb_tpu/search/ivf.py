@@ -1,0 +1,134 @@
+"""IVF vector index over a table column.
+
+Reference analog: the IVF ANN index (IvfBuilder/centroids/quantizer,
+libs/iresearch/formats/ivf/ivf_writer.hpp:44-100) with the session knobs
+sdb_nprobe / sdb_rerank_factor (reference: config_variables.cpp).
+
+Vectors live in a VARCHAR column as JSON arrays ('[0.1, 0.2, ...]'); the
+index parses them once at build into an HBM-resident (N, D) f32 matrix plus
+k-means cluster codes. Queries batch through ops/vector.ivf_topk.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import errors
+from ..ops import vector as vops
+
+DEFAULT_LISTS = 64
+KMEANS_ITERS = 8
+
+
+def parse_vector(text: Optional[str], dim: Optional[int] = None,
+                 ) -> Optional[np.ndarray]:
+    if text is None:
+        return None
+    try:
+        v = np.asarray(json.loads(text), dtype=np.float32)
+    except (json.JSONDecodeError, ValueError):
+        raise errors.SqlError(errors.INVALID_TEXT_REPRESENTATION,
+                              f"invalid vector literal: {text[:40]!r}")
+    if v.ndim != 1:
+        raise errors.SqlError(errors.INVALID_TEXT_REPRESENTATION,
+                              "vector literal must be a flat array")
+    if dim is not None and len(v) != dim:
+        raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                              f"expected {dim} dimensions, got {len(v)}")
+    return v
+
+
+@dataclass
+class IvfIndex:
+    column: str
+    dim: int
+    lists: int
+    metric: str                 # l2 | ip | cos
+    centroids: np.ndarray       # (lists, dim) f32
+    codes: jnp.ndarray          # (N_pad,) int32 device
+    vectors: jnp.ndarray        # (N_pad, dim) f32 device
+    valid: jnp.ndarray          # (N_pad,) bool device
+    num_rows: int
+    data_version: int
+    using: str = "ivf"
+    columns: tuple = ()
+    options: dict = None
+
+    def __post_init__(self):
+        self.columns = (self.column,)
+        if self.options is None:
+            self.options = {}
+
+    def search(self, queries: np.ndarray, k: int, nprobe: int,
+               ) -> tuple[np.ndarray, np.ndarray]:
+        """Batched: queries (Q, dim) → (distances (Q,k), row indices)."""
+        q = jnp.asarray(np.ascontiguousarray(queries, dtype=np.float32))
+        nprobe = max(1, min(nprobe, self.lists))
+        kk = min(max(k, 1), max(self.num_rows, 1))
+        d, idx = vops.ivf_topk(q, self.vectors, self.valid,
+                               jnp.asarray(self.centroids),
+                               self.codes, kk, nprobe, self.metric)
+        return np.asarray(d), np.asarray(idx)
+
+
+def build_ivf_index(provider, column: str, options: dict) -> IvfIndex:
+    col = provider.full_batch([column]).column(column)
+    if not col.type.is_string:
+        raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                              "ivf index requires a JSON-array vector column")
+    texts = col.to_pylist()
+    dim = int(options.get("dim", 0)) or None
+    vecs = []
+    valid = []
+    for t in texts:
+        v = parse_vector(t, dim) if t is not None else None
+        if v is None:
+            vecs.append(None)
+            valid.append(False)
+        else:
+            if dim is None:
+                dim = len(v)
+            vecs.append(v)
+            valid.append(True)
+    if dim is None:
+        dim = 1
+    n = len(texts)
+    mat = np.zeros((max(n, 1), dim), dtype=np.float32)
+    for i, v in enumerate(vecs):
+        if v is not None:
+            mat[i] = v
+    valid_arr = np.asarray(valid if n else [False], dtype=bool)
+    lists = int(options.get("lists", options.get("nlist", DEFAULT_LISTS)))
+    lists = max(1, min(lists, max(int(valid_arr.sum()), 1)))
+    metric = str(options.get("metric", "l2")).lower()
+    if metric not in ("l2", "ip", "cos"):
+        raise errors.unsupported(f"ivf metric {metric}")
+    train = mat[valid_arr] if valid_arr.any() else mat[:1]
+    init = vops.init_centroids(train, lists)
+    centroids = np.asarray(vops.kmeans_fit(
+        jnp.asarray(train), jnp.asarray(init), lists, KMEANS_ITERS))
+    mat_p = vops.pad_rows(mat)
+    valid_p = np.zeros(len(mat_p), dtype=bool)
+    valid_p[:n] = valid_arr[:n] if n else False
+    codes = np.zeros(len(mat_p), dtype=np.int32)
+    codes[:len(mat)] = np.asarray(vops.assign_clusters(
+        jnp.asarray(mat), jnp.asarray(centroids)))
+    return IvfIndex(
+        column=column, dim=dim, lists=lists, metric=metric,
+        centroids=centroids, codes=jnp.asarray(codes),
+        vectors=jnp.asarray(mat_p), valid=jnp.asarray(valid_p),
+        num_rows=n, data_version=provider.data_version,
+        options=dict(options))
+
+
+def find_ivf_index(provider, column: str) -> Optional[IvfIndex]:
+    for idx in getattr(provider, "indexes", {}).values():
+        if isinstance(idx, IvfIndex) and idx.column == column and \
+                idx.data_version == provider.data_version:
+            return idx
+    return None

@@ -34,8 +34,12 @@ def bind_operator(binder, e):
     from .query import match_phrase_brute, match_query_brute
 
     if e.op in ("<->", "<#>", "<=>"):
-        raise errors.unsupported("vector distance operators need an ivf index "
-                                 "(coming with the vector layer)")
+        # vector distance operators → vec_* functions (CPU oracle; the
+        # rewrite pass claims ORDER BY ... LIMIT k into the IVF index scan)
+        fname = {"<->": "vec_l2", "<#>": "vec_ip", "<=>": "vec_cos"}[e.op]
+        left = binder.bind(e.left)
+        right = binder.bind(e.right)
+        return binder._call(fname, [left, right])
     left = binder.bind(e.left)
     right = binder.bind(e.right)
     if not left.type.is_string:

@@ -77,7 +77,14 @@ class EsApi:
             t = self._table(index)
             for fname, fdef in props.items():
                 ftype = (fdef or {}).get("type", "text")
-                self._ensure_column(t, fname, _es_type_to_sql(ftype))
+                self._ensure_column(t, fname, _es_type_to_sql(ftype),
+                                    text_index=(ftype != "dense_vector"))
+                if ftype == "dense_vector":
+                    dims = int((fdef or {}).get("dims", 0))
+                    opts = f" WITH (dim = {dims})" if dims else ""
+                    self.conn.execute(
+                        f'CREATE INDEX ON {_ident(t.name)} USING ivf '
+                        f'({_ident(fname)}){opts}')
         return {"acknowledged": True, "shards_acknowledged": True,
                 "index": index}
 
@@ -102,7 +109,8 @@ class EsApi:
             props[name] = {"type": _sql_type_to_es(typ)}
         return {index: {"mappings": {"properties": props}}}
 
-    def _ensure_column(self, t: MemTable, name: str, typ: dt.SqlType):
+    def _ensure_column(self, t: MemTable, name: str, typ: dt.SqlType,
+                       text_index: bool = True):
         if name in t.column_names:
             return
         with self.db.lock:
@@ -110,7 +118,7 @@ class EsApi:
             col = Column.from_pylist([None] * full.num_rows, typ)
             t.replace(Batch(list(full.names) + [name],
                             list(full.columns) + [col]))
-        if typ.is_string and not name.startswith("_"):
+        if text_index and typ.is_string and not name.startswith("_"):
             # text fields get inverted indexes so match/bm25 use the TPU
             # scoring path (refreshed by maintenance / _refresh)
             try:
@@ -139,8 +147,15 @@ class EsApi:
             self._delete_by_id(t, doc_id)
             row = {"_id": doc_id, "_source": json.dumps(doc)}
             for k, v in doc.items():
+                if isinstance(v, list) and v and \
+                        all(isinstance(x, (int, float)) and
+                            not isinstance(x, bool) for x in v):
+                    # numeric arrays = dense vectors, stored as JSON text
+                    self._ensure_column(t, k, dt.VARCHAR, text_index=False)
+                    row[k] = json.dumps(v)
+                    continue
                 if isinstance(v, (dict, list)):
-                    continue  # objects/arrays live in _source only (v1)
+                    continue  # other objects/arrays live in _source only
                 self._ensure_column(t, k, _value_sql_type(v))
                 row[k] = v
             incoming = Batch.from_pydict(
@@ -250,6 +265,8 @@ class EsApi:
         t = self._table(index)
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
+        if "knn" in body:
+            return self._search_knn(index, body, size, from_)
         where, score_col = self._translate_query(body.get("query"))
         cols = '"_id", "_source"'
         order = ""
@@ -284,6 +301,55 @@ class EsApi:
                      "max_score": max_score if hits else None,
                      "hits": hits},
         }
+
+    def _search_knn(self, index: str, body: dict, size: int,
+                    from_: int) -> dict:
+        """kNN search, optionally hybrid with a text query via RRF fusion
+        (reference BASELINE config 5: BM25 + kNN with RRF top-k)."""
+        knn = body["knn"]
+        field = knn.get("field")
+        qvec = json.dumps(knn.get("query_vector", []))
+        k = int(knn.get("k", size))
+        cand = max(k, int(knn.get("num_candidates", k * 4)))
+        dist = f"vec_l2({_ident(field)}, {_sql_str(qvec)})"
+        # no IS NOT NULL guard: it would block the IvfScan pushdown, and
+        # both paths already handle NULL vectors (valid mask / NULLS LAST)
+        sql = (f'SELECT "_id", "_source", {dist} AS _dist FROM '
+               f'{_ident(index)} '
+               f"ORDER BY _dist LIMIT {cand}")
+        knn_rows = [r for r in self.conn.execute(sql).rows()
+                    if r[2] is not None]
+        knn_ranked = [(r[0], r[1]) for r in knn_rows]
+        if body.get("query") is None:
+            hits = []
+            page = knn_ranked[:k][from_:from_ + size]
+            for off, (doc_id, src) in enumerate(page):
+                d = float(knn_rows[from_ + off][2])
+                hits.append({"_index": index, "_id": doc_id,
+                             "_score": 1.0 / (1.0 + d),
+                             "_source": json.loads(src) if src else {}})
+            return _hits_response(hits, min(len(knn_ranked), k))
+        # hybrid: text query ranking + knn ranking → reciprocal rank fusion
+        text_res = self.search(index, {"query": body["query"],
+                                       "size": cand, "from": 0})
+        text_ranked = [(h["_id"], json.dumps(h["_source"]))
+                       for h in text_res["hits"]["hits"]]
+        RRF_K = 60
+        scores: dict[str, float] = {}
+        sources: dict[str, str] = {}
+        for rank, (doc_id, src) in enumerate(knn_ranked):
+            scores[doc_id] = scores.get(doc_id, 0.0) + 1.0 / (RRF_K + rank + 1)
+            sources[doc_id] = src
+        for rank, (doc_id, src) in enumerate(text_ranked):
+            scores[doc_id] = scores.get(doc_id, 0.0) + 1.0 / (RRF_K + rank + 1)
+            sources[doc_id] = src
+        fused = sorted(scores.items(), key=lambda kv: -kv[1])
+        hits = []
+        for doc_id, score in fused[from_:from_ + size]:
+            src = sources[doc_id]
+            hits.append({"_index": index, "_id": doc_id, "_score": score,
+                         "_source": json.loads(src) if src else {}})
+        return _hits_response(hits, len(fused))
 
     def cat_indices(self) -> list[dict]:
         out = []
@@ -391,6 +457,16 @@ def _as_list(v) -> list:
     if v is None:
         return []
     return v if isinstance(v, list) else [v]
+
+
+def _hits_response(hits: list[dict], total: int) -> dict:
+    return {
+        "took": 1, "timed_out": False,
+        "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+        "hits": {"total": {"value": total, "relation": "eq"},
+                 "max_score": max((h["_score"] for h in hits), default=None),
+                 "hits": hits},
+    }
 
 
 def _ident(name) -> str:

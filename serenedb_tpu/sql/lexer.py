@@ -35,13 +35,12 @@ class Token:
         return f"{self.kind.name}:{self.value!r}"
 
 
-# longest-match first
-_OPERATORS = [
+_OPERATORS = sorted([
     "::", "<=", ">=", "<>", "!=", "||", "##", "@@", "<->", "<#>", "<=>",
     "~*", "!~*", "!~",
     "(", ")", ",", ";", "+", "-", "*", "/", "%", "<", ">", "=", ".", "~",
     "[", "]", ":",
-]
+], key=len, reverse=True)  # longest match first (<=> before <=)
 
 
 def tokenize(sql: str) -> list[Token]:

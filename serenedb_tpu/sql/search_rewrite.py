@@ -60,6 +60,9 @@ def _rewrite_children(plan: PlanNode) -> None:
 
 # -- pattern 2: scored top-k ----------------------------------------------
 
+_VEC_FUNCS = {"vec_l2", "vec_ip", "vec_cos"}
+
+
 def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
     limit = plan if isinstance(plan, LimitNode) else None
     if limit is None or limit.limit is None:
@@ -72,12 +75,14 @@ def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
     if not isinstance(inner, SortNode):
         return None
     sort = inner
-    if len(sort.key_indices) != 1 or not sort.descs[0]:
+    if len(sort.key_indices) != 1:
         return None
     if not isinstance(sort.child, ProjectNode):
         return None
     proj = sort.child
     key_expr = proj.exprs[sort.key_indices[0]]
+    if not sort.descs[0]:
+        return _match_ann_topk(plan, limit, sort, proj, key_expr)
     if not (isinstance(key_expr, BoundFunc) and
             key_expr.name in _SCORER_FUNCS and key_expr.args and
             isinstance(key_expr.args[0], BoundColumn)):
@@ -95,6 +100,55 @@ def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
     node = SearchScanNode(scan.provider, scan.columns, scan.alias,
                           search_col, qnode, None, k, with_score=True)
     _rewire_scorers(proj.exprs, node)
+    proj.child = node
+    return plan
+
+
+def _match_ann_topk(plan: PlanNode, limit, sort, proj,
+                    key_expr) -> Optional[PlanNode]:
+    """ORDER BY vec_*(col, 'literal') ASC LIMIT k over an ivf-indexed
+    column → IvfScanNode (reference: TryClaimAnnRange)."""
+    from ..exec.search_scan import IvfScanNode
+    from ..search.ivf import find_ivf_index, parse_vector
+    from .expr import BoundLiteral
+    if not (isinstance(key_expr, BoundFunc) and
+            key_expr.name in _VEC_FUNCS and len(key_expr.args) == 2):
+        return None
+    col, lit = key_expr.args
+    if not (isinstance(col, BoundColumn) and
+            isinstance(lit, BoundLiteral) and isinstance(lit.value, str)):
+        return None
+    if not isinstance(proj.child, ScanNode):
+        return None
+    scan = proj.child
+    if scan.filter is not None:
+        return None  # predicate + ANN composition comes later
+    vec_col = scan.columns[col.index]
+    idx = find_ivf_index(scan.provider, vec_col)
+    if idx is None:
+        return None
+    metric = {"vec_l2": "l2", "vec_ip": "ip", "vec_cos": "cos"}[key_expr.name]
+    if idx.metric != metric:
+        return None
+    qvec = parse_vector(lit.value, idx.dim)
+    k = limit.limit + limit.offset
+    node = IvfScanNode(scan.provider, scan.columns, scan.alias, vec_col,
+                       qvec, k)
+    dist_ref = BoundColumn(len(node.columns), dt.DOUBLE, IvfScanNode.DIST_COL)
+
+    def rec(e: BoundExpr) -> BoundExpr:
+        if isinstance(e, BoundFunc):
+            if e.name in _VEC_FUNCS and len(e.args) == 2 and \
+                    isinstance(e.args[0], BoundColumn) and \
+                    e.args[0].index == col.index and \
+                    isinstance(e.args[1], BoundLiteral) and \
+                    e.args[1].value == lit.value:
+                return dist_ref
+            e.args = [rec(a) for a in e.args]
+        return e
+
+    for i in range(len(proj.exprs)):
+        proj.exprs[i] = rec(proj.exprs[i])
     proj.child = node
     return plan
 

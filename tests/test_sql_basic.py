@@ -264,3 +264,11 @@ def test_full_text_operators(conn):
         "SELECT count(*) FROM docs WHERE body @@ 'quick & dog'").scalar() == 1
     assert c.execute(
         "SELECT count(*) FROM docs WHERE body @@ 'fox | dog'").scalar() == 3
+
+
+def test_lexer_longest_match_operators():
+    # regression: <=> must not lex as <= + > (operator table ordering)
+    c = Database().connect()
+    assert c.execute("SELECT '[1,0]' <=> '[0,1]'").scalar() == pytest.approx(1.0)
+    assert c.execute("SELECT '[1,2]' <#> '[3,4]'").scalar() == -11.0
+    assert c.execute("SELECT 2 <= 3").scalar() is True
