@@ -9,6 +9,7 @@ RBAC) progressively replace the in-memory structures in this module.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
@@ -25,6 +26,12 @@ from .sql.binder import ExprBinder, Scope, cast_column
 from .sql.planner import Planner, TableResolver
 from .utils import faults, log, metrics
 from .utils.config import SessionSettings
+
+
+# current connection for context-dependent functions (nextval/currval —
+# the reference threads ClientContext through DuckDB function binding)
+CURRENT_CONNECTION: contextvars.ContextVar = contextvars.ContextVar(
+    "serene_current_connection", default=None)
 
 
 @dataclass
@@ -76,10 +83,16 @@ class Database(TableResolver):
     layer). With `path`, all DDL/DML is durable: definitions in
     catalog.json, data as parquet snapshots + WAL delta (storage/)."""
 
+    #: sequence counters persist in batches of this many values — a crash
+    #: skips at most one batch, never repeats (reference: batched counter
+    #: persistence, server/catalog/sequence.cpp)
+    SEQ_BATCH = 32
+
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self.lock = threading.RLock()
         self.schemas: dict[str, SchemaObj] = {"main": SchemaObj("main")}
+        self.sequences: dict[str, dict] = {}
         # parquet providers are cached by path so repeated queries reuse the
         # provider's HBM column cache and compiled XLA programs
         self._parquet_cache: dict[str, ParquetTable] = {}
@@ -131,6 +144,14 @@ class Database(TableResolver):
             q = pickle.loads(base64.b64decode(vdef["ast_b64"]))
             self.schemas[schema].views[name.lower()] = ViewDef(name, q, "")
 
+        for name, sdef in meta.get("sequences", {}).items():
+            # resume at the persisted high-water mark: crash skips at most
+            # one batch of values, never repeats
+            self.sequences[name] = {"value": sdef["hwm"],
+                                    "increment": sdef["increment"],
+                                    "start": sdef["start"],
+                                    "hwm": sdef["hwm"]}
+
         def committed_of(key: str) -> int:
             tdef = meta.get("tables", {}).get(key)
             if tdef is None:
@@ -158,6 +179,73 @@ class Database(TableResolver):
                     t, idef["columns"], idef["using"], idef["options"])
             except errors.SqlError:
                 log.warn("boot", f"index {idx_name} rebuild failed")
+
+    # -- sequences ---------------------------------------------------------
+
+    def _seq_key(self, name: str) -> str:
+        """Sequences are schema-scoped like tables: bare names live in
+        main, qualified names ('s2.seq') are used verbatim."""
+        return name if "." in name else f"main.{name}"
+
+    def create_sequence(self, name: str, start: int, increment: int,
+                        if_not_exists: bool):
+        name = self._seq_key(name)
+        with self.lock:
+            if name in self.sequences:
+                if if_not_exists:
+                    return
+                raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                                      f'sequence "{name}" already exists')
+            self.sequences[name] = {"value": start - increment,
+                                    "increment": increment, "start": start,
+                                    "hwm": start - increment}
+            self._persist_sequences()
+
+    def drop_sequence(self, name: str, if_exists: bool):
+        name = self._seq_key(name)
+        with self.lock:
+            if name not in self.sequences:
+                if if_exists:
+                    return
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'sequence "{name}" does not exist')
+            del self.sequences[name]
+            self._persist_sequences()
+
+    def sequence_nextval(self, name: str) -> int:
+        name = self._seq_key(name)
+        with self.lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'sequence "{name}" does not exist')
+            seq["value"] += seq["increment"]
+            if (seq["increment"] > 0 and seq["value"] > seq["hwm"]) or \
+                    (seq["increment"] < 0 and seq["value"] < seq["hwm"]):
+                seq["hwm"] = seq["value"] + seq["increment"] * self.SEQ_BATCH
+                self._persist_sequences()
+            return seq["value"]
+
+    def sequence_setval(self, name: str, value: int) -> int:
+        name = self._seq_key(name)
+        with self.lock:
+            seq = self.sequences.get(name)
+            if seq is None:
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'sequence "{name}" does not exist')
+            seq["value"] = value
+            seq["hwm"] = value
+            self._persist_sequences()
+            return value
+
+    def _persist_sequences(self):
+        if self.store is None:
+            return
+        snap = {n: {"hwm": s["hwm"], "increment": s["increment"],
+                    "start": s["start"]}
+                for n, s in self.sequences.items()}
+        self.store.update_meta(
+            lambda m: m.__setitem__("sequences", snap))
 
     def _table_by_key(self, key: str):
         schema, name = key.split(".", 1)
@@ -366,6 +454,7 @@ class Connection:
                 errors.IN_FAILED_TRANSACTION,
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
+        token = CURRENT_CONNECTION.set(self)
         try:
             with metrics.QUERIES_ACTIVE.scoped():
                 return self._dispatch(st, params)
@@ -373,6 +462,8 @@ class Connection:
             if self.in_txn:
                 self.txn_failed = True
             raise
+        finally:
+            CURRENT_CONNECTION.reset(token)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -403,7 +494,14 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE VIEW")
         if isinstance(st, ast.CreateIndex):
             return self._create_index(st)
+        if isinstance(st, ast.CreateSequence):
+            self.db.create_sequence(".".join(st.name), st.start,
+                                    st.increment, st.if_not_exists)
+            return QueryResult(Batch([], []), "CREATE SEQUENCE")
         if isinstance(st, ast.Drop):
+            if st.kind == "sequence":
+                self.db.drop_sequence(".".join(st.name), st.if_exists)
+                return QueryResult(Batch([], []), "DROP SEQUENCE")
             self.db.drop(st.kind, st.name, st.if_exists, st.cascade)
             if self.db.store is not None:
                 schema, name = self.db._split(st.name)

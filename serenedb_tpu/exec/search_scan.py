@@ -33,7 +33,7 @@ class SearchScanNode(PlanNode):
     def __init__(self, provider: TableProvider, columns: list[str],
                  alias: str, search_column: str, qnode: QNode,
                  residual: Optional[BoundExpr], topk: Optional[int],
-                 with_score: bool):
+                 with_score: bool, scorer: str = "bm25"):
         self.provider = provider
         self.columns = columns
         self.alias = alias
@@ -42,6 +42,7 @@ class SearchScanNode(PlanNode):
         self.residual = residual
         self.topk = topk
         self.with_score = with_score
+        self.scorer = scorer
         self.names = list(columns) + ([SCORE_COL] if with_score else [])
         self.types = [provider.type_of(c) for c in columns] + \
             ([dt.FLOAT] if with_score else [])
@@ -68,7 +69,7 @@ class SearchScanNode(PlanNode):
                                "(stale rewrite)")
         full = self.provider.full_batch(self.columns)
         if self.topk is not None:
-            scores, docs = searcher.topk(self.qnode, self.topk)
+            scores, docs = searcher.topk(self.qnode, self.topk, self.scorer)
             out = full.take(docs.astype(np.int64))
             if self.with_score:
                 out = Batch(list(self.names),
@@ -87,7 +88,8 @@ class SearchScanNode(PlanNode):
             docs = docs[col.validity[docs]]
         out = full.take(docs.astype(np.int64))
         if self.with_score:
-            scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1))
+            scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1),
+                                          self.scorer)
             smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
             smap[sdocs] = scores
             out = Batch(list(self.names),

@@ -761,3 +761,65 @@ def _vec_dims(ts):
                 out[i] = len(parse_vector(vals[i]))
         return _result(dt.BIGINT, out, cols)
     return FunctionResolution(dt.BIGINT, impl)
+
+
+# -- sequence functions (context-dependent; reference: functions/sequence.cpp)
+
+def _current_conn():
+    from ..engine import CURRENT_CONNECTION
+    conn = CURRENT_CONNECTION.get()
+    if conn is None:
+        raise errors.SqlError("55000",
+                              "sequence functions need a connection context")
+    return conn
+
+
+@register("nextval")
+def _nextval(ts):
+    def impl(cols, n):
+        conn = _current_conn()
+        names = string_values(cols[0])
+        valid = propagate_nulls(cols)
+        cur = dict(getattr(conn, "seq_currval", {}))
+        out = np.zeros(n, dtype=np.int64)
+        for i, nm in enumerate(names):
+            if valid is not None and not valid[i]:
+                continue  # NULL name → NULL result, no side effect
+            out[i] = conn.db.sequence_nextval(nm)
+            cur[nm] = int(out[i])
+        conn.seq_currval = cur
+        return _result(dt.BIGINT, out, cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("currval")
+def _currval(ts):
+    def impl(cols, n):
+        conn = _current_conn()
+        names = string_values(cols[0])
+        cur = getattr(conn, "seq_currval", {})
+        out = np.zeros(n, dtype=np.int64)
+        for i, nm in enumerate(names):
+            if nm not in cur:
+                raise errors.SqlError(
+                    "55000", f'currval of sequence "{nm}" is not yet '
+                             "defined in this session")
+            out[i] = cur[nm]
+        return _result(dt.BIGINT, out, cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("setval")
+def _setval(ts):
+    def impl(cols, n):
+        conn = _current_conn()
+        names = string_values(cols[0])
+        vals = cols[1].data.astype(np.int64)
+        valid = propagate_nulls(cols)
+        out = np.zeros(n, dtype=np.int64)
+        for i, (nm, v) in enumerate(zip(names, vals)):
+            if valid is not None and not valid[i]:
+                continue
+            out[i] = conn.db.sequence_setval(nm, int(v))
+        return _result(dt.BIGINT, out, cols)
+    return FunctionResolution(dt.BIGINT, impl)

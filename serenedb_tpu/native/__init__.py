@@ -1,0 +1,145 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA/Pallas; the CPU-bound runtime pieces mirror the
+reference's native implementation — currently the inverted-index builder
+(tokenize + postings in one pass). Compiled on first use with g++ into
+_build/; everything degrades gracefully to the Python implementations when
+no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(__file__), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(os.path.dirname(__file__), "indexer.cpp")
+        so = os.path.join(_build_dir(), "libsdbnative.so")
+        try:
+            if not os.path.exists(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src):
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                       "-o", so + ".tmp", src]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(so + ".tmp", so)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warn("native", f"native indexer unavailable: {e}")
+            return None
+        lib.sdb_build_index.restype = ctypes.c_void_p
+        lib.sdb_build_index.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.c_int64]
+        for name in ("sdb_num_terms", "sdb_postings_len",
+                     "sdb_positions_len", "sdb_terms_bytes",
+                     "sdb_total_tokens"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.sdb_fill.restype = None
+        lib.sdb_fill.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_char_p] + [ctypes.POINTER(ctypes.c_int64)] + \
+            [ctypes.POINTER(ctypes.c_int32)] + \
+            [ctypes.POINTER(ctypes.c_int64)] + \
+            [ctypes.POINTER(ctypes.c_int32)] * 2 + \
+            [ctypes.POINTER(ctypes.c_int64)] + \
+            [ctypes.POINTER(ctypes.c_int32)] * 2
+        lib.sdb_free.restype = None
+        lib.sdb_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def build_field_index_native(texts) -> Optional["FieldIndex"]:
+    """Build a FieldIndex with the C++ one-pass indexer. Returns None when
+    the native library is unavailable (caller falls back to Python)."""
+    lib = load()
+    if lib is None:
+        return None
+    from ..search.segment import FieldIndex
+
+    parts = []
+    doc_offsets = np.zeros(len(texts) + 1, dtype=np.int64)
+    total = 0
+    for i, t in enumerate(texts):
+        if t:
+            b = t.encode("utf-8")
+            parts.append(b)
+            total += len(b)
+        doc_offsets[i + 1] = total
+    buf = b"".join(parts)
+
+    handle = lib.sdb_build_index(
+        buf, doc_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(texts))
+    try:
+        t_count = lib.sdb_num_terms(handle)
+        p_len = lib.sdb_postings_len(handle)
+        pp_len = lib.sdb_positions_len(handle)
+        t_bytes = lib.sdb_terms_bytes(handle)
+        total_tokens = lib.sdb_total_tokens(handle)
+
+        terms_buf = ctypes.create_string_buffer(max(int(t_bytes), 1))
+        term_offsets = np.zeros(t_count + 1, dtype=np.int64)
+        doc_freq = np.zeros(max(t_count, 1), dtype=np.int32)
+        offsets = np.zeros(t_count + 1, dtype=np.int64)
+        post_docs = np.zeros(max(p_len, 1), dtype=np.int32)
+        post_tfs = np.zeros(max(p_len, 1), dtype=np.int32)
+        pos_offsets = np.zeros(p_len + 1, dtype=np.int64)
+        positions = np.zeros(max(pp_len, 1), dtype=np.int32)
+        norms = np.zeros(max(len(texts), 1), dtype=np.int32)
+
+        def p64(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+        def p32(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+        lib.sdb_fill(handle, terms_buf, p64(term_offsets), p32(doc_freq),
+                     p64(offsets), p32(post_docs), p32(post_tfs),
+                     p64(pos_offsets), p32(positions), p32(norms))
+    finally:
+        lib.sdb_free(handle)
+
+    raw = terms_buf.raw
+    terms = np.asarray(
+        [raw[term_offsets[i]:term_offsets[i + 1]].decode("utf-8")
+         for i in range(t_count)], dtype=object)
+    return FieldIndex(
+        terms=terms,
+        doc_freq=doc_freq[:t_count],
+        offsets=offsets,
+        post_docs=post_docs[:p_len],
+        post_tfs=post_tfs[:p_len],
+        pos_offsets=pos_offsets,
+        positions=positions[:pp_len],
+        norms=norms[:len(texts)],
+        block_max_tf=np.empty(0, dtype=np.int32),
+        block_offsets=np.zeros(t_count + 1, dtype=np.int64),
+        total_tokens=int(total_tokens),
+    )

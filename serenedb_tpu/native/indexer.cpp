@@ -1,0 +1,170 @@
+// Native inverted-index builder: tokenize + postings in one pass.
+//
+// Reference analog: IResearch's segment_writer/field_data pipeline
+// (libs/iresearch/index/segment_writer.cpp) — the analysis/indexing hot
+// path is native C++ in the reference, and stays native here: Python hands
+// a concatenated UTF-8 buffer of documents, C++ returns the full
+// FieldIndex arrays (sorted terms, postings, positions, norms) ready to
+// wrap as numpy arrays.
+//
+// Tokenization matches the engine's "simple" analyzer for ASCII: word
+// characters are [A-Za-z0-9_] (lowercased) plus any non-ASCII byte
+// (UTF-8 continuation-safe). Stemming/stopwords stay in Python analyzers.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Posting {
+    int32_t doc;
+    std::vector<int32_t> positions;
+};
+
+struct TermEntry {
+    std::vector<Posting> postings;
+};
+
+struct Builder {
+    // term -> postings; string keys own their bytes
+    std::unordered_map<std::string, TermEntry> terms;
+    std::vector<int32_t> norms;
+    int64_t total_tokens = 0;
+};
+
+inline bool is_word_byte(unsigned char c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+           (c >= '0' && c <= '9') || c == '_' || c >= 0x80;
+}
+
+inline char lower_ascii(char c) {
+    return (c >= 'A' && c <= 'Z') ? char(c - 'A' + 'a') : c;
+}
+
+}  // namespace
+
+struct BuildResult {
+    std::vector<std::string> sorted_terms;
+    std::vector<int32_t> doc_freq;
+    std::vector<int64_t> offsets;       // (T+1)
+    std::vector<int32_t> post_docs;
+    std::vector<int32_t> post_tfs;
+    std::vector<int64_t> pos_offsets;   // (P+1)
+    std::vector<int32_t> positions;
+    std::vector<int32_t> norms;
+    int64_t total_tokens = 0;
+};
+
+extern "C" {
+
+BuildResult* sdb_build_index(const char* buf, const int64_t* doc_offsets,
+                             int64_t n_docs) {
+    Builder b;
+    b.norms.resize(static_cast<size_t>(n_docs), 0);
+    std::string token;
+    for (int64_t d = 0; d < n_docs; ++d) {
+        const char* start = buf + doc_offsets[d];
+        const char* end = buf + doc_offsets[d + 1];
+        int32_t pos = 0;
+        const char* p = start;
+        // doc_offsets[d] == doc_offsets[d+1] encodes NULL/empty: norm 0
+        while (p < end) {
+            while (p < end && !is_word_byte(static_cast<unsigned char>(*p)))
+                ++p;
+            if (p >= end) break;
+            token.clear();
+            while (p < end && is_word_byte(static_cast<unsigned char>(*p))) {
+                token.push_back(lower_ascii(*p));
+                ++p;
+            }
+            auto& entry = b.terms[token];
+            if (entry.postings.empty() ||
+                entry.postings.back().doc != static_cast<int32_t>(d)) {
+                entry.postings.push_back({static_cast<int32_t>(d), {}});
+            }
+            entry.postings.back().positions.push_back(pos);
+            ++pos;
+        }
+        b.norms[static_cast<size_t>(d)] = pos;
+        b.total_tokens += pos;
+    }
+
+    auto* r = new BuildResult();
+    r->norms = std::move(b.norms);
+    r->total_tokens = b.total_tokens;
+    r->sorted_terms.reserve(b.terms.size());
+    for (auto& kv : b.terms) r->sorted_terms.push_back(kv.first);
+    std::sort(r->sorted_terms.begin(), r->sorted_terms.end());
+
+    r->offsets.push_back(0);
+    r->pos_offsets.push_back(0);
+    for (const auto& term : r->sorted_terms) {
+        auto& entry = b.terms[term];
+        r->doc_freq.push_back(static_cast<int32_t>(entry.postings.size()));
+        for (auto& p : entry.postings) {
+            r->post_docs.push_back(p.doc);
+            r->post_tfs.push_back(static_cast<int32_t>(p.positions.size()));
+            r->positions.insert(r->positions.end(), p.positions.begin(),
+                                p.positions.end());
+            r->pos_offsets.push_back(
+                static_cast<int64_t>(r->positions.size()));
+        }
+        r->offsets.push_back(static_cast<int64_t>(r->post_docs.size()));
+    }
+    return r;
+}
+
+int64_t sdb_num_terms(BuildResult* r) {
+    return static_cast<int64_t>(r->sorted_terms.size());
+}
+int64_t sdb_postings_len(BuildResult* r) {
+    return static_cast<int64_t>(r->post_docs.size());
+}
+int64_t sdb_positions_len(BuildResult* r) {
+    return static_cast<int64_t>(r->positions.size());
+}
+int64_t sdb_terms_bytes(BuildResult* r) {
+    int64_t total = 0;
+    for (const auto& t : r->sorted_terms) total += static_cast<int64_t>(t.size());
+    return total;
+}
+int64_t sdb_total_tokens(BuildResult* r) { return r->total_tokens; }
+
+// Fill pre-allocated numpy buffers (sizes from the getters above).
+void sdb_fill(BuildResult* r, char* terms_buf, int64_t* term_offsets,
+              int32_t* doc_freq, int64_t* offsets, int32_t* post_docs,
+              int32_t* post_tfs, int64_t* pos_offsets, int32_t* positions,
+              int32_t* norms) {
+    int64_t off = 0;
+    int64_t ti = 0;
+    term_offsets[0] = 0;
+    for (const auto& t : r->sorted_terms) {
+        std::memcpy(terms_buf + off, t.data(), t.size());
+        off += static_cast<int64_t>(t.size());
+        term_offsets[++ti] = off;
+    }
+    std::memcpy(doc_freq, r->doc_freq.data(),
+                r->doc_freq.size() * sizeof(int32_t));
+    std::memcpy(offsets, r->offsets.data(),
+                r->offsets.size() * sizeof(int64_t));
+    std::memcpy(post_docs, r->post_docs.data(),
+                r->post_docs.size() * sizeof(int32_t));
+    std::memcpy(post_tfs, r->post_tfs.data(),
+                r->post_tfs.size() * sizeof(int32_t));
+    std::memcpy(pos_offsets, r->pos_offsets.data(),
+                r->pos_offsets.size() * sizeof(int64_t));
+    std::memcpy(positions, r->positions.data(),
+                r->positions.size() * sizeof(int32_t));
+    std::memcpy(norms, r->norms.data(), r->norms.size() * sizeof(int32_t));
+}
+
+void sdb_free(BuildResult* r) { delete r; }
+
+}  // extern "C"

@@ -41,6 +41,17 @@ def idf_lucene(n_docs: int, doc_freq: np.ndarray) -> np.ndarray:
     return np.log(1.0 + (n_docs - df + 0.5) / (df + 0.5)).astype(np.float32)
 
 
+def idf_tfidf(n_docs: int, doc_freq: np.ndarray) -> np.ndarray:
+    """IResearch TFIDF idf: 1 + ln(N / (df + 1)) (reference: tfidf.cpp)."""
+    df = doc_freq.astype(np.float64)
+    return (1.0 + np.log(max(n_docs, 1) / (df + 1.0))).astype(np.float32)
+
+
+def idf_for(scorer: str, n_docs: int, doc_freq: np.ndarray) -> np.ndarray:
+    return idf_tfidf(n_docs, doc_freq) if scorer == "tfidf" \
+        else idf_lucene(n_docs, doc_freq)
+
+
 @dataclass
 class BlockStore:
     """Device-resident posting tiles for one field index."""
@@ -114,15 +125,17 @@ class QueryBatch:
 
 def assemble_query_batch(store: BlockStore, n_docs: int,
                          queries: list[tuple[np.ndarray, int]],
-                         doc_freq: np.ndarray) -> QueryBatch:
+                         doc_freq: np.ndarray,
+                         scorer: str = "bm25") -> QueryBatch:
     """queries: list of (term_ids, require_all) per query. Weights are the
-    Lucene idf of each term (computed here so one dispatch covers all)."""
+    scorer's per-term idf (computed here so one dispatch covers all)."""
     rows, row_w, row_q = [], [], []
     tails_d, tails_f, tails_w, tails_q = [], [], [], []
     require = []
     for qi, (term_ids, req) in enumerate(queries):
         require.append(req)
-        idf = idf_lucene(n_docs, doc_freq[np.asarray(term_ids, dtype=np.int64)]) \
+        idf = idf_for(scorer, n_docs,
+                      doc_freq[np.asarray(term_ids, dtype=np.int64)]) \
             if len(term_ids) else np.empty(0, dtype=np.float32)
         for k, tid in enumerate(term_ids):
             tid = int(tid)
@@ -188,12 +201,13 @@ def _pad_to(a: np.ndarray, n: int, fill) -> np.ndarray:
 
 @functools.partial(jax.jit,
                    static_argnames=("nb", "tt", "ndocs_pad", "k",
-                                    "n_queries", "any_require"))
+                                    "n_queries", "any_require", "scorer"))
 def score_topk_packed(block_docs: jax.Array, block_tfs: jax.Array,
                       norms: jax.Array, ints: jax.Array, floats: jax.Array,
                       nb: int, tt: int, ndocs_pad: int, k: int,
                       n_queries: int, any_require: bool, k1: float,
-                      b: float, avgdl: float) -> tuple[jax.Array, jax.Array]:
+                      b: float, avgdl: float,
+                      scorer: str = "bm25") -> tuple[jax.Array, jax.Array]:
     """Packed-argument entry (2 transfers): unpack then score."""
     row_idx = ints[:nb]
     row_qid = ints[nb:2 * nb]
@@ -206,7 +220,7 @@ def score_topk_packed(block_docs: jax.Array, block_tfs: jax.Array,
     return _score_topk(block_docs, block_tfs, norms, row_idx, row_w,
                        row_qid, tail_docs, tail_tfs, tail_w, tail_qid,
                        require, ndocs_pad, k, n_queries, any_require,
-                       k1, b, avgdl)
+                       k1, b, avgdl, scorer)
 
 
 @functools.partial(jax.jit,
@@ -229,19 +243,26 @@ def score_topk_batch(block_docs: jax.Array, block_tfs: jax.Array,
 def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
                 tail_docs, tail_tfs, tail_w, tail_qid, require,
                 ndocs_pad: int, k: int, n_queries: int, any_require: bool,
-                k1: float, b: float, avgdl: float):
-    """One dispatch scoring B queries: fused gather → BM25 → batched
+                k1: float, b: float, avgdl: float, scorer: str = "bm25"):
+    """One dispatch scoring B queries: fused gather → score → batched
     scatter-accumulate into (B, ndocs) → per-query top-k. Batching amortizes
-    host↔device dispatch latency — the QPS regime of the benchmark game."""
+    host↔device dispatch latency — the QPS regime of the benchmark game.
+
+    scorer: 'bm25' (k1/b saturation + length norm) or 'tfidf'
+    (sqrt(tf)·w — the IResearch TFIDF shape, tfidf.cpp; the per-term idf
+    part of w is supplied by the caller per scorer)."""
     avg = jnp.maximum(jnp.float32(avgdl), 1e-9)
 
     def contrib_of(docs, tfs, w):
         valid = docs >= 0
         safe_docs = jnp.where(valid, docs, 0)
         tfsf = tfs.astype(jnp.float32)
-        dl = norms[safe_docs].astype(jnp.float32)
-        denom = tfsf + k1 * (1.0 - b + b * dl / avg)
-        c = w * (k1 + 1.0) * tfsf / jnp.maximum(denom, 1e-9)
+        if scorer == "tfidf":
+            c = w * jnp.sqrt(tfsf)
+        else:
+            dl = norms[safe_docs].astype(jnp.float32)
+            denom = tfsf + k1 * (1.0 - b + b * dl / avg)
+            c = w * (k1 + 1.0) * tfsf / jnp.maximum(denom, 1e-9)
         return jnp.where(valid, c, 0.0), valid, safe_docs
 
     rdocs = block_docs[row_idx]            # (NB, 128)

@@ -182,10 +182,11 @@ class SegmentSearcher:
             needs_mask = True
         return tids, require_all, needs_mask, empty
 
-    def topk(self, node: QNode, k: int) -> tuple[np.ndarray, np.ndarray]:
-        return self.topk_batch([node], k)[0]
+    def topk(self, node: QNode, k: int,
+             scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
+        return self.topk_batch([node], k, scorer)[0]
 
-    def topk_batch(self, nodes: list[QNode], k: int,
+    def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
         """Top-k (scores, doc ids) for a batch of queries in ONE device
         dispatch (amortizes dispatch latency — the QPS regime). Pure term
@@ -201,7 +202,7 @@ class SegmentSearcher:
                     else np.empty(0, dtype=np.int64), req)
                    for tids, req, _, empty in shapes]
         qb = bm25_ops.assemble_query_batch(store, self.num_docs, queries,
-                                           self.index.doc_freq)
+                                           self.index.doc_freq, scorer)
         kk = bm25_ops.pad_k(min(max(k, 1), max(self.num_docs, 1)))
         kk = min(kk, nd_pad)
         ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
@@ -209,7 +210,7 @@ class SegmentSearcher:
             store.block_docs, store.block_tfs, store.norms,
             jnp.asarray(ints), jnp.asarray(floats), nb, tt,
             nd_pad, kk, nq, bool(qb.require.any()),
-            K1, B, self.index.avgdl)
+            K1, B, self.index.avgdl, scorer)
         vals, docs = jax.device_get((vals, docs))
         out = []
         for qi, (node, (tids, req, needs_mask, empty)) in enumerate(
@@ -234,7 +235,7 @@ class SegmentSearcher:
                 if (~ok[scores > 0.0]).any() and len(match) > 0:
                     # a non-match made device top-k → the survivors may not
                     # be the true top-k of the match set; exact CPU rescore
-                    scores, dd = self._cpu_score(match, tids, k)
+                    scores, dd = self._cpu_score(match, tids, k, scorer)
                 else:
                     scores, dd = scores[ok], dd[ok]
             keep = scores > 0.0
@@ -242,11 +243,11 @@ class SegmentSearcher:
             out.append((scores[:k], dd[:k]))
         return out
 
-    def _cpu_score(self, docs: np.ndarray, tids: list[int],
-                   k: int) -> tuple[np.ndarray, np.ndarray]:
+    def _cpu_score(self, docs: np.ndarray, tids: list[int], k: int,
+                   scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
         scores = np.zeros(len(docs), dtype=np.float64)
-        idf = bm25_ops.idf_lucene(self.num_docs,
-                                  self.index.doc_freq[np.asarray(tids)])
+        idf = bm25_ops.idf_for(scorer, self.num_docs,
+                               self.index.doc_freq[np.asarray(tids)])
         dl = self.index.norms[docs].astype(np.float64)
         avgdl = max(self.index.avgdl, 1e-9)
         for qi, tid in enumerate(tids):
@@ -256,8 +257,11 @@ class SegmentSearcher:
             hit = (len(pd) > 0) & (pd[ix] == docs)
             tf = np.where(hit, pt[np.clip(ix, 0, max(len(pd) - 1, 0))],
                           0).astype(np.float64)
-            denom = tf + K1 * (1 - B + B * dl / avgdl)
-            scores += idf[qi] * (K1 + 1) * tf / np.maximum(denom, 1e-9)
+            if scorer == "tfidf":
+                scores += idf[qi] * np.sqrt(tf)
+            else:
+                denom = tf + K1 * (1 - B + B * dl / avgdl)
+                scores += idf[qi] * (K1 + 1) * tf / np.maximum(denom, 1e-9)
         order = np.argsort(-scores, kind="stable")[:k]
         return (scores[order].astype(np.float32),
                 docs[order].astype(np.int32))

@@ -111,7 +111,19 @@ class Segment:
 def build_field_index(texts: Iterable[Optional[str]],
                       analyzer: Analyzer) -> FieldIndex:
     """Tokenize a column of documents into a FieldIndex (host-side; analysis
-    is CPU work by design — SURVEY.md §7 hard part 5)."""
+    is CPU work by design — SURVEY.md §7 hard part 5).
+
+    The "simple" analyzer over pure-ASCII corpora takes the native C++
+    one-pass indexer (serenedb_tpu/native); everything else (stemming,
+    stopwords, unicode casing) uses the Python analyzers."""
+    texts = list(texts)
+    if getattr(analyzer, "name", "") == "simple" and \
+            all(t is None or t.isascii() for t in texts):
+        from ..native import build_field_index_native
+        fi = build_field_index_native(texts)
+        if fi is not None:
+            _add_block_max(fi)
+            return fi
     term_postings: dict[str, list] = {}
     norms = []
     total_tokens = 0
@@ -166,6 +178,22 @@ def build_field_index(texts: Iterable[Optional[str]],
         block_offsets=block_offsets,
         total_tokens=total_tokens,
     )
+
+
+def _add_block_max(fi: FieldIndex) -> None:
+    """Compute per-128-block max-tf metadata for an index built without it
+    (the native builder returns raw postings)."""
+    block_max = []
+    block_offsets = np.zeros(fi.num_terms + 1, dtype=np.int64)
+    for ti in range(fi.num_terms):
+        s, e = int(fi.offsets[ti]), int(fi.offsets[ti + 1])
+        tfs = fi.post_tfs[s:e]
+        nb = -(-len(tfs) // BLOCK) if len(tfs) else 0
+        for bi in range(nb):
+            block_max.append(int(tfs[bi * BLOCK:(bi + 1) * BLOCK].max()))
+        block_offsets[ti + 1] = len(block_max)
+    fi.block_max_tf = np.asarray(block_max, dtype=np.int32)
+    fi.block_offsets = block_offsets
 
 
 def build_segment(columns: dict[str, Iterable[Optional[str]]],

@@ -211,8 +211,16 @@ class CreateSchema(Statement):
 
 
 @dataclass
+class CreateSequence(Statement):
+    name: list[str]
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
 class Drop(Statement):
-    kind: str                         # 'table' | 'index' | 'schema' | 'view'
+    kind: str          # 'table' | 'index' | 'schema' | 'view' | 'sequence'
     name: list[str]
     if_exists: bool = False
     cascade: bool = False

@@ -598,7 +598,23 @@ class Parser:
             opts = self._with_options()
             return ast.CreateIndex(idx_name, table, cols, using, ine, opts)
         if self.accept_kw("SEQUENCE"):
-            raise errors.unsupported("CREATE SEQUENCE not supported yet")
+            ine = self._if_not_exists()
+            name = self.qualified_name()
+            start = 1
+            increment = 1
+            while self.peek().kind is T.IDENT and \
+                    self.peek().value.upper() in ("START", "INCREMENT"):
+                word = self.ident().upper()
+                self.accept_kw("WITH") or self.accept_kw("BY")
+                sign = -1 if self.accept_op("-") else 1
+                t = self.next()
+                if t.kind is not T.NUMBER:
+                    raise errors.syntax("expected number in SEQUENCE options")
+                if word == "START":
+                    start = sign * int(t.value)
+                else:
+                    increment = sign * int(t.value)
+            return ast.CreateSequence(name, start, increment, ine)
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         name = self.qualified_name()
@@ -688,6 +704,8 @@ class Parser:
             kind = "schema"
         elif self.accept_kw("VIEW"):
             kind = "view"
+        elif self.accept_kw("SEQUENCE"):
+            kind = "sequence"
         else:
             raise errors.unsupported("DROP of that object kind")
         if_exists = False

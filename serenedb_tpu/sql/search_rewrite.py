@@ -37,7 +37,8 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
     # ScanNode branch claims the scan without score wiring
     if isinstance(plan, ProjectNode) and isinstance(plan.child, ScanNode):
         new_child = _try_search_scan(plan.child,
-                                     want_score=_has_scorer(plan.exprs))
+                                     want_score=_has_scorer(plan.exprs),
+                                     scorer=_scorer_name(plan.exprs))
         if new_child is not None:
             plan.child = new_child
             if new_child.with_score:
@@ -98,7 +99,8 @@ def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
         return None
     k = limit.limit + limit.offset
     node = SearchScanNode(scan.provider, scan.columns, scan.alias,
-                          search_col, qnode, None, k, with_score=True)
+                          search_col, qnode, None, k, with_score=True,
+                          scorer=key_expr.name)
     _rewire_scorers(proj.exprs, node)
     proj.child = node
     return plan
@@ -138,7 +140,9 @@ def _match_ann_topk(plan: PlanNode, limit, sort, proj,
 
     def rec(e: BoundExpr) -> BoundExpr:
         if isinstance(e, BoundFunc):
-            if e.name in _VEC_FUNCS and len(e.args) == 2 and \
+            # only the ordering metric's own function maps to #dist —
+            # vec_cos over an l2-ordered scan must keep its CPU value
+            if e.name == key_expr.name and len(e.args) == 2 and \
                     isinstance(e.args[0], BoundColumn) and \
                     e.args[0].index == col.index and \
                     isinstance(e.args[1], BoundLiteral) and \
@@ -159,14 +163,16 @@ def _has_scorer(exprs: list[BoundExpr]) -> bool:
 
 
 def _rewire_scorers(exprs: list[BoundExpr], node: SearchScanNode) -> None:
-    """Replace scorer calls over the *searched column* with the scan's
-    #score output; scorers over other columns keep their default (0.0)."""
+    """Replace calls of the scan's OWN scorer over the searched column with
+    the #score output; a different scorer function (the scan computes only
+    one) and scorers over other columns keep their default (0.0) — never
+    alias one scorer's values onto another's column."""
     score_ref = BoundColumn(len(node.columns), dt.FLOAT, SCORE_COL)
     search_idx = node.columns.index(node.search_column)
 
     def rec(e: BoundExpr) -> BoundExpr:
         if isinstance(e, BoundFunc):
-            if e.name in _SCORER_FUNCS and e.args and \
+            if e.name == node.scorer and e.args and \
                     isinstance(e.args[0], BoundColumn) and \
                     e.args[0].index == search_idx:
                 return score_ref
@@ -179,8 +185,16 @@ def _rewire_scorers(exprs: list[BoundExpr], node: SearchScanNode) -> None:
 
 # -- pattern 1: filter pushdown -------------------------------------------
 
-def _try_search_scan(scan: ScanNode,
-                     want_score: bool) -> Optional[SearchScanNode]:
+def _scorer_name(exprs: list[BoundExpr]) -> str:
+    for e in exprs:
+        for s in e.walk():
+            if isinstance(s, BoundFunc) and s.name in _SCORER_FUNCS:
+                return s.name
+    return "bm25"
+
+
+def _try_search_scan(scan: ScanNode, want_score: bool,
+                     scorer: str = "bm25") -> Optional[SearchScanNode]:
     if scan.filter is None:
         return None
     # find an indexed column among the ts conjuncts
@@ -191,7 +205,7 @@ def _try_search_scan(scan: ScanNode,
         if qnode is not None:
             return SearchScanNode(scan.provider, scan.columns, scan.alias,
                                   col_name, qnode, residual, None,
-                                  with_score=want_score)
+                                  with_score=want_score, scorer=scorer)
     return None
 
 

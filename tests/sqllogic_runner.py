@@ -1,0 +1,131 @@
+"""sqllogictest-style golden file runner.
+
+Reference analog: the sqllogictest-rs harness over tests/sqllogic/
+(1,642 .test files; SURVEY.md §4) — behavior files are the parity contract.
+
+File format (the common sqllogictest subset):
+
+    statement ok
+    CREATE TABLE t (a INT)
+
+    statement error <optional substring>
+    SELECT nope
+
+    query <types, e.g. ITR>          # I int, T text, R real (informational)
+    SELECT a FROM t ORDER BY a
+    ----
+    1
+    2
+
+Multi-column rows print values separated by a single space (tab in files is
+normalized); NULL prints as "NULL"; `rowsort` after the types sorts expected
+and actual rows before comparing.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Record:
+    kind: str                 # 'statement' | 'query'
+    sql: str
+    line: int
+    expect_error: Optional[str] = None   # None = ok; '' = any error
+    expected: Optional[list[str]] = None
+    rowsort: bool = False
+
+
+def parse_test_file(path: str) -> list[Record]:
+    with open(path) as f:
+        lines = f.read().split("\n")
+    records = []
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line or line.startswith("#"):
+            i += 1
+            continue
+        header = line.split()
+        start_line = i + 1
+        if header[0] == "statement":
+            expect_error = None
+            if len(header) > 1 and header[1] == "error":
+                expect_error = " ".join(header[2:])
+            elif len(header) > 1 and header[1] != "ok":
+                raise ValueError(f"{path}:{i+1}: bad statement header")
+            i += 1
+            sql_lines = []
+            while i < len(lines) and lines[i].strip():
+                sql_lines.append(lines[i])
+                i += 1
+            records.append(Record("statement", "\n".join(sql_lines),
+                                  start_line, expect_error))
+        elif header[0] == "query":
+            rowsort = "rowsort" in header[2:] or \
+                (len(header) > 2 and header[2] == "rowsort")
+            i += 1
+            sql_lines = []
+            while i < len(lines) and lines[i].strip() != "----":
+                sql_lines.append(lines[i])
+                i += 1
+            i += 1  # skip ----
+            expected = []
+            while i < len(lines) and lines[i].strip():
+                expected.append(lines[i].rstrip())
+                i += 1
+            records.append(Record("query", "\n".join(sql_lines),
+                                  start_line, None, expected, rowsort))
+        else:
+            raise ValueError(f"{path}:{i+1}: unknown directive {header[0]}")
+    return records
+
+
+def format_value(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return f"{v:.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def run_test_file(conn, path: str) -> list[str]:
+    """Run one file; returns a list of failure descriptions (empty = pass)."""
+    from serenedb_tpu.errors import SqlError
+    failures = []
+    for rec in parse_test_file(path):
+        where = f"{path}:{rec.line}"
+        try:
+            result = conn.execute(rec.sql)
+            if rec.kind == "statement" and rec.expect_error is not None:
+                failures.append(f"{where}: expected error, got success")
+                continue
+            if rec.kind == "query":
+                actual = [" ".join(format_value(v) for v in row)
+                          for row in result.rows()]
+                expected = [e.replace("\t", " ") for e in rec.expected]
+                if rec.rowsort:
+                    actual = sorted(actual)
+                    expected = sorted(expected)
+                if actual != expected:
+                    failures.append(
+                        f"{where}: mismatch\n  expected: {expected}\n"
+                        f"  actual:   {actual}")
+        except SqlError as e:
+            if rec.expect_error is None:
+                failures.append(f"{where}: unexpected error: {e.message}")
+            elif rec.expect_error and rec.expect_error not in e.message \
+                    and rec.expect_error != e.sqlstate:
+                failures.append(
+                    f"{where}: error mismatch: wanted {rec.expect_error!r} "
+                    f"in {e.message!r}")
+    return failures

@@ -183,3 +183,35 @@ def test_sql_mixed_predicate_residual(sql_conn):
         "SELECT count(*) FROM (SELECT * FROM docs) d "
         "WHERE body @@ 'apple' AND id < 100").scalar()
     assert with_index == brute
+
+
+def test_tfidf_scorer_differs_from_bm25(sql_conn):
+    sql_conn.execute("CREATE INDEX ON docs USING inverted (body)")
+    bm = sql_conn.execute(
+        "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple' "
+        "ORDER BY s DESC LIMIT 500").rows()
+    tf = sql_conn.execute(
+        "SELECT id, tfidf(body) AS s FROM docs WHERE body @@ 'apple' "
+        "ORDER BY s DESC LIMIT 500").rows()
+    assert len(bm) == len(tf)
+    # same match set (full), different score values (different formulas)
+    assert {r[0] for r in bm} == {r[0] for r in tf}
+    bm_scores = dict(bm)
+    assert any(abs(bm_scores[i] - s) > 1e-6 for i, s in tf)
+    # tfidf = idf * sqrt(tf) — verify one score by hand
+    import numpy as np
+    from serenedb_tpu.search.index import find_index
+    t = sql_conn.db.schemas["main"].tables["docs"]
+    idx = find_index(t, "body")
+    searcher = idx.searcher("body")
+    fi = searcher.index
+    tid = fi.term_id("apple")
+    if tid >= 0 and tf:
+        d = int(tf[0][0])
+        # find the row index of doc with id==d
+        ids = t.full_batch(["id"]).column("id").to_pylist()
+        row = ids.index(d)
+        pd, pt = fi.postings(tid)
+        tfreq = float(pt[np.searchsorted(pd, row)])
+        idf = 1.0 + np.log(searcher.num_docs / (fi.doc_freq[tid] + 1.0))
+        assert tf[0][1] == pytest.approx(idf * np.sqrt(tfreq), rel=1e-3)

@@ -1,0 +1,34 @@
+"""Pytest integration of the sqllogic golden files — each file runs on a
+fresh in-memory database AND on a fresh durable database with a
+close/reopen in the middle... (the durable variant comes with multi-run
+support; for now files run against both engine configurations)."""
+
+import glob
+import os
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from tests.sqllogic_runner import run_test_file
+
+FILES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "sqllogic", "*.test")))
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f)
+                                             for f in FILES])
+def test_sqllogic_memory(path):
+    conn = Database().connect()
+    failures = run_test_file(conn, path)
+    assert not failures, "\n".join(failures)
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f)
+                                             for f in FILES])
+def test_sqllogic_durable(path, tmp_path):
+    db = Database(str(tmp_path / "data"))
+    try:
+        failures = run_test_file(db.connect(), path)
+        assert not failures, "\n".join(failures)
+    finally:
+        db.close()
