@@ -283,6 +283,14 @@ class Database(TableResolver):
 
     def resolve_table(self, parts: list[str]) -> TableProvider:
         schema, name = self._split(parts)
+        if schema in ("pg_catalog", "information_schema", "sdb_catalog"):
+            from .pgcatalog import system_table
+            st = system_table(self, parts)
+            if st is not None:
+                return st
+            raise errors.SqlError(errors.UNDEFINED_TABLE,
+                                  f'relation "{".".join(parts)}" does not '
+                                  "exist")
         with self.lock:
             s = self.schemas.get(schema)
             if s is None:
@@ -629,13 +637,15 @@ class Connection:
         return QueryResult(Batch([], []), "CREATE TABLE")
 
     def _create_index(self, st: ast.CreateIndex) -> QueryResult:
+        from .utils.progress import REGISTRY as _progress
         provider = self.db.resolve_table(st.table)
         if not hasattr(provider, "indexes"):
             provider.indexes = {}
         idx_name = st.name or f"{st.table[-1]}_{'_'.join(st.columns)}_idx"
         from .search.index import build_index_for_table
-        provider.indexes[idx_name] = build_index_for_table(
-            provider, st.columns, st.using, st.options)
+        with _progress.track("CREATE INDEX", provider.row_count()):
+            provider.indexes[idx_name] = build_index_for_table(
+                provider, st.columns, st.using, st.options)
         if self.db.store is not None and isinstance(provider, StoredTable):
             idef = {"table": provider.key, "columns": list(st.columns),
                     "using": st.using, "options": dict(st.options)}
@@ -790,6 +800,15 @@ class Connection:
             raise errors.unsupported("EXPLAIN of non-SELECT")
         plan = self._plan(st.inner, params)
         lines = plan.explain()
+        if st.analyze:
+            import time as _time
+            t0 = _time.perf_counter()
+            result = plan.execute(ExecContext(self.settings, params))
+            elapsed = (_time.perf_counter() - t0) * 1000
+            lines = lines + [
+                f"Execution Time: {elapsed:.3f} ms",
+                f"Rows Returned: {result.num_rows}",
+            ]
         b = Batch.from_pydict({"QUERY PLAN": lines})
         return QueryResult(b, f"SELECT {len(lines)}")
 
@@ -820,9 +839,29 @@ class Connection:
         return QueryResult(Batch([], []), "VACUUM")
 
     def _copy(self, st: ast.CopyStmt, params: list) -> QueryResult:
+        from .utils.progress import REGISTRY as _progress
         fmt = str(st.options.get("format", "csv")).lower()
         if st.direction == "from":
             table = self._table_for_dml(st.table)
+            _track = _progress.track("COPY FROM")
+            _track.__enter__()
+            try:
+                return self._copy_from(st, table, fmt)
+            finally:
+                _track.__exit__(None, None, None)
+        # COPY TO
+        provider = self.db.resolve_table(st.table)
+        full = provider.full_batch(st.columns)
+        with _progress.track("COPY TO", full.num_rows):
+            if fmt == "parquet":
+                _write_parquet(st.target, full)
+            else:
+                _write_csv(st.target, full, st.options)
+        return QueryResult(Batch([], []), f"COPY {full.num_rows}")
+
+    def _copy_from(self, st: ast.CopyStmt, table: MemTable,
+                   fmt: str) -> QueryResult:
+        if True:
             if fmt == "parquet":
                 incoming = ParquetTable(st.target).full_batch()
             elif fmt in ("csv", "text"):
@@ -834,14 +873,6 @@ class Connection:
                                 for i in range(len(names))])
             self._insert_batch(table, sub)
             return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
-        # COPY TO
-        provider = self.db.resolve_table(st.table)
-        full = provider.full_batch(st.columns)
-        if fmt == "parquet":
-            _write_parquet(st.target, full)
-        else:
-            _write_csv(st.target, full, st.options)
-        return QueryResult(Batch([], []), f"COPY {full.num_rows}")
 
     def _insert_batch(self, table: MemTable, incoming: Batch):
         with self.db.lock:

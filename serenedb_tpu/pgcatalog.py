@@ -50,6 +50,71 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
             "relname": [r[1] for r in rows],
             "relkind": ["r" if r[2] == "table" else "v" for r in rows],
         }))
+    if name in ("pg_attribute", "columns"):
+        # pg_attribute / information_schema.columns: one row per column
+        rows_s, rows_t, rows_c, rows_ty, rows_pos, rows_null = \
+            [], [], [], [], [], []
+        with db.lock:
+            for sname, s in db.schemas.items():
+                for tname, t in s.tables.items():
+                    nn = set(getattr(t, "table_meta", {}).get("not_null", []))
+                    for pos, (cn, ct) in enumerate(
+                            zip(t.column_names, t.column_types), 1):
+                        rows_s.append(sname)
+                        rows_t.append(tname)
+                        rows_c.append(cn)
+                        rows_ty.append(str(ct).lower())
+                        rows_pos.append(pos)
+                        rows_null.append("NO" if cn in nn else "YES")
+        if name == "columns":
+            return MemTable("columns", Batch.from_pydict({
+                "table_schema": rows_s, "table_name": rows_t,
+                "column_name": rows_c, "ordinal_position": rows_pos,
+                "data_type": rows_ty, "is_nullable": rows_null}))
+        return MemTable("pg_attribute", Batch.from_pydict({
+            "attrelid": [hash((a, b)) % (1 << 30)
+                         for a, b in zip(rows_s, rows_t)],
+            "attname": rows_c, "attnum": rows_pos,
+            "atttypid": [25] * len(rows_c)}))
+    if name == "tables" and len(parts) >= 2 and \
+            parts[-2].lower() == "information_schema":
+        rows = db.table_list()
+        return MemTable("tables", Batch.from_pydict({
+            "table_schema": [r[0] for r in rows],
+            "table_name": [r[1] for r in rows],
+            "table_type": ["BASE TABLE" if r[2] == "table" else "VIEW"
+                           for r in rows]}))
+    if name == "pg_type":
+        from .columnar import dtypes as _dt
+        type_rows = [(16, "bool"), (20, "int8"), (21, "int2"), (23, "int4"),
+                     (25, "text"), (700, "float4"), (701, "float8"),
+                     (1043, "varchar"), (1082, "date"), (1114, "timestamp")]
+        return MemTable("pg_type", Batch.from_pydict({
+            "oid": [r[0] for r in type_rows],
+            "typname": [r[1] for r in type_rows]}))
+    if name == "pg_index" or name == "pg_indexes":
+        rows_t, rows_i, rows_d = [], [], []
+        with db.lock:
+            for sname, s in db.schemas.items():
+                for tname, t in s.tables.items():
+                    for iname, idx in getattr(t, "indexes", {}).items():
+                        rows_t.append(tname)
+                        rows_i.append(iname)
+                        rows_d.append(
+                            f"USING {idx.using} "
+                            f"({', '.join(idx.columns)})")
+        return MemTable("pg_indexes", Batch.from_pydict({
+            "tablename": rows_t, "indexname": rows_i, "indexdef": rows_d}))
+    if name == "pg_stat_progress_basebackup" or \
+            name.startswith("pg_stat_progress"):
+        from .utils.progress import REGISTRY as _progress
+        recs = _progress.snapshot()
+        return MemTable(name, Batch.from_pydict({
+            "pid": [r["pid"] for r in recs],
+            "command": [r["command"] for r in recs],
+            "phase": [r["phase"] for r in recs],
+            "tuples_done": [r["done"] for r in recs],
+            "tuples_total": [r["total"] for r in recs]}))
     if name == "sdb_settings":
         names = _settings_registry.names()
         return MemTable("sdb_settings", Batch.from_pydict({

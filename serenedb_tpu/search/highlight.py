@@ -1,0 +1,60 @@
+"""Highlighting: byte offsets of query matches + headline rendering.
+
+Reference analog: server/connector/highlight/memory_index.* — highlights are
+computed by re-analyzing the row's text against the query (SURVEY.md §2.5
+"Highlight") rather than storing offsets in the index.
+"""
+
+from __future__ import annotations
+
+from .query import QAnd, QNode, QNot, QOr, QPhrase, QPrefix, QTerm, parse_query
+
+
+def _positive_terms(node: QNode) -> tuple[set[str], set[str]]:
+    """(exact terms, prefixes) contributing to highlights."""
+    terms: set[str] = set()
+    prefixes: set[str] = set()
+
+    def rec(nd):
+        if isinstance(nd, QTerm):
+            terms.add(nd.term)
+        elif isinstance(nd, QPhrase):
+            terms.update(nd.terms)
+        elif isinstance(nd, QPrefix):
+            prefixes.add(nd.prefix)
+        elif isinstance(nd, (QAnd, QOr)):
+            for a in nd.args:
+                rec(a)
+        # QNot: negated terms never highlight
+    rec(node)
+    return terms, prefixes
+
+
+def match_offsets(analyzer, text: str, query: str) -> list[list[int]]:
+    """[[start, end], ...] character ranges of matching tokens."""
+    node = parse_query(query, analyzer)
+    terms, prefixes = _positive_terms(node)
+    out = []
+    for tok in analyzer.tokenize(text):
+        if tok.term in terms or any(tok.term.startswith(p)
+                                    for p in prefixes):
+            out.append([tok.start, tok.end])
+    return out
+
+
+def headline(analyzer, text: str, query: str, start_sel: str = "<b>",
+             stop_sel: str = "</b>") -> str:
+    """PG ts_headline-style rendering: matched tokens wrapped in markers."""
+    spans = match_offsets(analyzer, text, query)
+    if not spans:
+        return text
+    parts = []
+    prev = 0
+    for s, e in spans:
+        parts.append(text[prev:s])
+        parts.append(start_sel)
+        parts.append(text[s:e])
+        parts.append(stop_sel)
+        prev = e
+    parts.append(text[prev:])
+    return "".join(parts)

@@ -20,7 +20,8 @@ from .. import errors
 from ..columnar import dtypes as dt
 from ..columnar.column import Column
 
-_SEARCH_FUNCS = {"ts_match", "bm25", "tfidf", "to_tsquery", "ts_offsets"}
+_SEARCH_FUNCS = {"ts_match", "bm25", "tfidf", "to_tsquery", "ts_offsets",
+                 "ts_headline"}
 
 
 def is_search_function(name: str) -> bool:
@@ -84,5 +85,38 @@ def bind_function(binder, e):
 
         def impl(cols, batch):
             return cols[-1]
+        return BoundFunc(name, args, dt.VARCHAR, impl)
+    if name in ("ts_offsets", "ts_headline"):
+        # reference: byte-range highlight via per-row re-analysis
+        # (server/connector/highlight/memory_index.*)
+        if len(e.args) != 2:
+            raise errors.syntax(f"{name}(column, query) takes 2 arguments")
+        args = [binder.bind(a) for a in e.args]
+        headline = name == "ts_headline"
+
+        def impl(cols, batch, _headline=headline):
+            import json
+            from ..sql.expr import (make_string_column, propagate_nulls,
+                                    string_values)
+            from .analysis import default_analyzer
+            from .highlight import headline as _hl
+            from .highlight import match_offsets
+            texts = string_values(cols[0])
+            queries = string_values(cols[1])
+            an = default_analyzer()
+            valid = propagate_nulls(cols)
+            out = []
+            for i in range(batch.num_rows):
+                if valid is not None and not valid[i]:
+                    out.append("")
+                    continue
+                if _headline:
+                    out.append(_hl(an, texts[i], queries[i]))
+                else:
+                    out.append(json.dumps(
+                        match_offsets(an, texts[i], queries[i])))
+            col = make_string_column(
+                np.asarray(out, dtype=object).astype(str), valid)
+            return col
         return BoundFunc(name, args, dt.VARCHAR, impl)
     raise errors.unsupported(f"search function {name}")
