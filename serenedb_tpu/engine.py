@@ -476,7 +476,7 @@ class Connection:
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, st: ast.Statement, params: list) -> QueryResult:
-        if isinstance(st, ast.Select):
+        if isinstance(st, (ast.Select, ast.SetOp)):
             batch = self._run_select(st, params)
             return QueryResult(batch, f"SELECT {batch.num_rows}")
         if isinstance(st, ast.CreateTable):
@@ -796,7 +796,7 @@ class Connection:
                            "COMMIT" if st.action == "commit" else "ROLLBACK")
 
     def _explain(self, st: ast.Explain, params: list) -> QueryResult:
-        if not isinstance(st.inner, ast.Select):
+        if not isinstance(st.inner, (ast.Select, ast.SetOp)):
             raise errors.unsupported("EXPLAIN of non-SELECT")
         plan = self._plan(st.inner, params)
         lines = plan.explain()
@@ -843,12 +843,8 @@ class Connection:
         fmt = str(st.options.get("format", "csv")).lower()
         if st.direction == "from":
             table = self._table_for_dml(st.table)
-            _track = _progress.track("COPY FROM")
-            _track.__enter__()
-            try:
+            with _progress.track("COPY FROM"):
                 return self._copy_from(st, table, fmt)
-            finally:
-                _track.__exit__(None, None, None)
         # COPY TO
         provider = self.db.resolve_table(st.table)
         full = provider.full_batch(st.columns)
@@ -861,18 +857,17 @@ class Connection:
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
-        if True:
-            if fmt == "parquet":
-                incoming = ParquetTable(st.target).full_batch()
-            elif fmt in ("csv", "text"):
-                incoming = _read_csv(st.target, table, st.options)
-            else:
-                raise errors.unsupported(f"COPY format {fmt}")
-            names = st.columns or list(incoming.names)
-            sub = Batch(names, [incoming.columns[i]
-                                for i in range(len(names))])
-            self._insert_batch(table, sub)
-            return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
+        if fmt == "parquet":
+            incoming = ParquetTable(st.target).full_batch()
+        elif fmt in ("csv", "text"):
+            incoming = _read_csv(st.target, table, st.options)
+        else:
+            raise errors.unsupported(f"COPY format {fmt}")
+        names = st.columns or list(incoming.names)
+        sub = Batch(names, [incoming.columns[i]
+                            for i in range(len(names))])
+        self._insert_batch(table, sub)
+        return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
 
     def _insert_batch(self, table: MemTable, incoming: Batch):
         with self.db.lock:

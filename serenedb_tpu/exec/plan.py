@@ -334,6 +334,95 @@ class JoinNode(PlanNode):
         return f"HashJoin {self.kind}"
 
 
+class SetOpNode(PlanNode):
+    """UNION / INTERSECT / EXCEPT with set (default) or bag (ALL) semantics.
+    Row-tuple based on CPU; schema/names come from the left arm."""
+
+    def __init__(self, op: str, all_: bool, left: PlanNode, right: PlanNode):
+        self.op = op
+        self.all = all_
+        self.left = left
+        self.right = right
+        self.names = list(left.names)
+        self.types = [_unify_setop_type(lt, rt)
+                      for lt, rt in zip(left.types, right.types)]
+
+    def children(self):
+        return [self.left, self.right]
+
+    def label(self):
+        return f"SetOp {self.op.upper()}{' ALL' if self.all else ''}"
+
+    def batches(self, ctx):
+        if self.op == "union" and self.all:
+            # pure concatenation: stay columnar, no python row tuples
+            from ..sql.binder import cast_column
+            for arm in (self.left, self.right):
+                for b in arm.batches(ctx):
+                    cols = [cast_column(c, t)
+                            for c, t in zip(b.columns, self.types)]
+                    yield Batch(list(self.names), cols)
+            return
+        lrows = self.left.execute(ctx).rows()
+        rrows = self.right.execute(ctx).rows()
+        if self.op == "union":
+            out = lrows + rrows
+            if not self.all:
+                out = _dedup(out)
+        elif self.op == "intersect":
+            from collections import Counter
+            rc = Counter(rrows)
+            if self.all:
+                out = []
+                for row in lrows:
+                    if rc[row] > 0:
+                        rc[row] -= 1
+                        out.append(row)
+            else:
+                rset = set(rrows)
+                out = _dedup([row for row in lrows if row in rset])
+        else:  # except
+            from collections import Counter
+            rc = Counter(rrows)
+            if self.all:
+                out = []
+                for row in lrows:
+                    if rc[row] > 0:
+                        rc[row] -= 1
+                    else:
+                        out.append(row)
+            else:
+                rset = set(rrows)
+                out = _dedup([row for row in lrows if row not in rset])
+        cols = []
+        for i, t in enumerate(self.types):
+            cols.append(Column.from_pylist([r[i] for r in out], t))
+        yield Batch(list(self.names), cols)
+
+
+def _unify_setop_type(lt: dt.SqlType, rt: dt.SqlType) -> dt.SqlType:
+    if lt.id is dt.TypeId.NULL:
+        return rt
+    if rt.id is dt.TypeId.NULL:
+        return lt
+    if lt == rt:
+        return lt
+    if lt.is_numeric and rt.is_numeric:
+        return dt.common_numeric(lt, rt)
+    raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                          f"UNION types {lt} and {rt} cannot be matched")
+
+
+def _dedup(rows: list[tuple]) -> list[tuple]:
+    seen = set()
+    out = []
+    for r in rows:
+        if r not in seen:
+            seen.add(r)
+            out.append(r)
+    return out
+
+
 class AggregateNode(PlanNode):
     def __init__(self, child: PlanNode, group_exprs: list[BoundExpr],
                  aggs: list[AggSpec], names: list[str]):

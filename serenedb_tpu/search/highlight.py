@@ -43,9 +43,11 @@ def match_offsets(analyzer, text: str, query: str) -> list[list[int]]:
 
 
 def headline(analyzer, text: str, query: str, start_sel: str = "<b>",
-             stop_sel: str = "</b>") -> str:
-    """PG ts_headline-style rendering: matched tokens wrapped in markers."""
-    spans = match_offsets(analyzer, text, query)
+             stop_sel: str = "</b>", spans=None) -> str:
+    """PG ts_headline-style rendering: matched tokens wrapped in markers.
+    Pre-computed spans (from a cached parsed query) skip the re-parse."""
+    if spans is None:
+        spans = match_offsets(analyzer, text, query)
     if not spans:
         return text
     parts = []

@@ -105,16 +105,31 @@ def bind_function(binder, e):
             queries = string_values(cols[1])
             an = default_analyzer()
             valid = propagate_nulls(cols)
+            # the query argument is almost always a constant column: parse
+            # each distinct query string once, not once per row
+            from .highlight import _positive_terms
+            from .query import parse_query as _pq
+            qcache: dict[str, tuple] = {}
+
+            def parsed(q: str):
+                hit = qcache.get(q)
+                if hit is None:
+                    hit = qcache[q] = _positive_terms(_pq(q, an))
+                return hit
+
             out = []
             for i in range(batch.num_rows):
                 if valid is not None and not valid[i]:
                     out.append("")
                     continue
+                terms, prefixes = parsed(queries[i])
+                spans = [[t.start, t.end] for t in an.tokenize(texts[i])
+                         if t.term in terms or
+                         any(t.term.startswith(p) for p in prefixes)]
                 if _headline:
-                    out.append(_hl(an, texts[i], queries[i]))
+                    out.append(_hl(an, texts[i], queries[i], spans=spans))
                 else:
-                    out.append(json.dumps(
-                        match_offsets(an, texts[i], queries[i])))
+                    out.append(json.dumps(spans))
             col = make_string_column(
                 np.asarray(out, dtype=object).astype(str), valid)
             return col

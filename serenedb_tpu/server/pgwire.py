@@ -423,9 +423,10 @@ class PgSession:
 
     def _describe_statement(self, prep: Prepared):
         st = prep.statements[0] if prep.statements else None
-        if isinstance(st, (ast.Select, ast.ShowStmt, ast.Explain)):
+        if isinstance(st, (ast.Select, ast.SetOp, ast.ShowStmt,
+                           ast.Explain)):
             try:
-                if isinstance(st, ast.Select):
+                if isinstance(st, (ast.Select, ast.SetOp)):
                     plan = self.conn._plan(st, [None] * prep.n_params)
                     self.w.row_description(plan.names, plan.types)
                     return

@@ -171,6 +171,32 @@ class Select(Statement):
     limit: Optional[Expr] = None
     offset: Optional[Expr] = None
     distinct: bool = False
+    ctes: dict = field(default_factory=dict)      # name -> Select (WITH)
+
+
+@dataclass
+class SetOp(Statement):
+    op: str                    # 'union' | 'intersect' | 'except'
+    all: bool
+    left: "Select | SetOp"
+    right: "Select | SetOp"
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[Expr] = None
+    offset: Optional[Expr] = None
+    ctes: dict = field(default_factory=dict)
+
+
+@dataclass
+class Exists(Expr):
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Expr):
+    operand: Expr
+    query: "Select"
+    negated: bool = False
 
 
 @dataclass

@@ -92,13 +92,15 @@ def literal_type(v) -> dt.SqlType:
 
 
 class ExprBinder:
-    """Binds expressions in a scope; collects aggregates when allowed."""
+    """Binds expressions in a scope; collects aggregates when allowed.
+    `planner` (when provided) enables uncorrelated subquery expressions."""
 
     def __init__(self, scope: Scope, params: Optional[list] = None,
-                 allow_aggs: bool = False):
+                 allow_aggs: bool = False, planner=None):
         self.scope = scope
         self.params = params or []
         self.allow_aggs = allow_aggs
+        self.planner = planner
         self.aggs: list[AggSpec] = []
         self._agg_keys: dict[str, int] = {}
 
@@ -162,7 +164,11 @@ class ExprBinder:
         if isinstance(e, ast.Case):
             return self._bind_case(e)
         if isinstance(e, ast.Subquery):
-            raise errors.unsupported("scalar subqueries not supported yet")
+            return self._bind_scalar_subquery(e.query)
+        if isinstance(e, ast.InSubquery):
+            return self._bind_in_subquery(e)
+        if isinstance(e, ast.Exists):
+            return self._bind_exists(e)
         if isinstance(e, ast.Star):
             raise errors.syntax("* not allowed here")
         raise errors.unsupported(f"expression {type(e).__name__}")
@@ -247,6 +253,87 @@ class ExprBinder:
         if t.id is dt.TypeId.NULL and else_b is not None:
             t = else_b.type
         return BoundCase(bound, else_b, t)
+
+    # -- uncorrelated subqueries ------------------------------------------
+    # Correlated subqueries (referencing outer columns) are future work;
+    # the inner query is planned against its own scope only, executed once
+    # per statement and cached (reference: DuckDB flattens these the same
+    # way for the uncorrelated case).
+
+    def _subplan(self, query):
+        if self.planner is None:
+            raise errors.unsupported(
+                "subqueries are not allowed in this context")
+        return self.planner.plan_select(query)
+
+    def _bind_scalar_subquery(self, query) -> BoundExpr:
+        plan = self._subplan(query)
+        if len(plan.types) != 1:
+            raise errors.SqlError("42601",
+                                  "subquery must return only one column")
+        t = plan.types[0]
+        cache: list = []
+
+        def impl(cols, batch, _plan=plan, _t=t, _cache=cache):
+            if not _cache:
+                from ..exec.plan import ExecContext
+                rows = _plan.execute(ExecContext()).rows()
+                if len(rows) > 1:
+                    raise errors.SqlError(
+                        "21000",
+                        "more than one row returned by a subquery used as "
+                        "an expression")
+                _cache.append(rows[0][0] if rows else None)
+            return Column.const(_cache[0], batch.num_rows, _t)
+        return BoundFunc("scalar_subquery", [], t, impl)
+
+    def _bind_in_subquery(self, e) -> BoundExpr:
+        plan = self._subplan(e.query)
+        if len(plan.types) != 1:
+            raise errors.SqlError("42601",
+                                  "subquery must return only one column")
+        operand = self.bind(e.operand)
+        negated = e.negated
+        cache: list = []
+
+        def impl(cols, batch, _plan=plan, _neg=negated, _cache=cache):
+            if not _cache:
+                from ..exec.plan import ExecContext
+                vals = [r[0] for r in _plan.execute(ExecContext()).rows()]
+                _cache.append((set(v for v in vals if v is not None),
+                               any(v is None for v in vals)))
+            values, has_null = _cache[0]
+            x = cols[0]
+            import numpy as np
+            data = np.zeros(batch.num_rows, dtype=bool)
+            valid = np.ones(batch.num_rows, dtype=bool)
+            xv = x.to_pylist()
+            for i, v in enumerate(xv):
+                if v is None:
+                    valid[i] = False
+                elif v in values:
+                    data[i] = True
+                elif has_null:
+                    valid[i] = False   # x NOT IN set-with-null → NULL
+            if _neg:
+                data = ~data & valid
+            else:
+                data = data & valid
+            return Column(dt.BOOL, data,
+                          None if valid.all() else valid)
+        return BoundFunc("in_subquery", [operand], dt.BOOL, impl)
+
+    def _bind_exists(self, e) -> BoundExpr:
+        plan = self._subplan(e.query)
+        cache: list = []
+
+        def impl(cols, batch, _plan=plan, _neg=e.negated, _cache=cache):
+            if not _cache:
+                from ..exec.plan import ExecContext
+                _cache.append(_plan.execute(ExecContext()).num_rows > 0)
+            v = _cache[0] != _neg
+            return Column.const(v, batch.num_rows, dt.BOOL)
+        return BoundFunc("exists", [], dt.BOOL, impl)
 
     def _call(self, name: str, args: list[BoundExpr]) -> BoundExpr:
         if name == "opnot":

@@ -94,7 +94,7 @@ class Parser:
         return stmts
 
     def parse_statement(self) -> ast.Statement:
-        if self.at_kw("SELECT", "WITH"):
+        if self.at_kw("SELECT", "WITH") or self.at_op("("):
             return self.parse_select()
         if self.at_kw("CREATE"):
             return self.parse_create()
@@ -148,9 +148,69 @@ class Parser:
 
     # -- SELECT ------------------------------------------------------------
 
-    def parse_select(self) -> ast.Select:
-        if self.at_kw("WITH"):
-            raise errors.unsupported("WITH (CTEs) not supported yet")
+    def parse_select(self):
+        """SELECT / VALUES / set-operation chain / WITH prologue."""
+        ctes: dict = {}
+        if self.accept_kw("WITH"):
+            if self.accept_kw("RECURSIVE"):
+                raise errors.unsupported("WITH RECURSIVE")
+            while True:
+                name = self.ident()
+                self.expect_kw("AS")
+                self.expect_op("(")
+                ctes[name.lower()] = self.parse_select()
+                self.expect_op(")")
+                if not self.accept_op(","):
+                    break
+        node = self._parse_select_core()
+        while self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+            op = self.ident().lower()
+            all_ = bool(self.accept_kw("ALL"))
+            self.accept_kw("DISTINCT")
+            if isinstance(node, ast.Select) and \
+                    not getattr(node, "_parens", False) and (
+                    node.order_by or node.limit is not None or
+                    node.offset is not None):
+                raise errors.syntax(
+                    "ORDER BY/LIMIT/OFFSET in a set-operation arm needs "
+                    "parentheses")
+            right = self._parse_select_core()
+            node = ast.SetOp(op, all_, node, right)
+        if isinstance(node, ast.SetOp):
+            # PG grammar: a trailing ORDER BY/LIMIT binds to the whole set
+            # operation, but the greedy core parse attaches it to the last
+            # arm — steal it back (unless that arm was parenthesized)
+            last = node.right
+            if isinstance(last, ast.Select) and \
+                    not getattr(last, "_parens", False):
+                node.order_by = last.order_by
+                node.limit = last.limit
+                node.offset = last.offset
+                last.order_by, last.limit, last.offset = [], None, None
+            if self.accept_kw("ORDER"):
+                self.expect_kw("BY")
+                node.order_by.append(self.parse_order_item())
+                while self.accept_op(","):
+                    node.order_by.append(self.parse_order_item())
+            while self.at_kw("LIMIT", "OFFSET"):
+                if self.accept_kw("LIMIT"):
+                    if not self.accept_kw("ALL"):
+                        node.limit = self.parse_expr()
+                elif self.accept_kw("OFFSET"):
+                    node.offset = self.parse_expr()
+                    self.accept_kw("ROWS") or self.accept_kw("ROW")
+        if ctes:
+            # inner (more deeply scoped) CTEs shadow outer ones; never
+            # clobber a parenthesized arm's own WITH bindings
+            node.ctes = {**ctes, **getattr(node, "ctes", {})}
+        return node
+
+    def _parse_select_core(self) -> ast.Select:
+        if self.accept_op("("):
+            inner = self.parse_select()
+            self.expect_op(")")
+            inner._parens = True  # its ORDER BY/LIMIT are scoped by parens
+            return inner
         if self.at_kw("VALUES"):
             return self._parse_values_select()
         self.expect_kw("SELECT")
@@ -187,8 +247,6 @@ class Parser:
             elif self.accept_kw("OFFSET"):
                 offset = self.parse_expr()
                 self.accept_kw("ROWS") or self.accept_kw("ROW")
-        if self.at_kw("UNION", "EXCEPT", "INTERSECT"):
-            raise errors.unsupported("set operations not supported yet")
         return ast.Select(items, from_, where, group_by, having, order_by,
                           limit, offset, distinct)
 
@@ -375,8 +433,11 @@ class Parser:
                 negated = True
             if self.accept_kw("IN"):
                 self.expect_op("(")
-                if self.at_kw("SELECT"):
-                    raise errors.unsupported("IN (subquery) not supported yet")
+                if self.at_kw("SELECT", "WITH", "VALUES"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, sub, negated)
+                    continue
                 items = [self.parse_expr()]
                 while self.accept_op(","):
                     items.append(self.parse_expr())
@@ -476,7 +537,7 @@ class Parser:
             self.next()
             return ast.Param(int(t.value))
         if self.accept_op("("):
-            if self.at_kw("SELECT"):
+            if self.at_kw("SELECT", "WITH"):
                 inner = self.parse_select()
                 self.expect_op(")")
                 return ast.Subquery(inner)
@@ -497,6 +558,13 @@ class Parser:
             return ast.Literal(False)
         if upper == "CASE":
             return self.parse_case()
+        if upper == "EXISTS" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub)
         if upper == "CAST":
             self.next()
             self.expect_op("(")

@@ -105,23 +105,74 @@ class Planner:
     def __init__(self, resolver: TableResolver, params: Optional[list] = None):
         self.resolver = resolver
         self.params = params or []
+        self.ctes: dict[str, ast.Select] = {}
+
+    def _binder(self, scope: Scope, allow_aggs: bool = False) -> ExprBinder:
+        return ExprBinder(scope, self.params, allow_aggs, planner=self)
 
     # -- FROM --------------------------------------------------------------
 
-    def plan_select(self, sel: ast.Select) -> PlanNode:
-        values_rows = getattr(sel, "values_rows", None)
-        if values_rows is not None:
-            return self._plan_values(values_rows)
-        if sel.from_ is None:
-            plan: PlanNode = ValuesNode(
-                Batch(["__dummy"], [Column.from_pylist([0])]))
-            scope = Scope([])
-        else:
-            plan, scope = self._plan_from(sel.from_)
-        return self._plan_body(sel, plan, scope)
+    def plan_select(self, sel) -> PlanNode:
+        saved = dict(self.ctes)
+        try:
+            for name, q in getattr(sel, "ctes", {}).items():
+                self.ctes[name] = q
+            if isinstance(sel, ast.SetOp):
+                return self._plan_setop(sel)
+            values_rows = getattr(sel, "values_rows", None)
+            if values_rows is not None:
+                return self._plan_values(values_rows)
+            if sel.from_ is None:
+                plan: PlanNode = ValuesNode(
+                    Batch(["__dummy"], [Column.from_pylist([0])]))
+                scope = Scope([])
+            else:
+                plan, scope = self._plan_from(sel.from_)
+            return self._plan_body(sel, plan, scope)
+        finally:
+            self.ctes = saved
+
+    def _plan_setop(self, s: ast.SetOp) -> PlanNode:
+        from ..exec.plan import LimitNode as _Limit
+        from ..exec.plan import SetOpNode, SortNode as _Sort
+        left = self.plan_select(s.left)
+        right = self.plan_select(s.right)
+        if len(left.types) != len(right.types):
+            raise errors.SqlError(
+                "42601", "each %s query must have the same number of "
+                "columns" % s.op.upper())
+        plan: PlanNode = SetOpNode(s.op, s.all, left, right)
+        if s.order_by:
+            indices, descs, nfs = [], [], []
+            for oi in s.order_by:
+                descs.append(oi.desc)
+                nfs.append(oi.nulls_first)
+                e = oi.expr
+                if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                    if not (1 <= e.value <= len(plan.names)):
+                        raise errors.SqlError(
+                            "42P10",
+                            f"ORDER BY position {e.value} is out of range")
+                    indices.append(e.value - 1)
+                elif isinstance(e, ast.ColumnRef) and len(e.parts) == 1 and \
+                        e.parts[0].lower() in [n.lower() for n in plan.names]:
+                    indices.append([n.lower() for n in plan.names]
+                                   .index(e.parts[0].lower()))
+                else:
+                    raise errors.unsupported(
+                        "ORDER BY over a set operation must use output "
+                        "column names or positions")
+            plan = _Sort(plan, indices, descs, nfs)
+        if s.limit is not None or s.offset is not None:
+            limit = _const_int(s.limit, self.params) \
+                if s.limit is not None else None
+            offset = _const_int(s.offset, self.params) \
+                if s.offset is not None else 0
+            plan = _Limit(plan, limit, offset)
+        return plan
 
     def _plan_values(self, rows: list[list[ast.Expr]]) -> PlanNode:
-        binder = ExprBinder(Scope([]), self.params)
+        binder = self._binder(Scope([]))
         cols = []
         width = len(rows[0])
         one = Batch(["__dummy"], [Column.from_pylist([0])])
@@ -141,10 +192,24 @@ class Planner:
 
     def _plan_from(self, ref: ast.TableRef) -> tuple[PlanNode, Scope]:
         if isinstance(ref, ast.NamedTable):
+            if len(ref.parts) == 1 and ref.parts[0].lower() in self.ctes:
+                # shadow the name while planning its body: non-recursive
+                # WITH must not see itself (PG resolves to 42P01 there)
+                key = ref.parts[0].lower()
+                body = self.ctes.pop(key)
+                try:
+                    inner = self.plan_select(body)
+                finally:
+                    self.ctes[key] = body
+                alias = ref.alias or ref.parts[0]
+                scope = Scope([ScopeColumn(alias, n, t, i)
+                               for i, (n, t) in enumerate(
+                                   zip(inner.names, inner.types))])
+                return inner, scope
             provider = self.resolver.resolve_table(ref.parts)
             return self._scan_scope(provider, ref.alias or ref.parts[-1])
         if isinstance(ref, ast.TableFunction):
-            binder = ExprBinder(Scope([]), self.params)
+            binder = self._binder(Scope([]))
             args = []
             for a in ref.args:
                 b = binder.bind(a)
@@ -195,7 +260,7 @@ class Planner:
                 else:
                     residual_parts.append(c)
             if residual_parts:
-                binder = ExprBinder(combined, self.params)
+                binder = self._binder(combined)
                 bound = [binder.bind(p) for p in residual_parts]
                 residual = bound[0] if len(bound) == 1 else BoundFunc(
                     "and", bound, dt.BOOL, lambda cols, b: kleene_and(cols))
@@ -208,8 +273,8 @@ class Planner:
             return None
         for a, b in ((e.left, e.right), (e.right, e.left)):
             try:
-                lb = ExprBinder(lscope, self.params).bind(a)
-                rb = ExprBinder(rscope, self.params).bind(b)
+                lb = self._binder(lscope).bind(a)
+                rb = self._binder(rscope).bind(b)
                 return (lb, rb)
             except errors.SqlError:
                 continue
@@ -220,7 +285,7 @@ class Planner:
     def _plan_body(self, sel: ast.Select, plan: PlanNode,
                    scope: Scope) -> PlanNode:
         if sel.where is not None:
-            binder = ExprBinder(scope, self.params)
+            binder = self._binder(scope)
             pred = binder.bind(sel.where)
             plan = self._push_filter(plan, pred)
 
@@ -244,11 +309,11 @@ class Planner:
             plan, exprs, bind_order = self._plan_aggregate(sel, items, plan,
                                                            scope)
         else:
-            binder = ExprBinder(scope, self.params)
+            binder = self._binder(scope)
             exprs = [binder.bind(it.expr) for it in items]
 
             def bind_order(e: ast.Expr) -> BoundExpr:
-                return ExprBinder(scope, self.params).bind(e)
+                return self._binder(scope).bind(e)
 
         # ORDER BY: positions, select aliases, then arbitrary expressions
         sort_exprs: list[BoundExpr] = []
@@ -322,7 +387,7 @@ class Planner:
 
     def _plan_aggregate(self, sel: ast.Select, items: list[ast.SelectItem],
                         plan: PlanNode, scope: Scope):
-        base = ExprBinder(scope, self.params, allow_aggs=True)
+        base = self._binder(scope, allow_aggs=True)
         group_asts: list[ast.Expr] = []
         group_bound: list[BoundExpr] = []
         for g in sel.group_by:
@@ -342,6 +407,7 @@ class Planner:
 
         post = PostAggBinder(scope, self.params, group_asts,
                              [b.type for b in group_bound])
+        post.planner = self
         bound_items = [post.bind(it.expr) for it in items]
         having_b = post.bind(sel.having) if sel.having is not None else None
 
