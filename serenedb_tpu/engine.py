@@ -502,6 +502,8 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE VIEW")
         if isinstance(st, ast.CreateIndex):
             return self._create_index(st)
+        if isinstance(st, ast.AlterTable):
+            return self._alter_table(st)
         if isinstance(st, ast.CreateSequence):
             self.db.create_sequence(".".join(st.name), st.start,
                                     st.increment, st.if_not_exists)
@@ -652,6 +654,98 @@ class Connection:
             self.db.store.update_meta(
                 lambda m: m["indexes"].__setitem__(idx_name, idef))
         return QueryResult(Batch([], []), "CREATE INDEX")
+
+    def _alter_table(self, st: ast.AlterTable) -> QueryResult:
+        try:
+            table = self._table_for_dml(st.table)
+        except errors.SqlError:
+            if st.if_exists:
+                return QueryResult(Batch([], []), "ALTER TABLE")
+            raise
+        with self.db.lock:
+            full = table.full_batch()
+            names = list(full.names)
+            if st.action == "add_column":
+                if st.column in names:
+                    if st.if_not_exists:
+                        return QueryResult(Batch([], []), "ALTER TABLE")
+                    raise errors.SqlError(
+                        "42701", f'column "{st.column}" already exists')
+                t = dt.type_from_name(st.type_name)
+                col = Column.from_pylist([None] * full.num_rows, t)
+                table.replace(Batch(names + [st.column],
+                                    list(full.columns) + [col]))
+            elif st.action == "drop_column":
+                if st.column not in names:
+                    if st.col_if_exists:
+                        return QueryResult(Batch([], []), "ALTER TABLE")
+                    raise errors.SqlError(
+                        errors.UNDEFINED_COLUMN,
+                        f'column "{st.column}" does not exist')
+                if len(names) == 1:
+                    raise errors.SqlError(
+                        "0A000", "cannot drop the only column of a table")
+                keep = [i for i, n in enumerate(names) if n != st.column]
+                table.replace(Batch([names[i] for i in keep],
+                                    [full.columns[i] for i in keep]))
+            elif st.action == "rename_column":
+                if st.column not in names:
+                    raise errors.SqlError(
+                        errors.UNDEFINED_COLUMN,
+                        f'column "{st.column}" does not exist')
+                if st.new_name in names:
+                    raise errors.SqlError(
+                        "42701", f'column "{st.new_name}" already exists')
+                new_names = [st.new_name if n == st.column else n
+                             for n in names]
+                table.replace(Batch(new_names, list(full.columns)))
+            elif st.action == "rename_table":
+                schema, name = self.db._split(st.table)
+                s = self.db.schemas[schema]
+                new_key = st.new_name.lower()
+                if new_key in s.tables or new_key in s.views:
+                    raise errors.SqlError(
+                        errors.DUPLICATE_TABLE,
+                        f'relation "{st.new_name}" already exists')
+                del s.tables[name.lower()]
+                table.name = st.new_name
+                s.tables[new_key] = table
+                if isinstance(table, StoredTable):
+                    old_skey = table.key
+                    table.key = f"{schema}.{new_key}"
+            # indexes over altered tables rebuild on next refresh; dropped/
+            # renamed columns drop their indexes
+            if st.action in ("drop_column", "rename_column"):
+                for iname, idx in list(getattr(table, "indexes",
+                                               {}).items()):
+                    if st.column in idx.columns:
+                        del table.indexes[iname]
+            # persist new shape
+            if self.db.store is not None and isinstance(table, StoredTable):
+                from .storage.store import table_def
+                tick = self.db.store.ticks.current()
+                tdef = table_def(table.key, table.table_id,
+                                 table.column_names, table.column_types,
+                                 getattr(table, "table_meta", {}), tick)
+                self.db.store.write_snapshot(table.table_id,
+                                             table.full_batch())
+                tdef["checkpoint_tick"] = tick
+                key = table.key
+
+                def mutate(m):
+                    if st.action == "rename_table":
+                        m["tables"].pop(old_skey, None)
+                        for idef in m["indexes"].values():
+                            if idef["table"] == old_skey:
+                                idef["table"] = key
+                    m["tables"][key] = tdef
+                    if st.action in ("drop_column", "rename_column"):
+                        m["indexes"] = {
+                            k: v for k, v in m["indexes"].items()
+                            if not (v["table"] == key and
+                                    st.column in v["columns"])}
+                self.db.store.update_meta(mutate)
+        return QueryResult(Batch([], []), "ALTER TABLE")
 
     def _table_for_dml(self, parts: list[str]) -> MemTable:
         provider = self.db.resolve_table(parts)
@@ -840,6 +934,9 @@ class Connection:
 
     def _copy(self, st: ast.CopyStmt, params: list) -> QueryResult:
         from .utils.progress import REGISTRY as _progress
+        if st.target in ("STDIN", "STDOUT"):
+            raise errors.unsupported(
+                f"COPY {st.target} is only available over the wire protocol")
         fmt = str(st.options.get("format", "csv")).lower()
         if st.direction == "from":
             table = self._table_for_dml(st.table)
@@ -854,6 +951,101 @@ class Connection:
             else:
                 _write_csv(st.target, full, st.options)
         return QueryResult(Batch([], []), f"COPY {full.num_rows}")
+
+    def copy_in_data(self, st: ast.CopyStmt, data: bytes) -> QueryResult:
+        """COPY ... FROM STDIN: parse the wire-fed payload (PG text format
+        by default: tab-delimited, \\N nulls, backslash escapes; or csv)."""
+        table = self._table_for_dml(st.table)
+        for c in st.columns or []:
+            if c not in table.column_names:
+                raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                      f'column "{c}" does not exist')
+        fmt = str(st.options.get("format", "text")).lower()
+        delim = str(st.options.get("delimiter",
+                                   "," if fmt == "csv" else "\t"))
+        null_s = str(st.options.get("null", "" if fmt == "csv" else "\\N"))
+        target_names = st.columns or list(table.column_names)
+        types = [table.column_types[table.column_names.index(c)]
+                 for c in target_names]
+        text = data.decode("utf-8")
+        rows = []
+        is_csv = fmt == "csv"
+        if is_csv:
+            import csv as _csv
+            import io as _io
+            header = str(st.options.get("header", "false")).lower() in \
+                ("true", "on", "1")
+            rdr = _csv.reader(_io.StringIO(text), delimiter=delim)
+            rows = [r for r in rdr if r]
+            if header and rows:
+                rows = rows[1:]
+        else:
+            lines = text.split("\n")
+            if lines and lines[-1] == "":
+                lines.pop()          # trailing newline, not a row
+            for line in lines:
+                if line == "\\.":
+                    break            # end-of-data marker terminates input
+                # raw split: null markers compare BEFORE unescaping so a
+                # literal backslash-N value (escaped as \\N) round-trips
+                rows.append(_copy_text_split_raw(line, delim))
+        cols_vals: list[list] = [[] for _ in target_names]
+        from .sql.binder import _cast_text_to
+        for r in rows:
+            if len(r) != len(target_names):
+                raise errors.SqlError(
+                    "22P04", f"row has {len(r)} columns, expected "
+                             f"{len(target_names)}")
+            for k, raw in enumerate(r):
+                if raw == null_s:
+                    cols_vals[k].append(None)
+                    continue
+                val = raw if is_csv else _copy_text_unescape(raw)
+                if types[k].is_string:
+                    cols_vals[k].append(val)
+                else:
+                    cols_vals[k].append(_cast_text_to(val, types[k]))
+        incoming = Batch(list(target_names),
+                         [Column.from_pylist(v, t)
+                          for v, t in zip(cols_vals, types)])
+        self._insert_batch(table, incoming)
+        return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
+
+    def copy_out_data(self, st: ast.CopyStmt) -> tuple[list[bytes], int]:
+        """COPY ... TO STDOUT: PG text format by default, or csv with the
+        same options copy_in_data honors."""
+        provider = self.db.resolve_table(st.table)
+        full = provider.full_batch(st.columns)
+        cols = [c.to_pylist() for c in full.columns]
+        fmt = str(st.options.get("format", "text")).lower()
+        if fmt == "csv":
+            import csv as _csv
+            import io as _io
+            delim = str(st.options.get("delimiter", ","))
+            null_s = str(st.options.get("null", ""))
+            out = []
+            for i in range(full.num_rows):
+                buf = _io.StringIO()
+                w = _csv.writer(buf, delimiter=delim, lineterminator="\n")
+                w.writerow([null_s if v is None else v
+                            for v in (col[i] for col in cols)])
+                out.append(buf.getvalue().encode())
+            return out, full.num_rows
+        delim = str(st.options.get("delimiter", "\t"))
+        null_s = str(st.options.get("null", "\\N"))
+        out = []
+        for i in range(full.num_rows):
+            parts = []
+            for v in (col[i] for col in cols):
+                if v is None:
+                    parts.append(null_s)
+                else:
+                    s = str(v)
+                    s = s.replace("\\", "\\\\").replace("\t", "\\t") \
+                         .replace("\n", "\\n").replace("\r", "\\r")
+                    parts.append(s)
+            out.append((delim.join(parts) + "\n").encode())
+        return out, full.num_rows
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
@@ -926,6 +1118,45 @@ def _coerce(col: Column, target: dt.SqlType) -> Column:
             return Column.from_pylist([None] * len(col), target)
         return col
     return cast_column(col, target)
+
+
+def _copy_text_split_raw(line: str, delim: str) -> list[str]:
+    """Split one PG COPY text-format line into RAW (still-escaped) fields:
+    escape pairs are kept verbatim so the null-marker comparison happens
+    before unescaping (PG semantics — a literal backslash-N survives)."""
+    out = []
+    cur = []
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == "\\" and i + 1 < len(line):
+            cur.append(line[i:i + 2])
+            i += 2
+            continue
+        if c == delim:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _copy_text_unescape(raw: str) -> str:
+    out = []
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\" and i + 1 < len(raw):
+            nxt = raw[i + 1]
+            out.append({"t": "\t", "n": "\n", "r": "\r",
+                        "\\": "\\"}.get(nxt, nxt))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def _setting_text(v) -> str:

@@ -547,8 +547,25 @@ class AggregateNode(PlanNode):
                 acc = np.zeros(g, dtype=bool)
                 np.logical_or.at(acc, vc, vb)
             return Column(dt.BOOL, acc, ~empty if empty.any() else None)
-        if spec.func == "string_agg":
-            raise errors.unsupported("string_agg with GROUP BY")
+        if spec.func in ("string_agg", "array_agg"):
+            import json as _json
+            vals_all = arg.to_pylist()
+            groups: dict[int, list] = {}
+            for i, code in enumerate(codes):
+                v = vals_all[i]
+                if v is None:
+                    continue
+                groups.setdefault(int(code), []).append(v)
+            out = []
+            for gi in range(g):
+                items = groups.get(gi)
+                if items is None:
+                    out.append(None)
+                elif spec.func == "string_agg":
+                    out.append((spec.sep or "").join(str(x) for x in items))
+                else:
+                    out.append(_json.dumps(items))
+            return Column.from_pylist(out, dt.VARCHAR)
         raise errors.unsupported(f"aggregate {spec.func}")
 
     def _cpu_group_distinct(self, spec: AggSpec, arg: Column,
@@ -637,7 +654,7 @@ class _ScalarAcc:
             else:
                 self.bool_acc = (self.bool_acc and bool(v)) \
                     if spec.func == "bool_and" else (self.bool_acc or bool(v))
-        elif spec.func == "string_agg":
+        elif spec.func in ("string_agg", "array_agg"):
             self.strings.extend(v for v in col.to_pylist() if v is not None)
         elif spec.func == "count":
             pass
@@ -680,5 +697,12 @@ class _ScalarAcc:
         if spec.func in ("bool_and", "bool_or"):
             return Column.from_pylist([self.bool_acc], t)
         if spec.func == "string_agg":
-            return Column.from_pylist([",".join(self.strings) or None], t)
+            sep = spec.sep if spec.sep is not None else ""
+            v = sep.join(str(x) for x in self.strings) if self.strings \
+                else None
+            return Column.from_pylist([v], t)
+        if spec.func == "array_agg":
+            import json as _json
+            v = _json.dumps(self.strings) if self.strings else None
+            return Column.from_pylist([v], t)
         raise errors.unsupported(f"aggregate {spec.func}")

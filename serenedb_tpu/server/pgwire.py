@@ -305,6 +305,10 @@ class PgSession:
             if not stmts:
                 self.w.empty_query()
             for st in stmts:
+                if isinstance(st, ast.CopyStmt) and \
+                        st.target in ("STDIN", "STDOUT"):
+                    await self._run_copy(st)
+                    continue
                 res = await loop.run_in_executor(
                     self.server.pool, self.conn.execute_statement, st, [])
                 self._send_result(res, describe=True)
@@ -317,6 +321,55 @@ class PgSession:
             self.w.error(errors.SqlError("XX000", f"internal error: {e}"))
         self.w.ready(self._txn_status())
         await self.w.flush()
+
+    async def _run_copy(self, st):
+        """COPY ... FROM STDIN / TO STDOUT sub-protocol (reference:
+        pg_wire_session COPY in/out legs, SURVEY.md §2.2)."""
+        if self.conn.txn_failed:
+            raise errors.SqlError(
+                errors.IN_FAILED_TRANSACTION,
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block")
+        loop = asyncio.get_running_loop()
+        if st.direction == "from":
+            ncols = len(st.columns) if st.columns else \
+                len(self.conn.db.resolve_table(st.table).column_names)
+            self.w.msg(b"G", struct.pack("!bH", 0, ncols) +
+                       struct.pack("!h", 0) * ncols)
+            await self.w.flush()
+            chunks = []
+            failed = None
+            while True:
+                kind, payload = await self._read_msg()
+                if kind == b"d":
+                    chunks.append(payload)
+                elif kind == b"c":
+                    break
+                elif kind == b"f":
+                    failed = payload[:-1].decode() or "COPY terminated"
+                    break
+                elif kind == b"X":
+                    raise ConnectionResetError
+                # 'H'/'S' flush/sync during copy: ignore
+            if failed is not None:
+                raise errors.SqlError("57014",
+                                      f"COPY from stdin failed: {failed}")
+            data = b"".join(chunks)
+            res = await loop.run_in_executor(
+                self.server.pool, self.conn.copy_in_data, st, data)
+            self.w.command_complete(res.command_tag)
+            return
+        # COPY TO STDOUT
+        rows, n = await loop.run_in_executor(
+            self.server.pool, self.conn.copy_out_data, st)
+        ncols = len(st.columns) if st.columns else \
+            len(self.conn.db.resolve_table(st.table).column_names)
+        self.w.msg(b"H", struct.pack("!bH", 0, ncols) +
+                   struct.pack("!h", 0) * ncols)
+        for row in rows:
+            self.w.msg(b"d", row)
+        self.w.msg(b"c")
+        self.w.command_complete(f"COPY {n}")
 
     def _note_error(self):
         """Any error inside an explicit transaction block aborts it (the

@@ -237,6 +237,18 @@ class CreateSchema(Statement):
 
 
 @dataclass
+class AlterTable(Statement):
+    table: list[str]
+    action: str               # add_column | drop_column | rename_column | rename_table
+    column: Optional[str] = None
+    type_name: Optional[str] = None
+    new_name: Optional[str] = None
+    if_exists: bool = False          # table-level: ALTER TABLE IF EXISTS
+    col_if_exists: bool = False      # column-level: DROP COLUMN IF EXISTS
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateSequence(Statement):
     name: list[str]
     start: int = 1

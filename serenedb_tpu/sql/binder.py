@@ -25,6 +25,7 @@ from .expr import (AggSpec, BoundAggRef, BoundCase, BoundColumn, BoundExpr,
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_star",
              "stddev", "stddev_samp", "var_samp", "variance",
              "string_agg", "array_agg", "bool_and", "bool_or"}
+AGG_TWO_ARG = {"string_agg"}
 
 
 @dataclass
@@ -213,6 +214,16 @@ class ExprBinder:
         name = e.name
         if e.star or (name == "count" and not e.args):
             spec = AggSpec("count_star", None, False, dt.BIGINT)
+        elif name in AGG_TWO_ARG and len(e.args) == 2:
+            arg = self.bind(e.args[0])
+            sep_b = self.bind(e.args[1])
+            if not isinstance(sep_b, BoundLiteral):
+                raise errors.unsupported(
+                    f"{name} separator must be a constant")
+            out_t = _agg_result_type(name, arg.type)
+            # PG: a NULL delimiter concatenates with no separator
+            sep = "" if sep_b.value is None else str(sep_b.value)
+            spec = AggSpec(name, arg, e.distinct, out_t, sep=sep)
         else:
             if len(e.args) != 1:
                 raise errors.unsupported(f"{name} with {len(e.args)} args")
@@ -375,8 +386,8 @@ def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
         return arg_t
     if name in ("bool_and", "bool_or"):
         return dt.BOOL
-    if name in ("string_agg",):
-        return dt.VARCHAR
+    if name in ("string_agg", "array_agg"):
+        return dt.VARCHAR   # array_agg renders as a JSON array (no ARRAY type yet)
     raise errors.unsupported(f"aggregate {name}")
 
 

@@ -120,10 +120,11 @@ class BoundAggRef(BoundExpr):
 @dataclass
 class AggSpec:
     """One aggregate computation: func over an argument expression."""
-    func: str                      # count/sum/min/max/avg/count_star
+    func: str                      # count/sum/min/max/avg/count_star/...
     arg: Optional[BoundExpr]
     distinct: bool
     type: dt.SqlType
+    sep: Optional[str] = None      # string_agg separator
 
 
 # -- NULL-aware kernels used by the function library -----------------------

@@ -134,6 +134,8 @@ class Parser:
             self.next()
             analyze = self.accept_kw("ANALYZE")
             return ast.Explain(self.parse_statement(), analyze)
+        if self.at_kw("ALTER"):
+            return self.parse_alter()
         if self.at_kw("COPY"):
             return self.parse_copy()
         if self.at_kw("VACUUM"):
@@ -851,6 +853,42 @@ class Parser:
                 return ast.SetStmt(name, False)
             return ast.SetStmt(name, v)
         raise errors.syntax("bad SET value")
+
+    def parse_alter(self) -> ast.AlterTable:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        if_exists = False
+        if self.accept_kw("IF"):
+            self.expect_kw("EXISTS")
+            if_exists = True
+        table = self.qualified_name()
+        if self.accept_kw("ADD"):
+            self.accept_kw("COLUMN")
+            ine = self._if_not_exists()
+            col = self.ident()
+            tn = self._type_name()
+            return ast.AlterTable(table, "add_column", col, tn,
+                                  if_exists=if_exists, if_not_exists=ine)
+        if self.accept_kw("DROP"):
+            self.accept_kw("COLUMN")
+            ife2 = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                ife2 = True
+            col = self.ident()
+            return ast.AlterTable(table, "drop_column", col,
+                                  if_exists=if_exists, col_if_exists=ife2)
+        if self.accept_kw("RENAME"):
+            if self.accept_kw("COLUMN"):
+                col = self.ident()
+                self.expect_kw("TO")
+                return ast.AlterTable(table, "rename_column", col,
+                                      new_name=self.ident(),
+                                      if_exists=if_exists)
+            self.expect_kw("TO")
+            return ast.AlterTable(table, "rename_table",
+                                  new_name=self.ident(), if_exists=if_exists)
+        raise errors.unsupported("that ALTER TABLE action")
 
     def parse_copy(self) -> ast.CopyStmt:
         self.expect_kw("COPY")

@@ -277,3 +277,86 @@ def test_transaction_status_bytes(server):
     c.query("ROLLBACK")
     assert c.status == b"I"
     c.close()
+
+
+def test_copy_from_stdin_and_to_stdout(server):
+    c = RawPg(server.port)
+    c.query("CREATE TABLE cp (a INT, s TEXT)")
+    # COPY FROM STDIN: expect CopyInResponse then send data
+    c.send(b"Q", b"COPY cp FROM STDIN\x00")
+    kind, payload = c.read_msg()
+    assert kind == b"G", kind
+    c.send(b"d", b"1\thello\n2\t\\N\n")
+    c.send(b"c")
+    tags = []
+    while True:
+        kind, payload = c.read_msg()
+        if kind == b"C":
+            tags.append(payload[:-1].decode())
+        elif kind == b"Z":
+            break
+    assert tags == ["COPY 2"]
+    _, rows, _, _ = c.query("SELECT a, s FROM cp ORDER BY a")
+    assert rows == [("1", "hello"), ("2", None)]
+    # COPY TO STDOUT
+    c.send(b"Q", b"COPY cp TO STDOUT\x00")
+    kind, payload = c.read_msg()
+    assert kind == b"H"
+    data = []
+    while True:
+        kind, payload = c.read_msg()
+        if kind == b"d":
+            data.append(payload)
+        elif kind == b"c":
+            pass
+        elif kind == b"C":
+            assert payload[:-1] == b"COPY 2"
+        elif kind == b"Z":
+            break
+    assert b"".join(data) == b"1\thello\n2\t\\N\n"
+    c.query("DROP TABLE cp")
+    c.close()
+
+
+def test_copy_literal_backslash_n_roundtrip(server):
+    c = RawPg(server.port)
+    c.query("CREATE TABLE cpb (s TEXT)")
+    c.send(b"Q", b"COPY cpb FROM STDIN\x00")
+    k, _ = c.read_msg(); assert k == b"G"
+    # literal backslash-N is escaped as \\N — must NOT become NULL
+    c.send(b"d", b"\\\\N\n\\N\nplain\n")
+    c.send(b"c")
+    while True:
+        k, p = c.read_msg()
+        if k == b"Z":
+            break
+    _, rows, _, _ = c.query(
+        "SELECT s IS NULL, coalesce(s, '<null>') FROM cpb")
+    got = sorted(rows)
+    assert ("f", "\\N") in got       # the literal two-char value survives
+    assert ("t", "<null>") in got    # the bare marker is NULL
+    assert ("f", "plain") in got
+    c.query("DROP TABLE cpb")
+    c.close()
+
+
+def test_copy_rejected_in_aborted_txn(server):
+    c = RawPg(server.port)
+    c.query("CREATE TABLE cpt (a INT)")
+    c.query("BEGIN")
+    c.query("SELECT broken from syntax here")
+    c.send(b"Q", b"COPY cpt FROM STDIN\x00")
+    errs = []
+    while True:
+        k, p = c.read_msg()
+        if k == b"E":
+            errs.append(_parse_err(p))
+        elif k == b"G":
+            raise AssertionError("CopyInResponse in aborted txn")
+        elif k == b"Z":
+            break
+    assert errs and errs[0]["C"] == "25P02"
+    c.query("ROLLBACK")
+    assert c.query("SELECT count(*) FROM cpt")[1] == [("0",)]
+    c.query("DROP TABLE cpt")
+    c.close()

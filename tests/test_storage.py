@@ -233,3 +233,20 @@ def test_drop_schema_cascade_survives_restart(tmp_path):
     db2 = Database(d)              # must not KeyError on orphan defs
     assert "s2" not in db2.schemas
     db2.close()
+
+
+def test_alter_table_survives_restart(tmp_path):
+    from serenedb_tpu.engine import Database
+    d = str(tmp_path / "data")
+    db = Database(d)
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT)")
+    c.execute("INSERT INTO t VALUES (1)")
+    c.execute("ALTER TABLE t ADD COLUMN note TEXT")
+    c.execute("UPDATE t SET note = 'hello' WHERE a = 1")
+    c.execute("ALTER TABLE t RENAME TO t2")
+    db.close()
+    db2 = Database(d)
+    rows = db2.connect().execute("SELECT a, note FROM t2").rows()
+    assert rows == [(1, "hello")]
+    db2.close()
