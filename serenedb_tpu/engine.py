@@ -674,7 +674,8 @@ class Connection:
                 t = dt.type_from_name(st.type_name)
                 col = Column.from_pylist([None] * full.num_rows, t)
                 table.replace(Batch(names + [st.column],
-                                    list(full.columns) + [col]))
+                                    list(full.columns) + [col]),
+                              rows_preserved=True)
             elif st.action == "drop_column":
                 if st.column not in names:
                     if st.col_if_exists:
@@ -687,7 +688,8 @@ class Connection:
                         "0A000", "cannot drop the only column of a table")
                 keep = [i for i, n in enumerate(names) if n != st.column]
                 table.replace(Batch([names[i] for i in keep],
-                                    [full.columns[i] for i in keep]))
+                                    [full.columns[i] for i in keep]),
+                              rows_preserved=True)
             elif st.action == "rename_column":
                 if st.column not in names:
                     raise errors.SqlError(
@@ -698,7 +700,8 @@ class Connection:
                         "42701", f'column "{st.new_name}" already exists')
                 new_names = [st.new_name if n == st.column else n
                              for n in names]
-                table.replace(Batch(new_names, list(full.columns)))
+                table.replace(Batch(new_names, list(full.columns)),
+                              rows_preserved=True)
             elif st.action == "rename_table":
                 schema, name = self.db._split(st.table)
                 s = self.db.schemas[schema]
@@ -1092,24 +1095,17 @@ def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:
 
 
 def _append_rows(table: MemTable, aligned: Batch) -> None:
-    current = table.full_batch()
-    new_cols = []
-    for i, name in enumerate(table.column_names):
-        merged = concat_batches(
-            [Batch([name], [current.columns[i]]),
-             Batch([name], [aligned.columns[i]])]).columns[0]
-        new_cols.append(merged)
-    table.replace(Batch(list(table.column_names), new_cols))
+    table.append_batch(aligned)
 
 
 def _refresh_indexes(db: Database, table: MemTable) -> None:
-    """Rebuild any index whose data_version is stale (the refresh leg of the
-    reference's RefreshLoop, task.cpp:237-343)."""
-    from .search.index import build_index_for_table
+    """Refresh any index whose data_version is stale (the refresh leg of
+    the reference's RefreshLoop, task.cpp:237-343): appends publish a new
+    segment, mutations trigger the rebuild/merge leg."""
+    from .search.index import refresh_index
     for name, idx in list(getattr(table, "indexes", {}).items()):
         if idx.data_version != table.data_version:
-            table.indexes[name] = build_index_for_table(
-                table, idx.columns, idx.using, idx.options)
+            table.indexes[name] = refresh_index(table, idx)
 
 
 def _coerce(col: Column, target: dt.SqlType) -> Column:

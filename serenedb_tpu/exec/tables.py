@@ -82,13 +82,21 @@ class TableProvider:
 
 class MemTable(TableProvider):
     """In-memory columnar table (also the transactional-store table engine's
-    in-memory representation until the storage layer lands)."""
+    in-memory representation until the storage layer lands).
+
+    Two change counters steer index maintenance:
+    - data_version: bumps on ANY change (freshness checks)
+    - mutation_epoch: bumps only when existing row identity/order changes
+      (delete/update/truncate). Pure appends keep the epoch, which lets
+      search indexes refresh incrementally with a new segment instead of a
+      full rebuild (the reference's segment model, SURVEY.md §2.7)."""
 
     def __init__(self, name: str, batch: Batch):
         self.name = name
         self._batch = batch
         self.column_names = list(batch.names)
         self.column_types = [c.type for c in batch.columns]
+        self.mutation_epoch = 0
 
     def row_count(self) -> int:
         return self._batch.num_rows
@@ -102,11 +110,26 @@ class MemTable(TableProvider):
                                   f"column {missing[0]} does not exist")
         return Batch(list(columns), [self._batch.column(c) for c in columns])
 
-    def replace(self, batch: Batch):
+    def replace(self, batch: Batch, *, rows_preserved: bool = False):
         self._batch = batch
         self.column_names = list(batch.names)
         self.column_types = [c.type for c in batch.columns]
+        if not rows_preserved:
+            self.mutation_epoch += 1
         self.invalidate_device_cache()
+
+    def append_batch(self, aligned: Batch):
+        """Append rows (schema-aligned) without changing existing row
+        identity — search indexes stay valid for the old rows."""
+        from ..columnar.column import concat_batches
+        cols = []
+        for i, name in enumerate(self.column_names):
+            merged = concat_batches(
+                [Batch([name], [self._batch.columns[i]]),
+                 Batch([name], [aligned.columns[i]])]).columns[0]
+            cols.append(merged)
+        self.replace(Batch(list(self.column_names), cols),
+                     rows_preserved=True)
 
 
 _PA_TYPE_MAP = None

@@ -126,17 +126,23 @@ class QueryBatch:
 def assemble_query_batch(store: BlockStore, n_docs: int,
                          queries: list[tuple[np.ndarray, int]],
                          doc_freq: np.ndarray,
-                         scorer: str = "bm25") -> QueryBatch:
+                         scorer: str = "bm25", idf_of=None) -> QueryBatch:
     """queries: list of (term_ids, require_all) per query. Weights are the
-    scorer's per-term idf (computed here so one dispatch covers all)."""
+    scorer's per-term idf (computed here so one dispatch covers all);
+    idf_of overrides with global collection stats for multi-segment
+    searches."""
     rows, row_w, row_q = [], [], []
     tails_d, tails_f, tails_w, tails_q = [], [], [], []
     require = []
     for qi, (term_ids, req) in enumerate(queries):
         require.append(req)
-        idf = idf_for(scorer, n_docs,
-                      doc_freq[np.asarray(term_ids, dtype=np.int64)]) \
-            if len(term_ids) else np.empty(0, dtype=np.float32)
+        tid_arr = np.asarray(term_ids, dtype=np.int64)
+        if not len(term_ids):
+            idf = np.empty(0, dtype=np.float32)
+        elif idf_of is not None:
+            idf = np.asarray(idf_of(tid_arr), dtype=np.float32)
+        else:
+            idf = idf_for(scorer, n_docs, doc_freq[tid_arr])
         for k, tid in enumerate(term_ids):
             tid = int(tid)
             w = float(idf[k])

@@ -11,7 +11,7 @@ import numpy as np
 
 from .. import errors
 from .analysis import get_analyzer
-from .searcher import SearchIndex, SegmentSearcher
+from .searcher import MultiSearcher, SearchIndex, SegmentSearcher
 from .segment import build_field_index
 
 
@@ -26,6 +26,7 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
             raise errors.unsupported("ivf index over multiple columns")
         return build_ivf_index(provider, columns[0], options)
     searchers = {}
+    n_rows = provider.row_count()
     if using == "inverted":
         an = get_analyzer(analyzer_name)
         for col_name in columns:
@@ -37,9 +38,57 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
                     f"is {col.type}")
             texts = col.to_pylist()
             fi = build_field_index(texts, an)
-            searchers[col_name] = SegmentSearcher(fi, an, len(texts))
+            ms = MultiSearcher(an)
+            ms.add_segment(SegmentSearcher(fi, an, len(texts)), 0)
+            searchers[col_name] = ms
     return SearchIndex(list(columns), using, dict(options), analyzer_name,
-                       searchers, provider.data_version)
+                       searchers, provider.data_version,
+                       mutation_epoch=getattr(provider, "mutation_epoch", 0),
+                       indexed_rows=n_rows)
+
+
+MAX_SEGMENTS = 8   # compaction threshold: full rebuild merges the tier
+
+
+def refresh_index(provider, idx: SearchIndex) -> SearchIndex:
+    """Refresh one inverted index (reference RefreshLoop leg):
+    - rows appended since the last refresh → ONE new segment over the delta
+      (O(new docs), the real-time path)
+    - row mutations (delete/update/truncate) or too many segments → full
+      rebuild (the compaction/merge leg)."""
+    if idx.using != "inverted":
+        return build_index_for_table(provider, idx.columns, idx.using,
+                                     idx.options)
+    same_epoch = idx.mutation_epoch == getattr(provider, "mutation_epoch", 0)
+    n_rows = provider.row_count()
+    n_segments = max((len(ms.segments)
+                      for ms in idx.searchers.values()), default=1)
+    if not same_epoch or n_rows < idx.indexed_rows or \
+            n_segments >= MAX_SEGMENTS:
+        return build_index_for_table(provider, idx.columns, idx.using,
+                                     idx.options)
+    an = get_analyzer(idx.analyzer_name)
+    base = idx.indexed_rows
+    # build-new-then-swap: assemble fresh MultiSearchers (reusing the old
+    # immutable SegmentSearcher objects) and return a NEW SearchIndex the
+    # caller publishes with one assignment — in-flight queries keep their
+    # consistent snapshot, and a failure mid-build publishes nothing
+    new_searchers = {}
+    for col_name in idx.columns:
+        ms = MultiSearcher(an)
+        for seg, seg_base in idx.searchers[col_name].segments:
+            ms.add_segment(seg, seg_base)
+        if n_rows > base:
+            col = provider.full_batch([col_name]).column(col_name)
+            delta = col.slice(base, n_rows).to_pylist()  # O(new docs)
+            fi = build_field_index(delta, an)
+            ms.add_segment(SegmentSearcher(fi, an, len(delta)), base)
+        new_searchers[col_name] = ms
+    return SearchIndex(list(idx.columns), idx.using, dict(idx.options),
+                       idx.analyzer_name, new_searchers,
+                       provider.data_version,
+                       mutation_epoch=idx.mutation_epoch,
+                       indexed_rows=n_rows)
 
 
 def find_index(provider, column: str):

@@ -187,6 +187,7 @@ class SegmentSearcher:
         return self.topk_batch([node], k, scorer)[0]
 
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
+                   idf_of=None, avgdl_override=None,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
         """Top-k (scores, doc ids) for a batch of queries in ONE device
         dispatch (amortizes dispatch latency — the QPS regime). Pure term
@@ -202,7 +203,8 @@ class SegmentSearcher:
                     else np.empty(0, dtype=np.int64), req)
                    for tids, req, _, empty in shapes]
         qb = bm25_ops.assemble_query_batch(store, self.num_docs, queries,
-                                           self.index.doc_freq, scorer)
+                                           self.index.doc_freq, scorer,
+                                           idf_of=idf_of)
         kk = bm25_ops.pad_k(min(max(k, 1), max(self.num_docs, 1)))
         kk = min(kk, nd_pad)
         ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
@@ -210,7 +212,9 @@ class SegmentSearcher:
             store.block_docs, store.block_tfs, store.norms,
             jnp.asarray(ints), jnp.asarray(floats), nb, tt,
             nd_pad, kk, nq, bool(qb.require.any()),
-            K1, B, self.index.avgdl, scorer)
+            K1, B,
+            avgdl_override if avgdl_override is not None
+            else self.index.avgdl, scorer)
         vals, docs = jax.device_get((vals, docs))
         out = []
         for qi, (node, (tids, req, needs_mask, empty)) in enumerate(
@@ -235,7 +239,8 @@ class SegmentSearcher:
                 if (~ok[scores > 0.0]).any() and len(match) > 0:
                     # a non-match made device top-k → the survivors may not
                     # be the true top-k of the match set; exact CPU rescore
-                    scores, dd = self._cpu_score(match, tids, k, scorer)
+                    scores, dd = self._cpu_score(match, tids, k, scorer,
+                                                 idf_of, avgdl_override)
                 else:
                     scores, dd = scores[ok], dd[ok]
             keep = scores > 0.0
@@ -244,12 +249,17 @@ class SegmentSearcher:
         return out
 
     def _cpu_score(self, docs: np.ndarray, tids: list[int], k: int,
-                   scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
+                   scorer: str = "bm25", idf_of=None,
+                   avgdl_override=None) -> tuple[np.ndarray, np.ndarray]:
         scores = np.zeros(len(docs), dtype=np.float64)
-        idf = bm25_ops.idf_for(scorer, self.num_docs,
-                               self.index.doc_freq[np.asarray(tids)])
+        if idf_of is not None:
+            idf = idf_of(np.asarray(tids, dtype=np.int64))
+        else:
+            idf = bm25_ops.idf_for(scorer, self.num_docs,
+                                   self.index.doc_freq[np.asarray(tids)])
         dl = self.index.norms[docs].astype(np.float64)
-        avgdl = max(self.index.avgdl, 1e-9)
+        avgdl = max(avgdl_override if avgdl_override is not None
+                    else self.index.avgdl, 1e-9)
         for qi, tid in enumerate(tids):
             pd, pt = self.index.postings(tid)
             ix = np.searchsorted(pd, docs)
@@ -267,16 +277,104 @@ class SegmentSearcher:
                 docs[order].astype(np.int32))
 
 
+class MultiSearcher:
+    """Searches across immutable segments of one column (reference:
+    DirectoryReader over segment readers, SURVEY.md §2.7). Doc ids are
+    global row indices (segment base + local id); scoring uses GLOBAL
+    collection statistics so multi-segment scores equal a single-segment
+    build of the same data."""
+
+    def __init__(self, analyzer: Analyzer):
+        self.analyzer = analyzer
+        self.segments: list[tuple[SegmentSearcher, int]] = []  # (seg, base)
+
+    def add_segment(self, searcher: SegmentSearcher, base_row: int):
+        self.segments.append((searcher, base_row))
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.num_docs for s, _ in self.segments)
+
+    @property
+    def global_avgdl(self) -> float:
+        total_tokens = sum(s.index.total_tokens for s, _ in self.segments)
+        n = self.num_docs
+        return (total_tokens / n) if n else 0.0
+
+    def _global_df(self, term: str) -> int:
+        df = 0
+        for s, _ in self.segments:
+            tid = s.index.term_id(term)
+            if tid >= 0:
+                df += int(s.index.doc_freq[tid])
+        return df
+
+    def eval_filter(self, node: QNode) -> np.ndarray:
+        parts = []
+        for s, base in self.segments:
+            local = s.eval_filter(node)
+            if len(local):
+                parts.append(local.astype(np.int64) + base)
+        return np.concatenate(parts).astype(np.int64) if parts \
+            else np.empty(0, dtype=np.int64)
+
+    def topk(self, node: QNode, k: int,
+             scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
+        return self.topk_batch([node], k, scorer)[0]
+
+    def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
+                   ) -> list[tuple[np.ndarray, np.ndarray]]:
+        if len(self.segments) == 1:
+            seg, base = self.segments[0]
+            out = seg.topk_batch(nodes, k, scorer)
+            return [(s, d.astype(np.int64) + base) for s, d in out]
+        n_total = max(self.num_docs, 1)
+        # one pass: global df per query term STRING (terms have different
+        # ids per segment), shared by every segment's idf closure
+        term_strings: set[str] = set()
+        for node in nodes:
+            for seg, _ in self.segments:
+                ts = seg.index.terms_str
+                term_strings.update(str(ts[t])
+                                    for t in seg.scoring_terms(node))
+        global_df = {s: self._global_df(s) for s in term_strings}
+        merged: list[list[tuple]] = [[] for _ in nodes]
+        for seg, base in self.segments:
+            terms_str = seg.index.terms_str
+
+            def idf_of(tids, _ts=terms_str):
+                dfs = np.asarray([global_df[str(_ts[t])] for t in tids],
+                                 dtype=np.int64)
+                return bm25_ops.idf_for(scorer, n_total, dfs)
+
+            out = seg.topk_batch(nodes, k, scorer, idf_of=idf_of,
+                                 avgdl_override=self.global_avgdl)
+            for qi, (sc, dd) in enumerate(out):
+                merged[qi].extend(zip(sc.tolist(),
+                                      (dd.astype(np.int64) + base).tolist()))
+        results = []
+        for qi in range(len(nodes)):
+            cand = sorted(merged[qi], key=lambda t: -t[0])[:k]
+            results.append((
+                np.asarray([c[0] for c in cand], dtype=np.float32),
+                np.asarray([c[1] for c in cand], dtype=np.int64)))
+        return results
+
+
 @dataclass
 class SearchIndex:
-    """A built index over one or more text columns of a table provider."""
+    """A built index over one or more text columns of a table provider.
+    Each column holds a MultiSearcher over immutable segments; appends add
+    segments (incremental refresh), row mutations force full rebuilds."""
 
     columns: list[str]
     using: str
     options: dict
     analyzer_name: str
-    searchers: dict[str, SegmentSearcher]   # column → searcher
+    searchers: dict[str, MultiSearcher]   # column → multi-segment searcher
     data_version: int
+    mutation_epoch: int = 0
+    indexed_rows: int = 0
 
-    def searcher(self, column: str) -> Optional[SegmentSearcher]:
+    def searcher(self, column: str) -> Optional[MultiSearcher]:
         return self.searchers.get(column)

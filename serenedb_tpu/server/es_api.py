@@ -117,7 +117,8 @@ class EsApi:
             full = t.full_batch()
             col = Column.from_pylist([None] * full.num_rows, typ)
             t.replace(Batch(list(full.names) + [name],
-                            list(full.columns) + [col]))
+                            list(full.columns) + [col]),
+                      rows_preserved=True)
         if text_index and typ.is_string and not name.startswith("_"):
             # text fields get inverted indexes so match/bm25 use the TPU
             # scoring path (refreshed by maintenance / _refresh)

@@ -203,7 +203,8 @@ def test_tfidf_scorer_differs_from_bm25(sql_conn):
     from serenedb_tpu.search.index import find_index
     t = sql_conn.db.schemas["main"].tables["docs"]
     idx = find_index(t, "body")
-    searcher = idx.searcher("body")
+    ms = idx.searcher("body")
+    searcher = ms.segments[0][0]   # single-segment index
     fi = searcher.index
     tid = fi.term_id("apple")
     if tid >= 0 and tf:
