@@ -1,0 +1,145 @@
+"""Roles and ACLs.
+
+Reference analog: server/auth/{acl,role_closure}.cpp + the RBAC statements
+in server/pg/commands/rbac.cpp and AclMode bitmask checks at catalog
+snapshot reads (SURVEY.md §2.4). Model: flat roles with per-table privilege
+sets; the built-in superuser role `serene` (and any SUPERUSER role)
+bypasses checks; `public` grants apply to every role.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from . import errors
+
+PRIVILEGES = {"select", "insert", "update", "delete"}
+SUPERUSER = "serene"
+
+
+class Roles:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.roles: dict[str, dict] = {
+            SUPERUSER: {"password": None, "login": True, "superuser": True}}
+        # acls[table_key][role] = set of privileges
+        self.acls: dict[str, dict[str, set]] = {}
+
+    # -- role management ---------------------------------------------------
+
+    def create(self, name: str, password: Optional[str], login: bool,
+               superuser: bool, if_not_exists: bool):
+        key = name.lower()
+        with self._lock:
+            if key in self.roles:
+                if if_not_exists:
+                    return
+                raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                                      f'role "{name}" already exists')
+            self.roles[key] = {"password": password, "login": login,
+                               "superuser": superuser}
+
+    def drop(self, name: str, if_exists: bool):
+        key = name.lower()
+        with self._lock:
+            if key not in self.roles:
+                if if_exists:
+                    return
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'role "{name}" does not exist')
+            if key == SUPERUSER:
+                raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
+                                      "cannot drop the bootstrap superuser")
+            del self.roles[key]
+            for acl in self.acls.values():
+                acl.pop(key, None)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name.lower() in self.roles
+
+    def is_superuser(self, name: str) -> bool:
+        with self._lock:
+            r = self.roles.get(name.lower())
+            return bool(r and r.get("superuser"))
+
+    def can_login(self, name: str) -> bool:
+        with self._lock:
+            r = self.roles.get(name.lower())
+            return bool(r and r.get("login", True))
+
+    def has_password(self, name: str) -> bool:
+        with self._lock:
+            r = self.roles.get(name.lower())
+            return bool(r and r.get("password") is not None)
+
+    def check_password(self, name: str, password: str) -> bool:
+        with self._lock:
+            r = self.roles.get(name.lower())
+            if r is None or not r.get("login", True):
+                return False
+            stored = r.get("password")
+            return stored is None or stored == password
+
+    # -- grants ------------------------------------------------------------
+
+    def grant(self, table_key: str, role: str, privileges: list[str],
+              revoke: bool = False):
+        role = role.lower()
+        privs = set()
+        for p in privileges:
+            if p == "all":
+                privs |= PRIVILEGES
+            elif p in PRIVILEGES:
+                privs.add(p)
+            else:
+                raise errors.SqlError("0LP01",
+                                      f"unknown privilege {p!r}")
+        with self._lock:
+            if role != "public" and role not in self.roles:
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'role "{role}" does not exist')
+            acl = self.acls.setdefault(table_key, {})
+            cur = acl.setdefault(role, set())
+            if revoke:
+                cur -= privs
+            else:
+                cur |= privs
+
+    def allowed(self, role: str, table_key: str, privilege: str) -> bool:
+        role = role.lower()
+        with self._lock:
+            r = self.roles.get(role)
+            if r and r.get("superuser"):
+                return True
+            acl = self.acls.get(table_key, {})
+            if privilege in acl.get(role, ()):
+                return True
+            return privilege in acl.get("public", ())
+
+    def require(self, role: str, table_key: str, privilege: str):
+        if not self.allowed(role, table_key, privilege):
+            raise errors.SqlError(
+                errors.INSUFFICIENT_PRIVILEGE,
+                f"permission denied for table {table_key.split('.')[-1]}")
+
+    # -- persistence -------------------------------------------------------
+
+    def to_meta(self) -> dict:
+        with self._lock:
+            return {
+                "roles": {k: dict(v) for k, v in self.roles.items()},
+                "acls": {t: {r: sorted(p) for r, p in acl.items()}
+                         for t, acl in self.acls.items()},
+            }
+
+    def load_meta(self, meta: dict):
+        with self._lock:
+            if meta.get("roles"):
+                self.roles = {k: dict(v) for k, v in meta["roles"].items()}
+                self.roles.setdefault(
+                    SUPERUSER,
+                    {"password": None, "login": True, "superuser": True})
+            self.acls = {t: {r: set(p) for r, p in acl.items()}
+                         for t, acl in meta.get("acls", {}).items()}

@@ -96,6 +96,8 @@ class Database(TableResolver):
         # parquet providers are cached by path so repeated queries reuse the
         # provider's HBM column cache and compiled XLA programs
         self._parquet_cache: dict[str, ParquetTable] = {}
+        from .auth import Roles
+        self.roles = Roles()
         self.store = None
         self.maintenance = None
         if path is not None:
@@ -144,6 +146,7 @@ class Database(TableResolver):
             q = pickle.loads(base64.b64decode(vdef["ast_b64"]))
             self.schemas[schema].views[name.lower()] = ViewDef(name, q, "")
 
+        self.roles.load_meta(meta.get("auth", {}))
         for name, sdef in meta.get("sequences", {}).items():
             # resume at the persisted high-water mark: crash skips at most
             # one batch of values, never repeats
@@ -281,7 +284,16 @@ class Database(TableResolver):
         # database.schema.table — single-database process, ignore the first
         return parts[-2], parts[-1]
 
-    def resolve_table(self, parts: list[str]) -> TableProvider:
+    def _acl_check(self, schema: str, name: str, privilege: str = "select"):
+        """ACL applies to user tables only; system catalogs stay open
+        (reference surfaces introspection to all roles)."""
+        conn = CURRENT_CONNECTION.get()
+        if conn is not None:
+            self.roles.require(conn.current_role,
+                               f"{schema}.{name.lower()}", privilege)
+
+    def resolve_table(self, parts: list[str],
+                      privilege: str = "select") -> TableProvider:
         schema, name = self._split(parts)
         if schema in ("pg_catalog", "information_schema", "sdb_catalog"):
             from .pgcatalog import system_table
@@ -297,8 +309,10 @@ class Database(TableResolver):
                 raise errors.SqlError(errors.UNDEFINED_TABLE,
                                       f'schema "{schema}" does not exist')
             t = s.tables.get(name.lower())
-            if t is not None:
-                return t
+        if t is not None:
+            self._acl_check(schema, name, privilege)
+            return t
+        with self.lock:
             v = s.views.get(name.lower())
             if v is not None:
                 raise _ViewRef(v)  # unwound by the planner wrapper below
@@ -435,11 +449,15 @@ class _ResolverShim(TableResolver):
 
 
 class Connection:
-    def __init__(self, db: Database):
+    def __init__(self, db: Database, role: str = None):
+        from .auth import SUPERUSER
         self.db = db
         self.settings = SessionSettings()
         self.in_txn = False
         self.txn_failed = False
+        #: authenticated identity — SET ROLE can never escalate beyond it
+        self.session_role = (role or SUPERUSER).lower()
+        self.current_role = self.session_role
 
     # -- public API --------------------------------------------------------
 
@@ -476,6 +494,15 @@ class Connection:
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, st: ast.Statement, params: list) -> QueryResult:
+        if isinstance(st, (ast.Drop, ast.DropRole, ast.AlterTable,
+                           ast.CreateRole, ast.GrantRevoke, ast.CreateIndex,
+                           ast.VacuumStmt)):
+            # destructive/administrative DDL is superuser-only in the
+            # ownerless v1 model (PG would check ownership)
+            if not self.db.roles.is_superuser(self.current_role):
+                raise errors.SqlError(
+                    errors.INSUFFICIENT_PRIVILEGE,
+                    f"must be superuser to run {type(st).__name__}")
         if isinstance(st, (ast.Select, ast.SetOp)):
             batch = self._run_select(st, params)
             return QueryResult(batch, f"SELECT {batch.num_rows}")
@@ -502,6 +529,44 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE VIEW")
         if isinstance(st, ast.CreateIndex):
             return self._create_index(st)
+        if isinstance(st, ast.CreateRole):
+            self.db.roles.create(st.name, st.password, st.login,
+                                 st.superuser, st.if_not_exists)
+            self._persist_auth()
+            return QueryResult(Batch([], []), "CREATE ROLE")
+        if isinstance(st, ast.DropRole):
+            self.db.roles.drop(st.name, st.if_exists)
+            self._persist_auth()
+            return QueryResult(Batch([], []), "DROP ROLE")
+        if isinstance(st, ast.GrantRevoke):
+            schema, name = self.db._split(st.table)
+            try:
+                self.db.resolve_table(st.table)  # must exist
+            except _ViewRef:
+                raise errors.SqlError(
+                    "42809", f'"{name}" is not a table')
+            self.db.roles.grant(f"{schema}.{name.lower()}", st.role,
+                                st.privileges, revoke=not st.grant)
+            self._persist_auth()
+            return QueryResult(Batch([], []),
+                               "GRANT" if st.grant else "REVOKE")
+        if isinstance(st, ast.SetRole):
+            if st.name is None:
+                self.current_role = self.session_role  # RESET → auth role
+            else:
+                if not self.db.roles.exists(st.name):
+                    raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                          f'role "{st.name}" does not exist')
+                target = st.name.lower()
+                # only a superuser session may assume another role (no role
+                # membership model yet) — SET ROLE must never escalate
+                if target != self.session_role and \
+                        not self.db.roles.is_superuser(self.session_role):
+                    raise errors.SqlError(
+                        errors.INSUFFICIENT_PRIVILEGE,
+                        f'permission denied to set role "{st.name}"')
+                self.current_role = target
+            return QueryResult(Batch([], []), "SET")
         if isinstance(st, ast.AlterTable):
             return self._alter_table(st)
         if isinstance(st, ast.CreateSequence):
@@ -623,6 +688,9 @@ class Connection:
         }
         created = self.db.create_table(schema, name, provider,
                                        st.if_not_exists)
+        if created and not self.db.roles.is_superuser(self.current_role):
+            # creator keeps full use of their own table
+            self.db.roles.grant(key, self.current_role, ["all"])
         if created and self.db.store is not None:
             from .storage.store import table_def
             start_tick = self.db.store.ticks.current()
@@ -750,8 +818,9 @@ class Connection:
                 self.db.store.update_meta(mutate)
         return QueryResult(Batch([], []), "ALTER TABLE")
 
-    def _table_for_dml(self, parts: list[str]) -> MemTable:
-        provider = self.db.resolve_table(parts)
+    def _table_for_dml(self, parts: list[str],
+                       privilege: str = "insert") -> MemTable:
+        provider = self.db.resolve_table(parts, privilege)
         if not isinstance(provider, MemTable):
             raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
                                   "cannot modify this table")
@@ -785,7 +854,7 @@ class Connection:
         return QueryResult(Batch([], []), f"INSERT 0 {incoming.num_rows}")
 
     def _delete(self, st: ast.Delete, params: list) -> QueryResult:
-        table = self._table_for_dml(st.table)
+        table = self._table_for_dml(st.table, "delete")
         with self.db.lock:
             full = table.full_batch()
             if st.where is None:
@@ -809,7 +878,7 @@ class Connection:
         WAL replay transformation exactly, so recovered row order equals
         live row order — the reference does the same remove+insert in its
         search DML, duckdb_physical_search_update.*)."""
-        table = self._table_for_dml(st.table)
+        table = self._table_for_dml(st.table, "update")
         with self.db.lock:
             full = table.full_batch()
             scope = Scope.of(list(full.names), [c.type for c in full.columns],
@@ -845,13 +914,19 @@ class Connection:
         return QueryResult(Batch([], []), f"UPDATE {n}")
 
     def _truncate(self, st: ast.Truncate) -> QueryResult:
-        table = self._table_for_dml(st.table)
+        table = self._table_for_dml(st.table, "delete")
         with self.db.lock:
             self._wal_commit(table, [("truncate", None, None)])
             table.replace(table.full_batch().slice(0, 0))
         return QueryResult(Batch([], []), "TRUNCATE TABLE")
 
     # -- session statements ------------------------------------------------
+
+    def _persist_auth(self):
+        if self.db.store is not None:
+            auth = self.db.roles.to_meta()
+            self.db.store.update_meta(
+                lambda m: m.__setitem__("auth", auth))
 
     def _set(self, st: ast.SetStmt) -> QueryResult:
         if st.value == "DEFAULT":

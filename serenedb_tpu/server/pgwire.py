@@ -222,17 +222,36 @@ class PgSession:
             if k:
                 params[k.decode()] = v.decode()
         user = params.get("user", "serene")
-        if self.server.password is not None:
+        roles = self.server.db.roles
+        role_known = roles.exists(user)
+        if role_known and not roles.can_login(user):
+            self.w.error(errors.SqlError(
+                "28000", f'role "{user}" is not permitted to log in'))
+            await self.w.flush()
+            return False
+        needs_password = self.server.password is not None or (
+            role_known and roles.has_password(user))
+        if needs_password:
             self.w.auth_cleartext()
             await self.w.flush()
             kind, payload = await self._read_msg()
-            if kind != b"p" or payload[:-1].decode() != self.server.password:
+            supplied = payload[:-1].decode() if kind == b"p" else ""
+            if self.server.password is not None:
+                # a server-wide password gates EVERY login, including
+                # passwordless roles — no bypass via user=serene
+                ok = supplied == self.server.password
+            else:
+                ok = role_known and roles.check_password(user, supplied)
+            if kind != b"p" or not ok:
                 self.w.error(errors.SqlError(
                     "28P01",
                     f'password authentication failed for user "{user}"'))
                 await self.w.flush()
                 return False
-        self.conn = self.server.db.connect()
+        # known roles get their own privileges; unknown users fall back to
+        # the bootstrap superuser (trust mode, matching default pg_hba)
+        self.conn = Connection(self.server.db,
+                               user if role_known else None)
         for k, v in params.items():
             if k in ("user", "database", "options", "replication"):
                 continue

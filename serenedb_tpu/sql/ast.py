@@ -332,3 +332,31 @@ class VacuumStmt(Statement):
 @dataclass
 class Truncate(Statement):
     table: list[str]
+
+
+@dataclass
+class CreateRole(Statement):
+    name: str
+    password: Optional[str] = None
+    login: bool = True
+    superuser: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRole(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRevoke(Statement):
+    grant: bool                       # True=GRANT, False=REVOKE
+    privileges: list[str]             # select/insert/update/delete/all
+    table: list[str]
+    role: str
+
+
+@dataclass
+class SetRole(Statement):
+    name: Optional[str]               # None = RESET ROLE

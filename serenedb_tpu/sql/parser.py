@@ -110,6 +110,9 @@ class Parser:
             return self.parse_set()
         if self.at_kw("RESET"):
             self.next()
+            if self.at_kw("ROLE"):
+                self.next()
+                return ast.SetRole(None)
             name = self.ident()
             return ast.SetStmt(name.lower(), "DEFAULT")
         if self.at_kw("SHOW"):
@@ -136,6 +139,17 @@ class Parser:
             return ast.Explain(self.parse_statement(), analyze)
         if self.at_kw("ALTER"):
             return self.parse_alter()
+        if self.at_kw("GRANT", "REVOKE"):
+            grant = self.ident().upper() == "GRANT"
+            privs = [self.ident().lower()]
+            while self.accept_op(","):
+                privs.append(self.ident().lower())
+            self.expect_kw("ON")
+            self.accept_kw("TABLE")
+            table = self.qualified_name()
+            self.expect_kw("TO" if grant else "FROM")
+            role = self.ident()
+            return ast.GrantRevoke(grant, privs, table, role)
         if self.at_kw("COPY"):
             return self.parse_copy()
         if self.at_kw("VACUUM"):
@@ -667,6 +681,27 @@ class Parser:
             self.expect_op(")")
             opts = self._with_options()
             return ast.CreateIndex(idx_name, table, cols, using, ine, opts)
+        if self.accept_kw("ROLE") or self.accept_kw("USER"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            password = None
+            login = True
+            superuser = False
+            while True:
+                if self.accept_kw("PASSWORD"):
+                    t = self.next()
+                    password = t.value
+                elif self.accept_kw("LOGIN"):
+                    login = True
+                elif self.accept_kw("NOLOGIN"):
+                    login = False
+                elif self.accept_kw("SUPERUSER"):
+                    superuser = True
+                elif self.accept_kw("WITH"):
+                    continue
+                else:
+                    break
+            return ast.CreateRole(name, password, login, superuser, ine)
         if self.accept_kw("SEQUENCE"):
             ine = self._if_not_exists()
             name = self.qualified_name()
@@ -776,6 +811,12 @@ class Parser:
             kind = "view"
         elif self.accept_kw("SEQUENCE"):
             kind = "sequence"
+        elif self.accept_kw("ROLE") or self.accept_kw("USER"):
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            return ast.DropRole(self.ident(), if_exists)
         else:
             raise errors.unsupported("DROP of that object kind")
         if_exists = False
@@ -831,6 +872,11 @@ class Parser:
     def parse_set(self) -> ast.Statement:
         self.expect_kw("SET")
         self.accept_kw("SESSION") or self.accept_kw("LOCAL")
+        if self.at_kw("ROLE"):
+            self.next()
+            if self.accept_kw("NONE"):
+                return ast.SetRole(None)
+            return ast.SetRole(self.ident())
         name = self.ident().lower()
         if not (self.accept_op("=") or self.accept_kw("TO")):
             raise errors.syntax("expected = or TO in SET")
