@@ -823,3 +823,178 @@ def _setval(ts):
             out[i] = conn.db.sequence_setval(nm, int(v))
         return _result(dt.BIGINT, out, cols)
     return FunctionResolution(dt.BIGINT, impl)
+
+
+# -- more datetime ---------------------------------------------------------
+
+_TRUNC_UNITS = ("year", "quarter", "month", "week", "day", "hour", "minute",
+                "second")
+
+
+@register("date_trunc")
+def _date_trunc(ts):
+    if len(ts) == 2 and ts[1].id not in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE,
+                                         dt.TypeId.NULL):
+        raise errors.SqlError(errors.DATATYPE_MISMATCH,
+                              f"date_trunc does not accept {ts[1]}")
+
+    def impl(cols, n):
+        valid = propagate_nulls(cols)
+        if valid is not None and not valid.any():
+            return Column.from_pylist([None] * n, dt.TIMESTAMP)
+        unit_idx = int(np.argmax(valid)) if valid is not None else 0
+        unit = string_values(cols[0])[unit_idx].lower() if n else "day"
+        if unit not in _TRUNC_UNITS:
+            raise errors.unsupported(f"date_trunc unit {unit!r}")
+        src = cols[1]
+        if src.type.id is dt.TypeId.DATE:
+            us = src.data.astype("datetime64[D]").astype("datetime64[us]")
+        else:
+            us = src.data.astype("datetime64[us]")
+        if unit == "year":
+            out = us.astype("datetime64[Y]").astype("datetime64[us]")
+        elif unit == "quarter":
+            months = us.astype("datetime64[M]").astype(np.int64)
+            out = ((months // 3) * 3).astype("datetime64[M]") \
+                .astype("datetime64[us]")
+        elif unit == "month":
+            out = us.astype("datetime64[M]").astype("datetime64[us]")
+        elif unit == "week":
+            days = us.astype("datetime64[D]").astype(np.int64)
+            # 1970-01-01 was a Thursday; ISO weeks start Monday (+3 offset)
+            out = (((days + 3) // 7) * 7 - 3).astype("datetime64[D]") \
+                .astype("datetime64[us]")
+        elif unit == "day":
+            out = us.astype("datetime64[D]").astype("datetime64[us]")
+        elif unit == "hour":
+            out = us.astype("datetime64[h]").astype("datetime64[us]")
+        elif unit == "minute":
+            out = us.astype("datetime64[m]").astype("datetime64[us]")
+        else:
+            out = us.astype("datetime64[s]").astype("datetime64[us]")
+        return _result(dt.TIMESTAMP, out.astype(np.int64), cols[1:])
+    return FunctionResolution(dt.TIMESTAMP, impl)
+
+
+def _now_resolver(ts):
+    def impl(cols, n):
+        import time as _time
+        v = int(_time.time() * 1e6)
+        return Column(dt.TIMESTAMP, np.full(max(n, 1), v, dtype=np.int64))
+    return FunctionResolution(dt.TIMESTAMP, impl)
+
+
+_REGISTRY["now"] = _now_resolver
+_REGISTRY["current_timestamp"] = _now_resolver
+_REGISTRY["transaction_timestamp"] = _now_resolver
+
+
+@register("current_date")
+def _current_date(ts):
+    def impl(cols, n):
+        import time as _time
+        v = int(_time.time() // 86400)
+        return Column(dt.DATE, np.full(max(n, 1), v, dtype=np.int32))
+    return FunctionResolution(dt.DATE, impl)
+
+
+@register("age")
+def _age(ts):
+    def impl(cols, n):
+        a = cols[0].data.astype("datetime64[us]")
+        b = cols[1].data.astype("datetime64[us]")
+        secs = (a.astype(np.int64) - b.astype(np.int64)) / 1e6
+        return _result(dt.DOUBLE, secs, cols)  # seconds (interval-lite)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("make_date")
+def _make_date(ts):
+    def impl(cols, n):
+        y = cols[0].data.astype(np.int64)
+        m = cols[1].data.astype(np.int64)
+        d = cols[2].data.astype(np.int64)
+        valid = propagate_nulls(cols)
+        out = np.zeros(n, dtype=np.int32)
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue  # NULL row: sentinel components never parsed
+            try:
+                out[i] = np.datetime64(
+                    f"{y[i]:04d}-{m[i]:02d}-{d[i]:02d}", "D").astype(np.int32)
+            except ValueError:
+                raise errors.SqlError(
+                    "22008", f"date field value out of range: "
+                             f"{y[i]}-{m[i]}-{d[i]}")
+        return _result(dt.DATE, out, cols)
+    return FunctionResolution(dt.DATE, impl)
+
+
+# -- json (documents stored as TEXT; reference: functions/json.cpp) --------
+
+def _json_extract_impl(ts, as_text: bool):
+    def impl(cols, n):
+        import json as _json
+        docs = string_values(cols[0])
+        paths = string_values(cols[1])
+        valid = propagate_nulls(cols)
+        out = []
+        bad = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                out.append("")
+                continue
+            try:
+                obj = _json.loads(docs[i])
+            except _json.JSONDecodeError:
+                out.append("")
+                bad[i] = True
+                continue
+            path = paths[i].lstrip("$").lstrip(".")
+            cur = obj
+            ok = True
+            for part in [p for p in re.split(r"[.\[\]]+", path) if p]:
+                if isinstance(cur, dict) and part in cur:
+                    cur = cur[part]
+                elif isinstance(cur, list) and part.isdigit() and \
+                        int(part) < len(cur):
+                    cur = cur[int(part)]
+                else:
+                    ok = False
+                    break
+            if not ok or cur is None:
+                out.append("")
+                bad[i] = True
+            elif isinstance(cur, str) and as_text:
+                out.append(cur)         # ..._string: bare text (PG ->>)
+            else:
+                out.append(_json.dumps(cur))  # json_extract: valid JSON
+        col = make_string_column(np.asarray(out, dtype=object).astype(str),
+                                 valid)
+        if bad.any():
+            v = col.valid_mask() & ~bad
+            col = Column(dt.VARCHAR, col.data,
+                         None if v.all() else v, col.dictionary)
+        return col
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["json_extract"] = lambda ts: _json_extract_impl(ts, as_text=False)
+_REGISTRY["json_extract_string"] = \
+    lambda ts: _json_extract_impl(ts, as_text=True)
+
+
+@register("json_valid")
+def _json_valid(ts):
+    def impl(cols, n):
+        import json as _json
+        docs = string_values(cols[0])
+        out = np.zeros(n, dtype=bool)
+        for i in range(n):
+            try:
+                _json.loads(docs[i])
+                out[i] = True
+            except _json.JSONDecodeError:
+                pass
+        return _result(dt.BOOL, out, cols)
+    return FunctionResolution(dt.BOOL, impl)

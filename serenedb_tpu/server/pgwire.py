@@ -51,9 +51,8 @@ def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
     if tid is dt.TypeId.BOOL:
         return b"t" if value else b"f"
     if tid is dt.TypeId.TIMESTAMP:
-        import numpy as np
-        s = str(np.datetime64(int(value), "us")).replace("T", " ")
-        return s.encode()
+        from ..sql.binder import format_timestamp
+        return format_timestamp(int(value)).encode()
     if tid is dt.TypeId.DATE:
         import numpy as np
         return str(np.datetime64(int(value), "D")).encode()

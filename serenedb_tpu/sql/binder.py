@@ -407,6 +407,14 @@ def _expr_key(e: Optional[BoundExpr]) -> str:
     return "/".join(parts)
 
 
+def format_timestamp(us: int) -> str:
+    """PG-style timestamp text: microseconds only when non-zero."""
+    s = str(np.datetime64(int(us), "us")).replace("T", " ")
+    if s.endswith(".000000"):
+        return s[:-7]
+    return s.rstrip("0") if "." in s else s
+
+
 def cast_column(col: Column, target: dt.SqlType) -> Column:
     """PG-style CAST between supported types."""
     src = col.type
@@ -414,6 +422,16 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         return col
     validity = col.validity
     if target.is_string:
+        if src.id is dt.TypeId.TIMESTAMP:
+            out = [format_timestamp(v) for v in col.data]
+            from .expr import make_string_column
+            return make_string_column(
+                np.asarray(out, dtype=object).astype(str), validity)
+        if src.id is dt.TypeId.DATE:
+            out = [str(np.datetime64(int(v), "D")) for v in col.data]
+            from .expr import make_string_column
+            return make_string_column(
+                np.asarray(out, dtype=object).astype(str), validity)
         vals = col.to_pylist()
         out = ["" if v is None else _cast_to_text(v, src) for v in vals]
         from .expr import make_string_column

@@ -592,7 +592,9 @@ class Parser:
         if upper == "EXTRACT":
             self.next()
             self.expect_op("(")
-            fld = self.ident()
+            t_fld = self.peek()
+            fld = self.next().value if t_fld.kind in (T.IDENT, T.STRING) \
+                else self.ident()
             self.expect_kw("FROM")
             e = self.parse_expr()
             self.expect_op(")")
