@@ -840,12 +840,26 @@ def _date_trunc(ts):
 
     def impl(cols, n):
         valid = propagate_nulls(cols)
-        if valid is not None and not valid.any():
-            return Column.from_pylist([None] * n, dt.TIMESTAMP)
-        unit_idx = int(np.argmax(valid)) if valid is not None else 0
-        unit = string_values(cols[0])[unit_idx].lower() if n else "day"
-        if unit not in _TRUNC_UNITS:
-            raise errors.unsupported(f"date_trunc unit {unit!r}")
+        units = np.char.lower(string_values(cols[0])) if n else \
+            np.empty(0, dtype=str)
+        distinct_units = {units[i] for i in range(n)
+                          if valid is None or valid[i]}
+        bad = distinct_units - set(_TRUNC_UNITS)
+        if bad:
+            raise errors.unsupported(f"date_trunc unit {bad.pop()!r}")
+        if len(distinct_units) > 1:
+            # per-row units: compute per distinct unit and stitch
+            out = np.zeros(n, dtype=np.int64)
+            for u in distinct_units:
+                mask = (units == u) & (valid if valid is not None
+                                       else np.ones(n, dtype=bool))
+                sub = impl([Column.const(u, int(mask.sum()), dt.VARCHAR),
+                            cols[1].filter(mask)], int(mask.sum()))
+                out[np.flatnonzero(mask)] = sub.data
+            return Column(dt.TIMESTAMP, out,
+                          valid if valid is not None and not valid.all()
+                          else valid)
+        unit = distinct_units.pop() if distinct_units else "day"
         src = cols[1]
         if src.type.id is dt.TypeId.DATE:
             us = src.data.astype("datetime64[D]").astype("datetime64[us]")
@@ -872,7 +886,7 @@ def _date_trunc(ts):
             out = us.astype("datetime64[m]").astype("datetime64[us]")
         else:
             out = us.astype("datetime64[s]").astype("datetime64[us]")
-        return _result(dt.TIMESTAMP, out.astype(np.int64), cols[1:])
+        return _result(dt.TIMESTAMP, out.astype(np.int64), cols)
     return FunctionResolution(dt.TIMESTAMP, impl)
 
 

@@ -90,6 +90,19 @@ class FuncCall(Expr):
 
 
 @dataclass
+class WindowFunc(Expr):
+    func: "FuncCall"
+    partition_by: list = None
+    order_by: list = None     # list[OrderItem]
+
+    def __post_init__(self):
+        if self.partition_by is None:
+            self.partition_by = []
+        if self.order_by is None:
+            self.order_by = []
+
+
+@dataclass
 class Cast(Expr):
     operand: Expr
     type_name: str

@@ -628,7 +628,27 @@ class Parser:
                 while self.accept_op(","):
                     args.append(self.parse_expr())
             self.expect_op(")")
-            return ast.FuncCall(name, args, distinct, star)
+            call = ast.FuncCall(name, args, distinct, star)
+            if self.at_kw("OVER"):
+                self.next()
+                self.expect_op("(")
+                partition = []
+                order = []
+                if self.accept_kw("PARTITION"):
+                    self.expect_kw("BY")
+                    partition.append(self.parse_expr())
+                    while self.accept_op(","):
+                        partition.append(self.parse_expr())
+                if self.accept_kw("ORDER"):
+                    self.expect_kw("BY")
+                    order.append(self.parse_order_item())
+                    while self.accept_op(","):
+                        order.append(self.parse_order_item())
+                if self.at_kw("ROWS", "RANGE", "GROUPS"):
+                    raise errors.unsupported("window frames")
+                self.expect_op(")")
+                return ast.WindowFunc(call, partition, order)
+            return call
         return ast.ColumnRef(parts)
 
     def parse_case(self) -> ast.Expr:

@@ -302,18 +302,44 @@ class Planner:
         out_names = _dedup_names(
             [it.alias or _default_name(it.expr) for it in items])
 
+        # window functions: pull them out of the item trees first; they
+        # evaluate over the (post-aggregate) input via a WindowNode
+        window_asts: list[ast.WindowFunc] = []
+        items = [ast.SelectItem(_extract_windows(it.expr, window_asts),
+                                it.alias) for it in items]
+
         has_aggs = bool(sel.group_by) or sel.having is not None or \
-            any(_contains_agg(it.expr) for it in items)
+            any(_contains_agg(it.expr) for it in items) or \
+            any(_contains_agg_list(w.partition_by) or
+                _contains_agg_list([oi.expr for oi in w.order_by])
+                for w in window_asts)
 
         if has_aggs:
-            plan, exprs, bind_order = self._plan_aggregate(sel, items, plan,
-                                                           scope)
+            # window-referencing items can't bind before the WindowNode
+            # exists: swap a placeholder through the aggregate binder and
+            # rebind the real expression afterwards (mixing aggregates and
+            # window refs in ONE expression is not supported yet)
+            for it in items:
+                if _mentions_win(it.expr) and _contains_agg(it.expr):
+                    raise errors.unsupported(
+                        "mixing aggregate and window functions in one "
+                        "expression")
+            agg_items = [ast.SelectItem(ast.Literal(0), it.alias)
+                         if _mentions_win(it.expr) else it for it in items]
+            plan, exprs, bind_order = self._plan_aggregate(
+                sel, agg_items, plan, scope)
         else:
             binder = self._binder(scope)
-            exprs = [binder.bind(it.expr) for it in items]
+            exprs = [BoundLiteral(0, dt.INT) if _mentions_win(it.expr)
+                     else binder.bind(it.expr) for it in items]
 
             def bind_order(e: ast.Expr) -> BoundExpr:
                 return self._binder(scope).bind(e)
+
+        if window_asts:
+            plan, scope, exprs = self._plan_windows(
+                sel, window_asts, plan, scope, items, exprs, bind_order,
+                has_aggs)
 
         # ORDER BY: positions, select aliases, then arbitrary expressions
         sort_exprs: list[BoundExpr] = []
@@ -377,6 +403,71 @@ class Planner:
             plan = LimitNode(plan, limit, offset)
         return plan
 
+    def _plan_windows(self, sel, window_asts, plan, scope, items, exprs,
+                      bind_order, has_aggs):
+        """Insert a WindowNode computing #winN columns over the current
+        plan; rebind select items in the extended scope."""
+        from ..exec.window import (WINDOW_FUNCS, WindowNode, WindowSpec,
+                                   window_result_type)
+        specs = []
+        for w in window_asts:
+            fname = w.func.name
+            if fname not in WINDOW_FUNCS:
+                raise errors.SqlError(
+                    errors.UNDEFINED_FUNCTION,
+                    f"window function {fname}() does not exist")
+            arg = None
+            extra = None
+            if fname == "ntile":
+                if not w.func.args or not (
+                        isinstance(w.func.args[0], ast.Literal) and
+                        isinstance(w.func.args[0].value, int)):
+                    raise errors.syntax(
+                        "ntile requires a constant integer argument")
+                extra = w.func.args[0].value
+            elif fname in ("lag", "lead"):
+                if not w.func.args:
+                    raise errors.syntax(f"{fname} requires an argument")
+                arg = bind_order(w.func.args[0])
+                if len(w.func.args) > 1:
+                    off = w.func.args[1]
+                    if not (isinstance(off, ast.Literal) and
+                            isinstance(off.value, int)):
+                        raise errors.unsupported(
+                            f"{fname} offset must be a constant")
+                    extra = off.value
+            elif fname in ("count",) and (w.func.star or not w.func.args):
+                arg = None
+            elif w.func.args:
+                arg = bind_order(w.func.args[0])
+            elif fname in ("sum", "min", "max", "avg", "first_value",
+                           "last_value"):
+                raise errors.syntax(f"{fname} requires an argument")
+            partition = [bind_order(p) for p in w.partition_by]
+            order = [(bind_order(oi.expr), oi.desc) for oi in w.order_by]
+            specs.append(WindowSpec(
+                fname, arg, extra, partition, order,
+                window_result_type(fname, arg.type if arg else None)))
+        node = WindowNode(plan, specs)
+        # preserve the child scope's table qualifiers; only the appended
+        # #winN columns are unqualified
+        base_cols = [ScopeColumn(c.table, c.name, c.type, c.index)
+                     for c in scope.columns]
+        win_cols = [ScopeColumn(None, f"#win{i}", s.type,
+                                len(plan.names) + i)
+                    for i, s in enumerate(specs)]
+        new_scope = Scope(base_cols + win_cols)
+        # rebind items: #winN refs now resolve; previous bound exprs for
+        # non-window items are re-derived in the extended scope
+        binder = self._binder(new_scope)
+        new_exprs = []
+        for it, old in zip(items, exprs):
+            if _mentions_win(it.expr):
+                new_exprs.append(binder.bind(it.expr))
+            else:
+                new_exprs.append(old)
+        return node, new_scope, new_exprs
+
     def _push_filter(self, plan: PlanNode, pred: BoundExpr) -> PlanNode:
         """Claim the predicate into the scan when the input is a bare scan
         (the pushdown the reference does in its pre-optimizer pass)."""
@@ -426,6 +517,62 @@ class Planner:
             return _resolve_post(post.bind(e), ng, out_types)
 
         return agg_node, exprs, bind_order
+
+
+def _extract_windows(e: ast.Expr, out: list) -> ast.Expr:
+    """Replace WindowFunc nodes with #winN column refs, collecting specs
+    (deduplicated by syntactic equality)."""
+    if isinstance(e, ast.WindowFunc):
+        for k, w in enumerate(out):
+            if _ast_eq(e, w):
+                return ast.ColumnRef([f"#win{k}"])
+        out.append(e)
+        return ast.ColumnRef([f"#win{len(out) - 1}"])
+    for attr in ("left", "right", "operand", "low", "high", "pattern"):
+        v = getattr(e, attr, None)
+        if isinstance(v, ast.Expr):
+            setattr(e, attr, _extract_windows(v, out))
+    if isinstance(e, ast.Logical):
+        e.args = [_extract_windows(a, out) for a in e.args]
+    if isinstance(e, ast.FuncCall):
+        e.args = [_extract_windows(a, out) for a in e.args]
+    if isinstance(e, ast.InList):
+        e.items = [_extract_windows(i, out) for i in e.items]
+    if isinstance(e, ast.Case):
+        e.branches = [(_extract_windows(c, out), _extract_windows(v, out))
+                      for c, v in e.branches]
+        if e.else_ is not None:
+            e.else_ = _extract_windows(e.else_, out)
+    if isinstance(e, ast.Cast):
+        e.operand = _extract_windows(e.operand, out)
+    return e
+
+
+def _mentions_win(e: ast.Expr) -> bool:
+    if isinstance(e, ast.ColumnRef) and e.parts[-1].startswith("#win"):
+        return True
+    for attr in ("left", "right", "operand", "low", "high", "pattern"):
+        v = getattr(e, attr, None)
+        if isinstance(v, ast.Expr) and _mentions_win(v):
+            return True
+    for attr in ("args", "items"):
+        for v in getattr(e, attr, []) or []:
+            if isinstance(v, ast.Expr) and _mentions_win(v):
+                return True
+    if isinstance(e, ast.Case):
+        parts = [x for br in e.branches for x in br]
+        if e.operand:
+            parts.append(e.operand)
+        if e.else_:
+            parts.append(e.else_)
+        return any(_mentions_win(p) for p in parts)
+    if isinstance(e, ast.Cast):
+        return _mentions_win(e.operand)
+    return False
+
+
+def _contains_agg_list(exprs) -> bool:
+    return any(_contains_agg(x) for x in exprs or [])
 
 
 def _ast_eq(a: ast.Expr, b: ast.Expr) -> bool:

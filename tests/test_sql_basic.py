@@ -272,3 +272,25 @@ def test_lexer_longest_match_operators():
     assert c.execute("SELECT '[1,0]' <=> '[0,1]'").scalar() == pytest.approx(1.0)
     assert c.execute("SELECT '[1,2]' <#> '[3,4]'").scalar() == -11.0
     assert c.execute("SELECT 2 <= 3").scalar() is True
+
+
+def test_window_int_sum_exact_past_2_53():
+    c = Database().connect()
+    c.execute("CREATE TABLE big (x BIGINT)")
+    c.execute("INSERT INTO big VALUES (9007199254740993), (1)")
+    win = c.execute("SELECT sum(x) OVER () FROM big LIMIT 1").scalar()
+    agg = c.execute("SELECT sum(x) FROM big").scalar()
+    assert win == agg == 9007199254740994
+
+
+def test_date_trunc_per_row_units():
+    c = Database().connect()
+    c.execute("CREATE TABLE dtr (u TEXT, t TIMESTAMP)")
+    c.execute("INSERT INTO dtr VALUES "
+              "('month', TIMESTAMP '2024-03-17 14:25:11'), "
+              "('day', TIMESTAMP '2024-03-17 14:25:11'), "
+              "(NULL, TIMESTAMP '2024-03-17 14:25:11')")
+    rows = c.execute("SELECT date_trunc(u, t)::VARCHAR FROM dtr").rows()
+    assert rows[0][0] == "2024-03-01 00:00:00"
+    assert rows[1][0] == "2024-03-17 00:00:00"
+    assert rows[2][0] is None
