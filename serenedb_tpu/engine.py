@@ -400,6 +400,18 @@ class Database(TableResolver):
             if s is None:
                 return
             key = name.lower()
+            if kind == "index":
+                removed = False
+                for t in s.tables.values():
+                    idxs = getattr(t, "indexes", {})
+                    for iname in list(idxs):
+                        if iname.lower() == key:
+                            del idxs[iname]
+                            removed = True
+                if removed or if_exists:
+                    return
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'index "{name}" does not exist')
             store = s.views if kind == "view" else s.tables
             if key not in store:
                 if if_exists:
@@ -610,7 +622,10 @@ class Connection:
                             k: v for k, v in meta["indexes"].items()
                             if not v["table"].startswith(prefix)}
                     elif st.kind == "index":
-                        meta["indexes"].pop(st.name[-1], None)
+                        target = st.name[-1].lower()
+                        for k in [k for k in meta["indexes"]
+                                  if k.lower() == target]:
+                            del meta["indexes"][k]
 
                 dropped_ids: list[int] = []
                 store.update_meta(mutate)

@@ -140,3 +140,37 @@ class IvfScanNode(PlanNode):
         out = full.take(r.astype(np.int64))
         yield Batch(list(self.names),
                     out.columns + [Column(dt.DOUBLE, d.astype(np.float64))])
+
+
+class BtreeScanNode(PlanNode):
+    """Point/range lookup through a btree index (reference: PK lookup
+    fast path, scripts/perf/bench_pk_lookup.sh)."""
+
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, index_column: str, eq_value, residual):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.index_column = index_column
+        self.eq_value = eq_value
+        self.residual = residual
+        self.names = list(columns)
+        self.types = [provider.type_of(c) for c in columns]
+
+    def children(self):
+        return []
+
+    def label(self):
+        return f"BtreeScan {self.provider.name}.{self.index_column} eq"
+
+    def batches(self, ctx):
+        from ..search.index import find_btree_index
+        idx = find_btree_index(self.provider, self.index_column)
+        if idx is None:
+            raise RuntimeError("btree index disappeared under the plan")
+        rows = idx.lookup_eq(self.eq_value)
+        out = self.provider.full_batch(self.columns).take(rows)
+        if self.residual is not None:
+            c = self.residual.eval(out)
+            out = out.filter(c.data.astype(bool) & c.valid_mask())
+        yield out

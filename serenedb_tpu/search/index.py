@@ -15,9 +15,54 @@ from .searcher import MultiSearcher, SearchIndex, SegmentSearcher
 from .segment import build_field_index
 
 
+class BtreeIndex:
+    """Sorted-array point/range lookup index over one column (reference:
+    `USING btree`/`secondary` DuckDB bound indexes, server_engine.cpp:
+    290-299). Values sort as (dictionary codes | numerics); lookups are
+    binary searches returning row ids."""
+
+    def __init__(self, column: str, using: str, options: dict,
+                 sort_vals, row_ids, data_version: int):
+        self.column = column
+        self.columns = (column,)
+        self.using = using
+        self.options = dict(options)
+        self.sort_vals = sort_vals   # sorted values (codes for strings)
+        self.row_ids = row_ids       # row id of each sorted value
+        self.data_version = data_version
+        self.analyzer_name = ""
+
+    def lookup_eq(self, value) -> "np.ndarray":
+        lo = np.searchsorted(self.sort_vals, value, side="left")
+        hi = np.searchsorted(self.sort_vals, value, side="right")
+        return np.sort(self.row_ids[lo:hi])
+
+def build_btree_index(provider, column: str, using: str,
+                      options: dict) -> BtreeIndex:
+    col = provider.full_batch([column]).column(column)
+    valid = col.valid_mask()
+    rows = np.flatnonzero(valid)
+    vals = col.data[rows]
+    order = np.argsort(vals, kind="stable")
+    return BtreeIndex(column, using, options, vals[order],
+                      rows[order].astype(np.int64), provider.data_version)
+
+
+def find_btree_index(provider, column: str):
+    for idx in getattr(provider, "indexes", {}).values():
+        if isinstance(idx, BtreeIndex) and idx.column == column and \
+                idx.data_version == provider.data_version:
+            return idx
+    return None
+
+
 def build_index_for_table(provider, columns, using, options) -> SearchIndex:
     if using not in ("inverted", "btree", "secondary", "ivf"):
         raise errors.unsupported(f"index type {using}")
+    if using in ("btree", "secondary"):
+        if len(columns) != 1:
+            raise errors.unsupported("multi-column btree index")
+        return build_btree_index(provider, columns[0], using, options)
     analyzer_name = str(options.get("tokenizer", options.get("analyzer",
                                                              "text")))
     if using == "ivf":
@@ -50,8 +95,8 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
 MAX_SEGMENTS = 8   # compaction threshold: full rebuild merges the tier
 
 
-def refresh_index(provider, idx: SearchIndex) -> SearchIndex:
-    """Refresh one inverted index (reference RefreshLoop leg):
+def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
+    """Refresh one index (reference RefreshLoop leg). Inverted indexes:
     - rows appended since the last refresh → ONE new segment over the delta
       (O(new docs), the real-time path)
     - row mutations (delete/update/truncate) or too many segments → full

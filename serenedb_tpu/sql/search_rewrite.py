@@ -44,9 +44,15 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
             if new_child.with_score:
                 _rewire_scorers(plan.exprs, new_child)
             return plan
+        bt = _try_btree_scan(plan.child)
+        if bt is not None:
+            plan.child = bt
+            return plan
     _rewrite_children(plan)
     if isinstance(plan, ScanNode):
         replaced = _try_search_scan(plan, want_score=False)
+        if replaced is None:
+            replaced = _try_btree_scan(plan)
         if replaced is not None:
             return replaced
     return plan
@@ -193,6 +199,46 @@ def _scorer_name(exprs: list[BoundExpr]) -> str:
     return "bm25"
 
 
+def _try_btree_scan(scan: ScanNode):
+    """col = constant conjunct over a btree-indexed column → point lookup
+    (reference: PK lookup fast path)."""
+    from ..exec.search_scan import BtreeScanNode
+    from ..search.index import find_btree_index
+    from .expr import BoundLiteral
+    if scan.filter is None:
+        return None
+    conjuncts = _conjuncts(scan.filter)
+    for k, c in enumerate(conjuncts):
+        if not (isinstance(c, BoundFunc) and c.name == "op=" and
+                len(c.args) == 2):
+            continue
+        for col, lit in ((c.args[0], c.args[1]), (c.args[1], c.args[0])):
+            if not (isinstance(col, BoundColumn) and
+                    isinstance(lit, BoundLiteral) and
+                    lit.value is not None):
+                continue
+            col_name = scan.columns[col.index]
+            idx = find_btree_index(scan.provider, col_name)
+            if idx is None:
+                continue
+            value = lit.value
+            if scan.provider.type_of(col_name).is_string:
+                # equality on strings → dictionary code; an absent string
+                # maps to the impossible code -1 (empty lookup)
+                host = scan.provider.host_column(col_name)
+                if host.dictionary is None:
+                    continue
+                import numpy as _np
+                ds = host.dictionary.astype(str)
+                pos = int(_np.searchsorted(ds, str(value)))
+                value = pos if pos < len(ds) and ds[pos] == str(value) \
+                    else -1
+            residual = _and_conjuncts(conjuncts[:k] + conjuncts[k + 1:])
+            return BtreeScanNode(scan.provider, scan.columns, scan.alias,
+                                 col_name, value, residual)
+    return None
+
+
 def _try_search_scan(scan: ScanNode, want_score: bool,
                      scorer: str = "bm25") -> Optional[SearchScanNode]:
     if scan.filter is None:
@@ -232,11 +278,16 @@ def _claim_ts(scan: ScanNode, col_name: str,
     if not claimed:
         return None, None
     qnode = claimed[0] if len(claimed) == 1 else QAnd(claimed)
-    res: Optional[BoundExpr] = None
-    if residual:
-        res = residual[0] if len(residual) == 1 else BoundFunc(
-            "and", residual, dt.BOOL, lambda cols, b: kleene_and(cols))
-    return qnode, res
+    return qnode, _and_conjuncts(residual)
+
+
+def _and_conjuncts(exprs: list[BoundExpr]) -> Optional[BoundExpr]:
+    if not exprs:
+        return None
+    if len(exprs) == 1:
+        return exprs[0]
+    return BoundFunc("and", exprs, dt.BOOL,
+                     lambda cols, b: kleene_and(cols))
 
 
 def _conjuncts(e: BoundExpr) -> list[BoundExpr]:

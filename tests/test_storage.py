@@ -250,3 +250,18 @@ def test_alter_table_survives_restart(tmp_path):
     rows = db2.connect().execute("SELECT a, note FROM t2").rows()
     assert rows == [(1, "hello")]
     db2.close()
+
+
+def test_drop_index_case_insensitive_survives_restart(tmp_path):
+    from serenedb_tpu.engine import Database
+    d = str(tmp_path / "data")
+    db = Database(d)
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT)")
+    c.execute("CREATE INDEX MyIdx ON t USING btree (a)")
+    c.execute("DROP INDEX MYIDX")
+    db.close()
+    db2 = Database(d)
+    t = db2.schemas["main"].tables["t"]
+    assert not getattr(t, "indexes", {})   # no resurrection on reboot
+    db2.close()
