@@ -115,6 +115,25 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
             "phase": [r["phase"] for r in recs],
             "tuples_done": [r["done"] for r in recs],
             "tuples_total": [r["total"] for r in recs]}))
+    if name == "pg_settings":
+        names = _settings_registry.names()
+        return MemTable("pg_settings", Batch.from_pydict({
+            "name": names,
+            "setting": [str(_settings_registry.get_global(n))
+                        for n in names],
+            "short_desc": [_settings_registry.definition(n).description
+                           for n in names]}))
+    if name == "pg_roles" or name == "pg_user":
+        with db.roles._lock:
+            rn = sorted(db.roles.roles)
+            infos = [db.roles.roles[r] for r in rn]
+        return MemTable("pg_roles", Batch.from_pydict({
+            "rolname": rn,
+            "rolsuper": [bool(i.get("superuser")) for i in infos],
+            "rolcanlogin": [bool(i.get("login", True)) for i in infos]}))
+    if name == "pg_database":
+        return MemTable("pg_database", Batch.from_pydict({
+            "oid": [1], "datname": ["serene"], "encoding": [6]}))
     if name == "sdb_settings":
         names = _settings_registry.names()
         return MemTable("sdb_settings", Batch.from_pydict({

@@ -352,6 +352,109 @@ class EsApi:
                          "_source": json.loads(src) if src else {}})
         return _hits_response(hits, len(fused))
 
+    # -- scroll ------------------------------------------------------------
+    # (reference: ES _search?scroll + _search/scroll continuation)
+
+    def _parse_keepalive(self, keep: str) -> float:
+        import re as _re
+        m = _re.match(r"^(\d+)(ms|s|m|h)?$", keep or "")
+        if not m:
+            return 60.0
+        mult = {"ms": 0.001, "s": 1, "m": 60, "h": 3600}.get(
+            m.group(2) or "s", 1)
+        return min(float(m.group(1)) * mult, 24 * 3600)
+
+    def _prune_scrolls(self):
+        import time as _time
+        now = _time.monotonic()
+        scrolls = getattr(self, "_scrolls", None)
+        if scrolls:
+            for sid in [s for s, st in scrolls.items()
+                        if st["expires"] < now]:
+                del scrolls[sid]
+
+    def search_scroll_start(self, index: str, body: Optional[dict],
+                            keep: str) -> dict:
+        import time as _time
+        body = dict(body or {})
+        size = int(body.get("size", 10))
+        t = self._table(index)
+        # materialize the whole match set up front (scroll = deep
+        # pagination: the window must cover every hit, not a cap)
+        body["size"] = max(t.row_count(), 1)
+        body["from"] = 0
+        res = self.search(index, body)
+        hits = res["hits"]["hits"]
+        sid = _gen_id()
+        with self._lock:
+            self._scrolls = getattr(self, "_scrolls", {})
+            self._prune_scrolls()
+            self._scrolls[sid] = {
+                "hits": hits[size:],
+                "total": res["hits"]["total"]["value"],
+                "size": size,
+                "expires": _time.monotonic() + self._parse_keepalive(keep)}
+        res["hits"]["hits"] = hits[:size]
+        res["_scroll_id"] = sid
+        return res
+
+    def search_scroll_next(self, scroll_id: str,
+                           size: Optional[int] = None) -> dict:
+        with self._lock:
+            self._prune_scrolls()
+            scrolls = getattr(self, "_scrolls", {})
+            st = scrolls.get(scroll_id)
+            if st is None:
+                raise EsError(404, "search_context_missing_exception",
+                              f"No search context found for id [{scroll_id}]")
+            page_size = size if size is not None else st["size"]
+            page = st["hits"][:page_size]
+            st["hits"] = st["hits"][page_size:]
+            total = st["total"]
+        out = _hits_response(page, total)
+        out["_scroll_id"] = scroll_id
+        return out
+
+    def delete_scroll(self, scroll_id: str) -> dict:
+        with self._lock:
+            scrolls = getattr(self, "_scrolls", {})
+            found = scrolls.pop(scroll_id, None) is not None
+        return {"succeeded": found, "num_freed": int(found)}
+
+    def mget(self, index: str, body: dict) -> dict:
+        ids = [str(i) for i in (body.get("ids") or
+                                [d.get("_id") for d in body.get("docs", [])])]
+        t = self._table(index)
+        full = t.full_batch(["_id", "_source"])
+        id_col = full.column("_id").to_pylist()
+        src_col = full.column("_source").to_pylist()
+        lookup = {i: s for i, s in zip(id_col, src_col)}
+        docs = []
+        for i in ids:
+            if i in lookup:
+                docs.append({"_index": index, "_id": i, "found": True,
+                             "_source": json.loads(lookup[i] or "{}")})
+            else:
+                docs.append({"_index": index, "_id": i, "found": False})
+        return {"docs": docs}
+
+    def stats(self, index: Optional[str] = None) -> dict:
+        out = {}
+        with self.db.lock:
+            tables = list(self.db.schemas["main"].tables.items())
+        for name, t in tables:
+            if "_id" not in t.column_names:
+                continue
+            if index is not None and name != index.lower():
+                continue
+            out[name] = {"primaries": {
+                "docs": {"count": t.row_count(), "deleted": 0},
+                "store": {"size_in_bytes": sum(
+                    c.data.nbytes for c in t.full_batch().columns)}}}
+        return {"_all": {"primaries": {"docs": {"count": sum(
+            v["primaries"]["docs"]["count"] for v in out.values())}}},
+            "indices": out}
+
     def cat_indices(self) -> list[dict]:
         out = []
         with self.db.lock:

@@ -97,6 +97,29 @@ class Handler(BaseHTTPRequestHandler):
         if p[0] == "_bulk" and method == "POST":
             self._send(200, es.bulk(self._body()))
             return
+        if p[0] == "_search" and len(p) > 1 and p[1] == "scroll":
+            if method == "DELETE":
+                body = self._json_body() or {}
+                self._send(200, es.delete_scroll(
+                    str(body.get("scroll_id", ""))))
+            else:
+                body = self._json_body() or {}
+                size = body.get("size")
+                self._send(200, es.search_scroll_next(
+                    str(body.get("scroll_id", "")),
+                    int(size) if size is not None else None))
+            return
+        if p[0] == "_stats":
+            self._send(200, es.stats())
+            return
+        if p[0] == "_mget" and method == "POST":
+            body = self._json_body() or {}
+            index = body.get("index")
+            if not index:
+                raise EsError(400, "illegal_argument_exception",
+                              "_mget requires index")
+            self._send(200, es.mget(index, body))
+            return
         if p[0] == "_sql" and method == "POST":
             body = self._json_body() or {}
             # fresh connection per request: /_sql session state (BEGIN,
@@ -145,7 +168,18 @@ class Handler(BaseHTTPRequestHandler):
                               f"{method} on _doc requires an id")
             return
         if verb == "_search":
-            self._send(200, es.search(index, self._json_body()))
+            body = self._json_body()
+            if "scroll" in q:
+                self._send(200, es.search_scroll_start(
+                    index, body, q["scroll"][0]))
+            else:
+                self._send(200, es.search(index, body))
+            return
+        if verb == "_mget" and method == "POST":
+            self._send(200, es.mget(index, self._json_body() or {}))
+            return
+        if verb == "_stats":
+            self._send(200, es.stats(index))
             return
         if verb == "_count":
             self._send(200, es.count(index, self._json_body()))

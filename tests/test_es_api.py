@@ -217,3 +217,73 @@ def test_bulk_partial_failure_reports_per_item(srv):
     assert body["errors"] is True
     assert body["items"][0]["index"]["status"] == 201
     assert body["items"][1]["index"]["status"] == 400
+
+
+def test_scroll_pagination(srv):
+    req(srv, "PUT", "/scr")
+    for i in range(7):
+        req(srv, "PUT", f"/scr/_doc/{i}", {"n": i})
+    status, body = req(srv, "POST", "/scr/_search?scroll=1m",
+                       {"query": {"match_all": {}}, "size": 3,
+                        "sort": [{"n": "asc"}]})
+    assert status == 200
+    sid = body["_scroll_id"]
+    assert [h["_source"]["n"] for h in body["hits"]["hits"]] == [0, 1, 2]
+    status, body = req(srv, "POST", "/_search/scroll",
+                       {"scroll_id": sid, "size": 3})
+    assert [h["_source"]["n"] for h in body["hits"]["hits"]] == [3, 4, 5]
+    status, body = req(srv, "POST", "/_search/scroll",
+                       {"scroll_id": sid, "size": 3})
+    assert [h["_source"]["n"] for h in body["hits"]["hits"]] == [6]
+    status, body = req(srv, "DELETE", "/_search/scroll",
+                       {"scroll_id": sid})
+    assert body["succeeded"] is True
+    status, body = req(srv, "POST", "/_search/scroll", {"scroll_id": sid})
+    assert status == 404
+
+
+def test_mget_and_stats(srv):
+    req(srv, "PUT", "/mg")
+    req(srv, "PUT", "/mg/_doc/a", {"v": 1})
+    req(srv, "PUT", "/mg/_doc/b", {"v": 2})
+    status, body = req(srv, "POST", "/mg/_mget", {"ids": ["a", "b", "zz"]})
+    assert [d["found"] for d in body["docs"]] == [True, True, False]
+    status, body = req(srv, "GET", "/mg/_stats")
+    assert body["indices"]["mg"]["primaries"]["docs"]["count"] == 2
+
+
+def test_scroll_covers_all_hits_and_keeps_size(srv):
+    req(srv, "PUT", "/deep")
+    ndjson = "\n".join(
+        json.dumps({"index": {"_index": "deep", "_id": str(i)}}) + "\n" +
+        json.dumps({"n": i}) for i in range(25)) + "\n"
+    req(srv, "POST", "/_bulk", ndjson, raw=True)
+    status, body = req(srv, "POST", "/deep/_search?scroll=30s",
+                       {"size": 7, "sort": [{"n": "asc"}],
+                        "query": {"match_all": {}}})
+    sid = body["_scroll_id"]
+    seen = [h["_source"]["n"] for h in body["hits"]["hits"]]
+    assert len(seen) == 7
+    while True:
+        status, body = req(srv, "POST", "/_search/scroll",
+                           {"scroll_id": sid})  # no size: reuse initial 7
+        page = [h["_source"]["n"] for h in body["hits"]["hits"]]
+        if not page:
+            break
+        assert len(page) <= 7
+        seen += page
+    assert seen == list(range(25))   # every hit reached, in order
+
+
+def test_scroll_expiry():
+    from serenedb_tpu.server.es_api import EsApi
+    from serenedb_tpu.engine import Database
+    api = EsApi(Database())
+    api.index_doc("exp", {"n": 1}, "1")
+    res = api.search_scroll_start("exp", {"size": 1}, "1ms")
+    import time
+    time.sleep(0.01)
+    import pytest as _pytest
+    from serenedb_tpu.server.es_api import EsError
+    with _pytest.raises(EsError):
+        api.search_scroll_next(res["_scroll_id"])
