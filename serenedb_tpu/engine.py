@@ -479,7 +479,7 @@ class Connection:
 
     def execute_all(self, sql: str,
                     params: Optional[list] = None) -> list[QueryResult]:
-        stmts = parser.parse(sql)
+        stmts = parser.parse(sql)  # cached copy-on-read in the parser
         out = []
         for st in stmts:
             out.append(self.execute_statement(st, params or []))

@@ -43,6 +43,7 @@ class EsApi:
         self.db = db
         self.conn = db.connect()
         self._lock = threading.Lock()
+        self._scrolls: dict[str, dict] = {}
 
     # -- index management --------------------------------------------------
 
@@ -367,11 +368,9 @@ class EsApi:
     def _prune_scrolls(self):
         import time as _time
         now = _time.monotonic()
-        scrolls = getattr(self, "_scrolls", None)
-        if scrolls:
-            for sid in [s for s, st in scrolls.items()
-                        if st["expires"] < now]:
-                del scrolls[sid]
+        for sid in [s for s, st in self._scrolls.items()
+                    if st["expires"] < now]:
+            del self._scrolls[sid]
 
     def search_scroll_start(self, index: str, body: Optional[dict],
                             keep: str) -> dict:
@@ -387,26 +386,30 @@ class EsApi:
         hits = res["hits"]["hits"]
         sid = _gen_id()
         with self._lock:
-            self._scrolls = getattr(self, "_scrolls", {})
             self._prune_scrolls()
             self._scrolls[sid] = {
                 "hits": hits[size:],
                 "total": res["hits"]["total"]["value"],
                 "size": size,
+                "keep": self._parse_keepalive(keep),
                 "expires": _time.monotonic() + self._parse_keepalive(keep)}
         res["hits"]["hits"] = hits[:size]
         res["_scroll_id"] = sid
         return res
 
     def search_scroll_next(self, scroll_id: str,
-                           size: Optional[int] = None) -> dict:
+                           size: Optional[int] = None,
+                           keep: Optional[str] = None) -> dict:
+        import time as _time
         with self._lock:
             self._prune_scrolls()
-            scrolls = getattr(self, "_scrolls", {})
-            st = scrolls.get(scroll_id)
+            st = self._scrolls.get(scroll_id)
             if st is None:
                 raise EsError(404, "search_context_missing_exception",
                               f"No search context found for id [{scroll_id}]")
+            # an active continuation refreshes the keepalive (ES semantics)
+            ttl = self._parse_keepalive(keep) if keep else st["keep"]
+            st["expires"] = _time.monotonic() + ttl
             page_size = size if size is not None else st["size"]
             page = st["hits"][:page_size]
             st["hits"] = st["hits"][page_size:]
@@ -415,30 +418,55 @@ class EsApi:
         out["_scroll_id"] = scroll_id
         return out
 
-    def delete_scroll(self, scroll_id: str) -> dict:
+    def delete_scroll(self, scroll_ids) -> dict:
+        if isinstance(scroll_ids, str):
+            scroll_ids = [scroll_ids]
+        freed = 0
         with self._lock:
-            scrolls = getattr(self, "_scrolls", {})
-            found = scrolls.pop(scroll_id, None) is not None
-        return {"succeeded": found, "num_freed": int(found)}
+            for sid in scroll_ids:
+                if self._scrolls.pop(str(sid), None) is not None:
+                    freed += 1
+        return {"succeeded": freed > 0, "num_freed": freed}
 
-    def mget(self, index: str, body: dict) -> dict:
-        ids = [str(i) for i in (body.get("ids") or
-                                [d.get("_id") for d in body.get("docs", [])])]
-        t = self._table(index)
-        full = t.full_batch(["_id", "_source"])
-        id_col = full.column("_id").to_pylist()
-        src_col = full.column("_source").to_pylist()
-        lookup = {i: s for i, s in zip(id_col, src_col)}
+    def mget(self, index: Optional[str], body: dict) -> dict:
+        """ES shapes: {"ids": [...]} (index-scoped) or
+        {"docs": [{"_index": ..., "_id": ...}, ...]} (per-doc index)."""
+        wanted: list[tuple[str, str]] = []       # (index, id)
+        if body.get("ids") is not None:
+            if index is None:
+                raise EsError(400, "action_request_validation_exception",
+                              "index is missing")
+            wanted = [(index, str(i)) for i in body["ids"]]
+        else:
+            for d in body.get("docs", []):
+                doc_index = d.get("_index", index)
+                doc_id = d.get("_id")
+                if doc_index is None or doc_id is None:
+                    raise EsError(400,
+                                  "action_request_validation_exception",
+                                  "_index and _id are required in docs")
+                wanted.append((str(doc_index), str(doc_id)))
+        lookups: dict[str, dict] = {}
+        for idx_name in {w[0] for w in wanted}:
+            t = self._table(idx_name)
+            full = t.full_batch(["_id", "_source"])
+            lookups[idx_name] = dict(zip(full.column("_id").to_pylist(),
+                                         full.column("_source").to_pylist()))
         docs = []
-        for i in ids:
-            if i in lookup:
-                docs.append({"_index": index, "_id": i, "found": True,
-                             "_source": json.loads(lookup[i] or "{}")})
+        for idx_name, doc_id in wanted:
+            src = lookups[idx_name].get(doc_id)
+            if src is not None or doc_id in lookups[idx_name]:
+                docs.append({"_index": idx_name, "_id": doc_id,
+                             "found": True,
+                             "_source": json.loads(src or "{}")})
             else:
-                docs.append({"_index": index, "_id": i, "found": False})
+                docs.append({"_index": idx_name, "_id": doc_id,
+                             "found": False})
         return {"docs": docs}
 
     def stats(self, index: Optional[str] = None) -> dict:
+        if index is not None:
+            self._table(index)   # 404 for unknown index
         out = {}
         with self.db.lock:
             tables = list(self.db.schemas["main"].tables.items())

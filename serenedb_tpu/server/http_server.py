@@ -98,27 +98,26 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, es.bulk(self._body()))
             return
         if p[0] == "_search" and len(p) > 1 and p[1] == "scroll":
+            body = self._json_body() or {}
             if method == "DELETE":
-                body = self._json_body() or {}
                 self._send(200, es.delete_scroll(
-                    str(body.get("scroll_id", ""))))
+                    body.get("scroll_id", [])))
             else:
-                body = self._json_body() or {}
                 size = body.get("size")
+                sid = body.get("scroll_id", "")
+                if isinstance(sid, list):
+                    sid = sid[0] if sid else ""
                 self._send(200, es.search_scroll_next(
-                    str(body.get("scroll_id", "")),
-                    int(size) if size is not None else None))
+                    str(sid),
+                    int(size) if size is not None else None,
+                    body.get("scroll")))
             return
         if p[0] == "_stats":
             self._send(200, es.stats())
             return
         if p[0] == "_mget" and method == "POST":
             body = self._json_body() or {}
-            index = body.get("index")
-            if not index:
-                raise EsError(400, "illegal_argument_exception",
-                              "_mget requires index")
-            self._send(200, es.mget(index, body))
+            self._send(200, es.mget(body.get("index"), body))
             return
         if p[0] == "_sql" and method == "POST":
             body = self._json_body() or {}

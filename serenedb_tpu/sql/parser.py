@@ -1016,8 +1016,22 @@ class Parser:
         return ast.VacuumStmt(table, verbs)
 
 
+_PARSE_CACHE: dict = {}
+_PARSE_CACHE_MAX = 512
+
+
 def parse(sql: str) -> list[ast.Statement]:
-    return Parser(sql).parse_statements()
+    """Parse with a copy-on-read AST cache (the reference caches parse
+    trees the same way: PEG parser cache, server_engine.cpp:310-314).
+    Deep copies are handed out because the planner mutates ASTs."""
+    import copy
+    cached = _PARSE_CACHE.get(sql)
+    if cached is None:
+        cached = Parser(sql).parse_statements()
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[sql] = cached
+    return copy.deepcopy(cached)
 
 
 def parse_one(sql: str) -> ast.Statement:

@@ -287,3 +287,37 @@ def test_scroll_expiry():
     from serenedb_tpu.server.es_api import EsError
     with _pytest.raises(EsError):
         api.search_scroll_next(res["_scroll_id"])
+
+
+def test_mget_standard_docs_shape_and_errors(srv):
+    req(srv, "PUT", "/mgs")
+    req(srv, "PUT", "/mgs/_doc/x", {"v": 1})
+    # per-doc _index (standard ES shape) at the top-level endpoint
+    status, body = req(srv, "POST", "/_mget",
+                       {"docs": [{"_index": "mgs", "_id": "x"},
+                                 {"_index": "mgs", "_id": "nope"}]})
+    assert status == 200
+    assert [d["found"] for d in body["docs"]] == [True, False]
+    # malformed doc entry → 400, not a phantom id
+    status, body = req(srv, "POST", "/mgs/_mget", {"docs": [{"_idd": "x"}]})
+    assert status == 400
+    # stats on a missing index → 404
+    status, body = req(srv, "GET", "/no_such/_stats")
+    assert status == 404
+
+
+def test_scroll_delete_list_form_and_refresh(srv):
+    req(srv, "PUT", "/scr2")
+    for i in range(4):
+        req(srv, "PUT", f"/scr2/_doc/{i}", {"n": i})
+    status, body = req(srv, "POST", "/scr2/_search?scroll=30s",
+                       {"size": 2, "sort": [{"n": "asc"}]})
+    sid = body["_scroll_id"]
+    # continuation with the standard body shape refreshes keepalive
+    status, body = req(srv, "POST", "/_search/scroll",
+                       {"scroll": "30s", "scroll_id": sid})
+    assert [h["_source"]["n"] for h in body["hits"]["hits"]] == [2, 3]
+    # ES list form of delete
+    status, body = req(srv, "DELETE", "/_search/scroll",
+                       {"scroll_id": [sid]})
+    assert body["succeeded"] is True and body["num_freed"] == 1

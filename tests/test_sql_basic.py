@@ -294,3 +294,13 @@ def test_date_trunc_per_row_units():
     assert rows[0][0] == "2024-03-01 00:00:00"
     assert rows[1][0] == "2024-03-17 00:00:00"
     assert rows[2][0] is None
+
+
+def test_parse_cache_does_not_corrupt_reexecution():
+    c = Database().connect()
+    c.execute("CREATE TABLE pc (a INT)")
+    c.execute("INSERT INTO pc VALUES (1), (2)")
+    q = "SELECT a, 100 + row_number() OVER (ORDER BY a) FROM pc"
+    first = c.execute(q).rows()
+    second = c.execute(q).rows()
+    assert first == second == [(1, 101), (2, 102)]
