@@ -441,12 +441,34 @@ class AggregateNode(PlanNode):
                 f"aggs=[{', '.join(a.func for a in self.aggs)}]")
 
     def batches(self, ctx):
+        fast = self._try_count_fast_path(ctx)
+        if fast is not None:
+            yield fast
+            return
         from .device_agg import try_device_aggregate
         result = try_device_aggregate(self, ctx)
         if result is not None:
             yield result
             return
         yield self._cpu_aggregate(ctx)
+
+    def _try_count_fast_path(self, ctx):
+        """count(*)-only over an index scan skips row materialization
+        (reference: ScanMode::Count/CountFast,
+        duckdb_search_full_scan.hpp:58-62). The scan node owns the
+        counting semantics (count_matching) so they can never diverge
+        from its row-returning path."""
+        if self.group_exprs or not self.aggs or \
+                any(s.func != "count_star" for s in self.aggs):
+            return None
+        count_fn = getattr(self.child, "count_matching", None)
+        if count_fn is None:
+            return None
+        n = count_fn()
+        if n is None:
+            return None
+        return Batch(list(self.names),
+                     [Column.from_pylist([n], s.type) for s in self.aggs])
 
     # -- CPU reference aggregation ----------------------------------------
 

@@ -62,6 +62,26 @@ class SearchScanNode(PlanNode):
             return None
         return idx.searcher(self.search_column)
 
+    def _matching_docs(self, searcher) -> np.ndarray:
+        """Doc selection with PG NULL semantics: a predicate over a NULL
+        text value is NULL, never true — negation queries must not surface
+        NULL rows. The count fast path shares this exact logic."""
+        docs = searcher.eval_filter(self.qnode)
+        col = self.provider.host_column(self.search_column)
+        if col.validity is not None:
+            docs = docs[col.validity[docs]]
+        return docs
+
+    def count_matching(self):
+        """Row count without materialization (reference: ScanMode::Count);
+        None when not applicable (top-k or residual present)."""
+        if self.residual is not None or self.topk is not None:
+            return None
+        searcher = self._searcher()
+        if searcher is None:
+            return None
+        return len(self._matching_docs(searcher))
+
     def batches(self, ctx):
         searcher = self._searcher()
         if searcher is None:
@@ -80,12 +100,7 @@ class SearchScanNode(PlanNode):
                 out = out.filter(c.data.astype(bool) & c.valid_mask())
             yield out
             return
-        docs = searcher.eval_filter(self.qnode)
-        # PG semantics: a predicate over a NULL text value is NULL, never
-        # true — negation queries must not surface NULL rows
-        col = full.column(self.search_column)
-        if col.validity is not None:
-            docs = docs[col.validity[docs]]
+        docs = self._matching_docs(searcher)
         out = full.take(docs.astype(np.int64))
         if self.with_score:
             scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1),
@@ -162,6 +177,15 @@ class BtreeScanNode(PlanNode):
 
     def label(self):
         return f"BtreeScan {self.provider.name}.{self.index_column} eq"
+
+    def count_matching(self):
+        if self.residual is not None:
+            return None
+        from ..search.index import find_btree_index
+        idx = find_btree_index(self.provider, self.index_column)
+        if idx is None:
+            return None
+        return len(idx.lookup_eq(self.eq_value))
 
     def batches(self, ctx):
         from ..search.index import find_btree_index

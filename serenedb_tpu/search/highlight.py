@@ -7,13 +7,15 @@ computed by re-analyzing the row's text against the query (SURVEY.md §2.5
 
 from __future__ import annotations
 
-from .query import QAnd, QNode, QNot, QOr, QPhrase, QPrefix, QTerm, parse_query
+from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix,
+                    QTerm, edit_distance_at_most, parse_query)
 
 
-def _positive_terms(node: QNode) -> tuple[set[str], set[str]]:
-    """(exact terms, prefixes) contributing to highlights."""
+def _positive_terms(node: QNode) -> tuple[set[str], set[str], list]:
+    """(exact terms, prefixes, fuzzy specs) contributing to highlights."""
     terms: set[str] = set()
     prefixes: set[str] = set()
+    fuzzies: list[tuple[str, int]] = []
 
     def rec(nd):
         if isinstance(nd, QTerm):
@@ -22,22 +24,29 @@ def _positive_terms(node: QNode) -> tuple[set[str], set[str]]:
             terms.update(nd.terms)
         elif isinstance(nd, QPrefix):
             prefixes.add(nd.prefix)
+        elif isinstance(nd, QFuzzy):
+            fuzzies.append((nd.term, nd.max_edits))
         elif isinstance(nd, (QAnd, QOr)):
             for a in nd.args:
                 rec(a)
         # QNot: negated terms never highlight
     rec(node)
-    return terms, prefixes
+    return terms, prefixes, fuzzies
+
+
+def token_matches(term: str, terms: set, prefixes: set, fuzzies: list) -> bool:
+    return term in terms or \
+        any(term.startswith(p) for p in prefixes) or \
+        any(edit_distance_at_most(term, f, k) for f, k in fuzzies)
 
 
 def match_offsets(analyzer, text: str, query: str) -> list[list[int]]:
     """[[start, end], ...] character ranges of matching tokens."""
     node = parse_query(query, analyzer)
-    terms, prefixes = _positive_terms(node)
+    terms, prefixes, fuzzies = _positive_terms(node)
     out = []
     for tok in analyzer.tokenize(text):
-        if tok.term in terms or any(tok.term.startswith(p)
-                                    for p in prefixes):
+        if token_matches(tok.term, terms, prefixes, fuzzies):
             out.append([tok.start, tok.end])
     return out
 

@@ -82,6 +82,12 @@ class QPrefix(QNode):
         self.prefix = prefix
 
 
+class QFuzzy(QNode):
+    def __init__(self, term, max_edits=1):
+        self.term = term
+        self.max_edits = max_edits
+
+
 def parse_query(q: str, analyzer=None) -> QNode:
     """`a & b`, `a | b`, `!a`, `"a phrase"`, `pre*`, parens. Bare terms
     separated by whitespace are AND-ed (to_tsquery-ish)."""
@@ -156,6 +162,15 @@ def _parse_unary(toks, an):
     if t.endswith("*") and len(t) > 1:
         base = t[:-1].lower()
         return QPrefix(base), toks[1:]
+    if "~" in t and len(t) > 1:
+        base, _, edits = t.partition("~")
+        terms_f = [tok.term for tok in an.tokenize(base)]
+        if len(terms_f) == 1:
+            try:
+                n_edits = max(1, min(int(edits), 2)) if edits else 1
+            except ValueError:
+                n_edits = 1
+            return QFuzzy(terms_f[0], n_edits), toks[1:]
     terms = [tok.term for tok in an.tokenize(t)]
     if not terms:
         return None, toks[1:]
@@ -181,8 +196,31 @@ def eval_query_on_text(node: QNode, an, text: str) -> bool:
             return not ev(nd.arg)
         if isinstance(nd, QPrefix):
             return any(t.startswith(nd.prefix) for t in terms)
+        if isinstance(nd, QFuzzy):
+            return any(edit_distance_at_most(t, nd.term, nd.max_edits)
+                       for t in terms)
         return False
     return ev(node)
+
+
+def edit_distance_at_most(a: str, b: str, k: int) -> bool:
+    """Banded Levenshtein: True iff distance(a, b) <= k."""
+    if abs(len(a) - len(b)) > k:
+        return False
+    if a == b:
+        return True
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != cb))
+            row_min = min(row_min, cur[j])
+        if row_min > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
 
 
 def match_query_brute(texts: np.ndarray, queries: np.ndarray) -> np.ndarray:

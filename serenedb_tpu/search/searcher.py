@@ -26,8 +26,8 @@ import numpy as np
 from ..columnar.device import pad_len
 from ..ops import bm25 as bm25_ops
 from .analysis import Analyzer
-from .query import (QAnd, QNode, QNot, QOr, QPhrase, QPrefix, QTerm,
-                    parse_query)
+from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix,
+                    QTerm, edit_distance_at_most, parse_query)
 from .segment import BLOCK, FieldIndex
 
 K1 = 1.2
@@ -66,6 +66,11 @@ class SegmentSearcher:
                 return np.empty(0, dtype=np.int32)
             parts = [self.index.postings(t)[0] for t in tids]
             return np.unique(np.concatenate(parts))
+        if isinstance(node, QFuzzy):
+            tids = self._fuzzy_term_ids(node)
+            parts = [self.index.postings(t)[0] for t in tids]
+            return np.unique(np.concatenate(parts)) if parts \
+                else np.empty(0, dtype=np.int32)
         if isinstance(node, QPhrase):
             return self._eval_phrase(node.terms)
         if isinstance(node, QAnd):
@@ -126,6 +131,30 @@ class SegmentSearcher:
                 out.append(int(d))
         return np.asarray(out, dtype=np.int32)
 
+    def _fuzzy_term_ids(self, node: QFuzzy) -> list[int]:
+        """Edit-distance expansion over the term dictionary (reference:
+        levenshtein parametric automata over the burst trie; here a
+        length-banded numpy prefilter + banded edit distance). Uncapped —
+        indexed results must equal brute-force evaluation. Memoized per
+        (term, edits) while the segment is alive (segments are
+        immutable)."""
+        cache = getattr(self, "_fuzzy_cache", None)
+        if cache is None:
+            cache = self._fuzzy_cache = {}
+        key = (node.term, node.max_edits)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        ts = self.index.terms_str
+        lens = np.char.str_len(ts)
+        band = np.flatnonzero(np.abs(lens - len(node.term))
+                              <= node.max_edits)
+        out = [int(tid) for tid in band
+               if edit_distance_at_most(str(ts[tid]), node.term,
+                                        node.max_edits)]
+        cache[key] = out
+        return out
+
     # -- scoring (device) --------------------------------------------------
 
     def scoring_terms(self, node: QNode) -> list[int]:
@@ -145,6 +174,8 @@ class SegmentSearcher:
             elif isinstance(nd, QPrefix):
                 out.extend(int(t) for t in
                            self.index.prefix_term_ids(nd.prefix))
+            elif isinstance(nd, QFuzzy):
+                out.extend(self._fuzzy_term_ids(nd))
             elif isinstance(nd, (QAnd, QOr)):
                 for a in nd.args:
                     rec(a)
@@ -168,7 +199,7 @@ class SegmentSearcher:
         require_all = 0
         needs_mask = False
         empty = False
-        if isinstance(node, (QTerm, QPrefix)):
+        if isinstance(node, (QTerm, QPrefix, QFuzzy)):
             pass
         elif isinstance(node, QOr) and all(
                 isinstance(a, QTerm) for a in node.args):

@@ -107,7 +107,7 @@ def bind_function(binder, e):
             valid = propagate_nulls(cols)
             # the query argument is almost always a constant column: parse
             # each distinct query string once, not once per row
-            from .highlight import _positive_terms
+            from .highlight import _positive_terms, token_matches
             from .query import parse_query as _pq
             qcache: dict[str, tuple] = {}
 
@@ -122,10 +122,10 @@ def bind_function(binder, e):
                 if valid is not None and not valid[i]:
                     out.append("")
                     continue
-                terms, prefixes = parsed(queries[i])
+                terms, prefixes, fuzzies = parsed(queries[i])
                 spans = [[t.start, t.end] for t in an.tokenize(texts[i])
-                         if t.term in terms or
-                         any(t.term.startswith(p) for p in prefixes)]
+                         if token_matches(t.term, terms, prefixes,
+                                          fuzzies)]
                 if _headline:
                     out.append(_hl(an, texts[i], queries[i], spans=spans))
                 else:

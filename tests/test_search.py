@@ -216,3 +216,25 @@ def test_tfidf_scorer_differs_from_bm25(sql_conn):
         tfreq = float(pt[np.searchsorted(pd, row)])
         idf = 1.0 + np.log(searcher.num_docs / (fi.doc_freq[tid] + 1.0))
         assert tf[0][1] == pytest.approx(idf * np.sqrt(tfreq), rel=1e-3)
+
+
+def test_fuzzy_expansion_uncapped_matches_brute(sql_conn):
+    # >128 near-terms: indexed fuzzy must equal brute force (no silent cap)
+    c = sql_conn
+    c.execute("CREATE TABLE many (body TEXT)")
+    rows = ", ".join(f"('aaaa{chr(97 + i % 26)}{j}')"
+                     for i in range(26) for j in range(6))
+    c.execute(f"INSERT INTO many VALUES {rows}")
+    q = "SELECT count(*) FROM many WHERE body @@ 'aaaax1~2'"
+    brute = c.execute(q).scalar()
+    c.execute("CREATE INDEX ON many USING inverted (body)")
+    assert c.execute(q).scalar() == brute
+    neg = "SELECT count(*) FROM many WHERE body @@ '!aaaax1~2'"
+    assert c.execute(neg).scalar() == 156 - brute
+
+
+def test_fuzzy_highlight(sql_conn):
+    c = sql_conn
+    r = c.execute("SELECT ts_headline('databose quirks', 'database~1')"
+                  ).scalar()
+    assert r == "<b>databose</b> quirks"
