@@ -166,6 +166,8 @@ class Prepared:
 class Portal:
     prepared: Prepared
     params: list
+    pending: object = None     # QueryResult with rows not yet sent
+    sent: int = 0
 
 
 class PgSession:
@@ -395,8 +397,7 @@ class PgSession:
         if self.conn is not None and self.conn.in_txn:
             self.conn.txn_failed = True
 
-    def _send_result(self, res: QueryResult, describe: bool,
-                     max_rows: int = 0):
+    def _send_result(self, res: QueryResult, describe: bool):
         if res.batch.num_columns:
             if describe:
                 self.w.row_description(
@@ -512,6 +513,7 @@ class PgSession:
         name = payload[:end].decode()
         loop = asyncio.get_running_loop()
         try:
+            (max_rows,) = struct.unpack_from("!I", payload, end + 1)
             portal = self.portals.get(name)
             if portal is None:
                 raise errors.SqlError("34000",
@@ -519,11 +521,32 @@ class PgSession:
             if not portal.prepared.statements:
                 self.w.empty_query()
                 return
-            st = portal.prepared.statements[0]
-            res = await loop.run_in_executor(
-                self.server.pool, self.conn.execute_statement, st,
-                portal.params)
-            self._send_result(res, describe=False)
+            if portal.pending is None:
+                st = portal.prepared.statements[0]
+                portal.pending = await loop.run_in_executor(
+                    self.server.pool, self.conn.execute_statement, st,
+                    portal.params)
+                portal.sent = 0
+            res = portal.pending
+            total = res.batch.num_rows
+            if max_rows and res.batch.num_columns and \
+                    portal.sent + max_rows < total:
+                # partial page: rows then PortalSuspended (reference:
+                # portals with row-budget paging, pg_wire_session.h:293-300)
+                page = res.batch.slice(portal.sent,
+                                       portal.sent + max_rows)
+                portal.sent += max_rows
+                self.w.data_rows(page)
+                self.w.msg(b"s")           # PortalSuspended
+            else:
+                remainder = res
+                if res.batch.num_columns and portal.sent:
+                    from ..engine import QueryResult as _QR
+                    remainder = _QR(res.batch.slice(portal.sent, total),
+                                    res.command_tag)
+                self._send_result(remainder, describe=False)
+                portal.pending = None
+                portal.sent = 0
         except errors.SqlError as e:
             self._note_error()
             self.w.error(e)

@@ -360,3 +360,39 @@ def test_copy_rejected_in_aborted_txn(server):
     assert c.query("SELECT count(*) FROM cpt")[1] == [("0",)]
     c.query("DROP TABLE cpt")
     c.close()
+
+
+def test_portal_row_paging_with_suspension(server):
+    c = RawPg(server.port)
+    c.query("CREATE TABLE pg_page (n INT)")
+    c.query("INSERT INTO pg_page VALUES (1),(2),(3),(4),(5)")
+    # Parse + Bind once, Execute with max_rows=2 repeatedly
+    c.send(b"P", b"cur\x00SELECT n FROM pg_page ORDER BY n\x00\x00\x00")
+    c.send(b"B", b"p1\x00cur\x00" + struct.pack("!HHH", 0, 0, 0))
+    rows, suspended, complete = [], 0, 0
+    for _ in range(4):
+        c.send(b"E", b"p1\x00" + struct.pack("!I", 2))
+        c.send(b"H")
+        while True:
+            kind, payload = c.read_msg()
+            if kind == b"D":
+                (ncols,) = struct.unpack("!H", payload[:2])
+                (ln,) = struct.unpack("!i", payload[2:6])
+                rows.append(payload[6:6 + ln].decode())
+            elif kind == b"s":
+                suspended += 1
+                break
+            elif kind == b"C":
+                complete += 1
+                break
+            elif kind in (b"1", b"2"):
+                continue
+        if complete:
+            break
+    c.send(b"S")
+    while c.read_msg()[0] != b"Z":
+        pass
+    assert rows == ["1", "2", "3", "4", "5"]
+    assert suspended == 2 and complete == 1
+    c.query("DROP TABLE pg_page")
+    c.close()
