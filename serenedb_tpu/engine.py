@@ -147,6 +147,9 @@ class Database(TableResolver):
             self.schemas[schema].views[name.lower()] = ViewDef(name, q, "")
 
         self.roles.load_meta(meta.get("auth", {}))
+        from .search.analysis import register_dictionary
+        for dname, dopts in meta.get("tsdicts", {}).items():
+            register_dictionary(dname, dopts, replace=True)
         for name, sdef in meta.get("sequences", {}).items():
             # resume at the persisted high-water mark: crash skips at most
             # one batch of values, never repeats
@@ -581,11 +584,39 @@ class Connection:
             return QueryResult(Batch([], []), "SET")
         if isinstance(st, ast.AlterTable):
             return self._alter_table(st)
+        if isinstance(st, ast.CreateTsDictionary):
+            if not self.db.roles.is_superuser(self.current_role):
+                raise errors.SqlError(errors.INSUFFICIENT_PRIVILEGE,
+                                      "must be superuser to create "
+                                      "dictionaries")
+            from .search.analysis import register_dictionary
+            register_dictionary(st.name, st.options,
+                                if_not_exists=st.if_not_exists)
+            if self.db.store is not None:
+                opts = dict(st.options)
+                self.db.store.update_meta(
+                    lambda m: m.setdefault("tsdicts", {}).__setitem__(
+                        st.name.lower(), opts))
+            return QueryResult(Batch([], []), "CREATE TEXT SEARCH DICTIONARY")
         if isinstance(st, ast.CreateSequence):
             self.db.create_sequence(".".join(st.name), st.start,
                                     st.increment, st.if_not_exists)
             return QueryResult(Batch([], []), "CREATE SEQUENCE")
         if isinstance(st, ast.Drop):
+            if st.kind == "tsdictionary":
+                from .search.analysis import drop_dictionary
+                if not drop_dictionary(st.name[-1]) and not st.if_exists:
+                    raise errors.SqlError(
+                        errors.UNDEFINED_OBJECT,
+                        f'text search dictionary "{st.name[-1]}" does '
+                        "not exist")
+                if self.db.store is not None:
+                    target = st.name[-1].lower()
+                    self.db.store.update_meta(
+                        lambda m: m.setdefault("tsdicts", {}).pop(
+                            target, None))
+                return QueryResult(Batch([], []),
+                                   "DROP TEXT SEARCH DICTIONARY")
             if st.kind == "sequence":
                 self.db.drop_sequence(".".join(st.name), st.if_exists)
                 return QueryResult(Batch([], []), "DROP SEQUENCE")
@@ -728,12 +759,19 @@ class Connection:
             provider.indexes = {}
         idx_name = st.name or f"{st.table[-1]}_{'_'.join(st.columns)}_idx"
         from .search.index import build_index_for_table
+        options = dict(st.options)
+        if st.column_tokenizers:
+            # per-column dictionary names (single-column indexes use it as
+            # THE tokenizer; reference: USING inverted(text imdb_en))
+            options.setdefault(
+                "tokenizer", next(iter(st.column_tokenizers.values())))
+            options["column_tokenizers"] = dict(st.column_tokenizers)
         with _progress.track("CREATE INDEX", provider.row_count()):
             provider.indexes[idx_name] = build_index_for_table(
-                provider, st.columns, st.using, st.options)
+                provider, st.columns, st.using, options)
         if self.db.store is not None and isinstance(provider, StoredTable):
             idef = {"table": provider.key, "columns": list(st.columns),
-                    "using": st.using, "options": dict(st.options)}
+                    "using": st.using, "options": options}
             self.db.store.update_meta(
                 lambda m: m["indexes"].__setitem__(idx_name, idef))
         return QueryResult(Batch([], []), "CREATE INDEX")

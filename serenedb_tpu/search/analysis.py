@@ -171,10 +171,84 @@ _BUILTINS: dict[str, Callable[[], Analyzer]] = {
 }
 
 _cache: dict[str, Analyzer] = {}
+_custom: dict[str, Analyzer] = {}
+
+
+_KNOWN_DICT_OPTIONS = {
+    # behavioral
+    "template", "stemming", "accent", "stopwords", "min", "max",
+    "delimiter",
+    # accepted reference options that are defaults/no-ops here
+    "locale", "case", "frequency", "position", "norm",
+}
+
+
+def register_dictionary(name: str, options: dict,
+                        if_not_exists: bool = False,
+                        replace: bool = False) -> Analyzer:
+    """CREATE TEXT SEARCH DICTIONARY: a named, configured analyzer
+    (reference: server/pg/commands/create_tsdictionary.cpp; template/
+    case/stemming/accent options as in examples/demo0/demo.sql).
+
+    Dictionaries may not shadow builtin analyzer names, and duplicates
+    error unless IF NOT EXISTS / replace (recovery) is given."""
+    key = name.lower()
+    unknown = set(options) - _KNOWN_DICT_OPTIONS
+    if unknown:
+        raise errors.SqlError(
+            "22023", f"unrecognized dictionary option "
+                     f"{sorted(unknown)[0]!r}")
+    if key in _BUILTINS:
+        raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                              f'"{name}" is a builtin tokenizer')
+    if key in _custom and not replace:
+        if if_not_exists:
+            return _custom[key]
+        raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                              f'text search dictionary "{name}" already '
+                              "exists")
+    template = str(options.get("template", "text")).lower()
+    def truthy(v, default):
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        return str(v).lower() in ("true", "on", "1", "yes")
+    if template in ("text", "simple"):
+        a = TextAnalyzer(
+            stopwords=(EN_STOPWORDS
+                       if truthy(options.get("stopwords"), False)
+                       else frozenset()),
+            stem=truthy(options.get("stemming"), template == "text"),
+            accent_fold=truthy(options.get("accent"), True))
+    elif template == "whitespace":
+        a = WhitespaceAnalyzer()
+    elif template == "keyword":
+        a = KeywordAnalyzer()
+    elif template in ("ngram", "edge_ngram"):
+        a = NgramAnalyzer(int(options.get("min", 2)),
+                          int(options.get("max", 3)),
+                          edge=template == "edge_ngram")
+    elif template == "delimiter":
+        a = DelimiterAnalyzer(str(options.get("delimiter", ",")))
+    else:
+        raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                              f'tokenizer template "{template}" does not '
+                              "exist")
+    a.name = name.lower()
+    _custom[name.lower()] = a
+    return a
+
+
+def drop_dictionary(name: str) -> bool:
+    return _custom.pop(name.lower(), None) is not None
 
 
 def get_analyzer(name: str) -> Analyzer:
     key = (name or "text").lower()
+    a = _custom.get(key)
+    if a is not None:
+        return a
     a = _cache.get(key)
     if a is None:
         ctor = _BUILTINS.get(key)

@@ -72,9 +72,10 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
         return build_ivf_index(provider, columns[0], options)
     searchers = {}
     n_rows = provider.row_count()
+    col_toks = options.get("column_tokenizers", {}) or {}
     if using == "inverted":
-        an = get_analyzer(analyzer_name)
         for col_name in columns:
+            an = get_analyzer(col_toks.get(col_name, analyzer_name))
             col = provider.full_batch([col_name]).column(col_name)
             if not col.type.is_string:
                 raise errors.SqlError(
@@ -112,7 +113,7 @@ def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
             n_segments >= MAX_SEGMENTS:
         return build_index_for_table(provider, idx.columns, idx.using,
                                      idx.options)
-    an = get_analyzer(idx.analyzer_name)
+    col_toks = idx.options.get("column_tokenizers", {}) or {}
     base = idx.indexed_rows
     # build-new-then-swap: assemble fresh MultiSearchers (reusing the old
     # immutable SegmentSearcher objects) and return a NEW SearchIndex the
@@ -120,6 +121,7 @@ def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
     # consistent snapshot, and a failure mid-build publishes nothing
     new_searchers = {}
     for col_name in idx.columns:
+        an = get_analyzer(col_toks.get(col_name, idx.analyzer_name))
         ms = MultiSearcher(an)
         for seg, seg_base in idx.searchers[col_name].segments:
             ms.add_segment(seg, seg_base)

@@ -409,3 +409,9 @@ class SearchIndex:
 
     def searcher(self, column: str) -> Optional[MultiSearcher]:
         return self.searchers.get(column)
+
+    def analyzer_name_for(self, column: str) -> str:
+        """The column's own tokenizer (multi-column indexes may configure
+        one per column — reference: USING inverted(text imdb_en, label))."""
+        col_toks = (self.options or {}).get("column_tokenizers", {}) or {}
+        return col_toks.get(column, self.analyzer_name)

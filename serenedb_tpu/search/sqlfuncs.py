@@ -41,12 +41,21 @@ def bind_operator(binder, e):
         left = binder.bind(e.left)
         right = binder.bind(e.right)
         return binder._call(fname, [left, right])
+    from ..sql import ast as _ast
+    op = e.op
+    right_ast = e.right
+    # ts_phrase('...') inside @@ means phrase semantics
+    # (reference demo0: text @@ ts_phrase('breathtaking cinematography'))
+    if op == "@@" and isinstance(right_ast, _ast.FuncCall) and \
+            right_ast.name == "ts_phrase" and len(right_ast.args) == 1:
+        op = "##"
+        right_ast = right_ast.args[0]
     left = binder.bind(e.left)
-    right = binder.bind(e.right)
+    right = binder.bind(right_ast)
     if not left.type.is_string:
         raise errors.SqlError(errors.DATATYPE_MISMATCH,
-                              f"operator {e.op} requires a text column")
-    fn = match_phrase_brute if e.op == "##" else match_query_brute
+                              f"operator {op} requires a text column")
+    fn = match_phrase_brute if op == "##" else match_query_brute
 
     def impl(cols, batch, _fn=fn):
         hay, needle = cols
@@ -57,7 +66,7 @@ def bind_operator(binder, e):
         validity = propagate_nulls(cols)
         return Column(dt.BOOL, data, validity)
 
-    name = "ts_phrase" if e.op == "##" else "ts_query"
+    name = "ts_phrase" if op == "##" else "ts_query"
     return BoundFunc(name, [left, right], dt.BOOL, impl)
 
 

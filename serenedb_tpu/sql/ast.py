@@ -241,6 +241,7 @@ class CreateIndex(Statement):
     using: str = "inverted"           # 'inverted' | 'btree' | 'ivf'
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
+    column_tokenizers: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -258,6 +259,13 @@ class AlterTable(Statement):
     new_name: Optional[str] = None
     if_exists: bool = False          # table-level: ALTER TABLE IF EXISTS
     col_if_exists: bool = False      # column-level: DROP COLUMN IF EXISTS
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateTsDictionary(Statement):
+    name: str
+    options: dict
     if_not_exists: bool = False
 
 

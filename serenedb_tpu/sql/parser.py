@@ -697,12 +697,51 @@ class Parser:
             if self.accept_kw("USING"):
                 using = self.ident().lower()
             self.expect_op("(")
-            cols = [self.ident()]
-            while self.accept_op(","):
-                cols.append(self.ident())
+            cols = []
+            col_toks: dict = {}
+            while True:
+                col = self.ident()
+                cols.append(col)
+                # optional per-column tokenizer/dictionary name — inverted
+                # indexes only (reference: USING inverted(text imdb_en));
+                # ASC/DESC stay syntax errors for other index types
+                if self.peek().kind is T.IDENT and not self.at_op(","):
+                    if using != "inverted":
+                        raise errors.syntax(
+                            f"unexpected {self.peek().value!r} in index "
+                            "column list")
+                    col_toks[col] = self.ident()
+                if not self.accept_op(","):
+                    break
             self.expect_op(")")
             opts = self._with_options()
-            return ast.CreateIndex(idx_name, table, cols, using, ine, opts)
+            return ast.CreateIndex(idx_name, table, cols, using, ine, opts,
+                                   col_toks)
+        if self.at_kw("TEXT"):
+            # CREATE TEXT SEARCH DICTIONARY name (key = value, ...)
+            self.next()
+            self.expect_kw("SEARCH")
+            self.expect_kw("DICTIONARY")
+            ine = self._if_not_exists()
+            name = self.ident()
+            opts: dict = {}
+            if self.accept_op("("):
+                while True:
+                    key = self.ident().lower()
+                    self.expect_op("=")
+                    t = self.next()
+                    if t.kind is T.NUMBER:
+                        opts[key] = float(t.value) if "." in t.value \
+                            else int(t.value)
+                    elif t.kind is T.IDENT and t.value.upper() in \
+                            ("TRUE", "FALSE"):
+                        opts[key] = t.value.upper() == "TRUE"
+                    else:
+                        opts[key] = t.value
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ast.CreateTsDictionary(name, opts, ine)
         if self.accept_kw("ROLE") or self.accept_kw("USER"):
             ine = self._if_not_exists()
             name = self.ident()
@@ -839,6 +878,16 @@ class Parser:
                 self.expect_kw("EXISTS")
                 if_exists = True
             return ast.DropRole(self.ident(), if_exists)
+        elif self.at_kw("TEXT"):
+            self.next()
+            self.expect_kw("SEARCH")
+            self.expect_kw("DICTIONARY")
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            return ast.Drop("tsdictionary", [self.ident()], if_exists,
+                            False)
         else:
             raise errors.unsupported("DROP of that object kind")
         if_exists = False

@@ -266,7 +266,7 @@ def _claim_ts(scan: ScanNode, col_name: str,
         return None, None
     col_idx = scan.columns.index(col_name)
     from ..search.analysis import get_analyzer
-    an = get_analyzer(idx.analyzer_name)
+    an = get_analyzer(idx.analyzer_name_for(col_name))
     claimed: list[QNode] = []
     residual: list[BoundExpr] = []
     for c in _conjuncts(scan.filter):
