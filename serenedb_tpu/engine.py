@@ -98,6 +98,9 @@ class Database(TableResolver):
         self._parquet_cache: dict[str, ParquetTable] = {}
         from .auth import Roles
         self.roles = Roles()
+        #: dictionaries registered by THIS database; released on close so
+        #: process-global analyzer state never leaks across Databases
+        self._tsdict_names: set[str] = set()
         self.store = None
         self.maintenance = None
         if path is not None:
@@ -113,6 +116,10 @@ class Database(TableResolver):
             self.maintenance.stop()
         if self.store is not None:
             self.store.release()
+        from .search.analysis import drop_dictionary
+        for name in self._tsdict_names:
+            drop_dictionary(name)
+        self._tsdict_names.clear()
 
     # -- boot / recovery ---------------------------------------------------
 
@@ -150,6 +157,7 @@ class Database(TableResolver):
         from .search.analysis import register_dictionary
         for dname, dopts in meta.get("tsdicts", {}).items():
             register_dictionary(dname, dopts, replace=True)
+            self._tsdict_names.add(dname.lower())
         for name, sdef in meta.get("sequences", {}).items():
             # resume at the persisted high-water mark: crash skips at most
             # one batch of values, never repeats
@@ -589,14 +597,18 @@ class Connection:
                 raise errors.SqlError(errors.INSUFFICIENT_PRIVILEGE,
                                       "must be superuser to create "
                                       "dictionaries")
-            from .search.analysis import register_dictionary
+            from .search.analysis import (dictionary_exists,
+                                          register_dictionary)
+            existed = dictionary_exists(st.name)
             register_dictionary(st.name, st.options,
                                 if_not_exists=st.if_not_exists)
-            if self.db.store is not None:
-                opts = dict(st.options)
-                self.db.store.update_meta(
-                    lambda m: m.setdefault("tsdicts", {}).__setitem__(
-                        st.name.lower(), opts))
+            if not existed:
+                self.db._tsdict_names.add(st.name.lower())
+                if self.db.store is not None:
+                    opts = dict(st.options)
+                    self.db.store.update_meta(
+                        lambda m: m.setdefault("tsdicts", {}).__setitem__(
+                            st.name.lower(), opts))
             return QueryResult(Batch([], []), "CREATE TEXT SEARCH DICTIONARY")
         if isinstance(st, ast.CreateSequence):
             self.db.create_sequence(".".join(st.name), st.start,
@@ -605,13 +617,30 @@ class Connection:
         if isinstance(st, ast.Drop):
             if st.kind == "tsdictionary":
                 from .search.analysis import drop_dictionary
+                target = st.name[-1].lower()
+                with self.db.lock:
+                    for s in self.db.schemas.values():
+                        for t in s.tables.values():
+                            for iname, idx in getattr(t, "indexes",
+                                                      {}).items():
+                                names = {getattr(idx, "analyzer_name",
+                                                 "")} | set(
+                                    (getattr(idx, "options", {}) or {})
+                                    .get("column_tokenizers", {}).values())
+                                if target in {n.lower() for n in names}:
+                                    raise errors.SqlError(
+                                        "2BP01",
+                                        f'cannot drop text search '
+                                        f'dictionary "{st.name[-1]}" '
+                                        f'because index "{iname}" depends '
+                                        "on it")
                 if not drop_dictionary(st.name[-1]) and not st.if_exists:
                     raise errors.SqlError(
                         errors.UNDEFINED_OBJECT,
                         f'text search dictionary "{st.name[-1]}" does '
                         "not exist")
+                self.db._tsdict_names.discard(target)
                 if self.db.store is not None:
-                    target = st.name[-1].lower()
                     self.db.store.update_meta(
                         lambda m: m.setdefault("tsdicts", {}).pop(
                             target, None))
@@ -761,10 +790,8 @@ class Connection:
         from .search.index import build_index_for_table
         options = dict(st.options)
         if st.column_tokenizers:
-            # per-column dictionary names (single-column indexes use it as
-            # THE tokenizer; reference: USING inverted(text imdb_en))
-            options.setdefault(
-                "tokenizer", next(iter(st.column_tokenizers.values())))
+            # per-column dictionary names; columns WITHOUT one keep the
+            # index default ('text' unless WITH tokenizer=... says else)
             options["column_tokenizers"] = dict(st.column_tokenizers)
         with _progress.track("CREATE INDEX", provider.row_count()):
             provider.indexes[idx_name] = build_index_for_table(

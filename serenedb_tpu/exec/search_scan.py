@@ -147,7 +147,9 @@ class IvfScanNode(PlanNode):
         if idx is None:
             raise RuntimeError("ivf index disappeared under the plan")
         nprobe = int(ctx.settings.get("sdb_nprobe"))
-        dists, rows = idx.search(self.query_vec[None, :], self.topk, nprobe)
+        rerank = int(ctx.settings.get("sdb_rerank_factor"))
+        dists, rows = idx.search(self.query_vec[None, :], self.topk, nprobe,
+                                 rerank_factor=rerank)
         d, r = dists[0], rows[0]
         keep = np.isfinite(d)
         d, r = d[keep], r[keep]

@@ -115,3 +115,24 @@ def init_centroids(x: np.ndarray, k: int, seed: int = 0) -> np.ndarray:
     else:
         idx = rng.choice(max(n, 1), k, replace=True)
     return np.ascontiguousarray(x[idx], dtype=np.float32)
+
+
+def sq8_quantize(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-dimension affine SQ8: x ≈ lo + (q/255)·(hi−lo), q ∈ uint8
+    (reference: the IVF scalar quantizer, ivf_writer.hpp)."""
+    lo = x.min(axis=0)
+    hi = x.max(axis=0)
+    scale = np.where(hi > lo, hi - lo, 1.0)
+    q = np.clip(np.round((x - lo) / scale * 255.0), 0, 255).astype(np.uint8)
+    return q, lo.astype(np.float32), scale.astype(np.float32)
+
+
+def sq8_dequantize(q: np.ndarray, lo: np.ndarray,
+                   scale: np.ndarray) -> np.ndarray:
+    return (lo + q.astype(np.float32) / 255.0 * scale).astype(np.float32)
+
+
+def sq8_roundtrip(x: np.ndarray) -> np.ndarray:
+    """Quantize+dequantize: the f32 values the device will score with."""
+    q, lo, scale = sq8_quantize(x)
+    return sq8_dequantize(q, lo, scale)

@@ -240,6 +240,10 @@ def register_dictionary(name: str, options: dict,
     return a
 
 
+def dictionary_exists(name: str) -> bool:
+    return name.lower() in _custom
+
+
 def drop_dictionary(name: str) -> bool:
     return _custom.pop(name.lower(), None) is not None
 

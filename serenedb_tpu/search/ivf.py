@@ -51,13 +51,18 @@ class IvfIndex:
     metric: str                 # l2 | ip | cos
     centroids: np.ndarray       # (lists, dim) f32
     codes: jnp.ndarray          # (N_pad,) int32 device
-    vectors: jnp.ndarray        # (N_pad, dim) f32 device
+    vectors: jnp.ndarray        # (N_pad, dim) f32 (or dequant-ready) device
     valid: jnp.ndarray          # (N_pad,) bool device
     num_rows: int
     data_version: int
     using: str = "ivf"
     columns: tuple = ()
     options: dict = None
+    # SQ8 (reference: ivf scalar quantizer + sdb_rerank_factor knob):
+    # HBM holds int8-quantized vectors; originals stay host-side for the
+    # exact rerank of the approximate top candidates
+    quantized: bool = False
+    host_vectors: object = None   # np (N, dim) f32 originals (sq8 only)
 
     def __post_init__(self):
         self.columns = (self.column,)
@@ -65,15 +70,40 @@ class IvfIndex:
             self.options = {}
 
     def search(self, queries: np.ndarray, k: int, nprobe: int,
-               ) -> tuple[np.ndarray, np.ndarray]:
+               rerank_factor: int = 4) -> tuple[np.ndarray, np.ndarray]:
         """Batched: queries (Q, dim) → (distances (Q,k), row indices)."""
         q = jnp.asarray(np.ascontiguousarray(queries, dtype=np.float32))
         nprobe = max(1, min(nprobe, self.lists))
         kk = min(max(k, 1), max(self.num_rows, 1))
+        fetch = min(kk * max(rerank_factor, 1), max(self.num_rows, 1)) \
+            if self.quantized else kk
         d, idx = vops.ivf_topk(q, self.vectors, self.valid,
                                jnp.asarray(self.centroids),
-                               self.codes, kk, nprobe, self.metric)
-        return np.asarray(d), np.asarray(idx)
+                               self.codes, fetch, nprobe, self.metric)
+        d, idx = np.asarray(d), np.asarray(idx)
+        if not self.quantized:
+            return d, idx
+        # exact rerank over the approximate candidates (host originals)
+        out_d = np.full((len(idx), kk), np.inf, dtype=np.float32)
+        out_i = np.zeros((len(idx), kk), dtype=np.int64)
+        for qi in range(len(idx)):
+            cand = idx[qi][np.isfinite(d[qi])]
+            if not len(cand):
+                continue
+            vecs = self.host_vectors[cand]
+            qv = np.asarray(queries[qi], dtype=np.float32)
+            if self.metric == "l2":
+                dd = ((vecs - qv) ** 2).sum(axis=1)
+            elif self.metric == "ip":
+                dd = -(vecs @ qv)
+            else:
+                nv = np.linalg.norm(vecs, axis=1)
+                dd = 1.0 - (vecs @ qv) / np.maximum(
+                    nv * max(np.linalg.norm(qv), 1e-9), 1e-9)
+            order = np.argsort(dd, kind="stable")[:kk]
+            out_d[qi, :len(order)] = dd[order]
+            out_i[qi, :len(order)] = cand[order]
+        return out_d, out_i
 
 
 def build_ivf_index(provider, column: str, options: dict) -> IvfIndex:
@@ -118,6 +148,23 @@ def build_ivf_index(provider, column: str, options: dict) -> IvfIndex:
     codes = np.zeros(len(mat_p), dtype=np.int32)
     codes[:len(mat)] = np.asarray(vops.assign_clusters(
         jnp.asarray(mat), jnp.asarray(centroids)))
+    quant = str(options.get("quantization",
+                            options.get("quantizer", ""))).lower()
+    if quant in ("sq8", "int8"):
+        # per-dim affine SQ8: stats come from VALID rows only (zero padding
+        # must not widen the range and wreck precision); HBM stores the
+        # dequantized f32, originals stay host-side for exact rerank
+        stats_src = mat[valid_arr] if valid_arr.any() else mat[:1]
+        _, lo, scale = vops.sq8_quantize(stats_src)
+        q = np.clip(np.round((mat_p - lo) / scale * 255.0),
+                    0, 255).astype(np.uint8)
+        dq = vops.sq8_dequantize(q, lo, scale)
+        return IvfIndex(
+            column=column, dim=dim, lists=lists, metric=metric,
+            centroids=centroids, codes=jnp.asarray(codes),
+            vectors=jnp.asarray(dq), valid=jnp.asarray(valid_p),
+            num_rows=n, data_version=provider.data_version,
+            options=dict(options), quantized=True, host_vectors=mat)
     return IvfIndex(
         column=column, dim=dim, lists=lists, metric=metric,
         centroids=centroids, codes=jnp.asarray(codes),

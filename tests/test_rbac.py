@@ -175,3 +175,18 @@ def test_grant_on_view_clean_error(db):
     with pytest.raises(SqlError) as e:
         admin.execute("GRANT SELECT ON sv TO bob")
     assert e.value.sqlstate == "42809"
+
+
+def test_dictionary_ddl_superuser_only(db):
+    bob = db.connect()
+    bob.session_role = "bob"
+    bob.current_role = "bob"
+    with pytest.raises(SqlError) as e:
+        bob.execute("CREATE TEXT SEARCH DICTIONARY bobd(template = 'text')")
+    assert e.value.sqlstate == "42501"
+    admin = db.connect()
+    admin.execute("CREATE TEXT SEARCH DICTIONARY dropd(template = 'text')")
+    with pytest.raises(SqlError) as e:
+        bob.execute("DROP TEXT SEARCH DICTIONARY dropd")
+    assert e.value.sqlstate == "42501"
+    admin.execute("DROP TEXT SEARCH DICTIONARY dropd")

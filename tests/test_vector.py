@@ -170,3 +170,33 @@ def test_es_knn_uses_ivf_pushdown(es_srv):
         "knn": {"field": "vec", "query_vector": [0, 0], "k": 6},
         "from": 2, "size": 2})
     assert [h["_id"] for h in body["hits"]["hits"]] == ["2", "3"]
+
+
+def test_sq8_quantized_index_recall_and_rerank():
+    db = Database()
+    c = db.connect()
+    vecs = make_vec_table(c, n=200, d=16, seed=11)
+    c.execute("CREATE INDEX ON vt USING ivf (v) "
+              "WITH (lists = 8, quantization = 'sq8')")
+    c.execute("SET sdb_nprobe = 8")  # full probe: rerank makes it exact
+    hits = 0
+    for qi in range(15):
+        qs = json.dumps([round(float(x), 4) for x in vecs[qi]])
+        got = c.execute(
+            f"SELECT id FROM vt ORDER BY v <-> '{qs}' LIMIT 1").rows()
+        hits += int(got and got[0][0] == qi)
+    assert hits == 15   # exact self-recall via rerank despite quantization
+    # distances are the exact (reranked) values
+    qs = json.dumps([round(float(x), 4) for x in vecs[3]])
+    d0 = c.execute(f"SELECT v <-> '{qs}' FROM vt ORDER BY 1 LIMIT 1"
+                   ).scalar()
+    assert d0 == pytest.approx(0.0, abs=1e-4)
+
+
+def test_sq8_helpers_roundtrip_error_small():
+    from serenedb_tpu.ops.vector import sq8_quantize, sq8_dequantize
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 8)).astype(np.float32)
+    q, lo, scale = sq8_quantize(x)
+    err = np.abs(sq8_dequantize(q, lo, scale) - x).max()
+    assert err <= (scale.max() / 255.0) * 0.51
