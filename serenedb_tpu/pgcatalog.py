@@ -134,6 +134,28 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
     if name == "pg_database":
         return MemTable("pg_database", Batch.from_pydict({
             "oid": [1], "datname": ["serene"], "encoding": [6]}))
+    if name == "sdb_indexes":
+        rows = {"schema": [], "table": [], "index": [], "type": [],
+                "columns": [], "segments": [], "indexed_rows": [],
+                "fresh": []}
+        with db.lock:
+            for sname, s in db.schemas.items():
+                for tname, t in s.tables.items():
+                    for iname, idx in getattr(t, "indexes", {}).items():
+                        rows["schema"].append(sname)
+                        rows["table"].append(tname)
+                        rows["index"].append(iname)
+                        rows["type"].append(idx.using)
+                        rows["columns"].append(",".join(idx.columns))
+                        segs = max((len(ms.segments) for ms in
+                                    getattr(idx, "searchers", {}).values()),
+                                   default=1)
+                        rows["segments"].append(segs)
+                        rows["indexed_rows"].append(
+                            getattr(idx, "indexed_rows", t.row_count()))
+                        rows["fresh"].append(
+                            idx.data_version == t.data_version)
+        return MemTable("sdb_indexes", Batch.from_pydict(rows))
     if name == "sdb_settings":
         names = _settings_registry.names()
         return MemTable("sdb_settings", Batch.from_pydict({
