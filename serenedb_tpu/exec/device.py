@@ -120,12 +120,14 @@ def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
                 v, ok = _sub(env)
                 return ~_as_bool(v), ok
             return fn
-        if name == "is_null":
+        if name in ("is_null", "is_not_null"):
             sub = rec(e.args[0])
+            neg = name == "is_not_null"
 
-            def fn(env, _sub=sub):
+            def fn(env, _sub=sub, _neg=neg):
                 v, ok = _sub(env)
-                return ~_m(ok), True
+                m = _m(ok)
+                return (m if _neg else ~m), True
             return fn
         if name == "cast":
             sub = rec(e.args[0])

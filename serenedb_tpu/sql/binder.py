@@ -141,7 +141,9 @@ class ExprBinder:
                 c = cols[0]
                 data = c.valid_mask() if _neg else ~c.valid_mask()
                 return Column(dt.BOOL, data)
-            return BoundFunc("is_null", [arg], dt.BOOL, impl)
+            # the name carries the negation: the device compiler keys on it
+            return BoundFunc("is_not_null" if neg else "is_null",
+                             [arg], dt.BOOL, impl)
         if isinstance(e, ast.InList):
             return self._bind_in(e)
         if isinstance(e, ast.Between):

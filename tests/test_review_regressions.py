@@ -74,3 +74,22 @@ def test_cast_rounds_half_away_from_zero():
     assert c.execute("SELECT CAST(1.5 AS INT)").scalar() == 2
     assert c.execute("SELECT CAST(2.5 AS INT)").scalar() == 3
     assert c.execute("SELECT CAST(-0.5 AS INT)").scalar() == -1
+
+
+def test_device_is_not_null_predicate():
+    # fuzz-found: the binder named IS NULL and IS NOT NULL identically, so
+    # the device compiler always emitted the IS NULL mask
+    c = Database().connect()
+    c.execute("CREATE TABLE nn (a INT, g INT)")
+    c.execute("INSERT INTO nn VALUES (1, 0), (NULL, 0), (2, 1), (NULL, 1),"
+              " (3, 1)")
+    for dev in ("cpu", "tpu"):
+        c.execute(f"SET serene_device = '{dev}'")
+        c.execute("SET serene_device_min_rows = 1")
+        assert c.execute(
+            "SELECT count(*) FROM nn WHERE a IS NOT NULL").scalar() == 3
+        assert c.execute(
+            "SELECT count(*) FROM nn WHERE a IS NULL").scalar() == 2
+        rows = c.execute("SELECT g, sum(a) FROM nn WHERE a IS NOT NULL "
+                         "GROUP BY g ORDER BY g").rows()
+        assert rows == [(0, 1), (1, 5)]
