@@ -483,6 +483,69 @@ class EsApi:
             v["primaries"]["docs"]["count"] for v in out.values())}}},
             "indices": out}
 
+    def msearch(self, body: str, default_index: Optional[str] = None) -> dict:
+        """_msearch: ndjson header/body pairs. Per-item errors are inline
+        (ES semantics: a bad item never fails the whole request). Reference
+        analog: the multi-search REST action the bulk/_msearch clients use."""
+        # keep line positions: an EMPTY header line is valid ES syntax
+        # ("use defaults"), so blanks must not be stripped before pairing
+        lines = body.split("\n")
+        # pop only the empty element from the terminal newline — a blank
+        # line elsewhere is an empty header (valid) or empty body (error)
+        if lines and not lines[-1].strip():
+            lines.pop()
+        if len(lines) % 2:
+            raise EsError(400, "parsing_exception",
+                          "_msearch body must be header/body line pairs")
+        responses = []
+        for i in range(0, len(lines), 2):
+            try:
+                header = json.loads(lines[i]) if lines[i].strip() else {}
+                if not lines[i + 1].strip():
+                    raise EsError(400, "parsing_exception",
+                                  "_msearch search body must not be empty")
+                query = json.loads(lines[i + 1])
+                if not isinstance(header, dict) or not isinstance(query, dict):
+                    raise EsError(400, "parsing_exception",
+                                  "_msearch lines must be JSON objects")
+                index = header.get("index", default_index)
+                if not index:
+                    raise EsError(400, "illegal_argument_exception",
+                                  "no index specified for _msearch item")
+                if isinstance(index, list):
+                    if len(index) != 1:
+                        raise EsError(400, "illegal_argument_exception",
+                                      "multi-index _msearch items are not "
+                                      "supported")
+                    index = index[0]
+                responses.append({**self.search(str(index), query),
+                                  "status": 200})
+            except json.JSONDecodeError as e:
+                responses.append({"error": {
+                    "type": "parsing_exception",
+                    "reason": f"invalid JSON: {e}"}, "status": 400})
+            except EsError as e:
+                responses.append({"error": e.body()["error"],
+                                  "status": e.status})
+            except errors.SqlError as e:
+                responses.append({"error": {
+                    "type": "sql_exception", "reason": e.message,
+                    "sqlstate": e.sqlstate}, "status": 400})
+        return {"took": 1, "responses": responses}
+
+    def cat_health(self) -> list[dict]:
+        h = self.cluster_health()
+        return [{"cluster": h["cluster_name"], "status": h["status"],
+                 "node.total": str(h["number_of_nodes"]),
+                 "shards": str(h["active_shards"]),
+                 "unassign": str(h["unassigned_shards"])}]
+
+    def cat_count(self, index: Optional[str] = None) -> list[dict]:
+        if index is not None:
+            return [{"count": str(self._table(index).row_count())}]
+        total = sum(int(r["docs.count"]) for r in self.cat_indices())
+        return [{"count": str(total)}]
+
     def cat_indices(self) -> list[dict]:
         out = []
         with self.db.lock:

@@ -84,15 +84,32 @@ class Handler(BaseHTTPRequestHandler):
         if p[0] == "_cluster" and len(p) > 1 and p[1] == "health":
             self._send(200, es.cluster_health())
             return
-        if p[0] == "_cat" and len(p) > 1 and p[1] == "indices":
-            rows = es.cat_indices()
+        if p[0] == "_cat" and len(p) > 1:
+            if p[1] == "indices":
+                rows = es.cat_indices()
+            elif p[1] == "health":
+                rows = es.cat_health()
+            elif p[1] == "count":
+                rows = es.cat_count(p[2] if len(p) > 2 else None)
+            else:
+                raise EsError(400, "illegal_argument_exception",
+                              f"unknown _cat endpoint [{p[1]}]")
             if "format" in q and q["format"][0] == "json":
                 self._send(200, rows)
             else:
-                text = "\n".join(
-                    f"{r['health']} {r['status']} {r['index']} "
-                    f"{r['docs.count']}" for r in rows) + "\n"
+                if p[1] == "indices":
+                    # fixed 4-column layout — positional consumers rely on
+                    # docs.count being field 4
+                    text = "\n".join(
+                        f"{r['health']} {r['status']} {r['index']} "
+                        f"{r['docs.count']}" for r in rows) + "\n"
+                else:
+                    text = "\n".join(" ".join(str(v) for v in r.values())
+                                     for r in rows) + "\n"
                 self._send(200, text, "text/plain")
+            return
+        if p[0] == "_msearch" and method == "POST":
+            self._send(200, es.msearch(self._body()))
             return
         if p[0] == "_bulk" and method == "POST":
             self._send(200, es.bulk(self._body()))
@@ -176,6 +193,9 @@ class Handler(BaseHTTPRequestHandler):
             return
         if verb == "_mget" and method == "POST":
             self._send(200, es.mget(index, self._json_body() or {}))
+            return
+        if verb == "_msearch" and method == "POST":
+            self._send(200, es.msearch(self._body(), default_index=index))
             return
         if verb == "_stats":
             self._send(200, es.stats(index))

@@ -321,3 +321,81 @@ def test_scroll_delete_list_form_and_refresh(srv):
     status, body = req(srv, "DELETE", "/_search/scroll",
                        {"scroll_id": [sid]})
     assert body["succeeded"] is True and body["num_freed"] == 1
+
+
+def test_msearch(srv):
+    for i, txt in enumerate(["quick brown fox", "lazy dog", "quick wit"]):
+        req(srv, "PUT", f"/ms/_doc/{i}", {"body": txt})
+    nd = "\n".join([
+        json.dumps({"index": "ms"}),
+        json.dumps({"query": {"match": {"body": "quick"}}}),
+        json.dumps({}),
+        json.dumps({"query": {"match": {"body": "dog"}}, "size": 1}),
+        json.dumps({"index": "nope"}),
+        json.dumps({"query": {"match_all": {}}}),
+    ]) + "\n"
+    status, body = req(srv, "POST", "/ms/_msearch", nd, raw=True)
+    assert status == 200
+    rs = body["responses"]
+    assert len(rs) == 3
+    assert rs[0]["status"] == 200
+    assert rs[0]["hits"]["total"]["value"] == 2
+    assert rs[1]["hits"]["total"]["value"] == 1
+    assert len(rs[1]["hits"]["hits"]) == 1
+    # bad index fails only its own item
+    assert rs[2]["status"] == 404 and "error" in rs[2]
+
+    # top-level _msearch requires index per item
+    nd = json.dumps({}) + "\n" + json.dumps({"query": {"match_all": {}}}) \
+        + "\n"
+    status, body = req(srv, "POST", "/_msearch", nd, raw=True)
+    assert status == 200
+    assert body["responses"][0]["status"] == 400
+
+    # odd line count is a request-level error
+    status, body = req(srv, "POST", "/_msearch",
+                       json.dumps({"index": "ms"}) + "\n", raw=True)
+    assert status == 400
+
+
+def test_cat_health_and_count(srv):
+    status, body = req(srv, "GET", "/_cat/health?format=json")
+    assert status == 200 and body[0]["status"] == "green"
+    status, body = req(srv, "GET", "/_cat/count/ms?format=json")
+    assert status == 200 and body[0]["count"] == "3"
+    status, body = req(srv, "GET", "/_cat/count?format=json")
+    assert status == 200 and int(body[0]["count"]) >= 3
+    status, body = req(srv, "GET", "/_cat/count/doesnotexist?format=json")
+    assert status == 404
+    status, body = req(srv, "GET", "/_cat/health")
+    assert status == 200 and "green" in body
+    status, body = req(srv, "GET", "/_cat/nosuch")
+    assert status == 400
+
+
+def test_msearch_empty_header_line(srv):
+    # ES allows a blank header line meaning "defaults" — pairing must hold
+    nd = "\n" + json.dumps({"query": {"match": {"body": "quick"}}}) + "\n"
+    status, body = req(srv, "POST", "/ms/_msearch", nd, raw=True)
+    assert status == 200
+    assert body["responses"][0]["hits"]["total"]["value"] == 2
+    # blank header item mixed with an explicit-index item
+    nd = "\n" + json.dumps({"query": {"match_all": {}}}) + "\n" + \
+        json.dumps({"index": "ms"}) + "\n" + \
+        json.dumps({"query": {"match": {"body": "dog"}}}) + "\n"
+    status, body = req(srv, "POST", "/ms/_msearch", nd, raw=True)
+    rs = body["responses"]
+    assert rs[0]["hits"]["total"]["value"] == 3
+    assert rs[1]["hits"]["total"]["value"] == 1
+    # blank BODY line is a per-item parse error, not mis-pairing
+    nd = json.dumps({"index": "ms"}) + "\n\n"
+    status, body = req(srv, "POST", "/ms/_msearch", nd + nd, raw=True)
+    assert status == 200
+    assert all(r["status"] == 400 for r in body["responses"])
+
+
+def test_cat_indices_text_four_columns(srv):
+    status, body = req(srv, "GET", "/_cat/indices")
+    assert status == 200
+    line = next(ln for ln in body.splitlines() if " ms " in f" {ln} ")
+    assert line.split() == ["green", "open", "ms", "3"]
