@@ -34,6 +34,7 @@ FEATURE_NOT_SUPPORTED = "0A000"
 INSUFFICIENT_PRIVILEGE = "42501"
 UNDEFINED_OBJECT = "42704"
 IN_FAILED_TRANSACTION = "25P02"
+INVALID_REGULAR_EXPRESSION = "2201B"
 
 
 def syntax(msg: str) -> SqlError:

@@ -7,15 +7,17 @@ computed by re-analyzing the row's text against the query (SURVEY.md §2.5
 
 from __future__ import annotations
 
-from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix,
+from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix, QRegex,
                     QTerm, edit_distance_at_most, parse_query)
 
 
-def _positive_terms(node: QNode) -> tuple[set[str], set[str], list]:
-    """(exact terms, prefixes, fuzzy specs) contributing to highlights."""
+def _positive_terms(node: QNode) -> tuple[set[str], set[str], list, list]:
+    """(exact terms, prefixes, fuzzy specs, regexes) contributing to
+    highlights."""
     terms: set[str] = set()
     prefixes: set[str] = set()
     fuzzies: list[tuple[str, int]] = []
+    regexes: list[QRegex] = []
 
     def rec(nd):
         if isinstance(nd, QTerm):
@@ -26,27 +28,31 @@ def _positive_terms(node: QNode) -> tuple[set[str], set[str], list]:
             prefixes.add(nd.prefix)
         elif isinstance(nd, QFuzzy):
             fuzzies.append((nd.term, nd.max_edits))
+        elif isinstance(nd, QRegex):
+            regexes.append(nd)
         elif isinstance(nd, (QAnd, QOr)):
             for a in nd.args:
                 rec(a)
         # QNot: negated terms never highlight
     rec(node)
-    return terms, prefixes, fuzzies
+    return terms, prefixes, fuzzies, regexes
 
 
-def token_matches(term: str, terms: set, prefixes: set, fuzzies: list) -> bool:
+def token_matches(term: str, terms: set, prefixes: set, fuzzies: list,
+                  regexes: list = ()) -> bool:
     return term in terms or \
         any(term.startswith(p) for p in prefixes) or \
-        any(edit_distance_at_most(term, f, k) for f, k in fuzzies)
+        any(edit_distance_at_most(term, f, k) for f, k in fuzzies) or \
+        any(r.matches(term) for r in regexes)
 
 
 def match_offsets(analyzer, text: str, query: str) -> list[list[int]]:
     """[[start, end], ...] character ranges of matching tokens."""
     node = parse_query(query, analyzer)
-    terms, prefixes, fuzzies = _positive_terms(node)
+    terms, prefixes, fuzzies, regexes = _positive_terms(node)
     out = []
     for tok in analyzer.tokenize(text):
-        if token_matches(tok.term, terms, prefixes, fuzzies):
+        if token_matches(tok.term, terms, prefixes, fuzzies, regexes):
             out.append([tok.start, tok.end])
     return out
 

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import errors
 from .analysis import default_analyzer
+from .regexp import RegexpError, compile_regexp
 
 
 def match_phrase_brute(texts: np.ndarray, phrases: np.ndarray) -> np.ndarray:
@@ -88,6 +90,26 @@ class QFuzzy(QNode):
         self.max_edits = max_edits
 
 
+class QRegex(QNode):
+    """`/pattern/` — anchored full-term regex over analyzed terms
+    (reference: the by_regexp filter, libs/iresearch/search/regexp_filter;
+    Lucene regexp semantics: the pattern must match the whole term)."""
+
+    def __init__(self, pattern: str, case_fold: bool = False):
+        self.pattern = pattern
+        try:
+            # linear-time NFA, never Python `re`: user patterns run against
+            # whole term dictionaries, so backtracking blowup = query DoS
+            self.compiled = compile_regexp(pattern, case_fold)
+        except RegexpError as e:
+            raise errors.SqlError(
+                errors.INVALID_REGULAR_EXPRESSION,
+                f"invalid regular expression in query: {e}")
+
+    def matches(self, term: str) -> bool:
+        return self.compiled.fullmatch(term)
+
+
 def parse_query(q: str, analyzer=None) -> QNode:
     """`a & b`, `a | b`, `!a`, `"a phrase"`, `pre*`, parens. Bare terms
     separated by whitespace are AND-ed (to_tsquery-ish)."""
@@ -112,6 +134,14 @@ def _qlex(q: str) -> list[str]:
             j = len(q) if j < 0 else j
             out.append('"' + q[i + 1:j])
             i = j + 1
+        elif c == "/":
+            # scan for the closing '/', honoring backslash escapes so
+            # patterns may contain literal slashes (`/etc\/[a-z]+/`)
+            j = i + 1
+            while j < len(q) and q[j] != "/":
+                j += 2 if q[j] == "\\" and j + 1 < len(q) else 1
+            out.append("/" + q[i + 1:j] + "/")
+            i = j + 1
         else:
             j = i
             while j < len(q) and not q[j].isspace() and q[j] not in "&|!()":
@@ -119,6 +149,17 @@ def _qlex(q: str) -> list[str]:
             out.append(q[i:j])
             i = j
     return out
+
+
+def _folds_case(an) -> bool:
+    """Does this analyzer lowercase its terms? Probed (and memoized on the
+    analyzer) so regex literals fold exactly when bare terms do."""
+    cached = getattr(an, "_folds_case", None)
+    if cached is None:
+        toks = an.terms("AB")
+        cached = an._folds_case = bool(toks) and \
+            all(t == t.lower() for t in toks)
+    return cached
 
 
 def _parse_or(toks, an):
@@ -159,6 +200,8 @@ def _parse_unary(toks, an):
     if t.startswith('"'):
         terms = [tok.term for tok in an.tokenize(t[1:])]
         return QPhrase(terms), toks[1:]
+    if t.startswith("/") and t.endswith("/") and len(t) > 1:
+        return QRegex(t[1:-1], case_fold=_folds_case(an)), toks[1:]
     if t.endswith("*") and len(t) > 1:
         base = t[:-1].lower()
         return QPrefix(base), toks[1:]
@@ -199,6 +242,8 @@ def eval_query_on_text(node: QNode, an, text: str) -> bool:
         if isinstance(nd, QFuzzy):
             return any(edit_distance_at_most(t, nd.term, nd.max_edits)
                        for t in terms)
+        if isinstance(nd, QRegex):
+            return any(nd.matches(t) for t in terms)
         return False
     return ev(node)
 

@@ -1,0 +1,351 @@
+"""Linear-time regular expression matching (Thompson NFA simulation).
+
+Reference analog: the by_regexp filter's automaton over the term dictionary
+(libs/iresearch/search/regexp_filter — backed by a linear-time DFA/NFA, not
+a backtracking engine). User-supplied patterns run against every term in
+the dictionary, so matching must be O(len(term) * states): a backtracking
+engine (Python `re`) would allow catastrophic-backtracking DoS via patterns
+like `(a+)+c`.
+
+Supported syntax (Lucene-regexp-lite): literals, `.`, `[...]` classes with
+ranges and `^` negation, `\\d \\w \\s` (+ uppercase complements), `\\x`
+literal escapes, `* + ?` and `{m}`/`{m,}`/`{m,n}` quantifiers, `|`
+alternation, `(...)` grouping. Matching is anchored (fullmatch), as in
+Lucene.
+"""
+
+from __future__ import annotations
+
+MAX_STATES = 10_000
+MAX_REPEAT = 256
+
+_CLASS_SHORTHAND = {
+    "d": [("0", "9")],
+    "w": [("a", "z"), ("A", "Z"), ("0", "9"), ("_", "_")],
+    "s": [(" ", " "), ("\t", "\t"), ("\n", "\n"), ("\r", "\r"),
+          ("\f", "\f"), ("\v", "\v")],
+}
+
+
+class RegexpError(ValueError):
+    pass
+
+
+# -- pattern AST ------------------------------------------------------------
+
+class _Alt:
+    def __init__(self, branches):
+        self.branches = branches        # list of lists of (atom, lo, hi)
+
+
+class _Char:
+    def __init__(self, c):
+        self.c = c
+
+
+class _Dot:
+    pass
+
+
+class _Class:
+    def __init__(self, ranges, negated):
+        self.ranges = ranges            # list of (lo_char, hi_char)
+        self.negated = negated
+
+
+class _Parser:
+    def __init__(self, pat: str):
+        self.pat = pat
+        self.i = 0
+
+    def error(self, msg: str):
+        raise RegexpError(f"{msg} at position {self.i}")
+
+    def peek(self):
+        return self.pat[self.i] if self.i < len(self.pat) else None
+
+    def parse(self) -> _Alt:
+        node = self.parse_alt()
+        if self.peek() is not None:
+            self.error(f"unexpected {self.peek()!r}")
+        return node
+
+    def parse_alt(self) -> _Alt:
+        branches = [self.parse_concat()]
+        while self.peek() == "|":
+            self.i += 1
+            branches.append(self.parse_concat())
+        return _Alt(branches)
+
+    def parse_concat(self) -> list:
+        out = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                return out
+            atom = self.parse_atom()
+            lo, hi = self.parse_quantifier()
+            out.append((atom, lo, hi))
+
+    def parse_atom(self):
+        c = self.peek()
+        if c == "(":
+            self.i += 1
+            inner = self.parse_alt()
+            if self.peek() != ")":
+                self.error("missing closing parenthesis")
+            self.i += 1
+            return inner
+        if c == "[":
+            return self.parse_class()
+        if c == ".":
+            self.i += 1
+            return _Dot()
+        if c == "\\":
+            self.i += 1
+            e = self.peek()
+            if e is None:
+                self.error("trailing backslash")
+            self.i += 1
+            if e.lower() in _CLASS_SHORTHAND:
+                return _Class(_CLASS_SHORTHAND[e.lower()], e.isupper())
+            return _Char(e)
+        if c in "*+?{":
+            self.error(f"quantifier {c!r} with nothing to repeat")
+        self.i += 1
+        return _Char(c)
+
+    def parse_class(self) -> _Class:
+        self.i += 1                     # consume '['
+        negated = False
+        if self.peek() == "^":
+            negated = True
+            self.i += 1
+        ranges = []
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                self.error("unterminated character set")
+            if c == "]" and not first:
+                self.i += 1
+                return _Class(ranges, negated)
+            first = False
+            if c == "\\":
+                self.i += 1
+                e = self.peek()
+                if e is None:
+                    self.error("trailing backslash in character set")
+                self.i += 1
+                if e.lower() in _CLASS_SHORTHAND:
+                    if e.isupper():
+                        self.error("negated shorthand in character set")
+                    ranges.extend(_CLASS_SHORTHAND[e.lower()])
+                    continue
+                c = e
+            else:
+                self.i += 1
+            if self.peek() == "-" and self.i + 1 < len(self.pat) and \
+                    self.pat[self.i + 1] != "]":
+                self.i += 1
+                hi = self.peek()
+                if hi == "\\":
+                    self.i += 1
+                    hi = self.peek()
+                if hi is None:
+                    self.error("unterminated range")
+                self.i += 1
+                if hi < c:
+                    self.error(f"bad character range {c}-{hi}")
+                ranges.append((c, hi))
+            else:
+                ranges.append((c, c))
+
+    def parse_quantifier(self) -> tuple[int, int]:
+        """(lo, hi); hi = -1 means unbounded. Default (1, 1)."""
+        c = self.peek()
+        if c == "*":
+            self.i += 1
+            return 0, -1
+        if c == "+":
+            self.i += 1
+            return 1, -1
+        if c == "?":
+            self.i += 1
+            return 0, 1
+        if c == "{":
+            start = self.i
+            self.i += 1
+            digits = ""
+            while self.peek() and self.peek().isdigit():
+                digits += self.peek()
+                self.i += 1
+            if not digits:
+                self.error("bad repetition count")
+            lo = int(digits)
+            hi = lo
+            if self.peek() == ",":
+                self.i += 1
+                digits = ""
+                while self.peek() and self.peek().isdigit():
+                    digits += self.peek()
+                    self.i += 1
+                hi = int(digits) if digits else -1
+            if self.peek() != "}":
+                self.i = start
+                self.error("unterminated repetition")
+            self.i += 1
+            if hi != -1 and hi < lo:
+                self.i = start
+                self.error(f"bad repetition range {{{lo},{hi}}}")
+            if lo > MAX_REPEAT or hi > MAX_REPEAT:
+                self.i = start
+                self.error(f"repetition count over {MAX_REPEAT}")
+            return lo, hi
+        return 1, 1
+
+
+# -- NFA construction (epsilon transitions; start/end per fragment) ---------
+
+class _State:
+    __slots__ = ("eps", "edges")
+
+    def __init__(self):
+        self.eps = []                   # epsilon-reachable states
+        self.edges = []                 # (matcher_atom, target)
+
+
+class Regexp:
+    """Compiled pattern. `fullmatch(s)` is O(len(s) * states).
+
+    case_fold: lowercase literal atoms and plain class ranges so patterns
+    behave like analyzer-folded bare terms (`/Alpha.*/` matches the stored
+    term `alpha…` under a lowercasing analyzer). Negated classes and
+    shorthand escapes are left verbatim — folding them would change their
+    meaning."""
+
+    def __init__(self, pattern: str, case_fold: bool = False):
+        self.pattern = pattern
+        ast = _Parser(pattern).parse()
+        if case_fold:
+            _fold_ast(ast)
+        self._n_states = 0
+        self.start, self.end = self._build_alt(ast)
+        self.literal_prefix = _literal_prefix(ast)
+
+    def _new_state(self) -> _State:
+        self._n_states += 1
+        if self._n_states > MAX_STATES:
+            raise RegexpError("pattern too large")
+        return _State()
+
+    def _build_alt(self, node: _Alt) -> tuple[_State, _State]:
+        s, e = self._new_state(), self._new_state()
+        for branch in node.branches:
+            bs, be = self._build_concat(branch)
+            s.eps.append(bs)
+            be.eps.append(e)
+        return s, e
+
+    def _build_concat(self, factors: list) -> tuple[_State, _State]:
+        s = self._new_state()
+        cur = s
+        for atom, lo, hi in factors:
+            fs, fe = self._build_repeat(atom, lo, hi)
+            cur.eps.append(fs)
+            cur = fe
+        return s, cur
+
+    def _build_repeat(self, atom, lo: int, hi: int) -> tuple[_State, _State]:
+        s = self._new_state()
+        cur = s
+        for _ in range(lo):             # mandatory copies
+            fs, fe = self._build_atom(atom)
+            cur.eps.append(fs)
+            cur = fe
+        if hi == -1:                    # star over one more copy
+            fs, fe = self._build_atom(atom)
+            cur.eps.append(fs)
+            fe.eps.append(fs)
+            end = self._new_state()
+            cur.eps.append(end)
+            fe.eps.append(end)
+            return s, end
+        end = self._new_state()
+        for _ in range(hi - lo):        # optional copies
+            fs, fe = self._build_atom(atom)
+            cur.eps.append(fs)
+            cur.eps.append(end)
+            cur = fe
+        cur.eps.append(end)
+        return s, end
+
+    def _build_atom(self, atom) -> tuple[_State, _State]:
+        if isinstance(atom, _Alt):
+            return self._build_alt(atom)
+        s, e = self._new_state(), self._new_state()
+        s.edges.append((atom, e))
+        return s, e
+
+    @staticmethod
+    def _atom_matches(atom, ch: str) -> bool:
+        if isinstance(atom, _Char):
+            return ch == atom.c
+        if isinstance(atom, _Dot):
+            return True
+        hit = any(lo <= ch <= hi for lo, hi in atom.ranges)
+        return hit != atom.negated
+
+    @staticmethod
+    def _closure(states: set) -> set:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            st = stack.pop()
+            for nxt in st.eps:
+                if nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
+        return out
+
+    def fullmatch(self, s: str) -> bool:
+        cur = self._closure({self.start})
+        for ch in s:
+            nxt = {t for st in cur for atom, t in st.edges
+                   if self._atom_matches(atom, ch)}
+            if not nxt:
+                return False
+            cur = self._closure(nxt)
+        return self.end in cur
+
+
+def _fold_ast(node):
+    if isinstance(node, _Alt):
+        for branch in node.branches:
+            for atom, _, _ in branch:
+                _fold_ast(atom)
+    elif isinstance(node, _Char):
+        node.c = node.c.lower()
+    elif isinstance(node, _Class) and not node.negated:
+        extra = [(lo.lower(), hi.lower()) for lo, hi in node.ranges
+                 if (lo.lower(), hi.lower()) != (lo, hi)
+                 and lo.lower() <= hi.lower()]
+        node.ranges.extend(extra)
+
+
+def _literal_prefix(ast: _Alt) -> str:
+    """The mandatory literal prefix every match must start with — used to
+    narrow the sorted-term-dictionary scan. Empty when the pattern starts
+    with anything non-literal."""
+    if len(ast.branches) != 1:
+        return ""
+    out = []
+    for atom, lo, hi in ast.branches[0]:
+        if not isinstance(atom, _Char) or lo != 1 or hi != 1:
+            break
+        out.append(atom.c)
+    return "".join(out)
+
+
+def compile_regexp(pattern: str, case_fold: bool = False) -> Regexp:
+    return Regexp(pattern, case_fold)

@@ -26,7 +26,7 @@ import numpy as np
 from ..columnar.device import pad_len
 from ..ops import bm25 as bm25_ops
 from .analysis import Analyzer
-from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix,
+from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix, QRegex,
                     QTerm, edit_distance_at_most, parse_query)
 from .segment import BLOCK, FieldIndex
 
@@ -61,16 +61,12 @@ class SegmentSearcher:
                 return np.empty(0, dtype=np.int32)
             return self.index.postings(tid)[0]
         if isinstance(node, QPrefix):
-            tids = self.index.prefix_term_ids(node.prefix)
-            if len(tids) == 0:
-                return np.empty(0, dtype=np.int32)
-            parts = [self.index.postings(t)[0] for t in tids]
-            return np.unique(np.concatenate(parts))
+            return self._union_postings(self.index.prefix_term_ids(
+                node.prefix))
         if isinstance(node, QFuzzy):
-            tids = self._fuzzy_term_ids(node)
-            parts = [self.index.postings(t)[0] for t in tids]
-            return np.unique(np.concatenate(parts)) if parts \
-                else np.empty(0, dtype=np.int32)
+            return self._union_postings(self._fuzzy_term_ids(node))
+        if isinstance(node, QRegex):
+            return self._union_postings(self._regex_term_ids(node))
         if isinstance(node, QPhrase):
             return self._eval_phrase(node.terms)
         if isinstance(node, QAnd):
@@ -98,6 +94,13 @@ class SegmentSearcher:
             return np.setdiff1d(np.arange(self.num_docs, dtype=np.int32),
                                 inner, assume_unique=True)
         return np.empty(0, dtype=np.int32)
+
+    def _union_postings(self, tids) -> np.ndarray:
+        """Sorted unique doc ids across the postings of several terms
+        (multi-term leaves: prefix / fuzzy / regex expansions)."""
+        parts = [self.index.postings(t)[0] for t in tids]
+        return np.unique(np.concatenate(parts)) if parts \
+            else np.empty(0, dtype=np.int32)
 
     def _eval_phrase(self, terms: list[str]) -> np.ndarray:
         if not terms:
@@ -155,6 +158,30 @@ class SegmentSearcher:
         cache[key] = out
         return out
 
+    def _regex_term_ids(self, node: QRegex) -> list[int]:
+        """Full-term regex expansion over the term dictionary (reference:
+        by_regexp runs an automaton over the burst trie; here a linear scan
+        of the sorted dictionary — segments are immutable, so memoized)."""
+        cache = getattr(self, "_regex_cache", None)
+        if cache is None:
+            cache = self._regex_cache = {}
+        hit = cache.get(node.pattern)
+        if hit is not None:
+            return hit
+        prefix = node.compiled.literal_prefix
+        if prefix:
+            # every match starts with the pattern's mandatory literal
+            # prefix, so only the contiguous sorted-dictionary band needs
+            # the NFA (mirrors _fuzzy_term_ids' length-band prefilter)
+            cand = self.index.prefix_term_ids(prefix)
+        else:
+            cand = range(len(self.index.terms_str))
+        ts = self.index.terms_str
+        out = [int(tid) for tid in cand
+               if node.compiled.fullmatch(str(ts[tid]))]
+        cache[node.pattern] = out
+        return out
+
     # -- scoring (device) --------------------------------------------------
 
     def scoring_terms(self, node: QNode) -> list[int]:
@@ -176,6 +203,8 @@ class SegmentSearcher:
                            self.index.prefix_term_ids(nd.prefix))
             elif isinstance(nd, QFuzzy):
                 out.extend(self._fuzzy_term_ids(nd))
+            elif isinstance(nd, QRegex):
+                out.extend(self._regex_term_ids(nd))
             elif isinstance(nd, (QAnd, QOr)):
                 for a in nd.args:
                     rec(a)
@@ -199,7 +228,7 @@ class SegmentSearcher:
         require_all = 0
         needs_mask = False
         empty = False
-        if isinstance(node, (QTerm, QPrefix, QFuzzy)):
+        if isinstance(node, (QTerm, QPrefix, QFuzzy, QRegex)):
             pass
         elif isinstance(node, QOr) and all(
                 isinstance(a, QTerm) for a in node.args):

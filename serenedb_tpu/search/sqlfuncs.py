@@ -131,10 +131,10 @@ def bind_function(binder, e):
                 if valid is not None and not valid[i]:
                     out.append("")
                     continue
-                terms, prefixes, fuzzies = parsed(queries[i])
+                terms, prefixes, fuzzies, regexes = parsed(queries[i])
                 spans = [[t.start, t.end] for t in an.tokenize(texts[i])
                          if token_matches(t.term, terms, prefixes,
-                                          fuzzies)]
+                                          fuzzies, regexes)]
                 if _headline:
                     out.append(_hl(an, texts[i], queries[i], spans=spans))
                 else:

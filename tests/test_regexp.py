@@ -1,0 +1,95 @@
+"""The linear-time regexp engine: differential vs Python `re` on safe
+patterns, ReDoS immunity, and error handling."""
+
+import re
+import time
+
+import pytest
+
+from serenedb_tpu.search.regexp import RegexpError, compile_regexp
+
+CASES = [
+    ("abc", ["abc", "ab", "abcd", ""]),
+    ("a.c", ["abc", "axc", "ac", "abbc"]),
+    ("a*", ["", "a", "aaaa", "b", "ab"]),
+    ("a+b", ["ab", "aaab", "b", "a"]),
+    ("ab?c", ["ac", "abc", "abbc"]),
+    ("a{3}", ["aa", "aaa", "aaaa"]),
+    ("a{2,4}", ["a", "aa", "aaa", "aaaa", "aaaaa"]),
+    ("a{2,}", ["a", "aa", "aaaaaa"]),
+    ("(ab)+", ["ab", "abab", "aba", ""]),
+    ("a|bc", ["a", "bc", "b", "abc"]),
+    ("(a|b)*c", ["c", "abbac", "abba"]),
+    ("[abc]+", ["a", "cab", "d", ""]),
+    ("[a-f0-9]+", ["deadbeef", "cafe42", "xyz"]),
+    ("[^a-c]+", ["xyz", "axy", ""]),
+    (r"\d{2,3}", ["1", "12", "123", "1234", "ab"]),
+    (r"\w+", ["hello_1", "a b", ""]),
+    (r"\.x", [".x", "ax"]),
+    (r"a\\b", ["a\\b", "ab"]),
+    (".*serv.*", ["server", "observer", "nope"]),
+    ("rest.*", ["restart", "arrest", "rest"]),
+    ("x(y(z|w))?", ["x", "xyz", "xyw", "xy"]),
+    ("[]a]+", ["]a]", "b"]),
+    ("", ["", "a"]),
+]
+
+
+def test_matches_python_re():
+    for pat, subjects in CASES:
+        ours = compile_regexp(pat)
+        theirs = re.compile(pat)
+        for s in subjects:
+            assert ours.fullmatch(s) == (theirs.fullmatch(s) is not None), \
+                (pat, s)
+
+
+def test_redos_pattern_is_linear():
+    # (a+)+c on a long run of 'a's: exponential for backtracking engines
+    r = compile_regexp("(a+)+c")
+    t0 = time.monotonic()
+    assert not r.fullmatch("a" * 200)
+    assert r.fullmatch("a" * 200 + "c")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_nested_quantifier_blowup_is_linear():
+    r = compile_regexp("(a|a)*b")
+    t0 = time.monotonic()
+    assert not r.fullmatch("a" * 300)
+    assert time.monotonic() - t0 < 2.0
+
+
+@pytest.mark.parametrize("bad", [
+    "[unclosed", "(unclosed", "a{2,1}", "a{", "*a", "+", "a\\",
+    "a{999}",
+])
+def test_bad_patterns_raise(bad):
+    with pytest.raises(RegexpError):
+        compile_regexp(bad)
+
+
+def test_repeat_cap_rejects_state_blowup():
+    with pytest.raises(RegexpError):
+        compile_regexp("(a{100}){100}")
+
+
+def test_case_fold_literals_and_ranges():
+    r = compile_regexp("Alpha.*", case_fold=True)
+    assert r.fullmatch("alphabet")
+    assert not compile_regexp("Alpha.*").fullmatch("alphabet")
+    r = compile_regexp("[A-F]+", case_fold=True)
+    assert r.fullmatch("cafe") and r.fullmatch("CAFE")
+    # negated classes stay verbatim under folding
+    r = compile_regexp("[^A-Z]+", case_fold=True)
+    assert r.fullmatch("abc")
+
+
+def test_literal_prefix_extraction():
+    assert compile_regexp("rest.*").literal_prefix == "rest"
+    assert compile_regexp("abc").literal_prefix == "abc"
+    assert compile_regexp(".*x").literal_prefix == ""
+    assert compile_regexp("a|b").literal_prefix == ""
+    assert compile_regexp("ab(c|d)").literal_prefix == "ab"
+    assert compile_regexp("ab+c").literal_prefix == "a"
+    assert compile_regexp(r"a\.b").literal_prefix == "a.b"

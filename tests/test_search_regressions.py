@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
 
 
 @pytest.fixture
@@ -58,3 +59,84 @@ def test_stream_mode_scores_nonzero(conn):
     for k in rows:
         assert rows[k] == pytest.approx(topk[k], rel=1e-5)
         assert rows[k] > 0.0
+
+
+def test_regex_terms_indexed_matches_brute():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE rx (id INT, body TEXT)")
+    c.execute("INSERT INTO rx VALUES"
+              " (1, 'server restarted cleanly'),"
+              " (2, 'browser rendering issue'),"
+              " (3, 'observer pattern applied'),"
+              " (4, 'totally unrelated words'),"
+              " (5, NULL)")
+    queries = ["/.*server.*/", "/rest.*/", "/[bo]+.*er/",
+               "/rest.*/ & cleanly", "! /.*er.*/"]
+    brute = [c.execute(
+        f"SELECT id FROM rx WHERE body @@ '{q}' ORDER BY id").rows()
+        for q in queries]
+    c.execute("CREATE INDEX ON rx USING inverted (body)")
+    for q, expect in zip(queries, brute):
+        got = c.execute(
+            f"SELECT id FROM rx WHERE body @@ '{q}' ORDER BY id").rows()
+        assert got == expect, (q, got, expect)
+    # sanity on actual values (analyzer stems 'restarted'→'restart')
+    assert c.execute("SELECT id FROM rx WHERE body @@ '/.*server.*/' "
+                     "ORDER BY id").rows() == [(1,), (3,)]
+
+
+def test_regex_invalid_pattern_errors():
+    c = Database().connect()
+    c.execute("CREATE TABLE rxe (body TEXT)")
+    c.execute("INSERT INTO rxe VALUES ('abc')")
+    with pytest.raises(SqlError) as e:
+        c.execute("SELECT count(*) FROM rxe WHERE body @@ '/[unclosed/'")
+    assert e.value.sqlstate == "2201B"
+
+
+def test_regex_headline():
+    c = Database().connect()
+    c.execute("CREATE TABLE rxh (body TEXT)")
+    c.execute("INSERT INTO rxh VALUES ('the server restarted')")
+    assert c.execute(
+        "SELECT ts_headline(body, '/.*start.*/') FROM rxh").scalar() \
+        == "the server <b>restarted</b>"
+
+
+def test_regex_escaped_slash_in_pattern():
+    c = Database().connect()
+    c.execute("CREATE TABLE rxs (body TEXT)")
+    # keyword-style terms containing slashes need \/ inside /pattern/
+    c.execute("CREATE TEXT SEARCH DICTIONARY kw_rx(template = 'keyword')")
+    c.execute("INSERT INTO rxs VALUES ('etc/hosts'), ('etc/passwd'), "
+              "('var/log')")
+    c.execute("CREATE INDEX ON rxs USING inverted (body kw_rx)")
+    rows = c.execute(
+        r"SELECT body FROM rxs WHERE body @@ '/etc\/[a-z]+/' ORDER BY body"
+    ).rows()
+    assert rows == [("etc/hosts",), ("etc/passwd",)]
+    c.execute("DROP TABLE rxs")
+    c.execute("DROP TEXT SEARCH DICTIONARY kw_rx")
+
+
+def test_regex_case_folds_like_bare_terms():
+    # review finding: '/Alpha.*/' silently matched nothing while 'Alpha'
+    # matched — regex literals must fold exactly when the analyzer does
+    c = Database().connect()
+    c.execute("CREATE TABLE rxc (body TEXT)")
+    c.execute("INSERT INTO rxc VALUES ('Alpha beta')")
+    assert c.execute(
+        "SELECT count(*) FROM rxc WHERE body @@ '/Alpha.*/'").scalar() == 1
+    c.execute("CREATE INDEX ON rxc USING inverted (body)")
+    assert c.execute(
+        "SELECT count(*) FROM rxc WHERE body @@ '/Alpha.*/'").scalar() == 1
+    # keyword analyzer preserves case → pattern stays verbatim
+    c.execute("CREATE TEXT SEARCH DICTIONARY kw_c(template = 'keyword')")
+    c.execute("CREATE TABLE rxk (body TEXT)")
+    c.execute("INSERT INTO rxk VALUES ('Alpha'), ('alpha')")
+    c.execute("CREATE INDEX ON rxk USING inverted (body kw_c)")
+    assert c.execute(
+        "SELECT count(*) FROM rxk WHERE body @@ '/Alpha/'").scalar() == 1
+    c.execute("DROP TABLE rxk")
+    c.execute("DROP TEXT SEARCH DICTIONARY kw_c")
