@@ -203,7 +203,9 @@ def _parse_unary(toks, an):
     if t.startswith("/") and t.endswith("/") and len(t) > 1:
         return QRegex(t[1:-1], case_fold=_folds_case(an)), toks[1:]
     if t.endswith("*") and len(t) > 1:
-        base = t[:-1].lower()
+        # fold only when the analyzer folds bare terms: under keyword/
+        # whitespace analyzers stored terms keep their case
+        base = t[:-1].lower() if _folds_case(an) else t[:-1]
         return QPrefix(base), toks[1:]
     if "~" in t and len(t) > 1:
         base, _, edits = t.partition("~")

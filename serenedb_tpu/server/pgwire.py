@@ -66,6 +66,49 @@ def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
     return str(value).encode()
 
 
+# PG binary format epochs: timestamps are µs and dates are days since
+# 2000-01-01, vs our unix-epoch internals
+_PG_EPOCH_US = 946_684_800_000_000
+_PG_EPOCH_DAYS = 10_957
+
+
+def _fmt_for(fmts, i: int) -> int:
+    """Result-format code for column i (PG Bind semantics: none = all
+    text, one = applies to every column, else positional)."""
+    if not fmts:
+        return 0
+    if len(fmts) == 1:
+        return fmts[0]
+    return fmts[i] if i < len(fmts) else 0
+
+
+def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
+    """PG binary-format encoding for result columns (reference:
+    server/pg/serialize.cpp binary send functions). Types without a
+    defined binary send here fall back to their text bytes, matching the
+    OID we report (25/text) for them."""
+    if value is None:
+        return None
+    tid = typ.id
+    if tid is dt.TypeId.BOOL:
+        return b"\x01" if value else b"\x00"
+    if tid in (dt.TypeId.TINYINT, dt.TypeId.SMALLINT):
+        return struct.pack("!h", int(value))
+    if tid is dt.TypeId.INT:
+        return struct.pack("!i", int(value))
+    if tid is dt.TypeId.BIGINT:
+        return struct.pack("!q", int(value))
+    if tid is dt.TypeId.FLOAT:
+        return struct.pack("!f", float(value))
+    if tid is dt.TypeId.DOUBLE:
+        return struct.pack("!d", float(value))
+    if tid is dt.TypeId.TIMESTAMP:
+        return struct.pack("!q", int(value) - _PG_EPOCH_US)
+    if tid is dt.TypeId.DATE:
+        return struct.pack("!i", int(value) - _PG_EPOCH_DAYS)
+    return pg_text(value, typ)
+
+
 class Writer:
     def __init__(self, transport: asyncio.StreamWriter):
         self.t = transport
@@ -97,21 +140,24 @@ class Writer:
     def ready(self, status: bytes):
         self.msg(b"Z", status)
 
-    def row_description(self, names: list[str], types: list[dt.SqlType]):
+    def row_description(self, names: list[str], types: list[dt.SqlType],
+                        fmts: tuple = ()):
         out = [struct.pack("!H", len(names))]
-        for name, t in zip(names, types):
+        for i, (name, t) in enumerate(zip(names, types)):
             oid = _OID.get(t.id, 25)
             out.append(name.encode() + b"\x00")
             out.append(struct.pack("!IHIhih", 0, 0, oid,
-                                   _TYPLEN.get(oid, -1), -1, 0))
+                                   _TYPLEN.get(oid, -1), -1,
+                                   _fmt_for(fmts, i)))
         self.msg(b"T", b"".join(out))
 
-    def data_rows(self, batch: Batch):
+    def data_rows(self, batch: Batch, fmts: tuple = ()):
         types = [c.type for c in batch.columns]
         cols_text = []
-        for col, t in zip(batch.columns, types):
+        for ci, (col, t) in enumerate(zip(batch.columns, types)):
             vals = col.to_pylist()
-            cols_text.append([pg_text(v, t) for v in vals])
+            enc = pg_binary if _fmt_for(fmts, ci) == 1 else pg_text
+            cols_text.append([enc(v, t) for v in vals])
         for i in range(batch.num_rows):
             parts = [struct.pack("!H", len(types))]
             for ci in range(len(types)):
@@ -166,6 +212,7 @@ class Prepared:
 class Portal:
     prepared: Prepared
     params: list
+    result_fmts: tuple = ()    # Bind result-format codes (0 text, 1 binary)
     pending: object = None     # QueryResult with rows not yet sent
     sent: int = 0
 
@@ -397,12 +444,14 @@ class PgSession:
         if self.conn is not None and self.conn.in_txn:
             self.conn.txn_failed = True
 
-    def _send_result(self, res: QueryResult, describe: bool):
+    def _send_result(self, res: QueryResult, describe: bool,
+                     fmts: tuple = ()):
         if res.batch.num_columns:
             if describe:
                 self.w.row_description(
-                    res.batch.names, [c.type for c in res.batch.columns])
-            self.w.data_rows(res.batch)
+                    res.batch.names, [c.type for c in res.batch.columns],
+                    fmts)
+            self.w.data_rows(res.batch, fmts)
         self.w.command_complete(res.command_tag or "OK")
 
     # -- extended protocol -------------------------------------------------
@@ -462,11 +511,27 @@ class PgSession:
                     oid = prep.param_oids[i] if i < len(prep.param_oids) \
                         else 0
                     params.append(_decode_param(raw, fmt, oid))
-            self.portals[portal] = Portal(prep, params)
+            rfmts: tuple = ()
+            if off + 2 <= len(payload):   # tolerate clients omitting it
+                (n_rfmt,) = struct.unpack_from("!H", payload, off)
+                off += 2
+                rfmts = struct.unpack_from(f"!{n_rfmt}h", payload, off)
+            if any(f not in (0, 1) for f in rfmts):
+                raise errors.SqlError(
+                    "08P01", f"invalid result format code "
+                             f"{[f for f in rfmts if f not in (0, 1)][0]}")
+            self.portals[portal] = Portal(prep, params, rfmts)
             self.w.bind_complete()
         except errors.SqlError as e:
             self._note_error()
             self.w.error(e)
+            self.ignore_till_sync = True
+        except Exception as e:
+            # malformed Bind payloads (struct/index errors) must answer
+            # 08P01, not tear the connection down silently
+            self._note_error()
+            self.w.error(errors.SqlError(
+                "08P01", f"malformed Bind message: {e!r}"))
             self.ignore_till_sync = True
         await self.w.flush()
 
@@ -486,21 +551,22 @@ class PgSession:
                 if portal is None:
                     raise errors.SqlError(
                         "34000", f'portal "{name}" does not exist')
-                self._describe_statement(portal.prepared)
+                self._describe_statement(portal.prepared,
+                                         portal.result_fmts)
         except errors.SqlError as e:
             self._note_error()
             self.w.error(e)
             self.ignore_till_sync = True
         await self.w.flush()
 
-    def _describe_statement(self, prep: Prepared):
+    def _describe_statement(self, prep: Prepared, fmts: tuple = ()):
         st = prep.statements[0] if prep.statements else None
         if isinstance(st, (ast.Select, ast.SetOp, ast.ShowStmt,
                            ast.Explain)):
             try:
                 if isinstance(st, (ast.Select, ast.SetOp)):
                     plan = self.conn._plan(st, [None] * prep.n_params)
-                    self.w.row_description(plan.names, plan.types)
+                    self.w.row_description(plan.names, plan.types, fmts)
                     return
             except errors.SqlError:
                 pass
@@ -536,7 +602,7 @@ class PgSession:
                 page = res.batch.slice(portal.sent,
                                        portal.sent + max_rows)
                 portal.sent += max_rows
-                self.w.data_rows(page)
+                self.w.data_rows(page, portal.result_fmts)
                 self.w.msg(b"s")           # PortalSuspended
             else:
                 remainder = res
@@ -544,7 +610,8 @@ class PgSession:
                     from ..engine import QueryResult as _QR
                     remainder = _QR(res.batch.slice(portal.sent, total),
                                     res.command_tag)
-                self._send_result(remainder, describe=False)
+                self._send_result(remainder, describe=False,
+                                  fmts=portal.result_fmts)
                 portal.pending = None
                 portal.sent = 0
         except errors.SqlError as e:

@@ -396,3 +396,120 @@ def test_portal_row_paging_with_suspension(server):
     assert suspended == 2 and complete == 1
     c.query("DROP TABLE pg_page")
     c.close()
+
+
+class TestBinaryResults:
+    def _extended_raw(self, pg, sql, rfmts, params=()):
+        """Parse/Bind(with result formats)/Execute/Sync; raw value bytes."""
+        pg.send(b"P", b"\x00" + sql.encode() + b"\x00" + b"\x00\x00")
+        parts = [b"\x00", b"\x00", struct.pack("!H", 0),
+                 struct.pack("!H", len(params))]
+        for p in params:
+            enc = str(p).encode()
+            parts.append(struct.pack("!i", len(enc)) + enc)
+        parts.append(struct.pack("!H", len(rfmts)))
+        parts.extend(struct.pack("!h", f) for f in rfmts)
+        pg.send(b"B", b"".join(parts))
+        pg.send(b"D", b"P\x00")
+        pg.send(b"E", b"\x00" + struct.pack("!I", 0))
+        pg.send(b"S")
+        rows, errs, desc_fmts = [], [], []
+        while True:
+            kind, payload = pg.read_msg()
+            if kind == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    off = end + 1 + 18
+                    desc_fmts.append(struct.unpack(
+                        "!h", payload[off - 2:off])[0])
+            elif kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln])
+                        off += ln
+                rows.append(row)
+            elif kind == b"E":
+                errs.append(_parse_err(payload))
+            elif kind == b"Z":
+                return rows, errs, desc_fmts
+
+    def test_all_binary(self, server):
+        pg = RawPg(server.port)
+        pg.query("CREATE TABLE bin (b BOOL, i INT, l BIGINT, d DOUBLE, "
+                 "s TEXT)")
+        pg.query("INSERT INTO bin VALUES (true, -7, 5000000000, 2.5, 'hi'),"
+                 " (false, NULL, 1, -0.5, NULL)")
+        rows, errs, fmts = self._extended_raw(
+            pg, "SELECT b, i, l, d, s FROM bin ORDER BY i NULLS LAST", [1])
+        assert not errs and fmts == [1, 1, 1, 1, 1]
+        assert rows[0][0] == b"\x01"
+        assert struct.unpack("!i", rows[0][1])[0] == -7
+        assert struct.unpack("!q", rows[0][2])[0] == 5000000000
+        assert struct.unpack("!d", rows[0][3])[0] == 2.5
+        assert rows[0][4] == b"hi"
+        assert rows[1][0] == b"\x00" and rows[1][1] is None \
+            and rows[1][4] is None
+        pg.close()
+
+    def test_per_column_formats(self, server):
+        pg = RawPg(server.port)
+        rows, errs, fmts = self._extended_raw(
+            pg, "SELECT 300, 'x', 1.5", [1, 0, 1])
+        assert not errs and fmts == [1, 0, 1]
+        assert struct.unpack("!i", rows[0][0])[0] == 300
+        assert rows[0][1] == b"x"
+        assert struct.unpack("!d", rows[0][2])[0] == 1.5
+        pg.close()
+
+    def test_binary_timestamp_date(self, server):
+        pg = RawPg(server.port)
+        rows, errs, _ = self._extended_raw(
+            pg, "SELECT TIMESTAMP '2000-01-01 00:00:01', "
+                "DATE '2000-01-02'", [1])
+        assert not errs
+        assert struct.unpack("!q", rows[0][0])[0] == 1_000_000
+        assert struct.unpack("!i", rows[0][1])[0] == 1
+        pg.close()
+
+    def test_invalid_format_code(self, server):
+        pg = RawPg(server.port)
+        rows, errs, _ = self._extended_raw(pg, "SELECT 1", [7])
+        assert errs and errs[0]["C"] == "08P01"
+        pg.close()
+
+    def test_text_default_unchanged(self, server):
+        pg = RawPg(server.port)
+        rows, errs, fmts = self._extended_raw(pg, "SELECT 42", [])
+        assert not errs and fmts == [0] and rows[0][0] == b"42"
+        pg.close()
+
+
+def test_truncated_bind_result_formats(server):
+    # declared 3 format codes, sent 1: must answer 08P01, not kill the
+    # session
+    pg = RawPg(server.port)
+    pg.send(b"P", b"\x00SELECT 1\x00\x00\x00")
+    body = (b"\x00\x00" + struct.pack("!H", 0) + struct.pack("!H", 0) +
+            struct.pack("!H", 3) + struct.pack("!h", 1))
+    pg.send(b"B", body)
+    pg.send(b"S")
+    errs = []
+    while True:
+        kind, payload = pg.read_msg()
+        if kind == b"E":
+            errs.append(_parse_err(payload))
+        elif kind == b"Z":
+            break
+    assert errs and errs[0]["C"] == "08P01"
+    cols, rows, tags, qerrs = pg.query("SELECT 7")
+    assert rows == [("7",)] and not qerrs
+    pg.close()

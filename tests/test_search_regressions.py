@@ -140,3 +140,21 @@ def test_regex_case_folds_like_bare_terms():
         "SELECT count(*) FROM rxk WHERE body @@ '/Alpha/'").scalar() == 1
     c.execute("DROP TABLE rxk")
     c.execute("DROP TEXT SEARCH DICTIONARY kw_c")
+
+
+def test_prefix_respects_case_preserving_analyzer():
+    # review finding: prefixes were unconditionally lowercased, silently
+    # matching nothing under keyword/whitespace analyzers
+    c = Database().connect()
+    c.execute("CREATE TEXT SEARCH DICTIONARY kw_p(template = 'keyword')")
+    c.execute("CREATE TABLE pfx (body TEXT)")
+    c.execute("INSERT INTO pfx VALUES ('Alpha'), ('alpine')")
+    # the dictionary binds via the index: only the indexed path has
+    # case-preserving terms (un-indexed @@ uses the default text analyzer)
+    c.execute("CREATE INDEX ON pfx USING inverted (body kw_p)")
+    assert c.execute(
+        "SELECT count(*) FROM pfx WHERE body @@ 'Alph*'").scalar() == 1
+    assert c.execute(
+        "SELECT count(*) FROM pfx WHERE body @@ 'alp*'").scalar() == 1
+    c.execute("DROP TABLE pfx")
+    c.execute("DROP TEXT SEARCH DICTIONARY kw_p")
