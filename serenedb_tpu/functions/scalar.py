@@ -11,6 +11,7 @@ Each registry entry resolves (arg_types) -> (result_type, impl) where impl is
 
 from __future__ import annotations
 
+import json
 import math
 import re
 from typing import Callable, Optional
@@ -1011,4 +1012,130 @@ def _json_valid(ts):
             except _json.JSONDecodeError:
                 pass
         return _result(dt.BOOL, out, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+# -- geo functions ---------------------------------------------------------
+# Reference analog: libs/geo (S2-backed WKB/GeoJSON parsing + spherical
+# geometry; SURVEY.md §2 "Geo"). TPU re-design: points are WKT/GeoJSON
+# text; distance math is vectorized spherical trig over whole columns
+# (VPU-friendly batch math, no per-row geometry objects).
+
+_EARTH_RADIUS_M = 6371008.8          # mean radius, as in _sphere functions
+
+
+def _stringish(t) -> bool:
+    return t.is_string or t.id is dt.TypeId.NULL
+
+
+def _parse_point(s):
+    """Accepts 'POINT(lon lat)', '[lon, lat]', or GeoJSON Point."""
+    t = s.strip()
+    if t[:1] in "[{":
+        v = json.loads(t)
+        if isinstance(v, dict):
+            if str(v.get("type", "")).lower() != "point":
+                raise ValueError("not a Point")
+            v = v.get("coordinates")
+        if not isinstance(v, list) or len(v) != 2:
+            raise ValueError("expected two coordinates")
+        return float(v[0]), float(v[1])
+    if t[:5].upper() == "POINT":
+        inner = t[t.index("(") + 1:t.rindex(")")]
+        parts = inner.replace(",", " ").split()
+        if len(parts) != 2:
+            raise ValueError("expected two coordinates")
+        return float(parts[0]), float(parts[1])
+    raise ValueError("unrecognized point syntax")
+
+
+def _point_cols(cols, n):
+    """(lon, lat) arrays per point-text column. Parse failures raise, so
+    validity is exactly propagate_nulls(cols) — which _result applies."""
+    lons, lats = [], []
+    valid = propagate_nulls(cols)
+    for c in cols:
+        texts = string_values(c)
+        lon = np.zeros(n, dtype=np.float64)
+        lat = np.zeros(n, dtype=np.float64)
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue
+            try:
+                lon[i], lat[i] = _parse_point(texts[i])
+            except (ValueError, IndexError, TypeError) as e:
+                raise errors.SqlError(
+                    errors.INVALID_TEXT_REPRESENTATION,
+                    f"invalid geometry {texts[i][:40]!r}: {e}")
+        lons.append(lon)
+        lats.append(lat)
+    return lons, lats
+
+
+def _haversine_m(lon1, lat1, lon2, lat2):
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lon2 - lon1)
+    a = np.sin(dp / 2.0) ** 2 + \
+        np.cos(p1) * np.cos(p2) * np.sin(dl / 2.0) ** 2
+    return 2.0 * _EARTH_RADIUS_M * np.arcsin(np.minimum(np.sqrt(a), 1.0))
+
+
+@register("st_point")
+def _st_point(ts):
+    if len(ts) != 2 or not _all_numeric(ts):
+        return None
+
+    def impl(cols, n):
+        lon = cols[0].data.astype(np.float64)
+        lat = cols[1].data.astype(np.float64)
+        # shortest-repr floats: st_x(st_point(x, y)) must round-trip x
+        out = np.asarray([f"POINT({float(lon[i])!r} {float(lat[i])!r})"
+                          for i in range(n)], dtype=object)
+        return make_string_column(out.astype(str), propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+def _st_coord(idx):
+    def resolver(ts):
+        if len(ts) != 1 or not _stringish(ts[0]):
+            return None
+
+        def impl(cols, n):
+            (lon,), (lat,) = _point_cols(cols[:1], n)
+            return _result(dt.DOUBLE, (lon, lat)[idx], cols)
+        return FunctionResolution(dt.DOUBLE, impl)
+    return resolver
+
+
+_REGISTRY["st_x"] = _st_coord(0)
+_REGISTRY["st_y"] = _st_coord(1)
+
+
+@register("st_distance")
+def _st_distance(ts):
+    if len(ts) != 2 or not all(_stringish(t) for t in ts):
+        return None
+
+    def impl(cols, n):
+        (lon1, lon2), (lat1, lat2) = _point_cols(cols[:2], n)
+        data = _haversine_m(lon1, lat1, lon2, lat2)
+        return _result(dt.DOUBLE, data, cols)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
+_REGISTRY["st_distance_sphere"] = _REGISTRY["st_distance"]
+
+
+@register("st_dwithin")
+def _st_dwithin(ts):
+    if len(ts) != 3 or not all(_stringish(t) for t in ts[:2]) or \
+            not ts[2].is_numeric:
+        return None
+
+    def impl(cols, n):
+        (lon1, lon2), (lat1, lat2) = _point_cols(cols[:2], n)
+        radius = cols[2].data.astype(np.float64)
+        data = _haversine_m(lon1, lat1, lon2, lat2) <= radius
+        return _result(dt.BOOL, data, cols)
     return FunctionResolution(dt.BOOL, impl)
