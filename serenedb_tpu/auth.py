@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from . import errors
+from . import errors, scram
 
 PRIVILEGES = {"select", "insert", "update", "delete"}
 SUPERUSER = "serene"
@@ -37,8 +37,14 @@ class Roles:
                     return
                 raise errors.SqlError(errors.DUPLICATE_OBJECT,
                                       f'role "{name}" already exists')
-            self.roles[key] = {"password": password, "login": login,
-                               "superuser": superuser}
+            entry = {"password": None, "login": login,
+                     "superuser": superuser}
+            if password is not None:
+                # only the SCRAM verifier is stored, never the plaintext
+                # (reference: PG stores scram-sha-256 verifiers in
+                # pg_authid.rolpassword)
+                entry["scram"] = scram.build_verifier(password)
+            self.roles[key] = entry
 
     def drop(self, name: str, if_exists: bool):
         key = name.lower()
@@ -59,6 +65,11 @@ class Roles:
         with self._lock:
             return name.lower() in self.roles
 
+    def scram_verifier(self, name: str) -> Optional[dict]:
+        with self._lock:
+            r = self.roles.get(name.lower())
+            return dict(r["scram"]) if r and r.get("scram") else None
+
     def is_superuser(self, name: str) -> bool:
         with self._lock:
             r = self.roles.get(name.lower())
@@ -72,7 +83,8 @@ class Roles:
     def has_password(self, name: str) -> bool:
         with self._lock:
             r = self.roles.get(name.lower())
-            return bool(r and r.get("password") is not None)
+            return bool(r and (r.get("scram") or
+                               r.get("password") is not None))
 
     def check_password(self, name: str, password: str) -> bool:
         with self._lock:

@@ -23,6 +23,7 @@ from typing import Optional
 from .. import errors
 from ..columnar import dtypes as dt
 from ..columnar.column import Batch
+from .. import scram
 from ..engine import Connection, Database, QueryResult
 from ..sql import ast, parser
 from ..utils import log, metrics
@@ -130,6 +131,16 @@ class Writer:
 
     def auth_cleartext(self):
         self.msg(b"R", struct.pack("!I", 3))
+
+    def auth_sasl(self, mechanisms: list[str]):
+        body = b"".join(m.encode() + b"\x00" for m in mechanisms) + b"\x00"
+        self.msg(b"R", struct.pack("!I", 10) + body)
+
+    def auth_sasl_continue(self, data: str):
+        self.msg(b"R", struct.pack("!I", 11) + data.encode())
+
+    def auth_sasl_final(self, data: str):
+        self.msg(b"R", struct.pack("!I", 12) + data.encode())
 
     def parameter_status(self, k: str, v: str):
         self.msg(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
@@ -280,17 +291,23 @@ class PgSession:
         needs_password = self.server.password is not None or (
             role_known and roles.has_password(user))
         if needs_password:
-            self.w.auth_cleartext()
-            await self.w.flush()
-            kind, payload = await self._read_msg()
-            supplied = payload[:-1].decode() if kind == b"p" else ""
             if self.server.password is not None:
                 # a server-wide password gates EVERY login, including
                 # passwordless roles — no bypass via user=serene
-                ok = supplied == self.server.password
+                verifier = self.server.password_verifier
             else:
-                ok = role_known and roles.check_password(user, supplied)
-            if kind != b"p" or not ok:
+                verifier = roles.scram_verifier(user)
+            if verifier is not None:
+                ok = await self._scram_auth(verifier)
+            else:
+                # legacy cleartext: roles loaded from pre-SCRAM meta
+                self.w.auth_cleartext()
+                await self.w.flush()
+                kind, payload = await self._read_msg()
+                supplied = payload[:-1].decode() if kind == b"p" else ""
+                ok = kind == b"p" and role_known and \
+                    roles.check_password(user, supplied)
+            if not ok:
                 self.w.error(errors.SqlError(
                     "28P01",
                     f'password authentication failed for user "{user}"'))
@@ -323,6 +340,35 @@ class PgSession:
         self.w.ready(self._txn_status())
         await self.w.flush()
         return True
+
+    async def _scram_auth(self, verifier: dict) -> bool:
+        """SCRAM-SHA-256 SASL exchange (RFC 7677 over the PG SASL
+        messages: AuthenticationSASL → SASLInitialResponse →
+        SASLContinue → SASLResponse → SASLFinal)."""
+        self.w.auth_sasl([scram.MECHANISM])
+        await self.w.flush()
+        kind, payload = await self._read_msg()
+        if kind != b"p":
+            return False
+        try:
+            end = payload.index(b"\x00")
+            mech = payload[:end].decode()
+            (ln,) = struct.unpack_from("!i", payload, end + 1)
+            data = payload[end + 5:end + 5 + ln].decode() if ln >= 0 else ""
+            if mech != scram.MECHANISM:
+                return False
+            srv = scram.ScramServer(verifier)
+            self.w.auth_sasl_continue(srv.first(data))
+            await self.w.flush()
+            kind, payload = await self._read_msg()
+            if kind != b"p":
+                return False
+            ok, final = srv.final(payload.decode())
+        except (ValueError, IndexError, struct.error, UnicodeDecodeError):
+            return False
+        if ok:
+            self.w.auth_sasl_final(final)
+        return ok
 
     def _txn_status(self) -> bytes:
         if self.conn is None:
@@ -734,6 +780,9 @@ class PgServer:
         self.host = host
         self.port = port
         self.password = password
+        self.password_verifier = None
+        if password is not None:
+            self.password_verifier = scram.build_verifier(password)
         self._cancel_keys: dict[tuple[int, int], PgSession] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         import concurrent.futures

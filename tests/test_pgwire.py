@@ -20,6 +20,7 @@ class RawPg:
         self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
         self.params = {}
         self.backend_key = None
+        scram_cont = scram_verify = None
         while True:
             kind, payload = self.read_msg()
             if kind == b"R":
@@ -28,6 +29,22 @@ class RawPg:
                     assert password is not None, "server demands password"
                     pw = password.encode() + b"\x00"
                     self.send(b"p", pw)
+                elif code == 10:   # AuthenticationSASL → SCRAM-SHA-256
+                    assert password is not None, "server demands password"
+                    from serenedb_tpu.scram import client_exchange
+                    mechs = payload[4:].split(b"\x00")
+                    assert b"SCRAM-SHA-256" in mechs
+                    first, scram_cont, scram_verify = client_exchange(
+                        password)
+                    init = first.encode()
+                    self.send(b"p", b"SCRAM-SHA-256\x00" +
+                              struct.pack("!i", len(init)) + init)
+                elif code == 11:   # SASLContinue
+                    final = scram_cont(payload[4:].decode())
+                    self.send(b"p", final.encode())
+                elif code == 12:   # SASLFinal
+                    assert scram_verify(payload[4:].decode()), \
+                        "server signature mismatch"
                 elif code == 0:
                     pass
                 else:
@@ -513,3 +530,70 @@ def test_truncated_bind_result_formats(server):
     cols, rows, tags, qerrs = pg.query("SELECT 7")
     assert rows == [("7",)] and not qerrs
     pg.close()
+
+
+def test_scram_auth_role_password(server):
+    pg0 = RawPg(server.port)
+    pg0.query("CREATE ROLE scrammy LOGIN PASSWORD 'tops3cret'")
+    # correct password over SCRAM
+    pg = RawPg(server.port, user="scrammy", password="tops3cret")
+    cols, rows, tags, errs = pg.query("SELECT 1")
+    assert rows == [("1",)] and not errs
+    pg.close()
+    # wrong password rejected
+    with pytest.raises(AssertionError):
+        RawPg(server.port, user="scrammy", password="wrong")
+    pg0.query("DROP ROLE scrammy")
+    pg0.close()
+
+
+def _run_pg_server(db, password=None):
+    """Start a PgServer via its real start() in a thread; returns
+    (srv, stop_fn) — same bootstrap the module `server` fixture uses."""
+    import threading
+    srv = PgServer(db, port=0, password=password)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        try:
+            loop.run_until_complete(go())
+        except RuntimeError:
+            pass
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    return srv, (lambda: loop.call_soon_threadsafe(loop.stop))
+
+
+def test_scram_server_password():
+    srv, stop = _run_pg_server(Database(), password="gatekeeper")
+    try:
+        pg = RawPg(srv.port, user="serene", password="gatekeeper")
+        cols, rows, tags, errs = pg.query("SELECT 2")
+        assert rows == [("2",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="serene", password="nope")
+    finally:
+        stop()
+
+
+def test_scram_saslprep_unicode_password():
+    # U+00A0 no-break space must normalize to a plain space on both sides
+    # (RFC 4013 / pg_saslprep) so drivers that normalize interoperate
+    srv, stop = _run_pg_server(Database(), password="pa\u00a0ss")
+    try:
+        pg = RawPg(srv.port, user="serene", password="pa ss")
+        assert pg.query("SELECT 5")[1] == [("5",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="serene", password="pass")
+    finally:
+        stop()

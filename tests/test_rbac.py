@@ -190,3 +190,20 @@ def test_dictionary_ddl_superuser_only(db):
         bob.execute("DROP TEXT SEARCH DICTIONARY dropd")
     assert e.value.sqlstate == "42501"
     admin.execute("DROP TEXT SEARCH DICTIONARY dropd")
+
+
+def test_role_passwords_never_stored_plaintext(tmp_path):
+    import json as _json
+    db = Database(str(tmp_path / "data"))
+    c = db.connect()
+    c.execute("CREATE ROLE sec LOGIN PASSWORD 'hunter2'")
+    db.close()
+    blob = "".join(open(f).read() for f in
+                   (tmp_path / "data").glob("*.json"))
+    assert "hunter2" not in blob
+    assert "stored_key" in blob
+    # verifier works after reload
+    db2 = Database(str(tmp_path / "data"))
+    assert db2.roles.scram_verifier("sec") is not None
+    assert db2.roles.has_password("sec")
+    db2.close()
