@@ -1139,3 +1139,238 @@ def _st_dwithin(ts):
         data = _haversine_m(lon1, lat1, lon2, lat2) <= radius
         return _result(dt.BOOL, data, cols)
     return FunctionResolution(dt.BOOL, impl)
+
+
+# -- array functions -------------------------------------------------------
+# Reference analog: server/connector/functions/array.cpp. Arrays are JSON
+# text (same encoding array_agg produces), columnar-friendly: a VARCHAR
+# column of '[...]' values.
+
+
+def _array_rows(col, n):
+    """Per-row parsed arrays (list or None); non-array JSON raises 22P02."""
+    texts = string_values(col)
+    valid = col.valid_mask() if col.validity is not None else None
+    out = []
+    for i in range(n):
+        if valid is not None and not valid[i]:
+            out.append(None)
+            continue
+        try:
+            v = json.loads(texts[i])
+        except json.JSONDecodeError:
+            raise errors.SqlError(
+                errors.INVALID_TEXT_REPRESENTATION,
+                f"invalid array literal: {texts[i][:40]!r}")
+        if not isinstance(v, list):
+            raise errors.SqlError(
+                errors.INVALID_TEXT_REPRESENTATION,
+                f"expected a JSON array, got: {texts[i][:40]!r}")
+        out.append(v)
+    return out
+
+
+def _json_scalar(vals, i):
+    """vals: the column's to_pylist(), materialized ONCE by the caller."""
+    v = vals[i]
+    if isinstance(v, np.generic):
+        v = v.item()
+    return v
+
+
+@register("make_array")
+def _make_array(ts):
+    def impl(cols, n):
+        pylists = [c.to_pylist() for c in cols]
+        out = []
+        for i in range(n):
+            row = []
+            for p in pylists:
+                v = p[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                # arrays ARE JSON text in this encoding, so array-shaped
+                # string elements (e.g. nested ARRAY[...] results) splice
+                # as real nested arrays instead of double-encoding
+                if isinstance(v, str) and v.lstrip()[:1] == "[":
+                    try:
+                        parsed = json.loads(v)
+                        if isinstance(parsed, list):
+                            v = parsed
+                    except json.JSONDecodeError:
+                        pass
+                row.append(v)
+            out.append(json.dumps(row))
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_length")
+def _array_length(ts):
+    if not ts or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        data = np.asarray([len(a) if a is not None else 0 for a in arrs],
+                          dtype=np.int32)
+        return _result(dt.INT, data, cols[:1])
+    return FunctionResolution(dt.INT, impl)
+
+
+_REGISTRY["cardinality"] = _REGISTRY["array_length"]
+
+
+@register("array_get")
+def _array_get(ts):
+    if len(ts) != 2 or not _stringish(ts[0]) or not ts[1].is_numeric:
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        idx = cols[1].data.astype(np.int64)
+        out = []
+        ok = np.ones(n, dtype=bool)
+        for i in range(n):
+            a = arrs[i]
+            j = int(idx[i]) - 1           # PG arrays are 1-based
+            if a is None or j < 0 or j >= len(a) or a[j] is None:
+                out.append("")
+                ok[i] = False
+            else:
+                v = a[j]
+                if isinstance(v, str):
+                    out.append(v)
+                elif isinstance(v, (list, dict)):
+                    out.append(json.dumps(v))   # nested arrays stay JSON
+                else:
+                    out.append(_pg_text(v))
+        base = propagate_nulls(cols)
+        if base is not None:
+            ok &= base
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            None if ok.all() else ok)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_append")
+def _array_append(ts):
+    if len(ts) != 2 or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        vals = cols[1].to_pylist()
+        out = []
+        for i in range(n):
+            # PG semantics: a NULL array behaves as empty — the result is
+            # never NULL (array_append(NULL, 5) = {5})
+            a = list(arrs[i]) if arrs[i] is not None else []
+            a.append(_json_scalar(vals, i))
+            out.append(json.dumps(a))
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_cat")
+def _array_cat(ts):
+    if len(ts) != 2 or not all(_stringish(t) for t in ts):
+        return None
+
+    def impl(cols, n):
+        a1 = _array_rows(cols[0], n)
+        a2 = _array_rows(cols[1], n)
+        # PG: NULL || x = x; NULL only when BOTH sides are NULL
+        out = [json.dumps((x or []) + (y or [])) for x, y in zip(a1, a2)]
+        both_null = np.asarray([x is None and y is None
+                                for x, y in zip(a1, a2)])
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            None if not both_null.any() else ~both_null)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_position")
+def _array_position(ts):
+    if len(ts) != 2 or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        vals = cols[1].to_pylist()
+        out = np.zeros(n, dtype=np.int32)
+        absent = np.zeros(n, dtype=bool)
+        for i in range(n):
+            a = arrs[i]
+            needle = _json_scalar(vals, i)
+            if a is not None and needle in a:
+                out[i] = a.index(needle) + 1
+            else:
+                absent[i] = True
+        return _result(dt.INT, out, cols, extra_invalid=absent)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("array_contains")
+def _array_contains(ts):
+    if len(ts) != 2 or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        vals = cols[1].to_pylist()
+        data = np.asarray(
+            [a is not None and _json_scalar(vals, i) in a
+             for i, a in enumerate(arrs)])
+        return _result(dt.BOOL, data, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+@register("string_to_array")
+def _string_to_array(ts):
+    if len(ts) != 2 or not all(_stringish(t) for t in ts):
+        return None
+
+    def impl(cols, n):
+        s = string_values(cols[0])
+        d = string_values(cols[1])
+        d_null = (~cols[1].valid_mask() if cols[1].validity is not None
+                  else np.zeros(n, dtype=bool))
+        out = []
+        for i in range(n):
+            if d_null[i]:
+                parts = list(s[i])        # PG: NULL delimiter → per char
+            elif d[i] == "":
+                parts = [s[i]]            # PG: '' delimiter → one element
+            else:
+                parts = s[i].split(d[i])
+            out.append(json.dumps(parts))
+        # NULL only when the input string is NULL (non-strict in delim)
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            propagate_nulls(cols[:1]))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_to_string")
+def _array_to_string(ts):
+    if len(ts) != 2 or not _stringish(ts[0]) or not _stringish(ts[1]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        d = string_values(cols[1])
+        out = []
+        for i in range(n):
+            a = arrs[i] or []
+            # PG skips NULL elements in array_to_string
+            out.append(d[i].join(
+                v if isinstance(v, str) else _pg_text(v)
+                for v in a if v is not None))
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)

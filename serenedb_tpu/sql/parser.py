@@ -521,8 +521,11 @@ class Parser:
         while True:
             if self.accept_op("::"):
                 e = ast.Cast(e, self._type_name())
-            elif self.at_op("["):
-                raise errors.unsupported("array subscripts not supported yet")
+            elif self.accept_op("["):
+                # arr[i] — 1-based element access, desugared to a function
+                idx = self.parse_expr()
+                self.expect_op("]")
+                e = ast.FuncCall("array_get", [e, idx])
             else:
                 return e
 
@@ -574,6 +577,17 @@ class Parser:
             return ast.Literal(False)
         if upper == "CASE":
             return self.parse_case()
+        if upper == "ARRAY" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "[":
+            self.next()
+            self.expect_op("[")
+            items = []
+            if not self.at_op("]"):
+                items.append(self.parse_expr())
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+            self.expect_op("]")
+            return ast.FuncCall("make_array", items)
         if upper == "EXISTS" and self.peek(1).kind is T.OP and \
                 self.peek(1).value == "(":
             self.next()
