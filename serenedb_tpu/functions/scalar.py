@@ -1181,24 +1181,24 @@ def _json_scalar(vals, i):
 @register("make_array")
 def _make_array(ts):
     def impl(cols, n):
-        pylists = [c.to_pylist() for c in cols]
+        # first arg is the parser's splice map: comma-separated indices of
+        # elements that are array-valued expressions (nested ARRAY[...],
+        # array_agg, ...) — decided syntactically, never by sniffing values
+        spec = cols[0].decode(0) if n else ""
+        splice = {int(x) for x in str(spec or "").split(",") if x != ""}
+        pylists = [c.to_pylist() for c in cols[1:]]
         out = []
         for i in range(n):
             row = []
-            for p in pylists:
-                v = p[i]
-                if isinstance(v, np.generic):
-                    v = v.item()
-                # arrays ARE JSON text in this encoding, so array-shaped
-                # string elements (e.g. nested ARRAY[...] results) splice
-                # as real nested arrays instead of double-encoding
-                if isinstance(v, str) and v.lstrip()[:1] == "[":
+            for ci, vals in enumerate(pylists):
+                v = _json_scalar(vals, i)
+                if ci in splice and isinstance(v, str):
                     try:
-                        parsed = json.loads(v)
-                        if isinstance(parsed, list):
-                            v = parsed
+                        v = json.loads(v)
                     except json.JSONDecodeError:
-                        pass
+                        raise errors.SqlError(
+                            errors.INVALID_TEXT_REPRESENTATION,
+                            f"invalid array element: {v[:40]!r}")
                 row.append(v)
             out.append(json.dumps(row))
         return make_string_column(
@@ -1224,7 +1224,8 @@ _REGISTRY["cardinality"] = _REGISTRY["array_length"]
 
 @register("array_get")
 def _array_get(ts):
-    if len(ts) != 2 or not _stringish(ts[0]) or not ts[1].is_numeric:
+    if len(ts) != 2 or not _stringish(ts[0]) or not (
+            ts[1].is_numeric or ts[1].id is dt.TypeId.NULL):
         return None
 
     def impl(cols, n):
@@ -1368,7 +1369,9 @@ def _array_to_string(ts):
             a = arrs[i] or []
             # PG skips NULL elements in array_to_string
             out.append(d[i].join(
-                v if isinstance(v, str) else _pg_text(v)
+                v if isinstance(v, str)
+                else json.dumps(v) if isinstance(v, (list, dict))
+                else _pg_text(v)
                 for v in a if v is not None))
         return make_string_column(
             np.asarray(out, dtype=object).astype(str),
