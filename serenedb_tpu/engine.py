@@ -342,6 +342,36 @@ class Database(TableResolver):
                 if p is None:
                     p = self._parquet_cache[path] = ParquetTable(path)
             return p
+        if name == "unnest":
+            # set-returning: one row per element; multiple arrays zip with
+            # NULL padding (PG: FROM unnest(a, b)); arrays are JSON text
+            import json as _json
+            lists = []
+            for a in args:
+                if a is None:
+                    lists.append([])
+                    continue
+                try:
+                    elems = _json.loads(str(a))
+                except _json.JSONDecodeError:
+                    raise errors.SqlError(
+                        errors.INVALID_TEXT_REPRESENTATION,
+                        f"invalid array literal: {str(a)[:40]!r}")
+                if not isinstance(elems, list):
+                    raise errors.SqlError(
+                        errors.INVALID_TEXT_REPRESENTATION,
+                        "unnest expects a JSON array")
+                lists.append([
+                    _json.dumps(e) if isinstance(e, (list, dict)) else e
+                    for e in elems])
+            if not lists:
+                lists = [[]]
+            n = max(len(ls) for ls in lists)
+            cols = {}
+            for i, ls in enumerate(lists):
+                cols["unnest" if i == 0 else f"unnest_{i}"] = \
+                    ls + [None] * (n - len(ls))
+            return MemTable("unnest", Batch.from_pydict(cols))
         if name == "sdb_log":
             from .pgcatalog import log_table
             return log_table()

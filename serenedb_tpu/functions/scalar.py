@@ -1180,10 +1180,24 @@ def _json_scalar(vals, i):
 
 @register("make_array")
 def _make_array(ts):
+    # the user-callable spelling: every element taken verbatim
     def impl(cols, n):
-        # first arg is the parser's splice map: comma-separated indices of
-        # elements that are array-valued expressions (nested ARRAY[...],
-        # array_agg, ...) — decided syntactically, never by sniffing values
+        pylists = [c.to_pylist() for c in cols]
+        out = []
+        for i in range(n):
+            out.append(json.dumps(
+                [_json_scalar(vals, i) for vals in pylists]))
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("__make_array")
+def _make_array_spliced(ts):
+    """Parser-internal spelling for ARRAY[...] literals: the first arg is
+    a literal splice map (comma-separated indices of elements that are
+    array-valued expressions) — never reachable by user SQL."""
+    def impl(cols, n):
         spec = cols[0].decode(0) if n else ""
         splice = {int(x) for x in str(spec or "").split(",") if x != ""}
         pylists = [c.to_pylist() for c in cols[1:]]

@@ -378,6 +378,14 @@ def _fold_if_const(f: BoundFunc) -> BoundExpr:
 def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
     if name == "count":
         return dt.BIGINT
+    if name in ("sum", "avg", "stddev", "stddev_samp", "var_samp",
+                "variance") and not (
+            arg_t.is_numeric or arg_t.id is dt.TypeId.NULL):
+        # without this, the engine would silently aggregate dictionary
+        # CODES of a string column (PG: 42883 function sum(text)...)
+        raise errors.SqlError(
+            errors.UNDEFINED_FUNCTION,
+            f"function {name}({arg_t.id.name.lower()}) does not exist")
     if name in ("sum",):
         if arg_t.is_integer:
             return dt.BIGINT
@@ -387,6 +395,10 @@ def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
     if name in ("min", "max"):
         return arg_t
     if name in ("bool_and", "bool_or"):
+        if arg_t.id not in (dt.TypeId.BOOL, dt.TypeId.NULL):
+            raise errors.SqlError(
+                errors.UNDEFINED_FUNCTION,
+                f"function {name}({arg_t.id.name.lower()}) does not exist")
         return dt.BOOL
     if name in ("string_agg", "array_agg"):
         return dt.VARCHAR   # array_agg renders as a JSON array (no ARRAY type yet)

@@ -590,12 +590,12 @@ class Parser:
             # array-ness is syntactic, not sniffed from values: elements
             # that are themselves array-producing expressions splice as
             # nested arrays; plain strings never do
-            array_funcs = {"make_array", "array_append", "array_cat",
+            array_funcs = {"make_array", "__make_array", "array_append", "array_cat",
                            "array_agg", "string_to_array"}
             splice = [i for i, it in enumerate(items)
                       if isinstance(it, ast.FuncCall)
                       and it.name.lower() in array_funcs]
-            return ast.FuncCall("make_array",
+            return ast.FuncCall("__make_array",
                                 [ast.Literal(",".join(map(str, splice)))]
                                 + items)
         if upper == "EXISTS" and self.peek(1).kind is T.OP and \

@@ -213,13 +213,30 @@ class Planner:
             args = []
             for a in ref.args:
                 b = binder.bind(a)
-                if not isinstance(b, BoundLiteral):
-                    raise errors.unsupported(
-                        "table function arguments must be constants")
-                args.append(b.value)
+                if isinstance(b, BoundLiteral):
+                    args.append(b.value)
+                else:
+                    # constant-fold column-free expressions (e.g.
+                    # unnest(ARRAY[1,2,3])) on a one-row dummy batch
+                    if _refs_columns(b):
+                        raise errors.unsupported(
+                            "table function arguments must be constants")
+                    one_row = Batch(["__dummy"], [Column.const(0, 1)])
+                    args.append(b.eval(one_row).decode(0))
             provider = self.resolver.resolve_table_function(ref.name, args)
-            return self._scan_scope(provider,
-                                    ref.alias or ref.name.split(".")[-1])
+            node, scope = self._scan_scope(
+                provider, ref.alias or ref.name.split(".")[-1])
+            if ref.alias and ref.name == "unnest" and \
+                    len(scope.columns) == 1:
+                # PG: an alias on a single-column table function renames
+                # the column too (SELECT u FROM unnest(...) AS u)
+                c = scope.columns[0]
+                scope = Scope([ScopeColumn(c.table, ref.alias, c.type,
+                                           c.index)])
+                node = ProjectNode(node, [BoundColumn(c.index, c.type,
+                                                      ref.alias)],
+                                   [ref.alias])
+            return node, scope
         if isinstance(ref, ast.SubqueryRef):
             inner = self.plan_select(ref.query)
             alias = ref.alias or "subquery"
@@ -602,6 +619,14 @@ def _contains_agg(e: ast.Expr) -> bool:
     if isinstance(e, ast.Cast):
         return _contains_agg(e.operand)
     return False
+
+
+def _refs_columns(e: BoundExpr) -> bool:
+    """True if the bound expression reads any batch column (i.e. is not a
+    constant-foldable expression)."""
+    if isinstance(e, (BoundColumn, BoundAggRef)):
+        return True
+    return any(_refs_columns(c) for c in e.children())
 
 
 def _default_name(e: ast.Expr) -> str:

@@ -93,3 +93,17 @@ def test_device_is_not_null_predicate():
         rows = c.execute("SELECT g, sum(a) FROM nn WHERE a IS NOT NULL "
                          "GROUP BY g ORDER BY g").rows()
         assert rows == [(0, 1), (1, 5)]
+
+
+def test_sum_over_varchar_errors_not_codes():
+    # probe-found: sum/avg over a string column silently aggregated the
+    # dictionary CODES (sum('4','5','6') returned 3.0)
+    c = Database().connect()
+    c.execute("CREATE TABLE sv (v TEXT)")
+    c.execute("INSERT INTO sv VALUES ('4'), ('5'), ('6')")
+    for fn in ("sum", "avg"):
+        with pytest.raises(SqlError) as e:
+            c.execute(f"SELECT {fn}(v) FROM sv")
+        assert e.value.sqlstate == "42883"
+    # min/max on strings stay legal (lexicographic)
+    assert c.execute("SELECT min(v), max(v) FROM sv").rows() == [("4", "6")]
