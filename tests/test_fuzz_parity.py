@@ -35,7 +35,7 @@ def _mk_db(seed):
 
 def _rand_pred(rng) -> str:
     def leaf():
-        kind = rng.integers(0, 7)
+        kind = rng.integers(0, 10)
         if kind == 0:
             return f"a {rng.choice(['<', '<=', '>', '>=', '=', '<>'])} " \
                    f"{rng.integers(-60, 60)}"
@@ -50,6 +50,19 @@ def _rand_pred(rng) -> str:
             return "a IS NOT NULL"
         if kind == 5:
             return f"a + {rng.integers(1, 9)} > g * {rng.integers(1, 4)}"
+        if kind == 6:
+            lo = int(rng.integers(-50, 20))
+            return f"a BETWEEN {lo} AND {lo + int(rng.integers(0, 40))}"
+        if kind == 7:
+            vals = ", ".join(str(int(v))
+                             for v in rng.integers(-50, 50, 3))
+            neg = "NOT " if rng.random() < 0.3 else ""
+            return f"a {neg}IN ({vals})"
+        if kind == 8:
+            opts = ", ".join(f"'{o}'" for o in
+                             rng.choice(["red", "green", "teal"],
+                                        rng.integers(1, 3), replace=False))
+            return f"s IN ({opts})"
         return f"g {rng.choice(['=', '<>'])} {rng.integers(0, 14)}"
 
     e = leaf()
@@ -64,13 +77,30 @@ def _rand_pred(rng) -> str:
 
 def _rand_query(rng) -> str:
     pred = _rand_pred(rng)
-    aggs = rng.choice(
+    aggs = list(rng.choice(
         ["count(*)", "count(a)", "sum(a)", "sum(b)", "min(a)", "max(g)",
-         "avg(a)"], size=rng.integers(1, 4), replace=False)
-    if rng.random() < 0.5:
+         "avg(a)"], size=rng.integers(1, 4), replace=False))
+    shape = rng.integers(0, 5)
+    if shape == 0:
+        return f"SELECT {', '.join(aggs)} FROM fz WHERE {pred}"
+    if shape == 1:
         return (f"SELECT g, {', '.join(aggs)} FROM fz WHERE {pred} "
                 "GROUP BY g ORDER BY g NULLS LAST")
-    return f"SELECT {', '.join(aggs)} FROM fz WHERE {pred}"
+    if shape == 2:   # HAVING over an aggregate
+        return (f"SELECT g, count(*) FROM fz WHERE {pred} GROUP BY g "
+                f"HAVING count(*) > {rng.integers(0, 40)} "
+                "ORDER BY g NULLS LAST")
+    if shape == 3:   # expressions over aggregates in the projection
+        return (f"SELECT g, sum(a) + count(*), "
+                f"CASE WHEN count(*) > {rng.integers(5, 50)} THEN 'big' "
+                f"ELSE 'small' END "
+                f"FROM fz WHERE {pred} GROUP BY g ORDER BY g NULLS LAST")
+    # plain scan with ORDER BY + LIMIT; (g, b, a) pins the order — rows
+    # still tied after all three keys are identical in every projected
+    # column, so any order compares equal
+    return (f"SELECT a, b, g FROM fz WHERE {pred} "
+            f"ORDER BY g NULLS LAST, b, a NULLS LAST "
+            f"LIMIT {rng.integers(1, 30)}")
 
 
 @pytest.mark.parametrize("seed", [11, 23, 47])
