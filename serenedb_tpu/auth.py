@@ -46,6 +46,32 @@ class Roles:
                 entry["scram"] = scram.build_verifier(password)
             self.roles[key] = entry
 
+    def alter(self, name: str, set_password: bool = False,
+              password: Optional[str] = None, login=None, superuser=None):
+        """ALTER ROLE: rotate/clear credentials, flip LOGIN/SUPERUSER.
+        Passwords become SCRAM verifiers; the bootstrap superuser can
+        change its password but never lose LOGIN/SUPERUSER."""
+        key = name.lower()
+        with self._lock:
+            r = self.roles.get(key)
+            if r is None:
+                raise errors.SqlError(errors.UNDEFINED_OBJECT,
+                                      f'role "{name}" does not exist')
+            if key == SUPERUSER and (login is False or superuser is False):
+                raise errors.SqlError(
+                    errors.FEATURE_NOT_SUPPORTED,
+                    "cannot demote the bootstrap superuser")
+            if set_password:
+                r["password"] = None
+                if password is None:
+                    r.pop("scram", None)
+                else:
+                    r["scram"] = scram.build_verifier(password)
+            if login is not None:
+                r["login"] = login
+            if superuser is not None:
+                r["superuser"] = superuser
+
     def drop(self, name: str, if_exists: bool):
         key = name.lower()
         with self._lock:

@@ -548,8 +548,8 @@ class Connection:
 
     def _dispatch(self, st: ast.Statement, params: list) -> QueryResult:
         if isinstance(st, (ast.Drop, ast.DropRole, ast.AlterTable,
-                           ast.CreateRole, ast.GrantRevoke, ast.CreateIndex,
-                           ast.VacuumStmt)):
+                           ast.CreateRole, ast.AlterRole, ast.GrantRevoke,
+                           ast.CreateIndex, ast.VacuumStmt)):
             # destructive/administrative DDL is superuser-only in the
             # ownerless v1 model (PG would check ownership)
             if not self.db.roles.is_superuser(self.current_role):
@@ -587,6 +587,11 @@ class Connection:
                                  st.superuser, st.if_not_exists)
             self._persist_auth()
             return QueryResult(Batch([], []), "CREATE ROLE")
+        if isinstance(st, ast.AlterRole):
+            self.db.roles.alter(st.name, st.set_password, st.password,
+                                st.login, st.superuser)
+            self._persist_auth()
+            return QueryResult(Batch([], []), "ALTER ROLE")
         if isinstance(st, ast.DropRole):
             self.db.roles.drop(st.name, st.if_exists)
             self._persist_auth()

@@ -371,6 +371,15 @@ class DropRole(Statement):
 
 
 @dataclass
+class AlterRole(Statement):
+    name: str
+    set_password: bool = False     # PASSWORD clause present
+    password: object = None        # None with set_password = clear it
+    login: object = None           # None = unchanged
+    superuser: object = None       # None = unchanged
+
+
+@dataclass
 class GrantRevoke(Statement):
     grant: bool                       # True=GRANT, False=REVOKE
     privileges: list[str]             # select/insert/update/delete/all

@@ -995,8 +995,47 @@ class Parser:
             return ast.SetStmt(name, v)
         raise errors.syntax("bad SET value")
 
-    def parse_alter(self) -> ast.AlterTable:
+    def parse_alter(self):
         self.expect_kw("ALTER")
+        if self.accept_kw("ROLE") or self.accept_kw("USER"):
+            name = self.ident()
+            set_pw, password = False, None
+            login = superuser = None
+            n_opts = 0
+            while True:
+                n_opts += 1
+                if self.accept_kw("PASSWORD"):
+                    if set_pw:
+                        raise errors.syntax(
+                            "conflicting or redundant options")
+                    set_pw = True
+                    if self.accept_kw("NULL"):
+                        password = None
+                    else:
+                        t = self.next()
+                        if t.kind is not T.STRING:
+                            raise errors.syntax(
+                                "PASSWORD requires a string or NULL")
+                        password = t.value
+                elif self.accept_kw("LOGIN", "NOLOGIN"):
+                    if login is not None:
+                        raise errors.syntax(
+                            "conflicting or redundant options")
+                    login = self.toks[self.i - 1].value.upper() == "LOGIN"
+                elif self.accept_kw("SUPERUSER", "NOSUPERUSER"):
+                    if superuser is not None:
+                        raise errors.syntax(
+                            "conflicting or redundant options")
+                    superuser = self.toks[self.i - 1].value.upper() == \
+                        "SUPERUSER"
+                elif n_opts == 1 and self.accept_kw("WITH"):
+                    continue
+                else:
+                    n_opts -= 1
+                    break
+            if n_opts == 0:
+                raise errors.syntax("ALTER ROLE requires at least one option")
+            return ast.AlterRole(name, set_pw, password, login, superuser)
         self.expect_kw("TABLE")
         if_exists = False
         if self.accept_kw("IF"):

@@ -597,3 +597,22 @@ def test_scram_saslprep_unicode_password():
             RawPg(srv.port, user="serene", password="pass")
     finally:
         stop()
+
+
+def test_scram_login_after_password_rotation():
+    db = Database()
+    srv, stop = _run_pg_server(db)
+    try:
+        admin = RawPg(srv.port, user="serene")
+        admin.query("CREATE ROLE rotor LOGIN PASSWORD 'first'")
+        pg = RawPg(srv.port, user="rotor", password="first")
+        pg.close()
+        admin.query("ALTER ROLE rotor PASSWORD 'second'")
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="rotor", password="first")
+        pg = RawPg(srv.port, user="rotor", password="second")
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+        admin.close()
+    finally:
+        stop()

@@ -207,3 +207,45 @@ def test_role_passwords_never_stored_plaintext(tmp_path):
     assert db2.roles.scram_verifier("sec") is not None
     assert db2.roles.has_password("sec")
     db2.close()
+
+
+def test_alter_role_password_rotation():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE ROLE rot LOGIN PASSWORD 'old'")
+    v1 = db.roles.scram_verifier("rot")
+    c.execute("ALTER ROLE rot PASSWORD 'new'")
+    v2 = db.roles.scram_verifier("rot")
+    assert v1 != v2 and v2 is not None
+    c.execute("ALTER ROLE rot PASSWORD NULL")
+    assert db.roles.scram_verifier("rot") is None
+    assert not db.roles.has_password("rot")
+    c.execute("ALTER ROLE rot NOLOGIN")
+    assert not db.roles.can_login("rot")
+    c.execute("ALTER ROLE rot LOGIN SUPERUSER")
+    assert db.roles.can_login("rot") and db.roles.is_superuser("rot")
+    with pytest.raises(SqlError) as e:
+        c.execute("ALTER ROLE ghost PASSWORD 'x'")
+    assert e.value.sqlstate == "42704"
+    with pytest.raises(SqlError):
+        c.execute("ALTER ROLE serene NOLOGIN")
+    # non-superusers cannot alter roles
+    c.execute("CREATE ROLE peon LOGIN")
+    c2 = db.connect()
+    c2.execute("SET ROLE peon")
+    with pytest.raises(SqlError) as e:
+        c2.execute("ALTER ROLE rot PASSWORD 'pwn'")
+    assert e.value.sqlstate == "42501"
+
+
+def test_alter_role_option_validation():
+    c = Database().connect()
+    c.execute("CREATE ROLE optr LOGIN")
+    for bad in ["ALTER ROLE optr",
+                "ALTER ROLE optr LOGIN NOLOGIN",
+                "ALTER ROLE optr PASSWORD 'a' PASSWORD 'b'",
+                "ALTER ROLE optr SUPERUSER NOSUPERUSER"]:
+        with pytest.raises(SqlError) as e:
+            c.execute(bad)
+        assert e.value.sqlstate == "42601", bad
+    c.execute("ALTER ROLE optr WITH NOLOGIN")   # WITH prefix still legal
