@@ -270,16 +270,7 @@ class Database(TableResolver):
         t = self._table_by_key(op.table)
         if t is None:
             return
-        if op.kind == "insert":
-            _append_rows(t, op.batch)
-        elif op.kind == "delete":
-            full = t.full_batch()
-            mask = np.ones(full.num_rows, dtype=bool)
-            rows = op.rows[op.rows < full.num_rows]
-            mask[rows] = False
-            t.replace(full.filter(mask))
-        elif op.kind == "truncate":
-            t.replace(t.full_batch().slice(0, 0))
+        _apply_ops(t, [(op.kind, op.batch, op.rows)])
 
     def _persist_catalog(self):
         if self.store is not None:
@@ -488,14 +479,19 @@ class _ViewRef(Exception):
 
 
 class _ResolverShim(TableResolver):
-    """Expands views inline during planning."""
+    """Expands views inline during planning; inside a transaction, reads
+    resolve to the connection's pinned snapshot (snapshot isolation)."""
 
-    def __init__(self, db: Database, planner_params):
+    def __init__(self, db: Database, planner_params, conn=None):
         self.db = db
         self.params = planner_params
+        self.conn = conn
 
     def resolve_table(self, parts: list[str]) -> TableProvider:
-        return self.db.resolve_table(parts)
+        p = self.db.resolve_table(parts)
+        if self.conn is not None and self.conn.in_txn:
+            return self.conn._txn_read_provider(p)
+        return p
 
     def resolve_table_function(self, name, args):
         return self.db.resolve_table_function(name, args)
@@ -508,6 +504,10 @@ class Connection:
         self.settings = SessionSettings()
         self.in_txn = False
         self.txn_failed = False
+        # snapshot-isolation state: pinned read snapshots + buffered writes
+        # (key → {"real", "work", "version", "ops"}), live only in a txn
+        self._txn_pins: dict[str, MemTable] = {}
+        self._txn_writes: dict[str, dict] = {}
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
@@ -753,7 +753,7 @@ class Connection:
 
     def _plan(self, sel: ast.Select, params: list) -> PlanNode:
         from .sql.search_rewrite import rewrite_search
-        planner = Planner(_ResolverShim(self.db, params), params)
+        planner = Planner(_ResolverShim(self.db, params, self), params)
         while True:
             try:
                 return rewrite_search(planner.plan_select(sel))
@@ -939,7 +939,105 @@ class Connection:
         if not isinstance(provider, MemTable):
             raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
                                   "cannot modify this table")
+        if self.in_txn:
+            return self._txn_write_provider(provider)
         return provider
+
+    # -- snapshot-isolation transaction machinery -------------------------
+    # Reference analog: the versioned catalog snapshot model (SURVEY.md
+    # §3.2 "binding pins a catalog::Snapshot") — a txn reads one immutable
+    # snapshot and buffers writes; COMMIT is first-committer-wins.
+
+    def _txn_key_of(self, provider) -> Optional[str]:
+        """schema.table key when this provider is a user table (system
+        tables and table functions are rebuilt per query — never pinned).
+        Must be called under db.lock."""
+        key = getattr(provider, "key", None)      # StoredTable fast path
+        if key is not None and self.db._table_by_key(key) is provider:
+            return key
+        for sname, sch in self.db.schemas.items():
+            for tname, t in sch.tables.items():
+                if t is provider:
+                    return f"{sname}.{tname}"
+        return None
+
+    @staticmethod
+    def _txn_copy(provider, batch) -> MemTable:
+        copy = MemTable(provider.name, batch)
+        meta = getattr(provider, "table_meta", None)
+        if meta is not None:
+            copy.table_meta = meta
+        return copy
+
+    def _txn_read_provider(self, provider):
+        # pin under db.lock: batch + data_version must be one atomic
+        # observation (a concurrent UPDATE is replace-then-append — an
+        # unlocked read could pair a torn batch with the final version)
+        with self.db.lock:
+            key = self._txn_key_of(provider)
+            if key is None:
+                return provider
+            w = self._txn_writes.get(key)
+            if w is not None:
+                return w["work"]          # read-your-writes
+            pin = self._txn_pins.get(key)
+            if pin is None:
+                pin = self._txn_copy(provider, provider.full_batch())
+                pin._txn_base_version = provider.data_version
+                self._txn_pins[key] = pin
+            return pin
+
+    def _txn_write_provider(self, provider) -> MemTable:
+        with self.db.lock:
+            key = self._txn_key_of(provider)
+        if key is None:
+            raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
+                                  "cannot modify this table in a "
+                                  "transaction")
+        w = self._txn_writes.get(key)
+        if w is not None:
+            return w["work"]
+        # seed the working copy from the pinned snapshot (or pin now):
+        # the txn keeps seeing its own snapshot + its own writes
+        pin = self._txn_read_provider(provider)
+        work = self._txn_copy(provider, pin.full_batch())
+        work._txn_key = key
+        self._txn_writes[key] = {
+            "real": provider, "work": work, "key": key,
+            "version": getattr(pin, "_txn_base_version",
+                               provider.data_version),
+            "ops": []}
+        return work
+
+    def _txn_clear(self):
+        self._txn_pins = {}
+        self._txn_writes = {}
+
+    def _txn_commit_writes(self):
+        """First-committer-wins publish: conflict check, one atomic WAL
+        commit across all written tables, then in-memory apply."""
+        if not self._txn_writes:
+            return
+        from .storage.wal import WalOp
+        with self.db.lock:
+            for key, w in self._txn_writes.items():
+                if w["real"].data_version != w["version"] or \
+                        self.db._table_by_key(key) is not w["real"]:
+                    # concurrent update, or the table was dropped/replaced
+                    # under the txn
+                    self._txn_clear()
+                    raise errors.SqlError(
+                        "40001", "could not serialize access due to "
+                        "concurrent update")
+            if self.db.store is not None:
+                wal_ops = [WalOp(w["real"].key, kind, batch, rows)
+                           for w in self._txn_writes.values()
+                           if isinstance(w["real"], StoredTable)
+                           for kind, batch, rows in w["ops"]]
+                if wal_ops:
+                    self.db.store.commit(wal_ops)
+            for w in self._txn_writes.values():
+                _apply_ops(w["real"], w["ops"])
 
     def _insert(self, st: ast.Insert, params: list) -> QueryResult:
         table = self._table_for_dml(st.table)
@@ -1071,16 +1169,27 @@ class Connection:
         return QueryResult(b, "SHOW")
 
     def _txn(self, st: ast.Transaction) -> QueryResult:
-        # single-statement autocommit engine for now: BEGIN/COMMIT tracked
-        # for wire-protocol status; ROLLBACK clears failure state.
         if st.action == "begin":
+            if self.in_txn:
+                # PG: WARNING, there is already a transaction in progress —
+                # the open txn (and its failure state) is preserved
+                return QueryResult(Batch([], []), "BEGIN")
             self.in_txn = True
             self.txn_failed = False
+            self._txn_clear()
             return QueryResult(Batch([], []), "BEGIN")
+        was_failed = self.txn_failed
         self.in_txn = False
         self.txn_failed = False
-        return QueryResult(Batch([], []),
-                           "COMMIT" if st.action == "commit" else "ROLLBACK")
+        if st.action == "commit" and not was_failed:
+            try:
+                self._txn_commit_writes()
+            finally:
+                self._txn_clear()
+            return QueryResult(Batch([], []), "COMMIT")
+        # ROLLBACK, or COMMIT of a failed txn (PG answers ROLLBACK)
+        self._txn_clear()
+        return QueryResult(Batch([], []), "ROLLBACK")
 
     def _explain(self, st: ast.Explain, params: list) -> QueryResult:
         if not isinstance(st.inner, (ast.Select, ast.SetOp)):
@@ -1137,6 +1246,8 @@ class Connection:
                 return self._copy_from(st, table, fmt)
         # COPY TO
         provider = self.db.resolve_table(st.table)
+        if self.in_txn:
+            provider = self._txn_read_provider(provider)
         full = provider.full_batch(st.columns)
         with _progress.track("COPY TO", full.num_rows):
             if fmt == "parquet":
@@ -1208,6 +1319,8 @@ class Connection:
         """COPY ... TO STDOUT: PG text format by default, or csv with the
         same options copy_in_data honors."""
         provider = self.db.resolve_table(st.table)
+        if self.in_txn:
+            provider = self._txn_read_provider(provider)
         full = provider.full_batch(st.columns)
         cols = [c.to_pylist() for c in full.columns]
         fmt = str(st.options.get("format", "text")).lower()
@@ -1262,13 +1375,34 @@ class Connection:
 
     def _wal_commit(self, table: MemTable, ops: list[tuple]):
         """Durably log (kind, batch, rows) ops for a stored table before the
-        in-memory publish (WAL-then-apply, reference §3.4)."""
+        in-memory publish (WAL-then-apply, reference §3.4). Inside a txn
+        the working copy buffers the ops; COMMIT logs them atomically."""
+        key = getattr(table, "_txn_key", None)
+        if key is not None:
+            self._txn_writes[key]["ops"].extend(ops)
+            return
         if self.db.store is None or not isinstance(table, StoredTable):
             return
         from .storage.wal import WalOp
         wal_ops = [WalOp(table.key, kind, batch, rows)
                    for kind, batch, rows in ops]
         self.db.store.commit(wal_ops)
+
+
+def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
+    """THE op-replay transformation, shared by WAL recovery and txn
+    commit so committed state always matches recovered state."""
+    for kind, batch, rows in ops:
+        if kind == "insert":
+            _append_rows(table, batch)
+        elif kind == "delete":
+            full = table.full_batch()
+            mask = np.ones(full.num_rows, dtype=bool)
+            rows = np.asarray(rows, dtype=np.int64)
+            mask[rows[rows < full.num_rows]] = False
+            table.replace(full.filter(mask))
+        elif kind == "truncate":
+            table.replace(table.full_batch().slice(0, 0))
 
 
 def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:

@@ -97,3 +97,123 @@ def test_parallel_ddl_no_corruption():
     assert not errs, errs[:3]
     assert db.connect().execute(
         "SELECT count(*) FROM pg_tables").scalar() == 0
+
+
+class TestSnapshotIsolation:
+    def test_repeatable_reads(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE si (a INT)")
+        c1.execute("INSERT INTO si VALUES (1), (2)")
+        c1.execute("BEGIN")
+        assert c1.execute("SELECT count(*) FROM si").scalar() == 2
+        c2.execute("INSERT INTO si VALUES (3)")
+        # txn keeps its snapshot; outside sees the new row
+        assert c1.execute("SELECT count(*) FROM si").scalar() == 2
+        assert c2.execute("SELECT count(*) FROM si").scalar() == 3
+        c1.execute("COMMIT")
+        assert c1.execute("SELECT count(*) FROM si").scalar() == 3
+
+    def test_buffered_writes_and_rollback(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE bw (a INT)")
+        c1.execute("INSERT INTO bw VALUES (1)")
+        c1.execute("BEGIN")
+        c1.execute("INSERT INTO bw VALUES (2)")
+        c1.execute("UPDATE bw SET a = a + 10")
+        assert sorted(c1.execute("SELECT a FROM bw").rows()) == \
+            [(11,), (12,)]
+        assert c2.execute("SELECT a FROM bw").rows() == [(1,)]
+        c1.execute("ROLLBACK")
+        assert c1.execute("SELECT a FROM bw").rows() == [(1,)]
+        c1.execute("BEGIN")
+        c1.execute("DELETE FROM bw")
+        c1.execute("COMMIT")
+        assert c2.execute("SELECT count(*) FROM bw").scalar() == 0
+
+    def test_first_committer_wins(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE fc (a INT)")
+        c1.execute("INSERT INTO fc VALUES (1)")
+        c1.execute("BEGIN")
+        c1.execute("UPDATE fc SET a = 99")
+        c2.execute("UPDATE fc SET a = 77")          # commits first
+        with pytest.raises(SqlError) as e:
+            c1.execute("COMMIT")
+        assert e.value.sqlstate == "40001"
+        assert c2.execute("SELECT a FROM fc").rows() == [(77,)]
+        # the aborted session is usable again
+        assert c1.execute("SELECT a FROM fc").rows() == [(77,)]
+
+    def test_commit_of_failed_txn_rolls_back(self):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE ft (a INT)")
+        c.execute("BEGIN")
+        c.execute("INSERT INTO ft VALUES (1)")
+        with pytest.raises(SqlError):
+            c.execute("SELECT 1/0")
+        res = c.execute("COMMIT")
+        assert res.command_tag == "ROLLBACK"
+        assert c.execute("SELECT count(*) FROM ft").scalar() == 0
+
+    def test_txn_commit_is_durable(self, tmp_path):
+        path = str(tmp_path / "data")
+        db = Database(path)
+        c = db.connect()
+        c.execute("CREATE TABLE dur (a INT)")
+        c.execute("INSERT INTO dur VALUES (1)")
+        c.execute("BEGIN")
+        c.execute("INSERT INTO dur VALUES (2), (3)")
+        c.execute("UPDATE dur SET a = a * 10 WHERE a = 1")
+        c.execute("COMMIT")
+        # rolled-back txns must leave no WAL trace
+        c.execute("BEGIN")
+        c.execute("INSERT INTO dur VALUES (999)")
+        c.execute("ROLLBACK")
+        db.close()
+        db2 = Database(path)
+        rows = sorted(db2.connect().execute("SELECT a FROM dur").rows())
+        assert rows == [(2,), (3,), (10,)]
+        db2.close()
+
+    def test_nested_begin_preserves_txn(self):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE nb (a INT)")
+        c.execute("BEGIN")
+        c.execute("INSERT INTO nb VALUES (1)")
+        c.execute("BEGIN")            # PG: warning no-op
+        c.execute("COMMIT")
+        assert c.execute("SELECT count(*) FROM nb").scalar() == 1
+
+    def test_copy_out_sees_txn_snapshot(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE co (a INT)")
+        c1.execute("INSERT INTO co VALUES (1)")
+        c1.execute("BEGIN")
+        c1.execute("INSERT INTO co VALUES (2)")
+        c2.execute("INSERT INTO co VALUES (99)")
+        lines, n = c1.copy_out_data(
+            __import__("serenedb_tpu.sql.ast", fromlist=["ast"]).CopyStmt(
+                ["co"], None, True, {}))
+        vals = sorted(int(ln.strip()) for ln in lines)
+        # own write visible, concurrent commit not
+        assert vals == [1, 2] and n == 2
+        c1.execute("ROLLBACK")
+
+    def test_commit_after_table_recreated_conflicts(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE rc (a INT)")
+        c1.execute("BEGIN")
+        c1.execute("INSERT INTO rc VALUES (1)")
+        c2.execute("DROP TABLE rc")
+        c2.execute("CREATE TABLE rc (a INT)")
+        with pytest.raises(SqlError) as e:
+            c1.execute("COMMIT")
+        assert e.value.sqlstate == "40001"
+        assert c2.execute("SELECT count(*) FROM rc").scalar() == 0
