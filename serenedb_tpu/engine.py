@@ -840,7 +840,10 @@ class Connection:
 
     def _alter_table(self, st: ast.AlterTable) -> QueryResult:
         try:
-            table = self._table_for_dml(st.table)
+            # DDL is autocommit: ALTER must hit the REAL table, never the
+            # txn work copy (COMMIT replays only insert/delete/truncate,
+            # and RENAME must not publish uncommitted state)
+            table = self._table_for_dml(st.table, txn_route=False)
         except errors.SqlError:
             if st.if_exists:
                 return QueryResult(Batch([], []), "ALTER TABLE")
@@ -934,12 +937,13 @@ class Connection:
         return QueryResult(Batch([], []), "ALTER TABLE")
 
     def _table_for_dml(self, parts: list[str],
-                       privilege: str = "insert") -> MemTable:
+                       privilege: str = "insert",
+                       txn_route: bool = True) -> MemTable:
         provider = self.db.resolve_table(parts, privilege)
         if not isinstance(provider, MemTable):
             raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
                                   "cannot modify this table")
-        if self.in_txn:
+        if self.in_txn and txn_route:
             return self._txn_write_provider(provider)
         return provider
 
@@ -962,11 +966,18 @@ class Connection:
         return None
 
     @staticmethod
-    def _txn_copy(provider, batch) -> MemTable:
+    def _txn_copy(provider, batch, share_indexes: bool = False) -> MemTable:
         copy = MemTable(provider.name, batch)
         meta = getattr(provider, "table_meta", None)
         if meta is not None:
             copy.table_meta = meta
+        if share_indexes and batch is provider.full_batch():
+            # segments are immutable: a pin over the CURRENT batch can
+            # share the provider's search indexes (in-txn indexed search);
+            # matching data_version keeps the freshness checks honest
+            copy.data_version = provider.data_version
+            copy.mutation_epoch = provider.mutation_epoch
+            copy.indexes = dict(getattr(provider, "indexes", {}) or {})
         return copy
 
     def _txn_read_provider(self, provider):
@@ -982,7 +993,8 @@ class Connection:
                 return w["work"]          # read-your-writes
             pin = self._txn_pins.get(key)
             if pin is None:
-                pin = self._txn_copy(provider, provider.full_batch())
+                pin = self._txn_copy(provider, provider.full_batch(),
+                                     share_indexes=True)
                 pin._txn_base_version = provider.data_version
                 self._txn_pins[key] = pin
             return pin
@@ -1003,7 +1015,7 @@ class Connection:
         work = self._txn_copy(provider, pin.full_batch())
         work._txn_key = key
         self._txn_writes[key] = {
-            "real": provider, "work": work, "key": key,
+            "real": provider, "work": work,
             "version": getattr(pin, "_txn_base_version",
                                provider.data_version),
             "ops": []}

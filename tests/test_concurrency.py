@@ -217,3 +217,43 @@ class TestSnapshotIsolation:
             c1.execute("COMMIT")
         assert e.value.sqlstate == "40001"
         assert c2.execute("SELECT count(*) FROM rc").scalar() == 0
+
+    def test_txn_snapshot_uses_search_index(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE sx (body TEXT)")
+        c1.execute("INSERT INTO sx VALUES ('quick fox'), ('lazy dog')")
+        c1.execute("CREATE INDEX ON sx USING inverted (body)")
+        c1.execute("BEGIN")
+        assert c1.execute(
+            "SELECT count(*) FROM sx WHERE body @@ 'quick'").scalar() == 1
+        # concurrent write does not disturb the pinned indexed snapshot
+        c2.execute("INSERT INTO sx VALUES ('quick wit')")
+        assert c1.execute(
+            "SELECT count(*) FROM sx WHERE body @@ 'quick'").scalar() == 1
+        c1.execute("COMMIT")
+        assert c1.execute(
+            "SELECT count(*) FROM sx WHERE body @@ 'quick'").scalar() == 2
+
+    def test_alter_table_in_txn_is_autocommit(self):
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE at (a INT)")
+        c1.execute("INSERT INTO at VALUES (1)")
+        c1.execute("BEGIN")
+        c1.execute("ALTER TABLE at ADD COLUMN b INT")
+        c1.execute("COMMIT")
+        # column survives COMMIT (previously silently lost)
+        assert "b" in [r[0] for r in c2.execute(
+            "SELECT column_name FROM information_schema.columns "
+            "WHERE table_name = 'at'").rows()]
+        # RENAME in txn: the real table renames; no uncommitted rows leak
+        c1.execute("BEGIN")
+        c1.execute("INSERT INTO at VALUES (5, 5)")
+        c1.execute("ALTER TABLE at RENAME TO at2")
+        assert c2.execute("SELECT count(*) FROM at2").scalar() == 1
+        c1.execute("ROLLBACK")
+        assert c2.execute("SELECT count(*) FROM at2").scalar() == 1
+        # table is fully usable afterwards (no stale _txn_key KeyError)
+        c2.execute("INSERT INTO at2 VALUES (2, 2)")
+        assert c2.execute("SELECT count(*) FROM at2").scalar() == 2
