@@ -101,6 +101,10 @@ class Database(TableResolver):
         #: dictionaries registered by THIS database; released on close so
         #: process-global analyzer state never leaks across Databases
         self._tsdict_names: set[str] = set()
+        # live sessions for pg_stat_activity (id → info dict); entries
+        # are removed by Connection.close()/finalizer
+        self.sessions: dict[int, dict] = {}
+        self._session_seq = 0
         self.store = None
         self.maintenance = None
         if path is not None:
@@ -511,6 +515,16 @@ class Connection:
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
+        import time
+        import weakref
+        with db.lock:
+            db._session_seq += 1
+            self._session_id = db._session_seq
+            db.sessions[self._session_id] = {
+                "pid": self._session_id, "usename": self.session_role,
+                "application_name": "", "state": "idle", "query": "",
+                "backend_start": time.time(), "query_start": None}
+        weakref.finalize(self, db.sessions.pop, self._session_id, None)
 
     # -- public API --------------------------------------------------------
 
@@ -523,17 +537,32 @@ class Connection:
         stmts = parser.parse(sql)  # cached copy-on-read in the parser
         out = []
         for st in stmts:
-            out.append(self.execute_statement(st, params or []))
+            out.append(self.execute_statement(st, params or [],
+                                              sql_text=sql))
         return out
 
-    def execute_statement(self, st: ast.Statement,
-                          params: list) -> QueryResult:
+    def close(self):
+        """Deterministically retire this session from pg_stat_activity
+        (the weakref finalizer is only the GC backstop)."""
+        self.db.sessions.pop(self._session_id, None)
+
+    def execute_statement(self, st: ast.Statement, params: list,
+                          sql_text: Optional[str] = None) -> QueryResult:
         if self.txn_failed and not isinstance(st, ast.Transaction):
             raise errors.SqlError(
                 errors.IN_FAILED_TRANSACTION,
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         token = CURRENT_CONNECTION.set(self)
+        sess = self.db.sessions.get(self._session_id)
+        if sess is not None:
+            import time
+            sess["state"] = "active"
+            sess["query"] = sql_text if sql_text is not None \
+                else type(st).__name__
+            sess["query_start"] = time.time()
+            sess["application_name"] = \
+                str(self.settings.get("application_name") or "")
         try:
             with metrics.QUERIES_ACTIVE.scoped():
                 return self._dispatch(st, params)
@@ -543,6 +572,9 @@ class Connection:
             raise
         finally:
             CURRENT_CONNECTION.reset(token)
+            if sess is not None:
+                sess["state"] = ("idle in transaction"
+                                 if self.in_txn else "idle")
 
     # -- dispatch ----------------------------------------------------------
 

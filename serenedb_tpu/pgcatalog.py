@@ -37,6 +37,24 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
             "schemaname": [r[0] for r in rows if r[2] == "view"],
             "viewname": [r[1] for r in rows if r[2] == "view"],
         }))
+    if name == "pg_stat_activity":
+        from .sql.binder import format_timestamp
+        with db.lock:
+            sess = [dict(v) for v in db.sessions.values()]
+        sess.sort(key=lambda v: v["pid"])
+
+        def ts(v):
+            return (format_timestamp(int(v * 1_000_000))
+                    if v is not None else None)
+        return MemTable("pg_stat_activity", Batch.from_pydict({
+            "pid": [v["pid"] for v in sess],
+            "usename": [v["usename"] for v in sess],
+            "application_name": [v["application_name"] for v in sess],
+            "state": [v["state"] for v in sess],
+            "query": [v["query"] for v in sess],
+            "backend_start": [ts(v["backend_start"]) for v in sess],
+            "query_start": [ts(v["query_start"]) for v in sess],
+        }))
     if name == "pg_namespace":
         names = sorted(db.schemas)
         return MemTable("pg_namespace", Batch.from_pydict({

@@ -14,6 +14,7 @@ Message framing: [type:1][len:4 incl itself][payload]; startup has no type.
 from __future__ import annotations
 
 import asyncio
+import functools
 import os
 import secrets
 import struct
@@ -253,6 +254,8 @@ class PgSession:
                 pass
             finally:
                 self.server.unregister_cancel(self.pid, self.secret)
+                if self.conn is not None:
+                    self.conn.close()
                 self.w.t.close()
 
     async def _startup(self) -> bool:
@@ -324,6 +327,9 @@ class PgSession:
                 self.conn.settings.set(k, v)
             except (KeyError, ValueError):
                 pass
+        # the session registry id IS the backend pid clients see: a
+        # BackendKeyData pid must find its own row in pg_stat_activity
+        self.pid = self.conn._session_id
         self.w.auth_ok()
         for k, v in [("server_version", "16.0 (serenedb_tpu)"),
                      ("server_encoding", "UTF8"),
@@ -423,7 +429,9 @@ class PgSession:
                     await self._run_copy(st)
                     continue
                 res = await loop.run_in_executor(
-                    self.server.pool, self.conn.execute_statement, st, [])
+                    self.server.pool,
+                    functools.partial(self.conn.execute_statement, st, [],
+                                      sql_text=sql))
                 self._send_result(res, describe=True)
         except errors.SqlError as e:
             self._note_error()
@@ -636,8 +644,10 @@ class PgSession:
             if portal.pending is None:
                 st = portal.prepared.statements[0]
                 portal.pending = await loop.run_in_executor(
-                    self.server.pool, self.conn.execute_statement, st,
-                    portal.params)
+                    self.server.pool,
+                    functools.partial(self.conn.execute_statement, st,
+                                      portal.params,
+                                      sql_text=portal.prepared.sql))
                 portal.sent = 0
             res = portal.pending
             total = res.batch.num_rows

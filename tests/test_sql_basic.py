@@ -304,3 +304,22 @@ def test_parse_cache_does_not_corrupt_reexecution():
     first = c.execute(q).rows()
     second = c.execute(q).rows()
     assert first == second == [(1, 101), (2, 102)]
+
+
+def test_pg_stat_activity():
+    import gc
+    from serenedb_tpu.engine import Database
+    db = Database()
+    c1, c2 = db.connect(), db.connect()
+    c2.execute("BEGIN")
+    rows = c1.execute("SELECT pid, usename, state, query "
+                      "FROM pg_stat_activity ORDER BY pid").rows()
+    assert len(rows) == 2
+    assert rows[0][2] == "active"
+    assert rows[0][3].startswith("SELECT pid")   # full SQL text, like PG
+    assert rows[1][2] == "idle in transaction"
+    c2.execute("ROLLBACK")
+    del c2
+    gc.collect()
+    assert c1.execute(
+        "SELECT count(*) FROM pg_stat_activity").scalar() == 1
