@@ -1391,3 +1391,75 @@ def _array_to_string(ts):
             np.asarray(out, dtype=object).astype(str),
             propagate_nulls(cols))
     return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("json_typeof")
+def _json_typeof(ts):
+    if not ts or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        docs = string_values(cols[0])
+        out = []
+        bad = np.zeros(n, dtype=bool)
+        for i in range(n):
+            try:
+                v = json.loads(docs[i])
+            except json.JSONDecodeError:
+                out.append("")
+                bad[i] = True
+                continue
+            out.append("null" if v is None else
+                       "boolean" if isinstance(v, bool) else
+                       "number" if isinstance(v, (int, float)) else
+                       "string" if isinstance(v, str) else
+                       "array" if isinstance(v, list) else "object")
+        col = make_string_column(np.asarray(out, dtype=object).astype(str),
+                                 propagate_nulls(cols))
+        if bad.any():
+            v = col.valid_mask() & ~bad
+            col = Column(dt.VARCHAR, col.data,
+                         None if v.all() else v, col.dictionary)
+        return col
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("json_array_length")
+def _json_array_length(ts):
+    if not ts or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        arrs = _array_rows(cols[0], n)
+        data = np.asarray([len(a) if a is not None else 0 for a in arrs],
+                          dtype=np.int32)
+        return _result(dt.INT, data, cols)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("json_object_keys")
+def _json_object_keys(ts):
+    """Keys of a JSON object as a JSON array (PG's set-returning variant
+    maps onto unnest(json_object_keys(x)))."""
+    if not ts or not _stringish(ts[0]):
+        return None
+
+    def impl(cols, n):
+        docs = string_values(cols[0])
+        out = []
+        for i in range(n):
+            try:
+                v = json.loads(docs[i])
+            except json.JSONDecodeError:
+                raise errors.SqlError(
+                    errors.INVALID_TEXT_REPRESENTATION,
+                    f"invalid JSON: {docs[i][:40]!r}")
+            if not isinstance(v, dict):
+                raise errors.SqlError(
+                    errors.INVALID_TEXT_REPRESENTATION,
+                    "json_object_keys expects a JSON object")
+            out.append(json.dumps(list(v.keys())))
+        return make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
