@@ -1400,9 +1400,13 @@ def _json_typeof(ts):
 
     def impl(cols, n):
         docs = string_values(cols[0])
+        valid = propagate_nulls(cols)
         out = []
         bad = np.zeros(n, dtype=bool)
         for i in range(n):
+            if valid is not None and not valid[i]:
+                out.append("")
+                continue
             try:
                 v = json.loads(docs[i])
             except json.JSONDecodeError:
@@ -1415,7 +1419,7 @@ def _json_typeof(ts):
                        "string" if isinstance(v, str) else
                        "array" if isinstance(v, list) else "object")
         col = make_string_column(np.asarray(out, dtype=object).astype(str),
-                                 propagate_nulls(cols))
+                                 valid)
         if bad.any():
             v = col.valid_mask() & ~bad
             col = Column(dt.VARCHAR, col.data,
@@ -1446,8 +1450,12 @@ def _json_object_keys(ts):
 
     def impl(cols, n):
         docs = string_values(cols[0])
+        valid = propagate_nulls(cols)
         out = []
         for i in range(n):
+            if valid is not None and not valid[i]:
+                out.append("")
+                continue
             try:
                 v = json.loads(docs[i])
             except json.JSONDecodeError:
@@ -1460,6 +1468,5 @@ def _json_object_keys(ts):
                     "json_object_keys expects a JSON object")
             out.append(json.dumps(list(v.keys())))
         return make_string_column(
-            np.asarray(out, dtype=object).astype(str),
-            propagate_nulls(cols))
+            np.asarray(out, dtype=object).astype(str), valid)
     return FunctionResolution(dt.VARCHAR, impl)
