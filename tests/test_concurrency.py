@@ -257,3 +257,40 @@ class TestSnapshotIsolation:
         # table is fully usable afterwards (no stale _txn_key KeyError)
         c2.execute("INSERT INTO at2 VALUES (2, 2)")
         assert c2.execute("SELECT count(*) FROM at2").scalar() == 2
+
+    def test_concurrent_txn_increments_lose_nothing(self):
+        # classic lost-update check: N threads x M increments in txns with
+        # retry-on-40001 must sum exactly
+        db = Database()
+        c0 = db.connect()
+        c0.execute("CREATE TABLE ctr (v INT)")
+        c0.execute("INSERT INTO ctr VALUES (0)")
+        N_THREADS, N_INCR = 4, 12
+        errs = []
+
+        def worker():
+            c = db.connect()
+            for _ in range(N_INCR):
+                for attempt in range(60):
+                    try:
+                        c.execute("BEGIN")
+                        c.execute("UPDATE ctr SET v = v + 1")
+                        c.execute("COMMIT")
+                        break
+                    except SqlError as e:
+                        if e.sqlstate != "40001":
+                            errs.append(e)
+                            return
+                        # aborted: txn state already cleared; retry
+                else:
+                    errs.append(RuntimeError("retries exhausted"))
+                    return
+
+        ts = [threading.Thread(target=worker) for _ in range(N_THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs, errs[:2]
+        assert c0.execute("SELECT v FROM ctr").scalar() == \
+            N_THREADS * N_INCR
