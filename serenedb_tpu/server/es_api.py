@@ -533,6 +533,52 @@ class EsApi:
                     "sqlstate": e.sqlstate}, "status": 400})
         return {"took": 1, "responses": responses}
 
+    def analyze(self, body: Optional[dict],
+                default_index: Optional[str] = None) -> dict:
+        """_analyze: run an analyzer over text and return the tokens
+        (reference: the analyzer-introspection REST action). ES's
+        "standard" maps to our "simple" (lowercase word split, no
+        stemming)."""
+        from ..search.analysis import dictionary_exists, get_analyzer
+        body = body or {}
+        if not isinstance(body, dict):
+            raise EsError(400, "parsing_exception",
+                          "_analyze body must be a JSON object")
+        text = body.get("text", "")
+        if isinstance(text, list):
+            text = " ".join(str(t) for t in text)
+        name = body.get("analyzer")
+        if name is None and default_index is not None:
+            # ES precedence: explicit analyzer > field's analyzer > index
+            # default — resolve through the index's inverted indexes
+            t = self._table(default_index)   # 404 for unknown index
+            field = body.get("field")
+            name = "text"
+            for idx in getattr(t, "indexes", {}).values():
+                fn = getattr(idx, "analyzer_name_for", None)
+                if fn is None:
+                    continue
+                if field is not None:
+                    if field in getattr(idx, "columns", ()):
+                        name = fn(field)
+                        break
+                elif idx.columns:
+                    name = fn(idx.columns[0])
+                    break
+        name = str(name if name is not None else "standard")
+        if name == "standard" and not dictionary_exists("standard"):
+            name = "simple"   # ES "standard" = lowercase word split
+        try:
+            an = get_analyzer(name)
+        except errors.SqlError:
+            raise EsError(400, "illegal_argument_exception",
+                          f"failed to find global analyzer [{name}]")
+        return {"tokens": [
+            {"token": t.term, "start_offset": t.start,
+             "end_offset": t.end, "type": "<ALPHANUM>",
+             "position": t.position}
+            for t in an.tokenize(str(text))]}
+
     def cat_health(self) -> list[dict]:
         h = self.cluster_health()
         return [{"cluster": h["cluster_name"], "status": h["status"],

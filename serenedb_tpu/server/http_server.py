@@ -111,6 +111,9 @@ class Handler(BaseHTTPRequestHandler):
         if p[0] == "_msearch" and method == "POST":
             self._send(200, es.msearch(self._body()))
             return
+        if p[0] == "_analyze" and method in ("GET", "POST"):
+            self._send(200, es.analyze(self._json_body()))
+            return
         if p[0] == "_bulk" and method == "POST":
             self._send(200, es.bulk(self._body()))
             return
@@ -196,6 +199,9 @@ class Handler(BaseHTTPRequestHandler):
             return
         if verb == "_msearch" and method == "POST":
             self._send(200, es.msearch(self._body(), default_index=index))
+            return
+        if verb == "_analyze" and method in ("GET", "POST"):
+            self._send(200, es.analyze(self._json_body(), index))
             return
         if verb == "_stats":
             self._send(200, es.stats(index))

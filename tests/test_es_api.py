@@ -399,3 +399,48 @@ def test_cat_indices_text_four_columns(srv):
     assert status == 200
     line = next(ln for ln in body.splitlines() if " ms " in f" {ln} ")
     assert line.split() == ["green", "open", "ms", "3"]
+
+
+def test_analyze(srv):
+    status, body = req(srv, "POST", "/_analyze",
+                       {"analyzer": "standard", "text": "Quick-Brown Foxes"})
+    assert status == 200
+    toks = [t["token"] for t in body["tokens"]]
+    assert toks == ["quick", "brown", "foxes"]
+    assert body["tokens"][0]["start_offset"] == 0
+    # stemming analyzer
+    status, body = req(srv, "POST", "/_analyze",
+                       {"analyzer": "text", "text": "running dogs"})
+    assert [t["token"] for t in body["tokens"]] == ["runn", "dog"]
+    # unknown analyzer
+    status, body = req(srv, "POST", "/_analyze",
+                       {"analyzer": "nope", "text": "x"})
+    assert status == 400
+    # empty body → no tokens
+    status, body = req(srv, "POST", "/_analyze", {})
+    assert status == 200 and body["tokens"] == []
+
+
+def test_analyze_index_scoped(srv):
+    req(srv, "PUT", "/anz")
+    req(srv, "PUT", "/anz/_doc/1", {"body": "running dogs"})
+    # index-scoped without explicit analyzer uses the index's analyzer
+    # (inverted default "text": stemming) — the terms the index stores
+    status, body = req(srv, "POST", "/anz/_analyze",
+                       {"text": "running dogs"})
+    assert status == 200
+    assert [t["token"] for t in body["tokens"]] == ["runn", "dog"]
+    # field routing
+    status, body = req(srv, "POST", "/anz/_analyze",
+                       {"field": "body", "text": "running"})
+    assert [t["token"] for t in body["tokens"]] == ["runn"]
+    # explicit analyzer wins
+    status, body = req(srv, "POST", "/anz/_analyze",
+                       {"analyzer": "keyword", "text": "One Two"})
+    assert [t["token"] for t in body["tokens"]] == ["One Two"]
+    # unknown index 404s
+    status, body = req(srv, "POST", "/ghost_idx/_analyze", {"text": "x"})
+    assert status == 404
+    # non-object body is a 400, not a 500
+    status, body = req(srv, "POST", "/_analyze", '"hello"')
+    assert status == 400
