@@ -80,7 +80,10 @@ def _rand_query(rng) -> str:
     aggs = list(rng.choice(
         ["count(*)", "count(a)", "sum(a)", "sum(b)", "min(a)", "max(g)",
          "avg(a)"], size=rng.integers(1, 4), replace=False))
-    shape = rng.integers(0, 5)
+    shape = rng.integers(0, 6)
+    if shape == 5:   # string group keys (dictionary codes on device)
+        return (f"SELECT s, count(*), sum(b) FROM fz WHERE {pred} "
+                "GROUP BY s ORDER BY s NULLS LAST")
     if shape == 0:
         return f"SELECT {', '.join(aggs)} FROM fz WHERE {pred}"
     if shape == 1:
