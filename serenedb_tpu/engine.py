@@ -512,6 +512,7 @@ class Connection:
         # (key → {"real", "work", "version", "ops"}), live only in a txn
         self._txn_pins: dict[str, MemTable] = {}
         self._txn_writes: dict[str, dict] = {}
+        self._txn_savepoints: list[tuple] = []   # (name, {key: ops_len})
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
@@ -1056,6 +1057,7 @@ class Connection:
     def _txn_clear(self):
         self._txn_pins = {}
         self._txn_writes = {}
+        self._txn_savepoints = []
 
     def _txn_commit_writes(self):
         """First-committer-wins publish: conflict check, one atomic WAL
@@ -1213,6 +1215,8 @@ class Connection:
         return QueryResult(b, "SHOW")
 
     def _txn(self, st: ast.Transaction) -> QueryResult:
+        if st.action in ("savepoint", "release", "rollback_to"):
+            return self._txn_savepoint_stmt(st)
         if st.action == "begin":
             if self.in_txn:
                 # PG: WARNING, there is already a transaction in progress —
@@ -1233,6 +1237,60 @@ class Connection:
             return QueryResult(Batch([], []), "COMMIT")
         # ROLLBACK, or COMMIT of a failed txn (PG answers ROLLBACK)
         self._txn_clear()
+        return QueryResult(Batch([], []), "ROLLBACK")
+
+    def _txn_savepoint_stmt(self, st: ast.Transaction) -> QueryResult:
+        """SAVEPOINT / RELEASE / ROLLBACK TO over the txn op buffer: a
+        savepoint records each written table's op-count; rolling back
+        truncates the op streams and rebuilds the working copies from the
+        pins (and, per PG, un-fails an aborted transaction)."""
+        name = (st.savepoint or "").lower()
+        if not self.in_txn:
+            raise errors.SqlError(
+                "25P01", f"{st.action.upper().replace('_', ' ')} can only "
+                "be used in transaction blocks")
+        if st.action == "savepoint":
+            if self.txn_failed:
+                raise errors.SqlError(
+                    errors.IN_FAILED_TRANSACTION,
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            self._txn_savepoints.append(
+                (name, {k: len(w["ops"])
+                        for k, w in self._txn_writes.items()}))
+            return QueryResult(Batch([], []), "SAVEPOINT")
+        idx = next((i for i in range(len(self._txn_savepoints) - 1, -1, -1)
+                    if self._txn_savepoints[i][0] == name), None)
+        if idx is None:
+            raise errors.SqlError(
+                "3B001", f'savepoint "{st.savepoint}" does not exist')
+        if st.action == "release":
+            if self.txn_failed:
+                # PG: only ROLLBACK TO may run in an aborted txn —
+                # RELEASE would destroy the recovery point
+                raise errors.SqlError(
+                    errors.IN_FAILED_TRANSACTION,
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            # PG: releasing a savepoint also releases everything above it
+            del self._txn_savepoints[idx:]
+            return QueryResult(Batch([], []), "RELEASE")
+        # rollback_to: truncate ops, rebuild working copies, un-fail
+        marks = self._txn_savepoints[idx][1]
+        del self._txn_savepoints[idx + 1:]
+        for key, w in list(self._txn_writes.items()):
+            keep = marks.get(key, 0)
+            if len(w["ops"]) != keep:
+                w["ops"] = w["ops"][:keep]
+                pin = self._txn_pins[key]
+                w["work"].replace(pin.full_batch())
+                _apply_ops(w["work"], w["ops"])
+            if not w["ops"]:
+                # net-zero writes: drop the entry so COMMIT's conflict
+                # check never 40001s on a table this txn no longer touches
+                # (the pin stays for snapshot reads)
+                del self._txn_writes[key]
+        self.txn_failed = False
         return QueryResult(Batch([], []), "ROLLBACK")
 
     def _explain(self, st: ast.Explain, params: list) -> QueryResult:

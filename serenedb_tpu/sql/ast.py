@@ -326,7 +326,9 @@ class ShowStmt(Statement):
 
 @dataclass
 class Transaction(Statement):
-    action: str                       # 'begin' | 'commit' | 'rollback'
+    action: str                       # begin|commit|rollback|savepoint|
+                                      # rollback_to|release
+    savepoint: Optional[str] = None
 
 
 @dataclass

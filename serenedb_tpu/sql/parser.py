@@ -132,7 +132,17 @@ class Parser:
         if self.at_kw("ROLLBACK", "ABORT"):
             self.next()
             self.accept_kw("TRANSACTION") or self.accept_kw("WORK")
+            if self.accept_kw("TO"):
+                self.accept_kw("SAVEPOINT")
+                return ast.Transaction("rollback_to", self.ident())
             return ast.Transaction("rollback")
+        if self.at_kw("SAVEPOINT"):
+            self.next()
+            return ast.Transaction("savepoint", self.ident())
+        if self.at_kw("RELEASE"):
+            self.next()
+            self.accept_kw("SAVEPOINT")
+            return ast.Transaction("release", self.ident())
         if self.at_kw("EXPLAIN"):
             self.next()
             analyze = self.accept_kw("ANALYZE")

@@ -294,3 +294,94 @@ class TestSnapshotIsolation:
         assert not errs, errs[:2]
         assert c0.execute("SELECT v FROM ctr").scalar() == \
             N_THREADS * N_INCR
+
+    def test_savepoints(self):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE sp (a INT)")
+        c.execute("BEGIN")
+        c.execute("INSERT INTO sp VALUES (1)")
+        c.execute("SAVEPOINT s1")
+        c.execute("INSERT INTO sp VALUES (2)")
+        c.execute("SAVEPOINT s2")
+        c.execute("DELETE FROM sp")
+        assert c.execute("SELECT count(*) FROM sp").scalar() == 0
+        c.execute("ROLLBACK TO s2")
+        assert c.execute("SELECT count(*) FROM sp").scalar() == 2
+        c.execute("ROLLBACK TO SAVEPOINT s1")
+        assert c.execute("SELECT count(*) FROM sp").scalar() == 1
+        c.execute("RELEASE s1")
+        with pytest.raises(SqlError) as e:
+            c.execute("ROLLBACK TO s1")   # released: gone, and the error
+        assert e.value.sqlstate == "3B001"
+        # ... aborts the txn (PG semantics) so COMMIT rolls back
+        assert c.execute("COMMIT").command_tag == "ROLLBACK"
+        assert c.execute("SELECT a FROM sp").rows() == []
+        # clean txn: the kept work commits
+        c.execute("BEGIN")
+        c.execute("INSERT INTO sp VALUES (1)")
+        c.execute("SAVEPOINT s1")
+        c.execute("INSERT INTO sp VALUES (2)")
+        c.execute("ROLLBACK TO s1")
+        c.execute("COMMIT")
+        assert c.execute("SELECT a FROM sp").rows() == [(1,)]
+
+    def test_savepoint_recovers_failed_txn(self):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE spf (a INT)")
+        c.execute("BEGIN")
+        c.execute("INSERT INTO spf VALUES (1)")
+        c.execute("SAVEPOINT s")
+        c.execute("INSERT INTO spf VALUES (2)")
+        with pytest.raises(SqlError):
+            c.execute("SELECT 1/0")
+        with pytest.raises(SqlError) as e:
+            c.execute("SELECT 1")
+        assert e.value.sqlstate == "25P02"
+        c.execute("ROLLBACK TO s")          # PG: un-fails the txn
+        c.execute("INSERT INTO spf VALUES (3)")
+        c.execute("COMMIT")
+        assert sorted(c.execute("SELECT a FROM spf").rows()) == \
+            [(1,), (3,)]
+
+    def test_savepoint_errors(self):
+        db = Database()
+        c = db.connect()
+        with pytest.raises(SqlError) as e:
+            c.execute("SAVEPOINT x")
+        assert e.value.sqlstate == "25P01"
+        c.execute("BEGIN")
+        with pytest.raises(SqlError) as e:
+            c.execute("RELEASE nope")
+        assert e.value.sqlstate == "3B001"
+        c.execute("ROLLBACK")
+
+    def test_rolled_back_writes_do_not_conflict(self):
+        # review finding: a net-zero ROLLBACK TO left the table in the
+        # conflict check -> spurious 40001
+        db = Database()
+        c1, c2 = db.connect(), db.connect()
+        c1.execute("CREATE TABLE za (a INT)")
+        c1.execute("CREATE TABLE zb (a INT)")
+        c1.execute("BEGIN")
+        c1.execute("SAVEPOINT s")
+        c1.execute("INSERT INTO za VALUES (1)")
+        c1.execute("ROLLBACK TO s")          # net-zero on za
+        c2.execute("INSERT INTO za VALUES (9)")
+        c1.execute("INSERT INTO zb VALUES (2)")
+        c1.execute("COMMIT")                 # must not 40001
+        assert c2.execute("SELECT count(*) FROM zb").scalar() == 1
+
+    def test_release_rejected_in_failed_txn(self):
+        db = Database()
+        c = db.connect()
+        c.execute("BEGIN")
+        c.execute("SAVEPOINT s")
+        with pytest.raises(SqlError):
+            c.execute("SELECT 1/0")
+        with pytest.raises(SqlError) as e:
+            c.execute("RELEASE s")
+        assert e.value.sqlstate == "25P02"
+        c.execute("ROLLBACK TO s")           # the recovery point survives
+        c.execute("COMMIT")
