@@ -105,6 +105,10 @@ class Database(TableResolver):
         # are removed by Connection.close()/finalizer
         self.sessions: dict[int, dict] = {}
         self._session_seq = 0
+        # LISTEN/NOTIFY bus: channel → {Connection}; notifications land in
+        # each listener's thread-safe deque and drain at statement
+        # boundaries (pgwire sends NotificationResponse before ready)
+        self._listeners: dict[str, set] = {}
         self.store = None
         self.maintenance = None
         if path is not None:
@@ -513,6 +517,16 @@ class Connection:
         self._txn_pins: dict[str, MemTable] = {}
         self._txn_writes: dict[str, dict] = {}
         self._txn_savepoints: list[tuple] = []   # (name, {key: ops_len})
+        from collections import deque
+        self._listen_channels: set[str] = set()
+        #: bounded: a never-draining idle listener must not grow without
+        #: limit (oldest notifications drop past the cap)
+        self._notifications = deque(maxlen=8192)
+        #: set by the wire session to wake an idle client (thread-safe)
+        self.notify_hook = None
+        #: LISTEN/UNLISTEN/NOTIFY deferred to COMMIT inside a txn (PG
+        #: queues them transactionally; ROLLBACK discards)
+        self._txn_actions: list[tuple] = []
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
@@ -546,6 +560,49 @@ class Connection:
         """Deterministically retire this session from pg_stat_activity
         (the weakref finalizer is only the GC backstop)."""
         self.db.sessions.pop(self._session_id, None)
+        with self.db.lock:
+            for ch in list(self._listen_channels):
+                lst = self.db._listeners.get(ch)
+                if lst is not None:
+                    lst.discard(self)
+                    if not lst:
+                        del self.db._listeners[ch]
+        self._listen_channels.clear()
+
+    def _apply_listen(self, action: str, channel: str):
+        with self.db.lock:
+            if action == "listen":
+                self._listen_channels.add(channel)
+                self.db._listeners.setdefault(channel, set()).add(self)
+                return
+            chans = [channel] if action == "unlisten" \
+                else list(self._listen_channels)
+            for ch in chans:
+                self._listen_channels.discard(ch)
+                lst = self.db._listeners.get(ch)
+                if lst is not None:
+                    lst.discard(self)
+                    if not lst:
+                        del self.db._listeners[ch]   # no channel-name leak
+
+    def _apply_notify(self, channel: str, payload: str):
+        with self.db.lock:
+            targets = list(self.db._listeners.get(channel, ()))
+        for conn in targets:
+            conn._notifications.append((self._session_id, channel, payload))
+            hook = conn.notify_hook
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass
+
+    def take_notifications(self) -> list[tuple]:
+        """Drain pending (sender_pid, channel, payload) notifications."""
+        out = []
+        while self._notifications:
+            out.append(self._notifications.popleft())
+        return out
 
     def execute_statement(self, st: ast.Statement, params: list,
                           sql_text: Optional[str] = None) -> QueryResult:
@@ -772,6 +829,22 @@ class Connection:
             return self._set(st)
         if isinstance(st, ast.ShowStmt):
             return self._show(st)
+        if isinstance(st, ast.ListenStmt):
+            if self.in_txn:
+                # PG defers LISTEN/UNLISTEN effects to COMMIT
+                self._txn_actions.append((st.action, st.channel, None))
+            else:
+                self._apply_listen(st.action, st.channel)
+            return QueryResult(Batch([], []),
+                               "LISTEN" if st.action == "listen"
+                               else "UNLISTEN")
+        if isinstance(st, ast.NotifyStmt):
+            if self.in_txn:
+                # PG queues NOTIFY until COMMIT; ROLLBACK discards it
+                self._txn_actions.append(("notify", st.channel, st.payload))
+            else:
+                self._apply_notify(st.channel, st.payload)
+            return QueryResult(Batch([], []), "NOTIFY")
         if isinstance(st, ast.Transaction):
             return self._txn(st)
         if isinstance(st, ast.Explain):
@@ -1058,6 +1131,7 @@ class Connection:
         self._txn_pins = {}
         self._txn_writes = {}
         self._txn_savepoints = []
+        self._txn_actions = []
 
     def _txn_commit_writes(self):
         """First-committer-wins publish: conflict check, one atomic WAL
@@ -1232,8 +1306,14 @@ class Connection:
         if st.action == "commit" and not was_failed:
             try:
                 self._txn_commit_writes()
+                actions = self._txn_actions
             finally:
                 self._txn_clear()
+            for action, channel, payload in actions:
+                if action == "notify":
+                    self._apply_notify(channel, payload)
+                else:
+                    self._apply_listen(action, channel)
             return QueryResult(Batch([], []), "COMMIT")
         # ROLLBACK, or COMMIT of a failed txn (PG answers ROLLBACK)
         self._txn_clear()
@@ -1257,7 +1337,8 @@ class Connection:
                     "until end of transaction block")
             self._txn_savepoints.append(
                 (name, {k: len(w["ops"])
-                        for k, w in self._txn_writes.items()}))
+                        for k, w in self._txn_writes.items()},
+                 len(self._txn_actions)))
             return QueryResult(Batch([], []), "SAVEPOINT")
         idx = next((i for i in range(len(self._txn_savepoints) - 1, -1, -1)
                     if self._txn_savepoints[i][0] == name), None)
@@ -1277,6 +1358,8 @@ class Connection:
             return QueryResult(Batch([], []), "RELEASE")
         # rollback_to: truncate ops, rebuild working copies, un-fail
         marks = self._txn_savepoints[idx][1]
+        self._txn_actions = \
+            self._txn_actions[:self._txn_savepoints[idx][2]]
         del self._txn_savepoints[idx + 1:]
         for key, w in list(self._txn_writes.items()):
             keep = marks.get(key, 0)

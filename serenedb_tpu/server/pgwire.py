@@ -143,6 +143,10 @@ class Writer:
     def auth_sasl_final(self, data: str):
         self.msg(b"R", struct.pack("!I", 12) + data.encode())
 
+    def notification(self, pid: int, channel: str, payload: str):
+        self.msg(b"A", struct.pack("!I", pid) + channel.encode() +
+                 b"\x00" + payload.encode() + b"\x00")
+
     def parameter_status(self, k: str, v: str):
         self.msg(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
 
@@ -330,6 +334,13 @@ class PgSession:
         # the session registry id IS the backend pid clients see: a
         # BackendKeyData pid must find its own row in pg_stat_activity
         self.pid = self.conn._session_id
+        # idle NOTIFY delivery: the engine bus wakes this loop from any
+        # thread; the task only writes while the session is idle (a
+        # client blocked in select() on the socket sees the 'A' push)
+        loop = asyncio.get_running_loop()
+        self._idle = False
+        self.conn.notify_hook = lambda: loop.call_soon_threadsafe(
+            lambda: loop.create_task(self._push_notifications()))
         self.w.auth_ok()
         for k, v in [("server_version", "16.0 (serenedb_tpu)"),
                      ("server_encoding", "UTF8"),
@@ -343,6 +354,7 @@ class PgSession:
             self.w.parameter_status(k, v)
         self.w.backend_key(self.pid, self.secret)
         self.server.register_cancel(self.pid, self.secret, self)
+        self._drain_notifications()
         self.w.ready(self._txn_status())
         await self.w.flush()
         return True
@@ -376,6 +388,25 @@ class PgSession:
             self.w.auth_sasl_final(final)
         return ok
 
+    async def _push_notifications(self):
+        """Async NotificationResponse push while the session is idle."""
+        if not self._idle or self.conn is None:
+            return   # mid-command: the boundary drain will deliver
+        try:
+            self._drain_notifications()
+            await self.w.flush()
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+    def _drain_notifications(self):
+        """NotificationResponse delivery at statement boundaries (PG also
+        delivers when idle; boundary delivery covers the standard driver
+        poll loop)."""
+        if self.conn is None:
+            return
+        for pid, channel, payload in self.conn.take_notifications():
+            self.w.notification(pid, channel, payload)
+
     def _txn_status(self) -> bytes:
         if self.conn is None:
             return b"I"
@@ -393,7 +424,9 @@ class PgSession:
 
     async def _command_loop(self):
         while True:
+            self._idle = True
             kind, payload = await self._read_msg()
+            self._idle = False
             if kind == b"X":
                 return
             if self.ignore_till_sync and kind not in (b"S",):
@@ -440,6 +473,7 @@ class PgSession:
             log.error("pg", f"internal error: {e!r}")
             self._note_error()
             self.w.error(errors.SqlError("XX000", f"internal error: {e}"))
+        self._drain_notifications()
         self.w.ready(self._txn_status())
         await self.w.flush()
 
@@ -693,6 +727,7 @@ class PgSession:
 
     async def _on_sync(self, payload: bytes):
         self.ignore_till_sync = False
+        self._drain_notifications()
         self.w.ready(self._txn_status())
         await self.w.flush()
 

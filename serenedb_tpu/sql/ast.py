@@ -325,6 +325,18 @@ class ShowStmt(Statement):
 
 
 @dataclass
+class ListenStmt(Statement):
+    channel: str
+    action: str = "listen"        # listen | unlisten | unlisten_all
+
+
+@dataclass
+class NotifyStmt(Statement):
+    channel: str
+    payload: str = ""
+
+
+@dataclass
 class Transaction(Statement):
     action: str                       # begin|commit|rollback|savepoint|
                                       # rollback_to|release

@@ -168,6 +168,24 @@ class Parser:
             self.next()
             self.accept_kw("TABLE")
             return ast.Truncate(self.qualified_name())
+        if self.at_kw("LISTEN"):
+            self.next()
+            return ast.ListenStmt(self.ident().lower())
+        if self.at_kw("UNLISTEN"):
+            self.next()
+            if self.accept_op("*"):
+                return ast.ListenStmt("", "unlisten_all")
+            return ast.ListenStmt(self.ident().lower(), "unlisten")
+        if self.at_kw("NOTIFY"):
+            self.next()
+            channel = self.ident().lower()
+            payload = ""
+            if self.accept_op(","):
+                t = self.next()
+                if t.kind is not T.STRING:
+                    raise errors.syntax("NOTIFY payload must be a string")
+                payload = t.value
+            return ast.NotifyStmt(channel, payload)
         if self.at_kw("VALUES"):
             return self.parse_select()
         raise errors.syntax(f"unsupported statement near {self.peek().value!r}")

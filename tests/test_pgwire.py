@@ -616,3 +616,86 @@ def test_scram_login_after_password_rotation():
         admin.close()
     finally:
         stop()
+
+
+def test_listen_notify(server):
+    listener = RawPg(server.port)
+    sender = RawPg(server.port)
+    assert listener.query("LISTEN events")[2] == ["LISTEN"]
+    assert sender.query("NOTIFY events, 'payload-1'")[2] == ["NOTIFY"]
+    # notification arrives at the listener's next statement boundary
+    listener.send(b"Q", b"SELECT 1\x00")
+    got = []
+    while True:
+        kind, payload = listener.read_msg()
+        if kind == b"A":
+            pid = struct.unpack("!I", payload[:4])[0]
+            channel, load = payload[4:-1].split(b"\x00")[:2]
+            got.append((pid, channel.decode(), load.decode()))
+        elif kind == b"Z":
+            break
+    assert got == [(sender.backend_key[0], "events", "payload-1")]
+    # UNLISTEN stops delivery
+    listener.query("UNLISTEN events")
+    sender.query("NOTIFY events, 'after'")
+    kinds = []
+    listener.send(b"Q", b"SELECT 1\x00")
+    while True:
+        kind, _ = listener.read_msg()
+        kinds.append(kind)
+        if kind == b"Z":
+            break
+    assert b"A" not in kinds
+    # notify with no listeners is a no-op; self-notify works
+    sender.query("NOTIFY nowhere")
+    sender.query("LISTEN selfchan")
+    # self-notify is delivered at the NOTIFY's own statement boundary
+    sender.send(b"Q", b"NOTIFY selfchan, 'me'\x00")
+    got = []
+    while True:
+        kind, payload = sender.read_msg()
+        if kind == b"A":
+            got.append(payload[4:-1].split(b"\x00")[1].decode())
+        elif kind == b"Z":
+            break
+    assert got == ["me"]
+    listener.close()
+    sender.close()
+
+
+def test_notify_pushed_to_idle_listener(server):
+    import select
+    lis, snd = RawPg(server.port), RawPg(server.port)
+    lis.query("LISTEN idlechan")
+    snd.query("NOTIFY idlechan, 'wake'")
+    # listener sends NOTHING: the 'A' must arrive as an async push
+    ready, _, _ = select.select([lis.sock], [], [], 5.0)
+    assert ready, "no async NotificationResponse within 5s"
+    kind, payload = lis.read_msg()
+    assert kind == b"A"
+    assert payload[4:-1].split(b"\x00")[:2] == [b"idlechan", b"wake"]
+    lis.close()
+    snd.close()
+
+
+def test_notify_in_txn_is_transactional(server):
+    lis, snd = RawPg(server.port), RawPg(server.port)
+    lis.query("LISTEN txchan")
+    snd.query("BEGIN")
+    snd.query("NOTIFY txchan, 'rolled-back'")
+    snd.query("ROLLBACK")
+    snd.query("BEGIN")
+    snd.query("NOTIFY txchan, 'committed'")
+    snd.query("COMMIT")
+    import select
+    ready, _, _ = select.select([lis.sock], [], [], 5.0)
+    assert ready
+    kind, payload = lis.read_msg()
+    assert kind == b"A"
+    # only the committed txn's notification arrives
+    assert payload[4:-1].split(b"\x00")[1] == b"committed"
+    # nothing else pending
+    ready, _, _ = select.select([lis.sock], [], [], 0.3)
+    assert not ready
+    lis.close()
+    snd.close()
