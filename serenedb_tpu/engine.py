@@ -516,7 +516,7 @@ class Connection:
         # (key → {"real", "work", "version", "ops"}), live only in a txn
         self._txn_pins: dict[str, MemTable] = {}
         self._txn_writes: dict[str, dict] = {}
-        self._txn_savepoints: list[tuple] = []   # (name, {key: ops_len})
+        self._txn_savepoints: list[tuple] = []   # (name, {key: ops_len}, actions_len)
         from collections import deque
         self._listen_channels: set[str] = set()
         #: bounded: a never-draining idle listener must not grow without
@@ -1161,6 +1161,8 @@ class Connection:
 
     def _insert(self, st: ast.Insert, params: list) -> QueryResult:
         table = self._table_for_dml(st.table)
+        if st.returning:
+            self.db.resolve_table(st.table, "select")   # PG: RETURNING reads
         target_names = st.columns or table.column_names
         for c in target_names:
             if c not in table.column_names:
@@ -1168,6 +1170,14 @@ class Connection:
                                       f'column "{c}" does not exist')
         if st.query is not None:
             incoming = self._run_select(st.query, params)
+            if incoming.num_columns != len(target_names):
+                raise errors.SqlError(
+                    "42601", "INSERT has more expressions than columns"
+                    if incoming.num_columns > len(target_names)
+                    else "INSERT has more target columns than expressions")
+            # PG maps SELECT output to target columns POSITIONALLY —
+            # matching by name would silently insert NULLs
+            incoming = Batch(list(target_names), list(incoming.columns))
         else:
             binder = ExprBinder(Scope([]), params)
             one = Batch(["__dummy"], [Column.from_pylist([0])])
@@ -1183,11 +1193,17 @@ class Connection:
                     cols_vals[k].append(b.eval(one).decode(0))
             incoming = Batch(list(target_names),
                              [Column.from_pylist(v) for v in cols_vals])
-        self._insert_batch(table, incoming)
-        return QueryResult(Batch([], []), f"INSERT 0 {incoming.num_rows}")
+        aligned = self._insert_batch(table, incoming)
+        tag = f"INSERT 0 {incoming.num_rows}"
+        if st.returning:
+            return QueryResult(self._returning_batch(
+                st.returning, table, aligned, params), tag)
+        return QueryResult(Batch([], []), tag)
 
     def _delete(self, st: ast.Delete, params: list) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
+        if st.returning:
+            self.db.resolve_table(st.table, "select")
         with self.db.lock:
             full = table.full_batch()
             if st.where is None:
@@ -1203,8 +1219,13 @@ class Connection:
             self._wal_commit(table, [("delete", None, rows)])
             mask = np.ones(full.num_rows, dtype=bool)
             mask[rows] = False
+            deleted = full.take(rows) if st.returning else None
             table.replace(full.filter(mask))
-        return QueryResult(Batch([], []), f"DELETE {n}")
+        tag = f"DELETE {n}"
+        if st.returning:
+            return QueryResult(self._returning_batch(
+                st.returning, table, deleted, params), tag)
+        return QueryResult(Batch([], []), tag)
 
     def _update(self, st: ast.Update, params: list) -> QueryResult:
         """UPDATE = delete + re-append of the affected rows (matching the
@@ -1212,6 +1233,8 @@ class Connection:
         live row order — the reference does the same remove+insert in its
         search DML, duckdb_physical_search_update.*)."""
         table = self._table_for_dml(st.table, "update")
+        if st.returning:
+            self.db.resolve_table(st.table, "select")
         with self.db.lock:
             full = table.full_batch()
             scope = Scope.of(list(full.names), [c.type for c in full.columns],
@@ -1224,7 +1247,7 @@ class Connection:
                 mask = np.ones(full.num_rows, dtype=bool)
             rows = np.flatnonzero(mask)
             n = len(rows)
-            if n == 0:
+            if n == 0 and not st.returning:
                 return QueryResult(Batch([], []), "UPDATE 0")
             updated = full.take(rows)
             new_cols = {}
@@ -1244,7 +1267,11 @@ class Connection:
             mask_keep[rows] = False
             table.replace(full.filter(mask_keep))
             _append_rows(table, updated)
-        return QueryResult(Batch([], []), f"UPDATE {n}")
+        tag = f"UPDATE {n}"
+        if st.returning:
+            return QueryResult(self._returning_batch(
+                st.returning, table, updated, params), tag)
+        return QueryResult(Batch([], []), tag)
 
     def _truncate(self, st: ast.Truncate) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
@@ -1552,11 +1579,50 @@ class Connection:
         self._insert_batch(table, sub)
         return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
 
-    def _insert_batch(self, table: MemTable, incoming: Batch):
+    def _describe_returning(self, st, params: list):
+        """(names, types) of a DML RETURNING clause without executing —
+        bound against the target table's schema (Describe support)."""
+        provider = self.db.resolve_table(st.table)
+        scope = Scope.of(list(provider.column_names),
+                         list(provider.column_types), provider.name)
+        binder = ExprBinder(scope, params)
+        names, types = [], []
+        for it in st.returning:
+            if isinstance(it.expr, ast.Star):
+                for c in scope.columns:
+                    names.append(c.name)
+                    types.append(c.type)
+                continue
+            b = binder.bind(it.expr)
+            names.append(it.alias or _default_returning_name(it.expr))
+            types.append(b.type)
+        return names, types
+
+    def _returning_batch(self, items, table: MemTable, affected: Batch,
+                         params: list) -> Batch:
+        """RETURNING evaluation over the affected rows (PG: the new row
+        state for INSERT/UPDATE, the old row for DELETE)."""
+        scope = Scope.of(list(affected.names),
+                         [c.type for c in affected.columns], table.name)
+        binder = ExprBinder(scope, params)
+        names, cols = [], []
+        for it in items:
+            if isinstance(it.expr, ast.Star):
+                for c in scope.columns:
+                    names.append(c.name)
+                    cols.append(affected.columns[c.index])
+                continue
+            b = binder.bind(it.expr)
+            names.append(it.alias or _default_returning_name(it.expr))
+            cols.append(b.eval(affected))
+        return Batch(names, cols)
+
+    def _insert_batch(self, table: MemTable, incoming: Batch) -> Batch:
         with self.db.lock:
             aligned = _align_to_schema(table, incoming)
             self._wal_commit(table, [("insert", aligned, None)])
             _append_rows(table, aligned)
+            return aligned
 
     def _wal_commit(self, table: MemTable, ops: list[tuple]):
         """Durably log (kind, batch, rows) ops for a stored table before the
@@ -1588,6 +1654,14 @@ def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
             table.replace(full.filter(mask))
         elif kind == "truncate":
             table.replace(table.full_batch().slice(0, 0))
+
+
+def _default_returning_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.ColumnRef):
+        return e.parts[-1]
+    if isinstance(e, ast.FuncCall):
+        return e.name
+    return "?column?"
 
 
 def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:

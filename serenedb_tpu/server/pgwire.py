@@ -425,6 +425,11 @@ class PgSession:
     async def _command_loop(self):
         while True:
             self._idle = True
+            # close the missed-wakeup window: anything enqueued before
+            # _idle flipped is delivered here; later arrivals take the
+            # hook path
+            self._drain_notifications()
+            await self.w.flush()
             kind, payload = await self._read_msg()
             self._idle = False
             if kind == b"X":
@@ -659,6 +664,15 @@ class PgSession:
             except errors.SqlError:
                 pass
             self.w.no_data()
+        elif isinstance(st, (ast.Insert, ast.Update, ast.Delete)) and \
+                getattr(st, "returning", None):
+            # drivers need the RETURNING row shape from Describe
+            try:
+                names, types = self.conn._describe_returning(
+                    st, [None] * prep.n_params)
+                self.w.row_description(names, types, fmts)
+            except errors.SqlError:
+                self.w.no_data()
         else:
             self.w.no_data()
 

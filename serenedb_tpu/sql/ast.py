@@ -291,12 +291,14 @@ class Insert(Statement):
     columns: Optional[list[str]]
     values: Optional[list[list[Expr]]]
     query: Optional[Select] = None
+    returning: list = field(default_factory=list)   # list[SelectItem]
 
 
 @dataclass
 class Delete(Statement):
     table: list[str]
     where: Optional[Expr] = None
+    returning: list = field(default_factory=list)
 
 
 @dataclass
@@ -304,6 +306,7 @@ class Update(Statement):
     table: list[str]
     assignments: list[tuple[str, Expr]]
     where: Optional[Expr] = None
+    returning: list = field(default_factory=list)
 
 
 @dataclass

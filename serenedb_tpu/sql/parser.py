@@ -966,17 +966,29 @@ class Parser:
             rows = [self._parse_paren_exprs()]
             while self.accept_op(","):
                 rows.append(self._parse_paren_exprs())
-            return ast.Insert(table, columns, rows)
+            return ast.Insert(table, columns, rows,
+                              returning=self._parse_returning())
         if self.at_kw("SELECT"):
-            return ast.Insert(table, columns, None, self.parse_select())
+            q = self.parse_select()
+            return ast.Insert(table, columns, None, q,
+                              returning=self._parse_returning())
         raise errors.syntax("expected VALUES or SELECT in INSERT")
+
+    def _parse_returning(self) -> list:
+        if not self.accept_kw("RETURNING"):
+            return []
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        return items
 
     def parse_delete(self) -> ast.Delete:
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
         table = self.qualified_name()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
-        return ast.Delete(table, where)
+        return ast.Delete(table, where,
+                          returning=self._parse_returning())
 
     def parse_update(self) -> ast.Update:
         self.expect_kw("UPDATE")
@@ -990,7 +1002,8 @@ class Parser:
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("WHERE") else None
-        return ast.Update(table, assigns, where)
+        return ast.Update(table, assigns, where,
+                          returning=self._parse_returning())
 
     def parse_set(self) -> ast.Statement:
         self.expect_kw("SET")

@@ -699,3 +699,15 @@ def test_notify_in_txn_is_transactional(server):
     assert not ready
     lis.close()
     snd.close()
+
+
+def test_returning_described_in_extended_protocol(server):
+    pg = RawPg(server.port)
+    pg.query("CREATE TABLE retd (a INT, b TEXT)")
+    cols, rows, tags, errs = pg.extended(
+        "INSERT INTO retd VALUES ($1, 'p') RETURNING a, b", ["5"])
+    assert not errs
+    assert cols == ["a", "b"]          # Describe produced RowDescription
+    assert rows == [("5", "p")]
+    pg.query("DROP TABLE retd")
+    pg.close()

@@ -249,3 +249,20 @@ def test_alter_role_option_validation():
             c.execute(bad)
         assert e.value.sqlstate == "42601", bad
     c.execute("ALTER ROLE optr WITH NOLOGIN")   # WITH prefix still legal
+
+
+def test_returning_requires_select_privilege():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE secret (v TEXT)")
+    c.execute("INSERT INTO secret VALUES ('classified')")
+    c.execute("CREATE ROLE bob LOGIN")
+    c.execute("GRANT DELETE ON secret TO bob")
+    c2 = db.connect()
+    c2.execute("SET ROLE bob")
+    with pytest.raises(SqlError) as e:
+        c2.execute("DELETE FROM secret RETURNING *")
+    assert e.value.sqlstate == "42501"
+    # plain DELETE still allowed
+    c2.execute("DELETE FROM secret")
+    assert c.execute("SELECT count(*) FROM secret").scalar() == 0

@@ -323,3 +323,27 @@ def test_pg_stat_activity():
     gc.collect()
     assert c1.execute(
         "SELECT count(*) FROM pg_stat_activity").scalar() == 1
+
+
+def test_insert_select_maps_positionally():
+    # review finding: name-based alignment silently inserted NULLs
+    c = Database().connect()
+    c.execute("CREATE TABLE src (x INT, y TEXT)")
+    c.execute("INSERT INTO src VALUES (1, 'a')")
+    c.execute("CREATE TABLE dst (a INT, b TEXT)")
+    rows = c.execute("INSERT INTO dst SELECT x, y FROM src "
+                     "RETURNING a, b").rows()
+    assert rows == [(1, "a")]
+    assert c.execute("SELECT a, b FROM dst").rows() == [(1, "a")]
+    from serenedb_tpu.errors import SqlError
+    import pytest as _pytest
+    with _pytest.raises(SqlError) as e:
+        c.execute("INSERT INTO dst SELECT x FROM src")
+    assert e.value.sqlstate == "42601"
+
+
+def test_update_returning_zero_rows_keeps_shape():
+    c = Database().connect()
+    c.execute("CREATE TABLE zr (a INT)")
+    r = c.execute("UPDATE zr SET a = 1 WHERE false RETURNING a")
+    assert r.names == ["a"] and r.rows() == []
