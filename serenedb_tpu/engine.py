@@ -22,7 +22,7 @@ from .columnar.column import Batch, Column, concat_batches
 from .exec.plan import ExecContext, PlanNode
 from .exec.tables import MemTable, ParquetTable, TableProvider
 from .sql import ast, parser
-from .sql.binder import ExprBinder, Scope, cast_column
+from .sql.binder import ExprBinder, Scope, ScopeColumn, cast_column
 from .sql.planner import Planner, TableResolver
 from .utils import faults, log, metrics
 from .utils.config import SessionSettings
@@ -484,6 +484,21 @@ class Database(TableResolver):
 class _ViewRef(Exception):
     def __init__(self, view: ViewDef):
         self.view = view
+
+
+class _UpsertScope(Scope):
+    """Scope for DO UPDATE SET: unqualified names resolve to the TARGET
+    table only (never ambiguous with excluded.*), qualified names see
+    both the target alias and `excluded`."""
+
+    def __init__(self, base_cols, exc_cols):
+        super().__init__(base_cols + exc_cols)
+        self._base = Scope(base_cols)
+
+    def resolve(self, parts):
+        if len(parts) == 1:
+            return self._base.resolve(parts)
+        return super().resolve(parts)
 
 
 class _ResolverShim(TableResolver):
@@ -1193,12 +1208,135 @@ class Connection:
                     cols_vals[k].append(b.eval(one).decode(0))
             incoming = Batch(list(target_names),
                              [Column.from_pylist(v) for v in cols_vals])
+        if st.on_conflict is not None:
+            pk = _pk_of(table)
+            return self._insert_with_pk(st, table, incoming, pk, params)
         aligned = self._insert_batch(table, incoming)
         tag = f"INSERT 0 {incoming.num_rows}"
         if st.returning:
             return QueryResult(self._returning_batch(
                 st.returning, table, aligned, params), tag)
         return QueryResult(Batch([], []), tag)
+
+    def _insert_with_pk(self, st, table, incoming: Batch, pk: list,
+                        params: list) -> QueryResult:
+        """INSERT into a table with a PRIMARY KEY: uniqueness enforcement
+        (23505) and ON CONFLICT DO NOTHING / DO UPDATE (reference: PG
+        upsert; conflict arbitration is the declared primary key)."""
+        action, target, assigns = st.on_conflict
+        if action == "update" and not target:
+            raise errors.SqlError(
+                "42601", "ON CONFLICT DO UPDATE requires a conflict "
+                "target")
+        if target:
+            if not pk or sorted(t.lower() for t in target) != \
+                    sorted(c.lower() for c in pk):
+                raise errors.SqlError(
+                    "42P10", "there is no unique or exclusion constraint "
+                    "matching the ON CONFLICT specification")
+        if not pk:
+            # targetless DO NOTHING on an unconstrained table: nothing can
+            # conflict (PG accepts this); plain insert
+            aligned = self._insert_batch(table, incoming)
+            tag = f"INSERT 0 {aligned.num_rows}"
+            if st.returning:
+                return QueryResult(self._returning_batch(
+                    st.returning, table, aligned, params), tag)
+            return QueryResult(Batch([], []), tag)
+        with self.db.lock:
+            aligned = _align_to_schema(table, incoming)
+            key_cols_new = [aligned.column(c).to_pylist() for c in pk]
+            _check_pk_not_null(pk, key_cols_new, aligned.num_rows)
+            existing = _pk_map(table, pk)
+            fresh_rows, conflicts, seen = [], [], set()
+            for i in range(aligned.num_rows):
+                key = tuple(kc[i] for kc in key_cols_new)
+                if key in seen:
+                    # second hit on the same key within one statement
+                    if action == "update":
+                        raise errors.SqlError(
+                            "21000", "ON CONFLICT DO UPDATE command "
+                            "cannot affect row a second time")
+                    if action is None:
+                        raise errors.SqlError(
+                            "23505", "duplicate key value violates "
+                            "unique constraint "
+                            f"(key columns: {', '.join(pk)})")
+                    continue              # DO NOTHING drops the duplicate
+                if key in existing:
+                    if action is None:
+                        raise errors.SqlError(
+                            "23505", "duplicate key value violates "
+                            "unique constraint "
+                            f"(key columns: {', '.join(pk)})")
+                    conflicts.append((i, existing[key]))
+                    seen.add(key)
+                    continue              # DO NOTHING also lands here: no-op
+                fresh_rows.append(i)
+                seen.add(key)
+            if action == "nothing":
+                conflicts = []
+            ops = []
+            affected = []
+            if conflicts and action == "update":
+                full = table.full_batch()
+                old_rows = np.asarray([o for _, o in conflicts],
+                                      dtype=np.int64)
+                exc_rows = [i for i, _ in conflicts]
+                updated = self._apply_upsert_assignments(
+                    table, full.take(old_rows), aligned.take(
+                        np.asarray(exc_rows, dtype=np.int64)),
+                    assigns, params)
+                ops.append(("delete", None, old_rows))
+                ops.append(("insert", updated, None))
+                affected.append(updated)
+            if fresh_rows:
+                fresh = aligned.take(np.asarray(fresh_rows,
+                                                dtype=np.int64))
+                ops.append(("insert", fresh, None))
+                affected.append(fresh)
+            n_affected = (len(fresh_rows) +
+                          (len(conflicts) if action == "update" else 0))
+            if ops:
+                self._wal_commit(table, ops)
+                _apply_ops(table, ops)
+        tag = f"INSERT 0 {n_affected}"
+        if st.returning:
+            out = concat_batches(affected) if affected else Batch(
+                list(table.column_names),
+                [Column.from_pylist([], t) for t in table.column_types])
+            return QueryResult(self._returning_batch(
+                st.returning, table, out, params), tag)
+        return QueryResult(Batch([], []), tag)
+
+    def _apply_upsert_assignments(self, table, old: Batch, exc: Batch,
+                                  assigns, params: list) -> Batch:
+        """DO UPDATE SET evaluation: unqualified columns are the existing
+        row, excluded.col is the incoming row (PG semantics)."""
+        base_cols = [ScopeColumn(table.name, n, c.type, i)
+                     for i, (n, c) in enumerate(zip(old.names,
+                                                    old.columns))]
+        n_base = len(base_cols)
+        exc_cols = [ScopeColumn("excluded", n, c.type, n_base + i)
+                    for i, (n, c) in enumerate(zip(exc.names,
+                                                   exc.columns))]
+        scope = _UpsertScope(base_cols, exc_cols)
+        combined = Batch(list(old.names) + [f"__exc_{n}"
+                                            for n in exc.names],
+                         list(old.columns) + list(exc.columns))
+        binder = ExprBinder(scope, params)
+        new_cols = {}
+        for col_name, e in assigns:
+            if col_name not in old:
+                raise errors.SqlError(
+                    errors.UNDEFINED_COLUMN,
+                    f'column "{col_name}" does not exist')
+            target_t = old.column(col_name).type
+            new_cols[col_name] = _coerce(binder.bind(e).eval(combined),
+                                         target_t)
+        return Batch(list(old.names),
+                     [new_cols.get(n, c)
+                      for n, c in zip(old.names, old.columns)])
 
     def _delete(self, st: ast.Delete, params: list) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
@@ -1261,6 +1399,27 @@ class Connection:
             upd_cols = [new_cols.get(nm, c)
                         for nm, c in zip(updated.names, updated.columns)]
             updated = Batch(list(updated.names), upd_cols)
+            pk = _pk_of(table)
+            if pk:
+                # new keys must be unique among themselves AND against the
+                # untouched rows
+                key_cols_u = [updated.column(c).to_pylist() for c in pk]
+                _check_pk_not_null(pk, key_cols_u, updated.num_rows)
+                untouched = set()
+                key_cols_all = [full.column(c).to_pylist() for c in pk]
+                touched = set(int(r) for r in rows)
+                for i in range(full.num_rows):
+                    if i not in touched:
+                        untouched.add(tuple(kc[i] for kc in key_cols_all))
+                seen = set()
+                for i in range(updated.num_rows):
+                    key = tuple(kc[i] for kc in key_cols_u)
+                    if key in untouched or key in seen:
+                        raise errors.SqlError(
+                            "23505", "duplicate key value violates "
+                            "unique constraint "
+                            f"(key columns: {', '.join(pk)})")
+                    seen.add(key)
             self._wal_commit(table, [("delete", None, rows),
                                      ("insert", updated, None)])
             mask_keep = np.ones(full.num_rows, dtype=bool)
@@ -1620,8 +1779,24 @@ class Connection:
     def _insert_batch(self, table: MemTable, incoming: Batch) -> Batch:
         with self.db.lock:
             aligned = _align_to_schema(table, incoming)
+            pk = _pk_of(table)
+            if pk:
+                key_cols = [aligned.column(c).to_pylist() for c in pk]
+                _check_pk_not_null(pk, key_cols, aligned.num_rows)
+                existing = _pk_map(table, pk)
+                seen = set()
+                for i in range(aligned.num_rows):
+                    key = tuple(kc[i] for kc in key_cols)
+                    if key in existing or key in seen:
+                        raise errors.SqlError(
+                            "23505", "duplicate key value violates "
+                            "unique constraint "
+                            f"(key columns: {', '.join(pk)})")
+                    seen.add(key)
             self._wal_commit(table, [("insert", aligned, None)])
             _append_rows(table, aligned)
+            if pk:
+                _pk_map_extend(table, key_cols, aligned.num_rows)
             return aligned
 
     def _wal_commit(self, table: MemTable, ops: list[tuple]):
@@ -1654,6 +1829,49 @@ def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
             table.replace(full.filter(mask))
         elif kind == "truncate":
             table.replace(table.full_batch().slice(0, 0))
+
+
+def _pk_of(table) -> list:
+    return (getattr(table, "table_meta", None) or {}).get(
+        "primary_key") or []
+
+
+def _check_pk_not_null(pk: list, key_cols: list, n: int):
+    for i in range(n):
+        for c, kc in zip(pk, key_cols):
+            if kc[i] is None:
+                raise errors.SqlError(
+                    "23502", f'null value in column "{c}" violates '
+                    "not-null constraint")
+
+
+def _pk_map(table, pk: list) -> dict:
+    """key-tuple → row index for the CURRENT batch, cached on the
+    provider and invalidated by data_version (rebuilt O(N) only after
+    deletes/updates; appends extend it incrementally)."""
+    cache = getattr(table, "_pk_cache", None)
+    if cache is not None and cache[0] == table.data_version:
+        return cache[1]
+    full = table.full_batch()
+    key_cols = [full.column(c).to_pylist() for c in pk]
+    m = {}
+    for i in range(full.num_rows):
+        m[tuple(kc[i] for kc in key_cols)] = i
+    table._pk_cache = (table.data_version, m)
+    return m
+
+
+def _pk_map_extend(table, key_cols: list, n: int):
+    """After an append: extend the cached map in place instead of letting
+    the data_version bump force an O(N) rebuild."""
+    cache = getattr(table, "_pk_cache", None)
+    if cache is None:
+        return
+    m = cache[1]
+    base = table.row_count() - n
+    for i in range(n):
+        m[tuple(kc[i] for kc in key_cols)] = base + i
+    table._pk_cache = (table.data_version, m)
 
 
 def _default_returning_name(e: ast.Expr) -> str:

@@ -292,6 +292,9 @@ class Insert(Statement):
     values: Optional[list[list[Expr]]]
     query: Optional[Select] = None
     returning: list = field(default_factory=list)   # list[SelectItem]
+    #: ON CONFLICT: (action, target_cols, assignments) where action is
+    #: "nothing" | "update"; assignments may reference excluded.col
+    on_conflict: Optional[tuple] = None
 
 
 @dataclass

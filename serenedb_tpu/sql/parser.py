@@ -966,13 +966,42 @@ class Parser:
             rows = [self._parse_paren_exprs()]
             while self.accept_op(","):
                 rows.append(self._parse_paren_exprs())
+            oc = self._parse_on_conflict()
             return ast.Insert(table, columns, rows,
-                              returning=self._parse_returning())
+                              returning=self._parse_returning(),
+                              on_conflict=oc)
         if self.at_kw("SELECT"):
             q = self.parse_select()
+            oc = self._parse_on_conflict()
             return ast.Insert(table, columns, None, q,
-                              returning=self._parse_returning())
+                              returning=self._parse_returning(),
+                              on_conflict=oc)
         raise errors.syntax("expected VALUES or SELECT in INSERT")
+
+    def _parse_on_conflict(self) -> Optional[tuple]:
+        if not self.at_kw("ON"):
+            return None
+        self.next()
+        self.expect_kw("CONFLICT")
+        target = []
+        if self.accept_op("("):
+            target.append(self.ident().lower())
+            while self.accept_op(","):
+                target.append(self.ident().lower())
+            self.expect_op(")")
+        self.expect_kw("DO")
+        if self.accept_kw("NOTHING"):
+            return ("nothing", target, [])
+        self.expect_kw("UPDATE")
+        self.expect_kw("SET")
+        assigns = []
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            assigns.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        return ("update", target, assigns)
 
     def _parse_returning(self) -> list:
         if not self.accept_kw("RETURNING"):

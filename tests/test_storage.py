@@ -265,3 +265,19 @@ def test_drop_index_case_insensitive_survives_restart(tmp_path):
     t = db2.schemas["main"].tables["t"]
     assert not getattr(t, "indexes", {})   # no resurrection on reboot
     db2.close()
+
+
+def test_upsert_survives_recovery(tmp_path):
+    from serenedb_tpu.engine import Database
+    path = str(tmp_path / "data")
+    db = Database(path)
+    c = db.connect()
+    c.execute("CREATE TABLE up (id INT PRIMARY KEY, v TEXT)")
+    c.execute("INSERT INTO up VALUES (1, 'a')")
+    c.execute("INSERT INTO up VALUES (1, 'b'), (2, 'c') "
+              "ON CONFLICT (id) DO UPDATE SET v = excluded.v")
+    db.close()
+    db2 = Database(path)
+    rows = sorted(db2.connect().execute("SELECT id, v FROM up").rows())
+    assert rows == [(1, "b"), (2, "c")]
+    db2.close()
