@@ -9,6 +9,8 @@ BoundAggRef placeholders.
 
 from __future__ import annotations
 
+import copy
+import re
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -21,6 +23,60 @@ from ..functions import scalar as fnlib
 from . import ast
 from .expr import (AggSpec, BoundAggRef, BoundCase, BoundColumn, BoundExpr,
                    BoundFunc, BoundLiteral, kleene_and, kleene_or)
+
+def _from_aliases(ref) -> set:
+    """Aliases / table names a FROM clause introduces (lowercased)."""
+    if ref is None:
+        return set()
+    if isinstance(ref, ast.JoinRef):
+        return _from_aliases(ref.left) | _from_aliases(ref.right)
+    if isinstance(ref, ast.NamedTable):
+        return {(ref.alias or ref.parts[-1]).lower()}
+    if isinstance(ref, (ast.TableFunction, ast.SubqueryRef)):
+        name = ref.alias or getattr(ref, "name", None) or "subquery"
+        return {str(name).lower()}
+    return set()
+
+
+def _subst_colrefs(node, mapping: dict):
+    """Deep-copy an AST substituting ColumnRefs whose part-tuple matches
+    `mapping` (case-insensitive exact match) with Literal values
+    (correlated-subquery lowering). Descending into a nested SELECT whose
+    FROM re-introduces an alias drops the qualified entries that alias
+    shadows, so `... EXISTS (SELECT .. FROM t d WHERE d.x ..)` inside a
+    correlated subquery binds to the INNER d."""
+
+    def rec(n, mp):
+        if isinstance(n, ast.ColumnRef):
+            for k, v in mp.items():
+                if len(k) == len(n.parts) and \
+                        tuple(x.lower() for x in k) == \
+                        tuple(x.lower() for x in n.parts):
+                    return ast.Literal(v)
+            return n
+        if isinstance(n, (ast.Select, ast.SetOp)):
+            shadowed = _from_aliases(getattr(n, "from_", None))
+            inner_mp = {k: v for k, v in mp.items()
+                        if len(k) < 2 or k[0].lower() not in shadowed}
+            out = copy.copy(n)
+            for f in n.__dataclass_fields__:
+                setattr(out, f, rec(getattr(n, f), inner_mp))
+            return out
+        if isinstance(n, list):
+            return [rec(x, mp) for x in n]
+        if isinstance(n, tuple):
+            return tuple(rec(x, mp) for x in n)
+        if isinstance(n, dict):
+            return {k: rec(v, mp) for k, v in n.items()}
+        if isinstance(n, (ast.Expr, ast.Statement, ast.SelectItem,
+                          ast.TableRef, ast.OrderItem)):
+            out = copy.copy(n)
+            for f in n.__dataclass_fields__:
+                setattr(out, f, rec(getattr(n, f), mp))
+            return out
+        return n
+    return rec(node, mapping)
+
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_star",
              "stddev", "stddev_samp", "var_samp", "variance",
@@ -267,11 +323,10 @@ class ExprBinder:
             t = else_b.type
         return BoundCase(bound, else_b, t)
 
-    # -- uncorrelated subqueries ------------------------------------------
-    # Correlated subqueries (referencing outer columns) are future work;
-    # the inner query is planned against its own scope only, executed once
-    # per statement and cached (reference: DuckDB flattens these the same
-    # way for the uncorrelated case).
+    # -- subqueries --------------------------------------------------------
+    # Uncorrelated: planned against their own scope, executed once per
+    # statement and cached. Correlated (outer refs): lowered per outer
+    # row by literal substitution with a per-key plan cache (below).
 
     def _subplan(self, query):
         if self.planner is None:
@@ -279,8 +334,72 @@ class ExprBinder:
                 "subqueries are not allowed in this context")
         return self.planner.plan_select(query)
 
+    # -- correlated subqueries --------------------------------------------
+    # The reference executes correlated subqueries via DuckDB's flattening;
+    # here the correctness-first fallback is per-outer-row substitution of
+    # the correlated column references, replanning the (cached-parse) AST
+    # with literals. Uncorrelated subqueries never pay this cost.
+
+    # the pattern matches Scope.resolve's message above — they live in
+    # this same module, so wording changes must update both together
+    _COLERR = re.compile(r'column "([^"]+)" does not exist')
+
+    def _discover_correlation(self, query):
+        """(outer_refs, trial_plan): iteratively plan the subquery,
+        resolving each undefined column against the OUTER scope (inner
+        scope wins by construction — only columns the inner plan cannot
+        resolve are tried outside)."""
+        outer_refs: list[list[str]] = []
+        while True:
+            trial = _subst_colrefs(query, {tuple(r): None
+                                           for r in outer_refs})
+            try:
+                return outer_refs, self.planner.plan_select(trial)
+            except errors.SqlError as e:
+                if e.sqlstate != errors.UNDEFINED_COLUMN:
+                    raise
+                m = self._COLERR.search(e.message)
+                if m is None:
+                    raise
+                parts = m.group(1).split(".")
+                self.scope.resolve(parts)       # must exist OUTSIDE
+                if parts in outer_refs:
+                    raise                        # no progress — give up
+                outer_refs.append(parts)
+
+    def _correlated_rows(self, query, outer_refs, batch,
+                         plan_cache: dict):
+        """Execute the subquery once per outer row with the correlated
+        refs substituted; yields (row_index, rows). plan_cache persists
+        per bound expression so multi-batch execution and repeated keys
+        pay one plan+execute per distinct key."""
+        from ..exec.plan import ExecContext
+        cols = {tuple(r): self.scope.resolve(r) for r in outer_refs}
+        for i in range(batch.num_rows):
+            key_vals = {}
+            for parts, sc in cols.items():
+                c = batch.columns[sc.index]
+                v = None if (c.validity is not None and
+                             not c.validity[i]) else c.decode(i)
+                if isinstance(v, np.generic):
+                    v = v.item()
+                key_vals[parts] = v
+            cache_key = tuple(sorted(key_vals.items()))
+            rows = plan_cache.get(cache_key)
+            if rows is None:
+                sub = _subst_colrefs(query, key_vals)
+                rows = self.planner.plan_select(sub).execute(
+                    ExecContext()).rows()
+                plan_cache[cache_key] = rows
+            yield i, rows
+
     def _bind_scalar_subquery(self, query) -> BoundExpr:
-        plan = self._subplan(query)
+        try:
+            plan = self._subplan(query)
+        except errors.SqlError as e:
+            if e.sqlstate != errors.UNDEFINED_COLUMN:
+                raise
+            return self._bind_correlated_scalar(query)
         if len(plan.types) != 1:
             raise errors.SqlError("42601",
                                   "subquery must return only one column")
@@ -300,8 +419,33 @@ class ExprBinder:
             return Column.const(_cache[0], batch.num_rows, _t)
         return BoundFunc("scalar_subquery", [], t, impl)
 
+    def _bind_correlated_scalar(self, query) -> BoundExpr:
+        outer_refs, trial = self._discover_correlation(query)
+        if len(trial.types) != 1:
+            raise errors.SqlError("42601",
+                                  "subquery must return only one column")
+        t = trial.types[0]
+
+        _pc: dict = {}
+
+        def impl(cols, batch, _q=query, _refs=outer_refs, _t=t):
+            out = []
+            for i, rows in self._correlated_rows(_q, _refs, batch, _pc):
+                if len(rows) > 1:
+                    raise errors.SqlError(
+                        "21000", "more than one row returned by a "
+                        "subquery used as an expression")
+                out.append(rows[0][0] if rows else None)
+            return Column.from_pylist(out, _t)
+        return BoundFunc("scalar_subquery", [], t, impl)
+
     def _bind_in_subquery(self, e) -> BoundExpr:
-        plan = self._subplan(e.query)
+        try:
+            plan = self._subplan(e.query)
+        except errors.SqlError as err:
+            if err.sqlstate != errors.UNDEFINED_COLUMN:
+                raise
+            return self._bind_correlated_in(e)
         if len(plan.types) != 1:
             raise errors.SqlError("42601",
                                   "subquery must return only one column")
@@ -336,8 +480,57 @@ class ExprBinder:
                           None if valid.all() else valid)
         return BoundFunc("in_subquery", [operand], dt.BOOL, impl)
 
+    def _bind_correlated_in(self, e) -> BoundExpr:
+        outer_refs, trial = self._discover_correlation(e.query)
+        if len(trial.types) != 1:
+            raise errors.SqlError("42601",
+                                  "subquery must return only one column")
+        operand = self.bind(e.operand)
+        negated = e.negated
+
+        _pc: dict = {}
+
+        def impl(cols, batch, _q=e.query, _refs=outer_refs, _neg=negated):
+            x = cols[0]
+            xv = x.to_pylist()
+            data = np.zeros(batch.num_rows, dtype=bool)
+            valid = np.ones(batch.num_rows, dtype=bool)
+            for i, rows in self._correlated_rows(_q, _refs, batch, _pc):
+                vals = [r[0] for r in rows]
+                if xv[i] is None:
+                    valid[i] = False
+                elif xv[i] in set(v for v in vals if v is not None):
+                    data[i] = True
+                elif any(v is None for v in vals):
+                    valid[i] = False
+            if _neg:
+                data = ~data
+            return Column(dt.BOOL, data,
+                          None if valid.all() else valid)
+        return BoundFunc("in_subquery", [operand], dt.BOOL, impl)
+
+    def _bind_correlated_exists(self, e) -> BoundExpr:
+        outer_refs, _ = self._discover_correlation(e.query)
+
+        _pc: dict = {}
+
+        def impl(cols, batch, _q=e.query, _refs=outer_refs,
+                 _neg=e.negated):
+            data = np.zeros(batch.num_rows, dtype=bool)
+            for i, rows in self._correlated_rows(_q, _refs, batch, _pc):
+                data[i] = bool(rows)
+            if _neg:
+                data = ~data
+            return Column(dt.BOOL, data)
+        return BoundFunc("exists", [], dt.BOOL, impl)
+
     def _bind_exists(self, e) -> BoundExpr:
-        plan = self._subplan(e.query)
+        try:
+            plan = self._subplan(e.query)
+        except errors.SqlError as err:
+            if err.sqlstate != errors.UNDEFINED_COLUMN:
+                raise
+            return self._bind_correlated_exists(e)
         cache: list = []
 
         def impl(cols, batch, _plan=plan, _neg=e.negated, _cache=cache):
