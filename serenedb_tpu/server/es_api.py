@@ -42,7 +42,9 @@ class EsApi:
     def __init__(self, db: Database):
         self.db = db
         self.conn = db.connect()
-        self._lock = threading.Lock()
+        # reentrant: update_doc holds it across a read-merge-write
+        # while _index_doc_locked may re-enter via create_index
+        self._lock = threading.RLock()
         self._scrolls: dict[str, dict] = {}
 
     # -- index management --------------------------------------------------
@@ -143,29 +145,80 @@ class EsApi:
 
     def index_doc(self, index: str, doc: dict,
                   doc_id: Optional[str] = None) -> dict:
+        with self._lock:
+            return self._index_doc_locked(index, doc, doc_id)
+
+    def _index_doc_locked(self, index: str, doc: dict,
+                          doc_id: Optional[str] = None) -> dict:
+        """index_doc body; caller holds self._lock."""
         t = self._table(index, create=True)
         doc_id = doc_id or _gen_id()
-        with self._lock:
-            self._delete_by_id(t, doc_id)
-            row = {"_id": doc_id, "_source": json.dumps(doc)}
-            for k, v in doc.items():
-                if isinstance(v, list) and v and \
-                        all(isinstance(x, (int, float)) and
-                            not isinstance(x, bool) for x in v):
-                    # numeric arrays = dense vectors, stored as JSON text
-                    self._ensure_column(t, k, dt.VARCHAR, text_index=False)
-                    row[k] = json.dumps(v)
-                    continue
-                if isinstance(v, (dict, list)):
-                    continue  # other objects/arrays live in _source only
-                self._ensure_column(t, k, _value_sql_type(v))
-                row[k] = v
-            incoming = Batch.from_pydict(
-                {name: [row.get(name)] for name in t.column_names})
-            self.conn._insert_batch(t, incoming)
+        self._delete_by_id(t, doc_id)
+        row = {"_id": doc_id, "_source": json.dumps(doc)}
+        for k, v in doc.items():
+            if isinstance(v, list) and v and \
+                    all(isinstance(x, (int, float)) and
+                        not isinstance(x, bool) for x in v):
+                # numeric arrays = dense vectors, stored as JSON text
+                self._ensure_column(t, k, dt.VARCHAR, text_index=False)
+                row[k] = json.dumps(v)
+                continue
+            if isinstance(v, (dict, list)):
+                continue  # other objects/arrays live in _source only
+            self._ensure_column(t, k, _value_sql_type(v))
+            row[k] = v
+        incoming = Batch.from_pydict(
+            {name: [row.get(name)] for name in t.column_names})
+        self.conn._insert_batch(t, incoming)
         return {"_index": index, "_id": doc_id, "result": "created",
                 "_version": 1, "_shards": {"total": 1, "successful": 1,
                                            "failed": 0}}
+
+    def update_doc(self, index: str, doc_id: str, body: dict) -> dict:
+        """_update: partial-document merge, script-free (reference: the ES
+        update action). `doc` merges into the existing source; a missing
+        doc falls back to `upsert` (or 404 without one);
+        doc_as_upsert=true uses `doc` for both. Read-merge-write runs
+        under one lock so concurrent updates never lose fields."""
+        if not isinstance(body, dict):
+            raise EsError(400, "parsing_exception",
+                          "_update body must be a JSON object")
+        partial = body.get("doc")
+        upsert = body.get("upsert")
+        if partial is not None and not isinstance(partial, dict):
+            raise EsError(400, "parsing_exception",
+                          "_update doc must be a JSON object")
+        if upsert is not None and not isinstance(upsert, dict):
+            raise EsError(400, "parsing_exception",
+                          "_update upsert must be a JSON object")
+        if partial is None and upsert is None:
+            raise EsError(400, "illegal_argument_exception",
+                          "_update requires doc or upsert")
+        can_create = upsert is not None or bool(body.get("doc_as_upsert"))
+        self._table(index, create=can_create)   # 404 unless upserting
+        with self._lock:
+            existing = self.get_doc(index, doc_id)
+            if existing.get("found"):
+                merged = dict(existing["_source"])
+                merged.update(partial or {})
+                result = "updated"
+                if merged == existing["_source"]:
+                    result = "noop"
+            elif body.get("doc_as_upsert") and partial is not None:
+                merged = dict(partial)
+                result = "created"
+            elif upsert is not None:
+                merged = dict(upsert)
+                result = "created"
+            else:
+                raise EsError(404, "document_missing_exception",
+                              f"[{doc_id}]: document missing")
+            if result != "noop":
+                self._index_doc_locked(index, merged, doc_id)
+        return {"_index": index, "_id": doc_id, "result": result,
+                "_shards": {"total": 1,
+                            "successful": 0 if result == "noop" else 1,
+                            "failed": 0}}
 
     def get_doc(self, index: str, doc_id: str) -> dict:
         t = self._table(index)
@@ -222,11 +275,8 @@ class EsApi:
                     r = self.delete_doc(index, doc_id)
                     items.append({op: {**r, "status": 200}})
                 elif op == "update":
-                    body_doc = json.loads(doc_line)
-                    doc = body_doc.get("doc", {})
-                    existing = self.get_doc(index, doc_id)
-                    merged = {**existing.get("_source", {}), **doc}
-                    r = self.index_doc(index, merged, doc_id)
+                    r = self.update_doc(index, doc_id,
+                                        json.loads(doc_line))
                     items.append({op: {**r, "status": 200}})
                 else:
                     raise EsError(400, "illegal_argument_exception",

@@ -186,6 +186,10 @@ class Handler(BaseHTTPRequestHandler):
                 raise EsError(405, "method_not_allowed",
                               f"{method} on _doc requires an id")
             return
+        if verb == "_update" and method == "POST" and len(rest) > 1:
+            self._send(200, es.update_doc(index, rest[1],
+                                          self._json_body() or {}))
+            return
         if verb == "_search":
             body = self._json_body()
             if "scroll" in q:

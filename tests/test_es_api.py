@@ -444,3 +444,80 @@ def test_analyze_index_scoped(srv):
     # non-object body is a 400, not a 500
     status, body = req(srv, "POST", "/_analyze", '"hello"')
     assert status == 400
+
+
+def test_update_doc(srv):
+    req(srv, "PUT", "/upd")
+    req(srv, "PUT", "/upd/_doc/1", {"title": "old", "count": 1})
+    # partial merge
+    status, body = req(srv, "POST", "/upd/_update/1",
+                       {"doc": {"title": "new"}})
+    assert status == 200 and body["result"] == "updated"
+    status, body = req(srv, "GET", "/upd/_doc/1")
+    assert body["_source"] == {"title": "new", "count": 1}
+    # noop when nothing changes
+    status, body = req(srv, "POST", "/upd/_update/1",
+                       {"doc": {"title": "new"}})
+    assert body["result"] == "noop"
+    # missing doc without upsert -> 404
+    status, body = req(srv, "POST", "/upd/_update/ghost",
+                       {"doc": {"x": 1}})
+    assert status == 404
+    # upsert creates
+    status, body = req(srv, "POST", "/upd/_update/2",
+                       {"doc": {"x": 1}, "upsert": {"title": "fresh"}})
+    assert body["result"] == "created"
+    status, body = req(srv, "GET", "/upd/_doc/2")
+    assert body["_source"] == {"title": "fresh"}
+    # doc_as_upsert
+    status, body = req(srv, "POST", "/upd/_update/3",
+                       {"doc": {"v": 7}, "doc_as_upsert": True})
+    assert body["result"] == "created"
+    status, body = req(srv, "GET", "/upd/_doc/3")
+    assert body["_source"] == {"v": 7}
+    # malformed body
+    status, body = req(srv, "POST", "/upd/_update/1", {})
+    assert status == 400
+
+
+def test_concurrent_updates_lose_no_fields(srv):
+    import threading as _t
+    req(srv, "PUT", "/cu")
+    req(srv, "PUT", "/cu/_doc/1", {"base": 0})
+    errs = []
+
+    def worker(field):
+        for i in range(10):
+            st, body = req(srv, "POST", "/cu/_update/1",
+                           {"doc": {field: i}})
+            if st != 200:
+                errs.append(body)
+
+    ts = [_t.Thread(target=worker, args=(f"f{k}",)) for k in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs
+    st, body = req(srv, "GET", "/cu/_doc/1")
+    src = body["_source"]
+    # every thread's final write must survive (atomic read-merge-write)
+    assert src["f0"] == 9 and src["f1"] == 9 and src["f2"] == 9
+    assert src["base"] == 0
+
+
+def test_update_empty_upsert_and_bulk_parity(srv):
+    # {} upsert is legal and indexes an empty doc
+    st, body = req(srv, "POST", "/eu/_update/1", {"upsert": {}})
+    assert st == 200 and body["result"] == "created"
+    # bulk update now shares update_doc semantics: missing doc -> error item
+    nd = "\n".join([
+        json.dumps({"update": {"_index": "eu", "_id": "ghost"}}),
+        json.dumps({"doc": {"x": 1}}),
+    ]) + "\n"
+    st, body = req(srv, "POST", "/_bulk", nd, raw=True)
+    assert body["errors"] is True
+    assert body["items"][0]["update"]["status"] == 404
+    # non-dict doc -> 400, not 500
+    st, body = req(srv, "POST", "/eu/_update/1", {"doc": [1, 2]})
+    assert st == 400
