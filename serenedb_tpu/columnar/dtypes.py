@@ -31,6 +31,7 @@ class TypeId(enum.Enum):
     VARCHAR = "VARCHAR"
     TIMESTAMP = "TIMESTAMP"  # micros since epoch, int64
     DATE = "DATE"            # days since epoch, int32
+    INTERVAL = "INTERVAL"    # duration in micros, int64 (fixed units only)
     NULL = "NULL"            # type of bare NULL literal
 
 
@@ -45,6 +46,7 @@ _NUMPY_OF = {
     TypeId.VARCHAR: np.dtype(np.int32),   # dictionary codes
     TypeId.TIMESTAMP: np.dtype(np.int64),
     TypeId.DATE: np.dtype(np.int32),
+    TypeId.INTERVAL: np.dtype(np.int64),
     TypeId.NULL: np.dtype(np.int32),
 }
 
@@ -93,6 +95,7 @@ DOUBLE = SqlType(TypeId.DOUBLE)
 VARCHAR = SqlType(TypeId.VARCHAR)
 TIMESTAMP = SqlType(TypeId.TIMESTAMP)
 DATE = SqlType(TypeId.DATE)
+INTERVAL = SqlType(TypeId.INTERVAL)
 NULLTYPE = SqlType(TypeId.NULL)
 
 _BY_NAME = {
@@ -106,6 +109,7 @@ _BY_NAME = {
     "VARCHAR": VARCHAR, "TEXT": VARCHAR, "STRING": VARCHAR, "CHAR": VARCHAR,
     "TIMESTAMP": TIMESTAMP, "TIMESTAMPTZ": TIMESTAMP, "DATETIME": TIMESTAMP,
     "DATE": DATE,
+    "INTERVAL": INTERVAL,
 }
 
 # numeric widening lattice for binary-op result typing

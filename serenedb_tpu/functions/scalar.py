@@ -138,8 +138,111 @@ def _arith_type(op: str, a: dt.SqlType, b: dt.SqlType) -> dt.SqlType:
     return t
 
 
+_US_DAY = 86_400_000_000
+
+
+def _datetime_arith(op: str, ts: list):
+    """(result_type, a_to_us, b_to_us) for timestamp/date/interval
+    arithmetic (PG rules); None when not a datetime combination."""
+    TS, D, IV = dt.TypeId.TIMESTAMP, dt.TypeId.DATE, dt.TypeId.INTERVAL
+    a, b = ts[0].id, ts[1].id
+    NULL = dt.TypeId.NULL
+    if NULL in (a, b) and {a, b} & {TS, D, IV}:
+        # NULL operand: the result is NULL of the natural result type
+        other = ts[1] if a is NULL else ts[0]
+        if op in ("+", "-"):
+            return other if other.id is not D else dt.DATE
+        if op in ("*", "/") and other.id is IV:
+            return dt.INTERVAL
+    if op == "+":
+        pairs = {
+            (TS, IV): dt.TIMESTAMP, (IV, TS): dt.TIMESTAMP,
+            (D, IV): dt.TIMESTAMP, (IV, D): dt.TIMESTAMP,
+            (IV, IV): dt.INTERVAL,
+        }
+        r = pairs.get((a, b))
+        if r is not None:
+            return r
+        if a is D and ts[1].is_integer:
+            return dt.DATE
+        if ts[0].is_integer and b is D:
+            return dt.DATE
+    elif op == "-":
+        pairs = {
+            (TS, IV): dt.TIMESTAMP, (D, IV): dt.TIMESTAMP,
+            (TS, TS): dt.INTERVAL, (IV, IV): dt.INTERVAL,
+            (TS, D): dt.INTERVAL, (D, TS): dt.INTERVAL,
+        }
+        r = pairs.get((a, b))
+        if r is not None:
+            return r
+        if a is D and b is D:
+            return dt.INT            # days
+        if a is D and ts[1].is_integer:
+            return dt.DATE
+    elif op in ("*", "/"):
+        if a is IV and ts[1].is_numeric and b is not dt.TypeId.BOOL:
+            return dt.INTERVAL
+        if op == "*" and ts[0].is_numeric and b is IV and \
+                a is not dt.TypeId.BOOL:
+            return dt.INTERVAL
+    return None
+
+
+def _to_us(col, n):
+    """Column value in microseconds (dates scale by the day)."""
+    x = col.data.astype(np.int64)
+    if col.type.id is dt.TypeId.DATE:
+        x = x * _US_DAY
+    return x
+
+
+def _make_datetime_arith(op: str, ts: list, out_t):
+    def impl(cols, n):
+        D, IV = dt.TypeId.DATE, dt.TypeId.INTERVAL
+        a, b = cols[0], cols[1]
+        if op in ("*", "/"):
+            iv = a if a.type.id is IV else b
+            num = b if a.type.id is IV else a
+            x = num.data.astype(np.float64)
+            with np.errstate(all="ignore"):
+                data = (iv.data.astype(np.float64) * x if op == "*"
+                        else iv.data.astype(np.float64) / x)
+            if op == "/":
+                zero = x == 0
+                pn = propagate_nulls(cols)
+                live_zero = zero if pn is None else (zero & pn)
+                if live_zero.any():
+                    raise errors.SqlError(errors.DIVISION_BY_ZERO,
+                                          "division by zero")
+                with np.errstate(all="ignore"):
+                    data = np.where(zero, 0.0, data)
+            return _result(out_t, np.round(data).astype(np.int64), cols)
+        if out_t.id is dt.TypeId.DATE:
+            # date ± integer days
+            d = a if a.type.id is D else b
+            k = b if a.type.id is D else a
+            kk = k.data.astype(np.int64)
+            data = (d.data.astype(np.int64) + kk if op == "+"
+                    else d.data.astype(np.int64) - kk)
+            return _result(dt.DATE, data.astype(np.int32), cols)
+        if out_t.id is dt.TypeId.INT:
+            # date - date = days
+            return _result(dt.INT, (a.data.astype(np.int64) -
+                                    b.data.astype(np.int64)).astype(
+                                        np.int32), cols)
+        av, bv = _to_us(a, n), _to_us(b, n)
+        data = av + bv if op == "+" else av - bv
+        return _result(out_t, data, cols)
+    return FunctionResolution(out_t, impl)
+
+
 def _make_arith(op: str):
     def resolver(ts: list[dt.SqlType]):
+        if len(ts) == 2:
+            out_t = _datetime_arith(op, ts)
+            if out_t is not None:
+                return _make_datetime_arith(op, ts, out_t)
         if len(ts) != 2 or not _all_numeric(ts):
             return None
         t = _arith_type(op, ts[0], ts[1])
@@ -204,7 +307,8 @@ _REGISTRY["op||"] = None  # set below
 
 @register("opneg")
 def _neg(ts):
-    t = ts[0] if ts[0].is_numeric else None
+    t = ts[0] if (ts[0].is_numeric or
+                  ts[0].id is dt.TypeId.INTERVAL) else None
     if t is None:
         return None
 

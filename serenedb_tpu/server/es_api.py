@@ -216,6 +216,7 @@ class EsApi:
             if result != "noop":
                 self._index_doc_locked(index, merged, doc_id)
         return {"_index": index, "_id": doc_id, "result": result,
+                "_version": 1,
                 "_shards": {"total": 1,
                             "successful": 0 if result == "noop" else 1,
                             "failed": 0}}

@@ -39,10 +39,11 @@ _OID = {
     dt.TypeId.BOOL: 16, dt.TypeId.TINYINT: 21, dt.TypeId.SMALLINT: 21,
     dt.TypeId.INT: 23, dt.TypeId.BIGINT: 20, dt.TypeId.FLOAT: 700,
     dt.TypeId.DOUBLE: 701, dt.TypeId.VARCHAR: 25,
-    dt.TypeId.TIMESTAMP: 1114, dt.TypeId.DATE: 1082, dt.TypeId.NULL: 25,
+    dt.TypeId.TIMESTAMP: 1114, dt.TypeId.DATE: 1082,
+    dt.TypeId.INTERVAL: 1186, dt.TypeId.NULL: 25,
 }
 _TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
-           1082: 4}
+           1082: 4, 1186: 16}
 
 
 def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
@@ -58,6 +59,9 @@ def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
     if tid is dt.TypeId.DATE:
         import numpy as np
         return str(np.datetime64(int(value), "D")).encode()
+    if tid is dt.TypeId.INTERVAL:
+        from ..sql.binder import format_interval
+        return format_interval(int(value)).encode()
     if isinstance(value, float):
         import math
         if math.isnan(value):
@@ -108,6 +112,10 @@ def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
         return struct.pack("!q", int(value) - _PG_EPOCH_US)
     if tid is dt.TypeId.DATE:
         return struct.pack("!i", int(value) - _PG_EPOCH_DAYS)
+    if tid is dt.TypeId.INTERVAL:
+        # PG binary interval: (µs int64, days int32, months int32); ours
+        # is µs-only, semantically equal for fixed-unit intervals
+        return struct.pack("!qii", int(value), 0, 0)
     return pg_text(value, typ)
 
 

@@ -614,6 +614,84 @@ def _expr_key(e: Optional[BoundExpr]) -> str:
     return "/".join(parts)
 
 
+_US_PER = {
+    "microsecond": 1, "us": 1,
+    "millisecond": 1000, "ms": 1000,
+    "second": 1_000_000, "sec": 1_000_000, "s": 1_000_000,
+    "minute": 60_000_000, "min": 60_000_000,
+    "hour": 3_600_000_000, "h": 3_600_000_000, "hr": 3_600_000_000,
+    "day": 86_400_000_000, "d": 86_400_000_000,
+    "week": 604_800_000_000, "w": 604_800_000_000,
+}
+_IVAL_PAIR = re.compile(r"([+-]?\d+(?:\.\d+)?)\s*([a-zA-Z]+)")
+_IVAL_CLOCK = re.compile(
+    r"^([+-])?(\d+):(\d{1,2})(?::(\d{1,2})(\.\d+)?)?$")
+
+
+def parse_interval(text: str) -> int:
+    """'1 day 02:30:00', '90 minutes', '1.5 hours' → microseconds.
+    Calendar units (month/year) have no fixed length and are rejected
+    rather than silently approximated."""
+    t = text.strip().lower()
+    m = _IVAL_CLOCK.match(t)
+    if m:
+        sign = -1 if m.group(1) == "-" else 1
+        us = (int(m.group(2)) * 3_600_000_000 +
+              int(m.group(3)) * 60_000_000 +
+              (int(m.group(4)) if m.group(4) else 0) * 1_000_000 +
+              (int(round(float(m.group(5)) * 1e6))
+               if m.group(5) else 0))
+        return sign * us
+    total = 0
+    matched = 0
+    pos = 0
+    for m in _IVAL_PAIR.finditer(t):
+        if t[pos:m.start()].strip(" ,"):
+            raise ValueError(text)
+        pos = m.end()
+        qty, unit = float(m.group(1)), m.group(2).rstrip("s") \
+            if m.group(2) not in ("s", "us", "ms") else m.group(2)
+        if unit in ("month", "mon", "year", "yr", "y"):
+            raise errors.unsupported(
+                "calendar interval units (month/year) — use fixed units "
+                "(days/hours/...)")
+        if unit not in _US_PER:
+            raise ValueError(text)
+        # the remainder may be a clock part ('1 day 02:30:00')
+        total += int(round(qty * _US_PER[unit]))
+        matched += 1
+    rest = t[pos:].strip(" ,")
+    if rest:
+        cm = _IVAL_CLOCK.match(rest)
+        if cm is None:
+            raise ValueError(text)
+        total += parse_interval(rest)
+        matched += 1
+    if matched == 0:
+        raise ValueError(text)
+    return total
+
+
+def format_interval(us: int) -> str:
+    """PG-style rendering with PER-COMPONENT signs ('-1 days -02:30:00'):
+    a text round-trip through parse_interval is value-preserving."""
+    sign = "-" if us < 0 else ""
+    us = abs(int(us))
+    days, rem = divmod(us, 86_400_000_000)
+    h, rem = divmod(rem, 3_600_000_000)
+    mi, rem = divmod(rem, 60_000_000)
+    se, frac = divmod(rem, 1_000_000)
+    parts = []
+    if days:
+        parts.append(f"{sign}{days} day" + ("s" if days != 1 else ""))
+    if h or mi or se or frac or not days:
+        clock = f"{sign}{h:02d}:{mi:02d}:{se:02d}"
+        if frac:
+            clock += f".{frac:06d}".rstrip("0")
+        parts.append(clock)
+    return " ".join(parts)
+
+
 def format_timestamp(us: int) -> str:
     """PG-style timestamp text: microseconds only when non-zero."""
     s = str(np.datetime64(int(us), "us")).replace("T", " ")
@@ -665,12 +743,15 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         return Column(target, data, validity)
     if target.is_float:
         return Column(target, col.data.astype(target.np_dtype), validity)
-    if target.id in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE):
+    if target.id in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE,
+                     dt.TypeId.INTERVAL):
         return Column(target, col.data.astype(target.np_dtype), validity)
     raise errors.unsupported(f"cast {src} -> {target}")
 
 
 def _cast_to_text(v, src: dt.SqlType) -> str:
+    if src.id is dt.TypeId.INTERVAL:
+        return format_interval(int(v))
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):
@@ -697,6 +778,8 @@ def _cast_text_to(v: str, target: dt.SqlType):
             return int(np.datetime64(s).astype("datetime64[us]").astype(np.int64))
         if target.id is dt.TypeId.DATE:
             return int(np.datetime64(s, "D").astype(np.int64))
+        if target.id is dt.TypeId.INTERVAL:
+            return parse_interval(s)
     except ValueError:
         raise errors.SqlError(errors.INVALID_TEXT_REPRESENTATION,
                               f'invalid input syntax for type {target}: "{v}"')

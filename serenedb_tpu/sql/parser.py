@@ -651,8 +651,12 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return ast.FuncCall("extract", [ast.Literal(fld.lower()), e])
-        if upper in ("INTERVAL",):
-            raise errors.unsupported("INTERVAL literals not supported yet")
+        if upper == "INTERVAL":
+            self.next()
+            lit = self.next()
+            if lit.kind is not T.STRING:
+                raise errors.syntax("INTERVAL requires a string literal")
+            return ast.Cast(ast.Literal(lit.value), "INTERVAL")
         if upper in ("DATE", "TIMESTAMP") and self.peek(1).kind is T.STRING:
             self.next()
             lit = self.next()
