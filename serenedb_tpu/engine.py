@@ -371,6 +371,32 @@ class Database(TableResolver):
                 cols["unnest" if i == 0 else f"unnest_{i}"] = \
                     ls + [None] * (n - len(ls))
             return MemTable("unnest", Batch.from_pydict(cols))
+        if name == "generate_series":
+            # set-returning integer series (PG: generate_series(a, b[, s]))
+            if len(args) < 2:
+                raise errors.SqlError(
+                    "42883", "generate_series requires start and stop")
+            if any(a is None for a in args[:3]):
+                return MemTable("generate_series", Batch(
+                    ["generate_series"],
+                    [Column.from_numpy(np.empty(0, dtype=np.int64))]))
+            try:
+                start, stop = int(args[0]), int(args[1])
+                step = int(args[2]) if len(args) > 2 else 1
+            except (TypeError, ValueError, OverflowError):
+                raise errors.SqlError(
+                    errors.INVALID_TEXT_REPRESENTATION,
+                    "generate_series arguments must be integers")
+            if step == 0:
+                raise errors.SqlError(
+                    "22023", "step size cannot equal zero")
+            n = max(0, (stop - start) // step + 1)
+            if n > 50_000_000:
+                raise errors.SqlError(
+                    "54000", "generate_series result set too large")
+            vals = np.arange(start, start + n * step, step, dtype=np.int64)
+            return MemTable("generate_series", Batch(
+                ["generate_series"], [Column.from_numpy(vals)]))
         if name == "sdb_log":
             from .pgcatalog import log_table
             return log_table()

@@ -142,8 +142,8 @@ _US_DAY = 86_400_000_000
 
 
 def _datetime_arith(op: str, ts: list):
-    """(result_type, a_to_us, b_to_us) for timestamp/date/interval
-    arithmetic (PG rules); None when not a datetime combination."""
+    """Result SqlType for timestamp/date/interval arithmetic (PG rules);
+    None when the operand types are not a datetime combination."""
     TS, D, IV = dt.TypeId.TIMESTAMP, dt.TypeId.DATE, dt.TypeId.INTERVAL
     a, b = ts[0].id, ts[1].id
     NULL = dt.TypeId.NULL
@@ -225,6 +225,12 @@ def _make_datetime_arith(op: str, ts: list, out_t):
             kk = k.data.astype(np.int64)
             data = (d.data.astype(np.int64) + kk if op == "+"
                     else d.data.astype(np.int64) - kk)
+            pn = propagate_nulls(cols)
+            over = np.abs(data) > 2**31 - 1
+            if pn is not None:
+                over &= pn
+            if over.any():
+                raise errors.SqlError("22008", "date out of range")
             return _result(dt.DATE, data.astype(np.int32), cols)
         if out_t.id is dt.TypeId.INT:
             # date - date = days

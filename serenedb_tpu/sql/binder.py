@@ -625,7 +625,7 @@ _US_PER = {
 }
 _IVAL_PAIR = re.compile(r"([+-]?\d+(?:\.\d+)?)\s*([a-zA-Z]+)")
 _IVAL_CLOCK = re.compile(
-    r"^([+-])?(\d+):(\d{1,2})(?::(\d{1,2})(\.\d+)?)?$")
+    r"^([+-])?(\d+):([0-5]?\d)(?::([0-5]?\d)(\.\d+)?)?$")
 
 
 def parse_interval(text: str) -> int:
@@ -705,6 +705,13 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
     src = col.type
     if src == target:
         return col
+    if dt.TypeId.INTERVAL in (src.id, target.id) and not (
+            src.is_string or target.is_string or
+            src.id is dt.TypeId.NULL):
+        # PG: intervals cast only to/from text (42846) — reinterpreting
+        # µs as days/epochs would produce silent garbage
+        raise errors.SqlError(
+            "42846", f"cannot cast type {src} to {target}")
     validity = col.validity
     if target.is_string:
         if src.id is dt.TypeId.TIMESTAMP:

@@ -226,8 +226,8 @@ class Planner:
             provider = self.resolver.resolve_table_function(ref.name, args)
             node, scope = self._scan_scope(
                 provider, ref.alias or ref.name.split(".")[-1])
-            if ref.alias and ref.name == "unnest" and \
-                    len(scope.columns) == 1:
+            if ref.alias and ref.name in ("unnest", "generate_series") \
+                    and len(scope.columns) == 1:
                 # PG: an alias on a single-column table function renames
                 # the column too (SELECT u FROM unnest(...) AS u)
                 c = scope.columns[0]
