@@ -1376,7 +1376,10 @@ class Connection:
                 scope = Scope.of(list(full.names),
                                  [c.type for c in full.columns],
                                  st.table[-1])
-                pred = ExprBinder(scope, params).bind(st.where)
+                planner = Planner(_ResolverShim(self.db, params, self),
+                                  params)
+                pred = ExprBinder(scope, params,
+                                  planner=planner).bind(st.where)
                 c = pred.eval(full)
                 rows = np.flatnonzero(c.data.astype(bool) & c.valid_mask())
             n = len(rows)
@@ -1403,7 +1406,8 @@ class Connection:
             full = table.full_batch()
             scope = Scope.of(list(full.names), [c.type for c in full.columns],
                              st.table[-1])
-            binder = ExprBinder(scope, params)
+            planner = Planner(_ResolverShim(self.db, params, self), params)
+            binder = ExprBinder(scope, params, planner=planner)
             if st.where is not None:
                 c = binder.bind(st.where).eval(full)
                 mask = c.data.astype(bool) & c.valid_mask()

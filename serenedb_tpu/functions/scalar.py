@@ -761,6 +761,30 @@ _EXTRACT_FIELDS = {"year", "month", "day", "hour", "minute", "second", "dow",
 def _extract(ts):
     def impl(cols, n):
         field = string_values(cols[0])[0] if n else "year"
+        if cols[1].type.id is dt.TypeId.INTERVAL:
+            # duration fields over µs (normalized: hour < 24 etc.; our
+            # intervals are fixed-duration, unlike PG's month/day split)
+            us = cols[1].data.astype(np.int64)
+            sign = np.sign(us)
+            a = np.abs(us)
+            if field == "epoch":
+                data = us / 1e6
+            elif field == "day":
+                data = sign * (a // 86_400_000_000).astype(np.float64)
+            elif field == "hour":
+                data = sign * ((a // 3_600_000_000) % 24).astype(np.float64)
+            elif field == "minute":
+                data = sign * ((a // 60_000_000) % 60).astype(np.float64)
+            elif field == "second":
+                data = sign * ((a % 60_000_000) / 1e6)
+            elif field in ("millisecond", "milliseconds"):
+                data = sign * ((a % 60_000_000) / 1e3)
+            elif field in ("microsecond", "microseconds"):
+                data = sign * (a % 60_000_000).astype(np.float64)
+            else:
+                raise errors.unsupported(
+                    f"extract field {field!r} from interval")
+            return _result(dt.DOUBLE, data, cols[1:])
         micros = cols[1].data.astype("datetime64[us]") \
             if cols[1].type.id is dt.TypeId.TIMESTAMP \
             else cols[1].data.astype("datetime64[D]").astype("datetime64[us]")

@@ -298,6 +298,39 @@ class EsApi:
 
     # -- search ------------------------------------------------------------
 
+    def delete_by_query(self, index: str, body: Optional[dict]) -> dict:
+        """_delete_by_query: DSL → DELETE (reference: the ES task-based
+        deletion; ours is synchronous). max_docs caps the deletion by
+        _id order."""
+        t = self._table(index)
+        body = body or {}
+        if not isinstance(body, dict):
+            raise EsError(400, "parsing_exception",
+                          "_delete_by_query body must be a JSON object")
+        q = body.get("query")
+        if q is None:
+            raise EsError(400, "parsing_exception",
+                          "_delete_by_query requires a query")
+        where, _ = self._translate_query(q)
+        max_docs = body.get("max_docs")
+        with self._lock:
+            if max_docs is not None:
+                # cap via an id subselect (deterministic by _id order)
+                inner = f'SELECT "_id" FROM {_ident(t.name)}'
+                if where:
+                    inner += f" WHERE {where}"
+                inner += f' ORDER BY "_id" LIMIT {int(max_docs)}'
+                sql = (f'DELETE FROM {_ident(t.name)} WHERE "_id" IN '
+                       f"({inner})")
+            else:
+                sql = f"DELETE FROM {_ident(t.name)}"
+                if where:
+                    sql += f" WHERE {where}"
+            res = self.conn.execute(sql)
+        deleted = int(res.command_tag.split()[-1])
+        return {"took": 1, "timed_out": False, "total": deleted,
+                "deleted": deleted, "failures": []}
+
     def refresh(self, index: Optional[str] = None) -> dict:
         self.conn.execute(f'VACUUM REFRESH "{index}"' if index
                           else "VACUUM REFRESH")

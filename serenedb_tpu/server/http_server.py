@@ -186,6 +186,9 @@ class Handler(BaseHTTPRequestHandler):
                 raise EsError(405, "method_not_allowed",
                               f"{method} on _doc requires an id")
             return
+        if verb == "_delete_by_query" and method == "POST":
+            self._send(200, es.delete_by_query(index, self._json_body()))
+            return
         if verb == "_update" and method == "POST" and len(rest) > 1:
             self._send(200, es.update_doc(index, rest[1],
                                           self._json_body() or {}))

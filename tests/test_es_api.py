@@ -521,3 +521,37 @@ def test_update_empty_upsert_and_bulk_parity(srv):
     # non-dict doc -> 400, not 500
     st, body = req(srv, "POST", "/eu/_update/1", {"doc": [1, 2]})
     assert st == 400
+
+
+def test_delete_by_query(srv):
+    req(srv, "PUT", "/dbq")
+    for i, lvl in enumerate(["err", "err", "ok"]):
+        req(srv, "PUT", f"/dbq/_doc/{i}", {"level": lvl})
+    st, body = req(srv, "POST", "/dbq/_delete_by_query",
+                   {"query": {"term": {"level": "err"}}})
+    assert st == 200 and body["deleted"] == 2
+    st, body = req(srv, "GET", "/dbq/_count")
+    assert body["count"] == 1
+    # match_all wipes the rest
+    st, body = req(srv, "POST", "/dbq/_delete_by_query",
+                   {"query": {"match_all": {}}})
+    assert body["deleted"] == 1
+    # missing query -> 400; unknown index -> 404
+    st, _ = req(srv, "POST", "/dbq/_delete_by_query", {})
+    assert st == 400
+    st, _ = req(srv, "POST", "/ghostdbq/_delete_by_query",
+                {"query": {"match_all": {}}})
+    assert st == 404
+
+
+def test_delete_by_query_max_docs_and_bad_body(srv):
+    req(srv, "PUT", "/dbm")
+    for i in range(4):
+        req(srv, "PUT", f"/dbm/_doc/{i}", {"x": 1})
+    st, body = req(srv, "POST", "/dbm/_delete_by_query",
+                   {"query": {"match_all": {}}, "max_docs": 2})
+    assert st == 200 and body["deleted"] == 2
+    st, body = req(srv, "GET", "/dbm/_count")
+    assert body["count"] == 2
+    st, _ = req(srv, "POST", "/dbm/_delete_by_query", "[1, 2]")
+    assert st == 400
