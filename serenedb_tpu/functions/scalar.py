@@ -658,25 +658,8 @@ def like_impl(cols, n, negated=False, ci=False):
     return _result(dt.BOOL, data, cols)
 
 
-def _make_regexp(ci, negated):
-    def resolver(ts):
-        def impl(cols, n):
-            a = string_values(cols[0])
-            pats = string_values(cols[1])
-            flags = re.IGNORECASE if ci else 0
-            data = np.asarray([bool(re.compile(p, flags).search(x))
-                               for x, p in zip(a, pats)])
-            if negated:
-                data = ~data
-            return _result(dt.BOOL, data, cols)
-        return FunctionResolution(dt.BOOL, impl)
-    return resolver
-
-
-_REGISTRY["regexp_match_op"] = _make_regexp(False, False)
-_REGISTRY["regexp_imatch_op"] = _make_regexp(True, False)
-_REGISTRY["regexp_not_match_op"] = _make_regexp(False, True)
-_REGISTRY["regexp_not_imatch_op"] = _make_regexp(True, True)
+# (the former backtracking-`re` regexp_match_op path was removed: all
+# regex operators now route through the linear-time NFA above)
 
 
 @register("regexp_replace")
@@ -1147,6 +1130,54 @@ def _json_valid(ts):
                 pass
         return _result(dt.BOOL, out, cols)
     return FunctionResolution(dt.BOOL, impl)
+
+
+def _make_regex_match(ci: bool, negated: bool):
+    """PG ~ / ~* / !~ / !~* — unanchored regex search over strings,
+    compiled on the linear-time NFA (search/regexp.py): user patterns
+    never hit a backtracking engine."""
+    def resolver(ts):
+        if len(ts) != 2 or not all(
+                t.is_string or t.id is dt.TypeId.NULL for t in ts):
+            return None
+
+        def impl(cols, n):
+            from ..search.regexp import RegexpError, compile_regexp
+            texts = string_values(cols[0])
+            pats = string_values(cols[1])
+            valid = propagate_nulls(cols)
+            comp_cache: dict = {}
+            out = np.zeros(n, dtype=bool)
+            for i in range(n):
+                if valid is not None and not valid[i]:
+                    continue
+                pat = pats[i]
+                r = comp_cache.get(pat)
+                if r is None:
+                    try:
+                        # unanchored search; ^/$ are real zero-width
+                        # assertions in the NFA, composing with the
+                        # wrapper per PG semantics (per-branch anchors)
+                        r = compile_regexp(f"(.|\n)*({pat})(.|\n)*",
+                                           case_fold=ci)
+                    except RegexpError as e:
+                        raise errors.SqlError(
+                            errors.INVALID_REGULAR_EXPRESSION,
+                            f"invalid regular expression: {e}")
+                    comp_cache[pat] = r
+                hay = texts[i].lower() if ci else texts[i]
+                out[i] = r.fullmatch(hay)
+            if negated:
+                out = ~out
+            return _result(dt.BOOL, out, cols)
+        return FunctionResolution(dt.BOOL, impl)
+    return resolver
+
+
+_REGISTRY["op~"] = _make_regex_match(False, False)
+_REGISTRY["op~*"] = _make_regex_match(True, False)
+_REGISTRY["op!~"] = _make_regex_match(False, True)
+_REGISTRY["op!~*"] = _make_regex_match(True, True)
 
 
 # -- geo functions ---------------------------------------------------------

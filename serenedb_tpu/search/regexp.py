@@ -10,8 +10,10 @@ like `(a+)+c`.
 Supported syntax (Lucene-regexp-lite): literals, `.`, `[...]` classes with
 ranges and `^` negation, `\\d \\w \\s` (+ uppercase complements), `\\x`
 literal escapes, `* + ?` and `{m}`/`{m,}`/`{m,n}` quantifiers, `|`
-alternation, `(...)` grouping. Matching is anchored (fullmatch), as in
-Lucene.
+alternation, `(...)` grouping, and `^`/`$` start/end assertions
+(zero-width, per alternation branch — PG semantics). Matching is
+fullmatch; the SQL `~` operators wrap patterns in `(.|\n)*` for
+unanchored search, which composes with the assertions.
 """
 
 from __future__ import annotations
@@ -51,6 +53,11 @@ class _Class:
     def __init__(self, ranges, negated):
         self.ranges = ranges            # list of (lo_char, hi_char)
         self.negated = negated
+
+
+class _Assert:
+    def __init__(self, kind):
+        self.kind = kind                # "start" | "end"
 
 
 class _Parser:
@@ -112,6 +119,12 @@ class _Parser:
             return _Char(e)
         if c in "*+?{":
             self.error(f"quantifier {c!r} with nothing to repeat")
+        if c == "^":
+            self.i += 1
+            return _Assert("start")
+        if c == "$":
+            self.i += 1
+            return _Assert("end")
         self.i += 1
         return _Char(c)
 
@@ -208,11 +221,12 @@ class _Parser:
 # -- NFA construction (epsilon transitions; start/end per fragment) ---------
 
 class _State:
-    __slots__ = ("eps", "edges")
+    __slots__ = ("eps", "edges", "asserts")
 
     def __init__(self):
         self.eps = []                   # epsilon-reachable states
         self.edges = []                 # (matcher_atom, target)
+        self.asserts = []               # (kind, target) zero-width
 
 
 class Regexp:
@@ -284,7 +298,10 @@ class Regexp:
         if isinstance(atom, _Alt):
             return self._build_alt(atom)
         s, e = self._new_state(), self._new_state()
-        s.edges.append((atom, e))
+        if isinstance(atom, _Assert):
+            s.asserts.append((atom.kind, e))
+        else:
+            s.edges.append((atom, e))
         return s, e
 
     @staticmethod
@@ -297,7 +314,9 @@ class Regexp:
         return hit != atom.negated
 
     @staticmethod
-    def _closure(states: set) -> set:
+    def _closure(states: set, at_start: bool, at_end: bool) -> set:
+        """Epsilon closure; assertion edges traverse only when the
+        current position satisfies them (zero-width, linear time)."""
         out = set(states)
         stack = list(states)
         while stack:
@@ -306,16 +325,22 @@ class Regexp:
                 if nxt not in out:
                     out.add(nxt)
                     stack.append(nxt)
+            for kind, nxt in st.asserts:
+                ok = at_start if kind == "start" else at_end
+                if ok and nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
         return out
 
     def fullmatch(self, s: str) -> bool:
-        cur = self._closure({self.start})
-        for ch in s:
+        n = len(s)
+        cur = self._closure({self.start}, True, n == 0)
+        for i, ch in enumerate(s):
             nxt = {t for st in cur for atom, t in st.edges
                    if self._atom_matches(atom, ch)}
             if not nxt:
                 return False
-            cur = self._closure(nxt)
+            cur = self._closure(nxt, False, i + 1 == n)
         return self.end in cur
 
 

@@ -26,7 +26,7 @@ _KEYWORDS_STOP_ALIAS = {
 }
 
 _COMPARE_OPS = {"=", "<>", "!=", "<", "<=", ">", ">=", "##", "@@",
-                "<->", "<#>", "<=>"}
+                "<->", "<#>", "<=>", "~", "~*", "!~", "!~*"}
 
 
 class Parser:
@@ -508,13 +508,6 @@ class Parser:
                 self.next()
                 right = self.parse_additive_chain()
                 left = ast.BinaryOp(t.value, left, right)
-                continue
-            if t.kind is T.OP and t.value in ("~", "~*", "!~", "!~*"):
-                self.next()
-                right = self.parse_additive_chain()
-                fn = {"~": "regexp_match_op", "~*": "regexp_imatch_op",
-                      "!~": "regexp_not_match_op", "!~*": "regexp_not_imatch_op"}[t.value]
-                left = ast.FuncCall(fn, [left, right])
                 continue
             break
         return left

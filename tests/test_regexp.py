@@ -93,3 +93,17 @@ def test_literal_prefix_extraction():
     assert compile_regexp("ab(c|d)").literal_prefix == "ab"
     assert compile_regexp("ab+c").literal_prefix == "a"
     assert compile_regexp(r"a\.b").literal_prefix == "a.b"
+
+
+def test_anchor_assertions():
+    # ^/$ are zero-width assertions, composing with unanchored wrappers
+    r = compile_regexp("(.|\n)*(^a|b)(.|\n)*")
+    assert r.fullmatch("xb") and r.fullmatch("ab")
+    assert not r.fullmatch("xa")
+    r = compile_regexp("(.|\n)*(a$|b)(.|\n)*")
+    assert r.fullmatch("za") and r.fullmatch("bz")
+    assert not r.fullmatch("az")
+    assert compile_regexp("^abc$").fullmatch("abc")
+    assert not compile_regexp("a^b").fullmatch("ab")
+    assert compile_regexp("^$").fullmatch("")
+    assert not compile_regexp("^$").fullmatch("x")
