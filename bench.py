@@ -1,29 +1,47 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Two flagship shapes from BASELINE.md, measured on whatever jax device is
+Flagship shapes from BASELINE.md, measured on whatever jax device is
 available (real TPU under the driver):
 
-1. ClickBench-Q1-shaped aggregate: SELECT count(*), sum(x) WHERE filter over
-   a synthetic 10M-row table — device path vs the engine's own CPU path.
-2. BM25 top-10 over a synthetic corpus (100k docs) — device block-scoring
-   QPS vs the CPU reference scorer on the same index.
+- q1:   ClickBench-Q1-shaped aggregates over a synthetic 10M-row table —
+        device path vs the engine's own CPU path.
+- bm25: BM25 top-10 over a synthetic corpus (100k docs) — device
+        block-scoring QPS vs the CPU reference scorer on the same index.
 
-value = geometric mean speedup (device vs single-socket CPU paths);
-vs_baseline = the same ratio (the BASELINE.json targets are 3x / 2x on these
-two shapes respectively).
+value = geometric mean speedup (device vs single-socket CPU paths) over
+the shapes that completed; vs_baseline = the same ratio (BASELINE.json
+targets 3x / 2x on these shapes).
+
+Robustness: the tunneled TPU on this rig can hang any dispatch forever
+during tunnel outages (not an error — a hang). So the driver process
+never dispatches to the device itself. Instead it:
+  1. probes device liveness in a short-timeout subprocess, retrying with
+     backoff while the time budget allows;
+  2. runs each bench shape in its own subprocess with a hard timeout, so
+     one mid-shape hang costs that shape, not the round;
+  3. always prints the one JSON line, with per-shape partial results and
+     errors, before exiting.
+Budget via SDB_BENCH_BUDGET_S (default 1200s total).
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+METRIC = ("geomean device-vs-CPU speedup (ClickBench-Q1 agg, BM25 top-10 "
+          "QPS); result parity asserted")
 
+
+# ---------------------------------------------------------------- shapes
 
 def bench_q1() -> float:
+    import numpy as np
+
     from serenedb_tpu.columnar.column import Batch, Column
     from serenedb_tpu.engine import Database
     from serenedb_tpu.exec.tables import MemTable
@@ -65,6 +83,8 @@ def bench_q1() -> float:
 
 
 def bench_bm25() -> float:
+    import numpy as np
+
     from serenedb_tpu.search.analysis import get_analyzer
     from serenedb_tpu.search.query import parse_query
     from serenedb_tpu.search.searcher import SegmentSearcher
@@ -104,55 +124,152 @@ def bench_bm25() -> float:
     qps_dev = reps * len(queries) / t_dev
 
     t0 = time.perf_counter()
-    for q in queries[:64]:
+    # every 4th query: spans all three classes (single/disjunction/
+    # conjunction) so the CPU baseline is an apples-to-apples sample
+    sample = queries[::4]
+    for q in sample:
         match = searcher.eval_filter(q)
         tids = searcher.scoring_terms(q)
         searcher._cpu_score(match, tids, 10)
     t_cpu = time.perf_counter() - t0
-    qps_cpu = 64 / t_cpu
+    qps_cpu = len(sample) / t_cpu
     return qps_dev / qps_cpu
 
 
-def _watchdog(seconds: int = 480):
-    """The tunneled TPU can hang a dispatch indefinitely; the driver must
-    still get its one JSON line. A stuck main thread can't be interrupted,
-    so the watchdog prints an error record and hard-exits."""
-    import os
-    import threading
-
-    def fire():
-        print(json.dumps({
-            "metric": "geomean device-vs-CPU speedup (ClickBench-Q1 agg, "
-                      "BM25 top-10 QPS); result parity asserted",
-            "value": 0.0,
-            "unit": "x",
-            "vs_baseline": 0.0,
-            "error": f"device unresponsive for {seconds}s (tunnel outage?)",
-        }), flush=True)
-        os._exit(3)
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    return t
+SHAPES = {
+    "q1": bench_q1,
+    "bm25": bench_bm25,
+}
 
 
-def main():
-    timer = _watchdog()
-    s_q1 = bench_q1()
-    s_bm = bench_bm25()
-    timer.cancel()
-    geomean = math.sqrt(s_q1 * s_bm)
-    print(json.dumps({
-        "metric": "geomean device-vs-CPU speedup (ClickBench-Q1 agg, BM25 "
-                  "top-10 QPS); result parity asserted",
-        "value": round(geomean, 3),
+# ------------------------------------------------------------- harness
+
+def _run_shape_child(name: str) -> None:
+    """Child mode: run one shape, print its JSON result, exit."""
+    try:
+        if os.environ.get("SDB_BENCH_FORCE_CPU") == "1":
+            # test hook: sitecustomize overrides JAX_PLATFORMS, so force
+            # the CPU backend explicitly (harness validation off-device)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        speedup = SHAPES[name]()
+        print(json.dumps({"shape": name, "speedup": round(speedup, 4)}),
+              flush=True)
+    except Exception as e:  # noqa: BLE001 — report, don't crash silently
+        print(json.dumps({"shape": name, "error": f"{type(e).__name__}: {e}"}),
+              flush=True)
+        sys.exit(1)
+
+
+def _probe_device(timeout_s: float = 75.0) -> tuple[bool, bool, str]:
+    """(alive, transient, error) for a tiny dispatch on the default device.
+
+    transient=True only for a timeout (plausible tunnel outage — worth a
+    retry); a fast nonzero exit is an environment problem and fails fast,
+    with the child's stderr tail surfaced."""
+    force_cpu = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                 if os.environ.get("SDB_BENCH_FORCE_CPU") == "1" else "")
+    code = (force_cpu + "import jax.numpy as jnp; "
+            "assert float(jnp.ones(8).sum()) == 8.0; print('ALIVE')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, True, f"probe timed out after {timeout_s:.0f}s"
+    if r.returncode == 0 and "ALIVE" in r.stdout:
+        return True, False, ""
+    return False, False, r.stderr.strip()[-400:] or "probe exited nonzero"
+
+
+def main() -> None:
+    budget = float(os.environ.get("SDB_BENCH_BUDGET_S", "1200"))
+    deadline = time.monotonic() + budget
+    t_start = time.monotonic()
+
+    # 1. liveness: retry across a possible transient outage, but keep at
+    # least ~2/3 of the budget for the shapes themselves; scale the probe
+    # timeout down for small validation budgets
+    probe_window_end = t_start + budget / 3
+    probe_timeout = max(20.0, min(75.0, budget / 3))
+    probes = 0
+    alive = False
+    probe_err = ""
+    while time.monotonic() < probe_window_end:
+        probes += 1
+        alive, transient, probe_err = _probe_device(probe_timeout)
+        if alive or not transient:
+            break
+        backoff = min(60.0, 10.0 * probes)
+        if time.monotonic() + backoff >= probe_window_end:
+            break
+        time.sleep(backoff)
+
+    results: dict[str, float] = {}
+    errors: dict[str, str] = {}
+    if not alive:
+        errors["device"] = (
+            f"device liveness probe failed {probes}x: {probe_err}")
+    else:
+        shape_floor = max(30.0, min(90.0, budget / 8))
+        for name in SHAPES:
+            remaining = deadline - time.monotonic()
+            if remaining < shape_floor:
+                errors[name] = "skipped: bench budget exhausted"
+                continue
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--shape", name],
+                    capture_output=True, text=True,
+                    timeout=min(600.0, remaining))
+            except subprocess.TimeoutExpired:
+                errors[name] = "shape timed out (device hang mid-run?)"
+                continue
+            rec = None
+            for line in reversed(r.stdout.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(parsed, dict):
+                    rec = parsed
+                    break
+            if rec and isinstance(rec.get("speedup"), (int, float)) \
+                    and rec["speedup"] > 0:
+                results[name] = float(rec["speedup"])
+            else:
+                msg = (rec or {}).get("error") or r.stderr[-400:] or "no output"
+                errors[name] = str(msg)
+
+    if results:
+        logs = [math.log(v) for v in results.values()]
+        value = round(math.exp(sum(logs) / len(logs)), 3)
+    else:
+        value = 0.0
+    out = {
+        "metric": METRIC,
+        "value": value,
         "unit": "x",
-        "vs_baseline": round(geomean, 3),
-        "detail": {"q1_speedup": round(s_q1, 3),
-                   "bm25_qps_ratio": round(s_bm, 3)},
-    }))
+        "vs_baseline": value,
+        "detail": {f"{k}_speedup": v for k, v in results.items()},
+    }
+    if errors:
+        out["errors"] = errors
+        if results:
+            out["partial"] = True
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--shape":
+        _run_shape_child(sys.argv[2])
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — the one JSON line is a contract
+            print(json.dumps({
+                "metric": METRIC, "value": 0.0, "unit": "x",
+                "vs_baseline": 0.0,
+                "errors": {"harness": f"{type(e).__name__}: {e}"},
+            }), flush=True)
+            sys.exit(0)
