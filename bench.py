@@ -105,12 +105,13 @@ def bench_bm25() -> float:
     searcher = SegmentSearcher(fi, an, n_docs)
 
     # benchmark-game-style query set: single terms across the frequency
-    # spectrum, 2-term disjunctions, 2-term conjunctions (256 queries)
+    # spectrum, 2-term disjunctions pairing common with rare terms (the
+    # shape WAND/MaxScore exists for), 2-term conjunctions (256 queries)
     idxs = [1 + 3 * i for i in range(128)]
     qterms = [vocab[i] for i in idxs]
     queries = ([parse_query(t, an) for t in qterms] +
                [parse_query(f"{a} | {b}", an)
-                for a, b in zip(qterms[::2], qterms[1::2])] +
+                for a, b in zip(qterms[:64], qterms[64:][::-1])] +
                [parse_query(f"{a} & {b}", an)
                 for a, b in zip(qterms[1::2], qterms[::2])])
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,12 @@ class BlockStore:
     offsets: np.ndarray
     ndocs_pad: int
     pad_row: int               # index of the all-padding block row
+    # block-max (WAND) metadata, host-resident: per heavy block row the max
+    # tf and min doc length — a score upper bound valid for any avgdl
+    # (reference: formats/posting/wand_writer.hpp impact pairs)
+    block_bmax_tf: np.ndarray = None   # (NB_total+1,) int32
+    block_bmin_dl: np.ndarray = None   # (NB_total+1,) int32
+    norms_host: np.ndarray = None      # (num_docs,) int32
 
 
 def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
@@ -79,6 +86,9 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
     nb_total = int(block_offsets[-1])
     bdocs = np.full((nb_total + 1, BLOCK), -1, dtype=np.int32)
     btfs = np.zeros((nb_total + 1, BLOCK), dtype=np.int32)
+    norms_h = np.ascontiguousarray(norms[:num_docs], dtype=np.int32)
+    bmax_tf = np.zeros(nb_total + 1, dtype=np.int32)
+    bmin_dl = np.full(nb_total + 1, np.iinfo(np.int32).max, dtype=np.int32)
     for t in np.flatnonzero(heavy):
         s, e = int(offsets[t]), int(offsets[t + 1])
         n = e - s
@@ -90,6 +100,10 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
         f = np.concatenate([post_tfs[s:e], np.zeros(pad, dtype=np.int32)])
         bdocs[b0:b0 + nb] = d.reshape(nb, BLOCK)
         btfs[b0:b0 + nb] = f.reshape(nb, BLOCK)
+        bmax_tf[b0:b0 + nb] = f.reshape(nb, BLOCK).max(axis=1)
+        dl = np.where(d >= 0, norms_h[np.clip(d, 0, None)],
+                      np.iinfo(np.int32).max)
+        bmin_dl[b0:b0 + nb] = dl.reshape(nb, BLOCK).min(axis=1)
     nd_pad = max(1024, ((num_docs + 1023) // 1024) * 1024)
     norms_pad = np.zeros(nd_pad, dtype=np.int32)
     norms_pad[:num_docs] = norms[:num_docs]
@@ -104,6 +118,9 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
         offsets=offsets,
         ndocs_pad=nd_pad,
         pad_row=nb_total,
+        block_bmax_tf=bmax_tf,
+        block_bmin_dl=bmin_dl,
+        norms_host=norms_h,
     )
 
 
@@ -123,14 +140,239 @@ class QueryBatch:
     n_queries: int         # logical B before pow2 padding
 
 
+def _sat_exact(tfs: np.ndarray, dls: np.ndarray, k1: float, b: float,
+               avg: float, scorer: str) -> np.ndarray:
+    """Per-posting saturation term of the score (score = w · sat)."""
+    tfs = tfs.astype(np.float64)
+    if scorer == "tfidf":
+        return np.sqrt(tfs)
+    denom = tfs + k1 * (1.0 - b + b * dls.astype(np.float64) /
+                        max(avg, 1e-9))
+    return (k1 + 1.0) * tfs / np.maximum(denom, 1e-9)
+
+
+def _sparse_table(arr: np.ndarray) -> np.ndarray:
+    """Range-max sparse table: tab[j, i] = max(arr[i : i + 2^j])."""
+    n = len(arr)
+    levels = max(1, int(n).bit_length())
+    tab = np.full((levels, n), -np.inf)
+    tab[0] = arr
+    for j in range(1, levels):
+        half = 1 << (j - 1)
+        m = n - (1 << j) + 1
+        if m <= 0:
+            break
+        tab[j, :m] = np.maximum(tab[j - 1, :m], tab[j - 1, half:half + m])
+    return tab
+
+
+def _range_max(tab: np.ndarray, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Vectorized max(arr[lo..hi]) (inclusive) over a sparse table."""
+    length = (hi - lo + 1).astype(np.float64)
+    j = np.floor(np.log2(np.maximum(length, 1.0))).astype(np.int64)
+    j = np.minimum(j, tab.shape[0] - 1)
+    left = tab[j, lo]
+    right = tab[j, np.maximum(hi + 1 - (1 << j), 0)]
+    return np.maximum(left, right)
+
+
+def _bucket_tables(store: BlockStore, tid: int, avg: float, k1: float,
+                   b: float, scorer: str, shift: int) -> np.ndarray:
+    """Sparse range-max table of the term's per-doc-bucket max *sat* value
+    (w-free; the caller scales by idf). Cached on the store — segments are
+    immutable and avg is fixed per (segment, collection-stats) pair."""
+    cache = getattr(store, "_bucket_cache", None)
+    if cache is None:
+        cache = store._bucket_cache = {}
+    if len(cache) > 4096:  # stale stats (avgdl/idf drift) accumulate keys
+        cache.clear()
+    key = (tid, round(avg, 6), scorer, shift, k1, b)
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    n_buckets = (store.ndocs_pad >> shift) + 1
+    arr = np.zeros(n_buckets)
+    s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+    if store.heavy[tid]:
+        b0, b1 = int(store.block_offsets[tid]), int(store.block_offsets[tid + 1])
+        r = np.arange(b0, b1, dtype=np.int64)
+        sat = _sat_exact(store.block_bmax_tf[r], store.block_bmin_dl[r],
+                         k1, b, avg, scorer)
+        loc = r - b0
+        first = store.flat_docs[s + loc * BLOCK]
+        last = store.flat_docs[np.minimum(s + (loc + 1) * BLOCK, e) - 1]
+        bs, be = first >> shift, last >> shift
+        np.maximum.at(arr, bs, sat)
+        np.maximum.at(arr, be, sat)
+        for i in np.flatnonzero(be - bs >= 2):  # blocks spanning ≥3 buckets
+            arr[bs[i] + 1:be[i]] = np.maximum(arr[bs[i] + 1:be[i]], sat[i])
+    elif e > s:
+        d = store.flat_docs[s:e]
+        sat = _sat_exact(store.flat_tfs[s:e], store.norms_host[d],
+                         k1, b, avg, scorer)
+        np.maximum.at(arr, d >> shift, sat)
+    tab = _sparse_table(arr)
+    cache[key] = tab
+    return tab
+
+
+@dataclass
+class WandPlan:
+    """Threshold + bounds for one pure-disjunction query (WAND family).
+
+    theta: lower bound on the k-th final score (from exact champion
+    scoring); maxscore: {tid: w·max sat} for every query term; kept:
+    {tid: surviving global block-row indices} for heavy terms after
+    block-max row pruning against theta."""
+
+    theta: float
+    maxscore: dict
+    kept: dict
+
+
+def wand_prune(store: BlockStore, term_ids, idf: np.ndarray, k: int,
+               avg: float, k1: float, b: float, scorer: str,
+               champions: int = 16) -> Optional[dict]:
+    """Row-pruning view of wand_plan (kept rows only)."""
+    plan = wand_plan(store, term_ids, idf, k, avg, k1, b, scorer, champions)
+    return plan.kept if plan is not None else None
+
+
+def wand_plan(store: BlockStore, term_ids, idf: np.ndarray, k: int,
+              avg: float, k1: float, b: float, scorer: str,
+              champions: int = 16) -> Optional[WandPlan]:
+    """Block-max WAND planning for one pure-disjunction query.
+
+    Reference analog: wand_writer.hpp block-max metadata consumed by
+    block_disjunction's skip logic. TPU re-formulation: instead of
+    data-dependent skipping inside the kernel (shape-hostile), the HOST
+    derives a threshold θ — a lower bound on the k-th final score, from
+    exact scoring of the `champions` best block rows plus all light-term
+    tails. θ powers two exact optimizations chosen by the caller:
+
+    1. MaxScore essential-list split: terms whose max scores sum below θ
+       cannot alone lift a doc into the top-k, so candidate docs are the
+       remaining ("essential") terms' postings only — selective queries
+       collapse to a small sparse scoring problem.
+    2. Block-row pruning for the dense path: a heavy block row is dropped
+       when its own w·sat(block_max_tf, block_min_dl) plus, for every
+       OTHER query term, the max of that term's per-bucket upper bounds
+       over the row's doc range (sparse-table range-max, cached per
+       segment) cannot reach θ.
+
+    Both preserve exact top-k: any doc losing a contribution is provably
+    below the true k-th score. Returns None when not applicable (θ=0 or
+    no heavy terms).
+    """
+    heavy_ts, light_ts = [], []
+    for j, tid in enumerate(term_ids):
+        (heavy_ts if store.heavy[int(tid)] else light_ts).append(
+            (int(tid), float(idf[j])))
+    if not heavy_ts:
+        return None
+    norms = store.norms_host
+    # per-row upper bounds of each heavy term
+    rows_per, ub_per = [], []
+    maxscore = {}
+    for tid, w in heavy_ts:
+        b0, b1 = int(store.block_offsets[tid]), int(store.block_offsets[tid + 1])
+        r = np.arange(b0, b1, dtype=np.int64)
+        ub = w * _sat_exact(store.block_bmax_tf[r], store.block_bmin_dl[r],
+                            k1, b, avg, scorer)
+        rows_per.append(r)
+        ub_per.append(ub)
+        maxscore[tid] = float(ub.max()) if len(ub) else 0.0
+    light_contribs = []  # (docs, contribs) for the champion accumulation
+    for tid, w in light_ts:
+        s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+        if e <= s:
+            maxscore[tid] = 0.0
+            continue
+        d = store.flat_docs[s:e]
+        c = w * _sat_exact(store.flat_tfs[s:e], norms[d], k1, b, avg, scorer)
+        light_contribs.append((d, c))
+        maxscore[tid] = float(c.max())
+
+    # champion pass: exact host scoring of the top-C rows by upper bound
+    all_ub = np.concatenate(ub_per)
+    all_rows = np.concatenate(rows_per)
+    all_w = np.concatenate([np.full(len(r), w)
+                            for (_, w), r in zip(heavy_ts, rows_per)])
+    all_tid = np.concatenate([np.full(len(r), tid, dtype=np.int64)
+                              for (tid, _), r in zip(heavy_ts, rows_per)])
+    C = min(len(all_ub), max(champions, 2 * ((k + BLOCK - 1) // BLOCK)))
+    champ = np.argpartition(-all_ub, C - 1)[:C] if C < len(all_ub) \
+        else np.arange(len(all_ub))
+    docs_parts, contrib_parts = [], []
+    for ci in champ:
+        tid, w, row = int(all_tid[ci]), float(all_w[ci]), int(all_rows[ci])
+        b0 = int(store.block_offsets[tid])
+        s = int(store.offsets[tid]) + (row - b0) * BLOCK
+        e = min(s + BLOCK, int(store.offsets[tid + 1]))
+        d = store.flat_docs[s:e]
+        docs_parts.append(d)
+        contrib_parts.append(w * _sat_exact(store.flat_tfs[s:e], norms[d],
+                                            k1, b, avg, scorer))
+    for d, c in light_contribs:
+        docs_parts.append(d)
+        contrib_parts.append(c)
+    if not docs_parts:
+        return None
+    docs_all = np.concatenate(docs_parts)
+    contrib_all = np.concatenate(contrib_parts)
+    uniq, inv = np.unique(docs_all, return_inverse=True)
+    totals = np.bincount(inv, weights=contrib_all)
+    if len(totals) < k:
+        return None  # fewer champion docs than k → no safe threshold
+    theta = float(np.partition(totals, len(totals) - k)[len(totals) - k])
+    # device scores are float32 while this pass is float64 — shave an
+    # epsilon off θ so borderline rows are kept, never wrongly dropped
+    theta *= 1.0 - 1e-5
+    if theta <= 0.0:
+        return None
+
+    # doc-space bucket size: ≥1024 docs, ≤16384 buckets
+    shift = 10
+    while (store.ndocs_pad >> shift) + 1 > 16384:
+        shift += 1
+    kept = {}
+    for (tid, _w), r, ub in zip(heavy_ts, rows_per, ub_per):
+        if len(r) == 0:
+            kept[tid] = r
+            continue
+        b0 = int(store.block_offsets[tid])
+        s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+        loc = r - b0
+        first = store.flat_docs[s + loc * BLOCK]
+        last = store.flat_docs[np.minimum(s + (loc + 1) * BLOCK, e) - 1]
+        lo_b, hi_b = first >> shift, last >> shift
+        other = np.zeros(len(r))
+        for tid2, w2 in heavy_ts + light_ts:
+            if tid2 == tid:
+                continue
+            tab = _bucket_tables(store, tid2, avg, k1, b, scorer, shift)
+            other += w2 * np.maximum(_range_max(tab, lo_b, hi_b), 0.0)
+        kept[tid] = r[ub + other >= theta]
+    return WandPlan(theta=theta, maxscore=maxscore, kept=kept)
+
+
 def assemble_query_batch(store: BlockStore, n_docs: int,
                          queries: list[tuple[np.ndarray, int]],
                          doc_freq: np.ndarray,
-                         scorer: str = "bm25", idf_of=None) -> QueryBatch:
+                         scorer: str = "bm25", idf_of=None,
+                         wand_k: Optional[int] = None,
+                         avgdl: Optional[float] = None,
+                         k1: float = 1.2, b: float = 0.75,
+                         prunable=None, plans=None) -> QueryBatch:
     """queries: list of (term_ids, require_all) per query. Weights are the
     scorer's per-term idf (computed here so one dispatch covers all);
     idf_of overrides with global collection stats for multi-segment
-    searches."""
+    searches.
+
+    When wand_k is set, queries flagged in `prunable` (pure disjunctions)
+    get block-max WAND pruning: heavy block rows provably unable to reach
+    the top-wand_k are dropped before the device gather (see wand_prune).
+    """
     rows, row_w, row_q = [], [], []
     tails_d, tails_f, tails_w, tails_q = [], [], [], []
     require = []
@@ -143,15 +385,29 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
             idf = np.asarray(idf_of(tid_arr), dtype=np.float32)
         else:
             idf = idf_for(scorer, n_docs, doc_freq[tid_arr])
+        kept = None
+        if plans is not None and plans[qi] is not None and req == 0:
+            kept = plans[qi].kept
+        elif (wand_k is not None and req == 0 and len(term_ids) > 0
+                and (prunable is None or prunable[qi])
+                and store.norms_host is not None
+                and (scorer == "tfidf" or (avgdl or 0.0) > 0.0)):
+            kept = wand_prune(store, term_ids, idf, wand_k,
+                              avgdl if avgdl is not None else 0.0,
+                              k1, b, scorer)
         for k, tid in enumerate(term_ids):
             tid = int(tid)
             w = float(idf[k])
             if store.heavy[tid]:
-                b0 = int(store.block_offsets[tid])
-                b1 = int(store.block_offsets[tid + 1])
-                rows.append(np.arange(b0, b1, dtype=np.int32))
-                row_w.append(np.full(b1 - b0, w, dtype=np.float32))
-                row_q.append(np.full(b1 - b0, qi, dtype=np.int32))
+                if kept is not None:
+                    r = kept[tid].astype(np.int32)
+                else:
+                    b0 = int(store.block_offsets[tid])
+                    b1 = int(store.block_offsets[tid + 1])
+                    r = np.arange(b0, b1, dtype=np.int32)
+                rows.append(r)
+                row_w.append(np.full(len(r), w, dtype=np.float32))
+                row_q.append(np.full(len(r), qi, dtype=np.int32))
             else:
                 s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
                 tails_d.append(store.flat_docs[s:e])

@@ -242,6 +242,66 @@ class SegmentSearcher:
             needs_mask = True
         return tids, require_all, needs_mask, empty
 
+    def _wand_plan_cached(self, store, tids, k: int, avgdl: float,
+                          scorer: str, idf_of):
+        """wand_plan with a per-store memo — segments are immutable, and
+        batched QPS workloads repeat query shapes."""
+        tid_arr = np.asarray(tids, dtype=np.int64)
+        if idf_of is not None:
+            idf = np.asarray(idf_of(tid_arr), dtype=np.float32)
+        else:
+            idf = bm25_ops.idf_for(scorer, self.num_docs,
+                                   self.index.doc_freq[tid_arr])
+        cache = getattr(store, "_plan_cache", None)
+        if cache is None:
+            cache = store._plan_cache = {}
+        if len(cache) > 8192:  # stale stats (avgdl/idf drift) accumulate keys
+            cache.clear()
+        key = (tuple(int(t) for t in tids), k, round(avgdl, 6), scorer,
+               idf.tobytes())
+        if key in cache:
+            return cache[key]
+        plan = bm25_ops.wand_plan(store, tids, idf, k, avgdl, K1, B, scorer)
+        cache[key] = plan
+        return plan
+
+    # candidate cap for the sparse MaxScore path: above this, the dense
+    # device kernel amortizes better than host gather-scoring
+    MAXSCORE_CAND_CAP = 4096
+
+    def _maxscore_candidates(self, plan, tids, k: int) -> Optional[np.ndarray]:
+        """MaxScore essential-list split: if the non-essential terms' max
+        scores sum below θ, docs containing ONLY non-essential terms can
+        never reach the top-k, so the candidate set is the union of the
+        essential terms' postings. Returns sorted candidate doc ids when
+        the sparse path applies (small enough and ≥ k docs), else None.
+
+        Reference analog: the max-score optimization of
+        block_disjunction.hpp / max_score_iterator."""
+        order = sorted(plan.maxscore.items(), key=lambda t: t[1])
+        cum = 0.0
+        non_ess = set()
+        for tid, ms in order:
+            if cum + ms < plan.theta:
+                cum += ms
+                non_ess.add(tid)
+            else:
+                break
+        if not non_ess:
+            return None
+        ess = [t for t in tids if int(t) not in non_ess]
+        if not ess:
+            return None
+        fi = self.index
+        total = sum(int(fi.doc_freq[int(t)]) for t in ess)
+        if total > self.MAXSCORE_CAND_CAP:
+            return None
+        parts = [fi.postings(int(t))[0] for t in ess]
+        cand = np.unique(np.concatenate(parts)) if parts else None
+        if cand is None or len(cand) < k:
+            return None  # too few candidates to fill k exact slots
+        return cand.astype(np.int32)
+
     def topk(self, node: QNode, k: int,
              scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
         return self.topk_batch([node], k, scorer)[0]
@@ -262,23 +322,55 @@ class SegmentSearcher:
         queries = [(np.asarray(tids, dtype=np.int64) if not empty
                     else np.empty(0, dtype=np.int64), req)
                    for tids, req, _, empty in shapes]
+        # block-max WAND applies to pure disjunctions whose device top-k is
+        # final (no exact-match mask re-ranking a subset afterwards)
+        prunable = [req == 0 and not needs_mask and not empty
+                    for _, req, needs_mask, empty in shapes]
+        avgdl = (avgdl_override if avgdl_override is not None
+                 else self.index.avgdl)
+        k_true = min(max(k, 1), max(self.num_docs, 1))
+        plans: list = [None] * len(nodes)
+        host_results: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        if store.norms_host is not None and \
+                (scorer == "tfidf" or avgdl > 0.0):
+            for qi, (tids, req, needs_mask, empty) in enumerate(shapes):
+                if not (prunable[qi] and tids):
+                    continue
+                plan = self._wand_plan_cached(store, tids, k_true, avgdl,
+                                              scorer, idf_of)
+                if plan is None:
+                    continue
+                plans[qi] = plan
+                cand = self._maxscore_candidates(plan, tids, k_true)
+                if cand is not None:
+                    host_results[qi] = self._cpu_score(
+                        cand, tids, k, scorer, idf_of, avgdl_override)
+                    queries[qi] = (np.empty(0, dtype=np.int64), 0)
         qb = bm25_ops.assemble_query_batch(store, self.num_docs, queries,
                                            self.index.doc_freq, scorer,
-                                           idf_of=idf_of)
-        kk = bm25_ops.pad_k(min(max(k, 1), max(self.num_docs, 1)))
+                                           idf_of=idf_of, plans=plans)
+        kk = bm25_ops.pad_k(k_true)
         kk = min(kk, nd_pad)
-        ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
-        vals, docs = bm25_ops.score_topk_packed(
-            store.block_docs, store.block_tfs, store.norms,
-            jnp.asarray(ints), jnp.asarray(floats), nb, tt,
-            nd_pad, kk, nq, bool(qb.require.any()),
-            K1, B,
-            avgdl_override if avgdl_override is not None
-            else self.index.avgdl, scorer)
-        vals, docs = jax.device_get((vals, docs))
+        nq = qb.n_queries
+        if any(len(q[0]) > 0 for q in queries):
+            ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
+            vals, docs = bm25_ops.score_topk_packed(
+                store.block_docs, store.block_tfs, store.norms,
+                jnp.asarray(ints), jnp.asarray(floats), nb, tt,
+                nd_pad, kk, nq, bool(qb.require.any()),
+                K1, B, avgdl, scorer)
+            vals, docs = jax.device_get((vals, docs))
+        else:  # every query resolved host-side — skip the dispatch entirely
+            vals = np.zeros((nq, kk), dtype=np.float32)
+            docs = np.zeros((nq, kk), dtype=np.int32)
         out = []
         for qi, (node, (tids, req, needs_mask, empty)) in enumerate(
                 zip(nodes, shapes)):
+            if qi in host_results:
+                scores, dd = host_results[qi]
+                keep = scores > 0.0
+                out.append((scores[keep][:k], dd[keep][:k]))
+                continue
             scores, dd = vals[qi], docs[qi]
             if empty:
                 out.append((np.empty(0, dtype=np.float32),

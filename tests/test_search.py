@@ -238,3 +238,92 @@ def test_fuzzy_highlight(sql_conn):
     r = c.execute("SELECT ts_headline('databose quirks', 'database~1')"
                   ).scalar()
     assert r == "<b>databose</b> quirks"
+
+
+# -- block-max WAND pruning (reference: wand_writer.hpp / block_disjunction) --
+
+def _wand_fixture(n_docs=6000, seed=11):
+    """A corpus with realistic block-max variance: a clustered 'hot' doc-id
+    region (short docs with high tf of a few terms) and a long cold tail
+    (long docs, background tf only). Blocks covering the cold region get
+    provably-low upper bounds — the structure WAND exploits."""
+    rng = np.random.default_rng(seed)
+    vocab = [f"t{i}" for i in range(40)]
+    docs = []
+    for d in range(n_docs):
+        if d < 600:  # hot cluster: short docs, two boosted terms
+            words = list(rng.choice(vocab, int(rng.integers(20, 60))))
+            words += [vocab[d % 7]] * 30 + [vocab[(d + 1) % 7]] * 30
+        else:        # cold tail: long docs, background term frequencies
+            words = list(rng.choice(vocab, int(rng.integers(150, 300))))
+        docs.append(" ".join(words))
+    an = get_analyzer("simple")
+    fi = build_field_index(docs, an)
+    return SegmentSearcher(fi, an, n_docs), docs, an
+
+
+def test_wand_pruning_parity_and_reduction():
+    """Pruned top-k must equal the unpruned top-k exactly, and the pruning
+    must actually drop block rows on a skewed corpus."""
+    from serenedb_tpu.ops import bm25 as bm25_ops
+    searcher, docs, an = _wand_fixture()
+    store = searcher._device_store()
+    fi = searcher.index
+    qs = ["t0 | t1", "t2 | t3 | t4", "t5", "t0 | t6 | t1"]
+    nodes = [parse_query(q, an) for q in qs]
+    k = 10
+
+    # unpruned assembly (wand off) vs pruned assembly row counts
+    shapes = [searcher._query_shape(n) for n in nodes]
+    queries = [(np.asarray(t, dtype=np.int64), r)
+               for t, r, _, _ in shapes]
+    qb_off = bm25_ops.assemble_query_batch(store, searcher.num_docs,
+                                           queries, fi.doc_freq)
+    qb_on = bm25_ops.assemble_query_batch(
+        store, searcher.num_docs, queries, fi.doc_freq,
+        wand_k=k, avgdl=fi.avgdl)
+    rows_off = int((qb_off.row_idx != store.pad_row).sum())
+    rows_on = int((qb_on.row_idx != store.pad_row).sum())
+    assert rows_on < rows_off, (rows_on, rows_off)
+
+    # end-to-end parity: device top-k with pruning equals CPU reference
+    out = searcher.topk_batch(nodes, k)
+    for node, (scores, dd) in zip(nodes, out):
+        match = searcher.eval_filter(node)
+        tids = searcher.scoring_terms(node)
+        ref_s, ref_d = searcher._cpu_score(match, tids, k)
+        np.testing.assert_allclose(scores, ref_s[:len(scores)],
+                                   rtol=2e-3, atol=1e-3)
+        # doc sets must agree wherever scores are not tied at the cut
+        assert set(dd.tolist()) == set(ref_d[:len(dd)].tolist()) or \
+            abs(float(ref_s[len(dd) - 1]) - float(ref_s[min(len(dd), len(ref_s) - 1)])) < 1e-4
+
+
+def test_wand_prune_never_drops_topk_docs():
+    """Direct unit check of wand_prune: every true top-k doc's rows survive."""
+    from serenedb_tpu.ops import bm25 as bm25_ops
+    searcher, docs, an = _wand_fixture(n_docs=4000, seed=5)
+    store = searcher._device_store()
+    fi = searcher.index
+    tids = [fi.term_id("t0"), fi.term_id("t1"), fi.term_id("t2")]
+    assert all(t >= 0 for t in tids)
+    k = 7
+    idf = bm25_ops.idf_lucene(searcher.num_docs, fi.doc_freq[np.asarray(tids)])
+    kept = bm25_ops.wand_prune(store, tids, idf, k, fi.avgdl, 1.2, 0.75,
+                               "bm25")
+    if kept is None:
+        return  # nothing prunable on this corpus — parity covered above
+    ref_s, ref_d = searcher._cpu_score(
+        np.arange(searcher.num_docs, dtype=np.int32), tids, k)
+    for d in ref_d:
+        d = int(d)
+        for tid in tids:
+            if not store.heavy[tid]:
+                continue
+            s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+            pd = store.flat_docs[s:e]
+            i = int(np.searchsorted(pd, d))
+            if i >= len(pd) or pd[i] != d:
+                continue  # term doesn't hit this doc
+            row = int(store.block_offsets[tid]) + i // 128
+            assert row in set(kept[tid].tolist()), (d, tid)
