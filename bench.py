@@ -33,8 +33,8 @@ import subprocess
 import sys
 import time
 
-METRIC = ("geomean device-vs-CPU speedup (ClickBench-Q1 agg, BM25 top-10 "
-          "QPS); result parity asserted")
+METRIC = ("geomean device-vs-CPU speedup (ClickBench Q1 agg, ClickBench "
+          "Q5-Q20 hash GROUP BY, BM25 top-10 QPS); result parity asserted")
 
 
 # ---------------------------------------------------------------- shapes
@@ -79,6 +79,90 @@ def bench_q1() -> float:
     dev_res = run_all()
     t_dev = time.perf_counter() - t0
     assert cpu_res == dev_res, "device/CPU result mismatch in Q1 bench"
+    return t_cpu / t_dev
+
+
+def bench_hits() -> float:
+    """ClickBench Q5–Q20-style hash GROUP BY aggregates over a faithful
+    10M-row hits generator: full-range int64 UserID (zipf-skewed user
+    activity), skewed RegionID, mostly-zero AdvEngineID, mostly-empty
+    SearchPhrase, SearchEngineID. Exercises direct-coded, dictionary and
+    host-factorized device GROUP BY paths. ORDER BY gets deterministic
+    tie-breaks so result parity is assertable (reference harness:
+    scripts/perf/run_hits_perf.sh)."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+
+    rng = np.random.default_rng(3)
+    n = 10_000_000
+    n_users = 500_000
+    user_hashes = rng.integers(0, 1 << 62, n_users, dtype=np.int64)
+    uid = user_hashes[rng.zipf(1.4, n).astype(np.int64) % n_users]
+    region = (rng.zipf(1.5, n) % 9000).astype(np.int32)
+    adv = np.where(rng.random(n) < 0.96, 0,
+                   rng.integers(1, 64, n)).astype(np.int32)
+    n_phrases = 100_000
+    phrase_pool = np.asarray([""] + [f"phrase {i}" for i in range(n_phrases)],
+                             dtype=object)
+    pid = np.where(rng.random(n) < 0.7, 0,
+                   1 + rng.zipf(1.3, n) % n_phrases).astype(np.int64)
+    seid = (rng.zipf(1.6, n) % 100).astype(np.int32)
+    width = rng.integers(0, 4000, n).astype(np.int32)
+
+    db = Database()
+    c = db.connect()
+    batch = Batch.from_pydict({
+        "UserID": Column.from_numpy(uid),
+        "RegionID": Column.from_numpy(region),
+        "AdvEngineID": Column.from_numpy(adv),
+        "SearchPhrase": Column.from_numpy(phrase_pool[pid]),
+        "SearchEngineID": Column.from_numpy(seid),
+        "ResolutionWidth": Column.from_numpy(width),
+    })
+    db.schemas["main"].tables["hits"] = MemTable("hits", batch)
+    queries = [
+        # Q8: low-card direct-coded key
+        "SELECT AdvEngineID, count(*) AS c FROM hits WHERE AdvEngineID <> 0 "
+        "GROUP BY AdvEngineID ORDER BY c DESC, AdvEngineID",
+        # Q10-shape (no distinct): region rollup
+        "SELECT RegionID, sum(AdvEngineID), count(*) AS c, "
+        "avg(ResolutionWidth) FROM hits GROUP BY RegionID "
+        "ORDER BY c DESC, RegionID LIMIT 10",
+        # Q13: dictionary string key
+        "SELECT SearchPhrase, count(*) AS c FROM hits "
+        "WHERE SearchPhrase <> '' GROUP BY SearchPhrase "
+        "ORDER BY c DESC, SearchPhrase LIMIT 10",
+        # Q15: composite key beyond the direct code space → factorize
+        "SELECT SearchEngineID, SearchPhrase, count(*) AS c FROM hits "
+        "WHERE SearchPhrase <> '' GROUP BY SearchEngineID, SearchPhrase "
+        "ORDER BY c DESC, SearchEngineID, SearchPhrase LIMIT 10",
+        # Q16: full-range int64 key → factorize
+        "SELECT UserID, count(*) AS c FROM hits GROUP BY UserID "
+        "ORDER BY c DESC, UserID LIMIT 10",
+        # Q17: wide composite key → factorize
+        "SELECT UserID, SearchPhrase, count(*) AS c FROM hits "
+        "GROUP BY UserID, SearchPhrase ORDER BY c DESC, UserID, "
+        "SearchPhrase LIMIT 10",
+    ]
+
+    def run_all():
+        return [tuple(c.execute(q).rows()) for q in queries]
+
+    c.execute("SET serene_device = 'cpu'")
+    run_all()
+    t0 = time.perf_counter()
+    cpu_res = run_all()
+    t_cpu = time.perf_counter() - t0
+
+    c.execute("SET serene_device = 'tpu'")
+    run_all()  # compile + upload + factorize-cache warm
+    t0 = time.perf_counter()
+    dev_res = run_all()
+    t_dev = time.perf_counter() - t0
+    assert cpu_res == dev_res, "device/CPU result mismatch in hits bench"
     return t_cpu / t_dev
 
 
@@ -139,6 +223,7 @@ def bench_bm25() -> float:
 
 SHAPES = {
     "q1": bench_q1,
+    "hits": bench_hits,
     "bm25": bench_bm25,
 }
 

@@ -61,11 +61,12 @@ class DeviceColumn:
         return self.data.shape[0] * LANES
 
 
-def _narrow_i64(a: np.ndarray) -> np.ndarray:
-    """int64 device policy: values that fit in int32 go down as int32 (the
-    common case for ClickBench-style data); wider values fall back to f32
-    pairs — not needed yet, so assert for now and keep the CPU path exact."""
-    return a.astype(np.int64)
+class DeviceNarrowingError(ValueError):
+    """A column cannot be represented exactly on device (e.g. int64 values
+    outside int32 range with x64 off). Callers treat this like a
+    NotCompilable: fall back to the exact CPU path. Silently narrowing to
+    f32 would make device SUM/compare results diverge from CPU — a parity
+    violation, not an optimization."""
 
 
 def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColumn:
@@ -73,11 +74,13 @@ def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColum
     n_pad = pad_len(n, pad_multiple)
     arr = col.data
     if arr.dtype == np.dtype(np.int64):
-        # keep exactness when it fits; otherwise go float32 (approx path)
+        # exact only when it fits in int32 (TPU x64 is off)
         if n == 0 or (np.abs(arr, dtype=np.float64).max(initial=0.0) < 2**31):
             arr = arr.astype(np.int32)
         else:
-            arr = arr.astype(np.float32)
+            raise DeviceNarrowingError(
+                "int64 column with |values| >= 2^31: no exact device "
+                "representation")
     dev_dt = _DEVICE_DTYPE.get(arr.dtype, jnp.float32)
     padded = np.zeros(n_pad, dtype=arr.dtype)
     padded[:n] = arr

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.column import Batch, Column
+from ..columnar.device import DeviceNarrowingError, pad_len
 from ..ops import agg as ops_agg
 from ..sql.binder import _expr_key
 from ..sql.expr import AggSpec, BoundColumn, BoundExpr
@@ -62,7 +63,7 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
             return None
     try:
         return _run(node, scan, provider, preds, ctx)
-    except NotCompilable as e:
+    except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"aggregate fell back to CPU: {e}")
         return None
 
@@ -86,35 +87,17 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
 
     compiled_preds = [compile_expr(p, scan.types, dictionaries) for p in preds]
 
-    # group keys: direct coding only (dict codes / small-range ints)
-    key_plans = []
-    group_space = 1
-    for g in node.group_exprs:
-        if not isinstance(g, BoundColumn):
-            raise NotCompilable("group key must be a plain column (for now)")
-        t = scan.types[g.index]
-        if t.is_string:
-            d = dictionaries.get(g.index)
-            if d is None:
-                raise NotCompilable("string key without dictionary")
-            size = len(d) + 1      # +1: NULL group
-            key_plans.append(("dict", g.index, 0, size))
-        elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
-            col = provider.host_column(col_names[g.index])
-            if col.data.size == 0:
-                lo, hi = 0, 0
-            else:
-                lo, hi = int(col.data.min()), int(col.data.max())
-            rng = hi - lo + 1
-            if rng > MAX_INT_KEY_RANGE:
-                raise NotCompilable("integer key range too large for direct coding")
-            size = rng + 1
-            key_plans.append(("int", g.index, lo, size))
-        else:
-            raise NotCompilable(f"group key type {t}")
-        group_space *= size
-        if group_space > MAX_GROUP_PRODUCT:
-            raise NotCompilable("group code space too large")
+    # group keys: direct coding (dict codes / small-range ints) when it
+    # fits, else composite host factorization (arbitrary keys/cardinality)
+    fact = None
+    try:
+        key_plans, group_space = _plan_direct_keys(
+            node, scan, provider, col_names, dictionaries)
+    except NotCompilable:
+        if not node.group_exprs:
+            raise
+        fact = _factorize_group_keys(node, scan, provider)
+        key_plans, group_space = [], max(fact["g"], 1)
 
     agg_plans = []
     for spec in node.aggs:
@@ -135,7 +118,7 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     for spec, ce in agg_plans:
         if ce is not None:
             needed.update(ce.inputs)
-    needed = sorted(needed) or [0]  # count(*)-only queries still need a shape
+    needed = sorted(needed)
     env_cols = {i: provider.device_column(col_names[i]) for i in needed}
     metrics.DEVICE_OFFLOADS.add()
 
@@ -144,7 +127,10 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     def env_for(ce: DeviceExpr, arrays):
         return [arrays[i] for i in ce.inputs]
 
-    group_mode = bool(key_plans)
+    group_mode = bool(node.group_exprs)
+    # capture only the flag, not the fact dict — the closure lives in the
+    # program cache and must not pin the codes buffer in HBM
+    has_fact = fact is not None
 
     def program(*flat):
         arrays = {}
@@ -158,15 +144,18 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
             mask = jnp.logical_and(mask, jnp.logical_and(b, ok))
         outputs = []
         if group_mode:
-            codes = jnp.zeros_like(mask, dtype=jnp.int32)
-            for kind, idx, lo, size in key_plans:
-                data, ok = arrays[idx]
-                if kind == "dict":
-                    c = data.astype(jnp.int32)
-                else:
-                    c = (data.astype(jnp.int32) - jnp.int32(lo))
-                c = jnp.where(ok, c, jnp.int32(size - 1))
-                codes = codes * jnp.int32(size) + jnp.clip(c, 0, size - 1)
+            if has_fact:
+                codes = flat[2 * len(needed)]  # precomputed composite codes
+            else:
+                codes = jnp.zeros_like(mask, dtype=jnp.int32)
+                for kind, idx, lo, size in key_plans:
+                    data, ok = arrays[idx]
+                    if kind == "dict":
+                        c = data.astype(jnp.int32)
+                    else:
+                        c = (data.astype(jnp.int32) - jnp.int32(lo))
+                    c = jnp.where(ok, c, jnp.int32(size - 1))
+                    codes = codes * jnp.int32(size) + jnp.clip(c, 0, size - 1)
             outputs.append(
                 ops_agg.group_count_scatter(codes, mask, group_space))
             for spec, ce in agg_plans:
@@ -193,14 +182,16 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     for i in needed:
         dc = env_cols[i]
         flat_args.extend([dc.data, dc.mask])
+    if fact is not None:
+        flat_args.append(fact["codes2d"])
     # A column's device mask excludes padding but ALSO that column's NULLs —
     # wrong as a row mask for count(*). Use a pure row-validity mask built
     # from the logical length (cached on the provider: it's per-table state).
-    dc0 = env_cols[needed[0]]
+    nrows = provider.row_count()
+    prows = pad_len(nrows)
     rowmask_arr = getattr(provider, "_device_rowmask", None)
-    if rowmask_arr is None or rowmask_arr.shape != dc0.mask.shape:
-        nrows = provider.row_count()
-        rm = np.zeros(dc0.padded_rows, dtype=bool)
+    if rowmask_arr is None or rowmask_arr.shape != (prows // 128, 128):
+        rm = np.zeros(prows, dtype=bool)
         rm[:nrows] = True
         rowmask_arr = jnp.asarray(rm.reshape(-1, 128))
         provider._device_rowmask = rowmask_arr
@@ -209,8 +200,99 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     if group_mode:
         return _build_group_batch(node, key_plans, agg_plans, results,
                                   provider, col_names, dictionaries,
-                                  group_space)
+                                  group_space, fact)
     return _build_scalar_batch(node, agg_plans, results)
+
+
+def _plan_direct_keys(node, scan, provider, col_names, dictionaries):
+    """Direct group-key coding: dictionary codes / small-range integers.
+    Raises NotCompilable when any key needs factorization."""
+    key_plans = []
+    group_space = 1
+    for g in node.group_exprs:
+        if not isinstance(g, BoundColumn):
+            raise NotCompilable("group key is not a plain column")
+        t = scan.types[g.index]
+        if t.is_string:
+            d = dictionaries.get(g.index)
+            if d is None:
+                raise NotCompilable("string key without dictionary")
+            size = len(d) + 1      # +1: NULL group
+            key_plans.append(("dict", g.index, 0, size))
+        elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
+            col = provider.host_column(col_names[g.index])
+            if col.data.size == 0:
+                lo, hi = 0, 0
+            else:
+                lo, hi = int(col.data.min()), int(col.data.max())
+            rng = hi - lo + 1
+            if rng > MAX_INT_KEY_RANGE:
+                raise NotCompilable("integer key range too large for direct coding")
+            if not (-2**31 <= lo and hi < 2**31):
+                # small range but offset beyond int32 (snowflake-style ids):
+                # the raw column can't upload exactly — factorize instead
+                raise NotCompilable("integer key offset beyond int32")
+            size = rng + 1
+            key_plans.append(("int", g.index, lo, size))
+        else:
+            raise NotCompilable(f"group key type {t}")
+        group_space *= size
+        if group_space > MAX_GROUP_PRODUCT:
+            raise NotCompilable("group code space too large")
+    return key_plans, group_space
+
+
+def _factorize_group_keys(node, scan, provider) -> dict:
+    """Composite host factorization of arbitrary GROUP BY keys: evaluate
+    the key expressions over the host columns, build dense codes with
+    ops_agg.factorize_keys (NULLs group per PG semantics), upload the
+    codes as device tiles. Cached per (data_version, key exprs) — the
+    factorize pass is O(n log n) once, amortized across queries.
+
+    Reference analog: DuckDB's RadixPartitionedHashTable grouped
+    aggregate (SURVEY.md §1 L3) — re-expressed as host factorize +
+    device scatter so the hot per-row work stays on the TPU."""
+    import jax.numpy as jnp
+
+    ekeys = tuple(_expr_key(g) for g in node.group_exprs)
+    ver = provider.data_version
+    cache = getattr(provider, "_factorize_cache", None)
+    if cache is None:
+        cache = provider._factorize_cache = {}
+    stale = [k2 for k2 in cache if k2[0] != ver]
+    for k2 in stale:  # old data versions can never be read again
+        del cache[k2]
+    hit = cache.get((ver, ekeys))
+    if hit is not None:
+        return hit
+    full = provider.full_batch(scan.columns)
+    try:
+        key_cols = [g.eval(full) for g in node.group_exprs]
+    except Exception as e:
+        # the CPU path evaluates keys only over WHERE-surviving rows; an
+        # eval error on a filtered-out row (e.g. division by zero) must
+        # fall back, not surface
+        raise NotCompilable(f"group key eval over unfiltered rows: {e}")
+    codes, uniq_vals, uniq_valid = ops_agg.factorize_keys(
+        [c.data for c in key_cols], [c.validity for c in key_cols])
+    g_count = len(uniq_vals[0]) if uniq_vals else 0
+    if g_count > MAX_GROUP_PRODUCT:
+        raise NotCompilable(
+            f"{g_count} distinct groups exceeds the device code-space cap")
+    n_pad = pad_len(len(codes))
+    padded = np.zeros(n_pad, dtype=np.int32)
+    padded[:len(codes)] = codes
+    value = {
+        "codes2d": jnp.asarray(padded.reshape(-1, 128)),
+        "uniq_vals": uniq_vals,
+        "uniq_valid": uniq_valid,
+        "g": g_count,
+        "key_meta": [(c.type, c.dictionary) for c in key_cols],
+    }
+    if len(cache) >= 16:  # bound HBM held by codes buffers
+        cache.pop(next(iter(cache)))
+    cache[(ver, ekeys)] = value
+    return value
 
 
 def _scalar_agg_device(spec: AggSpec, ce, arrays, mask, env_for):
@@ -303,10 +385,22 @@ def _scalar_result_col(spec: AggSpec, ri, total: int) -> Column:
 
 
 def _build_group_batch(node, key_plans, agg_plans, results, provider,
-                       col_names, dictionaries, g) -> Batch:
+                       col_names, dictionaries, g, fact=None) -> Batch:
     ri = iter(results)
     counts = np.asarray(next(ri)).astype(np.int64)
     present = np.flatnonzero(counts > 0)
+    cols: list[Column] = []
+    if fact is not None:
+        for k2, (t, d) in enumerate(fact["key_meta"]):
+            uv = np.asarray(fact["uniq_vals"][k2])[present]
+            validity = fact["uniq_valid"][k2][present] \
+                if fact["uniq_valid"].size else None
+            if validity is not None and validity.all():
+                validity = None
+            cols.append(Column(t, uv, validity, d))
+        for spec, ce in agg_plans:
+            cols.append(_group_result_col(spec, ri, counts, present))
+        return Batch(list(node.names), cols)
     # decode combined codes back to per-key codes
     sizes = [kp[3] for kp in key_plans]
     rem = present.copy()
@@ -315,7 +409,6 @@ def _build_group_batch(node, key_plans, agg_plans, results, provider,
         key_codes.append(rem % size)
         rem //= size
     key_codes.reverse()
-    cols: list[Column] = []
     for (kind, idx, lo, size), kc in zip(key_plans, key_codes):
         null_mask = kc == (size - 1)
         t = provider.type_of(col_names[idx])

@@ -184,7 +184,7 @@ def _bucket_tables(store: BlockStore, tid: int, avg: float, k1: float,
     cache = getattr(store, "_bucket_cache", None)
     if cache is None:
         cache = store._bucket_cache = {}
-    if len(cache) > 4096:  # stale stats (avgdl/idf drift) accumulate keys
+    if len(cache) > 512:  # tables are up to ~1MB each — bound host RAM
         cache.clear()
     key = (tid, round(avg, 6), scorer, shift, k1, b)
     hit = cache.get(key)
@@ -211,7 +211,10 @@ def _bucket_tables(store: BlockStore, tid: int, avg: float, k1: float,
         sat = _sat_exact(store.flat_tfs[s:e], store.norms_host[d],
                          k1, b, avg, scorer)
         np.maximum.at(arr, d >> shift, sat)
-    tab = _sparse_table(arr)
+    tab = _sparse_table(arr).astype(np.float32)  # bounds stay valid: the
+    # float32 rounding of a float64 max can go either way, but callers add
+    # an epsilon margin on θ, and the champion pass (exact) sets θ — a
+    # half-ULP of slack on a bound dominated by that margin is immaterial
     cache[key] = tab
     return tab
 
@@ -228,14 +231,6 @@ class WandPlan:
     theta: float
     maxscore: dict
     kept: dict
-
-
-def wand_prune(store: BlockStore, term_ids, idf: np.ndarray, k: int,
-               avg: float, k1: float, b: float, scorer: str,
-               champions: int = 16) -> Optional[dict]:
-    """Row-pruning view of wand_plan (kept rows only)."""
-    plan = wand_plan(store, term_ids, idf, k, avg, k1, b, scorer, champions)
-    return plan.kept if plan is not None else None
 
 
 def wand_plan(store: BlockStore, term_ids, idf: np.ndarray, k: int,
@@ -360,18 +355,15 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
                          queries: list[tuple[np.ndarray, int]],
                          doc_freq: np.ndarray,
                          scorer: str = "bm25", idf_of=None,
-                         wand_k: Optional[int] = None,
-                         avgdl: Optional[float] = None,
-                         k1: float = 1.2, b: float = 0.75,
-                         prunable=None, plans=None) -> QueryBatch:
+                         plans=None) -> QueryBatch:
     """queries: list of (term_ids, require_all) per query. Weights are the
     scorer's per-term idf (computed here so one dispatch covers all);
     idf_of overrides with global collection stats for multi-segment
     searches.
 
-    When wand_k is set, queries flagged in `prunable` (pure disjunctions)
-    get block-max WAND pruning: heavy block rows provably unable to reach
-    the top-wand_k are dropped before the device gather (see wand_prune).
+    plans: optional per-query WandPlan list (see wand_plan) — a plan's
+    kept-rows replace the term's full block-row span, dropping rows
+    provably unable to reach the top-k before the device gather.
     """
     rows, row_w, row_q = [], [], []
     tails_d, tails_f, tails_w, tails_q = [], [], [], []
@@ -388,13 +380,6 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
         kept = None
         if plans is not None and plans[qi] is not None and req == 0:
             kept = plans[qi].kept
-        elif (wand_k is not None and req == 0 and len(term_ids) > 0
-                and (prunable is None or prunable[qi])
-                and store.norms_host is not None
-                and (scorer == "tfidf" or (avgdl or 0.0) > 0.0)):
-            kept = wand_prune(store, term_ids, idf, wand_k,
-                              avgdl if avgdl is not None else 0.0,
-                              k1, b, scorer)
         for k, tid in enumerate(term_ids):
             tid = int(tid)
             w = float(idf[k])

@@ -82,3 +82,93 @@ def test_device_falls_back_for_strings_minmax(conn):
     # min over strings is not device-compilable; must still be correct
     r = conn.execute("SELECT min(g) FROM h").scalar()
     assert r == "alpha"
+
+
+# -- hash GROUP BY over arbitrary keys (host factorize + device scatter) ----
+
+@pytest.fixture
+def wide_conn():
+    """Table with ClickBench-shaped keys: full-range int64 UserID (values
+    far beyond int32), an expression-worthy small int, and a wide int64
+    value column that must NOT be narrowed to f32."""
+    rng = np.random.default_rng(11)
+    n = 20000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE hits2 (uid BIGINT, region INT, w BIGINT, x INT)")
+    uids = rng.integers(0, 1 << 62, n, dtype=np.int64)
+    uids = uids[rng.integers(0, n, n)]  # repeats → real groups
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.exec.tables import MemTable
+    batch = Batch.from_pydict({
+        "uid": Column.from_numpy(uids),
+        "region": Column.from_numpy(rng.integers(0, 200, n).astype(np.int32)),
+        "w": Column.from_numpy(
+            rng.integers(-(1 << 40), 1 << 40, n, dtype=np.int64)),
+        "x": Column.from_numpy(rng.integers(0, 1000, n).astype(np.int32)),
+    })
+    db.schemas["main"].tables["hits2"] = MemTable("hits2", batch)
+    return c
+
+
+WIDE_QUERIES = [
+    # full-range int64 key → factorize path
+    "SELECT uid, count(*) FROM hits2 GROUP BY uid ORDER BY uid LIMIT 20",
+    "SELECT uid, count(*), sum(x) FROM hits2 WHERE x < 900 "
+    "GROUP BY uid ORDER BY count(*) DESC, uid LIMIT 10",
+    # expression key → factorize path
+    "SELECT region % 10, count(*) FROM hits2 GROUP BY region % 10 "
+    "ORDER BY region % 10",
+    # composite wide + narrow keys
+    "SELECT uid, region, count(*) FROM hits2 GROUP BY uid, region "
+    "ORDER BY uid, region LIMIT 20",
+]
+
+
+@pytest.mark.parametrize("q", WIDE_QUERIES)
+def test_factorized_groupby_parity(wide_conn, q):
+    wide_conn.execute("SET serene_device = 'cpu'")
+    cpu = wide_conn.execute(q).rows()
+    wide_conn.execute("SET serene_device = 'tpu'")
+    dev = wide_conn.execute(q).rows()
+    assert cpu == dev, q
+
+
+def test_factorized_groupby_uses_device(wide_conn):
+    from serenedb_tpu.utils import metrics
+    wide_conn.execute("SET serene_device = 'tpu'")
+    before = metrics.DEVICE_OFFLOADS.value
+    wide_conn.execute("SELECT uid, count(*) FROM hits2 GROUP BY uid LIMIT 5")
+    assert metrics.DEVICE_OFFLOADS.value > before
+
+
+def test_wide_int64_sum_exact_not_narrowed(wide_conn):
+    """SUM over int64 values beyond 2^31 must be bit-exact on both paths
+    (the device path either represents it exactly or falls back)."""
+    wide_conn.execute("SET serene_device = 'cpu'")
+    cpu = wide_conn.execute("SELECT sum(w), min(w), max(w) FROM hits2").rows()
+    wide_conn.execute("SET serene_device = 'tpu'")
+    dev = wide_conn.execute("SELECT sum(w), min(w), max(w) FROM hits2").rows()
+    assert cpu == dev
+    # and grouped
+    q = ("SELECT region, sum(w) FROM hits2 GROUP BY region "
+         "ORDER BY region LIMIT 10")
+    wide_conn.execute("SET serene_device = 'cpu'")
+    cpu = wide_conn.execute(q).rows()
+    wide_conn.execute("SET serene_device = 'tpu'")
+    dev = wide_conn.execute(q).rows()
+    assert cpu == dev
+
+
+def test_expr_key_eval_error_on_filtered_rows_falls_back():
+    """GROUP BY a/b WHERE b <> 0: the device factorize path evaluates keys
+    over UNFILTERED rows, where b=0 raises — must fall back to CPU, which
+    only evaluates surviving rows (review regression)."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t0 (a INT, b INT)")
+    c.execute("INSERT INTO t0 VALUES (10, 2), (16, 2), (30, 2), (7, 0)")
+    c.execute("SET serene_device = 'tpu'")
+    rows = c.execute("SELECT a / b, count(*) FROM t0 WHERE b <> 0 "
+                     "GROUP BY a / b ORDER BY a / b").rows()
+    assert rows == [(5, 1), (8, 1), (15, 1)]

@@ -279,9 +279,11 @@ def test_wand_pruning_parity_and_reduction():
                for t, r, _, _ in shapes]
     qb_off = bm25_ops.assemble_query_batch(store, searcher.num_docs,
                                            queries, fi.doc_freq)
+    plans = [bm25_ops.wand_plan(
+        store, t, bm25_ops.idf_lucene(searcher.num_docs, fi.doc_freq[t]),
+        k, fi.avgdl, 1.2, 0.75, "bm25") for t, r, _, _ in shapes]
     qb_on = bm25_ops.assemble_query_batch(
-        store, searcher.num_docs, queries, fi.doc_freq,
-        wand_k=k, avgdl=fi.avgdl)
+        store, searcher.num_docs, queries, fi.doc_freq, plans=plans)
     rows_off = int((qb_off.row_idx != store.pad_row).sum())
     rows_on = int((qb_on.row_idx != store.pad_row).sum())
     assert rows_on < rows_off, (rows_on, rows_off)
@@ -309,10 +311,11 @@ def test_wand_prune_never_drops_topk_docs():
     assert all(t >= 0 for t in tids)
     k = 7
     idf = bm25_ops.idf_lucene(searcher.num_docs, fi.doc_freq[np.asarray(tids)])
-    kept = bm25_ops.wand_prune(store, tids, idf, k, fi.avgdl, 1.2, 0.75,
-                               "bm25")
-    if kept is None:
+    plan = bm25_ops.wand_plan(store, tids, idf, k, fi.avgdl, 1.2, 0.75,
+                              "bm25")
+    if plan is None:
         return  # nothing prunable on this corpus — parity covered above
+    kept = plan.kept
     ref_s, ref_d = searcher._cpu_score(
         np.arange(searcher.num_docs, dtype=np.int32), tids, k)
     for d in ref_d:
