@@ -113,12 +113,22 @@ class Roles:
                                r.get("password") is not None))
 
     def check_password(self, name: str, password: str) -> bool:
+        """Cleartext check; SCRAM-only roles verify by re-deriving the
+        stored key. Fails CLOSED when no credential is on record — a
+        cleartext exchange against a passwordless role must not succeed
+        (the HBA 'password' method made this path reachable)."""
         with self._lock:
             r = self.roles.get(name.lower())
             if r is None or not r.get("login", True):
                 return False
             stored = r.get("password")
-            return stored is None or stored == password
+            verifier = r.get("scram")
+        if stored is not None:
+            return stored == password
+        if verifier:
+            from . import scram
+            return scram.verify_cleartext(verifier, password)
+        return False
 
     # -- grants ------------------------------------------------------------
 

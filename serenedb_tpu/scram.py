@@ -157,3 +157,21 @@ def client_exchange(password: str, username: str = ""):
         return server_final == f"v={state['server_sig']}"
 
     return f"n,,{bare}", cont, verify
+
+
+def verify_cleartext(verifier: dict, password: str) -> bool:
+    """Check a cleartext password against a stored SCRAM verifier by
+    re-deriving the stored key with the verifier's salt/iterations
+    (constant-time compare). Powers HBA method=password for roles whose
+    password exists only as a SCRAM verifier."""
+    import hmac as hmac_mod
+    try:
+        salt = base64.b64decode(verifier["salt"])
+        iterations = int(verifier["iterations"])
+        salted = hashlib.pbkdf2_hmac("sha256", saslprep(password).encode(),
+                                     salt, iterations)
+        stored = base64.b64decode(verifier["stored_key"])
+        return hmac_mod.compare_digest(_h(_hmac(salted, b"Client Key")),
+                                       stored)
+    except (KeyError, ValueError, TypeError):
+        return False

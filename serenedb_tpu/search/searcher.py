@@ -306,6 +306,11 @@ class SegmentSearcher:
              scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
         return self.topk_batch([node], k, scorer)[0]
 
+    # cap on per-dispatch accumulator entries (B × ndocs_pad f32): bounds
+    # HBM at large corpora — the batch splits into query chunks instead of
+    # materializing (256, 8.8M) at MS-MARCO scale
+    ACC_ENTRY_CAP = 128 * 1024 * 1024
+
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
                    idf_of=None, avgdl_override=None,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
@@ -317,6 +322,13 @@ class SegmentSearcher:
             return [(np.empty(0, dtype=np.float32),
                      np.empty(0, dtype=np.int32))] * len(nodes)
         store = self._device_store()
+        max_b = max(1, self.ACC_ENTRY_CAP // store.ndocs_pad)
+        if len(nodes) > max_b:
+            out = []
+            for i in range(0, len(nodes), max_b):
+                out.extend(self.topk_batch(nodes[i:i + max_b], k, scorer,
+                                           idf_of, avgdl_override))
+            return out
         nd_pad = store.ndocs_pad
         shapes = [self._query_shape(n) for n in nodes]
         queries = [(np.asarray(tids, dtype=np.int64) if not empty

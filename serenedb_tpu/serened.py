@@ -30,13 +30,23 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--password", default=None)
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--tls-cert", default=None,
+                    help="PEM certificate chain; enables in-band TLS "
+                         "upgrade on SSLRequest")
+    ap.add_argument("--tls-key", default=None, help="PEM private key")
+    ap.add_argument("--hba-config", default=None,
+                    help="pg_hba.conf-style rules file")
     args = ap.parse_args(argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        ap.error("--tls-cert and --tls-key must be given together")
 
     log.MANAGER.stdout = True
     db = Database(args.datadir)
     http = HttpServer(db, args.host, args.http_port)
     http.start()
-    pg = PgServer(db, args.host, args.pg_port, args.password)
+    pg = PgServer(db, args.host, args.pg_port, args.password,
+                  tls_cert=args.tls_cert, tls_key=args.tls_key,
+                  hba_conf=args.hba_config)
 
     async def run():
         stop = asyncio.Event()

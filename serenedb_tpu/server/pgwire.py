@@ -28,6 +28,7 @@ from .. import scram
 from ..engine import Connection, Database, QueryResult
 from ..sql import ast, parser
 from ..utils import log, metrics
+from . import hba
 
 PROTOCOL_VERSION = 196608          # 3.0
 SSL_REQUEST = 80877103
@@ -253,6 +254,7 @@ class PgSession:
         self.pid = os.getpid()
         self.secret = secrets.randbits(31)
         self.ignore_till_sync = False
+        self.tls_active = False
 
     # -- startup -----------------------------------------------------------
 
@@ -276,8 +278,21 @@ class PgSession:
             (ln,) = struct.unpack("!I", raw)
             body = await self.reader.readexactly(ln - 4)
             (code,) = struct.unpack("!I", body[:4])
-            if code == SSL_REQUEST or code == GSS_REQUEST:
-                self.w.t.write(b"N")   # no TLS on this listener
+            if code == SSL_REQUEST:
+                ctx = self.server.tls_context
+                if ctx is not None and not self.tls_active:
+                    self.w.t.write(b"S")
+                    await self.w.t.drain()
+                    # in-band upgrade (reference: MaybeTls,
+                    # tls_context.cpp); the stream pair survives start_tls
+                    await self.w.t.start_tls(ctx)
+                    self.tls_active = True
+                else:
+                    self.w.t.write(b"N")
+                    await self.w.t.drain()
+                continue
+            if code == GSS_REQUEST:
+                self.w.t.write(b"N")
                 await self.w.t.drain()
                 continue
             if code == CANCEL_REQUEST:
@@ -296,6 +311,7 @@ class PgSession:
             if k:
                 params[k.decode()] = v.decode()
         user = params.get("user", "serene")
+        database = params.get("database", user)
         roles = self.server.db.roles
         role_known = roles.exists(user)
         if role_known and not roles.can_login(user):
@@ -303,25 +319,54 @@ class PgSession:
                 "28000", f'role "{user}" is not permitted to log in'))
             await self.w.flush()
             return False
-        needs_password = self.server.password is not None or (
-            role_known and roles.has_password(user))
-        if needs_password:
+        # HBA: first matching rule decides the auth method (reference:
+        # server/network/pg/hba.cpp). Without an HBA config, fall back to
+        # the implicit policy (server password / role password / trust).
+        method = None
+        if self.server.hba_rules is not None:
+            peer = self.w.t.get_extra_info("peername")
+            addr = peer[0] if isinstance(peer, tuple) else None
+            rule = hba.match_rule(self.server.hba_rules, database, user,
+                                  addr, self.tls_active)
+            if rule is None or rule.method == "reject":
+                self.w.error(errors.SqlError(
+                    "28000",
+                    f'no pg_hba.conf entry for host "{addr}", user '
+                    f'"{user}", database "{database}"' if rule is None
+                    else f'pg_hba.conf rejects connection for host '
+                         f'"{addr}", user "{user}", database "{database}"'))
+                await self.w.flush()
+                return False
+            method = rule.method
+        if method is None:
+            needs_password = self.server.password is not None or (
+                role_known and roles.has_password(user))
+            method = "implicit-password" if needs_password else "trust"
+        if method != "trust":
             if self.server.password is not None:
                 # a server-wide password gates EVERY login, including
                 # passwordless roles — no bypass via user=serene
                 verifier = self.server.password_verifier
             else:
                 verifier = roles.scram_verifier(user)
-            if verifier is not None:
-                ok = await self._scram_auth(verifier)
-            else:
-                # legacy cleartext: roles loaded from pre-SCRAM meta
+            if method in ("password", "md5") or (
+                    method == "implicit-password" and verifier is None):
+                # cleartext exchange (md5 verifiers are never stored; the
+                # md5 method degrades to password, as documented in hba.py)
                 self.w.auth_cleartext()
                 await self.w.flush()
                 kind, payload = await self._read_msg()
                 supplied = payload[:-1].decode() if kind == b"p" else ""
-                ok = kind == b"p" and role_known and \
-                    roles.check_password(user, supplied)
+                if self.server.password is not None:
+                    ok = kind == b"p" and supplied == self.server.password
+                else:
+                    ok = kind == b"p" and role_known and \
+                        roles.check_password(user, supplied)
+            elif verifier is not None:
+                ok = await self._scram_auth(verifier)
+            else:
+                # scram demanded by HBA but the role has no password
+                ok = False
             if not ok:
                 self.w.error(errors.SqlError(
                     "28P01",
@@ -842,7 +887,10 @@ def _count_params(st: ast.Statement) -> int:
 
 class PgServer:
     def __init__(self, db: Database, host: str = "127.0.0.1",
-                 port: int = 5432, password: Optional[str] = None):
+                 port: int = 5432, password: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 hba_conf: Optional[str] = None):
         self.db = db
         self.host = host
         self.port = port
@@ -850,11 +898,31 @@ class PgServer:
         self.password_verifier = None
         if password is not None:
             self.password_verifier = scram.build_verifier(password)
+        # TLS: in-band upgrade on SSLRequest (reference: tls_context.cpp)
+        self.tls_context = None
+        if tls_cert is not None:
+            import ssl as ssl_mod
+            ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl_mod.TLSVersion.TLSv1_2
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self.tls_context = ctx
+        # HBA: None = implicit policy; text/path = pg_hba-style rules
+        self.hba_rules = None
+        if hba_conf is not None:
+            self.set_hba(hba_conf)
         self._cancel_keys: dict[tuple[int, int], PgSession] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         import concurrent.futures
         self.pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, (os.cpu_count() or 4)))
+
+    def set_hba(self, conf: str) -> None:
+        """Install pg_hba rules from conf text or a file path (runtime
+        reconfigurable, matching the reference's SET hba)."""
+        if "\n" not in conf and os.path.exists(conf):
+            with open(conf) as f:
+                conf = f.read()
+        self.hba_rules = hba.parse_hba(conf)
 
     def register_cancel(self, pid: int, key: int, session: PgSession):
         self._cancel_keys[(pid, key)] = session

@@ -12,10 +12,23 @@ from serenedb_tpu.server.pgwire import PgServer
 
 
 class RawPg:
-    def __init__(self, port, user="tester", password=None):
+    def __init__(self, port, user="tester", password=None, tls=False,
+                 database=None):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=15)
         self.buf = b""
-        params = f"user\x00{user}\x00\x00".encode()
+        if tls:
+            import ssl
+            self.sock.sendall(struct.pack("!II", 8, 80877103))  # SSLRequest
+            resp = self.sock.recv(1)
+            assert resp == b"S", f"server declined TLS: {resp!r}"
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE   # self-signed test certs
+            self.sock = ctx.wrap_socket(self.sock)
+        params = f"user\x00{user}\x00".encode()
+        if database is not None:
+            params += f"database\x00{database}\x00".encode()
+        params += b"\x00"
         body = struct.pack("!I", 196608) + params
         self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
         self.params = {}
@@ -547,11 +560,11 @@ def test_scram_auth_role_password(server):
     pg0.close()
 
 
-def _run_pg_server(db, password=None):
+def _run_pg_server(db, password=None, **kwargs):
     """Start a PgServer via its real start() in a thread; returns
     (srv, stop_fn) — same bootstrap the module `server` fixture uses."""
     import threading
-    srv = PgServer(db, port=0, password=password)
+    srv = PgServer(db, port=0, password=password, **kwargs)
     loop = asyncio.new_event_loop()
     started = threading.Event()
 

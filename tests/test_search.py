@@ -330,3 +330,21 @@ def test_wand_prune_never_drops_topk_docs():
                 continue  # term doesn't hit this doc
             row = int(store.block_offsets[tid]) + i // 128
             assert row in set(kept[tid].tolist()), (d, tid)
+
+
+def test_query_batch_chunking_parity():
+    """The accumulator-cap query chunking must not change results: force a
+    tiny cap so a batch splits, compare against the unsplit batch."""
+    searcher, docs, an = _wand_fixture(n_docs=3000, seed=7)
+    qs = ["t0 | t1", "t2", "t3 & t4", "t5 | t6 | t0", "t1", "t2 | t5"]
+    nodes = [parse_query(q, an) for q in qs]
+    base = searcher.topk_batch(nodes, 10)
+    old = SegmentSearcher.ACC_ENTRY_CAP
+    try:
+        SegmentSearcher.ACC_ENTRY_CAP = searcher._device_store().ndocs_pad * 2
+        chunked = searcher.topk_batch(nodes, 10)
+    finally:
+        SegmentSearcher.ACC_ENTRY_CAP = old
+    for (s1, d1), (s2, d2) in zip(base, chunked):
+        np.testing.assert_allclose(s1, s2, rtol=1e-6)
+        assert d1.tolist() == d2.tolist()

@@ -1,0 +1,233 @@
+"""TLS in-band upgrade + host-based auth (reference:
+server/network/tls_context.cpp, server/network/pg/hba.cpp).
+
+The TLS tests generate a self-signed cert with the openssl CLI; the client
+is the same raw-socket RawPg used by the wire tests, upgraded via
+SSLRequest → 'S' → wrap. psycopg2/asyncpg are not in this image (by
+design); the raw client plus these rules cover the same contract the
+reference's driver matrix exercises for auth/TLS."""
+
+import shutil
+import socket
+import struct
+import subprocess
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.server.hba import HbaError, match_rule, parse_hba
+
+from test_pgwire import RawPg, _run_pg_server
+
+HAVE_OPENSSL = shutil.which("openssl") is not None
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    if not HAVE_OPENSSL:
+        pytest.skip("openssl CLI unavailable")
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "server.crt"), str(d / "server.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+# -- HBA rule engine (pure) -------------------------------------------------
+
+HBA_SAMPLE = """
+# comment line
+host    all  all   127.0.0.1/32   trust
+hostssl all  app   0.0.0.0/0      scram-sha-256
+host    db1  alice 10.0.0.0/8     password
+local   all  all   trust
+host    all  all   all            reject
+"""
+
+
+def test_hba_parse_and_first_match():
+    rules = parse_hba(HBA_SAMPLE)
+    assert len(rules) == 5
+    r = match_rule(rules, "any", "bob", "127.0.0.1", tls=False)
+    assert r.method == "trust"
+    # hostssl only matches TLS connections
+    r = match_rule(rules, "db", "app", "10.1.2.3", tls=False)
+    assert r.method == "reject"
+    r = match_rule(rules, "db", "app", "10.1.2.3", tls=True)
+    assert r.method == "scram-sha-256"
+    # db/user/CIDR matching
+    r = match_rule(rules, "db1", "alice", "10.9.9.9", tls=False)
+    assert r.method == "password"
+    r = match_rule(rules, "db2", "alice", "10.9.9.9", tls=False)
+    assert r.method == "reject"
+    # no rules matching → None
+    assert match_rule(rules[:1], "d", "u", "192.168.0.1", tls=False) is None
+
+
+def test_hba_netmask_and_lists():
+    rules = parse_hba(
+        "host db1,db2 u1,u2 192.168.0.0 255.255.0.0 scram-sha-256\n")
+    assert match_rule(rules, "db2", "u1", "192.168.5.5", False) is not None
+    assert match_rule(rules, "db3", "u1", "192.168.5.5", False) is None
+    assert match_rule(rules, "db1", "u3", "192.168.5.5", False) is None
+    assert match_rule(rules, "db1", "u1", "192.169.0.1", False) is None
+
+
+def test_hba_rejects_malformed():
+    with pytest.raises(HbaError):
+        parse_hba("host all all 127.0.0.1/32 frobnicate\n")
+    with pytest.raises(HbaError):
+        parse_hba("teleport all all 127.0.0.1/32 trust\n")
+    with pytest.raises(HbaError):
+        parse_hba("host all all not-an-ip trust\n")
+
+
+# -- live server: TLS upgrade ----------------------------------------------
+
+def test_tls_upgrade_and_query(certpair):
+    cert, key = certpair
+    srv, stop = _run_pg_server(Database(), tls_cert=cert, tls_key=key)
+    try:
+        pg = RawPg(srv.port, tls=True)
+        cols, rows, tags, errs = pg.query("SELECT 41 + 1")
+        assert rows == [("42",)]
+        pg.close()
+        # non-TLS connections still work on the same listener
+        pg = RawPg(srv.port, tls=False)
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+    finally:
+        stop()
+
+
+def test_tls_scram_auth(certpair):
+    cert, key = certpair
+    srv, stop = _run_pg_server(Database(), password="s3cret",
+                               tls_cert=cert, tls_key=key)
+    try:
+        pg = RawPg(srv.port, tls=True, password="s3cret")
+        assert pg.query("SELECT 7")[1] == [("7",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, tls=True, password="wrong")
+    finally:
+        stop()
+
+
+def test_no_tls_configured_answers_N():
+    srv, stop = _run_pg_server(Database())
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.sendall(struct.pack("!II", 8, 80877103))
+        assert s.recv(1) == b"N"
+        s.close()
+    finally:
+        stop()
+
+
+# -- live server: HBA enforcement ------------------------------------------
+
+def test_hba_reject_rule_blocks_connection():
+    db = Database()
+    srv, stop = _run_pg_server(db, hba_conf="host all all all reject\n")
+    try:
+        with pytest.raises(AssertionError, match="reject"):
+            RawPg(srv.port)
+    finally:
+        stop()
+
+
+def test_hba_trust_rule_allows_without_password():
+    db = Database()
+    db.connect().execute(
+        "CREATE ROLE secured LOGIN PASSWORD 'pw123'")
+    srv, stop = _run_pg_server(
+        db, hba_conf="host all all 127.0.0.1/32 trust\n")
+    try:
+        # trust overrides the role password requirement
+        pg = RawPg(srv.port, user="secured")
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+    finally:
+        stop()
+
+
+def test_hba_scram_rule_requires_password():
+    db = Database()
+    db.connect().execute("CREATE ROLE locked LOGIN PASSWORD 'hunter2'")
+    srv, stop = _run_pg_server(
+        db, hba_conf="host all all 127.0.0.1/32 scram-sha-256\n")
+    try:
+        pg = RawPg(srv.port, user="locked", password="hunter2")
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="locked", password="bad")
+        # a role with no password cannot satisfy a scram rule
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="tester", password="anything")
+    finally:
+        stop()
+
+
+def test_hba_hostssl_requires_tls(certpair):
+    cert, key = certpair
+    db = Database()
+    srv, stop = _run_pg_server(
+        db, tls_cert=cert, tls_key=key,
+        hba_conf="hostssl all all all trust\nhost all all all reject\n")
+    try:
+        pg = RawPg(srv.port, tls=True)
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+        with pytest.raises(AssertionError, match="reject"):
+            RawPg(srv.port, tls=False)
+    finally:
+        stop()
+
+
+def test_hba_database_scoping():
+    db = Database()
+    srv, stop = _run_pg_server(
+        db, hba_conf=("host db_ok all 127.0.0.1/32 trust\n"
+                      "host all   all all          reject\n"))
+    try:
+        pg = RawPg(srv.port, database="db_ok")
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, database="other_db")
+    finally:
+        stop()
+
+
+def test_hba_password_method_verifies_scram_roles():
+    """HBA method=password against a role stored as a SCRAM verifier must
+    verify the cleartext against the verifier — never fall open (review
+    regression: auth bypass)."""
+    db = Database()
+    db.connect().execute("CREATE ROLE vaulted LOGIN PASSWORD 'realpw'")
+    srv, stop = _run_pg_server(
+        db, hba_conf="host all all 127.0.0.1/32 password\n")
+    try:
+        pg = RawPg(srv.port, user="vaulted", password="realpw")
+        assert pg.query("SELECT 1")[1] == [("1",)]
+        pg.close()
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="vaulted", password="anything-else")
+        # passwordless role under method=password: fail closed
+        with pytest.raises(AssertionError):
+            RawPg(srv.port, user="tester", password="whatever")
+    finally:
+        stop()
+
+
+def test_hba_samehost_and_samenet():
+    rules = parse_hba("host all all samehost trust\n")
+    assert match_rule(rules, "d", "u", "127.0.0.1", False) is not None
+    assert match_rule(rules, "d", "u", "::1", False) is not None
+    assert match_rule(rules, "d", "u", "203.0.113.9", False) is None
+    with pytest.raises(HbaError, match="samenet"):
+        parse_hba("host all all samenet trust\n")
