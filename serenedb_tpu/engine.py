@@ -9,6 +9,7 @@ RBAC) progressively replace the in-memory structures in this module.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 from dataclasses import dataclass, field
@@ -597,6 +598,53 @@ class Connection:
                                               sql_text=sql))
         return out
 
+    def execute_streaming(self, st: ast.Statement, params: Optional[list] = None,
+                          sql_text: Optional[str] = None):
+        """Streaming SELECT execution: (names, types, batch iterator).
+
+        The iterator yields result batches as the executor produces them,
+        so the wire session can encode and flush incrementally — bounding
+        session memory and time-to-first-row instead of materializing the
+        whole result before the first DataRow (reference: the wire
+        collector streams rows to the socket DURING execution,
+        server/network/pg/wire_collector.h:20-60).
+
+        Only Select/SetOp are streamable; anything else raises ValueError
+        (callers route other statements through execute_statement)."""
+        if not isinstance(st, (ast.Select, ast.SetOp)):
+            raise ValueError("execute_streaming handles SELECT only")
+        if self.txn_failed:
+            raise errors.SqlError(
+                errors.IN_FAILED_TRANSACTION,
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block")
+        params = params or []
+        token = CURRENT_CONNECTION.set(self)
+        try:
+            plan = self._plan(st, params)   # binding enforces ACLs here
+        finally:
+            CURRENT_CONNECTION.reset(token)
+        ctx = ExecContext(self.settings, params)
+
+        def run():
+            with self._session_scope(sql_text if sql_text is not None
+                                     else "SELECT"):
+                it = plan.batches(ctx)
+                while True:
+                    # the caller may resume this generator from any
+                    # worker thread: pin the connection contextvar around
+                    # every underlying step (scalar functions read it)
+                    tok = CURRENT_CONNECTION.set(self)
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                    finally:
+                        CURRENT_CONNECTION.reset(tok)
+                    yield b
+
+        return plan.names, plan.types, run()
+
     def close(self):
         """Deterministically retire this session from pg_stat_activity
         (the weakref finalizer is only the GC backstop)."""
@@ -653,24 +701,33 @@ class Connection:
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         token = CURRENT_CONNECTION.set(self)
+        try:
+            with self._session_scope(sql_text if sql_text is not None
+                                     else type(st).__name__):
+                return self._dispatch(st, params)
+        finally:
+            CURRENT_CONNECTION.reset(token)
+
+    @contextlib.contextmanager
+    def _session_scope(self, label: str):
+        """pg_stat_activity bookkeeping + active-query metrics + txn-abort
+        marking shared by the materializing and streaming paths."""
         sess = self.db.sessions.get(self._session_id)
         if sess is not None:
             import time
             sess["state"] = "active"
-            sess["query"] = sql_text if sql_text is not None \
-                else type(st).__name__
+            sess["query"] = label
             sess["query_start"] = time.time()
             sess["application_name"] = \
                 str(self.settings.get("application_name") or "")
         try:
             with metrics.QUERIES_ACTIVE.scoped():
-                return self._dispatch(st, params)
+                yield
         except errors.SqlError:
             if self.in_txn:
                 self.txn_failed = True
             raise
         finally:
-            CURRENT_CONNECTION.reset(token)
             if sess is not None:
                 sess["state"] = ("idle in transaction"
                                  if self.in_txn else "idle")

@@ -519,6 +519,9 @@ class PgSession:
                         st.target in ("STDIN", "STDOUT"):
                     await self._run_copy(st)
                     continue
+                if isinstance(st, (ast.Select, ast.SetOp)):
+                    await self._stream_select(st, sql)
+                    continue
                 res = await loop.run_in_executor(
                     self.server.pool,
                     functools.partial(self.conn.execute_statement, st, [],
@@ -589,6 +592,35 @@ class PgSession:
         engine only marks this for errors it raises during execution)."""
         if self.conn is not None and self.conn.in_txn:
             self.conn.txn_failed = True
+
+    async def _stream_select(self, st, sql: str):
+        """Streaming wire collector for simple-protocol SELECTs: encode +
+        flush per executor batch (reference: wire_collector.h:20-60 —
+        rows leave the socket during execution, bounding session memory
+        and time-to-first-row)."""
+        loop = asyncio.get_running_loop()
+        names, types, it = await loop.run_in_executor(
+            self.server.pool,
+            functools.partial(self.conn.execute_streaming, st, [],
+                              sql_text=sql))
+        self.w.row_description(names, types)
+        n = 0
+        try:
+            while True:
+                b = await loop.run_in_executor(self.server.pool,
+                                               lambda: next(it, None))
+                if b is None:
+                    break
+                if b.num_rows:
+                    self.w.data_rows(b)
+                    n += b.num_rows
+                    # flush per batch: backpressure via the transport drain
+                    await self.w.flush()
+        finally:
+            # deterministic engine-side cleanup (session state, metrics) on
+            # error/disconnect — never wait for GC to finalize the generator
+            await loop.run_in_executor(self.server.pool, it.close)
+        self.w.command_complete(f"SELECT {n}")
 
     def _send_result(self, res: QueryResult, describe: bool,
                      fmts: tuple = ()):

@@ -724,3 +724,70 @@ def test_returning_described_in_extended_protocol(server):
     assert rows == [("5", "p")]
     pg.query("DROP TABLE retd")
     pg.close()
+
+
+# -- streaming wire collector (reference: wire_collector.h:20-60) -----------
+
+def test_streaming_select_flushes_per_batch():
+    """A large SELECT must stream: multiple flushes (one per executor
+    batch), not one materialized send."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.exec.tables import MemTable
+    from serenedb_tpu.server import pgwire as pgwire_mod
+
+    db = Database()
+    n = 400_000   # > 3 executor batches of 2^17 rows
+    batch = Batch.from_pydict({
+        "id": Column.from_numpy(np.arange(n, dtype=np.int64))})
+    db.schemas["main"].tables["big"] = MemTable("big", batch)
+    srv, stop = _run_pg_server(db)
+    flushes = []
+    orig_flush = pgwire_mod.Writer.flush
+
+    async def counting_flush(self):
+        flushes.append(1)
+        await orig_flush(self)
+    pgwire_mod.Writer.flush = counting_flush
+    try:
+        pg = RawPg(srv.port)
+        before = len(flushes)
+        cols, rows, tags, errs = pg.query("SELECT id FROM big")
+        assert len(rows) == n
+        assert tags == [f"SELECT {n}"]
+        # at least one flush per executor batch (4 batches for 400k rows)
+        assert len(flushes) - before >= 4
+        pg.close()
+    finally:
+        pgwire_mod.Writer.flush = orig_flush
+        stop()
+
+
+def test_streaming_select_midstream_error():
+    """An error in a later batch arrives after earlier DataRows; the
+    session stays usable (ErrorResponse then ReadyForQuery)."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.exec.tables import MemTable
+
+    db = Database()
+    n = 300_000
+    ids = np.arange(n, dtype=np.int64)
+    batch = Batch.from_pydict({"id": Column.from_numpy(ids)})
+    db.schemas["main"].tables["big2"] = MemTable("big2", batch)
+    srv, stop = _run_pg_server(db)
+    try:
+        pg = RawPg(srv.port)
+        # division by zero on a row in the third executor batch
+        cols, rows, tags, errs = pg.query(
+            "SELECT 100 / (id - 280000) FROM big2")
+        assert errs, "expected a mid-stream error"
+        assert len(rows) >= (1 << 17), "rows before the error must stream"
+        assert not tags     # no CommandComplete after an error
+        # session still alive
+        assert pg.query("SELECT 5")[1] == [("5",)]
+        pg.close()
+    finally:
+        stop()
