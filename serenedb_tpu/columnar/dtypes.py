@@ -33,6 +33,14 @@ class TypeId(enum.Enum):
     DATE = "DATE"            # days since epoch, int32
     INTERVAL = "INTERVAL"    # duration in micros, int64 (fixed units only)
     NULL = "NULL"            # type of bare NULL literal
+    # PG pseudo-types for catalog introspection (reference:
+    # server/query/server_engine.cpp:61-216). Physically int64 object ids;
+    # casting to/from text resolves names against the live catalog.
+    OID = "OID"
+    REGCLASS = "REGCLASS"
+    REGTYPE = "REGTYPE"
+    REGPROC = "REGPROC"
+    REGNAMESPACE = "REGNAMESPACE"
 
 
 _NUMPY_OF = {
@@ -48,9 +56,16 @@ _NUMPY_OF = {
     TypeId.DATE: np.dtype(np.int32),
     TypeId.INTERVAL: np.dtype(np.int64),
     TypeId.NULL: np.dtype(np.int32),
+    TypeId.OID: np.dtype(np.int64),
+    TypeId.REGCLASS: np.dtype(np.int64),
+    TypeId.REGTYPE: np.dtype(np.int64),
+    TypeId.REGPROC: np.dtype(np.int64),
+    TypeId.REGNAMESPACE: np.dtype(np.int64),
 }
 
-_INTEGERS = {TypeId.TINYINT, TypeId.SMALLINT, TypeId.INT, TypeId.BIGINT}
+_INTEGERS = {TypeId.TINYINT, TypeId.SMALLINT, TypeId.INT, TypeId.BIGINT,
+             TypeId.OID, TypeId.REGCLASS, TypeId.REGTYPE, TypeId.REGPROC,
+             TypeId.REGNAMESPACE}
 _FLOATS = {TypeId.FLOAT, TypeId.DOUBLE}
 
 
@@ -96,6 +111,11 @@ VARCHAR = SqlType(TypeId.VARCHAR)
 TIMESTAMP = SqlType(TypeId.TIMESTAMP)
 DATE = SqlType(TypeId.DATE)
 INTERVAL = SqlType(TypeId.INTERVAL)
+OID = SqlType(TypeId.OID)
+REGCLASS = SqlType(TypeId.REGCLASS)
+REGTYPE = SqlType(TypeId.REGTYPE)
+REGPROC = SqlType(TypeId.REGPROC)
+REGNAMESPACE = SqlType(TypeId.REGNAMESPACE)
 NULLTYPE = SqlType(TypeId.NULL)
 
 _BY_NAME = {
@@ -110,12 +130,19 @@ _BY_NAME = {
     "TIMESTAMP": TIMESTAMP, "TIMESTAMPTZ": TIMESTAMP, "DATETIME": TIMESTAMP,
     "DATE": DATE,
     "INTERVAL": INTERVAL,
+    "OID": OID, "REGCLASS": REGCLASS, "REGTYPE": REGTYPE,
+    "REGPROC": REGPROC, "REGPROCEDURE": REGPROC,
+    "REGNAMESPACE": REGNAMESPACE,
+    "NAME": VARCHAR, "BPCHAR": VARCHAR, "JSON": VARCHAR, "JSONB": VARCHAR,
+    "UUID": VARCHAR, "XID": BIGINT, "CID": BIGINT,
 }
 
 # numeric widening lattice for binary-op result typing
 _RANK = {
     TypeId.BOOL: 0, TypeId.TINYINT: 1, TypeId.SMALLINT: 2, TypeId.INT: 3,
     TypeId.DATE: 3, TypeId.BIGINT: 4, TypeId.TIMESTAMP: 4,
+    TypeId.OID: 4, TypeId.REGCLASS: 4, TypeId.REGTYPE: 4, TypeId.REGPROC: 4,
+    TypeId.REGNAMESPACE: 4,
     TypeId.FLOAT: 5, TypeId.DOUBLE: 6,
 }
 

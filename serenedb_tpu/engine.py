@@ -110,6 +110,12 @@ class Database(TableResolver):
         # each listener's thread-safe deque and drain at statement
         # boundaries (pgwire sends NotificationResponse before ready)
         self._listeners: dict[str, set] = {}
+        # stable in-process OIDs for pg_catalog introspection: assigned
+        # lazily per (kind, schema, name), never reused within a process
+        # (reference: catalog object ids, server/pg/pg_catalog/)
+        self._oids: dict[tuple, int] = {}
+        self._oid_rev: dict[int, tuple] = {}
+        self._oid_next = 16384
         self.store = None
         self.maintenance = None
         if path is not None:
@@ -504,6 +510,46 @@ class Database(TableResolver):
                     out.append((sname, v, "view"))
             return sorted(out)
 
+    def oid_of(self, kind: str, schema: str, name: str) -> int:
+        """Stable per-process OID for a catalog object (lazily assigned).
+        kind ∈ {schema, table, view, index, sequence}."""
+        key = (kind, schema, name)
+        with self.lock:
+            oid = self._oids.get(key)
+            if oid is None:
+                oid = self._oid_next
+                self._oid_next += 1
+                self._oids[key] = oid
+                self._oid_rev[oid] = key
+            return oid
+
+    def oid_lookup(self, oid: int):
+        """(kind, schema, name) for an OID assigned by oid_of, else None."""
+        with self.lock:
+            return self._oid_rev.get(int(oid))
+
+    def resolve_relation_oid(self, text: str) -> int:
+        """'schema.table' / 'table' → OID, PG ::regclass semantics."""
+        parts = [p.strip().strip('"') for p in text.split(".")]
+        with self.lock:
+            cands = ([(parts[0], parts[1])] if len(parts) == 2
+                     else [(sn, parts[0]) for sn in ("main",
+                                                     *sorted(self.schemas))])
+            for sn, tn in cands:
+                s = self.schemas.get(sn)
+                if s is None:
+                    continue
+                tl = tn.lower()
+                if tl in s.tables:
+                    return self.oid_of("table", sn, tl)
+                if tl in s.views:
+                    return self.oid_of("view", sn, tl)
+                for t in s.tables.values():
+                    if tl in getattr(t, "indexes", {}):
+                        return self.oid_of("index", sn, tl)
+        raise errors.SqlError(errors.UNDEFINED_TABLE,
+                              f'relation "{text}" does not exist')
+
     def connect(self) -> "Connection":
         return Connection(self)
 
@@ -704,7 +750,7 @@ class Connection:
         try:
             with self._session_scope(sql_text if sql_text is not None
                                      else type(st).__name__):
-                return self._dispatch(st, params)
+                return self._dispatch(st, params, sql_text)
         finally:
             CURRENT_CONNECTION.reset(token)
 
@@ -734,7 +780,8 @@ class Connection:
 
     # -- dispatch ----------------------------------------------------------
 
-    def _dispatch(self, st: ast.Statement, params: list) -> QueryResult:
+    def _dispatch(self, st: ast.Statement, params: list,
+                  sql_text: Optional[str] = None) -> QueryResult:
         if isinstance(st, (ast.Drop, ast.DropRole, ast.AlterTable,
                            ast.CreateRole, ast.AlterRole, ast.GrantRevoke,
                            ast.CreateIndex, ast.VacuumStmt)):
@@ -758,8 +805,11 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE SCHEMA")
         if isinstance(st, ast.CreateView):
             schema, name = self.db._split(st.name)
-            self.db.create_view(schema, name,
-                                ViewDef(name, st.query, ""), st.or_replace)
+            self.db.create_view(
+                schema, name,
+                ViewDef(name, st.query,
+                        getattr(st, "source_sql", None) or sql_text or ""),
+                st.or_replace)
             if self.db.store is not None:
                 import base64
                 import pickle

@@ -1385,6 +1385,87 @@ def _make_array_spliced(ts):
     return FunctionResolution(dt.VARCHAR, impl)
 
 
+@register("__quant_cmp")
+def _quant_cmp(ts):
+    """op ANY/ALL(array) — parser-internal spelling. SQL three-valued
+    semantics: ANY is an OR fold, ALL an AND fold, NULL elements give
+    UNKNOWN (reference: PG quantified comparison; used by psql's
+    `nspname = ANY(current_schemas(true))`)."""
+    if len(ts) != 4:
+        return None
+
+    def cmp_one(op, a, b):
+        if a is None or b is None:
+            return None
+        if op in ("~", "~*", "!~", "!~*"):
+            flags = re.IGNORECASE if op.endswith("*") else 0
+            m = re.search(str(b), str(a), flags) is not None
+            return (not m) if op.startswith("!") else m
+        if isinstance(a, str) != isinstance(b, str):
+            # PG resolves the unknown-typed side toward the typed side:
+            # numeric-vs-text coerces the text numerically, never
+            # lexicographically (9 < ALL(ARRAY['10']) is true)
+            s = a if isinstance(a, str) else b
+            try:
+                conv = float(s)
+                if isinstance(a, str):
+                    a = conv
+                else:
+                    b = conv
+            except ValueError:
+                if op == "=":
+                    return str(a) == str(b)
+                if op in ("<>", "!="):
+                    return str(a) != str(b)
+                raise errors.SqlError(
+                    errors.INVALID_TEXT_REPRESENTATION,
+                    f'invalid input syntax for type numeric: "{s}"')
+        try:
+            if op == "=":
+                return a == b
+            if op in ("<>", "!="):
+                return a != b
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            if op == ">=":
+                return a >= b
+        except TypeError:
+            return str(a) == str(b) if op == "=" else None
+        return None
+
+    def impl(cols, n):
+        op = cols[0].decode(0) if n else "="
+        quant = cols[1].decode(0) if n else "ANY"
+        left = cols[2].to_pylist()
+        arrs = _array_rows(cols[3], n)
+        out = np.zeros(n, dtype=bool)
+        validity = np.ones(n, dtype=bool)
+        for i in range(n):
+            arr = arrs[i]
+            if arr is None:
+                validity[i] = False
+                continue
+            votes = [cmp_one(op, left[i], el) for el in arr]
+            if quant == "ANY":
+                if any(v is True for v in votes):
+                    out[i] = True
+                elif any(v is None for v in votes):
+                    validity[i] = False
+            else:  # ALL
+                if any(v is False for v in votes):
+                    out[i] = False
+                elif any(v is None for v in votes):
+                    validity[i] = False
+                else:
+                    out[i] = True
+        return Column(dt.BOOL, out, validity if not validity.all() else None)
+    return FunctionResolution(dt.BOOL, impl)
+
+
 @register("array_length")
 def _array_length(ts):
     if not ts or not _stringish(ts[0]):
@@ -1635,3 +1716,8 @@ def _json_object_keys(ts):
         return make_string_column(
             np.asarray(out, dtype=object).astype(str), valid)
     return FunctionResolution(dt.VARCHAR, impl)
+
+
+# PG system/introspection functions register themselves on import (kept in
+# a separate module so the catalog surface doesn't bloat this file)
+from . import pgsys  # noqa: E402,F401  (registration side effects)

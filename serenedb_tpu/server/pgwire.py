@@ -42,9 +42,12 @@ _OID = {
     dt.TypeId.DOUBLE: 701, dt.TypeId.VARCHAR: 25,
     dt.TypeId.TIMESTAMP: 1114, dt.TypeId.DATE: 1082,
     dt.TypeId.INTERVAL: 1186, dt.TypeId.NULL: 25,
+    dt.TypeId.OID: 26, dt.TypeId.REGCLASS: 2205,
+    dt.TypeId.REGTYPE: 2206, dt.TypeId.REGPROC: 24,
+    dt.TypeId.REGNAMESPACE: 4089,
 }
 _TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
-           1082: 4, 1186: 16}
+           1082: 4, 1186: 16, 26: 4, 2205: 4, 2206: 4, 24: 4, 4089: 4}
 
 
 def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
@@ -117,6 +120,9 @@ def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
         # PG binary interval: (µs int64, days int32, months int32); ours
         # is µs-only, semantically equal for fixed-unit intervals
         return struct.pack("!qii", int(value), 0, 0)
+    if tid in (dt.TypeId.OID, dt.TypeId.REGCLASS, dt.TypeId.REGTYPE,
+               dt.TypeId.REGPROC, dt.TypeId.REGNAMESPACE):
+        return struct.pack("!I", int(value) & 0xFFFFFFFF)
     return pg_text(value, typ)
 
 

@@ -213,6 +213,12 @@ class InSubquery(Expr):
 
 
 @dataclass
+class ArraySubquery(Expr):
+    """ARRAY(SELECT ...): first output column gathered into an array."""
+    query: "Select"
+
+
+@dataclass
 class ColumnDef:
     name: str
     type_name: str

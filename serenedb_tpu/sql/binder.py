@@ -228,6 +228,8 @@ class ExprBinder:
             return self._bind_in_subquery(e)
         if isinstance(e, ast.Exists):
             return self._bind_exists(e)
+        if isinstance(e, ast.ArraySubquery):
+            return self._bind_array_subquery(e.query)
         if isinstance(e, ast.Star):
             raise errors.syntax("* not allowed here")
         raise errors.unsupported(f"expression {type(e).__name__}")
@@ -418,6 +420,44 @@ class ExprBinder:
                 _cache.append(rows[0][0] if rows else None)
             return Column.const(_cache[0], batch.num_rows, _t)
         return BoundFunc("scalar_subquery", [], t, impl)
+
+    def _bind_array_subquery(self, query) -> BoundExpr:
+        """ARRAY(SELECT ...) → JSON-array string (the array physical
+        representation), correlated or not."""
+        import json as _json
+        try:
+            plan = self._subplan(query)
+        except errors.SqlError as e:
+            if e.sqlstate != errors.UNDEFINED_COLUMN:
+                raise
+            outer_refs, trial = self._discover_correlation(query)
+            if len(trial.types) != 1:
+                raise errors.SqlError(
+                    "42601", "subquery must return only one column")
+
+            plan_cache: dict = {}
+
+            def impl_corr(cols, batch, _q=query, _refs=outer_refs,
+                          _pc=plan_cache):
+                out = [None] * batch.num_rows
+                for i, rows in self._correlated_rows(_q, _refs, batch, _pc):
+                    out[i] = _json.dumps([r[0] for r in rows])
+                from .expr import make_string_column
+                return make_string_column(
+                    np.asarray(out, dtype=object).astype(str), None)
+            return BoundFunc("array_subquery", [], dt.VARCHAR, impl_corr)
+        if len(plan.types) != 1:
+            raise errors.SqlError("42601",
+                                  "subquery must return only one column")
+        cache: list = []
+
+        def impl(cols, batch, _plan=plan, _cache=cache):
+            if not _cache:
+                from ..exec.plan import ExecContext
+                rows = _plan.execute(ExecContext()).rows()
+                _cache.append(_json.dumps([r[0] for r in rows]))
+            return Column.const(_cache[0], batch.num_rows, dt.VARCHAR)
+        return BoundFunc("array_subquery", [], dt.VARCHAR, impl)
 
     def _bind_correlated_scalar(self, query) -> BoundExpr:
         outer_refs, trial = self._discover_correlation(query)
@@ -713,6 +753,53 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         raise errors.SqlError(
             "42846", f"cannot cast type {src} to {target}")
     validity = col.validity
+    _REG = (dt.TypeId.REGCLASS, dt.TypeId.REGTYPE, dt.TypeId.REGPROC,
+            dt.TypeId.REGNAMESPACE)
+    if target.id in _REG and src.is_string:
+        # name → oid resolution against the live catalog ('t'::regclass)
+        from ..pgcatalog import (current_db, resolve_namespace_oid,
+                                 resolve_proc_oid, resolve_type_oid)
+        db = current_db()
+        vals = col.to_pylist()
+        out = np.zeros(len(vals), dtype=np.int64)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            s = str(v).strip()
+            if s.lstrip("-").isdigit():
+                out[i] = int(s)
+            elif target.id is dt.TypeId.REGTYPE:
+                out[i] = resolve_type_oid(s)
+            elif target.id is dt.TypeId.REGPROC:
+                out[i] = resolve_proc_oid(s)
+            elif target.id is dt.TypeId.REGNAMESPACE:
+                out[i] = resolve_namespace_oid(db, s)
+            else:
+                if db is None:
+                    raise errors.SqlError(errors.UNDEFINED_TABLE,
+                                          f'relation "{s}" does not exist')
+                out[i] = db.resolve_relation_oid(s)
+        return Column(target, out, validity)
+    if src.id in _REG and target.is_string:
+        from ..pgcatalog import (current_db, namespace_render, proc_name_of,
+                                 regclass_render, type_name_of)
+        db = current_db()
+        vals = col.to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append("")
+            elif src.id is dt.TypeId.REGTYPE:
+                out.append(type_name_of(v) or str(int(v)))
+            elif src.id is dt.TypeId.REGPROC:
+                out.append(proc_name_of(v) or str(int(v)))
+            elif src.id is dt.TypeId.REGNAMESPACE:
+                out.append(namespace_render(db, int(v)))
+            else:
+                out.append(regclass_render(db, int(v)))
+        from .expr import make_string_column
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  validity)
     if target.is_string:
         if src.id is dt.TypeId.TIMESTAMP:
             out = [format_timestamp(v) for v in col.data]

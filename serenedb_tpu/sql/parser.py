@@ -31,6 +31,7 @@ _COMPARE_OPS = {"=", "<>", "!=", "<", "<=", ">", ">=", "##", "@@",
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
 
@@ -88,7 +89,13 @@ class Parser:
         while self.peek().kind is not T.EOF:
             if self.accept_op(";"):
                 continue
-            stmts.append(self.parse_statement())
+            start = self.peek().pos
+            st = self.parse_statement()
+            end = (self.peek().pos if self.peek().kind is not T.EOF
+                   else len(self.sql))
+            # per-statement source slice (view definitions, pg_stat_activity)
+            st.source_sql = self.sql[start:end].rstrip().rstrip(";")
+            stmts.append(st)
             if self.peek().kind is not T.EOF:
                 self.expect_op(";")
         return stmts
@@ -504,12 +511,50 @@ class Parser:
                 self.i = save
                 break
             t = self.peek()
-            if t.kind is T.OP and t.value in _COMPARE_OPS:
+            op = None
+            if t.kind is T.IDENT and t.value.upper() == "OPERATOR" and \
+                    self.peek(1).kind is T.OP and self.peek(1).value == "(":
+                # psql spells operators as OPERATOR(pg_catalog.~)
                 self.next()
-                right = self.parse_additive_chain()
-                left = ast.BinaryOp(t.value, left, right)
+                self.next()
+                while self.peek().kind is T.IDENT:
+                    self.ident()
+                    self.expect_op(".")
+                opt = self.next()
+                if opt.kind is not T.OP or opt.value == ")":
+                    raise errors.syntax("expected operator in OPERATOR()")
+                op = opt.value
+                self.expect_op(")")
+                if op not in _COMPARE_OPS:
+                    raise errors.unsupported(f"OPERATOR({op})")
+            elif t.kind is T.OP and t.value in _COMPARE_OPS:
+                op = t.value
+                self.next()
+            if op is None:
+                break
+            if self.at_kw("ANY", "SOME", "ALL"):
+                quant = self.next().value.upper()
+                quant = "ANY" if quant == "SOME" else quant
+                self.expect_op("(")
+                if self.at_kw("SELECT", "WITH", "VALUES"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    if quant == "ANY" and op == "=":
+                        left = ast.InSubquery(left, sub, False)
+                    elif quant == "ALL" and op in ("<>", "!="):
+                        left = ast.InSubquery(left, sub, True)
+                    else:
+                        raise errors.unsupported(f"{op} {quant} (subquery)")
+                    continue
+                arr = self.parse_expr()
+                self.expect_op(")")
+                left = ast.FuncCall("__quant_cmp",
+                                    [ast.Literal(op), ast.Literal(quant),
+                                     left, arr])
                 continue
-            break
+            right = self.parse_additive_chain()
+            left = ast.BinaryOp(op, left, right)
+            continue
         return left
 
     def parse_additive_chain(self) -> ast.Expr:
@@ -542,6 +587,12 @@ class Parser:
         while True:
             if self.accept_op("::"):
                 e = ast.Cast(e, self._type_name())
+            elif self.at_kw("COLLATE"):
+                # COLLATE pg_catalog.default etc. — single collation, no-op
+                self.next()
+                self.ident()
+                while self.accept_op("."):
+                    self.ident()
             elif self.accept_op("["):
                 # arr[i] — 1-based element access, desugared to a function
                 idx = self.parse_expr()
@@ -552,9 +603,19 @@ class Parser:
 
     def _type_name(self) -> str:
         name = self.ident()
+        # psql qualifies pseudo-types: ::pg_catalog.regclass
+        while self.at_op(".") and name.upper() in ("PG_CATALOG",
+                                                   "INFORMATION_SCHEMA"):
+            self.next()
+            name = self.ident()
         if name.upper() == "DOUBLE" and self.at_kw("PRECISION"):
             self.next()
             name = "DOUBLE"
+        if name.upper() == "TIMESTAMP" and self.at_kw("WITHOUT", "WITH"):
+            # TIMESTAMP WITH[OUT] TIME ZONE — single timestamp type
+            self.next()
+            self.expect_kw("TIME")
+            self.expect_kw("ZONE")
         if self.accept_op("("):  # VARCHAR(n), DECIMAL(p,s) — swallow params
             while not self.at_op(")"):
                 self.next()
@@ -598,6 +659,14 @@ class Parser:
             return ast.Literal(False)
         if upper == "CASE":
             return self.parse_case()
+        if upper == "ARRAY" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            # ARRAY(subquery): first output column gathered into an array
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.ArraySubquery(sub)
         if upper == "ARRAY" and self.peek(1).kind is T.OP and \
                 self.peek(1).value == "[":
             self.next()
@@ -663,6 +732,9 @@ class Parser:
             parts.append(self.ident())
         if self.at_op("("):
             self.next()
+            if len(parts) > 1 and parts[0].lower() in ("pg_catalog",
+                                                       "information_schema"):
+                parts = parts[1:]
             name = ".".join(parts).lower()
             distinct = False
             star = False

@@ -1,0 +1,287 @@
+"""pg_catalog emulation + PG pseudo-types: the psql \\d-family workflow.
+
+Query texts below are the literal queries psql 14 issues for \\dt, \\d tbl,
+\\di, \\dn, \\du, \\l, \\df (reference parity surface:
+server/pg/pg_catalog/, server/query/server_engine.cpp:61-216).
+"""
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+
+
+@pytest.fixture
+def conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE users (id INT PRIMARY KEY, name TEXT, "
+              "score DOUBLE)")
+    c.execute("CREATE INDEX users_name ON users USING inverted (name)")
+    c.execute("CREATE VIEW v_users AS SELECT id FROM users")
+    c.execute("CREATE SEQUENCE user_seq")
+    return c
+
+
+def test_psql_dt(conn):
+    rows = conn.execute("""
+        SELECT n.nspname, c.relname, c.relkind,
+               pg_catalog.pg_get_userbyid(c.relowner)
+        FROM pg_catalog.pg_class c
+             LEFT JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace
+        WHERE c.relkind IN ('r','p','')
+              AND n.nspname <> 'pg_catalog'
+              AND n.nspname !~ '^pg_toast'
+              AND n.nspname <> 'information_schema'
+          AND pg_catalog.pg_table_is_visible(c.oid)
+        ORDER BY 1,2""").rows()
+    assert ("main", "users", "r", "serene") in rows
+
+
+def test_psql_d_table_full_flow(conn):
+    # query 1: resolve the name to an oid
+    rows = conn.execute("""
+        SELECT c.oid, n.nspname, c.relname
+        FROM pg_catalog.pg_class c
+             LEFT JOIN pg_catalog.pg_namespace n ON n.oid = c.relnamespace
+        WHERE c.relname OPERATOR(pg_catalog.~) '^(users)$'
+              COLLATE pg_catalog.default
+          AND pg_catalog.pg_table_is_visible(c.oid)
+        ORDER BY 2, 3""").rows()
+    assert len(rows) == 1
+    oid = rows[0][0]
+    assert oid >= 16384
+
+    # query 2: relation detail (incl. chained reg casts)
+    det = conn.execute(f"""
+        SELECT c.relchecks, c.relkind, c.relhasindex,
+          CASE WHEN c.reloftype = 0 THEN ''
+               ELSE c.reloftype::pg_catalog.regtype::pg_catalog.text END,
+          c.relpersistence
+        FROM pg_catalog.pg_class c WHERE c.oid = '{oid}'""").rows()
+    assert det == [(0, "r", True, "", "p")]
+
+    # query 3: columns via pg_attribute + format_type
+    cols = conn.execute(f"""
+        SELECT a.attname, pg_catalog.format_type(a.atttypid, a.atttypmod),
+          a.attnotnull
+        FROM pg_catalog.pg_attribute a
+        WHERE a.attrelid = '{oid}' AND a.attnum > 0
+              AND NOT a.attisdropped
+        ORDER BY a.attnum""").rows()
+    assert cols == [("id", "integer", True), ("name", "text", False),
+                    ("score", "double precision", False)]
+
+    # query 4: indexes (comma joins + LEFT JOIN + pg_get_indexdef)
+    idx = conn.execute(f"""
+        SELECT c2.relname, i.indisprimary, i.indisunique,
+          pg_catalog.pg_get_indexdef(i.indexrelid, 0, true)
+        FROM pg_catalog.pg_class c, pg_catalog.pg_class c2,
+             pg_catalog.pg_index i
+          LEFT JOIN pg_catalog.pg_constraint con
+            ON (con.conrelid = i.indrelid AND con.conindid = i.indexrelid
+                AND con.contype IN ('p','u','x'))
+        WHERE c.oid = '{oid}' AND c.oid = i.indrelid
+              AND i.indexrelid = c2.oid
+        ORDER BY i.indisprimary DESC, c2.relname""").rows()
+    assert idx == [("users_name", False, False,
+                    "CREATE INDEX users_name ON users "
+                    "USING inverted (name)")]
+
+
+def test_psql_du_array_subquery(conn):
+    rows = conn.execute("""
+        SELECT r.rolname, r.rolsuper, r.rolcanlogin,
+          ARRAY(SELECT b.rolname FROM pg_catalog.pg_auth_members m
+                JOIN pg_catalog.pg_roles b ON (m.roleid = b.oid)
+                WHERE m.member = r.oid) as memberof
+        FROM pg_catalog.pg_roles r WHERE r.rolname !~ '^pg_'
+        ORDER BY 1""").rows()
+    assert rows[0][:3] == ("serene", True, True)
+    assert rows[0][3] == "[]"
+
+
+def test_psql_l(conn):
+    rows = conn.execute("""
+        SELECT d.datname, pg_catalog.pg_get_userbyid(d.datdba),
+          pg_catalog.pg_encoding_to_char(d.encoding), d.datcollate
+        FROM pg_catalog.pg_database d ORDER BY 1""").rows()
+    assert rows == [("serene", "serene", "UTF8", "C")]
+
+
+def test_psql_dn(conn):
+    rows = conn.execute("""
+        SELECT n.nspname, pg_catalog.pg_get_userbyid(n.nspowner)
+        FROM pg_catalog.pg_namespace n
+        WHERE n.nspname !~ '^pg_' AND n.nspname <> 'information_schema'
+        ORDER BY 1""").rows()
+    assert ("main", "serene") in rows
+
+
+def test_psql_df(conn):
+    rows = conn.execute("""
+        SELECT n.nspname, p.proname,
+          pg_catalog.pg_get_function_result(p.oid)
+        FROM pg_catalog.pg_proc p
+          LEFT JOIN pg_catalog.pg_namespace n ON n.oid = p.pronamespace
+        WHERE p.proname OPERATOR(pg_catalog.~) '^(abs)$'
+        ORDER BY 1, 2""").rows()
+    assert rows == [("pg_catalog", "abs", None)]
+
+
+def test_regclass_casts(conn):
+    r = conn.execute("SELECT 'users'::regclass::text, "
+                     "'users'::regclass::int8").rows()[0]
+    assert r[0] == "users"
+    assert r[1] >= 16384
+    # schema-qualified and quoted forms
+    assert conn.execute(
+        "SELECT 'main.users'::regclass::text").scalar() == "users"
+    with pytest.raises(SqlError):
+        conn.execute("SELECT 'nope_missing'::regclass")
+    # to_regclass returns NULL instead of raising
+    assert conn.execute("SELECT to_regclass('nope_missing')").scalar() is None
+    assert conn.execute(
+        "SELECT to_regclass('users')::text").scalar() == "users"
+
+
+def test_regtype_regproc(conn):
+    assert conn.execute("SELECT 23::regtype::text").scalar() == "int4"
+    assert conn.execute("SELECT 'integer'::regtype::int").scalar() == 23
+    assert conn.execute(
+        "SELECT 'bigint'::regtype = 20::regtype").scalar() is True
+    assert conn.execute(
+        "SELECT 'abs'::regproc::text").scalar() == "abs"
+
+
+def test_regnamespace(conn):
+    assert conn.execute(
+        "SELECT 'pg_catalog'::regnamespace::int").scalar() == 11
+    assert conn.execute(
+        "SELECT 'main'::regnamespace::text").scalar() == "main"
+    with pytest.raises(SqlError):
+        conn.execute("SELECT 'no_such_schema'::regnamespace")
+
+
+def test_view_columns_in_pg_attribute(conn):
+    rows = conn.execute("""
+        SELECT a.attname, pg_catalog.format_type(a.atttypid, a.atttypmod)
+        FROM pg_catalog.pg_attribute a
+        JOIN pg_catalog.pg_class c ON c.oid = a.attrelid
+        WHERE c.relname = 'v_users' ORDER BY a.attnum""").rows()
+    assert rows == [("id", "integer")]
+
+
+def test_quote_ident_reserved(conn):
+    assert conn.execute("SELECT quote_ident('select')").scalar() == '"select"'
+    assert conn.execute("SELECT quote_ident('order')").scalar() == '"order"'
+
+
+def test_mixed_numeric_text_quant(conn):
+    # numeric-vs-text coerces numerically, never lexicographically
+    assert conn.execute("SELECT 9 < ALL(ARRAY['10'])").scalar() is True
+    assert conn.execute("SELECT 9 = ANY(ARRAY['9'])").scalar() is True
+
+
+def test_view_definition_is_single_statement(conn):
+    conn.execute("CREATE TABLE vd (x INT); "
+                 "CREATE VIEW vd_v AS SELECT x FROM vd; "
+                 "INSERT INTO vd VALUES (1)")
+    d = conn.execute("SELECT definition FROM pg_views "
+                     "WHERE viewname = 'vd_v'").scalar()
+    assert d == "CREATE VIEW vd_v AS SELECT x FROM vd"
+
+
+def test_quantified_comparisons(conn):
+    assert conn.execute(
+        "SELECT 'main' = ANY(current_schemas(true))").scalar() is True
+    assert conn.execute("SELECT 3 > ALL(ARRAY[1,2])").scalar() is True
+    assert conn.execute("SELECT 3 > ALL(ARRAY[1,4])").scalar() is False
+    assert conn.execute("SELECT 2 = SOME(ARRAY[1,2,3])").scalar() is True
+    # NULL element: ANY stays unknown when no match
+    assert conn.execute(
+        "SELECT 9 = ANY(ARRAY[1,NULL])").scalar() is None
+    assert conn.execute(
+        "SELECT id = ANY(ARRAY[1,3]) FROM users").rows() == []
+    # subquery forms
+    conn.execute("INSERT INTO users VALUES (1,'a',0.5),(2,'b',1.5)")
+    assert conn.execute(
+        "SELECT count(*) FROM users WHERE id = "
+        "ANY(SELECT id FROM users WHERE score > 1)").scalar() == 1
+    assert conn.execute(
+        "SELECT count(*) FROM users WHERE id <> "
+        "ALL(SELECT id FROM users WHERE score > 1)").scalar() == 1
+
+
+def test_info_schema_breadth(conn):
+    conn.execute("INSERT INTO users VALUES (1,'a',0.5)")
+    assert conn.execute(
+        "SELECT schema_name FROM information_schema.schemata "
+        "WHERE schema_name = 'main'").rows() == [("main",)]
+    assert conn.execute(
+        "SELECT constraint_type FROM information_schema.table_constraints "
+        "WHERE table_name = 'users'").rows() == [("PRIMARY KEY",)]
+    assert conn.execute(
+        "SELECT column_name FROM information_schema.key_column_usage "
+        "WHERE table_name = 'users'").rows() == [("id",)]
+    assert conn.execute(
+        "SELECT table_name FROM information_schema.views "
+        "WHERE table_name = 'v_users'").rows() == [("v_users",)]
+    assert conn.execute(
+        "SELECT sequence_name FROM information_schema.sequences "
+        "WHERE sequence_name = 'user_seq'").rows() == [("user_seq",)]
+
+
+def test_catalog_stubs_join_cleanly(conn):
+    # empty catalogs psql/ORMs join against: zero rows, correct columns
+    for t in ("pg_locks", "pg_trigger", "pg_policy", "pg_inherits",
+              "pg_extension", "pg_depend", "pg_matviews",
+              "pg_auth_members", "pg_description"):
+        assert conn.execute(f"SELECT count(*) FROM {t}").scalar() == 0
+    # pg_type joins
+    assert conn.execute(
+        "SELECT t.typname FROM pg_catalog.pg_type t "
+        "WHERE t.oid = 25").rows() == [("text",)]
+
+
+def test_sizes_and_misc_functions(conn):
+    conn.execute("INSERT INTO users VALUES (1,'a',0.5)")
+    size = conn.execute(
+        "SELECT pg_total_relation_size('users'::regclass)").scalar()
+    assert size > 0
+    assert conn.execute(
+        "SELECT pg_size_pretty(10)").scalar() == "10 bytes"
+    assert conn.execute(
+        "SELECT pg_size_pretty(20480)").scalar() == "20 kB"
+    assert conn.execute("SELECT quote_ident('x y')").scalar() == '"x y"'
+    assert conn.execute("SELECT quote_ident('xy')").scalar() == "xy"
+    assert conn.execute("SELECT quote_literal('o''x')").scalar() == "'o''x'"
+    assert conn.execute("SELECT current_database()").scalar() == "serene"
+    assert conn.execute("SELECT current_user()").scalar() == "serene"
+    assert conn.execute("SELECT pg_backend_pid()").scalar() == 1
+    assert conn.execute("SELECT pg_is_in_recovery()").scalar() is False
+    assert conn.execute(
+        "SELECT has_table_privilege('serene','users','SELECT')"
+    ).scalar() is True
+
+
+def test_pg_get_viewdef(conn):
+    oid = conn.execute("SELECT c.oid FROM pg_class c "
+                       "WHERE c.relname = 'v_users'").scalar()
+    d = conn.execute(f"SELECT pg_get_viewdef({oid})").scalar()
+    assert "SELECT" in (d or "").upper()
+
+
+def test_sequences_catalog(conn):
+    rows = conn.execute(
+        "SELECT sequencename, data_type FROM pg_sequences").rows()
+    assert ("user_seq", "bigint") in rows
+
+
+def test_oid_stability(conn):
+    a = conn.execute("SELECT 'users'::regclass::int8").scalar()
+    b = conn.execute("SELECT oid FROM pg_class "
+                     "WHERE relname = 'users'").scalar()
+    c2 = conn.execute("SELECT attrelid FROM pg_attribute "
+                      "WHERE attname = 'score'").scalar()
+    assert a == b == c2
