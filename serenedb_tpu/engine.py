@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
@@ -805,11 +806,16 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE SCHEMA")
         if isinstance(st, ast.CreateView):
             schema, name = self.db._split(st.name)
-            self.db.create_view(
-                schema, name,
-                ViewDef(name, st.query,
-                        getattr(st, "source_sql", None) or sql_text or ""),
-                st.or_replace)
+            src = getattr(st, "source_sql", None) or sql_text or ""
+            # store the SELECT body: pg_get_viewdef/pg_views.definition
+            # return the query, not the CREATE statement (PG semantics —
+            # tools wrap it in their own CREATE VIEW)
+            m = re.match(r"(?is)\s*CREATE\s+(?:OR\s+REPLACE\s+)?VIEW\s+"
+                         r".*?\s+AS\s+(.*)$", src)
+            body = m.group(1).strip() if m else src
+            self.db.create_view(schema, name,
+                                ViewDef(name, st.query, body),
+                                st.or_replace)
             if self.db.store is not None:
                 import base64
                 import pickle

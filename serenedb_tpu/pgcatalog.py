@@ -236,7 +236,8 @@ def _rel_rows(db):
 
 
 def _pg_namespace(db) -> MemTable:
-    names = sorted(db.schemas)
+    with db.lock:
+        names = sorted(db.schemas)
     oids = [_ns_oid(db, n) for n in names]
     oids += [NS_PG_CATALOG, NS_INFO_SCHEMA, NS_SDB_CATALOG]
     names += ["pg_catalog", "information_schema", "sdb_catalog"]
@@ -320,12 +321,34 @@ _PG_ATTR_SPEC = [
 _view_attr_guard = __import__("threading").local()
 
 
+def _catalog_signature(db) -> int:
+    """Cheap fingerprint of every table's shape + view definitions; when
+    unchanged, cached view column layouts are still valid."""
+    parts = []
+    with db.lock:
+        for sn in sorted(db.schemas):
+            s = db.schemas[sn]
+            for tn in sorted(s.tables):
+                t = s.tables[tn]
+                parts.append((sn, tn, tuple(t.column_names),
+                              tuple(str(ct) for ct in t.column_types)))
+            for vn in sorted(s.views):
+                parts.append((sn, vn, getattr(s.views[vn], "sql", "")))
+    return hash(tuple(parts))
+
+
 def _view_columns(db) -> dict:
     """(schema, view) → [(name, SqlType)] by zero-row executing each view.
     Guarded against recursion (a view over pg_attribute would otherwise
-    re-enter this builder)."""
+    re-enter this builder) and cached per catalog signature — psql issues
+    several pg_attribute scans per \\d and must not re-plan every view
+    each time."""
     if getattr(_view_attr_guard, "busy", False):
         return {}
+    sig = _catalog_signature(db)
+    cached = getattr(db, "_view_cols_cache", None)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
     out: dict = {}
     _view_attr_guard.busy = True
     try:
@@ -346,6 +369,7 @@ def _view_columns(db) -> dict:
             conn.close()
     finally:
         _view_attr_guard.busy = False
+    db._view_cols_cache = (sig, out)
     return out
 
 
@@ -792,7 +816,9 @@ def _info_columns(db) -> MemTable:
 
 
 def _info_schemata(db) -> MemTable:
-    names = sorted(db.schemas) + ["pg_catalog", "information_schema"]
+    with db.lock:
+        names = sorted(db.schemas)
+    names += ["pg_catalog", "information_schema"]
     return _typed("schemata", [
         ("catalog_name", dt.VARCHAR), ("schema_name", dt.VARCHAR),
         ("schema_owner", dt.VARCHAR)], {

@@ -1,13 +1,17 @@
 """Text analyzers (tokenizer pipelines).
 
-Reference analog: libs/iresearch/analysis/ — 25+ analyzers (SURVEY.md §2.7).
-Analysis is pointer-chasing CPU work in any architecture; it stays on host
-here too (the reference's design point holds: term matching on CPU, scoring
-on the accelerator — SURVEY.md §7 hard part 5).
+Reference analog: libs/iresearch/analysis/ — 53 files / 25+ analyzers
+(SURVEY.md §2.7). Analysis is pointer-chasing CPU work in any architecture;
+it stays on host here too (the reference's design point holds: term matching
+on CPU, scoring on the accelerator — SURVEY.md §7 hard part 5).
 
-Implemented: text (lowercase + unicode word split + stopwords + stemming),
-whitespace, keyword, ngram, edge_ngram, delimiter. The registry mirrors the
-reference's named-tokenizer catalog objects (CREATE ... TOKENIZER options).
+Implemented: locale text analyzers (unicode word split + per-language
+stopwords + snowball-family stemming + CJK bigrams), whitespace, keyword,
+ngram, edge_ngram, delimiter, multi_delimiter, segmentation, normalizing,
+collation, stem, pattern, path_hierarchy, synonyms, pipeline, union,
+minhash. The registry mirrors the reference's named-tokenizer catalog
+objects (CREATE ... TOKENIZER options; analysis/pipeline_tokenizer.cpp,
+solr_synonyms_tokenizer.cpp, minhash_tokenizer.cpp, ...).
 """
 
 from __future__ import annotations
@@ -18,34 +22,106 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
 
 from .. import errors
+from .stemmers import lang_of as _lang_of
+from .stemmers import porter2, stemmer_for
 
 _WORD_RE = re.compile(r"\w+", re.UNICODE)
 
-# minimal english stopword list (reference text analyzer uses snowball lists)
+# CJK codepoint runs are split into overlapping bigrams (the standard
+# segmentation approximation the reference gets from ICU break iterators)
+_CJK_RE = re.compile(
+    "[\u3400-\u4dbf\u4e00-\u9fff\uf900-\ufaff"
+    "\u3040-\u30ff\uac00-\ud7af]")
+
+# per-language stopword lists (reference: snowball lists via libstemmer;
+# compact high-frequency subsets keep index/query symmetric)
 EN_STOPWORDS = frozenset(
     "a an and are as at be but by for if in into is it no not of on or such "
     "that the their then there these they this to was will with".split())
+DE_STOPWORDS = frozenset(
+    "aber als am an auch auf aus bei bin bis das dass dem den der des die "
+    "durch ein eine einem einen einer es für hat ich im in ist mit nach "
+    "nicht noch nur oder sich sie sind so über um und von vor war wie wird "
+    "zu zum zur".split())
+FR_STOPWORDS = frozenset(
+    "au aux avec ce ces dans de des du elle en et eux il ils je la le les "
+    "leur lui ma mais me même mes moi mon ne nos notre nous on ou par pas "
+    "pour qu que qui sa se ses son sur ta te tes toi ton tu un une vos "
+    "votre vous".split())
+ES_STOPWORDS = frozenset(
+    "a al algo como con de del desde donde el ella ellas ellos en entre "
+    "era es esta este ha hay la las le les lo los me mi muy más ni no nos "
+    "o para pero por que se sin sobre su sus te tiene un una uno y ya".split())
+RU_STOPWORDS = frozenset(
+    "и в во не что он на я с со как а то все она так его но да ты к у же "
+    "вы за бы по только ее мне было вот от меня еще нет о из ему".split())
+IT_STOPWORDS = frozenset(
+    "a ad al alla alle anche che chi ci come con da dal de del della di "
+    "e ed è era gli ha hanno il in io la le lo ma mi nel nella non o per "
+    "più quella questo se si sono su un una uno".split())
+PT_STOPWORDS = frozenset(
+    "a ao aos as com da das de dele do dos e ela elas ele eles em entre "
+    "essa esse esta este eu foi há isso já mais mas me mesmo na nas não "
+    "no nos nós o os ou para pela pelo por qual quando que se sem seu sua "
+    "também te um uma você".split())
+NL_STOPWORDS = frozenset(
+    "aan als bij dan dat de der die dit een en er haar had heeft het hij "
+    "hoe ik in is je kan maar me met mijn naar niet nog nu of om onder "
+    "ook op over te tot uit van voor was wat we wel wij zal ze zich zij "
+    "zijn zo".split())
+SV_STOPWORDS = frozenset(
+    "alla att av blev bli den det detta dig din du där då efter ej eller "
+    "en er ett från för ha hade han hans har hon i icke inte jag kan man "
+    "med mig min mot mycket ni nu när och om oss på samma sedan sig sin "
+    "som så till under upp vad var vara varför vi vid är".split())
+FI_STOPWORDS = frozenset(
+    "ei en että he hän ja jo jos kanssa kun me mikä minä mutta myös ne "
+    "niin nyt ole oli on ovat se sen siellä sinä tai tämä vain voi".split())
+
+STOPWORDS_BY_LANG = {
+    "en": EN_STOPWORDS, "de": DE_STOPWORDS, "fr": FR_STOPWORDS,
+    "es": ES_STOPWORDS, "ru": RU_STOPWORDS, "it": IT_STOPWORDS,
+    "pt": PT_STOPWORDS, "nl": NL_STOPWORDS, "sv": SV_STOPWORDS,
+    "fi": FI_STOPWORDS,
+}
 
 
 def _porter_light(token: str) -> str:
-    """Lightweight English stemmer (S-stemmer + common suffixes). The
-    reference uses snowball; this approximation keeps index/query symmetric
-    (both sides stem identically), which is what parity requires."""
-    t = token
-    for suf in ("ational", "iveness", "fulness", "ousness"):
-        if t.endswith(suf) and len(t) > len(suf) + 2:
-            return t[: -len(suf) + 3] if suf == "ational" else t[: -4]
-    for suf in ("ing", "edly", "ed", "ly", "ies", "ness"):
-        if t.endswith(suf) and len(t) - len(suf) >= 3:
-            t = t[: -len(suf)]
-            if suf == "ies":
-                t += "y"
-            return t
-    if t.endswith("es") and len(t) >= 5:
-        return t[:-2]
-    if t.endswith("s") and not t.endswith("ss") and len(t) >= 4:
-        return t[:-1]
-    return t
+    """English stemmer — full Porter2 (stemmers.py). The name survives as
+    the historical seam used across the index/query sides."""
+    return porter2(token)
+
+
+def _cjk_split(term: str, pos: int, start: int) -> list["Token"]:
+    """Split a \\w+ run containing CJK into script-run tokens: non-CJK runs
+    stay whole, CJK runs become overlapping bigrams (unigram when length
+    1) — the ICU-segmentation approximation for unspaced scripts."""
+    out = []
+    i = 0
+    n = len(term)
+    while i < n:
+        if _CJK_RE.match(term[i]):
+            j = i
+            while j < n and _CJK_RE.match(term[j]):
+                j += 1
+            run = term[i:j]
+            if len(run) == 1:
+                out.append(Token(run, pos, start + i, start + i + 1))
+                pos += 1
+            else:
+                for k in range(len(run) - 1):
+                    out.append(Token(run[k:k + 2], pos, start + i + k,
+                                     start + i + k + 2))
+                    pos += 1
+            i = j
+        else:
+            j = i
+            while j < n and not _CJK_RE.match(term[j]):
+                j += 1
+            out.append(Token(term[i:j], pos, start + i, start + j))
+            pos += 1
+            i = j
+    return out
 
 
 @dataclass
@@ -86,31 +162,48 @@ class WhitespaceAnalyzer(Analyzer):
 
 
 class TextAnalyzer(Analyzer):
-    """Locale text analyzer: NFC normalize, lowercase, word split, accent
-    fold, optional stopwords + stemming (reference: analysis/text_analyzer)."""
+    """Locale text analyzer: NFC normalize, lowercase, word split (CJK
+    runs → bigrams), accent fold, per-language stopwords + stemming
+    (reference: analysis/text_tokenizer.cpp; locale handling mirrors its
+    ICU locale option)."""
 
     name = "text"
 
-    def __init__(self, stopwords: Optional[frozenset] = EN_STOPWORDS,
-                 stem: bool = True, accent_fold: bool = True):
+    def __init__(self, stopwords: Optional[frozenset] = None,
+                 stem: bool = True, accent_fold: bool = True,
+                 locale: str = "en"):
+        lang = _lang_of(locale)
+        if stopwords is None:
+            stopwords = STOPWORDS_BY_LANG.get(lang, frozenset())
         self.stopwords = stopwords or frozenset()
         self.stem = stem
         self.accent_fold = accent_fold
+        self.locale = lang
+        self._stemmer = stemmer_for(lang) if stem else None
 
     def tokenize(self, text: str) -> list[Token]:
         norm = unicodedata.normalize("NFC", text).lower()
         out = []
         pos = 0
         for m in _WORD_RE.finditer(norm):
-            term = m.group()
+            raw = m.group()
+            if _CJK_RE.search(raw):
+                toks = _cjk_split(raw, pos, m.start())
+                out.extend(toks)
+                pos += len(toks) if toks else 1
+                continue
+            term = raw
+            if term in self.stopwords:
+                pos += 1
+                continue
             if self.accent_fold:
                 term = "".join(c for c in unicodedata.normalize("NFD", term)
                                if not unicodedata.combining(c))
             if term in self.stopwords:
                 pos += 1
                 continue
-            if self.stem:
-                term = _porter_light(term)
+            if self._stemmer is not None:
+                term = self._stemmer(term)
             out.append(Token(term, pos, m.start(), m.end()))
             pos += 1
         return out
@@ -159,16 +252,349 @@ class DelimiterAnalyzer(Analyzer):
         return out
 
 
+class MultiDelimiterAnalyzer(Analyzer):
+    """Split on any of several delimiters (reference:
+    analysis/multi_delimited_tokenizer.cpp)."""
+
+    name = "multi_delimiter"
+
+    def __init__(self, delimiters: Iterable[str] = (",", ";")):
+        ds = [re.escape(d) for d in delimiters if d]
+        self._re = re.compile("|".join(ds)) if ds else None
+
+    def tokenize(self, text: str) -> list[Token]:
+        if self._re is None:
+            return [Token(text, 0, 0, len(text))] if text else []
+        out = []
+        start = pos = 0
+        for m in self._re.finditer(text):
+            if m.start() > start:
+                out.append(Token(text[start:m.start()], pos, start,
+                                 m.start()))
+                pos += 1
+            start = m.end()
+        if start < len(text):
+            out.append(Token(text[start:], pos, start, len(text)))
+        return out
+
+
+class SegmentationAnalyzer(Analyzer):
+    """Unicode word-boundary segmentation with case control (reference:
+    analysis/segmentation_tokenizer.cpp; break='word'|'alpha'|'graphic',
+    case='lower'|'upper'|'none')."""
+
+    name = "segmentation"
+
+    def __init__(self, break_mode: str = "alpha", case: str = "lower"):
+        if break_mode not in ("word", "alpha", "graphic"):
+            raise errors.SqlError("22023",
+                                  f"unknown break option {break_mode!r}")
+        if case not in ("lower", "upper", "none"):
+            raise errors.SqlError("22023", f"unknown case option {case!r}")
+        self.break_mode = break_mode
+        self.case = case
+
+    def tokenize(self, text: str) -> list[Token]:
+        if self.case == "lower":
+            text = text.lower()
+        elif self.case == "upper":
+            text = text.upper()
+        pat = {"word": r"\w+", "alpha": r"\w+",
+               "graphic": r"\S+"}[self.break_mode]
+        out = []
+        pos = 0
+        for m in re.finditer(pat, text, re.UNICODE):
+            raw = m.group()
+            if self.break_mode == "alpha" and raw.isdigit():
+                continue
+            if _CJK_RE.search(raw):
+                toks = _cjk_split(raw, pos, m.start())
+                out.extend(toks)
+                pos += len(toks) if toks else 1
+                continue
+            out.append(Token(raw, pos, m.start(), m.end()))
+            pos += 1
+        return out
+
+
+class NormalizingAnalyzer(Analyzer):
+    """Whole-input normalization, no split (reference:
+    analysis/normalizing_tokenizer.cpp): case fold + optional accent
+    removal, emits one token."""
+
+    name = "norm"
+
+    def __init__(self, case: str = "lower", accent: bool = False):
+        self.case = case
+        self.accent = accent
+
+    def tokenize(self, text: str) -> list[Token]:
+        t = unicodedata.normalize("NFC", text)
+        if self.case == "lower":
+            t = t.lower()
+        elif self.case == "upper":
+            t = t.upper()
+        if not self.accent:
+            t = "".join(c for c in unicodedata.normalize("NFD", t)
+                        if not unicodedata.combining(c))
+        return [Token(t, 0, 0, len(text))] if t else []
+
+
+class CollationAnalyzer(Analyzer):
+    """Collation sort-key token (reference:
+    analysis/collation_tokenizer.cpp): emits a locale-insensitive sort key
+    so ORDER BY / range filters over the index agree with a case/accent
+    -insensitive collation. Approximated as NFKD casefold with marks
+    stripped — correct for the Latin-script locales this build targets."""
+
+    name = "collation"
+
+    def __init__(self, locale: str = "en"):
+        self.locale = _lang_of(locale)
+
+    def tokenize(self, text: str) -> list[Token]:
+        key = "".join(c for c in unicodedata.normalize("NFKD",
+                                                       text.casefold())
+                      if not unicodedata.combining(c))
+        return [Token(key, 0, 0, len(text))]
+
+
+class StemAnalyzer(Analyzer):
+    """Whole-input stemmer (reference: analysis/stemming_tokenizer.cpp):
+    lowercases and stems the input as a single token."""
+
+    name = "stem"
+
+    def __init__(self, locale: str = "en"):
+        self.locale = _lang_of(locale)
+        self._stemmer = stemmer_for(self.locale) or (lambda w: w)
+
+    def tokenize(self, text: str) -> list[Token]:
+        t = self._stemmer(text.strip().lower())
+        return [Token(t, 0, 0, len(text))] if t else []
+
+
+class PatternAnalyzer(Analyzer):
+    """Regex tokenizer (reference: analysis/pattern_tokenizer.cpp):
+    mode='match' emits every match of the pattern (group 1 if present),
+    mode='split' uses the pattern as a separator."""
+
+    name = "pattern"
+
+    def __init__(self, pattern: str, mode: str = "match",
+                 case: str = "none"):
+        if mode not in ("match", "split"):
+            raise errors.SqlError("22023", f"unknown pattern mode {mode!r}")
+        try:
+            self._re = re.compile(pattern)
+        except re.error as e:
+            raise errors.SqlError("2201B", f"invalid regex: {e}")
+        self.mode = mode
+        self.case = case
+
+    def tokenize(self, text: str) -> list[Token]:
+        if self.case == "lower":
+            text = text.lower()
+        elif self.case == "upper":
+            text = text.upper()
+        out = []
+        if self.mode == "match":
+            for pos, m in enumerate(self._re.finditer(text)):
+                term = m.group(1) if self._re.groups else m.group()
+                if term:
+                    out.append(Token(term, pos, m.start(), m.end()))
+        else:
+            start = pos = 0
+            for m in self._re.finditer(text):
+                if m.end() == m.start():
+                    continue   # zero-width separators split nothing
+                if m.start() > start:
+                    out.append(Token(text[start:m.start()], pos, start,
+                                     m.start()))
+                    pos += 1
+                start = m.end()
+            if start < len(text):
+                out.append(Token(text[start:], pos, start, len(text)))
+        return out
+
+
+class PathHierarchyAnalyzer(Analyzer):
+    """Path prefixes (reference: analysis/path_hierarchy_tokenizer.cpp):
+    '/a/b/c' → '/a', '/a/b', '/a/b/c' (all at position 0, like the
+    reference — a path filter matches any ancestor)."""
+
+    name = "path_hierarchy"
+
+    def __init__(self, delimiter: str = "/", reverse: bool = False):
+        self.delimiter = delimiter
+        self.reverse = reverse
+
+    def tokenize(self, text: str) -> list[Token]:
+        d = self.delimiter
+        parts = [p for p in text.split(d) if p != ""]
+        if not parts:
+            return []
+        out = []
+        if not self.reverse:
+            lead = d if text.startswith(d) else ""
+            for i in range(1, len(parts) + 1):
+                term = lead + d.join(parts[:i])
+                out.append(Token(term, 0, 0, len(term)))
+        else:
+            trail = d if text.endswith(d) else ""
+            for i in range(len(parts)):
+                term = d.join(parts[i:]) + trail
+                out.append(Token(term, 0, len(text) - len(term), len(text)))
+        return out
+
+
+class SynonymAnalyzer(Analyzer):
+    """Synonym expansion over an inner analyzer (reference:
+    analysis/solr_synonyms_tokenizer.cpp / wordnet_synonyms_tokenizer.cpp).
+    Mapping 'a => b,c' (solr style) or symmetric groups 'a,b,c'; expansions
+    are emitted AT THE SAME POSITION so phrase queries still line up."""
+
+    name = "synonyms"
+
+    def __init__(self, rules: Iterable[str],
+                 inner: Optional[Analyzer] = None):
+        self.inner = inner or SimpleTextAnalyzer()
+        self.map: dict[str, list[str]] = {}
+        for rule in rules:
+            rule = rule.strip()
+            if not rule or rule.startswith("#"):
+                continue
+            if "=>" in rule:
+                lhs, rhs = rule.split("=>", 1)
+                targets = [t.strip().lower() for t in rhs.split(",")
+                           if t.strip()]
+                for src in lhs.split(","):
+                    src = src.strip().lower()
+                    if src:
+                        self.map.setdefault(src, []).extend(
+                            t for t in targets
+                            if t not in self.map.get(src, []))
+            else:
+                group = [t.strip().lower() for t in rule.split(",")
+                         if t.strip()]
+                for src in group:
+                    self.map.setdefault(src, []).extend(
+                        t for t in group
+                        if t != src and t not in self.map.get(src, []))
+
+    def tokenize(self, text: str) -> list[Token]:
+        out = []
+        for tok in self.inner.tokenize(text):
+            out.append(tok)
+            for syn in self.map.get(tok.term.lower(), ()):
+                out.append(Token(syn, tok.position, tok.start, tok.end))
+        return out
+
+
+class PipelineAnalyzer(Analyzer):
+    """Chain analyzers: each stage re-tokenizes the previous stage's terms
+    (reference: analysis/pipeline_tokenizer.cpp). Positions compose so a
+    delimiter → text pipeline keeps phrase semantics."""
+
+    name = "pipeline"
+
+    def __init__(self, stages: list[Analyzer]):
+        if not stages:
+            raise errors.SqlError("22023", "pipeline requires stages")
+        self.stages = stages
+
+    def tokenize(self, text: str) -> list[Token]:
+        toks = self.stages[0].tokenize(text)
+        for stage in self.stages[1:]:
+            nxt: list[Token] = []
+            pos = 0
+            for t in toks:
+                subs = stage.tokenize(t.term)
+                for s in subs:
+                    nxt.append(Token(s.term, pos, t.start, t.end))
+                    pos += 1
+                if not subs:
+                    pos += 1
+            toks = nxt
+        return toks
+
+
+class UnionAnalyzer(Analyzer):
+    """Union of several analyzers' outputs, deduplicated by (term,
+    position) (reference: analysis/union_tokenizer.cpp — e.g. exact +
+    stemmed forms indexed together)."""
+
+    name = "union"
+
+    def __init__(self, parts: list[Analyzer]):
+        if not parts:
+            raise errors.SqlError("22023", "union requires analyzers")
+        self.parts = parts
+
+    def tokenize(self, text: str) -> list[Token]:
+        seen = set()
+        out = []
+        for a in self.parts:
+            for t in a.tokenize(text):
+                key = (t.term, t.position)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(t)
+        return out
+
+
+class MinHashAnalyzer(Analyzer):
+    """MinHash signature tokens (reference: analysis/minhash_tokenizer.cpp):
+    k minimal 64-bit hashes over the inner analyzer's term shingles —
+    near-duplicate detection with |sig∩sig'|/k ≈ Jaccard similarity."""
+
+    name = "minhash"
+
+    def __init__(self, k: int = 32, inner: Optional[Analyzer] = None,
+                 shingle: int = 3):
+        self.k = int(k)
+        self.inner = inner or SimpleTextAnalyzer()
+        self.shingle = max(1, int(shingle))
+
+    def tokenize(self, text: str) -> list[Token]:
+        import hashlib
+        terms = [t.term for t in self.inner.tokenize(text)]
+        if not terms:
+            return []
+        n = self.shingle
+        shingles = ({" ".join(terms[i:i + n])
+                     for i in range(max(1, len(terms) - n + 1))}
+                    if len(terms) >= 1 else set())
+        hashes = sorted(
+            int.from_bytes(
+                hashlib.blake2b(s.encode(), digest_size=8).digest(),
+                "big")
+            for s in shingles)[: self.k]
+        return [Token(format(h, "016x"), i, 0, 0)
+                for i, h in enumerate(hashes)]
+
+
 _BUILTINS: dict[str, Callable[[], Analyzer]] = {
     "keyword": KeywordAnalyzer,
     "whitespace": WhitespaceAnalyzer,
     "text": TextAnalyzer,
-    "text_en": TextAnalyzer,
     "simple": SimpleTextAnalyzer,
     "ngram": NgramAnalyzer,
     "edge_ngram": lambda: NgramAnalyzer(edge=True),
     "delimiter": DelimiterAnalyzer,
+    "multi_delimiter": MultiDelimiterAnalyzer,
+    "segmentation": SegmentationAnalyzer,
+    "norm": NormalizingAnalyzer,
+    "collation": CollationAnalyzer,
+    "stem": StemAnalyzer,
+    "path_hierarchy": PathHierarchyAnalyzer,
+    "minhash": MinHashAnalyzer,
 }
+# locale text analyzers: text_en … text_fi (reference registers per-locale
+# text tokenizers the same way)
+for _lang in ("en", "de", "fr", "es", "it", "pt", "nl", "ru", "sv", "fi"):
+    _BUILTINS[f"text_{_lang}"] = (
+        lambda _l=_lang: TextAnalyzer(locale=_l))
 
 _cache: dict[str, Analyzer] = {}
 _custom: dict[str, Analyzer] = {}
@@ -177,9 +603,11 @@ _custom: dict[str, Analyzer] = {}
 _KNOWN_DICT_OPTIONS = {
     # behavioral
     "template", "stemming", "accent", "stopwords", "min", "max",
-    "delimiter",
+    "delimiter", "delimiters", "locale", "case", "break", "pattern",
+    "mode", "synonyms", "stages", "analyzers", "hashes", "shingle",
+    "reverse", "analyzer",
     # accepted reference options that are defaults/no-ops here
-    "locale", "case", "frequency", "position", "norm",
+    "frequency", "position", "norm",
 }
 
 
@@ -214,13 +642,14 @@ def register_dictionary(name: str, options: dict,
         if isinstance(v, bool):
             return v
         return str(v).lower() in ("true", "on", "1", "yes")
+    locale = str(options.get("locale", "en"))
     if template in ("text", "simple"):
+        want_stop = truthy(options.get("stopwords"), False)
         a = TextAnalyzer(
-            stopwords=(EN_STOPWORDS
-                       if truthy(options.get("stopwords"), False)
-                       else frozenset()),
+            stopwords=(None if want_stop else frozenset()),
             stem=truthy(options.get("stemming"), template == "text"),
-            accent_fold=truthy(options.get("accent"), True))
+            accent_fold=truthy(options.get("accent"), True),
+            locale=locale)
     elif template == "whitespace":
         a = WhitespaceAnalyzer()
     elif template == "keyword":
@@ -231,6 +660,52 @@ def register_dictionary(name: str, options: dict,
                           edge=template == "edge_ngram")
     elif template == "delimiter":
         a = DelimiterAnalyzer(str(options.get("delimiter", ",")))
+    elif template == "multi_delimiter":
+        ds = options.get("delimiters", ",;")
+        if isinstance(ds, str):
+            ds = list(ds)
+        a = MultiDelimiterAnalyzer(ds)
+    elif template == "segmentation":
+        a = SegmentationAnalyzer(
+            break_mode=str(options.get("break", "alpha")).lower(),
+            case=str(options.get("case", "lower")).lower())
+    elif template == "norm":
+        a = NormalizingAnalyzer(
+            case=str(options.get("case", "lower")).lower(),
+            accent=truthy(options.get("accent"), False))
+    elif template == "collation":
+        a = CollationAnalyzer(locale)
+    elif template == "stem":
+        a = StemAnalyzer(locale)
+    elif template == "pattern":
+        a = PatternAnalyzer(str(options.get("pattern", r"\w+")),
+                            mode=str(options.get("mode", "match")).lower(),
+                            case=str(options.get("case", "none")).lower())
+    elif template == "path_hierarchy":
+        a = PathHierarchyAnalyzer(
+            str(options.get("delimiter", "/")),
+            reverse=truthy(options.get("reverse"), False))
+    elif template == "synonyms":
+        rules = options.get("synonyms", "")
+        if isinstance(rules, str):
+            rules = [r for r in re.split(r"[\n;]", rules) if r.strip()]
+        inner = get_analyzer(str(options.get("analyzer", "simple")))
+        a = SynonymAnalyzer(rules, inner)
+    elif template == "pipeline":
+        names = options.get("stages", "")
+        stage_names = ([s.strip() for s in names.split(",") if s.strip()]
+                       if isinstance(names, str) else list(names))
+        a = PipelineAnalyzer([get_analyzer(s) for s in stage_names])
+    elif template == "union":
+        names = options.get("analyzers", "")
+        part_names = ([s.strip() for s in names.split(",") if s.strip()]
+                      if isinstance(names, str) else list(names))
+        a = UnionAnalyzer([get_analyzer(s) for s in part_names])
+    elif template == "minhash":
+        a = MinHashAnalyzer(
+            k=int(options.get("hashes", 32)),
+            inner=get_analyzer(str(options.get("analyzer", "simple"))),
+            shingle=int(options.get("shingle", 3)))
     else:
         raise errors.SqlError(errors.UNDEFINED_OBJECT,
                               f'tokenizer template "{template}" does not '

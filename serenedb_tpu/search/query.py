@@ -18,34 +18,49 @@ from .analysis import default_analyzer
 from .regexp import RegexpError, compile_regexp
 
 
+def position_groups(tokens) -> list[list[str]]:
+    """Tokens → per-position alternative groups, in position order.
+    Same-position tokens (synonym expansions) become alternatives of one
+    phrase slot."""
+    by_pos: dict[int, list[str]] = {}
+    for t in tokens:
+        by_pos.setdefault(t.position, []).append(t.term)
+    return [by_pos[p] for p in sorted(by_pos)]
+
+
 def match_phrase_brute(texts: np.ndarray, phrases: np.ndarray) -> np.ndarray:
     an = default_analyzer()
     out = np.zeros(len(texts), dtype=bool)
     # common case: constant phrase
-    cache: dict[str, list[str]] = {}
+    cache: dict[str, list[list[str]]] = {}
     for i, (text, phrase) in enumerate(zip(texts, phrases)):
-        terms = cache.get(phrase)
-        if terms is None:
-            terms = cache[phrase] = [t.term for t in an.tokenize(phrase)]
-        out[i] = _phrase_in(an, text, terms)
+        groups = cache.get(phrase)
+        if groups is None:
+            groups = cache[phrase] = position_groups(an.tokenize(phrase))
+        out[i] = _phrase_in(an, text, groups)
     return out
 
 
-def _phrase_in(an, text: str, terms: list[str]) -> bool:
-    if not terms:
+def _phrase_in(an, text: str, groups: list[list[str]]) -> bool:
+    if not groups:
         return False
     toks = an.tokenize(text)
-    if len(terms) == 1:
-        return any(t.term == terms[0] for t in toks)
-    # positions must be consecutive
-    pos_of: dict[str, list[int]] = {}
+    pos_of: dict[str, set[int]] = {}
     for t in toks:
-        pos_of.setdefault(t.term, []).append(t.position)
-    first = pos_of.get(terms[0], [])
-    for p in first:
-        if all((p + k) in pos_of.get(term, ()) for k, term in enumerate(terms[1:], 1)):
-            return True
-    return False
+        pos_of.setdefault(t.term, set()).add(t.position)
+
+    def positions(alts):
+        out: set[int] = set()
+        for a in alts:
+            out |= pos_of.get(a, set())
+        return out
+
+    first = positions(groups[0])
+    if len(groups) == 1:
+        return bool(first)
+    rest = [positions(g) for g in groups[1:]]
+    return any(all((p + k) in ps for k, ps in enumerate(rest, 1))
+               for p in first)
 
 
 # -- tsquery-style boolean query parsing ----------------------------------
@@ -60,8 +75,20 @@ class QTerm(QNode):
 
 
 class QPhrase(QNode):
-    def __init__(self, terms):
+    """Consecutive-position phrase. `groups` holds the alternatives at
+    each position (synonym analyzers emit expansions at the same position,
+    so one phrase slot may accept several terms); `terms` stays the flat
+    list for scoring."""
+
+    def __init__(self, terms, groups=None):
         self.terms = terms
+        self.groups = groups if groups is not None else [[t] for t in terms]
+
+
+class QNothing(QNode):
+    """Matches no documents (e.g. a phrase that analyzed to zero terms —
+    PG's to_tsquery('') semantics). Distinct from an unclaimable conjunct:
+    this IS claimable, and returns the empty set."""
 
 
 class QAnd(QNode):
@@ -232,7 +259,9 @@ def eval_query_on_text(node: QNode, an, text: str) -> bool:
         if isinstance(nd, QTerm):
             return nd.term in terms
         if isinstance(nd, QPhrase):
-            return _phrase_in(an, text, nd.terms)
+            return _phrase_in(an, text, nd.groups)
+        if isinstance(nd, QNothing):
+            return False
         if isinstance(nd, QAnd):
             return all(ev(a) for a in nd.args)
         if isinstance(nd, QOr):

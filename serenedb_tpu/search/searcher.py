@@ -26,8 +26,9 @@ import numpy as np
 from ..columnar.device import pad_len
 from ..ops import bm25 as bm25_ops
 from .analysis import Analyzer
-from .query import (QAnd, QFuzzy, QNode, QNot, QOr, QPhrase, QPrefix, QRegex,
-                    QTerm, edit_distance_at_most, parse_query)
+from .query import (QAnd, QFuzzy, QNode, QNot, QNothing, QOr, QPhrase,
+                    QPrefix, QRegex, QTerm, edit_distance_at_most,
+                    parse_query)
 from .segment import BLOCK, FieldIndex
 
 K1 = 1.2
@@ -68,7 +69,9 @@ class SegmentSearcher:
         if isinstance(node, QRegex):
             return self._union_postings(self._regex_term_ids(node))
         if isinstance(node, QPhrase):
-            return self._eval_phrase(node.terms)
+            return self._eval_phrase(node.groups)
+        if isinstance(node, QNothing):
+            return np.empty(0, dtype=np.int32)
         if isinstance(node, QAnd):
             if not node.args:
                 return np.empty(0, dtype=np.int32)
@@ -102,36 +105,43 @@ class SegmentSearcher:
         return np.unique(np.concatenate(parts)) if parts \
             else np.empty(0, dtype=np.int32)
 
-    def _eval_phrase(self, terms: list[str]) -> np.ndarray:
-        if not terms:
+    def _eval_phrase(self, groups: list[list[str]]) -> np.ndarray:
+        """Phrase over per-position alternative groups: each slot is the
+        union of its alternatives' postings (synonym expansions), slots
+        must land on consecutive doc positions."""
+        if not groups:
             return np.empty(0, dtype=np.int32)
-        tids = [self.index.term_id(t) for t in terms]
-        if any(t < 0 for t in tids):
+        gtids = [[t for t in (self.index.term_id(a) for a in g) if t >= 0]
+                 for g in groups]
+        if any(not g for g in gtids):
             return np.empty(0, dtype=np.int32)
-        cand = self.index.postings(tids[0])[0]
-        for t in tids[1:]:
-            cand = np.intersect1d(cand, self.index.postings(t)[0],
+        cand = self._union_postings(gtids[0])
+        for g in gtids[1:]:
+            cand = np.intersect1d(cand, self._union_postings(g),
                                   assume_unique=True)
-        if len(terms) == 1 or len(cand) == 0:
+        if len(groups) == 1 or len(cand) == 0:
             return cand
-        pos_maps = [self.index.positions_of(t, cand) for t in tids]
+        # doc → union of positions across the group's alternatives
+        pos_maps = []
+        for g in gtids:
+            merged: dict[int, set] = {}
+            for t in g:
+                for d, ps in self.index.positions_of(t, cand).items():
+                    merged.setdefault(int(d), set()).update(
+                        int(p) for p in ps)
+            pos_maps.append(merged)
         out = []
         for d in cand:
-            first = pos_maps[0].get(int(d))
+            d = int(d)
+            first = pos_maps[0].get(d)
             if first is None:
                 continue
-            ok = False
-            rest = [pm.get(int(d)) for pm in pos_maps[1:]]
+            rest = [pm.get(d) for pm in pos_maps[1:]]
             if any(r is None for r in rest):
                 continue
-            rest_sets = [set(r.tolist()) for r in rest]
-            for p in first:
-                if all((int(p) + k1) in rs
-                       for k1, rs in enumerate(rest_sets, 1)):
-                    ok = True
-                    break
-            if ok:
-                out.append(int(d))
+            if any(all((p + k1) in rs for k1, rs in enumerate(rest, 1))
+                   for p in first):
+                out.append(d)
         return np.asarray(out, dtype=np.int32)
 
     def _fuzzy_term_ids(self, node: QFuzzy) -> list[int]:

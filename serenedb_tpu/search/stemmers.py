@@ -1,0 +1,320 @@
+"""Language stemmers for the text analyzers.
+
+Reference analog: libs/iresearch/analysis/stemming_tokenizer.cpp +
+text_tokenizer.cpp delegate to libstemmer (snowball). No snowball binding
+exists in this image, so English gets a full Porter2 implementation and the
+other languages get snowball-derived suffix strippers. What parity actually
+requires is that index-side and query-side stem identically and that
+morphological variants collapse — both hold for these.
+"""
+
+from __future__ import annotations
+
+_VOWELS = set("aeiouy")
+_DOUBLES = ("bb", "dd", "ff", "gg", "mm", "nn", "pp", "rr", "tt")
+_LI_ENDING = set("cdeghkmnrt")
+
+_P2_EXCEPTIONS = {
+    "skis": "ski", "skies": "sky", "dying": "die", "lying": "lie",
+    "tying": "tie", "idly": "idl", "gently": "gentl", "ugly": "ugli",
+    "early": "earli", "only": "onli", "singly": "singl", "sky": "sky",
+    "news": "news", "howe": "howe", "atlas": "atlas", "cosmos": "cosmos",
+    "bias": "bias", "andes": "andes",
+}
+_P2_EXCEPTIONS1A = {"inning", "outing", "canning", "herring", "earring",
+                    "proceed", "exceed", "succeed"}
+
+
+def _is_vowel(word: str, i: int) -> bool:
+    return word[i] in _VOWELS
+
+
+def _regions(word: str) -> tuple[int, int]:
+    """Porter2 R1/R2 start offsets."""
+    if word.startswith(("gener", "commun", "arsen")):
+        r1 = 6 if word.startswith("commun") else 5
+    else:
+        r1 = len(word)
+        for i in range(1, len(word)):
+            if not _is_vowel(word, i) and _is_vowel(word, i - 1):
+                r1 = i + 1
+                break
+    r2 = len(word)
+    for i in range(r1 + 1, len(word)):
+        if not _is_vowel(word, i) and _is_vowel(word, i - 1):
+            r2 = i + 1
+            break
+    return r1, r2
+
+
+def _short_syllable_end(word: str) -> bool:
+    """word ends in a short syllable (porter2 definition)."""
+    n = len(word)
+    if n >= 3:
+        a, b, c = word[n - 3], word[n - 2], word[n - 1]
+        if (c not in _VOWELS and c not in "wxY" and b in _VOWELS
+                and a not in _VOWELS):
+            return True
+    if n == 2 and word[0] in _VOWELS and word[1] not in _VOWELS:
+        return True
+    return False
+
+
+def _is_short(word: str, r1: int) -> bool:
+    return r1 >= len(word) and _short_syllable_end(word)
+
+
+def porter2(word: str) -> str:
+    """Snowball English (Porter2) stemmer."""
+    w = word.lower()
+    if len(w) <= 2:
+        return w
+    if w in _P2_EXCEPTIONS:
+        return _P2_EXCEPTIONS[w]
+    w = w.replace("’", "'")
+    if w.startswith("'"):
+        w = w[1:]
+    # mark consonant-y as Y
+    if w.startswith("y"):
+        w = "Y" + w[1:]
+    w = "".join("Y" if (c == "y" and i > 0 and w[i - 1] in _VOWELS) else c
+                for i, c in enumerate(w))
+    r1, r2 = _regions(w)
+
+    # step 0
+    for suf in ("'s'", "'s", "'"):
+        if w.endswith(suf):
+            w = w[: -len(suf)]
+            break
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith(("ied", "ies")):
+        w = w[:-2] if len(w) > 4 else w[:-1]
+    elif w.endswith(("us", "ss")):
+        pass
+    elif w.endswith("s") and any(c in _VOWELS for c in w[:-2]):
+        w = w[:-1]
+    if w in _P2_EXCEPTIONS1A:
+        return w.lower()
+    # step 1b
+    if w.endswith(("eed", "eedly")):
+        suf = "eedly" if w.endswith("eedly") else "eed"
+        if len(w) - len(suf) >= r1:
+            w = w[: -len(suf)] + "ee"
+    else:
+        for suf in ("ingly", "edly", "ing", "ed"):
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if any(c in _VOWELS for c in stem):
+                    w = stem
+                    if w.endswith(("at", "bl", "iz")):
+                        w += "e"
+                    elif w.endswith(_DOUBLES):
+                        w = w[:-1]
+                    elif _is_short(w, r1):
+                        w += "e"
+                break
+    # step 1c
+    if len(w) > 2 and w[-1] in "yY" and w[-2] not in _VOWELS:
+        w = w[:-1] + "i"
+
+    # step 2 (longest suffix, in R1)
+    step2 = [("ational", "ate"), ("fulness", "ful"), ("iveness", "ive"),
+             ("ization", "ize"), ("ousness", "ous"), ("biliti", "ble"),
+             ("lessli", "less"), ("tional", "tion"), ("alism", "al"),
+             ("aliti", "al"), ("ation", "ate"), ("entli", "ent"),
+             ("fulli", "ful"), ("iviti", "ive"), ("ousli", "ous"),
+             ("abli", "able"), ("alli", "al"), ("anci", "ance"),
+             ("ator", "ate"), ("enci", "ence"), ("izer", "ize"),
+             ("bli", "ble"), ("ogi", "og"), ("li", "")]
+    for suf, rep in step2:
+        if w.endswith(suf):
+            if len(w) - len(suf) >= r1:
+                if suf == "ogi":
+                    if w[-4:-3] == "l":
+                        w = w[:-3] + rep
+                elif suf == "li":
+                    if len(w) >= 3 and w[-3] in _LI_ENDING:
+                        w = w[:-2]
+                else:
+                    w = w[: -len(suf)] + rep
+            break
+    # step 3 (in R1; ative needs R2)
+    step3 = [("ational", "ate"), ("tional", "tion"), ("alize", "al"),
+             ("icate", "ic"), ("iciti", "ic"), ("ative", ""),
+             ("ical", "ic"), ("ness", ""), ("ful", "")]
+    for suf, rep in step3:
+        if w.endswith(suf):
+            if len(w) - len(suf) >= r1:
+                if suf == "ative":
+                    if len(w) - len(suf) >= r2:
+                        w = w[: -len(suf)]
+                else:
+                    w = w[: -len(suf)] + rep
+            break
+    # step 4 (in R2)
+    step4 = ["ement", "ance", "ence", "able", "ible", "ment", "ant", "ent",
+             "ism", "ate", "iti", "ous", "ive", "ize", "ion", "al", "er",
+             "ic"]
+    for suf in step4:
+        if w.endswith(suf):
+            if len(w) - len(suf) >= r2:
+                if suf == "ion":
+                    if len(w) >= 4 and w[-4] in "st":
+                        w = w[:-3]
+                else:
+                    w = w[: -len(suf)]
+            break
+    # step 5
+    if w.endswith("e"):
+        if len(w) - 1 >= r2:
+            w = w[:-1]
+        elif len(w) - 1 >= r1 and not _short_syllable_end(w[:-1]):
+            w = w[:-1]
+    elif w.endswith("l") and len(w) - 1 >= r2 and len(w) >= 2 and \
+            w[-2] == "l":
+        w = w[:-1]
+    return w.lower()
+
+
+def _strip_suffixes(word: str, suffixes, min_stem: int) -> str:
+    """Strip the longest matching suffix, keeping at least min_stem chars."""
+    for suf in suffixes:
+        if word.endswith(suf) and len(word) - len(suf) >= min_stem:
+            return word[: -len(suf)]
+    return word
+
+
+def stem_de(w: str) -> str:
+    w = (w.replace("ä", "a").replace("ö", "o").replace("ü", "u")
+          .replace("ß", "ss"))
+    w = _strip_suffixes(w, ("ungen", "heiten", "keiten", "erung", "ern",
+                            "ung", "heit", "keit", "isch", "lich", "en",
+                            "er", "em", "es", "e", "s"), 4)
+    return w
+
+
+def stem_fr(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    # suffixes are accent-folded to match the folded input
+    return _strip_suffixes(
+        w, ("issements", "issement", "issantes", "issante", "issants",
+            "issant", "atrices", "atrice", "ateurs", "ateur", "logies",
+            "logie", "emment", "amment", "ements", "ement", "euses",
+            "ments", "ment", "euse", "eux", "ives", "ive", "ifs", "if",
+            "ables", "able", "istes", "iste", "ances", "ance", "ences",
+            "ence", "ites", "ite", "aient", "erent", "erons", "eront",
+            "antes", "ante", "ants", "ant", "ees", "ee", "er",
+            "ez", "ent", "ais", "ait", "ons", "ion", "es", "s", "e"), 4)
+
+
+def stem_es(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    return _strip_suffixes(
+        w, ("amientos", "imientos", "amiento", "imiento", "aciones",
+            "uciones", "adoras", "adores", "ancias", "logias", "encias",
+            "idades", "acion", "ucion", "adora", "ador", "ancia", "logia",
+            "encia", "antes", "anzas", "ismos", "ables", "ibles", "istas",
+            "osos", "osas", "ivas", "ivos", "anza", "icos", "icas", "ismo",
+            "able", "ible", "ista", "oso", "osa", "iva", "ivo", "idad",
+            "ante", "arse", "iendo", "ando", "aria", "eria", "iria",
+            "aron", "ieron", "ando", "aban", "amos", "emos", "imos",
+            "ar", "er", "ir", "as", "es", "os", "a", "e", "o", "s"), 4)
+
+
+def stem_it(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    return _strip_suffixes(
+        w, ("azioni", "azione", "amenti", "imenti", "amento", "imento",
+            "atrici", "atrice", "abili", "ibili", "ismi", "ismo", "iste",
+            "isti", "ista", "osi", "ose", "osa", "oso", "ivi", "ive",
+            "iva", "ivo", "anza", "anze", "ichi", "iche", "logia",
+            "logie", "mente", "ando", "endo", "are", "ere", "ire",
+            "ato", "ata", "ati", "ate", "uto", "uta", "uti", "ute",
+            "ito", "ita", "iti", "ite", "ano", "ono", "i", "e", "a",
+            "o"), 4)
+
+
+def stem_pt(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    return _strip_suffixes(
+        w, ("amentos", "imentos", "amento", "imento", "adoras", "adores",
+            "acoes", "ancias", "logias", "encias", "idades", "issimo",
+            "acao", "ancia", "logia", "encia", "adora", "ador", "antes",
+            "ismos", "istas", "aveis", "iveis", "osos", "osas", "ivas",
+            "ivos", "ismo", "avel", "ivel", "ista", "oso", "osa", "iva",
+            "ivo", "idade", "ante", "ando", "endo", "indo", "aram",
+            "eram", "iram", "amos", "emos", "imos", "ar", "er", "ir",
+            "as", "es", "os", "a", "e", "o", "s"), 4)
+
+
+def stem_nl(w: str) -> str:
+    return _strip_suffixes(
+        w, ("heden", "ingen", "erend", "end", "ing", "tje", "pje", "je",
+            "en", "se", "s", "e"), 4)
+
+
+def stem_ru(w: str) -> str:
+    # noun/adjective/verb endings, longest-first (snowball russian order)
+    return _strip_suffixes(
+        w, ("ированиями", "ованиями", "ированием", "ирование", "ирования",
+            "ированию", "ировании", "ованием", "ованиям", "ованиях",
+            "ировани", "ностью", "ениями", "ование", "ением", "ениях",
+            "ениям", "ывание", "ивание", "ность", "ости", "ение", "ость",
+            "ними", "ыми", "ими", "ого", "его", "ому", "ему", "ями",
+            "ами", "ует", "уют", "ишь", "ешь", "ить", "ать", "ять",
+            "еть", "ала", "ила", "ыла", "ела", "ях", "ям", "ах", "ам",
+            "ие", "ия", "ий", "ые", "ый", "ое", "ой", "ая", "яя", "ью",
+            "ов", "ев", "ей", "ом", "ем", "ан", "ен", "ут", "ют", "ат",
+            "ят", "ы", "и", "а", "я", "о", "е", "у", "ю", "ь", "й"), 3)
+
+
+def stem_sv(w: str) -> str:
+    w = w.replace("å", "a").replace("ä", "a").replace("ö", "o")
+    return _strip_suffixes(
+        w, ("heterna", "heten", "heter", "arna", "erna", "orna", "ande",
+            "ende", "aste", "arne", "are", "ast", "ade", "ad", "arnas",
+            "ernas", "or", "ar", "er", "en", "an", "et", "na", "a", "e",
+            "s"), 3)
+
+
+def stem_fi(w: str) -> str:
+    w = w.replace("ä", "a").replace("ö", "o")
+    return _strip_suffixes(
+        w, ("isuudet", "isuuden", "immat", "impia", "sti", "ssa", "sta",
+            "lla", "lta", "lle", "ksi", "tta", "ista", "issa", "iin",
+            "ihin", "iden", "ien", "it", "et", "at", "in", "an", "en",
+            "na", "a", "i", "t", "n"), 3)
+
+
+STEMMERS = {
+    "en": porter2, "english": porter2,
+    "de": stem_de, "german": stem_de,
+    "fr": stem_fr, "french": stem_fr,
+    "es": stem_es, "spanish": stem_es,
+    "it": stem_it, "italian": stem_it,
+    "pt": stem_pt, "portuguese": stem_pt,
+    "nl": stem_nl, "dutch": stem_nl,
+    "ru": stem_ru, "russian": stem_ru,
+    "sv": stem_sv, "swedish": stem_sv,
+    "fi": stem_fi, "finnish": stem_fi,
+}
+
+
+def lang_of(locale: str) -> str:
+    """'de_DE.utf-8' / 'de-AT' / 'german' → normalized language key."""
+    return (locale or "en").lower().split("_")[0].split("-")[0].split(".")[0]
+
+
+def stemmer_for(locale: str):
+    """locale → stemmer fn (None = no stemmer for that language)."""
+    return STEMMERS.get(lang_of(locale))

@@ -50,13 +50,26 @@ _TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
            1082: 4, 1186: 16, 26: 4, 2205: 4, 2206: 4, 24: 4, 4089: 4}
 
 
-def pg_text(value, typ: dt.SqlType) -> Optional[bytes]:
+def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:
     """PG text-format encoding (reference: server/pg/serialize.cpp)."""
     if value is None:
         return None
     tid = typ.id
     if tid is dt.TypeId.BOOL:
         return b"t" if value else b"f"
+    if tid in (dt.TypeId.REGCLASS, dt.TypeId.REGTYPE, dt.TypeId.REGPROC,
+               dt.TypeId.REGNAMESPACE):
+        # PG renders reg* as names in text format (binary stays the oid)
+        from .. import pgcatalog as _pgcat
+        if tid is dt.TypeId.REGTYPE:
+            s = _pgcat.type_name_of(value) or str(int(value))
+        elif tid is dt.TypeId.REGPROC:
+            s = _pgcat.proc_name_of(value) or str(int(value))
+        elif tid is dt.TypeId.REGNAMESPACE:
+            s = _pgcat.namespace_render(db, int(value))
+        else:
+            s = _pgcat.regclass_render(db, int(value))
+        return s.encode()
     if tid is dt.TypeId.TIMESTAMP:
         from ..sql.binder import format_timestamp
         return format_timestamp(int(value)).encode()
@@ -127,9 +140,11 @@ def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
 
 
 class Writer:
-    def __init__(self, transport: asyncio.StreamWriter):
+    def __init__(self, transport: asyncio.StreamWriter, db=None):
         self.t = transport
         self._buf = bytearray()
+        #: the session's Database — reg* text rendering resolves names
+        self.db = db
 
     def msg(self, kind: bytes, payload: bytes = b""):
         self._buf += kind + struct.pack("!I", len(payload) + 4) + payload
@@ -187,8 +202,10 @@ class Writer:
         cols_text = []
         for ci, (col, t) in enumerate(zip(batch.columns, types)):
             vals = col.to_pylist()
-            enc = pg_binary if _fmt_for(fmts, ci) == 1 else pg_text
-            cols_text.append([enc(v, t) for v in vals])
+            if _fmt_for(fmts, ci) == 1:
+                cols_text.append([pg_binary(v, t) for v in vals])
+            else:
+                cols_text.append([pg_text(v, t, self.db) for v in vals])
         for i in range(batch.num_rows):
             parts = [struct.pack("!H", len(types))]
             for ci in range(len(types)):
@@ -253,7 +270,7 @@ class PgSession:
                  writer: asyncio.StreamWriter):
         self.server = server
         self.reader = reader
-        self.w = Writer(writer)
+        self.w = Writer(writer, db=server.db)
         self.conn: Optional[Connection] = None
         self.prepared: dict[str, Prepared] = {}
         self.portals: dict[str, Portal] = {}

@@ -659,6 +659,16 @@ class Parser:
             return ast.Literal(False)
         if upper == "CASE":
             return self.parse_case()
+        if upper in ("CURRENT_USER", "SESSION_USER", "CURRENT_ROLE",
+                     "CURRENT_CATALOG", "CURRENT_SCHEMA", "CURRENT_DATE",
+                     "CURRENT_TIMESTAMP", "LOCALTIMESTAMP") and not (
+                self.peek(1).kind is T.OP and self.peek(1).value == "("):
+            # PG reserved niladic functions: bare keyword, no parens
+            self.next()
+            fname = {"CURRENT_ROLE": "current_user",
+                     "LOCALTIMESTAMP": "current_timestamp"}.get(
+                upper, upper.lower())
+            return ast.FuncCall(fname, [])
         if upper == "ARRAY" and self.peek(1).kind is T.OP and \
                 self.peek(1).value == "(":
             # ARRAY(subquery): first output column gathered into an array

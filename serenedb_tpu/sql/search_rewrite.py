@@ -309,8 +309,17 @@ def _to_qnode(e: BoundExpr, col_idx: int, analyzer) -> Optional[QNode]:
             isinstance(lit, BoundLiteral) and isinstance(lit.value, str)):
         return None
     if e.name == "ts_phrase":
-        terms = [t.term for t in analyzer.tokenize(lit.value)]
-        if not terms:
-            return None
-        return QTerm(terms[0]) if len(terms) == 1 else QPhrase(terms)
+        from ..search.query import QNothing, QOr, position_groups
+        toks = analyzer.tokenize(lit.value)
+        groups = position_groups(toks)
+        if not groups:
+            # zero analyzed terms match nothing (to_tsquery('')), and the
+            # claim MUST happen: the brute fallback analyzes with the
+            # default analyzer, not this column's dictionary
+            return QNothing()
+        if len(groups) == 1:
+            alts = groups[0]
+            return (QTerm(alts[0]) if len(alts) == 1
+                    else QOr([QTerm(a) for a in alts]))
+        return QPhrase([t.term for t in toks], groups)
     return parse_query(lit.value, analyzer)

@@ -1,0 +1,234 @@
+"""Analyzer breadth: stemmers, locale text, CJK, synonyms, pipeline,
+minhash, and end-to-end non-ASCII indexing + search.
+
+Reference parity surface: libs/iresearch/include/iresearch/analysis/
+(text/segmentation/normalizing/collation/stemming/pattern/path_hierarchy/
+synonyms/pipeline/union/minhash tokenizers)."""
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.search import analysis
+from serenedb_tpu.search.stemmers import (porter2, stem_de, stem_fr,
+                                          stem_ru, stemmer_for)
+
+
+def terms(name, text, **opts):
+    return analysis.get_analyzer(name).terms(text)
+
+
+# -- stemmers --------------------------------------------------------------
+
+def test_porter2_snowball_vocabulary():
+    cases = {
+        "consigned": "consign", "consisting": "consist",
+        "consistently": "consist", "caresses": "caress", "flies": "fli",
+        "dies": "die", "mules": "mule", "denied": "deni",
+        "agreed": "agre", "owned": "own", "humbled": "humbl",
+        "meeting": "meet", "stating": "state", "itemization": "item",
+        "sensational": "sensat", "traditional": "tradit",
+        "reference": "refer", "colonizer": "colon", "plotted": "plot",
+        "generate": "generat", "generally": "general", "happy": "happi",
+        "skies": "sky", "dying": "die", "cats": "cat", "running": "run",
+    }
+    for w, want in cases.items():
+        assert porter2(w) == want, (w, porter2(w), want)
+
+
+def test_language_stemmers_collapse_variants():
+    # each language: morphological variants map to a shared stem
+    assert stem_de("häuser") == stem_de("hauses") == "haus"
+    assert stem_fr("nationalité") == stem_fr("national")
+    assert stem_ru("программирования") == stem_ru("программирование")
+    assert stemmer_for("de_DE.utf-8") is stem_de
+    assert stemmer_for("pt-BR") is not None
+    assert stemmer_for("xx") is None
+
+
+# -- locale text analyzers -------------------------------------------------
+
+def test_text_de_stopwords_and_stemming():
+    out = terms("text_de", "Die Häuser und die Wohnungen")
+    assert "die" not in out and "und" not in out
+    assert "haus" in out
+
+
+def test_text_fr_accents():
+    out = terms("text_fr", "les nationalités européennes")
+    assert "les" not in out
+    # accent-folded + stemmed to the shared base form
+    assert "national" in out
+
+
+def test_text_ru():
+    out = terms("text_ru", "быстрое программирование на сервере")
+    assert "на" not in out
+    assert any(t.startswith("программ") for t in out)
+
+
+def test_cjk_bigrams():
+    out = terms("text", "机器学习")
+    assert out == ["机器", "器学", "学习"]
+    out = terms("text", "日本語のtokenizer")
+    assert "日本" in out and "本語" in out
+    # single CJK char is a unigram
+    assert terms("text", "猫") == ["猫"]
+
+
+def test_korean_and_kana():
+    assert "한국" in terms("text", "한국어")
+    assert "かた" in terms("text", "かたかな")
+
+
+# -- structural analyzers --------------------------------------------------
+
+def test_segmentation_modes():
+    a = analysis.SegmentationAnalyzer(break_mode="alpha", case="lower")
+    assert a.terms("Quick 123 Brown!") == ["quick", "brown"]
+    a = analysis.SegmentationAnalyzer(break_mode="word", case="none")
+    assert a.terms("Quick 123") == ["Quick", "123"]
+    a = analysis.SegmentationAnalyzer(break_mode="graphic", case="upper")
+    assert a.terms("a-b c") == ["A-B", "C"]
+
+
+def test_normalizing_and_collation():
+    a = analysis.NormalizingAnalyzer(case="lower", accent=False)
+    assert a.terms("Crème BRÛLÉE") == ["creme brulee"]
+    c = analysis.CollationAnalyzer("de")
+    assert c.terms("Straße")[0] == c.terms("strasse")[0]
+
+
+def test_stem_analyzer():
+    a = analysis.StemAnalyzer("en")
+    assert a.terms("Running") == ["run"]
+
+
+def test_pattern_analyzer():
+    a = analysis.PatternAnalyzer(r"[A-Z][a-z]+")
+    assert a.terms("CamelCaseWords here") == ["Camel", "Case", "Words"]
+    s = analysis.PatternAnalyzer(r"[,;]\s*", mode="split")
+    assert s.terms("a, b; c") == ["a", "b", "c"]
+    with pytest.raises(Exception):
+        analysis.PatternAnalyzer("(unclosed")
+
+
+def test_multi_delimiter():
+    a = analysis.MultiDelimiterAnalyzer([",", ";", "|"])
+    assert a.terms("a,b;c|d") == ["a", "b", "c", "d"]
+
+
+def test_path_hierarchy():
+    a = analysis.PathHierarchyAnalyzer()
+    assert a.terms("/usr/local/bin") == ["/usr", "/usr/local",
+                                         "/usr/local/bin"]
+    r = analysis.PathHierarchyAnalyzer(".", reverse=True)
+    assert r.terms("a.b.c") == ["a.b.c", "b.c", "c"]
+    # ancestors share position 0 so a term filter hits any level
+    assert {t.position for t in a.tokenize("/x/y")} == {0}
+
+
+def test_synonyms_same_position():
+    a = analysis.SynonymAnalyzer(["tv => television", "fast,quick"])
+    toks = a.tokenize("fast tv")
+    by_term = {t.term: t.position for t in toks}
+    assert by_term["television"] == by_term["tv"]
+    assert by_term["quick"] == by_term["fast"]
+
+
+def test_pipeline_composition():
+    p = analysis.PipelineAnalyzer([
+        analysis.DelimiterAnalyzer(","),
+        analysis.TextAnalyzer(stopwords=frozenset())])
+    assert p.terms("Running Fast,Jumped High") == \
+        ["run", "fast", "jump", "high"]
+
+
+def test_union_dedup():
+    u = analysis.UnionAnalyzer([
+        analysis.SimpleTextAnalyzer(),
+        analysis.TextAnalyzer(stopwords=frozenset())])
+    out = u.terms("running")
+    assert "running" in out and "run" in out
+
+
+def test_minhash_similarity():
+    a = analysis.MinHashAnalyzer(k=16)
+    s1 = set(a.terms("the quick brown fox jumps over the lazy dog"))
+    s2 = set(a.terms("the quick brown fox jumps over the lazy cat"))
+    s3 = set(a.terms("completely different sentence about databases"))
+    assert 0 < len(s1) <= 16   # k caps the signature; fewer shingles → fewer
+    assert len(s1 & s2) > len(s1 & s3)
+    # deterministic
+    assert a.terms("same input") == a.terms("same input")
+
+
+# -- SQL end-to-end --------------------------------------------------------
+
+@pytest.fixture
+def conn():
+    return Database().connect()
+
+
+def test_german_corpus_end_to_end(conn):
+    conn.execute("CREATE TABLE de_docs (id INT, body TEXT)")
+    conn.execute("INSERT INTO de_docs VALUES "
+                 "(1, 'Die Häuser der Stadt'), "
+                 "(2, 'Ein Haus am See'), "
+                 "(3, 'Der Garten und die Bäume')")
+    conn.execute("CREATE INDEX ON de_docs USING inverted (body text_de)")
+    # 'Häusern' stems to the same term as 'Haus'/'Häuser'
+    rows = conn.execute(
+        "SELECT id FROM de_docs WHERE body ## 'Häusern' ORDER BY id").rows()
+    assert rows == [(1,), (2,)]
+
+
+def test_cjk_corpus_end_to_end(conn):
+    conn.execute("CREATE TABLE zh_docs (id INT, body TEXT)")
+    conn.execute("INSERT INTO zh_docs VALUES "
+                 "(1, '机器学习与数据库'), (2, '数据库系统'), "
+                 "(3, '自然语言处理')")
+    conn.execute("CREATE INDEX ON zh_docs USING inverted (body)")
+    rows = conn.execute(
+        "SELECT id FROM zh_docs WHERE body ## '数据库' ORDER BY id").rows()
+    assert rows == [(1,), (2,)]
+
+
+def test_synonym_dictionary_end_to_end(conn):
+    conn.execute("CREATE TEXT SEARCH DICTIONARY tvsyn("
+                 "template = 'synonyms', "
+                 "synonyms = 'tv => television; couch,sofa')")
+    conn.execute("CREATE TABLE furn (id INT, body TEXT)")
+    conn.execute("INSERT INTO furn VALUES "
+                 "(1, 'a tv stand'), (2, 'a sofa cushion'), "
+                 "(3, 'a wooden table')")
+    conn.execute("CREATE INDEX ON furn USING inverted (body tvsyn)")
+    assert conn.execute("SELECT id FROM furn WHERE body ## 'television'"
+                        ).rows() == [(1,)]
+    assert conn.execute("SELECT id FROM furn WHERE body ## 'couch'"
+                        ).rows() == [(2,)]
+
+
+def test_pipeline_dictionary_end_to_end(conn):
+    conn.execute("CREATE TEXT SEARCH DICTIONARY csv_text("
+                 "template = 'pipeline', stages = 'delimiter,text')")
+    conn.execute("CREATE TABLE tags (id INT, body TEXT)")
+    conn.execute("INSERT INTO tags VALUES (1, 'Databases,Searching'), "
+                 "(2, 'Compilers,Parsing')")
+    conn.execute("CREATE INDEX ON tags USING inverted (body csv_text)")
+    assert conn.execute("SELECT id FROM tags WHERE body ## 'search'"
+                        ).rows() == [(1,)]
+
+
+def test_locale_dictionary_option(conn):
+    conn.execute("CREATE TEXT SEARCH DICTIONARY fr_dict("
+                 "template = 'text', locale = 'fr_FR.utf-8', "
+                 "stopwords = 'true')")
+    conn.execute("CREATE TABLE fr_docs (id INT, body TEXT)")
+    conn.execute("INSERT INTO fr_docs VALUES "
+                 "(1, 'les nationalités des pays')")
+    conn.execute("CREATE INDEX ON fr_docs USING inverted (body fr_dict)")
+    assert conn.execute("SELECT id FROM fr_docs WHERE body ## 'nationalité'"
+                        ).rows() == [(1,)]
+    # stopword never indexed
+    assert conn.execute("SELECT id FROM fr_docs WHERE body ## 'les'"
+                        ).rows() == []

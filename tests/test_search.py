@@ -90,7 +90,7 @@ def test_bm25_manual_formula(searcher):
     scores, docs = searcher.topk(node, 1)
     d = int(docs[0])
     fi = searcher.index
-    tid = fi.term_id("apple")
+    tid = fi.term_id(an.terms("apple")[0])   # analyzed (stemmed) form
     pd, pt = fi.postings(tid)
     tf = float(pt[np.searchsorted(pd, d)])
     df = float(fi.doc_freq[tid])
