@@ -53,6 +53,40 @@ def idf_for(scorer: str, n_docs: int, doc_freq: np.ndarray) -> np.ndarray:
         else idf_lucene(n_docs, doc_freq)
 
 
+# language-model scorer family (reference: libs/iresearch/search/
+# lm_dirichlet.cpp, jelinek_mercer.cpp, dfi.cpp). Their per-term weight is
+# the collection probability p_t = ctf_t / total_tokens, not an idf; the
+# hyper-parameter (µ or λ) rides the k1 float slot of the shared kernel.
+LM_SCORERS = ("lm_dirichlet", "jelinek_mercer", "dfi")
+LM_MU = 2000.0     # Dirichlet µ (Lucene LMDirichletSimilarity default)
+JM_LAMBDA = 0.1    # Jelinek-Mercer λ (short-query default)
+#: per-matched-posting score floor: lm_dirichlet/dfi legitimately score 0
+#: on weak matches, but downstream keep-filters use score>0 ⇔ matched.
+#: Far below score resolution, so ranking is unchanged.
+MATCH_EPS = 1e-6
+
+
+def scorer_param(scorer: str, k1: float) -> float:
+    """The value carried in the kernel's k1 slot for this scorer."""
+    if scorer == "lm_dirichlet":
+        return LM_MU
+    if scorer == "jelinek_mercer":
+        return JM_LAMBDA
+    return k1
+
+
+def term_weight_for(scorer: str, n_docs: int, doc_freq: np.ndarray,
+                    ctf: Optional[np.ndarray] = None,
+                    total_tokens: float = 0.0) -> np.ndarray:
+    """Per-term weight: idf for bm25/tfidf, collection probability p_t for
+    the LM family."""
+    if scorer in LM_SCORERS:
+        total = max(float(total_tokens), 1.0)
+        p = np.asarray(ctf, dtype=np.float64) / total
+        return np.maximum(p, 1e-12).astype(np.float32)
+    return idf_for(scorer, n_docs, doc_freq)
+
+
 @dataclass
 class BlockStore:
     """Device-resident posting tiles for one field index."""
@@ -506,6 +540,31 @@ def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
         tfsf = tfs.astype(jnp.float32)
         if scorer == "tfidf":
             c = w * jnp.sqrt(tfsf)
+        elif scorer == "lm_dirichlet":
+            # w = p_t (collection probability), k1 slot = µ. Lucene
+            # LMDirichletSimilarity shape, clamped at 0
+            # (reference: lm_dirichlet.cpp)
+            dl = norms[safe_docs].astype(jnp.float32)
+            mu = k1
+            c = (jnp.log1p(tfsf / (mu * w)) +
+                 jnp.log(mu / (dl + mu)))
+            # + MATCH_EPS: LM scores clamp to 0 for weak matches, but the
+            # engine's result filters rely on score>0 ⇔ matched
+            c = jnp.maximum(c, 0.0) + MATCH_EPS
+        elif scorer == "jelinek_mercer":
+            # w = p_t, k1 slot = λ (reference: jelinek_mercer smoothing)
+            dl = norms[safe_docs].astype(jnp.float32)
+            lam = k1
+            c = jnp.log1p(((1.0 - lam) * tfsf / jnp.maximum(dl, 1.0)) /
+                          (lam * w))
+        elif scorer == "dfi":
+            # divergence from independence: expected tf under independence
+            # is e = p_t·dl; score the standardized excess
+            # (reference: dfi.cpp)
+            dl = norms[safe_docs].astype(jnp.float32)
+            e = w * dl
+            excess = (tfsf - e) / jnp.sqrt(jnp.maximum(e, 1e-9))
+            c = jnp.where(tfsf > e, jnp.log2(1.0 + excess), 0.0) + MATCH_EPS
         else:
             dl = norms[safe_docs].astype(jnp.float32)
             denom = tfsf + k1 * (1.0 - b + b * dl / avg)

@@ -331,6 +331,13 @@ class SegmentSearcher:
         if self.num_docs == 0:
             return [(np.empty(0, dtype=np.float32),
                      np.empty(0, dtype=np.int32))] * len(nodes)
+        if scorer in bm25_ops.LM_SCORERS and idf_of is None:
+            # LM-family weights are collection probabilities, not idf
+            ctf, total = self.index.ctf, float(self.index.total_tokens)
+
+            def idf_of(tids, _ctf=ctf, _tot=total):
+                return bm25_ops.term_weight_for(
+                    scorer, self.num_docs, None, _ctf[tids], _tot)
         store = self._device_store()
         max_b = max(1, self.ACC_ENTRY_CAP // store.ndocs_pad)
         if len(nodes) > max_b:
@@ -345,8 +352,10 @@ class SegmentSearcher:
                     else np.empty(0, dtype=np.int64), req)
                    for tids, req, _, empty in shapes]
         # block-max WAND applies to pure disjunctions whose device top-k is
-        # final (no exact-match mask re-ranking a subset afterwards)
-        prunable = [req == 0 and not needs_mask and not empty
+        # final (no exact-match mask re-ranking a subset afterwards); the
+        # LM scorers don't decompose as w·sat, so their bounds don't hold
+        prunable = [req == 0 and not needs_mask and not empty and
+                    scorer not in bm25_ops.LM_SCORERS
                     for _, req, needs_mask, empty in shapes]
         avgdl = (avgdl_override if avgdl_override is not None
                  else self.index.avgdl)
@@ -380,7 +389,7 @@ class SegmentSearcher:
                 store.block_docs, store.block_tfs, store.norms,
                 jnp.asarray(ints), jnp.asarray(floats), nb, tt,
                 nd_pad, kk, nq, bool(qb.require.any()),
-                K1, B, avgdl, scorer)
+                bm25_ops.scorer_param(scorer, K1), B, avgdl, scorer)
             vals, docs = jax.device_get((vals, docs))
         else:  # every query resolved host-side — skip the dispatch entirely
             vals = np.zeros((nq, kk), dtype=np.float32)
@@ -426,11 +435,16 @@ class SegmentSearcher:
                    scorer: str = "bm25", idf_of=None,
                    avgdl_override=None) -> tuple[np.ndarray, np.ndarray]:
         scores = np.zeros(len(docs), dtype=np.float64)
+        tid_arr = np.asarray(tids, dtype=np.int64)
         if idf_of is not None:
-            idf = idf_of(np.asarray(tids, dtype=np.int64))
+            idf = idf_of(tid_arr)
+        elif scorer in bm25_ops.LM_SCORERS:
+            idf = bm25_ops.term_weight_for(
+                scorer, self.num_docs, None, self.index.ctf[tid_arr],
+                float(self.index.total_tokens))
         else:
             idf = bm25_ops.idf_for(scorer, self.num_docs,
-                                   self.index.doc_freq[np.asarray(tids)])
+                                   self.index.doc_freq[tid_arr])
         dl = self.index.norms[docs].astype(np.float64)
         avgdl = max(avgdl_override if avgdl_override is not None
                     else self.index.avgdl, 1e-9)
@@ -441,11 +455,28 @@ class SegmentSearcher:
             hit = (len(pd) > 0) & (pd[ix] == docs)
             tf = np.where(hit, pt[np.clip(ix, 0, max(len(pd) - 1, 0))],
                           0).astype(np.float64)
+            w = float(idf[qi])
             if scorer == "tfidf":
-                scores += idf[qi] * np.sqrt(tf)
+                scores += w * np.sqrt(tf)
+            elif scorer == "lm_dirichlet":
+                mu = bm25_ops.LM_MU
+                c = np.log1p(tf / (mu * w)) + np.log(mu / (dl + mu))
+                scores += np.where(
+                    tf > 0, np.maximum(c, 0.0) + bm25_ops.MATCH_EPS, 0.0)
+            elif scorer == "jelinek_mercer":
+                lam = bm25_ops.JM_LAMBDA
+                scores += np.log1p(((1 - lam) * tf / np.maximum(dl, 1.0)) /
+                                   (lam * w))
+            elif scorer == "dfi":
+                e = w * dl
+                excess = (tf - e) / np.sqrt(np.maximum(e, 1e-9))
+                scores += np.where(
+                    tf > 0,
+                    np.where(tf > e, np.log2(1.0 + excess), 0.0) +
+                    bm25_ops.MATCH_EPS, 0.0)
             else:
                 denom = tf + K1 * (1 - B + B * dl / avgdl)
-                scores += idf[qi] * (K1 + 1) * tf / np.maximum(denom, 1e-9)
+                scores += w * (K1 + 1) * tf / np.maximum(denom, 1e-9)
         order = np.argsort(-scores, kind="stable")[:k]
         return (scores[order].astype(np.float32),
                 docs[order].astype(np.int32))
@@ -483,6 +514,14 @@ class MultiSearcher:
                 df += int(s.index.doc_freq[tid])
         return df
 
+    def _global_ctf(self, term: str) -> int:
+        ctf = 0
+        for s, _ in self.segments:
+            tid = s.index.term_id(term)
+            if tid >= 0:
+                ctf += int(s.index.ctf[tid])
+        return ctf
+
     def eval_filter(self, node: QNode) -> np.ndarray:
         parts = []
         for s, base in self.segments:
@@ -512,11 +551,22 @@ class MultiSearcher:
                 term_strings.update(str(ts[t])
                                     for t in seg.scoring_terms(node))
         global_df = {s: self._global_df(s) for s in term_strings}
+        lm = scorer in bm25_ops.LM_SCORERS
+        global_ctf = ({s: self._global_ctf(s) for s in term_strings}
+                      if lm else {})
+        total_tokens = (float(sum(s.index.total_tokens
+                                  for s, _ in self.segments)) if lm else 0.0)
         merged: list[list[tuple]] = [[] for _ in nodes]
         for seg, base in self.segments:
             terms_str = seg.index.terms_str
 
             def idf_of(tids, _ts=terms_str):
+                if lm:
+                    ctfs = np.asarray(
+                        [global_ctf[str(_ts[t])] for t in tids],
+                        dtype=np.int64)
+                    return bm25_ops.term_weight_for(
+                        scorer, n_total, None, ctfs, total_tokens)
                 dfs = np.asarray([global_df[str(_ts[t])] for t in tids],
                                  dtype=np.int64)
                 return bm25_ops.idf_for(scorer, n_total, dfs)

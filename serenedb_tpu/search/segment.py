@@ -50,6 +50,22 @@ class FieldIndex:
         return (self.total_tokens / n) if n else 0.0
 
     @property
+    def ctf(self) -> np.ndarray:
+        """Collection term frequency per term (LM-family scorers); lazily
+        reduced over the postings and memoized — segments are immutable."""
+        c = getattr(self, "_ctf", None)
+        if c is None:
+            if len(self.offsets) > 1 and len(self.post_tfs):
+                c = np.add.reduceat(
+                    self.post_tfs.astype(np.int64), self.offsets[:-1])
+                # reduceat repeats values for empty ranges; terms always
+                # have ≥1 posting here, but guard stays cheap
+            else:
+                c = np.zeros(max(len(self.offsets) - 1, 0), dtype=np.int64)
+            self._ctf = c
+        return c
+
+    @property
     def terms_str(self) -> np.ndarray:
         """str-dtype view of the term dictionary, materialized once (term
         lookups are the hot path — no per-query O(T) copies)."""

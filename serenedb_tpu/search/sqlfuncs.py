@@ -20,7 +20,8 @@ from .. import errors
 from ..columnar import dtypes as dt
 from ..columnar.column import Column
 
-_SEARCH_FUNCS = {"ts_match", "bm25", "tfidf", "to_tsquery", "ts_offsets",
+_SEARCH_FUNCS = {"ts_match", "bm25", "tfidf", "lm_dirichlet",
+                 "jelinek_mercer", "dfi", "to_tsquery", "ts_offsets",
                  "ts_headline"}
 
 
@@ -80,7 +81,8 @@ def bind_function(binder, e):
         import dataclasses
         from ..sql import ast as _ast
         return bind_operator(binder, _ast.BinaryOp("@@", e.args[0], e.args[1]))
-    if name in ("bm25", "tfidf"):
+    if name in ("bm25", "tfidf", "lm_dirichlet", "jelinek_mercer",
+                "dfi"):
         # scorer over an indexed scan; meaningful only with pushdown — the
         # optimizer replaces it with the scan's score column. Unpushed use
         # yields 0.0 (reference: unscored context returns default score).

@@ -26,7 +26,8 @@ from ..search.query import QAnd, QNode, QPhrase, QTerm, parse_query
 from .expr import BoundColumn, BoundExpr, BoundFunc, kleene_and
 
 _TS_FUNCS = {"ts_phrase", "ts_query"}
-_SCORER_FUNCS = {"bm25", "tfidf"}
+_SCORER_FUNCS = {"bm25", "tfidf", "lm_dirichlet", "jelinek_mercer",
+                 "dfi"}
 
 
 def rewrite_search(plan: PlanNode) -> PlanNode:
