@@ -1,0 +1,156 @@
+"""PG binary COPY format codec.
+
+Reference analog: server/connector/duckdb_pg_binary_copy.cpp — the
+`PGCOPY\\n\\377\\r\\n\\0` signature, 4-byte flags + extension, per-tuple
+int16 field count and int32-length-prefixed fields in PG binary send
+format, int16 -1 trailer. Value encodings match server/pgwire.pg_binary
+(network byte order; timestamps/dates on the 2000-01-01 PG epoch).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from .. import errors
+from . import dtypes as dt
+from .column import Batch, Column
+
+SIGNATURE = b"PGCOPY\n\xff\r\n\x00"
+
+_PG_EPOCH_US = 946_684_800_000_000
+_PG_EPOCH_DAYS = 10_957
+
+
+_OID_IDS = (dt.TypeId.OID, dt.TypeId.REGCLASS, dt.TypeId.REGTYPE,
+            dt.TypeId.REGPROC, dt.TypeId.REGNAMESPACE)
+
+
+def encode_value(v, typ: dt.SqlType) -> Optional[bytes]:
+    """One field's binary payload (no length prefix); None = NULL.
+    Single source of truth for PG binary sends — the wire result encoder
+    (server/pgwire.pg_binary) delegates here."""
+    if v is None:
+        return None
+    tid = typ.id
+    if tid is dt.TypeId.BOOL:
+        return b"\x01" if v else b"\x00"
+    if tid in (dt.TypeId.TINYINT, dt.TypeId.SMALLINT):
+        return struct.pack("!h", int(v))
+    if tid is dt.TypeId.INT:
+        return struct.pack("!i", int(v))
+    if tid is dt.TypeId.BIGINT:
+        return struct.pack("!q", int(v))
+    if tid is dt.TypeId.FLOAT:
+        return struct.pack("!f", float(v))
+    if tid is dt.TypeId.DOUBLE:
+        return struct.pack("!d", float(v))
+    if tid is dt.TypeId.TIMESTAMP:
+        return struct.pack("!q", int(v) - _PG_EPOCH_US)
+    if tid is dt.TypeId.DATE:
+        return struct.pack("!i", int(v) - _PG_EPOCH_DAYS)
+    if tid is dt.TypeId.INTERVAL:
+        return struct.pack("!qii", int(v), 0, 0)
+    if tid in _OID_IDS:
+        return struct.pack("!I", int(v) & 0xFFFFFFFF)
+    return str(v).encode()
+
+
+def decode_value(raw: bytes, typ: dt.SqlType):
+    tid = typ.id
+    try:
+        if tid is dt.TypeId.BOOL:
+            if len(raw) != 1:
+                raise struct.error("bool is 1 byte")
+            return raw != b"\x00"
+        if tid in (dt.TypeId.TINYINT, dt.TypeId.SMALLINT):
+            return struct.unpack("!h", raw)[0]
+        if tid is dt.TypeId.INT:
+            return struct.unpack("!i", raw)[0]
+        if tid is dt.TypeId.BIGINT:
+            return struct.unpack("!q", raw)[0]
+        if tid is dt.TypeId.FLOAT:
+            return struct.unpack("!f", raw)[0]
+        if tid is dt.TypeId.DOUBLE:
+            return struct.unpack("!d", raw)[0]
+        if tid is dt.TypeId.TIMESTAMP:
+            return struct.unpack("!q", raw)[0] + _PG_EPOCH_US
+        if tid is dt.TypeId.DATE:
+            return struct.unpack("!i", raw)[0] + _PG_EPOCH_DAYS
+        if tid is dt.TypeId.INTERVAL:
+            us, days, months = struct.unpack("!qii", raw)
+            # our intervals are µs-only; days/months fold in at PG's
+            # nominal 24h/30d (the text parser makes the same choice)
+            return us + (days + months * 30) * 86_400_000_000
+        if tid in _OID_IDS:
+            return struct.unpack("!I", raw)[0]
+        return raw.decode("utf-8")
+    except (struct.error, UnicodeDecodeError):
+        raise errors.SqlError(
+            "22P03", f"incorrect binary data format for type {typ}")
+
+
+def header() -> bytes:
+    return SIGNATURE + struct.pack("!II", 0, 0)   # flags, extension length
+
+
+def trailer() -> bytes:
+    return struct.pack("!h", -1)
+
+
+def encode_rows(batch: Batch) -> list[bytes]:
+    """Per-tuple CopyData payloads (header/trailer NOT included)."""
+    types = [c.type for c in batch.columns]
+    cols = [c.to_pylist() for c in batch.columns]
+    n_fields = struct.pack("!h", len(types))
+    out = []
+    for i in range(batch.num_rows):
+        parts = [n_fields]
+        for ci, t in enumerate(types):
+            payload = encode_value(cols[ci][i], t)
+            if payload is None:
+                parts.append(struct.pack("!i", -1))
+            else:
+                parts.append(struct.pack("!i", len(payload)) + payload)
+        out.append(b"".join(parts))
+    return out
+
+
+def decode_stream(data: bytes, types: list[dt.SqlType]) -> list[list]:
+    """Binary COPY payload → per-column python value lists.
+
+    Tolerates the trailer being absent (some clients close the stream
+    instead) but rejects a bad signature or malformed tuples."""
+    if not data.startswith(SIGNATURE):
+        raise errors.SqlError("22P04",
+                              "COPY binary signature not recognized")
+    off = len(SIGNATURE)
+    if off + 8 > len(data):
+        raise errors.SqlError("22P04", "invalid COPY binary header")
+    flags, ext = struct.unpack_from("!II", data, off)
+    off += 8 + ext
+    cols: list[list] = [[] for _ in types]
+    n = len(data)
+    while off + 2 <= n:
+        (nf,) = struct.unpack_from("!h", data, off)
+        off += 2
+        if nf == -1:
+            break                      # trailer
+        if nf != len(types):
+            raise errors.SqlError(
+                "22P04", f"row field count {nf}, expected {len(types)}")
+        for ci in range(nf):
+            if off + 4 > n:
+                raise errors.SqlError("22P04",
+                                      "unexpected EOF in COPY binary data")
+            (ln,) = struct.unpack_from("!i", data, off)
+            off += 4
+            if ln < 0:
+                cols[ci].append(None)
+                continue
+            if off + ln > n:
+                raise errors.SqlError("22P04",
+                                      "unexpected EOF in COPY binary data")
+            cols[ci].append(decode_value(data[off:off + ln], types[ci]))
+            off += ln
+    return cols

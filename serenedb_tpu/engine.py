@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import re
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
@@ -806,13 +805,12 @@ class Connection:
             return QueryResult(Batch([], []), "CREATE SCHEMA")
         if isinstance(st, ast.CreateView):
             schema, name = self.db._split(st.name)
-            src = getattr(st, "source_sql", None) or sql_text or ""
             # store the SELECT body: pg_get_viewdef/pg_views.definition
             # return the query, not the CREATE statement (PG semantics —
-            # tools wrap it in their own CREATE VIEW)
-            m = re.match(r"(?is)\s*CREATE\s+(?:OR\s+REPLACE\s+)?VIEW\s+"
-                         r".*?\s+AS\s+(.*)$", src)
-            body = m.group(1).strip() if m else src
+            # tools wrap it in their own CREATE VIEW). body_sql is sliced
+            # from token positions by the parser.
+            body = (getattr(st, "body_sql", None) or
+                    getattr(st, "source_sql", None) or sql_text or "")
             self.db.create_view(schema, name,
                                 ViewDef(name, st.query, body),
                                 st.or_replace)
@@ -1766,6 +1764,13 @@ class Connection:
         with _progress.track("COPY TO", full.num_rows):
             if fmt == "parquet":
                 _write_parquet(st.target, full)
+            elif fmt == "binary":
+                from .columnar import pgcopy
+                with open(st.target, "wb") as f:
+                    f.write(pgcopy.header())
+                    for row in pgcopy.encode_rows(full):
+                        f.write(row)
+                    f.write(pgcopy.trailer())
             else:
                 _write_csv(st.target, full, st.options)
         return QueryResult(Batch([], []), f"COPY {full.num_rows}")
@@ -1779,6 +1784,17 @@ class Connection:
                 raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                       f'column "{c}" does not exist')
         fmt = str(st.options.get("format", "text")).lower()
+        target_names_b = st.columns or list(table.column_names)
+        if fmt == "binary":
+            from .columnar import pgcopy
+            types_b = [table.column_types[table.column_names.index(c)]
+                       for c in target_names_b]
+            cols_b = pgcopy.decode_stream(data, types_b)
+            incoming = Batch(list(target_names_b),
+                             [Column.from_pylist(v, t)
+                              for v, t in zip(cols_b, types_b)])
+            self._insert_batch(table, incoming)
+            return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
         delim = str(st.options.get("delimiter",
                                    "," if fmt == "csv" else "\t"))
         null_s = str(st.options.get("null", "" if fmt == "csv" else "\\N"))
@@ -1836,8 +1852,13 @@ class Connection:
         if self.in_txn:
             provider = self._txn_read_provider(provider)
         full = provider.full_batch(st.columns)
-        cols = [c.to_pylist() for c in full.columns]
         fmt = str(st.options.get("format", "text")).lower()
+        if fmt == "binary":
+            from .columnar import pgcopy
+            rows = ([pgcopy.header()] + pgcopy.encode_rows(full) +
+                    [pgcopy.trailer()])
+            return rows, full.num_rows
+        cols = [c.to_pylist() for c in full.columns]
         if fmt == "csv":
             import csv as _csv
             import io as _io
@@ -1869,8 +1890,21 @@ class Connection:
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
+        for c in st.columns or []:
+            if c not in table.column_names:
+                raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                      f'column "{c}" does not exist')
         if fmt == "parquet":
             incoming = ParquetTable(st.target).full_batch()
+        elif fmt == "binary":
+            from .columnar import pgcopy
+            names = st.columns or list(table.column_names)
+            types = [table.column_types[table.column_names.index(c)]
+                     for c in names]
+            with open(st.target, "rb") as f:
+                cols = pgcopy.decode_stream(f.read(), types)
+            incoming = Batch(names, [Column.from_pylist(v, t)
+                                     for v, t in zip(cols, types)])
         elif fmt in ("csv", "text"):
             incoming = _read_csv(st.target, table, st.options)
         else:

@@ -89,12 +89,6 @@ def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:
     return str(value).encode()
 
 
-# PG binary format epochs: timestamps are µs and dates are days since
-# 2000-01-01, vs our unix-epoch internals
-_PG_EPOCH_US = 946_684_800_000_000
-_PG_EPOCH_DAYS = 10_957
-
-
 def _fmt_for(fmts, i: int) -> int:
     """Result-format code for column i (PG Bind semantics: none = all
     text, one = applies to every column, else positional)."""
@@ -107,36 +101,10 @@ def _fmt_for(fmts, i: int) -> int:
 
 def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
     """PG binary-format encoding for result columns (reference:
-    server/pg/serialize.cpp binary send functions). Types without a
-    defined binary send here fall back to their text bytes, matching the
-    OID we report (25/text) for them."""
-    if value is None:
-        return None
-    tid = typ.id
-    if tid is dt.TypeId.BOOL:
-        return b"\x01" if value else b"\x00"
-    if tid in (dt.TypeId.TINYINT, dt.TypeId.SMALLINT):
-        return struct.pack("!h", int(value))
-    if tid is dt.TypeId.INT:
-        return struct.pack("!i", int(value))
-    if tid is dt.TypeId.BIGINT:
-        return struct.pack("!q", int(value))
-    if tid is dt.TypeId.FLOAT:
-        return struct.pack("!f", float(value))
-    if tid is dt.TypeId.DOUBLE:
-        return struct.pack("!d", float(value))
-    if tid is dt.TypeId.TIMESTAMP:
-        return struct.pack("!q", int(value) - _PG_EPOCH_US)
-    if tid is dt.TypeId.DATE:
-        return struct.pack("!i", int(value) - _PG_EPOCH_DAYS)
-    if tid is dt.TypeId.INTERVAL:
-        # PG binary interval: (µs int64, days int32, months int32); ours
-        # is µs-only, semantically equal for fixed-unit intervals
-        return struct.pack("!qii", int(value), 0, 0)
-    if tid in (dt.TypeId.OID, dt.TypeId.REGCLASS, dt.TypeId.REGTYPE,
-               dt.TypeId.REGPROC, dt.TypeId.REGNAMESPACE):
-        return struct.pack("!I", int(value) & 0xFFFFFFFF)
-    return pg_text(value, typ)
+    server/pg/serialize.cpp binary send functions). Delegates to the
+    shared COPY codec — one source of truth for binary sends."""
+    from ..columnar.pgcopy import encode_value
+    return encode_value(value, typ)
 
 
 class Writer:
@@ -570,11 +538,13 @@ class PgSession:
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         loop = asyncio.get_running_loop()
+        is_bin = str(st.options.get("format", "")).lower() == "binary"
+        ov_fmt = 1 if is_bin else 0
         if st.direction == "from":
             ncols = len(st.columns) if st.columns else \
                 len(self.conn.db.resolve_table(st.table).column_names)
-            self.w.msg(b"G", struct.pack("!bH", 0, ncols) +
-                       struct.pack("!h", 0) * ncols)
+            self.w.msg(b"G", struct.pack("!bH", ov_fmt, ncols) +
+                       struct.pack("!h", ov_fmt) * ncols)
             await self.w.flush()
             chunks = []
             failed = None
@@ -603,8 +573,8 @@ class PgSession:
             self.server.pool, self.conn.copy_out_data, st)
         ncols = len(st.columns) if st.columns else \
             len(self.conn.db.resolve_table(st.table).column_names)
-        self.w.msg(b"H", struct.pack("!bH", 0, ncols) +
-                   struct.pack("!h", 0) * ncols)
+        self.w.msg(b"H", struct.pack("!bH", ov_fmt, ncols) +
+                   struct.pack("!h", ov_fmt) * ncols)
         for row in rows:
             self.w.msg(b"d", row)
         self.w.msg(b"c")

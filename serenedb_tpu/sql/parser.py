@@ -95,6 +95,8 @@ class Parser:
                    else len(self.sql))
             # per-statement source slice (view definitions, pg_stat_activity)
             st.source_sql = self.sql[start:end].rstrip().rstrip(";")
+            if getattr(st, "body_pos", None) is not None:
+                st.body_sql = self.sql[st.body_pos:end].rstrip().rstrip(";")
             stmts.append(st)
             if self.peek().kind is not T.EOF:
                 self.expect_op(";")
@@ -816,7 +818,11 @@ class Parser:
         if self.accept_kw("VIEW"):
             name = self.qualified_name()
             self.expect_kw("AS")
-            return ast.CreateView(name, self.parse_select(), or_replace)
+            body_pos = self.peek().pos   # token-accurate body start —
+            # quoted identifiers containing ' as ' can't fool this
+            st = ast.CreateView(name, self.parse_select(), or_replace)
+            st.body_pos = body_pos
+            return st
         if self.accept_kw("INDEX"):
             ine = self._if_not_exists()
             idx_name = None
