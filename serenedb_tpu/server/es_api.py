@@ -774,6 +774,49 @@ class EsApi:
         if kind == "ids":
             lits = ", ".join(_sql_lit(v) for v in body.get("values", []))
             return f'"_id" IN ({lits})'
+        if kind == "geo_bounding_box":
+            field, spec = _geo_field(kind, body)
+            tl = _es_point(spec.get("top_left"))
+            br = _es_point(spec.get("bottom_right"))
+            left, top = tl
+            right, bottom = br
+            poly = (f"POLYGON(({left!r} {bottom!r}, {right!r} {bottom!r}, "
+                    f"{right!r} {top!r}, {left!r} {top!r}, "
+                    f"{left!r} {bottom!r}))")
+            return f'ST_Contains({_sql_str(poly)}, {_ident(field)})'
+        if kind == "geo_distance":
+            dist_m = _es_distance_m(body.get("distance"))
+            field, origin = _geo_field(kind, body, extra=("distance",))
+            lon, lat = _es_point(origin)
+            pt = f"POINT({lon!r} {lat!r})"
+            return (f'ST_DWithin({_ident(field)}, {_sql_str(pt)}, '
+                    f'{dist_m!r})')
+        if kind == "geo_polygon":
+            field, spec = _geo_field(kind, body)
+            pts = [_es_point(p) for p in spec.get("points", [])]
+            if len(pts) < 3:
+                raise EsError(400, "parsing_exception",
+                              "geo_polygon needs at least 3 points")
+            if pts[0] != pts[-1]:
+                pts.append(pts[0])
+            ring = ", ".join(f"{lon!r} {lat!r}" for lon, lat in pts)
+            return f'ST_Contains({_sql_str(f"POLYGON(({ring}))")}, ' \
+                   f'{_ident(field)})'
+        if kind == "geo_shape":
+            field, spec = _geo_field(kind, body)
+            shape = spec.get("shape") if isinstance(spec, dict) else None
+            if shape is None:
+                raise EsError(400, "parsing_exception",
+                              "geo_shape requires a shape")
+            relation = str(spec.get("relation", "intersects")).lower()
+            fn = {"intersects": "ST_Intersects", "within": "ST_Within",
+                  "contains": "ST_Contains",
+                  "disjoint": "ST_Disjoint"}.get(relation)
+            if fn is None:
+                raise EsError(400, "parsing_exception",
+                              f"unknown geo_shape relation [{relation}]")
+            return (f'{fn}({_ident(field)}, '
+                    f'{_sql_str(json.dumps(shape))})')
         raise EsError(400, "parsing_exception",
                       f"unsupported query type [{kind}]")
 
@@ -782,6 +825,66 @@ def _as_list(v) -> list:
     if v is None:
         return []
     return v if isinstance(v, list) else [v]
+
+
+_GEO_OPTION_KEYS = ("validation_method", "ignore_unmapped", "_name",
+                    "boost", "distance_type")
+
+
+def _geo_field(kind: str, body: dict, extra: tuple = ()) -> tuple:
+    """The (field, spec) pair of a geo query, skipping ES option keys;
+    missing/ambiguous field answers parsing_exception, not a 500."""
+    if not isinstance(body, dict):
+        raise EsError(400, "parsing_exception", f"malformed {kind}")
+    fields = [(k, v) for k, v in body.items()
+              if k not in _GEO_OPTION_KEYS and k not in extra]
+    if len(fields) != 1:
+        raise EsError(400, "parsing_exception",
+                      f"{kind} requires exactly one field")
+    field, spec = fields[0]
+    if kind != "geo_distance" and not isinstance(spec, dict):
+        raise EsError(400, "parsing_exception", f"malformed {kind}")
+    return field, spec
+
+
+def _es_point(v) -> tuple:
+    """ES point input ({'lat','lon'} / [lon,lat] / 'lat,lon' / WKT /
+    geohash-free subset) → (lon, lat)."""
+    from ..geo.shapes import parse_any
+    try:
+        g = parse_any(v)
+    except Exception:
+        raise EsError(400, "parsing_exception", f"invalid point {v!r}")
+    if g.kind != "point":
+        raise EsError(400, "parsing_exception", "expected a point")
+    return g.coords
+
+
+_DIST_UNITS_M = {
+    "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+    "in": 0.0254, "ft": 0.3048, "yd": 0.9144, "mi": 1609.344,
+    "nmi": 1852.0, "nauticalmiles": 1852.0, "meters": 1.0,
+    "kilometers": 1000.0, "miles": 1609.344, "feet": 0.3048,
+    "yards": 0.9144, "inches": 0.0254,
+}
+
+
+def _es_distance_m(v) -> float:
+    """'200km' / '1.5mi' / numeric meters → meters."""
+    if v is None:
+        raise EsError(400, "parsing_exception",
+                      "geo_distance requires a distance")
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.match(r"^\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*$", str(v))
+    if not m:
+        raise EsError(400, "parsing_exception", f"invalid distance {v!r}")
+    unit = m.group(2).lower() or "m"
+    scale = _DIST_UNITS_M.get(unit)
+    if scale is None:
+        raise EsError(400, "parsing_exception",
+                      f"unknown distance unit [{unit}]")
+    return float(m.group(1)) * scale
 
 
 def _hits_response(hits: list[dict], total: int) -> dict:

@@ -1,0 +1,323 @@
+"""Geo shapes: WKT/WKB/GeoJSON codecs, predicates, measures, SQL ST_*
+functions, and ES geo queries (reference parity: libs/geo/)."""
+
+import json
+import math
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+from serenedb_tpu.geo import ops as geo_ops
+from serenedb_tpu.geo import shapes as gs
+
+
+# -- codecs ----------------------------------------------------------------
+
+WKT_SAMPLES = [
+    "POINT(1.0 2.0)",
+    "LINESTRING(0.0 0.0, 1.0 1.0, 2.0 0.0)",
+    "POLYGON((0.0 0.0, 10.0 0.0, 10.0 10.0, 0.0 10.0, 0.0 0.0))",
+    "POLYGON((0.0 0.0, 10.0 0.0, 10.0 10.0, 0.0 10.0, 0.0 0.0), "
+    "(4.0 4.0, 6.0 4.0, 6.0 6.0, 4.0 6.0, 4.0 4.0))",
+    "MULTIPOINT(1.0 1.0, 2.0 2.0)",
+    "MULTILINESTRING((0.0 0.0, 1.0 1.0), (2.0 2.0, 3.0 3.0))",
+    "MULTIPOLYGON(((0.0 0.0, 1.0 0.0, 1.0 1.0, 0.0 0.0)), "
+    "((5.0 5.0, 6.0 5.0, 6.0 6.0, 5.0 5.0)))",
+    "GEOMETRYCOLLECTION(POINT(1.0 2.0), LINESTRING(0.0 0.0, 1.0 1.0))",
+]
+
+
+@pytest.mark.parametrize("wkt", WKT_SAMPLES)
+def test_wkt_roundtrip(wkt):
+    assert gs.to_wkt(gs.from_wkt(wkt)) == wkt
+
+
+@pytest.mark.parametrize("wkt", WKT_SAMPLES)
+def test_wkb_roundtrip(wkt):
+    g = gs.from_wkt(wkt)
+    assert gs.to_wkt(gs.from_wkb(gs.to_wkb(g))) == wkt
+
+
+@pytest.mark.parametrize("wkt", WKT_SAMPLES)
+def test_geojson_roundtrip(wkt):
+    g = gs.from_wkt(wkt)
+    assert gs.to_wkt(gs.from_geojson(gs.to_geojson(g))) == wkt
+
+
+def test_wkt_forgiving_forms():
+    assert gs.from_wkt("point ( 1 2 )").coords == (1.0, 2.0)
+    assert gs.from_wkt("MULTIPOINT((1 2), (3 4))").coords == \
+        [(1.0, 2.0), (3.0, 4.0)]
+    assert gs.from_wkt("POINT EMPTY").coords == ()
+    with pytest.raises(SqlError):
+        gs.from_wkt("CIRCLE(1 2, 3)")
+    with pytest.raises(SqlError):
+        gs.from_wkt("POINT(1)")
+
+
+def test_wkb_big_endian_and_ewkb_srid():
+    import struct
+    # big-endian point
+    be = b"\x00" + struct.pack(">I", 1) + struct.pack(">dd", 3.0, 4.0)
+    assert gs.from_wkb(be).coords == (3.0, 4.0)
+    # EWKB with SRID flag
+    ewkb = b"\x01" + struct.pack("<I", 1 | 0x20000000) + \
+        struct.pack("<I", 4326) + struct.pack("<dd", 1.0, 2.0)
+    assert gs.from_wkb(ewkb).coords == (1.0, 2.0)
+    with pytest.raises(SqlError):
+        gs.from_wkb(b"\x01\x63\x00\x00\x00")
+
+
+def test_parse_any_es_formats():
+    assert gs.parse_any({"lat": 40.7, "lon": -74.0}).coords == (-74.0, 40.7)
+    assert gs.parse_any("40.7, -74.0").coords == (-74.0, 40.7)
+    assert gs.parse_any("[-74.0, 40.7]").coords == (-74.0, 40.7)
+
+
+# -- predicates ------------------------------------------------------------
+
+SQUARE = gs.from_wkt("POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))")
+DONUT = gs.from_wkt("POLYGON((0 0, 10 0, 10 10, 0 10, 0 0), "
+                    "(4 4, 6 4, 6 6, 4 6, 4 4))")
+
+
+def test_point_in_polygon():
+    assert geo_ops.contains(SQUARE, gs.from_wkt("POINT(5 5)"))
+    assert not geo_ops.contains(SQUARE, gs.from_wkt("POINT(15 5)"))
+    # boundary counts as inside (ST_Covers semantics)
+    assert geo_ops.contains(SQUARE, gs.from_wkt("POINT(0 5)"))
+    # inside the hole is outside the donut
+    assert not geo_ops.contains(DONUT, gs.from_wkt("POINT(5 5)"))
+    assert geo_ops.contains(DONUT, gs.from_wkt("POINT(2 2)"))
+
+
+def test_polygon_contains_shapes():
+    assert geo_ops.contains(
+        SQUARE, gs.from_wkt("LINESTRING(1 1, 9 9)"))
+    assert not geo_ops.contains(
+        SQUARE, gs.from_wkt("LINESTRING(5 5, 15 5)"))
+    assert geo_ops.contains(
+        SQUARE, gs.from_wkt("POLYGON((1 1, 9 1, 9 9, 1 9, 1 1))"))
+    assert not geo_ops.contains(
+        SQUARE, gs.from_wkt("POLYGON((5 5, 15 5, 15 15, 5 15, 5 5))"))
+    # both endpoints inside but the segment crosses the hole: not contained
+    assert not geo_ops.contains(
+        DONUT, gs.from_wkt("LINESTRING(2 5, 8 5)"))
+
+
+def test_intersects():
+    assert geo_ops.intersects(gs.from_wkt("LINESTRING(0 0, 10 10)"),
+                              gs.from_wkt("LINESTRING(0 10, 10 0)"))
+    assert not geo_ops.intersects(gs.from_wkt("LINESTRING(0 0, 1 1)"),
+                                  gs.from_wkt("LINESTRING(2 2, 3 3)"))
+    assert geo_ops.intersects(SQUARE, gs.from_wkt(
+        "POLYGON((5 5, 15 5, 15 15, 5 15, 5 5))"))
+    assert geo_ops.intersects(SQUARE, gs.from_wkt("POINT(10 10)"))
+    # polygon fully inside another intersects
+    assert geo_ops.intersects(
+        SQUARE, gs.from_wkt("POLYGON((1 1, 2 1, 2 2, 1 1))"))
+
+
+# -- measures --------------------------------------------------------------
+
+def test_distance_and_length():
+    # one degree of latitude ≈ 111.2 km
+    d = geo_ops.distance_m(gs.from_wkt("POINT(0 0)"),
+                           gs.from_wkt("POINT(0 1)"))
+    assert d == pytest.approx(111195, rel=1e-3)
+    # point to segment: closest approach, not vertex distance
+    d = geo_ops.distance_m(gs.from_wkt("POINT(5 1)"),
+                           gs.from_wkt("LINESTRING(0 0, 10 0)"))
+    assert d == pytest.approx(111195, rel=1e-2)
+    d = geo_ops.distance_m(gs.from_wkt("POINT(5 5)"), SQUARE)
+    assert d == 0.0
+    ln = geo_ops.length_m(gs.from_wkt("LINESTRING(0 0, 0 1, 0 2)"))
+    assert ln == pytest.approx(2 * 111195, rel=1e-3)
+
+
+def test_area():
+    # 1°×1° at the equator ≈ 12,364 km²
+    a = geo_ops.area_m2(gs.from_wkt("POLYGON((0 0, 1 0, 1 1, 0 1, 0 0))"))
+    assert a == pytest.approx(12364e6, rel=2e-2)
+    # donut area = outer − hole
+    outer = geo_ops.area_m2(SQUARE)
+    donut = geo_ops.area_m2(DONUT)
+    hole = geo_ops.area_m2(gs.from_wkt(
+        "POLYGON((4 4, 6 4, 6 6, 4 6, 4 4))"))
+    assert donut == pytest.approx(outer - hole, rel=1e-6)
+
+
+# -- SQL surface -----------------------------------------------------------
+
+@pytest.fixture
+def conn():
+    return Database().connect()
+
+
+def test_sql_st_functions(conn):
+    assert conn.execute(
+        "SELECT ST_Contains('POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))', "
+        "'POINT(5 5)')").scalar() is True
+    assert conn.execute(
+        "SELECT ST_Within('POINT(5 5)', "
+        "'POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))')").scalar() is True
+    assert conn.execute(
+        "SELECT ST_Disjoint('POINT(50 50)', "
+        "'POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))')").scalar() is True
+    assert conn.execute(
+        "SELECT ST_DWithin('POINT(0 0)', 'POINT(0 1)', 120000)"
+    ).scalar() is True
+    assert conn.execute(
+        "SELECT ST_DWithin('POINT(0 0)', 'POINT(0 1)', 100000)"
+    ).scalar() is False
+    assert conn.execute(
+        "SELECT ST_GeometryType('LINESTRING(0 0, 1 1)')"
+    ).scalar() == "ST_LineString"
+    assert conn.execute(
+        "SELECT ST_NPoints('POLYGON((0 0, 1 0, 1 1, 0 0))')"
+    ).scalar() == 4
+    assert conn.execute(
+        "SELECT ST_Centroid('POLYGON((0 0, 2 0, 2 2, 0 2, 0 0))')"
+    ).scalar() == "POINT(1.0 1.0)"
+    assert conn.execute(
+        "SELECT ST_Envelope('LINESTRING(0 0, 3 4)')"
+    ).scalar() == "POLYGON((0.0 0.0, 3.0 0.0, 3.0 4.0, 0.0 4.0, 0.0 0.0))"
+    j = json.loads(conn.execute(
+        "SELECT ST_AsGeoJSON('POINT(1 2)')").scalar())
+    assert j == {"type": "Point", "coordinates": [1.0, 2.0]}
+    # WKB hex round trip through SQL
+    assert conn.execute(
+        "SELECT ST_GeomFromWKB(ST_AsBinary('POINT(3 4)'))"
+    ).scalar() == "POINT(3.0 4.0)"
+    # geometry column filters
+    conn.execute("CREATE TABLE places (name TEXT, geom TEXT)")
+    conn.execute("INSERT INTO places VALUES "
+                 "('in', 'POINT(5 5)'), ('out', 'POINT(50 50)'), "
+                 "('edge', 'POINT(10 5)')")
+    rows = conn.execute(
+        "SELECT name FROM places WHERE ST_Contains("
+        "'POLYGON((0 0, 10 0, 10 10, 0 10, 0 0))', geom) "
+        "ORDER BY name").rows()
+    assert rows == [("edge",), ("in",)]
+
+
+def test_sql_errors(conn):
+    with pytest.raises(SqlError):
+        conn.execute("SELECT ST_Contains('NOT A SHAPE', 'POINT(1 1)')")
+
+
+# -- ES geo queries --------------------------------------------------------
+
+def _es_server():
+    from serenedb_tpu.server.http_server import HttpServer
+    db = Database()
+    s = HttpServer(db, port=0)
+    s.start()
+    return s
+
+
+def _req(srv, method, path, body=None):
+    import urllib.request
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data,
+        headers={"Content-Type": "application/json"}, method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except Exception as e:
+        import urllib.error
+        if isinstance(e, urllib.error.HTTPError):
+            return e.code, json.loads(e.read().decode())
+        raise
+
+
+@pytest.fixture(scope="module")
+def es():
+    srv = _es_server()
+    _req(srv, "PUT", "/shops")
+    docs = [
+        ("1", {"name": "downtown", "location": [-73.99, 40.72]}),
+        ("2", {"name": "uptown", "location": [-73.95, 40.80]}),
+        ("3", {"name": "far", "location": [-118.24, 34.05]}),
+    ]
+    for _id, d in docs:
+        _req(srv, "PUT", f"/shops/_doc/{_id}", d)
+    yield srv
+    srv.stop()
+
+
+def test_es_geo_bounding_box(es):
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_bounding_box": {"location": {
+            "top_left": {"lat": 40.9, "lon": -74.1},
+            "bottom_right": {"lat": 40.6, "lon": -73.9}}}}})
+    assert status == 200
+    ids = {h["_id"] for h in body["hits"]["hits"]}
+    assert ids == {"1", "2"}
+
+
+def test_es_geo_distance(es):
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_distance": {
+            "distance": "10km",
+            "location": {"lat": 40.72, "lon": -73.99}}}})
+    assert status == 200
+    ids = {h["_id"] for h in body["hits"]["hits"]}
+    assert ids == {"1", "2"}
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_distance": {
+            "distance": "1km",
+            "location": {"lat": 40.72, "lon": -73.99}}}})
+    assert {h["_id"] for h in body["hits"]["hits"]} == {"1"}
+
+
+def test_es_geo_polygon(es):
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_polygon": {"location": {"points": [
+            {"lat": 40.6, "lon": -74.1}, {"lat": 40.9, "lon": -74.1},
+            {"lat": 40.9, "lon": -73.9}, {"lat": 40.6, "lon": -73.9}]}}}})
+    assert status == 200
+    assert {h["_id"] for h in body["hits"]["hits"]} == {"1", "2"}
+
+
+def test_es_geo_shape(es):
+    shape = {"type": "Polygon", "coordinates": [[
+        [-74.1, 40.6], [-73.9, 40.6], [-73.9, 40.9], [-74.1, 40.9],
+        [-74.1, 40.6]]]}
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_shape": {"location": {
+            "shape": shape, "relation": "within"}}}})
+    assert status == 200
+    assert {h["_id"] for h in body["hits"]["hits"]} == {"1", "2"}
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_shape": {"location": {
+            "shape": shape, "relation": "bogus"}}}})
+    assert status == 400
+
+
+def test_es_bad_geo_inputs(es):
+    status, _ = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_distance": {"distance": "10 parsecs",
+                                   "location": [0, 0]}}})
+    assert status == 400
+
+
+def test_es_geo_option_keys_tolerated(es):
+    # ES option keys must not be mistaken for the field
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_bounding_box": {
+            "validation_method": "STRICT",
+            "location": {"top_left": {"lat": 40.9, "lon": -74.1},
+                         "bottom_right": {"lat": 40.6, "lon": -73.9}}}}})
+    assert status == 200
+    assert {h["_id"] for h in body["hits"]["hits"]} == {"1", "2"}
+    status, body = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_distance": {"distance": "10km", "boost": 2.0,
+                                   "location": [-73.99, 40.72]}}})
+    assert status == 200
+    # empty body → 400, not a 500
+    status, _ = _req(es, "POST", "/shops/_search", {
+        "query": {"geo_bounding_box": {}}})
+    assert status == 400
