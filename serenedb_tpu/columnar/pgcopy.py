@@ -116,6 +116,18 @@ def encode_rows(batch: Batch) -> list[bytes]:
     return out
 
 
+def decode_to_batch(data: bytes, names: list, types: list) -> Batch:
+    """Binary COPY payload → Batch with the given column names/types."""
+    cols = decode_stream(data, types)
+    return Batch(list(names), [Column.from_pylist(v, t)
+                               for v, t in zip(cols, types)])
+
+
+def encode_full(batch: Batch) -> list[bytes]:
+    """header + per-tuple payloads + trailer, ready to stream/write."""
+    return [header()] + encode_rows(batch) + [trailer()]
+
+
 def decode_stream(data: bytes, types: list[dt.SqlType]) -> list[list]:
     """Binary COPY payload → per-column python value lists.
 

@@ -1767,10 +1767,8 @@ class Connection:
             elif fmt == "binary":
                 from .columnar import pgcopy
                 with open(st.target, "wb") as f:
-                    f.write(pgcopy.header())
-                    for row in pgcopy.encode_rows(full):
-                        f.write(row)
-                    f.write(pgcopy.trailer())
+                    for chunk in pgcopy.encode_full(full):
+                        f.write(chunk)
             else:
                 _write_csv(st.target, full, st.options)
         return QueryResult(Batch([], []), f"COPY {full.num_rows}")
@@ -1784,23 +1782,17 @@ class Connection:
                 raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                       f'column "{c}" does not exist')
         fmt = str(st.options.get("format", "text")).lower()
-        target_names_b = st.columns or list(table.column_names)
+        target_names = st.columns or list(table.column_names)
+        types = [table.column_types[table.column_names.index(c)]
+                 for c in target_names]
         if fmt == "binary":
             from .columnar import pgcopy
-            types_b = [table.column_types[table.column_names.index(c)]
-                       for c in target_names_b]
-            cols_b = pgcopy.decode_stream(data, types_b)
-            incoming = Batch(list(target_names_b),
-                             [Column.from_pylist(v, t)
-                              for v, t in zip(cols_b, types_b)])
+            incoming = pgcopy.decode_to_batch(data, target_names, types)
             self._insert_batch(table, incoming)
             return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
         delim = str(st.options.get("delimiter",
                                    "," if fmt == "csv" else "\t"))
         null_s = str(st.options.get("null", "" if fmt == "csv" else "\\N"))
-        target_names = st.columns or list(table.column_names)
-        types = [table.column_types[table.column_names.index(c)]
-                 for c in target_names]
         text = data.decode("utf-8")
         rows = []
         is_csv = fmt == "csv"
@@ -1855,9 +1847,7 @@ class Connection:
         fmt = str(st.options.get("format", "text")).lower()
         if fmt == "binary":
             from .columnar import pgcopy
-            rows = ([pgcopy.header()] + pgcopy.encode_rows(full) +
-                    [pgcopy.trailer()])
-            return rows, full.num_rows
+            return pgcopy.encode_full(full), full.num_rows
         cols = [c.to_pylist() for c in full.columns]
         if fmt == "csv":
             import csv as _csv
@@ -1890,30 +1880,40 @@ class Connection:
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
+        seen = set()
         for c in st.columns or []:
             if c not in table.column_names:
                 raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                       f'column "{c}" does not exist')
+            if c in seen:
+                raise errors.SqlError(
+                    "42701", f'column "{c}" specified more than once')
+            seen.add(c)
+        names = st.columns or list(table.column_names)
+        types = [table.column_types[table.column_names.index(c)]
+                 for c in names]
         if fmt == "parquet":
-            incoming = ParquetTable(st.target).full_batch()
+            # parquet files carry column names: select by NAME so a
+            # column-list subset maps correctly, never positionally
+            full = ParquetTable(st.target).full_batch()
+            missing = [c for c in names if c not in full]
+            if missing:
+                raise errors.SqlError(
+                    errors.UNDEFINED_COLUMN,
+                    f'column "{missing[0]}" not present in {st.target}')
+            sub = Batch(names, [full.column(c) for c in names])
         elif fmt == "binary":
             from .columnar import pgcopy
-            names = st.columns or list(table.column_names)
-            types = [table.column_types[table.column_names.index(c)]
-                     for c in names]
             with open(st.target, "rb") as f:
-                cols = pgcopy.decode_stream(f.read(), types)
-            incoming = Batch(names, [Column.from_pylist(v, t)
-                                     for v, t in zip(cols, types)])
+                sub = pgcopy.decode_to_batch(f.read(), names, types)
         elif fmt in ("csv", "text"):
-            incoming = _read_csv(st.target, table, st.options)
+            # csv/text files are headerless positional data over exactly
+            # the listed columns (PG COPY semantics)
+            sub = _read_csv(st.target, names, types, st.options)
         else:
             raise errors.unsupported(f"COPY format {fmt}")
-        names = st.columns or list(incoming.names)
-        sub = Batch(names, [incoming.columns[i]
-                            for i in range(len(names))])
         self._insert_batch(table, sub)
-        return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
+        return QueryResult(Batch([], []), f"COPY {sub.num_rows}")
 
     def _describe_returning(self, st, params: list):
         """(names, types) of a DML RETURNING clause without executing —
@@ -2156,7 +2156,7 @@ def _inline_view(sel: ast.Select, view: ViewDef) -> ast.Select:
     return sel2
 
 
-def _read_csv(path: str, table: MemTable, options: dict) -> Batch:
+def _read_csv(path: str, names: list, types: list, options: dict) -> Batch:
     import csv as _csv
     delim = str(options.get("delimiter", ","))
     header = str(options.get("header", "false")).lower() in ("true", "on", "1")
@@ -2164,9 +2164,8 @@ def _read_csv(path: str, table: MemTable, options: dict) -> Batch:
         rows = list(_csv.reader(f, delimiter=delim))
     if header and rows:
         rows = rows[1:]
-    names = table.column_names
     cols = []
-    for k, (nm, t) in enumerate(zip(names, table.column_types)):
+    for k, (nm, t) in enumerate(zip(names, types)):
         vals = []
         for r in rows:
             raw = r[k] if k < len(r) else ""

@@ -1721,3 +1721,8 @@ def _json_object_keys(ts):
 # PG system/introspection functions register themselves on import (kept in
 # a separate module so the catalog surface doesn't bloat this file)
 from . import pgsys  # noqa: E402,F401  (registration side effects)
+# Geo shape functions (WKT/WKB/GeoJSON, predicates, measures) — same
+# registration-on-import pattern
+from . import geofns  # noqa: E402,F401  (registration side effects)
+# Embedding provider layer (ai_embed + secrets)
+from . import embedfns  # noqa: E402,F401  (registration side effects)

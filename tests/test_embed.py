@@ -1,0 +1,69 @@
+"""ai_embed provider layer (reference: connector/functions/embedding/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+from serenedb_tpu.functions.embedfns import local_embed
+
+
+@pytest.fixture
+def conn():
+    return Database().connect()
+
+
+def test_local_embed_deterministic_and_normalized():
+    a = local_embed("the quick brown fox", 64)
+    b = local_embed("the quick brown fox", 64)
+    c = local_embed("a completely different text", 64)
+    assert np.allclose(a, b)
+    assert not np.allclose(a, c)
+    assert np.linalg.norm(a) == pytest.approx(1.0)
+    # similar texts are closer than dissimilar ones
+    d = local_embed("the quick brown foxes", 64)
+    assert a @ d > a @ c
+
+
+def test_sql_ai_embed_default_and_dim(conn):
+    v = json.loads(conn.execute("SELECT ai_embed('hello world')").scalar())
+    assert len(v) == 64
+    v = json.loads(conn.execute(
+        "SELECT ai_embed('hello world', 'local:128')").scalar())
+    assert len(v) == 128
+    assert conn.execute("SELECT ai_embed(NULL)").scalar() is None
+    with pytest.raises(SqlError):
+        conn.execute("SELECT ai_embed('x', 'local:99999')")
+    with pytest.raises(SqlError):
+        conn.execute("SELECT ai_embed('x', 'quantum:q1')")
+
+
+def test_ai_embed_feeds_vector_ops(conn):
+    sim = conn.execute(
+        "SELECT vec_cos(ai_embed('database search engine'), "
+        "ai_embed('database search engines'))").scalar()
+    far = conn.execute(
+        "SELECT vec_cos(ai_embed('database search engine'), "
+        "ai_embed('grilled cheese recipe'))").scalar()
+    assert sim < far   # cosine DISTANCE: similar pair is closer
+
+
+def test_remote_provider_gating(conn):
+    # no secret → clear error, no network attempt
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT ai_embed('x', 'openai:text-embedding-3-small', "
+                     "'nope')")
+    assert "secret" in str(e.value)
+    # missing secret arg
+    with pytest.raises(SqlError):
+        conn.execute("SELECT ai_embed('x', 'openai:m')")
+    # with a secret the request is attempted and fails on the
+    # network boundary (zero egress) with the provider SQLSTATE
+    conn.execute("SELECT create_secret('k1', 'sk-test')")
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT ai_embed('x', 'openai:m', 'k1')")
+    assert e.value.sqlstate == "58030"
+    assert conn.execute("SELECT drop_secret('k1')").scalar() is True
+    assert conn.execute("SELECT drop_secret('k1')").scalar() is False

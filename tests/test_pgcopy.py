@@ -152,3 +152,28 @@ def test_wire_binary_copy_roundtrip(server):
                   key=lambda t: t[0]) == [(7, None), (42, "hello")]
     c.query("DROP TABLE wb")
     c.close()
+
+
+def test_copy_from_file_column_subset(tmp_path):
+    """COPY t (cols) FROM file maps by NAME for parquet and positionally
+    over the LISTED columns for csv (PG semantics) — never positionally
+    over the table schema."""
+    c = Database().connect()
+    c.execute("CREATE TABLE s2 (a INT, b INT)")
+    c.execute("INSERT INTO s2 VALUES (1, 100)")
+    pq = str(tmp_path / "s2.parquet")
+    c.execute(f"COPY s2 TO '{pq}' WITH (FORMAT parquet)")
+    c.execute("CREATE TABLE d2 (a INT, b INT)")
+    c.execute(f"COPY d2 (b) FROM '{pq}' WITH (FORMAT parquet)")
+    assert c.execute("SELECT a, b FROM d2").rows() == [(None, 100)]
+    with pytest.raises(SqlError):
+        c.execute(f"COPY d2 (a, b, a) FROM '{pq}' WITH (FORMAT parquet)")
+    # csv subset: the file holds exactly the listed column
+    csvp = str(tmp_path / "only_b.csv")
+    open(csvp, "w").write("7\n8\n")
+    c.execute("CREATE TABLE d3 (a INT, b INT)")
+    c.execute(f"COPY d3 (b) FROM '{csvp}' WITH (FORMAT csv)")
+    assert c.execute("SELECT a, b FROM d3 ORDER BY b").rows() == \
+        [(None, 7), (None, 8)]
+    with pytest.raises(SqlError):
+        c.execute(f"COPY d3 (nope) FROM '{csvp}' WITH (FORMAT csv)")
