@@ -21,3 +21,14 @@ Layer map (mirrors SURVEY.md §1, re-expressed for TPU):
 """
 
 __version__ = "0.1.0"
+
+# Import pyarrow EAGERLY, on whatever thread first imports this package
+# (normally the main thread). pyarrow's C++ initialization must not happen
+# lazily inside a short-lived request/worker thread: when the importing
+# thread exits, subsequent parquet reads from other threads segfault in
+# this image's pyarrow build (reproduced: COPY ... (FORMAT parquet) on an
+# HTTP worker thread, then read_parquet() anywhere → SIGSEGV in
+# ParquetFile.read). Engine code may still `import pyarrow` locally for
+# namespacing — those become no-op cache hits after this.
+import pyarrow  # noqa: E402,F401
+import pyarrow.parquet  # noqa: E402,F401

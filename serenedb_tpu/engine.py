@@ -342,12 +342,18 @@ class Database(TableResolver):
 
     def resolve_table_function(self, name: str, args: list) -> TableProvider:
         if name in ("read_parquet", "parquet_scan"):
-            path = str(args[0])
-            with self.lock:
-                p = self._parquet_cache.get(path)
-                if p is None:
-                    p = self._parquet_cache[path] = ParquetTable(path)
-            return p
+            from .exec.filesource import parquet_source
+            return parquet_source(self, str(args[0]))
+        if name in ("read_csv", "read_csv_auto", "csv_scan"):
+            from .exec.filesource import csv_source
+            header = None
+            delim = ","
+            if len(args) > 1 and args[1] is not None:
+                header = (str(args[1]).lower() in ("true", "t", "1")
+                          if not isinstance(args[1], bool) else args[1])
+            if len(args) > 2 and args[2] is not None:
+                delim = str(args[2])
+            return csv_source(self, str(args[0]), header, delim)
         if name == "unnest":
             # set-returning: one row per element; multiple arrays zip with
             # NULL padding (PG: FROM unnest(a, b)); arrays are JSON text
@@ -1777,10 +1783,15 @@ class Connection:
         """COPY ... FROM STDIN: parse the wire-fed payload (PG text format
         by default: tab-delimited, \\N nulls, backslash escapes; or csv)."""
         table = self._table_for_dml(st.table)
+        seen = set()
         for c in st.columns or []:
             if c not in table.column_names:
                 raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                       f'column "{c}" does not exist')
+            if c in seen:
+                raise errors.SqlError(
+                    "42701", f'column "{c}" specified more than once')
+            seen.add(c)
         fmt = str(st.options.get("format", "text")).lower()
         target_names = st.columns or list(table.column_names)
         types = [table.column_types[table.column_names.index(c)]

@@ -207,7 +207,11 @@ class ParquetTable(TableProvider):
         with self._lock:
             to_read = [c for c in cols if c not in self._columns]
             if to_read:
-                tbl = self._pf.read(columns=to_read)
+                # use_threads=False: pyarrow's internal CPU pool segfaults when a
+                # write happened on another (daemon) server thread earlier in
+                # this process; single-threaded decode is safe and the column
+                # cache amortizes it (see test_filesource server drive)
+                tbl = self._pf.read(columns=to_read, use_threads=False)
                 for cname in to_read:
                     self._columns[cname] = _arrow_to_column(tbl.column(cname))
             return Batch(list(cols), [self._columns[c] for c in cols])

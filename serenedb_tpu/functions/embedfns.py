@@ -107,45 +107,57 @@ def _ai_embed(ts):
     if not ts or len(ts) > 3:
         return None
 
+    def _local_dim(param: str) -> int:
+        try:
+            dim = int(param) if param else 64
+        except ValueError:
+            raise errors.SqlError(
+                "22023", f"ai_embed: invalid local dim {param!r}")
+        if not (1 <= dim <= 4096):
+            raise errors.SqlError("22023",
+                                  "ai_embed: dim must be in [1, 4096]")
+        return dim
+
     def impl(cols, n):
         texts = string_values(cols[0])
         valid = propagate_nulls(cols)
-        model = "local"
-        if len(cols) > 1:
-            mv = string_values(cols[1])
-            model = mv[0] if n else "local"
-        provider, param = _parse_model(model)
+        models = (string_values(cols[1]) if len(cols) > 1
+                  else ["local"] * n)
+        snames = (string_values(cols[2]) if len(cols) > 2
+                  else [None] * n)
         out = [""] * n
-        live = [i for i in range(n)
-                if valid is None or valid[i]]
-        if provider == "local":
-            dim = int(param) if param else 64
-            if not (1 <= dim <= 4096):
-                raise errors.SqlError("22023",
-                                      "ai_embed: dim must be in [1, 4096]")
-            for i in live:
-                vec = local_embed(str(texts[i]), dim)
-                out[i] = json.dumps([round(float(x), 6) for x in vec])
-        else:
+        live = [i for i in range(n) if valid is None or valid[i]]
+        # group rows by (model, secret): local rows embed inline, each
+        # remote group goes out as ONE batched provider request
+        groups: dict[tuple, list[int]] = {}
+        for i in live:
+            groups.setdefault((str(models[i]), snames[i]), []).append(i)
+        for (model, sname), idxs in groups.items():
+            provider, param = _parse_model(model)
+            if provider == "local":
+                dim = _local_dim(param)
+                for i in idxs:
+                    vec = local_embed(str(texts[i]), dim)
+                    out[i] = json.dumps([round(float(x), 6) for x in vec])
+                continue
             if len(cols) < 3:
                 raise errors.SqlError(
                     "22023", "ai_embed: remote providers need a secret "
                              "name: ai_embed(text, model, secret_name)")
             db = _db()
-            sname = string_values(cols[2])[0] if n else ""
             secret = _secrets(db).get(sname) if db is not None else None
             if secret is None:
                 raise errors.SqlError(
                     "22023", f"ai_embed: secret '{sname}' not found — "
                              "create_secret(name, value) first")
             vecs = _http_embed(provider, param,
-                               [str(texts[i]) for i in live], secret)
-            if len(vecs) != len(live):
+                               [str(texts[i]) for i in idxs], secret)
+            if len(vecs) != len(idxs):
                 raise errors.SqlError("58030",
                                       "ai_embed: provider returned "
                                       f"{len(vecs)} vectors for "
-                                      f"{len(live)} inputs")
-            for i, vec in zip(live, vecs):
+                                      f"{len(idxs)} inputs")
+            for i, vec in zip(idxs, vecs):
                 out[i] = json.dumps(vec)
         return make_string_column(
             np.asarray(out, dtype=object).astype(str), valid)
@@ -166,7 +178,7 @@ def _create_secret(ts):
         for i in range(n):
             _secrets(db)[str(names[i])] = str(values[i])
         return make_string_column(
-            np.asarray(["ok"] * max(n, 1), dtype=object).astype(str), None)
+            np.asarray(["ok"] * n, dtype=object).astype(str), None)
     return FunctionResolution(dt.VARCHAR, impl)
 
 

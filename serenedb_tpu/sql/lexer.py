@@ -140,10 +140,68 @@ def tokenize(sql: str) -> list[Token]:
             while j < n and (sql[j].isalnum() or sql[j] == "_"):
                 j += 1
             word = sql[i:j]
-            # E'...' escape strings
+            # E'...' escape strings: PG backslash escapes (\n \t \r \b \f
+            # \\ \' \xHH \uXXXX); '' still escapes a quote
             if word.upper() == "E" and j < n and sql[j] == "'":
-                i = j
-                continue  # treat as plain string (PG escape semantics simplified)
+                k = j + 1
+                buf = []
+                while True:
+                    if k >= n:
+                        raise SqlError("42601",
+                                       "unterminated string literal")
+                    ch = sql[k]
+                    if ch == "'":
+                        if k + 1 < n and sql[k + 1] == "'":
+                            buf.append("'")
+                            k += 2
+                            continue
+                        break
+                    if ch == "\\" and k + 1 < n:
+                        nxt = sql[k + 1]
+                        simple = {"n": "\n", "t": "\t", "r": "\r",
+                                  "b": "\b", "f": "\f", "\\": "\\",
+                                  "'": "'"}
+                        if nxt in simple:
+                            buf.append(simple[nxt])
+                            k += 2
+                            continue
+                        if nxt in "01234567":
+                            # octal \o \oo \ooo
+                            m = k + 1
+                            while m < min(k + 4, n) and \
+                                    sql[m] in "01234567":
+                                m += 1
+                            buf.append(chr(int(sql[k + 1:m], 8) & 0xFF))
+                            k = m
+                            continue
+                        if nxt in "xX":
+                            # \x with 1–2 hex digits (PG rule)
+                            m = k + 2
+                            while m < min(k + 4, n) and \
+                                    sql[m] in "0123456789abcdefABCDEF":
+                                m += 1
+                            if m > k + 2:
+                                buf.append(chr(int(sql[k + 2:m], 16)))
+                                k = m
+                                continue
+                        if nxt in "uU":
+                            width = 4 if nxt == "u" else 8
+                            hx = sql[k + 2:k + 2 + width]
+                            if len(hx) == width:
+                                try:
+                                    buf.append(chr(int(hx, 16)))
+                                    k += 2 + width
+                                    continue
+                                except ValueError:
+                                    pass
+                        buf.append(nxt)   # unknown escape: literal char
+                        k += 2
+                        continue
+                    buf.append(ch)
+                    k += 1
+                toks.append(Token(T.STRING, "".join(buf), i))
+                i = k + 1
+                continue
             toks.append(Token(T.IDENT, word, i))
             i = j
             continue

@@ -67,3 +67,15 @@ def test_remote_provider_gating(conn):
     assert e.value.sqlstate == "58030"
     assert conn.execute("SELECT drop_secret('k1')").scalar() is True
     assert conn.execute("SELECT drop_secret('k1')").scalar() is False
+
+
+def test_per_row_model_and_errors(conn):
+    conn.execute("CREATE TABLE em (t TEXT, mo TEXT)")
+    conn.execute("INSERT INTO em VALUES ('a','local:8'), ('b','local:16')")
+    rows = conn.execute("SELECT ai_embed(t, mo) FROM em").rows()
+    assert [len(json.loads(r[0])) for r in rows] == [8, 16]
+    with pytest.raises(SqlError):
+        conn.execute("SELECT ai_embed('x', 'local:abc')")
+    # zero-row input → zero output rows
+    conn.execute("CREATE TABLE em0 (a TEXT, b TEXT)")
+    assert conn.execute("SELECT create_secret(a, b) FROM em0").rows() == []

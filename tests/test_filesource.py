@@ -1,0 +1,81 @@
+"""Zero-ETL file sources: read_parquet/read_csv, globs, remote gating
+(reference: index_source_view_file.cpp)."""
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+
+
+@pytest.fixture
+def conn():
+    return Database().connect()
+
+
+def _write_parquet(conn, tmp_path, name, rows):
+    conn.execute(f"CREATE TABLE _w_{name} (id INT, v DOUBLE)")
+    vals = ", ".join(f"({a}, {b})" for a, b in rows)
+    conn.execute(f"INSERT INTO _w_{name} VALUES {vals}")
+    p = str(tmp_path / f"{name}.parquet")
+    conn.execute(f"COPY _w_{name} TO '{p}' WITH (FORMAT parquet)")
+    conn.execute(f"DROP TABLE _w_{name}")
+    return p
+
+
+def test_read_parquet_single_and_view(conn, tmp_path):
+    p = _write_parquet(conn, tmp_path, "one", [(1, 1.5), (2, 2.5)])
+    rows = conn.execute(
+        f"SELECT id, v FROM read_parquet('{p}') ORDER BY id").rows()
+    assert rows == [(1, 1.5), (2, 2.5)]
+    # zero-ETL view over the file
+    conn.execute(f"CREATE VIEW pv AS SELECT * FROM read_parquet('{p}')")
+    assert conn.execute("SELECT count(*) FROM pv").scalar() == 2
+    assert conn.execute(
+        "SELECT sum(v) FROM pv WHERE id > 1").scalar() == 2.5
+
+
+def test_read_parquet_glob_union(conn, tmp_path):
+    _write_parquet(conn, tmp_path, "part1", [(1, 1.0)])
+    _write_parquet(conn, tmp_path, "part2", [(2, 2.0), (3, 3.0)])
+    g = str(tmp_path / "part*.parquet")
+    rows = conn.execute(
+        f"SELECT id FROM read_parquet('{g}') ORDER BY id").rows()
+    assert rows == [(1,), (2,), (3,)]
+    with pytest.raises(SqlError):
+        conn.execute(
+            f"SELECT * FROM read_parquet('{tmp_path}/nope*.parquet')")
+
+
+def test_read_csv_inference_and_header(conn, tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("id,name,score\n1,ann,1.5\n2,bob,\n3,cy,3.25\n")
+    rows = conn.execute(
+        f"SELECT id, name, score FROM read_csv('{p}') ORDER BY id").rows()
+    assert rows == [(1, "ann", 1.5), (2, "bob", None), (3, "cy", 3.25)]
+    # headerless numeric file → column0..n names, int inference
+    q = tmp_path / "raw.csv"
+    q.write_text("10,x\n20,y\n")
+    rows = conn.execute(
+        f'SELECT column0, column1 FROM read_csv(\'{q}\') '
+        "ORDER BY column0").rows()
+    assert rows == [(10, "x"), (20, "y")]
+    # explicit header flag overrides detection
+    rows = conn.execute(
+        f"SELECT count(*) FROM read_csv('{q}', true)").scalar()
+    assert rows == 1
+
+
+def test_read_csv_bool_and_delim(conn, tmp_path):
+    p = tmp_path / "flags.tsv"
+    p.write_text("a\tb\ntrue\t1\nfalse\t2\n")
+    rows = conn.execute(
+        f"SELECT a, b FROM read_csv('{p}', true, E'\\t') "
+        "ORDER BY b").rows()
+    assert rows == [(True, 1), (False, 2)]
+
+
+def test_remote_fetch_gated(conn):
+    with pytest.raises(SqlError) as e:
+        conn.execute("SELECT * FROM "
+                     "read_parquet('https://198.51.100.1/x.parquet')")
+    assert e.value.sqlstate == "58030"
