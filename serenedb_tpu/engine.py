@@ -618,6 +618,9 @@ class Connection:
         self._notifications = deque(maxlen=8192)
         #: set by the wire session to wake an idle client (thread-safe)
         self.notify_hook = None
+        #: mid-query cancel: set from ANY thread (CancelRequest socket),
+        #: polled cooperatively at executor batch boundaries
+        self._cancel_event = threading.Event()
         #: LISTEN/UNLISTEN/NOTIFY deferred to COMMIT inside a txn (PG
         #: queues them transactionally; ROLLBACK discards)
         self._txn_actions: list[tuple] = []
@@ -760,10 +763,27 @@ class Connection:
         finally:
             CURRENT_CONNECTION.reset(token)
 
+    def request_cancel(self):
+        """Ask the in-flight statement to stop (PG CancelRequest). Safe
+        from any thread; a no-op when the connection is idle — the flag
+        clears when the next statement starts."""
+        self._cancel_event.set()
+
+    def check_cancel(self):
+        """Cooperative cancellation point (reference: the session's
+        interrupt check inside DuckDB execution tasks,
+        pg_wire_session.h:205-220). Executors call this at batch
+        boundaries."""
+        if self._cancel_event.is_set():
+            self._cancel_event.clear()
+            raise errors.SqlError(
+                "57014", "canceling statement due to user request")
+
     @contextlib.contextmanager
     def _session_scope(self, label: str):
         """pg_stat_activity bookkeeping + active-query metrics + txn-abort
         marking shared by the materializing and streaming paths."""
+        self._cancel_event.clear()   # cancel targets the CURRENT statement
         sess = self.db.sessions.get(self._session_id)
         if sess is not None:
             import time

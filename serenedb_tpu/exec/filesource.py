@@ -89,10 +89,12 @@ def parquet_source(db, path: str) -> TableProvider:
         return hit
     batches = [ParquetTable(p).full_batch() for p in paths]
     names = batches[0].names
+    types0 = [c.type for c in batches[0].columns]
     for i, b in enumerate(batches[1:], 1):
-        if list(b.names) != list(names):
+        if list(b.names) != list(names) or \
+                [c.type for c in b.columns] != types0:
             raise errors.SqlError(
-                "42P16", f"parquet files disagree on columns: "
+                "42P16", f"parquet files disagree on schema: "
                          f"{paths[0]} vs {paths[i]}")
     t = MemTable(os.path.basename(path), concat_batches(batches))
     if len(cache) > 32:
@@ -163,6 +165,9 @@ def csv_source(db, path: str, header=None, delimiter=",") -> TableProvider:
             rows = rows[1:]
         all_rows.extend(rows)
     ncols = max((len(r) for r in all_rows), default=0)
+    if first_header is not None:
+        # header-only files still expose their declared columns
+        ncols = max(ncols, len(first_header))
     if first_header is None:
         first_header = [f"column{i}" for i in range(ncols)]
     if len(first_header) < ncols:

@@ -63,6 +63,17 @@ class PlanNode:
         return type(self).__name__
 
 
+def check_cancel():
+    """Cooperative cancellation point at executor batch boundaries
+    (reference: interrupt checks inside execution tasks,
+    pg_wire_session.h:205-220). Reads the executing connection from the
+    contextvar; free when no connection or no cancel pending."""
+    from ..engine import CURRENT_CONNECTION
+    conn = CURRENT_CONNECTION.get()
+    if conn is not None:
+        conn.check_cancel()
+
+
 class ScanNode(PlanNode):
     def __init__(self, provider: TableProvider, columns: list[str],
                  alias: str, filter_expr: Optional[BoundExpr] = None):
@@ -75,6 +86,7 @@ class ScanNode(PlanNode):
 
     def batches(self, ctx: ExecContext) -> Iterator[Batch]:
         for b in self.provider.batches(self.columns):
+            check_cancel()
             if self.filter is not None:
                 mask_col = self.filter.eval(b)
                 mask = mask_col.data.astype(bool) & mask_col.valid_mask()

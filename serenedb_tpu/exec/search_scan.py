@@ -83,6 +83,8 @@ class SearchScanNode(PlanNode):
         return len(self._matching_docs(searcher))
 
     def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
         searcher = self._searcher()
         if searcher is None:
             raise RuntimeError("search index disappeared under the plan "
@@ -142,6 +144,8 @@ class IvfScanNode(PlanNode):
                 f"k={self.topk}")
 
     def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
         from ..search.ivf import find_ivf_index
         idx = find_ivf_index(self.provider, self.vector_column)
         if idx is None:
@@ -190,6 +194,8 @@ class BtreeScanNode(PlanNode):
         return len(idx.lookup_eq(self.eq_value))
 
     def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
         from ..search.index import find_btree_index
         idx = find_btree_index(self.provider, self.index_column)
         if idx is None:

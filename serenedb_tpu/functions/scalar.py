@@ -1142,6 +1142,7 @@ def _make_regex_match(ci: bool, negated: bool):
             return None
 
         def impl(cols, n):
+            from ..exec.plan import check_cancel
             from ..search.regexp import RegexpError, compile_regexp
             texts = string_values(cols[0])
             pats = string_values(cols[1])
@@ -1149,6 +1150,11 @@ def _make_regex_match(ci: bool, negated: bool):
             comp_cache: dict = {}
             out = np.zeros(n, dtype=bool)
             for i in range(n):
+                if (i & 0x3FF) == 0:
+                    # regex over a wide batch is the slowest row loop in
+                    # the engine — finer cancel granularity than the
+                    # batch boundary (~1k rows ≈ ms)
+                    check_cancel()
                 if valid is not None and not valid[i]:
                     continue
                 pat = pats[i]

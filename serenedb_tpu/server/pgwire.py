@@ -231,6 +231,22 @@ class Portal:
     result_fmts: tuple = ()    # Bind result-format codes (0 text, 1 binary)
     pending: object = None     # QueryResult with rows not yet sent
     sent: int = 0
+    #: streaming SELECT state: {"it": batch iterator, "leftover": Batch
+    #: remainder after a row-budget split, "total": rows sent} — rows leave
+    #: the socket as the executor produces them (wire_collector.h:20-60)
+    stream: object = None
+
+
+def _close_portal_stream(portal: Optional["Portal"]) -> None:
+    """Close a suspended streaming portal's executor generator eagerly —
+    its session scope (pg_stat_activity 'active', QUERIES_ACTIVE gauge)
+    must end now, never at GC time."""
+    if portal is not None and portal.stream is not None:
+        try:
+            portal.stream["it"].close()
+        except Exception:
+            pass
+        portal.stream = None
 
 
 class PgSession:
@@ -259,6 +275,8 @@ class PgSession:
                 pass
             finally:
                 self.server.unregister_cancel(self.pid, self.secret)
+                for p in self.portals.values():
+                    _close_portal_stream(p)
                 if self.conn is not None:
                     self.conn.close()
                 self.w.t.close()
@@ -691,6 +709,7 @@ class PgSession:
                 raise errors.SqlError(
                     "08P01", f"invalid result format code "
                              f"{[f for f in rfmts if f not in (0, 1)][0]}")
+            _close_portal_stream(self.portals.get(portal))
             self.portals[portal] = Portal(prep, params, rfmts)
             self.w.bind_complete()
         except errors.SqlError as e:
@@ -767,11 +786,25 @@ class PgSession:
             if not portal.prepared.statements:
                 self.w.empty_query()
                 return
+            st0 = portal.prepared.statements[0]
+            if portal.stream is not None or (
+                    portal.pending is None and
+                    isinstance(st0, (ast.Select, ast.SetOp))):
+                try:
+                    await self._execute_streaming_portal(portal, st0,
+                                                         max_rows)
+                except Exception:
+                    # never resume a broken iterator — and close it NOW so
+                    # session-scope state (pg_stat_activity 'active',
+                    # QUERIES_ACTIVE) never waits for GC
+                    _close_portal_stream(portal)
+                    raise
+                await self.w.flush()
+                return
             if portal.pending is None:
-                st = portal.prepared.statements[0]
                 portal.pending = await loop.run_in_executor(
                     self.server.pool,
-                    functools.partial(self.conn.execute_statement, st,
+                    functools.partial(self.conn.execute_statement, st0,
                                       portal.params,
                                       sql_text=portal.prepared.sql))
                 portal.sent = 0
@@ -807,13 +840,53 @@ class PgSession:
             self.ignore_till_sync = True
         await self.w.flush()
 
+    async def _execute_streaming_portal(self, portal: Portal, st,
+                                        max_rows: int):
+        """Extended-protocol streaming Execute: DataRows flush per
+        executor batch; a row budget suspends the portal mid-stream
+        without materializing the rest (reference: wire_collector.h:20-60
+        + portal row-budget paging, pg_wire_session.h:293-300)."""
+        loop = asyncio.get_running_loop()
+        if portal.stream is None:
+            names, types, it = await loop.run_in_executor(
+                self.server.pool,
+                functools.partial(self.conn.execute_streaming, st,
+                                  portal.params,
+                                  sql_text=portal.prepared.sql))
+            portal.stream = {"it": it, "leftover": None, "total": 0}
+        s = portal.stream
+        it = s["it"]
+        budget = max_rows if max_rows else None
+        while True:
+            b = s["leftover"]
+            s["leftover"] = None
+            if b is None:
+                b = await loop.run_in_executor(self.server.pool,
+                                               lambda: next(it, None))
+            if b is None:
+                self.w.command_complete(f"SELECT {s['total']}")
+                portal.stream = None
+                break
+            if budget is not None and b.num_rows > budget:
+                s["leftover"] = b.slice(budget, b.num_rows)
+                b = b.slice(0, budget)
+            if b.num_rows:
+                self.w.data_rows(b, portal.result_fmts)
+                s["total"] += b.num_rows
+                if budget is not None:
+                    budget -= b.num_rows
+                await self.w.flush()   # backpressure via transport drain
+            if budget == 0:
+                self.w.msg(b"s")       # PortalSuspended
+                break
+
     async def _on_close(self, payload: bytes):
         kind = payload[:1]
         name = payload[1:-1].decode()
         if kind == b"S":
             self.prepared.pop(name, None)
         else:
-            self.portals.pop(name, None)
+            _close_portal_stream(self.portals.pop(name, None))
         self.w.close_complete()
         await self.w.flush()
 
@@ -956,9 +1029,15 @@ class PgServer:
         self._cancel_keys.pop((pid, key), None)
 
     def cancel(self, pid: int, key: int):
-        # cancellation is registered; in-flight interruption lands with the
-        # native runtime (reference: CancelRegistry, cancel_registry.h)
+        """CancelRequest: interrupt the session's in-flight statement
+        (reference: CancelRegistry, cancel_registry.h). Cooperative — the
+        executor raises 57014 at its next batch boundary."""
+        session = self._cancel_keys.get((pid, key))
+        if session is None or session.conn is None:
+            log.info("pg", f"cancel request for unknown {pid}/{key}")
+            return
         log.info("pg", f"cancel request for {pid}/{key}")
+        session.conn.request_cancel()
 
     async def _client(self, reader, writer):
         await PgSession(self, reader, writer).run()

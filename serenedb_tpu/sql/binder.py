@@ -375,9 +375,10 @@ class ExprBinder:
         refs substituted; yields (row_index, rows). plan_cache persists
         per bound expression so multi-batch execution and repeated keys
         pay one plan+execute per distinct key."""
-        from ..exec.plan import ExecContext
+        from ..exec.plan import ExecContext, check_cancel
         cols = {tuple(r): self.scope.resolve(r) for r in outer_refs}
         for i in range(batch.num_rows):
+            check_cancel()
             key_vals = {}
             for parts, sc in cols.items():
                 c = batch.columns[sc.index]

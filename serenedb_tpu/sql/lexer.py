@@ -189,11 +189,23 @@ def tokenize(sql: str) -> list[Token]:
                             hx = sql[k + 2:k + 2 + width]
                             if len(hx) == width:
                                 try:
-                                    buf.append(chr(int(hx, 16)))
+                                    cp = int(hx, 16)
+                                except ValueError:
+                                    cp = None
+                                if cp is not None:
+                                    if 0xD800 <= cp <= 0xDFFF or \
+                                            cp > 0x10FFFF:
+                                        # PG rejects surrogates/overflow
+                                        # at parse time — stored lone
+                                        # surrogates poison every later
+                                        # read of the row
+                                        raise SqlError(
+                                            "42601",
+                                            "invalid Unicode escape "
+                                            f"value \\{nxt}{hx}")
+                                    buf.append(chr(cp))
                                     k += 2 + width
                                     continue
-                                except ValueError:
-                                    pass
                         buf.append(nxt)   # unknown escape: literal char
                         k += 2
                         continue

@@ -1,0 +1,166 @@
+"""Extended-protocol streaming Execute + mid-query cancellation.
+
+Reference parity: wire_collector.h:20-60 (rows leave the socket during
+execution), pg_wire_session.h:293-300 (portal row budgets) and
+pg_wire_session.h:205-220 (interrupting execution tasks on cancel)."""
+
+import asyncio
+import socket
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+from serenedb_tpu.server.pgwire import PgServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE big (i INT, s TEXT)")
+    # several executor batches (batch is 128k rows)
+    n = 300_000
+    import numpy as np
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.columnar import dtypes as dt
+    ints = np.arange(n, dtype=np.int32)
+    strs = np.asarray([f"row{i % 1000}x" for i in range(n)], dtype=object)
+    t = db.resolve_table(["big"])
+    t.append_batch(Batch(["i", "s"], [
+        Column(dt.INT, ints),
+        Column.from_numpy(strs.astype(str))]))
+    srv = PgServer(db, port=0)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        try:
+            loop.run_until_complete(go())
+        except RuntimeError:
+            pass
+    threading.Thread(target=run, daemon=True).start()
+    started.wait(10)
+    return srv
+
+
+def _client(server):
+    from test_pgwire import RawPg
+    return RawPg(server.port)
+
+
+def test_extended_streaming_full_fetch(server):
+    c = _client(server)
+    cols, rows, tags, errs = c.extended(
+        "SELECT i FROM big WHERE i < 200000")
+    assert not errs
+    assert len(rows) == 200_000
+    assert tags == ["SELECT 200000"]
+    c.close()
+
+
+def test_extended_portal_row_budget_streams(server):
+    c = _client(server)
+    c.send(b"P", b"\x00SELECT i FROM big ORDER BY i\x00\x00\x00")
+    c.send(b"B", b"\x00\x00" + struct.pack("!H", 0) +
+           struct.pack("!H", 0) + struct.pack("!H", 0))
+    c.send(b"E", b"\x00" + struct.pack("!I", 5))     # 5-row budget
+    c.send(b"H")                                     # Flush
+    rows, suspended = [], False
+    while True:
+        kind, payload = c.read_msg()
+        if kind == b"D":
+            rows.append(payload)
+        elif kind == b"s":
+            suspended = True
+            break
+        elif kind == b"E":
+            raise AssertionError(payload)
+    assert suspended and len(rows) == 5
+    # resume for 3 more
+    c.send(b"E", b"\x00" + struct.pack("!I", 3))
+    c.send(b"H")
+    more = []
+    while True:
+        kind, payload = c.read_msg()
+        if kind == b"D":
+            more.append(payload)
+        elif kind == b"s":
+            break
+    assert len(more) == 3
+    # fetch the rest (0 = no limit) and complete
+    c.send(b"E", b"\x00" + struct.pack("!I", 0))
+    c.send(b"S")
+    rest, tag = 0, None
+    while True:
+        kind, payload = c.read_msg()
+        if kind == b"D":
+            rest += 1
+        elif kind == b"C":
+            tag = payload[:-1].decode()
+        elif kind == b"Z":
+            break
+    assert rest == 300_000 - 8
+    assert tag == "SELECT 300000"
+    c.close()
+
+
+def test_engine_cancel_interrupts_running_query():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE slow (i INT, s TEXT)")
+    import numpy as np
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.columnar import dtypes as dt
+    n = 400_000
+    t = db.resolve_table(["slow"])
+    t.append_batch(Batch(["i", "s"], [
+        Column(dt.INT, np.arange(n, dtype=np.int32)),
+        Column.from_numpy(np.asarray(
+            [f"text value {i}" for i in range(n)], dtype=object
+        ).astype(str))]))
+    timer = threading.Timer(0.2, c.request_cancel)
+    timer.start()
+    t0 = time.monotonic()
+    with pytest.raises(SqlError) as e:
+        # regex over every row: seconds of CPU without cancellation
+        c.execute("SELECT count(*) FROM slow "
+                  "WHERE s ~ '.*value.*9.*7.*' OR s ~ '.*x.*y.*'")
+    assert e.value.sqlstate == "57014"
+    timer.cancel()
+    # next statement runs normally (flag cleared)
+    assert c.execute("SELECT count(*) FROM slow").scalar() == n
+
+
+def test_wire_cancel_request(server):
+    c = _client(server)
+    assert c.backend_key is not None
+    pid, key = c.backend_key
+
+    def fire_cancel():
+        time.sleep(0.3)
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        body = struct.pack("!III", 80877102, pid, key)
+        s.sendall(struct.pack("!I", len(body) + 4) + body)
+        s.close()
+    threading.Thread(target=fire_cancel, daemon=True).start()
+    cols, rows, tags, errs = c.extended(
+        "SELECT count(*) FROM big "
+        "WHERE s ~ '.*row.*1.*2.*' OR s ~ '.*x.*0.*9.*'")
+    assert errs and errs[0]["C"] == "57014", (errs, tags)
+    # session survives: simple query still works
+    _, rows2, _, errs2 = c.query("SELECT 1")
+    assert not errs2 and rows2 == [("1",)]
+    c.close()

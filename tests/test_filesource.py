@@ -79,3 +79,19 @@ def test_remote_fetch_gated(conn):
         conn.execute("SELECT * FROM "
                      "read_parquet('https://198.51.100.1/x.parquet')")
     assert e.value.sqlstate == "58030"
+
+
+def test_header_only_csv(conn, tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("a,b\n")
+    assert conn.execute(
+        f"SELECT a, b FROM read_csv('{p}', true)").rows() == []
+
+
+def test_glob_type_mismatch(conn, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({"id": [1]}), str(tmp_path / "t1.parquet"))
+    pq.write_table(pa.table({"id": ["x"]}), str(tmp_path / "t2.parquet"))
+    with pytest.raises(SqlError):
+        conn.execute(f"SELECT * FROM read_parquet('{tmp_path}/t*.parquet')")
