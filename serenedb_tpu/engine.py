@@ -126,6 +126,26 @@ class Database(TableResolver):
             self.maintenance = MaintenanceManager(self)
             self.maintenance.start()
 
+    def crash(self):
+        """Abandon this Database as if the process was killed: stop loops
+        without any further checkpoint/refresh pass, release the datadir
+        lock, write nothing else. Recovery harnesses reopen the datadir
+        afterwards (reference: recovery tests kill serened and restart,
+        tests/sqllogic/recovery/)."""
+        self._crashed = True
+        if self.maintenance is not None:
+            self.maintenance.stop()
+        if self.store is not None:
+            import os
+            try:
+                os.remove(self.store._lockfile)
+            except OSError:
+                pass
+        from .search.analysis import drop_dictionary
+        for name in self._tsdict_names:
+            drop_dictionary(name)
+        self._tsdict_names.clear()
+
     def close(self):
         if self.maintenance is not None:
             self.maintenance.stop()

@@ -564,6 +564,33 @@ def _strpos(ts):
     return FunctionResolution(dt.BIGINT, impl)
 
 
+def _pad_impl(ts, left_side: bool):
+    if len(ts) not in (2, 3):
+        return None
+
+    def impl(cols, n):
+        s = string_values(cols[0])
+        k = cols[1].data.astype(np.int64)
+        fill = string_values(cols[2]) if len(cols) > 2 else [" "] * n
+        out = []
+        for v, kk, f in zip(s, k, fill):
+            kk = int(kk)
+            if kk <= len(v):
+                out.append(v[:max(kk, 0)])
+            elif not f:
+                out.append(v)
+            else:
+                pad = (f * ((kk - len(v)) // len(f) + 1))[:kk - len(v)]
+                out.append(pad + v if left_side else v + pad)
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["lpad"] = lambda ts: _pad_impl(ts, left_side=True)
+_REGISTRY["rpad"] = lambda ts: _pad_impl(ts, left_side=False)
+
+
 @register("left")
 def _left(ts):
     def impl(cols, n):
@@ -1114,6 +1141,199 @@ def _json_extract_impl(ts, as_text: bool):
 _REGISTRY["json_extract"] = lambda ts: _json_extract_impl(ts, as_text=False)
 _REGISTRY["json_extract_string"] = \
     lambda ts: _json_extract_impl(ts, as_text=True)
+
+
+# -- PG json operators (-> ->> #> #>> @> <@ ? ?| ?&) -----------------------
+# Desugared by the parser (sql/parser.py _JSON_OPS) to these functions
+# (reference: the DuckDB fork's json operator → json_extract lowering).
+
+def _json_docs(col, n):
+    """Per-row parsed JSON values (None for SQL NULL rows)."""
+    texts = string_values(col)
+    valid = col.valid_mask() if col.validity is not None else None
+    out = []
+    for i in range(n):
+        if valid is not None and not valid[i]:
+            out.append(None)
+            continue
+        try:
+            out.append(json.loads(texts[i]))
+        except json.JSONDecodeError:
+            raise errors.SqlError(
+                errors.INVALID_TEXT_REPRESENTATION,
+                f"invalid input syntax for type json: {texts[i][:40]!r}")
+    return out
+
+
+def _json_render(v, as_text: bool):
+    if v is None:
+        return None
+    if as_text and isinstance(v, str):
+        return v
+    if as_text and isinstance(v, bool):
+        return "true" if v else "false"
+    return json.dumps(v)
+
+
+def _json_getelem_impl(ts, as_text: bool):
+    if len(ts) != 2:
+        return None
+    key_is_int = ts[1].is_integer
+
+    def impl(cols, n):
+        docs = _json_docs(cols[0], n)
+        keys = cols[1].to_pylist()
+        out, missing = [], np.zeros(n, dtype=bool)
+        for i in range(n):
+            doc, cur = docs[i], None
+            if doc is not None:
+                k = _json_scalar(keys, i)
+                if key_is_int and isinstance(doc, list):
+                    k = int(k)
+                    if -len(doc) <= k < len(doc):
+                        cur = doc[k]
+                elif not key_is_int and isinstance(doc, dict):
+                    cur = doc.get(str(k))
+            r = _json_render(cur, as_text)
+            missing[i] = r is None
+            out.append(r or "")
+        return _result_text(out, missing, cols)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+def _result_text(out, missing, cols):
+    col = make_string_column(np.asarray(out, dtype=object).astype(str),
+                             propagate_nulls(cols))
+    if missing.any():
+        v = col.valid_mask() & ~missing
+        col = Column(dt.VARCHAR, col.data,
+                     None if v.all() else v, col.dictionary)
+    return col
+
+
+def _pg_path_elems(p):
+    """'{a,1,b}' (PG text[] literal) or '["a","b"]' (this engine's array
+    encoding) → ['a','1','b']."""
+    p = p.strip()
+    if p.startswith("["):
+        try:
+            return [str(e) for e in json.loads(p)]
+        except json.JSONDecodeError:
+            pass
+    if p.startswith("{") and p.endswith("}"):
+        p = p[1:-1]
+    return [e.strip().strip('"') for e in p.split(",") if e.strip() != ""]
+
+
+def _json_getpath_impl(ts, as_text: bool):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        docs = _json_docs(cols[0], n)
+        paths = string_values(cols[1])
+        out, missing = [], np.zeros(n, dtype=bool)
+        for i in range(n):
+            cur = docs[i]
+            for part in _pg_path_elems(paths[i]) if cur is not None else []:
+                if isinstance(cur, dict) and part in cur:
+                    cur = cur[part]
+                elif isinstance(cur, list) and \
+                        part.lstrip("-").isdigit() and \
+                        -len(cur) <= int(part) < len(cur):
+                    cur = cur[int(part)]
+                else:
+                    cur = None
+                    break
+            r = _json_render(cur, as_text)
+            missing[i] = r is None
+            out.append(r or "")
+        return _result_text(out, missing, cols)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["json_getelem"] = lambda ts: _json_getelem_impl(ts, as_text=False)
+_REGISTRY["json_getelem_text"] = \
+    lambda ts: _json_getelem_impl(ts, as_text=True)
+_REGISTRY["json_getpath"] = lambda ts: _json_getpath_impl(ts, as_text=False)
+_REGISTRY["json_getpath_text"] = \
+    lambda ts: _json_getpath_impl(ts, as_text=True)
+
+
+def _jsonb_contains(a, b) -> bool:
+    """PG jsonb containment: objects pairwise-recursive; arrays ⊇ every
+    RHS element; top-level array contains RHS scalar; scalars by equality."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return all(k in a and _jsonb_contains(a[k], v)
+                   for k, v in b.items())
+    if isinstance(a, list) and isinstance(b, list):
+        return all(any(_jsonb_contains(x, y) for x in a) for y in b)
+    if isinstance(a, list):
+        return any(_jsonb_contains(x, b) for x in a)
+    return type(a) is type(b) and a == b or \
+        (isinstance(a, (int, float)) and not isinstance(a, bool)
+         and isinstance(b, (int, float)) and not isinstance(b, bool)
+         and a == b)
+
+
+def _containment_impl(ts, flipped: bool):
+    if len(ts) != 2 or not (_stringish(ts[0]) and _stringish(ts[1])):
+        return None
+
+    def impl(cols, n):
+        a = _json_docs(cols[0], n)
+        b = _json_docs(cols[1], n)
+        if flipped:
+            a, b = b, a
+        data = np.asarray([x is not None and y is not None
+                           and _jsonb_contains(x, y)
+                           for x, y in zip(a, b)])
+        return _result(dt.BOOL, data, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+_REGISTRY["contains_op"] = lambda ts: _containment_impl(ts, flipped=False)
+_REGISTRY["contained_op"] = lambda ts: _containment_impl(ts, flipped=True)
+
+
+@register("json_exists_op")
+def _json_exists_op(ts):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        docs = _json_docs(cols[0], n)
+        keys = string_values(cols[1])
+        data = np.asarray([
+            (isinstance(d, dict) and keys[i] in d)
+            or (isinstance(d, list) and keys[i] in d)
+            for i, d in enumerate(docs)])
+        return _result(dt.BOOL, data, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+def _json_exists_multi(ts, want_all: bool):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        docs = _json_docs(cols[0], n)
+        key_lists = string_values(cols[1])
+        out = np.zeros(n, dtype=bool)
+        for i, d in enumerate(docs):
+            ks = _pg_path_elems(key_lists[i])
+            def has(k):
+                return (isinstance(d, dict) and k in d) or \
+                    (isinstance(d, list) and k in d)
+            out[i] = all(map(has, ks)) if want_all else any(map(has, ks))
+        return _result(dt.BOOL, out, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+_REGISTRY["json_exists_any"] = \
+    lambda ts: _json_exists_multi(ts, want_all=False)
+_REGISTRY["json_exists_all"] = \
+    lambda ts: _json_exists_multi(ts, want_all=True)
 
 
 @register("json_valid")

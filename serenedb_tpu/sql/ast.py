@@ -155,6 +155,7 @@ class TableFunction(TableRef):
 class SubqueryRef(TableRef):
     query: "Select"
     alias: Optional[str] = None
+    col_aliases: Optional[list[str]] = None   # FROM (…) v(a, b)
 
 
 @dataclass

@@ -38,6 +38,7 @@ class Token:
 _OPERATORS = sorted([
     "::", "<=", ">=", "<>", "!=", "||", "##", "@@", "<->", "<#>", "<=>",
     "~*", "!~*", "!~",
+    "->>", "->", "#>>", "#>", "?|", "?&", "?", "@>", "<@", "^",
     "(", ")", ",", ";", "+", "-", "*", "/", "%", "<", ">", "=", ".", "~",
     "[", "]", ":",
 ], key=len, reverse=True)  # longest match first (<=> before <=)

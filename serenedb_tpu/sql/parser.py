@@ -405,7 +405,15 @@ class Parser:
             inner = self.parse_select()
             self.expect_op(")")
             alias = self._table_alias()
-            return ast.SubqueryRef(inner, alias)
+            cols = None
+            if alias is not None and self.at_op("("):
+                # FROM (VALUES …) v(a, b) — column aliases (PG)
+                self.next()
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            return ast.SubqueryRef(inner, alias, cols)
         parts = [self.ident()]
         while self.accept_op("."):
             parts.append(self.ident())
@@ -559,12 +567,24 @@ class Parser:
             continue
         return left
 
+    #: PG json/containment operators desugared to functions at parse time
+    #: (reference: DuckDB fork maps -> / ->> onto json_extract family)
+    _JSON_OPS = {"->": "json_getelem", "->>": "json_getelem_text",
+                 "#>": "json_getpath", "#>>": "json_getpath_text",
+                 "@>": "contains_op", "<@": "contained_op",
+                 "?": "json_exists_op", "?|": "json_exists_any",
+                 "?&": "json_exists_all"}
+
     def parse_additive_chain(self) -> ast.Expr:
         left = self.parse_multiplicative()
         while True:
             if self.at_op("+") or self.at_op("-") or self.at_op("||"):
                 op = self.next().value
                 left = ast.BinaryOp(op, left, self.parse_multiplicative())
+            elif self.peek().kind is T.OP and \
+                    self.peek().value in self._JSON_OPS:
+                fn = self._JSON_OPS[self.next().value]
+                left = ast.FuncCall(fn, [left, self.parse_multiplicative()])
             else:
                 return left
 
@@ -582,7 +602,16 @@ class Parser:
             return ast.UnaryOp("-", self.parse_unary())
         if self.accept_op("+"):
             return self.parse_unary()
-        return self.parse_postfix()
+        return self.parse_power()
+
+    def parse_power(self) -> ast.Expr:
+        # PG: ^ binds tighter than * and is left-associative
+        left = self.parse_postfix()
+        while self.at_op("^"):
+            self.next()
+            right = self.parse_postfix()
+            left = ast.FuncCall("power", [left, right])
+        return left
 
     def parse_postfix(self) -> ast.Expr:
         e = self.parse_primary()
@@ -731,6 +760,45 @@ class Parser:
             if lit.kind is not T.STRING:
                 raise errors.syntax("INTERVAL requires a string literal")
             return ast.Cast(ast.Literal(lit.value), "INTERVAL")
+        if upper == "POSITION" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            # PG: position(substr IN str) = strpos(str, substr)
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_additive_chain()
+            if self.accept_kw("IN"):
+                s = self.parse_expr()
+                self.expect_op(")")
+                return ast.FuncCall("strpos", [s, sub])
+            args = [sub]
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("position", args)
+        if upper == "SUBSTRING" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            # PG: substring(str FROM n [FOR k]) — also plain (s, n[, k])
+            self.next()
+            self.expect_op("(")
+            s = self.parse_expr()
+            if self.at_kw("FROM") or self.at_kw("FOR"):
+                from_kw = bool(self.accept_kw("FROM"))
+                if not from_kw:
+                    self.expect_kw("FOR")
+                first = self.parse_expr()
+                if from_kw:
+                    args = [s, first]
+                    if self.accept_kw("FOR"):
+                        args.append(self.parse_expr())
+                else:  # substring(s FOR k) = substr(s, 1, k)
+                    args = [s, ast.Literal(1), first]
+                self.expect_op(")")
+                return ast.FuncCall("substr", args)
+            args = [s]
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("substr", args)
         if upper in ("DATE", "TIMESTAMP") and self.peek(1).kind is T.STRING:
             self.next()
             lit = self.next()

@@ -240,9 +240,20 @@ class Planner:
         if isinstance(ref, ast.SubqueryRef):
             inner = self.plan_select(ref.query)
             alias = ref.alias or "subquery"
+            names = list(inner.names)
+            if ref.col_aliases:
+                if len(ref.col_aliases) > len(names):
+                    raise errors.SqlError(
+                        errors.SYNTAX_ERROR,
+                        f"table \"{alias}\" has {len(names)} columns "
+                        f"available but {len(ref.col_aliases)} specified")
+                names[:len(ref.col_aliases)] = ref.col_aliases
+                inner = ProjectNode(
+                    inner, [BoundColumn(i, t, nm) for i, (nm, t) in
+                            enumerate(zip(names, inner.types))], names)
             scope = Scope([ScopeColumn(alias, n, t, i)
                            for i, (n, t) in enumerate(
-                               zip(inner.names, inner.types))])
+                               zip(names, inner.types))])
             return inner, scope
         if isinstance(ref, ast.JoinRef):
             return self._plan_join(ref)

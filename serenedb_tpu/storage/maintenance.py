@@ -69,6 +69,8 @@ class MaintenanceManager:
 
     def run_once(self) -> bool:
         """One maintenance pass; returns True if any work was done."""
+        if getattr(self.db, "_crashed", False):
+            return False  # abandoned db must not checkpoint post-"kill"
         did = self._refresh_pass()
         did = self._checkpoint_pass() or did
         return did

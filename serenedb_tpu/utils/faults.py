@@ -56,9 +56,24 @@ def if_failure(name: str) -> None:
         raise FaultInjected(name)
 
 
+#: 'exit' hard-kills the process (the real crash semantics); 'raise' throws
+#: FaultInjected so an in-process recovery harness can abandon the Database
+#: (no close/flush) and reopen from disk — equivalent on-disk state to a
+#: kill at the fault point, but runnable inside one pytest process.
+_crash_mode = "exit"
+
+
+def set_crash_mode(mode: str) -> None:
+    global _crash_mode
+    assert mode in ("exit", "raise")
+    _crash_mode = mode
+
+
 def crash_if_armed(name: str) -> None:
     """Hard-kill the process if `name` is armed (crash-recovery testing)."""
     if armed(name):
+        if _crash_mode == "raise":
+            raise FaultInjected(name)
         os._exit(137)
 
 

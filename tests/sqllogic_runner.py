@@ -20,6 +20,16 @@ File format (the common sqllogictest subset):
 Multi-column rows print values separated by a single space (tab in files is
 normalized); NULL prints as "NULL"; `rowsort` after the types sorts expected
 and actual rows before comparing.
+
+Recovery extensions (tests/sqllogic/recovery/, durable runner only —
+reference analog: fault-armed crash+restart .test files,
+/root/reference/tests/sqllogic/recovery/ 162 files):
+
+    restart            # clean close + reopen of the datadir (checkpoint ok)
+
+    statement crash    # statement must die on an armed crash fault; the
+    INSERT ...         # runner then abandons the db (no close/flush) and
+                       # reopens from disk — a kill at the fault point
 """
 
 from __future__ import annotations
@@ -51,10 +61,16 @@ def parse_test_file(path: str) -> list[Record]:
             continue
         header = line.split()
         start_line = i + 1
+        if header[0] == "restart":
+            records.append(Record("restart", "", start_line))
+            i += 1
+            continue
         if header[0] == "statement":
             expect_error = None
             if len(header) > 1 and header[1] == "error":
                 expect_error = " ".join(header[2:])
+            elif len(header) > 1 and header[1] == "crash":
+                expect_error = "__crash__"
             elif len(header) > 1 and header[1] != "ok":
                 raise ValueError(f"{path}:{i+1}: bad statement header")
             i += 1
@@ -84,9 +100,22 @@ def parse_test_file(path: str) -> list[Record]:
     return records
 
 
-def format_value(v) -> str:
+def format_value(v, typ=None) -> str:
     if v is None:
         return "NULL"
+    if typ is not None:
+        # temporal types render as PG text, not raw epoch ints — the same
+        # encoding the wire sends (serenedb_tpu/server/pgwire.py pg_text)
+        from serenedb_tpu.columnar import dtypes as dt
+        if typ.id is dt.TypeId.TIMESTAMP:
+            from serenedb_tpu.sql.binder import format_timestamp
+            return format_timestamp(int(v))
+        if typ.id is dt.TypeId.DATE:
+            import numpy as np
+            return str(np.datetime64(int(v), "D"))
+        if typ.id is dt.TypeId.INTERVAL:
+            from serenedb_tpu.sql.binder import format_interval
+            return format_interval(int(v))
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):
@@ -98,19 +127,46 @@ def format_value(v) -> str:
     return str(v)
 
 
-def run_test_file(conn, path: str) -> list[str]:
-    """Run one file; returns a list of failure descriptions (empty = pass)."""
+def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
+        list[str]:
+    """Run one file; returns a list of failure descriptions (empty = pass).
+
+    `reopen()` → fresh conn after a clean close (the `restart` directive);
+    `crash_reopen()` → fresh conn after abandoning the db without close
+    (after a `statement crash`). Recovery directives in a file without the
+    matching callback are reported as failures, not silently skipped."""
     from serenedb_tpu.errors import SqlError
+    from serenedb_tpu.utils.faults import FaultInjected
     failures = []
     for rec in parse_test_file(path):
         where = f"{path}:{rec.line}"
+        if rec.kind == "restart":
+            if reopen is None:
+                failures.append(f"{where}: restart in non-durable run")
+                break
+            conn = reopen()
+            continue
+        if rec.kind == "statement" and rec.expect_error == "__crash__":
+            try:
+                conn.execute(rec.sql)
+                failures.append(f"{where}: expected crash, got success")
+            except FaultInjected:
+                if crash_reopen is None:
+                    failures.append(f"{where}: crash in non-durable run")
+                    break
+                conn = crash_reopen()
+            except SqlError as e:
+                failures.append(f"{where}: wanted crash fault, got {e!r}")
+            continue
         try:
             result = conn.execute(rec.sql)
             if rec.kind == "statement" and rec.expect_error is not None:
                 failures.append(f"{where}: expected error, got success")
                 continue
             if rec.kind == "query":
-                actual = [" ".join(format_value(v) for v in row)
+                tys = [c.type for c in result.batch.columns]
+                actual = [" ".join(format_value(v, tys[i])
+                                   for i, v in enumerate(row))
                           for row in result.rows()]
                 expected = [e.replace("\t", " ") for e in rec.expected]
                 if rec.rowsort:

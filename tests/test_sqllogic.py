@@ -1,7 +1,16 @@
-"""Pytest integration of the sqllogic golden files — each file runs on a
-fresh in-memory database AND on a fresh durable database with a
-close/reopen in the middle... (the durable variant comes with multi-run
-support; for now files run against both engine configurations)."""
+"""Pytest integration of the sqllogic golden files.
+
+Layout mirrors the reference's corpus split (reference:
+tests/sqllogic/{any,sdb,pg,recovery}/ — SURVEY.md §4):
+
+  tests/sqllogic/*.test            legacy flat files (both runners)
+  tests/sqllogic/any/**.test       portable SQL behavior (both runners)
+  tests/sqllogic/sdb/**.test       SereneDB-specific surface (both runners)
+  tests/sqllogic/recovery/*.test   crash/restart scenarios (durable only;
+                                   may use `restart` / `statement crash`)
+
+Every non-recovery file runs twice: on a fresh in-memory database and on a
+fresh durable datadir (close/reopen covered by recovery files)."""
 
 import glob
 import os
@@ -9,22 +18,31 @@ import os
 import pytest
 
 from serenedb_tpu.engine import Database
+from serenedb_tpu.utils import faults
 from tests.sqllogic_runner import run_test_file
 
-FILES = sorted(glob.glob(
-    os.path.join(os.path.dirname(__file__), "sqllogic", "*.test")))
+_ROOT = os.path.join(os.path.dirname(__file__), "sqllogic")
+
+FILES = sorted(
+    glob.glob(os.path.join(_ROOT, "*.test"))
+    + glob.glob(os.path.join(_ROOT, "any", "**", "*.test"), recursive=True)
+    + glob.glob(os.path.join(_ROOT, "sdb", "**", "*.test"), recursive=True))
+
+RECOVERY_FILES = sorted(glob.glob(os.path.join(_ROOT, "recovery", "*.test")))
 
 
-@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f)
-                                             for f in FILES])
+def _ids(files):
+    return [os.path.relpath(f, _ROOT) for f in files]
+
+
+@pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
 def test_sqllogic_memory(path):
     conn = Database().connect()
     failures = run_test_file(conn, path)
     assert not failures, "\n".join(failures)
 
 
-@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f)
-                                             for f in FILES])
+@pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
 def test_sqllogic_durable(path, tmp_path):
     db = Database(str(tmp_path / "data"))
     try:
@@ -32,3 +50,32 @@ def test_sqllogic_durable(path, tmp_path):
         assert not failures, "\n".join(failures)
     finally:
         db.close()
+
+
+@pytest.mark.parametrize("path", RECOVERY_FILES, ids=_ids(RECOVERY_FILES))
+def test_sqllogic_recovery(path, tmp_path):
+    """Durable-only: files may crash (fault-armed) and restart the db."""
+    datadir = str(tmp_path / "data")
+    state = {"db": Database(datadir)}
+    faults.set_crash_mode("raise")
+
+    def reopen():
+        state["db"].close()
+        faults.clear()  # a restarted "process" starts with no armed faults
+        state["db"] = Database(datadir)
+        return state["db"].connect()
+
+    def crash_reopen():
+        state["db"].crash()  # abandon: no close/flush, lock released
+        faults.clear()
+        state["db"] = Database(datadir)
+        return state["db"].connect()
+
+    try:
+        failures = run_test_file(state["db"].connect(), path,
+                                 reopen=reopen, crash_reopen=crash_reopen)
+        assert not failures, "\n".join(failures)
+    finally:
+        faults.set_crash_mode("exit")
+        faults.clear()
+        state["db"].close()
