@@ -150,6 +150,17 @@ class Database(TableResolver):
         if self.maintenance is not None:
             self.maintenance.stop()
         if self.store is not None:
+            # clean shutdown persists exact sequence counters so a restart
+            # continues without a gap (PG semantics); only a crash skips
+            # ahead to the batched high-water mark
+            with self.lock:
+                dirty = False
+                for seq in self.sequences.values():
+                    if seq["hwm"] != seq["value"]:
+                        seq["hwm"] = seq["value"]
+                        dirty = True
+                if dirty:
+                    self._persist_sequences()
             self.store.release()
         from .search.analysis import drop_dictionary
         for name in self._tsdict_names:
@@ -172,11 +183,15 @@ class Database(TableResolver):
             types = [dt.type_from_name(c["type"]) for c in tdef["columns"]]
             batch = self.store.read_snapshot(tdef["id"], names, types)
             t = StoredTable(name, batch, key, tdef["id"])
+            import base64
+            import pickle
             t.table_meta = {
                 "engine": tdef.get("engine", "columnar"),
                 "primary_key": tdef.get("primary_key", []),
                 "not_null": tdef.get("not_null", []),
-                "defaults": {},
+                "defaults": {n: pickle.loads(base64.b64decode(b))
+                             for n, b in
+                             (tdef.get("defaults") or {}).items()},
                 "tokenizers": tdef.get("tokenizers", {}),
                 "options": tdef.get("options", {}),
             }
@@ -1243,7 +1258,11 @@ class Connection:
     def _table_for_dml(self, parts: list[str],
                        privilege: str = "insert",
                        txn_route: bool = True) -> MemTable:
-        provider = self.db.resolve_table(parts, privilege)
+        try:
+            provider = self.db.resolve_table(parts, privilege)
+        except _ViewRef:
+            raise errors.SqlError(
+                "55000", f'cannot modify view "{parts[-1]}"')
         if not isinstance(provider, MemTable):
             raise errors.SqlError(errors.FEATURE_NOT_SUPPORTED,
                                   "cannot modify this table")
@@ -1380,6 +1399,10 @@ class Connection:
             binder = ExprBinder(Scope([]), params)
             one = Batch(["__dummy"], [Column.from_pylist([0])])
             cols_vals: list[list] = [[] for _ in target_names]
+            # epoch-int types (INTERVAL/DATE/TIMESTAMP) must keep their
+            # bound type: re-inferring from the raw int would type interval
+            # micros as BIGINT and then refuse the BIGINT→INTERVAL cast
+            cols_types: list = [None] * len(target_names)
             for row in st.values:
                 if len(row) != len(target_names):
                     raise errors.SqlError(
@@ -1387,10 +1410,22 @@ class Connection:
                         if len(row) > len(target_names)
                         else "INSERT has more target columns than expressions")
                 for k, e in enumerate(row):
+                    if isinstance(e, ast.DefaultMarker):
+                        dv, dvt = _default_typed(table, target_names[k])
+                        cols_vals[k].append(dv)
+                        if dvt is not None and dvt.id in (
+                                dt.TypeId.INTERVAL, dt.TypeId.DATE,
+                                dt.TypeId.TIMESTAMP):
+                            cols_types[k] = dvt
+                        continue
                     b = binder.bind(e)
                     cols_vals[k].append(b.eval(one).decode(0))
+                    if b.type.id in (dt.TypeId.INTERVAL, dt.TypeId.DATE,
+                                     dt.TypeId.TIMESTAMP):
+                        cols_types[k] = b.type
             incoming = Batch(list(target_names),
-                             [Column.from_pylist(v) for v in cols_vals])
+                             [Column.from_pylist(v, cols_types[k])
+                              for k, v in enumerate(cols_vals)])
         if st.on_conflict is not None:
             pk = _pk_of(table)
             return self._insert_with_pk(st, table, incoming, pk, params)
@@ -1428,6 +1463,7 @@ class Connection:
             return QueryResult(Batch([], []), tag)
         with self.db.lock:
             aligned = _align_to_schema(table, incoming)
+            _check_not_null(table, aligned)
             key_cols_new = [aligned.column(c).to_pylist() for c in pk]
             _check_pk_not_null(pk, key_cols_new, aligned.num_rows)
             existing = _pk_map(table, pk)
@@ -1581,11 +1617,19 @@ class Connection:
                     raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                           f'column "{col_name}" does not exist')
                 target_t = full.column(col_name).type
+                if isinstance(e, ast.DefaultMarker):
+                    dv, dvt = _default_typed(table, col_name)
+                    new_cols[col_name] = _coerce(
+                        Column.from_pylist([dv] * n, dvt), target_t) \
+                        if dv is not None else \
+                        Column.from_pylist([None] * n, target_t)
+                    continue
                 val = _coerce(binder.bind(e).eval(full), target_t)
                 new_cols[col_name] = val.take(rows)
             upd_cols = [new_cols.get(nm, c)
                         for nm, c in zip(updated.names, updated.columns)]
             updated = Batch(list(updated.names), upd_cols)
+            _check_not_null(table, updated)
             pk = _pk_of(table)
             if pk:
                 # new keys must be unique among themselves AND against the
@@ -2007,6 +2051,7 @@ class Connection:
     def _insert_batch(self, table: MemTable, incoming: Batch) -> Batch:
         with self.db.lock:
             aligned = _align_to_schema(table, incoming)
+            _check_not_null(table, aligned)
             pk = _pk_of(table)
             if pk:
                 key_cols = [aligned.column(c).to_pylist() for c in pk]
@@ -2110,16 +2155,55 @@ def _default_returning_name(e: ast.Expr) -> str:
     return "?column?"
 
 
+def _default_value(table: MemTable, name: str):
+    """Evaluate a column's DEFAULT expression to a constant (None if the
+    column has no default). Defaults are constant-foldable expressions."""
+    v, _t = _default_typed(table, name)
+    return v
+
+
+def _default_typed(table: MemTable, name: str):
+    """(value, bound SqlType|None) of a column's DEFAULT — the type matters
+    for epoch-int families (DATE/TIMESTAMP/INTERVAL) where the raw int
+    would otherwise re-infer as BIGINT and then refuse the cast."""
+    d = (getattr(table, "table_meta", None) or {}).get("defaults", {})
+    e = d.get(name)
+    if e is None:
+        return None, None
+    from .sql.binder import ExprBinder, Scope
+    b = ExprBinder(Scope([]), [])
+    one = Batch(["__d"], [Column.from_pylist([0])])
+    bound = b.bind(e)
+    return bound.eval(one).decode(0), bound.type
+
+
+def _check_not_null(table: MemTable, aligned: Batch):
+    """Enforce NOT NULL column constraints (PG 23502)."""
+    nn = (getattr(table, "table_meta", None) or {}).get("not_null", [])
+    for name in nn:
+        if name not in aligned.names:
+            continue
+        col = aligned.column(name)
+        if col.validity is not None and not col.valid_mask().all():
+            raise errors.SqlError(
+                "23502", f'null value in column "{name}" of relation '
+                         f'"{table.name}" violates not-null constraint')
+
+
 def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:
     """Project incoming rows onto the table schema: coerce types, fill
-    missing columns with NULL. The aligned batch is what goes to the WAL, so
-    replay needs no re-coercion."""
+    missing columns with their DEFAULT (NULL when none). The aligned batch
+    is what goes to the WAL, so replay needs no re-coercion."""
     cols = []
     for name, t in zip(table.column_names, table.column_types):
         if name in incoming.names:
             cols.append(_coerce(incoming.column(name), t))
         else:
-            cols.append(Column.from_pylist([None] * incoming.num_rows, t))
+            dv, dvt = _default_typed(table, name)
+            cols.append(_coerce(
+                Column.from_pylist([dv] * incoming.num_rows, dvt), t)
+                if dv is not None else
+                Column.from_pylist([None] * incoming.num_rows, t))
     return Batch(list(table.column_names), cols)
 
 
@@ -2199,6 +2283,10 @@ def _inline_view(sel: ast.Select, view: ViewDef) -> ast.Select:
         if isinstance(ref, ast.JoinRef):
             ref.left = rewrite(ref.left)
             ref.right = rewrite(ref.right)
+        if isinstance(ref, ast.SubqueryRef) and ref.query.from_ is not None:
+            # view-over-view: an earlier inlining produced this subquery;
+            # the view reference to replace now lives inside it
+            ref.query.from_ = rewrite(ref.query.from_)
         return ref
     import copy
     sel2 = copy.deepcopy(sel)

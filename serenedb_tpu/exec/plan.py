@@ -559,7 +559,9 @@ class AggregateNode(PlanNode):
             ufunc.at(acc, vc, vals)
             acc = np.where(empty, 0, acc).astype(arg.type.np_dtype)
             return Column(arg.type, acc, ~empty if empty.any() else None)
-        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance"):
+        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance",
+                         "stddev_pop", "var_pop"):
+            pop = spec.func.endswith("_pop")
             s1 = np.zeros(g)
             s2 = np.zeros(g)
             fv = vals.astype(np.float64)
@@ -567,9 +569,10 @@ class AggregateNode(PlanNode):
             np.add.at(s2, vc, fv * fv)
             cnt = counts.astype(np.float64)
             with np.errstate(invalid="ignore", divide="ignore"):
-                var = (s2 - s1 * s1 / cnt) / (cnt - 1)
-            bad = counts < 2
-            data = np.sqrt(var) if spec.func.startswith("stddev") else var
+                var = (s2 - s1 * s1 / cnt) / (cnt if pop else cnt - 1)
+            bad = counts < (1 if pop else 2)
+            data = np.sqrt(np.maximum(var, 0.0)) \
+                if spec.func.startswith("stddev") else var
             return Column(dt.DOUBLE, np.where(bad, 0.0, data),
                           ~bad if bad.any() else None)
         if spec.func in ("bool_and", "bool_or"):
@@ -665,7 +668,7 @@ class _ScalarAcc:
             return
         self.count += n_valid
         if spec.func in ("sum", "avg", "stddev", "stddev_samp", "var_samp",
-                         "variance"):
+                         "variance", "stddev_pop", "var_pop"):
             vals = col.data[valid]
             if col.type.is_integer or col.type.id is dt.TypeId.BOOL:
                 self.sum_i += int(vals.astype(np.int64).sum())
@@ -722,10 +725,13 @@ class _ScalarAcc:
         if spec.func == "max":
             v = self.max_v
             return Column.from_pylist([v.item() if hasattr(v, "item") else v], t)
-        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance"):
-            if self.count < 2:
+        if spec.func in ("stddev", "stddev_samp", "var_samp", "variance",
+                         "stddev_pop", "var_pop"):
+            pop = spec.func.endswith("_pop")
+            if self.count < (1 if pop else 2):
                 return Column.from_pylist([None], t)
-            var = (self.sum_sq - self.sum_f ** 2 / self.count) / (self.count - 1)
+            var = (self.sum_sq - self.sum_f ** 2 / self.count) / \
+                (self.count if pop else self.count - 1)
             v = math.sqrt(max(var, 0.0)) if spec.func.startswith("stddev") else var
             return Column.from_pylist([v], t)
         if spec.func in ("bool_and", "bool_or"):

@@ -33,6 +33,7 @@ class WindowSpec:
     partition_by: list[BoundExpr]
     order_by: list[tuple[BoundExpr, bool]]   # (expr, desc)
     type: dt.SqlType
+    default: Optional[object] = None   # lag/lead 3rd arg (PG default NULL)
 
 
 def window_result_type(func: str, arg_type: Optional[dt.SqlType]) -> dt.SqlType:
@@ -173,10 +174,14 @@ class WindowNode(PlanNode):
             clipped = np.clip(src_idx, 0, max(n - 1, 0))
             if n:
                 same_part = ok & (s_codes[clipped] == s_codes)
+            fill = spec.default if spec.default is not None else 0
             result = np.where(same_part, vals[clipped] if vals is not None
-                              else 0, 0)
+                              else 0, fill)
             res_valid = same_part & (valid[clipped] if valid is not None
                                      else True)
+            if spec.default is not None:
+                # rows outside the partition take the default VALUE
+                res_valid = res_valid | ~same_part
         elif f in ("first_value", "last_value"):
             if f == "first_value":
                 result = vals[part_start] if vals is not None else None

@@ -342,7 +342,30 @@ def _concat_resolver(ts):
 
 
 _REGISTRY["op||"] = _concat_resolver
-_REGISTRY["concat"] = _concat_resolver
+
+
+def _concat_skip_nulls(ts):
+    """concat(...) ignores NULL arguments (PG); only || propagates them."""
+    def impl(cols, n):
+        parts = []
+        for c in cols:
+            valid = c.valid_mask() if c.validity is not None else None
+            if c.type.is_string:
+                vals = string_values(c)
+            else:
+                vals = np.asarray([_pg_text(v) for v in c.to_pylist()],
+                                  dtype=object).astype(str)
+            if valid is not None:
+                vals = np.where(valid, vals, "")
+            parts.append(vals)
+        data = parts[0]
+        for p in parts[1:]:
+            data = np.char.add(data, p)
+        return make_string_column(data, None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["concat"] = _concat_skip_nulls
 
 
 def _pg_text(v) -> str:
@@ -357,7 +380,9 @@ def _pg_text(v) -> str:
 
 # -- math functions --------------------------------------------------------
 
-def _unary_math(np_fn, out_type=None, domain_check=None):
+def _unary_math(np_fn, out_type=None, domain=None, domain_msg=""):
+    """domain: predicate over the input array; rows where a VALID input
+    falls outside it raise (PG: sqrt(-1)/ln(0) are errors, not NaN)."""
     def resolver(ts):
         if len(ts) != 1 or not _all_numeric(ts):
             return None
@@ -365,6 +390,15 @@ def _unary_math(np_fn, out_type=None, domain_check=None):
                          else dt.DOUBLE)
         def impl(cols, n):
             x = cols[0].data.astype(np.float64 if t == dt.DOUBLE else t.np_dtype)
+            if domain is not None:
+                valid = cols[0].valid_mask() \
+                    if cols[0].validity is not None else None
+                bad = ~domain(x)
+                if valid is not None:
+                    bad &= valid
+                if bad.any():
+                    raise errors.SqlError("2201F", domain_msg or
+                                          "input is out of range")
             with np.errstate(all="ignore"):
                 data = np_fn(x)
             return _result(t, data, cols)
@@ -389,18 +423,45 @@ def _round(ts):
 
 
 for name, fn in [("floor", np.floor), ("ceil", np.ceil), ("ceiling", np.ceil),
-                 ("sqrt", np.sqrt), ("ln", np.log), ("log10", np.log10),
                  ("exp", np.exp), ("sin", np.sin), ("cos", np.cos),
-                 ("tan", np.tan), ("asin", np.arcsin), ("acos", np.arccos),
-                 ("atan", np.arctan), ("degrees", np.degrees),
-                 ("radians", np.radians), ("trunc", np.trunc)]:
+                 ("tan", np.tan), ("atan", np.arctan),
+                 ("degrees", np.degrees), ("radians", np.radians),
+                 ("trunc", np.trunc), ("cbrt", np.cbrt)]:
     _REGISTRY[name] = _unary_math(fn)
+
+_REGISTRY["sqrt"] = _unary_math(
+    np.sqrt, domain=lambda x: x >= 0,
+    domain_msg="cannot take square root of a negative number")
+_REGISTRY["ln"] = _unary_math(
+    np.log, domain=lambda x: x > 0,
+    domain_msg="cannot take logarithm of zero or a negative number")
+_REGISTRY["log10"] = _unary_math(
+    np.log10, domain=lambda x: x > 0,
+    domain_msg="cannot take logarithm of zero or a negative number")
+_REGISTRY["asin"] = _unary_math(np.arcsin, domain=lambda x: np.abs(x) <= 1)
+_REGISTRY["acos"] = _unary_math(np.arccos, domain=lambda x: np.abs(x) <= 1)
+
+
+@register("factorial")
+def _factorial(ts):
+    def impl(cols, n):
+        import math as _math
+        vals = cols[0].data.astype(np.int64)
+        if (vals < 0).any():
+            raise errors.SqlError("2201F",
+                                  "factorial of a negative number")
+        data = np.asarray([_math.factorial(int(v)) if int(v) < 21 else 0
+                           for v in vals], dtype=np.int64)
+        if (vals > 20).any():
+            raise errors.SqlError("22003", "factorial out of BIGINT range")
+        return _result(dt.BIGINT, data, cols)
+    return FunctionResolution(dt.BIGINT, impl)
 
 
 @register("log")
 def _log(ts):
     if len(ts) == 1:
-        return _unary_math(np.log10)(ts)
+        return _REGISTRY["log10"](ts)
     def impl(cols, n):
         base = cols[0].data.astype(np.float64)
         x = cols[1].data.astype(np.float64)
@@ -591,6 +652,71 @@ _REGISTRY["lpad"] = lambda ts: _pad_impl(ts, left_side=True)
 _REGISTRY["rpad"] = lambda ts: _pad_impl(ts, left_side=False)
 
 
+@register("initcap")
+def _initcap(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        out = [v.title() for v in s]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("ascii")
+def _ascii(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        data = np.asarray([ord(v[0]) if v else 0 for v in s],
+                          dtype=np.int32)
+        return _result(dt.INT, data, cols)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("chr")
+def _chr(ts):
+    def impl(cols, n):
+        k = cols[0].data.astype(np.int64)
+        valid = cols[0].valid_mask() \
+            if cols[0].validity is not None else None
+        bad = k <= 0
+        if valid is not None:
+            bad &= valid
+        if bad.any():
+            raise errors.SqlError("54000", "null character not permitted")
+        out = [chr(int(v)) if v > 0 else "" for v in k]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("md5")
+def _md5(ts):
+    def impl(cols, n):
+        import hashlib
+        s = string_values(cols[0])
+        out = [hashlib.md5(v.encode()).hexdigest() for v in s]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("translate")
+def _translate(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        frm = string_values(cols[1])
+        to = string_values(cols[2])
+        out = []
+        for v, f, t in zip(s, frm, to):
+            # chars beyond len(to) are deleted (PG semantics)
+            table = {ord(c): (t[i] if i < len(t) else None)
+                     for i, c in enumerate(f)}
+            out.append(v.translate(table))
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
 @register("left")
 def _left(ts):
     def impl(cols, n):
@@ -763,7 +889,9 @@ _REGISTRY["least"] = _make_extreme(False)
 # -- date/time -------------------------------------------------------------
 
 _EXTRACT_FIELDS = {"year", "month", "day", "hour", "minute", "second", "dow",
-                   "doy", "epoch", "quarter", "week"}
+                   "isodow", "doy", "epoch", "quarter", "week", "century",
+                   "millennium", "millisecond", "milliseconds",
+                   "microsecond", "microseconds"}
 
 
 @register("extract")
@@ -818,9 +946,35 @@ def _extract(ts):
             data = dts.astype(np.int64) / 1e6
         elif field == "dow":
             data = ((dts.astype("datetime64[D]").astype(np.int64) + 4) % 7).astype(np.float64)
+        elif field == "isodow":
+            # PG: Monday=1 … Sunday=7
+            data = ((dts.astype("datetime64[D]").astype(np.int64) + 3) % 7
+                    + 1).astype(np.float64)
+        elif field == "doy":
+            data = ((dts.astype("datetime64[D]") -
+                     dts.astype("datetime64[Y]").astype("datetime64[D]"))
+                    .astype(np.int64) + 1).astype(np.float64)
+        elif field == "week":
+            # ISO 8601 week number: the week containing the year's first
+            # Thursday is week 1
+            days = dts.astype("datetime64[D]").astype(np.int64)
+            # Thursday of each date's ISO week (Mon-based week start)
+            thu = days - (days + 3) % 7 + 3
+            thu_d = thu.astype("datetime64[D]")
+            year_start = thu_d.astype("datetime64[Y]").astype("datetime64[D]")
+            data = ((thu - year_start.astype(np.int64)) // 7
+                    + 1).astype(np.float64)
         elif field == "quarter":
             m = dts.astype("datetime64[M]").astype(np.int64) % 12
             data = (m // 3 + 1).astype(np.float64)
+        elif field == "century":
+            data = np.ceil(Y / 100.0)
+        elif field == "millennium":
+            data = np.ceil(Y / 1000.0)
+        elif field in ("millisecond", "milliseconds"):
+            data = (dts.astype(np.int64) % 60_000_000) / 1e3
+        elif field in ("microsecond", "microseconds"):
+            data = (dts.astype(np.int64) % 60_000_000).astype(np.float64)
         else:
             raise errors.unsupported(f"extract field {field!r}")
         return _result(dt.DOUBLE, data, cols[1:])
@@ -1089,6 +1243,37 @@ def _make_date(ts):
     return FunctionResolution(dt.DATE, impl)
 
 
+@register("make_timestamp")
+def _make_timestamp(ts):
+    if len(ts) != 6:
+        return None
+
+    def impl(cols, n):
+        y, mo, d, h, mi = (cols[k].data.astype(np.int64) for k in range(5))
+        sec = cols[5].data.astype(np.float64)
+        valid = propagate_nulls(cols)
+        out = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue
+            try:
+                day_us = np.datetime64(
+                    f"{y[i]:04d}-{mo[i]:02d}-{d[i]:02d}", "D") \
+                    .astype("datetime64[us]").astype(np.int64)
+            except ValueError:
+                raise errors.SqlError(
+                    "22008", f"date field value out of range: "
+                             f"{y[i]}-{mo[i]}-{d[i]}")
+            if not (0 <= h[i] < 24 and 0 <= mi[i] < 60
+                    and 0 <= sec[i] < 60):
+                raise errors.SqlError(
+                    "22008", "time field value out of range")
+            out[i] = day_us + (h[i] * 3600 + mi[i] * 60) * 1_000_000 \
+                + int(round(sec[i] * 1e6))
+        return _result(dt.TIMESTAMP, out, cols)
+    return FunctionResolution(dt.TIMESTAMP, impl)
+
+
 # -- json (documents stored as TEXT; reference: functions/json.cpp) --------
 
 def _json_extract_impl(ts, as_text: bool):
@@ -1186,8 +1371,8 @@ def _json_getelem_impl(ts, as_text: bool):
         out, missing = [], np.zeros(n, dtype=bool)
         for i in range(n):
             doc, cur = docs[i], None
-            if doc is not None:
-                k = _json_scalar(keys, i)
+            k = _json_scalar(keys, i)
+            if doc is not None and k is not None:
                 if key_is_int and isinstance(doc, list):
                     k = int(k)
                     if -len(doc) <= k < len(doc):
@@ -1260,16 +1445,20 @@ _REGISTRY["json_getpath_text"] = \
     lambda ts: _json_getpath_impl(ts, as_text=True)
 
 
-def _jsonb_contains(a, b) -> bool:
+def _jsonb_contains(a, b, top: bool = True) -> bool:
     """PG jsonb containment: objects pairwise-recursive; arrays ⊇ every
-    RHS element; top-level array contains RHS scalar; scalars by equality."""
+    RHS element; a TOP-LEVEL array contains an RHS scalar (the one special
+    case — nested values must match in kind); scalars by equality."""
     if isinstance(a, dict) and isinstance(b, dict):
-        return all(k in a and _jsonb_contains(a[k], v)
+        return all(k in a and _jsonb_contains(a[k], v, top=False)
                    for k, v in b.items())
     if isinstance(a, list) and isinstance(b, list):
-        return all(any(_jsonb_contains(x, y) for x in a) for y in b)
-    if isinstance(a, list):
-        return any(_jsonb_contains(x, b) for x in a)
+        return all(any(_jsonb_contains(x, y, top=False) for x in a)
+                   for y in b)
+    if isinstance(a, list) and top:
+        return any(_jsonb_contains(x, b, top=False) for x in a)
+    if isinstance(a, (dict, list)) or isinstance(b, (dict, list)):
+        return False
     return type(a) is type(b) and a == b or \
         (isinstance(a, (int, float)) and not isinstance(a, bool)
          and isinstance(b, (int, float)) and not isinstance(b, bool)

@@ -49,9 +49,12 @@ def build_btree_index(provider, column: str, using: str,
 
 
 def find_btree_index(provider, column: str):
-    for idx in getattr(provider, "indexes", {}).values():
-        if isinstance(idx, BtreeIndex) and idx.column == column and \
-                idx.data_version == provider.data_version:
+    for name, idx in getattr(provider, "indexes", {}).items():
+        if isinstance(idx, BtreeIndex) and idx.column == column:
+            if idx.data_version != provider.data_version:
+                idx = build_btree_index(provider, idx.column, idx.using,
+                                        idx.options)
+                provider.indexes[name] = idx
             return idx
     return None
 
@@ -139,10 +142,15 @@ def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
 
 
 def find_index(provider, column: str):
-    """The freshest inverted index covering `column`, or None (stale indexes
-    — data_version behind the provider — are skipped, not used wrongly)."""
-    for idx in getattr(provider, "indexes", {}).values():
-        if idx.using == "inverted" and column in idx.columns and \
-                idx.data_version == provider.data_version:
+    """The inverted index covering `column`, or None. A stale index
+    (data_version behind the provider) is refreshed IN PLACE before use —
+    read-repair. Skipping it instead would silently fall back to a brute
+    scan with the DEFAULT analyzer, diverging from the column's tokenizer
+    (and the maintenance loop only narrows, never closes, that window)."""
+    for name, idx in getattr(provider, "indexes", {}).items():
+        if idx.using == "inverted" and column in idx.columns:
+            if idx.data_version != provider.data_version:
+                idx = refresh_index(provider, idx)
+                provider.indexes[name] = idx
             return idx
     return None

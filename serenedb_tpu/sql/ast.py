@@ -220,6 +220,12 @@ class ArraySubquery(Expr):
 
 
 @dataclass
+class DefaultMarker(Expr):
+    """Bare DEFAULT in INSERT VALUES / UPDATE SET — replaced by the
+    column's default expression (or NULL) at execution."""
+
+
+@dataclass
 class ColumnDef:
     name: str
     type_name: str

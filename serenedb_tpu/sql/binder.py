@@ -80,6 +80,7 @@ def _subst_colrefs(node, mapping: dict):
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_star",
              "stddev", "stddev_samp", "var_samp", "variance",
+             "stddev_pop", "var_pop",
              "string_agg", "array_agg", "bool_and", "bool_or"}
 AGG_TWO_ARG = {"string_agg"}
 
@@ -90,6 +91,9 @@ class ScopeColumn:
     name: str
     type: dt.SqlType
     index: int
+    #: JOIN USING merges key columns: the non-merged side's copy stays
+    #: qualified-resolvable but is skipped for bare names and SELECT *
+    hidden: bool = False
 
 
 @dataclass
@@ -105,7 +109,11 @@ class Scope:
     def resolve(self, parts: list[str]) -> ScopeColumn:
         if len(parts) == 1:
             name = parts[0]
-            matches = [c for c in self.columns if c.name.lower() == name.lower()]
+            matches = [c for c in self.columns
+                       if c.name.lower() == name.lower() and not c.hidden]
+            if not matches:   # only hidden copies exist: take the first
+                matches = [c for c in self.columns
+                           if c.name.lower() == name.lower()][:1]
         elif len(parts) == 2:
             tbl, name = parts
             matches = [c for c in self.columns
@@ -126,7 +134,7 @@ class Scope:
 
     def star_columns(self, table: Optional[str] = None) -> list[ScopeColumn]:
         if table is None:
-            return list(self.columns)
+            return [c for c in self.columns if not c.hidden]
         out = [c for c in self.columns
                if c.table and c.table.lower() == table.lower()]
         if not out:
@@ -613,7 +621,7 @@ def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
     if name == "count":
         return dt.BIGINT
     if name in ("sum", "avg", "stddev", "stddev_samp", "var_samp",
-                "variance") and not (
+                "variance", "stddev_pop", "var_pop") and not (
             arg_t.is_numeric or arg_t.id is dt.TypeId.NULL):
         # without this, the engine would silently aggregate dictionary
         # CODES of a string column (PG: 42883 function sum(text)...)
@@ -624,7 +632,8 @@ def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
         if arg_t.is_integer:
             return dt.BIGINT
         return dt.DOUBLE if arg_t.id is not dt.TypeId.NULL else dt.DOUBLE
-    if name in ("avg", "stddev", "stddev_samp", "var_samp", "variance"):
+    if name in ("avg", "stddev", "stddev_samp", "var_samp", "variance",
+                "stddev_pop", "var_pop"):
         return dt.DOUBLE
     if name in ("min", "max"):
         return arg_t
@@ -724,7 +733,9 @@ def format_interval(us: int) -> str:
     se, frac = divmod(rem, 1_000_000)
     parts = []
     if days:
-        parts.append(f"{sign}{days} day" + ("s" if days != 1 else ""))
+        # PG pluralizes negative day counts ('-1 days -02:00:00')
+        parts.append(f"{sign}{days} day" +
+                     ("s" if days != 1 or sign else ""))
     if h or mi or se or frac or not days:
         clock = f"{sign}{h:02d}:{mi:02d}:{se:02d}"
         if frac:
@@ -838,8 +849,21 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         return Column(target, data, validity)
     if target.is_float:
         return Column(target, col.data.astype(target.np_dtype), validity)
+    if src.id is dt.TypeId.DATE and target.id is dt.TypeId.TIMESTAMP:
+        # days → µs at midnight (NOT a raw reinterpretation)
+        data = col.data.astype(np.int64) * 86_400_000_000
+        return Column(target, data, validity)
+    if src.id is dt.TypeId.TIMESTAMP and target.id is dt.TypeId.DATE:
+        # µs → days, flooring (negative timestamps floor toward -∞)
+        data = np.floor_divide(col.data.astype(np.int64),
+                               86_400_000_000).astype(np.int32)
+        return Column(target, data, validity)
     if target.id in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE,
                      dt.TypeId.INTERVAL):
+        if src.id not in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE,
+                          dt.TypeId.INTERVAL, dt.TypeId.NULL):
+            raise errors.SqlError(
+                "42846", f"cannot cast type {src} to {target}")
         return Column(target, col.data.astype(target.np_dtype), validity)
     raise errors.unsupported(f"cast {src} -> {target}")
 

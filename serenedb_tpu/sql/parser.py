@@ -554,7 +554,13 @@ class Parser:
                     elif quant == "ALL" and op in ("<>", "!="):
                         left = ast.InSubquery(left, sub, True)
                     else:
-                        raise errors.unsupported(f"{op} {quant} (subquery)")
+                        # general op ANY/ALL (subquery): gather the
+                        # subquery column and fold with the same
+                        # three-valued __quant_cmp as the array form
+                        left = ast.FuncCall(
+                            "__quant_cmp",
+                            [ast.Literal(op), ast.Literal(quant), left,
+                             ast.ArraySubquery(sub)])
                     continue
                 arr = self.parse_expr()
                 self.expect_op(")")
@@ -598,20 +604,22 @@ class Parser:
                 return left
 
     def parse_unary(self) -> ast.Expr:
-        if self.accept_op("-"):
-            return ast.UnaryOp("-", self.parse_unary())
-        if self.accept_op("+"):
-            return self.parse_unary()
-        return self.parse_power()
-
-    def parse_power(self) -> ast.Expr:
-        # PG: ^ binds tighter than * and is left-associative
-        left = self.parse_postfix()
+        # PG precedence: unary minus binds TIGHTER than ^ (gram.y UMINUS),
+        # so -2^2 = (-2)^2 = 4; the ^ loop therefore sits ABOVE the unary
+        # parser and below * (parse_multiplicative calls parse_unary)
+        left = self._parse_signed()
         while self.at_op("^"):
             self.next()
-            right = self.parse_postfix()
+            right = self._parse_signed()
             left = ast.FuncCall("power", [left, right])
         return left
+
+    def _parse_signed(self) -> ast.Expr:
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self._parse_signed())
+        if self.accept_op("+"):
+            return self._parse_signed()
+        return self.parse_postfix()
 
     def parse_postfix(self) -> ast.Expr:
         e = self.parse_primary()
@@ -1116,9 +1124,9 @@ class Parser:
             self.expect_op(")")
         if self.at_kw("VALUES"):
             self.next()
-            rows = [self._parse_paren_exprs()]
+            rows = [self._parse_insert_row()]
             while self.accept_op(","):
-                rows.append(self._parse_paren_exprs())
+                rows.append(self._parse_insert_row())
             oc = self._parse_on_conflict()
             return ast.Insert(table, columns, rows,
                               returning=self._parse_returning(),
@@ -1130,6 +1138,21 @@ class Parser:
                               returning=self._parse_returning(),
                               on_conflict=oc)
         raise errors.syntax("expected VALUES or SELECT in INSERT")
+
+    def _parse_insert_row(self) -> list[ast.Expr]:
+        """A VALUES row where a bare DEFAULT element is allowed."""
+        self.expect_op("(")
+        exprs = []
+        while True:
+            if self.at_kw("DEFAULT"):
+                self.next()
+                exprs.append(ast.DefaultMarker())
+            else:
+                exprs.append(self.parse_expr())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return exprs
 
     def _parse_on_conflict(self) -> Optional[tuple]:
         if not self.at_kw("ON"):
@@ -1180,7 +1203,11 @@ class Parser:
         while True:
             col = self.ident()
             self.expect_op("=")
-            assigns.append((col, self.parse_expr()))
+            if self.at_kw("DEFAULT"):
+                self.next()
+                assigns.append((col, ast.DefaultMarker()))
+            else:
+                assigns.append((col, self.parse_expr()))
             if not self.accept_op(","):
                 break
         where = self.parse_expr() if self.accept_kw("WHERE") else None

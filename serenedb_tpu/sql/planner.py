@@ -264,8 +264,10 @@ class Planner:
         right, rscope = self._plan_from(ref.right)
         n_left = len(lscope.columns)
         combined = Scope(
-            list(lscope.columns) +
-            [ScopeColumn(c.table, c.name, c.type, c.index + n_left)
+            [ScopeColumn(c.table, c.name, c.type, c.index, c.hidden)
+             for c in lscope.columns] +
+            [ScopeColumn(c.table, c.name, c.type, c.index + n_left,
+                         c.hidden)
              for c in rscope.columns])
         names = _dedup_names([c.name for c in combined.columns])
         types = [c.type for c in combined.columns]
@@ -278,6 +280,17 @@ class Planner:
                 rc = rscope.resolve([col])
                 left_keys.append(BoundColumn(lc.index, lc.type, lc.name))
                 right_keys.append(BoundColumn(rc.index, rc.type, rc.name))
+                # PG: USING merges the key column — hide the non-merged
+                # side's copy from bare-name resolution and SELECT *
+                # (right joins keep the right side, others the left)
+                hide_right = ref.kind != "right"
+                for c in combined.columns:
+                    if c.name.lower() != col.lower():
+                        continue
+                    if hide_right and c.index >= n_left:
+                        c.hidden = True
+                    elif not hide_right and c.index < n_left:
+                        c.hidden = True
         elif ref.condition is not None:
             residual_parts = []
             for c in _split_conjuncts(ref.condition):
@@ -446,6 +459,7 @@ class Planner:
                     f"window function {fname}() does not exist")
             arg = None
             extra = None
+            default = None
             if fname == "ntile":
                 if not w.func.args or not (
                         isinstance(w.func.args[0], ast.Literal) and
@@ -464,6 +478,18 @@ class Planner:
                         raise errors.unsupported(
                             f"{fname} offset must be a constant")
                     extra = off.value
+                if len(w.func.args) > 2:
+                    dv = w.func.args[2]
+                    neg = isinstance(dv, ast.UnaryOp) and dv.op == "-"
+                    if neg:
+                        dv = dv.operand
+                    if not isinstance(dv, ast.Literal):
+                        raise errors.unsupported(
+                            f"{fname} default must be a constant")
+                    default = -dv.value if neg else dv.value
+                    if isinstance(default, str):
+                        raise errors.unsupported(
+                            f"{fname} string default not supported")
             elif fname in ("count",) and (w.func.star or not w.func.args):
                 arg = None
             elif w.func.args:
@@ -475,7 +501,8 @@ class Planner:
             order = [(bind_order(oi.expr), oi.desc) for oi in w.order_by]
             specs.append(WindowSpec(
                 fname, arg, extra, partition, order,
-                window_result_type(fname, arg.type if arg else None)))
+                window_result_type(fname, arg.type if arg else None),
+                default=default))
         node = WindowNode(plan, specs)
         # preserve the child scope's table qualifiers; only the appended
         # #winN columns are unqualified

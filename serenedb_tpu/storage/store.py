@@ -83,6 +83,7 @@ class Store:
         """Atomic catalog write: tmp + fsync + rename (the definitions
         equivalent of the reference's transactional WriteContext batches)."""
         faults.if_failure("catalog_write_error")
+        faults.crash_if_armed("crash_before_catalog_write")
         with self._lock:
             tmp = self.catalog_path + ".tmp"
             with open(tmp, "w") as f:
@@ -181,6 +182,8 @@ def table_def(name_key: str, table_id: int, names: list[str],
     """start_tick must be the store's current tick at creation: a freshly
     created table must never replay WAL records of an earlier same-named
     (dropped) table."""
+    import base64
+    import pickle
     return {
         "id": table_id,
         "columns": [{"name": n, "type": serialize_type(t)}
@@ -189,6 +192,10 @@ def table_def(name_key: str, table_id: int, names: list[str],
         "options": meta.get("options", {}),
         "primary_key": meta.get("primary_key", []),
         "not_null": meta.get("not_null", []),
+        # DEFAULT expressions persist as pickled ASTs (same encoding as
+        # view definitions)
+        "defaults": {n: base64.b64encode(pickle.dumps(e)).decode()
+                     for n, e in (meta.get("defaults") or {}).items()},
         "tokenizers": meta.get("tokenizers", {}),
         "checkpoint_tick": start_tick,
     }

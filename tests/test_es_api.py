@@ -76,7 +76,10 @@ def test_index_lifecycle_and_docs(srv):
     assert status == 200
     hits = body["hits"]["hits"]
     assert body["hits"]["total"]["value"] == 2
-    assert {h["_id"] for h in hits} == {"1", hits[1]["_id"]}
+    ids = {h["_id"] for h in hits}
+    assert "1" in ids and len(ids) == 2   # doc 1 + the auto-id doc
+    scores = [h["_score"] for h in hits]
+    assert scores == sorted(scores, reverse=True)
 
     # range + bool
     status, body = req(srv, "POST", "/books/_search", {

@@ -161,17 +161,19 @@ def test_sql_topk_scored(sql_conn):
     assert all(s > 0 for s in scores)
 
 
-def test_sql_index_stale_after_insert_falls_back(sql_conn):
+def test_sql_index_stale_after_insert_read_repairs(sql_conn):
     sql_conn.execute("CREATE INDEX ON docs USING inverted (body)")
     sql_conn.execute("INSERT INTO docs VALUES (9999, 'zzzuniqueterm here')")
-    # stale index must NOT be used (data_version mismatch) — brute force
+    # a stale index (data_version mismatch) is refreshed in place and
+    # USED — falling back to a brute scan would silently analyze with the
+    # default analyzer instead of the column's tokenizer
     assert sql_conn.execute(
         "SELECT count(*) FROM docs WHERE body @@ 'zzzuniqueterm'"
     ).scalar() == 1
     ex = sql_conn.execute(
         "EXPLAIN SELECT count(*) FROM docs WHERE body @@ 'zzzuniqueterm'"
     ).rows()
-    assert not any("SearchScan" in r[0] for r in ex)
+    assert any("SearchScan" in r[0] for r in ex)
 
 
 def test_sql_mixed_predicate_residual(sql_conn):

@@ -12,6 +12,7 @@ tests/sqllogic/{any,sdb,pg,recovery}/ — SURVEY.md §4):
 Every non-recovery file runs twice: on a fresh in-memory database and on a
 fresh durable datadir (close/reopen covered by recovery files)."""
 
+import contextlib
 import glob
 import os
 
@@ -35,10 +36,23 @@ def _ids(files):
     return [os.path.relpath(f, _ROOT) for f in files]
 
 
+@contextlib.contextmanager
+def _scratch_cwd(tmp_path):
+    """Relative COPY TO/FROM paths in test files land in the test's tmp
+    dir, never the repo root."""
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        yield
+    finally:
+        os.chdir(old)
+
+
 @pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
-def test_sqllogic_memory(path):
+def test_sqllogic_memory(path, tmp_path):
     conn = Database().connect()
-    failures = run_test_file(conn, path)
+    with _scratch_cwd(tmp_path):
+        failures = run_test_file(conn, path)
     assert not failures, "\n".join(failures)
 
 
@@ -46,7 +60,8 @@ def test_sqllogic_memory(path):
 def test_sqllogic_durable(path, tmp_path):
     db = Database(str(tmp_path / "data"))
     try:
-        failures = run_test_file(db.connect(), path)
+        with _scratch_cwd(tmp_path):
+            failures = run_test_file(db.connect(), path)
         assert not failures, "\n".join(failures)
     finally:
         db.close()
@@ -72,8 +87,10 @@ def test_sqllogic_recovery(path, tmp_path):
         return state["db"].connect()
 
     try:
-        failures = run_test_file(state["db"].connect(), path,
-                                 reopen=reopen, crash_reopen=crash_reopen)
+        with _scratch_cwd(tmp_path):
+            failures = run_test_file(state["db"].connect(), path,
+                                     reopen=reopen,
+                                     crash_reopen=crash_reopen)
         assert not failures, "\n".join(failures)
     finally:
         faults.set_crash_mode("exit")
