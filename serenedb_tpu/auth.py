@@ -25,6 +25,8 @@ class Roles:
             SUPERUSER: {"password": None, "login": True, "superuser": True}}
         # acls[table_key][role] = set of privileges
         self.acls: dict[str, dict[str, set]] = {}
+        # memberships[member] = roles granted to it (GRANT role TO member)
+        self.memberships: dict[str, set] = {}
 
     # -- role management ---------------------------------------------------
 
@@ -86,6 +88,9 @@ class Roles:
             del self.roles[key]
             for acl in self.acls.values():
                 acl.pop(key, None)
+            self.memberships.pop(key, None)
+            for g in self.memberships.values():
+                g.discard(key)
 
     def exists(self, name: str) -> bool:
         with self._lock:
@@ -155,6 +160,40 @@ class Roles:
             else:
                 cur |= privs
 
+    def grant_role(self, granted: str, member: str,
+                   revoke: bool = False):
+        """Role membership: `GRANT granted TO member` — member inherits
+        granted's privileges transitively (reference: auth::RoleClosure,
+        server/auth/role_closure.cpp)."""
+        granted, member = granted.lower(), member.lower()
+        with self._lock:
+            for r in (granted, member):
+                if r not in self.roles:
+                    raise errors.SqlError(
+                        errors.UNDEFINED_OBJECT,
+                        f'role "{r}" does not exist')
+            ms = self.memberships.setdefault(member, set())
+            if revoke:
+                ms.discard(granted)
+            else:
+                if member in self._closure(granted):
+                    raise errors.SqlError(
+                        "0LP01", f'role "{member}" is a member of role '
+                                 f'"{granted}"')  # cycle
+                ms.add(granted)
+
+    def _closure(self, role: str) -> set:
+        """role + every role reachable through memberships (under lock
+        or on a consistent snapshot)."""
+        out, stack = set(), [role]
+        while stack:
+            r = stack.pop()
+            if r in out:
+                continue
+            out.add(r)
+            stack.extend(self.memberships.get(r, ()))
+        return out
+
     def allowed(self, role: str, table_key: str, privilege: str) -> bool:
         role = role.lower()
         with self._lock:
@@ -162,8 +201,9 @@ class Roles:
             if r and r.get("superuser"):
                 return True
             acl = self.acls.get(table_key, {})
-            if privilege in acl.get(role, ()):
-                return True
+            for g in self._closure(role):
+                if privilege in acl.get(g, ()):
+                    return True
             return privilege in acl.get("public", ())
 
     def require(self, role: str, table_key: str, privilege: str):
@@ -180,6 +220,8 @@ class Roles:
                 "roles": {k: dict(v) for k, v in self.roles.items()},
                 "acls": {t: {r: sorted(p) for r, p in acl.items()}
                          for t, acl in self.acls.items()},
+                "memberships": {m: sorted(g)
+                                for m, g in self.memberships.items() if g},
             }
 
     def load_meta(self, meta: dict):
@@ -191,3 +233,5 @@ class Roles:
                     {"password": None, "login": True, "superuser": True})
             self.acls = {t: {r: set(p) for r, p in acl.items()}
                          for t, acl in meta.get("acls", {}).items()}
+            self.memberships = {m: set(g) for m, g in
+                                meta.get("memberships", {}).items()}

@@ -900,6 +900,13 @@ class Connection:
             self._persist_auth()
             return QueryResult(Batch([], []), "DROP ROLE")
         if isinstance(st, ast.GrantRevoke):
+            if st.granted_role is not None:
+                self.db.roles.grant_role(st.granted_role, st.role,
+                                         revoke=not st.grant)
+                self._persist_auth()
+                return QueryResult(Batch([], []),
+                                   "GRANT ROLE" if st.grant
+                                   else "REVOKE ROLE")
             schema, name = self.db._split(st.table)
             try:
                 self.db.resolve_table(st.table)  # must exist
@@ -2153,13 +2160,6 @@ def _default_returning_name(e: ast.Expr) -> str:
     if isinstance(e, ast.FuncCall):
         return e.name
     return "?column?"
-
-
-def _default_value(table: MemTable, name: str):
-    """Evaluate a column's DEFAULT expression to a constant (None if the
-    column has no default). Defaults are constant-foldable expressions."""
-    v, _t = _default_typed(table, name)
-    return v
 
 
 def _default_typed(table: MemTable, name: str):

@@ -570,6 +570,9 @@ class AggregateNode(PlanNode):
             cnt = counts.astype(np.float64)
             with np.errstate(invalid="ignore", divide="ignore"):
                 var = (s2 - s1 * s1 / cnt) / (cnt if pop else cnt - 1)
+            # float cancellation can drive the variance fractionally
+            # negative (PG clamps to zero)
+            var = np.maximum(var, 0.0)
             bad = counts < (1 if pop else 2)
             data = np.sqrt(np.maximum(var, 0.0)) \
                 if spec.func.startswith("stddev") else var
@@ -730,8 +733,8 @@ class _ScalarAcc:
             pop = spec.func.endswith("_pop")
             if self.count < (1 if pop else 2):
                 return Column.from_pylist([None], t)
-            var = (self.sum_sq - self.sum_f ** 2 / self.count) / \
-                (self.count if pop else self.count - 1)
+            var = max((self.sum_sq - self.sum_f ** 2 / self.count) /
+                      (self.count if pop else self.count - 1), 0.0)
             v = math.sqrt(max(var, 0.0)) if spec.func.startswith("stddev") else var
             return Column.from_pylist([v], t)
         if spec.func in ("bool_and", "bool_or"):

@@ -346,6 +346,8 @@ _REGISTRY["op||"] = _concat_resolver
 
 def _concat_skip_nulls(ts):
     """concat(...) ignores NULL arguments (PG); only || propagates them."""
+    if not ts:
+        return None   # concat() with no args: 42883, like PG
     def impl(cols, n):
         parts = []
         for c in cols:
@@ -678,12 +680,13 @@ def _chr(ts):
         k = cols[0].data.astype(np.int64)
         valid = cols[0].valid_mask() \
             if cols[0].validity is not None else None
-        bad = k <= 0
+        bad = (k <= 0) | (k > 0x10FFFF)
         if valid is not None:
             bad &= valid
         if bad.any():
-            raise errors.SqlError("54000", "null character not permitted")
-        out = [chr(int(v)) if v > 0 else "" for v in k]
+            raise errors.SqlError(
+                "54000", "character number must be between 1 and 1114111")
+        out = [chr(int(v)) if 0 < v <= 0x10FFFF else "" for v in k]
         return make_string_column(np.asarray(out, dtype=object).astype(str),
                                   propagate_nulls(cols))
     return FunctionResolution(dt.VARCHAR, impl)

@@ -155,6 +155,15 @@ def type_name_of(oid: int) -> Optional[str]:
     return _TYPE_NAME_BY_OID.get(int(oid))
 
 
+def regtype_render(oid: int) -> str:
+    """regtype → text renders the CANONICAL SQL name ('integer', not
+    'int4') — PG's format_type() behavior."""
+    name = _FORMAT_TYPE.get(int(oid))
+    if name is not None:
+        return name
+    return type_name_of(oid) or str(int(oid))
+
+
 def resolve_namespace_oid(db, text: str) -> int:
     """'::regnamespace' cast: schema name → pg_namespace oid."""
     from . import errors

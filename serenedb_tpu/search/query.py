@@ -229,11 +229,14 @@ def _parse_unary(toks, an):
         return QPhrase(terms), toks[1:]
     if t.startswith("/") and t.endswith("/") and len(t) > 1:
         return QRegex(t[1:-1], case_fold=_folds_case(an)), toks[1:]
-    if t.endswith("*") and len(t) > 1:
-        # fold only when the analyzer folds bare terms: under keyword/
+    if (t.endswith("*") or t.endswith(":*")) and len(t) > 1:
+        # Lucene-style `pre*` and PG tsquery `pre:*` both spell prefix.
+        # Fold only when the analyzer folds bare terms: under keyword/
         # whitespace analyzers stored terms keep their case
-        base = t[:-1].lower() if _folds_case(an) else t[:-1]
-        return QPrefix(base), toks[1:]
+        base = t[:-2] if t.endswith(":*") else t[:-1]
+        base = base.lower() if _folds_case(an) else base
+        if base:
+            return QPrefix(base), toks[1:]
     if "~" in t and len(t) > 1:
         base, _, edits = t.partition("~")
         terms_f = [tok.term for tok in an.tokenize(base)]

@@ -100,10 +100,21 @@ def bind_function(binder, e):
     if name in ("ts_offsets", "ts_headline"):
         # reference: byte-range highlight via per-row re-analysis
         # (server/connector/highlight/memory_index.*)
-        if len(e.args) != 2:
-            raise errors.syntax(f"{name}(column, query) takes 2 arguments")
-        args = [binder.bind(a) for a in e.args]
         headline = name == "ts_headline"
+        n_args = 3 if headline else 2
+        if not 2 <= len(e.args) <= n_args:
+            raise errors.syntax(
+                f"{name}(column, query[, options]) takes "
+                f"{n_args} arguments at most")
+        args = [binder.bind(a) for a in e.args]
+
+        def _hl_opts(spec: str) -> dict:
+            # PG ts_headline options string: 'StartSel=[, StopSel=]'
+            out = {}
+            for part in spec.split(","):
+                k, _, v = part.partition("=")
+                out[k.strip().lower()] = v.strip()
+            return out
 
         def impl(cols, batch, _headline=headline):
             import json
@@ -138,7 +149,13 @@ def bind_function(binder, e):
                          if token_matches(t.term, terms, prefixes,
                                           fuzzies, regexes)]
                 if _headline:
-                    out.append(_hl(an, texts[i], queries[i], spans=spans))
+                    start_sel, stop_sel = "<b>", "</b>"
+                    if len(cols) > 2:
+                        opts = _hl_opts(string_values(cols[2])[i])
+                        start_sel = opts.get("startsel", start_sel)
+                        stop_sel = opts.get("stopsel", stop_sel)
+                    out.append(_hl(an, texts[i], queries[i], spans=spans,
+                                   start_sel=start_sel, stop_sel=stop_sel))
                 else:
                     out.append(json.dumps(spans))
             col = make_string_column(

@@ -62,7 +62,7 @@ def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:
         # PG renders reg* as names in text format (binary stays the oid)
         from .. import pgcatalog as _pgcat
         if tid is dt.TypeId.REGTYPE:
-            s = _pgcat.type_name_of(value) or str(int(value))
+            s = _pgcat.regtype_render(value)
         elif tid is dt.TypeId.REGPROC:
             s = _pgcat.proc_name_of(value) or str(int(value))
         elif tid is dt.TypeId.REGNAMESPACE:

@@ -416,8 +416,9 @@ class AlterRole(Statement):
 class GrantRevoke(Statement):
     grant: bool                       # True=GRANT, False=REVOKE
     privileges: list[str]             # select/insert/update/delete/all
-    table: list[str]
+    table: list[str]                  # [] for role-membership grants
     role: str
+    granted_role: Optional[str] = None   # GRANT <granted_role> TO <role>
 
 
 @dataclass

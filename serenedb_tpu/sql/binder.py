@@ -794,7 +794,7 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         return Column(target, out, validity)
     if src.id in _REG and target.is_string:
         from ..pgcatalog import (current_db, namespace_render, proc_name_of,
-                                 regclass_render, type_name_of)
+                                 regclass_render, regtype_render)
         db = current_db()
         vals = col.to_pylist()
         out = []
@@ -802,7 +802,7 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
             if v is None:
                 out.append("")
             elif src.id is dt.TypeId.REGTYPE:
-                out.append(type_name_of(v) or str(int(v)))
+                out.append(regtype_render(int(v)))
             elif src.id is dt.TypeId.REGPROC:
                 out.append(proc_name_of(v) or str(int(v)))
             elif src.id is dt.TypeId.REGNAMESPACE:

@@ -163,6 +163,12 @@ class Parser:
             privs = [self.ident().lower()]
             while self.accept_op(","):
                 privs.append(self.ident().lower())
+            if self.at_kw("TO" if grant else "FROM") and len(privs) == 1:
+                # GRANT <role> TO <member> — role membership
+                self.next()
+                member = self.ident()
+                return ast.GrantRevoke(grant, [], [], member,
+                                       granted_role=privs[0])
             self.expect_kw("ON")
             self.accept_kw("TABLE")
             table = self.qualified_name()

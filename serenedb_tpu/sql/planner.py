@@ -487,9 +487,12 @@ class Planner:
                         raise errors.unsupported(
                             f"{fname} default must be a constant")
                     default = -dv.value if neg else dv.value
-                    if isinstance(default, str):
+                    if isinstance(default, str) or arg.type.is_string:
+                        # a numeric default on a dictionary-coded string
+                        # column would be injected as a raw code
                         raise errors.unsupported(
-                            f"{fname} string default not supported")
+                            f"{fname} default over a text column is not "
+                            "supported")
             elif fname in ("count",) and (w.func.star or not w.func.args):
                 arg = None
             elif w.func.args:

@@ -146,7 +146,8 @@ def test_regclass_casts(conn):
 
 
 def test_regtype_regproc(conn):
-    assert conn.execute("SELECT 23::regtype::text").scalar() == "int4"
+    # PG renders regtype as the canonical SQL name (format_type)
+    assert conn.execute("SELECT 23::regtype::text").scalar() == "integer"
     assert conn.execute("SELECT 'integer'::regtype::int").scalar() == 23
     assert conn.execute(
         "SELECT 'bigint'::regtype = 20::regtype").scalar() is True
