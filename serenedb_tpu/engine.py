@@ -92,6 +92,11 @@ class Database(TableResolver):
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self.lock = threading.RLock()
+        #: signalled when a parallel-ingest fast-path commit publishes;
+        #: mutating ops / checkpoints wait on it until a table has no
+        #: committed-but-unpublished inserts (shares self.lock so waiting
+        #: releases the DML lock for the publisher)
+        self.publish_cond = threading.Condition(self.lock)
         self.schemas: dict[str, SchemaObj] = {"main": SchemaObj("main")}
         self.sequences: dict[str, dict] = {}
         # parquet providers are cached by path so repeated queries reuse the
@@ -926,13 +931,18 @@ class Connection:
                     raise errors.SqlError(errors.UNDEFINED_OBJECT,
                                           f'role "{st.name}" does not exist')
                 target = st.name.lower()
-                # only a superuser session may assume another role (no role
-                # membership model yet) — SET ROLE must never escalate
+                # PG: a session may SET ROLE to itself, to any role it is
+                # a member of (transitive), or anything if superuser —
+                # never an escalation beyond the membership closure
                 if target != self.session_role and \
                         not self.db.roles.is_superuser(self.session_role):
-                    raise errors.SqlError(
-                        errors.INSUFFICIENT_PRIVILEGE,
-                        f'permission denied to set role "{st.name}"')
+                    with self.db.roles._lock:
+                        member_of = self.db.roles._closure(
+                            self.session_role)
+                    if target not in member_of:
+                        raise errors.SqlError(
+                            errors.INSUFFICIENT_PRIVILEGE,
+                            f'permission denied to set role "{st.name}"')
                 self.current_role = target
             return QueryResult(Batch([], []), "SET")
         if isinstance(st, ast.AlterTable):
@@ -1175,6 +1185,7 @@ class Connection:
                 return QueryResult(Batch([], []), "ALTER TABLE")
             raise
         with self.db.lock:
+            self._wait_quiesced(table)
             full = table.full_batch()
             names = list(full.names)
             if st.action == "add_column":
@@ -1261,6 +1272,24 @@ class Connection:
                                     st.column in v["columns"])}
                 self.db.store.update_meta(mutate)
         return QueryResult(Batch([], []), "ALTER TABLE")
+
+    def _wait_quiesced(self, table) -> None:
+        """Block (releasing the DML lock) until `table` has no committed-
+        but-unpublished fast-path inserts. MUST be called while holding
+        db.lock; on return the lock is held and no new in-flight commit can
+        register until it is released. Mutating ops and checkpoint capture
+        call this so they never order between a fast-path commit's WAL
+        tick and its in-memory publish (which would make live state
+        diverge from replayed state)."""
+        table._quiesce_waiters = getattr(table, "_quiesce_waiters", 0) + 1
+        try:
+            while getattr(table, "_inflight", 0):
+                self.db.publish_cond.wait(timeout=5)
+        finally:
+            # new fast-path registrations gate on _quiesce_waiters, so a
+            # sustained insert stream cannot starve a waiting mutator
+            table._quiesce_waiters -= 1
+            self.db.publish_cond.notify_all()
 
     def _table_for_dml(self, parts: list[str],
                        privilege: str = "insert",
@@ -1569,6 +1598,7 @@ class Connection:
         if st.returning:
             self.db.resolve_table(st.table, "select")
         with self.db.lock:
+            self._wait_quiesced(table)
             full = table.full_batch()
             if st.where is None:
                 rows = np.arange(full.num_rows, dtype=np.int64)
@@ -1603,6 +1633,7 @@ class Connection:
         if st.returning:
             self.db.resolve_table(st.table, "select")
         with self.db.lock:
+            self._wait_quiesced(table)
             full = table.full_batch()
             scope = Scope.of(list(full.names), [c.type for c in full.columns],
                              st.table[-1])
@@ -1673,6 +1704,7 @@ class Connection:
     def _truncate(self, st: ast.Truncate) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
         with self.db.lock:
+            self._wait_quiesced(table)
             self._wal_commit(table, [("truncate", None, None)])
             table.replace(table.full_batch().slice(0, 0))
         return QueryResult(Batch([], []), "TRUNCATE TABLE")
@@ -1835,6 +1867,7 @@ class Connection:
         for t in targets:
             if isinstance(t, StoredTable) and self.db.store is not None:
                 with self.db.lock:  # batch+tick must be captured atomically
+                    self._wait_quiesced(t)
                     batch = t.full_batch()
                     tick = self.db.store.ticks.current()
                 self.db.store.checkpoint_table(t.key, t.table_id, batch,
@@ -2073,11 +2106,35 @@ class Connection:
                             "unique constraint "
                             f"(key columns: {', '.join(pk)})")
                     seen.add(key)
-            self._wal_commit(table, [("insert", aligned, None)])
-            _append_rows(table, aligned)
-            if pk:
+                self._wal_commit(table, [("insert", aligned, None)])
+                _append_rows(table, aligned)
                 _pk_map_extend(table, key_cols, aligned.num_rows)
-            return aligned
+                return aligned
+            # give way to any mutator waiting to quiesce this table —
+            # without this gate a sustained insert stream starves it
+            while getattr(table, "_quiesce_waiters", 0):
+                self.db.publish_cond.wait(timeout=5)
+            table._inflight = getattr(table, "_inflight", 0) + 1
+        # parallel-ingest fast path (no PK to reserve): the WAL encode +
+        # group-commit fsync run OUTSIDE the DML lock so concurrent bulk
+        # INSERTs overlap their compression and share fsyncs (reference:
+        # ParallelSink per-thread ChunkWriters,
+        # duckdb_physical_search_insert.cpp:107-369). Publish order may
+        # differ from tick order ONLY relative to other appends (harmless:
+        # PG guarantees no row order); table-mutating ops and checkpoints
+        # quiesce in-flight commits first via _wait_quiesced, so they can
+        # never order between a fast-path commit's tick and its publish.
+        # The _inflight increment above (under db.lock) opened the window;
+        # the publish below closes it and wakes any waiting mutator.
+        try:
+            self._wal_commit(table, [("insert", aligned, None)])
+            with self.db.lock:
+                _append_rows(table, aligned)
+        finally:
+            with self.db.lock:
+                table._inflight -= 1
+                self.db.publish_cond.notify_all()
+        return aligned
 
     def _wal_commit(self, table: MemTable, ops: list[tuple]):
         """Durably log (kind, batch, rows) ops for a stored table before the

@@ -574,8 +574,7 @@ class AggregateNode(PlanNode):
             # negative (PG clamps to zero)
             var = np.maximum(var, 0.0)
             bad = counts < (1 if pop else 2)
-            data = np.sqrt(np.maximum(var, 0.0)) \
-                if spec.func.startswith("stddev") else var
+            data = np.sqrt(var) if spec.func.startswith("stddev") else var
             return Column(dt.DOUBLE, np.where(bad, 0.0, data),
                           ~bad if bad.any() else None)
         if spec.func in ("bool_and", "bool_or"):
@@ -735,7 +734,7 @@ class _ScalarAcc:
                 return Column.from_pylist([None], t)
             var = max((self.sum_sq - self.sum_f ** 2 / self.count) /
                       (self.count if pop else self.count - 1), 0.0)
-            v = math.sqrt(max(var, 0.0)) if spec.func.startswith("stddev") else var
+            v = math.sqrt(var) if spec.func.startswith("stddev") else var
             return Column.from_pylist([v], t)
         if spec.func in ("bool_and", "bool_or"):
             return Column.from_pylist([self.bool_acc], t)

@@ -136,10 +136,10 @@ class Store:
 
     def commit(self, ops: list[WalOp]) -> int:
         """Durably log one commit; returns its tick. The caller applies the
-        ops to memory AFTER this returns (WAL-then-publish, §3.4)."""
-        tick = self.ticks.next()
-        self.wal.append_commit(CommitRecord(tick, ops))
-        return tick
+        ops to memory AFTER this returns (WAL-then-publish, §3.4). Tick
+        assignment happens inside the WAL's group-commit queue so WAL file
+        order always matches tick order."""
+        return self.wal.commit_ops(ops, self.ticks)
 
     def checkpoint_table(self, key: str, table_id: int, batch: Batch,
                          tick: int) -> None:

@@ -5,10 +5,17 @@ cross-table commits are atomic; tick-banded records; zstd-compressed inline
 chunks; 16 MB segment seal; GC by min committed tick; delta replay on boot
 (reference: server/search/search_db_wal.h:50-205, .cpp, SURVEY.md §3.4/§3.5).
 
-Record frame: [u32 len][u32 crc32(payload)][payload]; payload is a zstd-1
-compressed msgpack-less JSON header + arrow-IPC chunk blobs:
+Record frame: [u32 len][u32 crc32(tick||payload)][u64 tick][payload];
+payload is a zstd-1 compressed JSON header + arrow-IPC chunk blobs:
 
-    {tick, ops: [{table, kind: insert|delete|truncate, ...}]}
+    {ops: [{table, kind: insert|delete|truncate, ...}]}
+
+The tick lives OUTSIDE the compressed payload so the expensive encoding
+(arrow IPC + zstd — the reference's per-thread ChunkWriter work,
+duckdb_physical_search_insert.cpp:107-369) happens before the tick is
+assigned: concurrent committers encode in parallel, enqueue (tick order ==
+queue order), and a group-commit leader writes every pending frame with
+ONE fsync.
 
 Commit protocol (mirrors SearchTableTransaction::Commit,
 search_table_transaction.cpp:117-211):
@@ -55,10 +62,21 @@ class CommitRecord:
     ops: list[WalOp]
 
 
-def _encode_record(rec: CommitRecord) -> bytes:
-    header = {"tick": rec.tick, "ops": []}
+@dataclass
+class _Pending:
+    """One queued group-commit entry."""
+    done: threading.Event
+    tick: int = 0
+    payload: bytes = b""
+    error: Optional[BaseException] = None
+
+
+def _encode_ops(ops: list[WalOp]) -> bytes:
+    """Encode a commit's ops (tick-independent — the expensive leg, done
+    OUTSIDE any commit lock)."""
+    header = {"ops": []}
     blobs: list[bytes] = []
-    for op in rec.ops:
+    for op in ops:
         entry = {"table": op.table, "kind": op.kind}
         if op.batch is not None:
             blob = batch_to_bytes(op.batch)
@@ -77,7 +95,7 @@ def _encode_record(rec: CommitRecord) -> bytes:
     return zstandard.ZstdCompressor(level=1).compress(raw)
 
 
-def _decode_record(payload: bytes) -> CommitRecord:
+def _decode_record(tick: int, payload: bytes) -> CommitRecord:
     raw = zstandard.ZstdDecompressor().decompress(payload)
     off = 0
     (hlen,) = struct.unpack_from("<I", raw, off)
@@ -99,7 +117,7 @@ def _decode_record(payload: bytes) -> CommitRecord:
         rows = np.asarray(entry["rows"], dtype=np.int64) \
             if "rows" in entry else None
         ops.append(WalOp(entry["table"], entry["kind"], batch, rows))
-    return CommitRecord(header["tick"], ops)
+    return CommitRecord(tick, ops)
 
 
 class SearchDbWal:
@@ -109,6 +127,11 @@ class SearchDbWal:
         self.dir = wal_dir
         os.makedirs(wal_dir, exist_ok=True)
         self._lock = threading.Lock()
+        # group-commit queue: (tick, payload, Event) triples appended under
+        # _pending_lock (tick order == queue order); a leader holding _lock
+        # drains and writes all of them with one fsync
+        self._pending_lock = threading.Lock()
+        self._pending: list = []
         self._fh = None
         self._gen = 0
         self._bytes = 0
@@ -148,23 +171,72 @@ class SearchDbWal:
 
     # -- commit ------------------------------------------------------------
 
-    def append_commit(self, rec: CommitRecord) -> None:
-        """Durably append one commit record (fsync before returning)."""
+    def commit_ops(self, ops: list[WalOp], ticks) -> int:
+        """Durably commit ops; returns the assigned tick. Encoding happens
+        before the tick is assigned (parallel across committers); the tick
+        is taken under the queue lock so queue order == tick order; a
+        group-commit leader writes every queued frame with one fsync
+        (reference: parallel sink ChunkWriters combined at Finalize,
+        duckdb_physical_search_insert.h:46-61)."""
         faults.if_failure("search_wal_append_error")
         faults.crash_if_armed("crash_before_search_wal_commit")
-        payload = _encode_record(rec)
-        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
-        with self._lock:
-            self._open_for_append()
-            self._fh.write(frame)
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
-            self._bytes += len(frame)
-            self._seg_max_tick[self._gen] = max(
-                self._seg_max_tick.get(self._gen, 0), rec.tick)
-            self._seal_if_needed()
+        payload = _encode_ops(ops)
+        entry = _Pending(threading.Event())
+        with self._pending_lock:
+            tick = ticks.next()
+            entry.tick = tick
+            entry.payload = payload
+            self._pending.append(entry)
+        while not entry.done.is_set():
+            with self._lock:
+                if entry.done.is_set():
+                    break
+                with self._pending_lock:
+                    batch, self._pending = self._pending, []
+                if not batch:
+                    continue
+                try:
+                    self._open_for_append()
+                    max_tick = 0
+                    for e in batch:
+                        tb = struct.pack("<Q", e.tick)
+                        frame = _HDR.pack(
+                            len(e.payload),
+                            zlib.crc32(tb + e.payload)) + tb + e.payload
+                        self._fh.write(frame)
+                        self._bytes += len(frame)
+                        max_tick = max(max_tick, e.tick)
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._seg_max_tick[self._gen] = max(
+                        self._seg_max_tick.get(self._gen, 0), max_tick)
+                    self._seal_if_needed()
+                except BaseException as exc:
+                    # the leader must fail EVERY drained follower — their
+                    # frames were lost with this write and they would
+                    # otherwise spin forever on an empty queue
+                    for e in batch:
+                        e.error = exc
+                        e.done.set()
+                    raise
+                for e in batch:
+                    e.done.set()
+        if entry.error is not None:
+            raise entry.error
         metrics.WAL_COMMITS.add()
         faults.crash_if_armed("crash_after_search_wal_commit")
+        return tick
+
+    def append_commit(self, rec: CommitRecord) -> None:
+        """Single-record append at a caller-chosen tick (tests/tools; the
+        engine path is commit_ops)."""
+        class _Fixed:
+            def __init__(self, t):
+                self.t = t
+
+            def next(self):
+                return self.t
+        self.commit_ops(rec.ops, _Fixed(rec.tick))
 
     # -- recovery ----------------------------------------------------------
 
@@ -185,14 +257,15 @@ class SearchDbWal:
                 data = f.read()
             off = 0
             seg_max = 0
-            while off + _HDR.size <= len(data):
+            while off + _HDR.size + 8 <= len(data):
                 ln, crc = _HDR.unpack_from(data, off)
-                start = off + _HDR.size
+                start = off + _HDR.size + 8      # u64 tick after the crc
                 end = start + ln
                 torn = end > len(data)
                 if not torn:
+                    tick_bytes = data[off + _HDR.size:start]
                     payload = data[start:end]
-                    torn = zlib.crc32(payload) != crc
+                    torn = zlib.crc32(tick_bytes + payload) != crc
                 if torn:
                     if gi != len(gens) - 1:
                         raise errors.SqlError(
@@ -204,7 +277,8 @@ class SearchDbWal:
                         f.truncate(off)
                     self._seg_max_tick[gen] = seg_max
                     return max_tick
-                rec = _decode_record(payload)
+                rec = _decode_record(
+                    struct.unpack("<Q", tick_bytes)[0], payload)
                 max_tick = max(max_tick, rec.tick)
                 seg_max = max(seg_max, rec.tick)
                 for op in rec.ops:

@@ -10,7 +10,7 @@ import pytest
 
 from serenedb_tpu.columnar.column import Batch
 from serenedb_tpu.storage.wal import (CommitRecord, SearchDbWal, WalOp,
-                                      _decode_record, _encode_record)
+                                      _decode_record, _encode_ops)
 
 
 def test_wal_record_roundtrip():
@@ -19,7 +19,7 @@ def test_wal_record_roundtrip():
                            WalOp("main.t", "delete",
                                  rows=np.array([0, 2])),
                            WalOp("main.u", "truncate")])
-    out = _decode_record(_encode_record(rec))
+    out = _decode_record(rec.tick, _encode_ops(rec.ops))
     assert out.tick == 7
     assert [o.kind for o in out.ops] == ["insert", "delete", "truncate"]
     assert out.ops[0].batch.to_pydict() == b.to_pydict()
