@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
@@ -130,6 +131,20 @@ class Database(TableResolver):
             from .storage.maintenance import MaintenanceManager
             self.maintenance = MaintenanceManager(self)
             self.maintenance.start()
+
+    def wait_quiesced(self, table) -> None:
+        """Block (releasing self.lock via publish_cond) until `table` has
+        no committed-but-unpublished fast-path inserts. MUST be called
+        while holding self.lock; on return the lock is held and no new
+        in-flight commit can register until it is released. The waiters
+        gate keeps a sustained insert stream from starving the caller."""
+        table._quiesce_waiters = getattr(table, "_quiesce_waiters", 0) + 1
+        try:
+            while getattr(table, "_inflight", 0):
+                self.publish_cond.wait(timeout=5)
+        finally:
+            table._quiesce_waiters -= 1
+            self.publish_cond.notify_all()
 
     def crash(self):
         """Abandon this Database as if the process was killed: stop loops
@@ -1159,6 +1174,13 @@ class Connection:
             provider.indexes = {}
         idx_name = st.name or f"{st.table[-1]}_{'_'.join(st.columns)}_idx"
         from .search.index import build_index_for_table
+        if st.using is None:
+            # no USING clause: text columns get the inverted index (this
+            # is a search database), anything else a btree — PG's own
+            # default method
+            first = provider.full_batch([st.columns[0]]) \
+                .column(st.columns[0])
+            st.using = "inverted" if first.type.is_string else "btree"
         options = dict(st.options)
         if st.column_tokenizers:
             # per-column dictionary names; columns WITHOUT one keep the
@@ -1274,22 +1296,10 @@ class Connection:
         return QueryResult(Batch([], []), "ALTER TABLE")
 
     def _wait_quiesced(self, table) -> None:
-        """Block (releasing the DML lock) until `table` has no committed-
-        but-unpublished fast-path inserts. MUST be called while holding
-        db.lock; on return the lock is held and no new in-flight commit can
-        register until it is released. Mutating ops and checkpoint capture
-        call this so they never order between a fast-path commit's WAL
-        tick and its in-memory publish (which would make live state
-        diverge from replayed state)."""
-        table._quiesce_waiters = getattr(table, "_quiesce_waiters", 0) + 1
-        try:
-            while getattr(table, "_inflight", 0):
-                self.db.publish_cond.wait(timeout=5)
-        finally:
-            # new fast-path registrations gate on _quiesce_waiters, so a
-            # sustained insert stream cannot starve a waiting mutator
-            table._quiesce_waiters -= 1
-            self.db.publish_cond.notify_all()
+        """Mutating ops and checkpoint capture quiesce fast-path inserts
+        so they never order between a commit's WAL tick and its publish
+        (which would make live state diverge from replayed state)."""
+        self.db.wait_quiesced(table)
 
     def _table_for_dml(self, parts: list[str],
                        privilege: str = "insert",
@@ -1718,12 +1728,18 @@ class Connection:
                 lambda m: m.__setitem__("auth", auth))
 
     def _set(self, st: ast.SetStmt) -> QueryResult:
-        if st.value == "DEFAULT":
-            self.settings.reset(st.name)
-        else:
-            self.settings.set(st.name, st.value)
-            if st.name == "sdb_faults":
-                faults.arm_from_spec(str(st.value))
+        try:
+            if st.value == "DEFAULT":
+                self.settings.reset(st.name)
+            else:
+                self.settings.set(st.name, st.value)
+                if st.name == "sdb_faults":
+                    faults.arm_from_spec(str(st.value))
+        except KeyError as e:
+            raise errors.SqlError("42704", str(e).strip("'\""))
+        except ValueError as e:
+            raise errors.SqlError(
+                "22023", f'invalid value for parameter "{st.name}": {e}')
         return QueryResult(Batch([], []), "SET")
 
     def _show(self, st: ast.ShowStmt) -> QueryResult:
@@ -1740,7 +1756,10 @@ class Connection:
                 "name": names,
                 "setting": [str(self.settings.get(n)) for n in names]})
             return QueryResult(b, f"SELECT {b.num_rows}")
-        v = self.settings.get(st.name)
+        try:
+            v = self.settings.get(st.name)
+        except KeyError as e:
+            raise errors.SqlError("42704", str(e).strip("'\""))
         b = Batch.from_pydict({st.name: [_setting_text(v)]})
         return QueryResult(b, "SHOW")
 
@@ -2015,6 +2034,12 @@ class Connection:
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
+        if isinstance(st.target, str) and not st.target.startswith(
+                ("http://", "https://", "s3://")) and \
+                not os.path.exists(st.target):
+            raise errors.SqlError(
+                "58P01", f'could not open file "{st.target}" for reading: '
+                         "No such file or directory")
         seen = set()
         for c in st.columns or []:
             if c not in table.column_names:
@@ -2115,28 +2140,45 @@ class Connection:
             while getattr(table, "_quiesce_waiters", 0):
                 self.db.publish_cond.wait(timeout=5)
             table._inflight = getattr(table, "_inflight", 0) + 1
+            entry = {"tick": None, "done": False}
+            if not hasattr(table, "_pub_entries"):
+                table._pub_entries = []
+            table._pub_entries.append(entry)
         # parallel-ingest fast path (no PK to reserve): the WAL encode +
         # group-commit fsync run OUTSIDE the DML lock so concurrent bulk
         # INSERTs overlap their compression and share fsyncs (reference:
         # ParallelSink per-thread ChunkWriters,
-        # duckdb_physical_search_insert.cpp:107-369). Publish order may
-        # differ from tick order ONLY relative to other appends (harmless:
-        # PG guarantees no row order); table-mutating ops and checkpoints
-        # quiesce in-flight commits first via _wait_quiesced, so they can
-        # never order between a fast-path commit's tick and its publish.
-        # The _inflight increment above (under db.lock) opened the window;
-        # the publish below closes it and wakes any waiting mutator.
+        # duckdb_physical_search_insert.cpp:107-369). Publishes are
+        # SEQUENCED BY TICK below: DELETE/UPDATE WAL records address rows
+        # positionally, so live row order must equal replay (tick) order.
+        # on_tick runs inside the WAL queue lock, so once this commit
+        # knows its tick every earlier tick is already recorded in
+        # _pub_entries; still-unticked entries are guaranteed LATER.
         try:
-            self._wal_commit(table, [("insert", aligned, None)])
+            self._wal_commit(table, [("insert", aligned, None)],
+                             on_tick=lambda t: entry.__setitem__("tick", t))
             with self.db.lock:
+                while any(e is not entry and not e["done"]
+                          and e["tick"] is not None
+                          and entry["tick"] is not None
+                          and e["tick"] < entry["tick"]
+                          for e in table._pub_entries):
+                    self.db.publish_cond.wait(timeout=5)
                 _append_rows(table, aligned)
+                entry["done"] = True
+                self.db.publish_cond.notify_all()
         finally:
             with self.db.lock:
+                entry["done"] = True
+                try:
+                    table._pub_entries.remove(entry)
+                except ValueError:
+                    pass
                 table._inflight -= 1
                 self.db.publish_cond.notify_all()
         return aligned
 
-    def _wal_commit(self, table: MemTable, ops: list[tuple]):
+    def _wal_commit(self, table: MemTable, ops: list[tuple], on_tick=None):
         """Durably log (kind, batch, rows) ops for a stored table before the
         in-memory publish (WAL-then-apply, reference §3.4). Inside a txn
         the working copy buffers the ops; COMMIT logs them atomically."""
@@ -2149,7 +2191,7 @@ class Connection:
         from .storage.wal import WalOp
         wal_ops = [WalOp(table.key, kind, batch, rows)
                    for kind, batch, rows in ops]
-        self.db.store.commit(wal_ops)
+        self.db.store.commit(wal_ops, on_tick=on_tick)
 
 
 def _apply_ops(table: MemTable, ops: list[tuple]) -> None:

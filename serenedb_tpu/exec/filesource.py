@@ -66,6 +66,10 @@ def expand_glob(path: str) -> list[str]:
             raise errors.SqlError("58P01",
                                   f"no files match {path!r}")
         return matches
+    if not os.path.exists(path):
+        raise errors.SqlError(
+            "58P01", f'could not open file "{path}": '
+                     "No such file or directory")
     return [path]
 
 

@@ -437,13 +437,28 @@ def _dedup(rows: list[tuple]) -> list[tuple]:
 
 class AggregateNode(PlanNode):
     def __init__(self, child: PlanNode, group_exprs: list[BoundExpr],
-                 aggs: list[AggSpec], names: list[str]):
+                 aggs: list[AggSpec], names: list[str] = None):
         self.child = child
         self.group_exprs = group_exprs
         self.aggs = aggs
-        self.names = names
-        self.types = ([g.type for g in group_exprs] +
-                      [a.type for a in aggs])
+        self._names = names
+
+    # names/types derive from the LIVE agg list: ORDER BY / HAVING binding
+    # may append aggregates after construction (ORDER BY sum(x) when
+    # sum(x) is not in the select list), so a constructor-time snapshot
+    # can go stale; explicit names are honored while they still match
+    @property
+    def names(self) -> list[str]:
+        n = len(self.group_exprs) + len(self.aggs)
+        if self._names is not None and len(self._names) == n:
+            return self._names
+        return [f"#g{k}" for k in range(len(self.group_exprs))] + \
+               [f"#agg{k}" for k in range(len(self.aggs))]
+
+    @property
+    def types(self) -> list:
+        return ([g.type for g in self.group_exprs] +
+                [a.type for a in self.aggs])
 
     def children(self):
         return [self.child]

@@ -257,7 +257,43 @@ def _make_arith(op: str):
         def impl(cols, n):
             a, b = cols[0].data, cols[1].data
             extra_invalid = None
-            if op == "+":
+            if op in ("+", "-", "*") and t.is_integer:
+                # compute in int64 and range-check: PG raises 22003 on
+                # int32/int64 overflow instead of silently wrapping
+                aa = a.astype(np.int64)
+                bb = b.astype(np.int64)
+                with np.errstate(over="ignore"):
+                    if op == "+":
+                        data64 = aa + bb
+                        bad = ((aa > 0) & (bb > 0) & (data64 < 0)) | \
+                              ((aa < 0) & (bb < 0) & (data64 > 0))
+                    elif op == "-":
+                        data64 = aa - bb
+                        bad = ((aa >= 0) & (bb < 0) & (data64 < 0)) | \
+                              ((aa < 0) & (bb > 0) & (data64 > 0))
+                    else:
+                        data64 = aa * bb
+                        # verify from BOTH sides: -1 * INT64_MIN wraps and
+                        # the aa-side division wraps back to bb, hiding it
+                        bad = (aa != 0) & (data64 // np.where(aa == 0, 1,
+                                                              aa) != bb)
+                        bad |= (bb != 0) & (
+                            data64 // np.where(bb == 0, 1, bb) != aa)
+                pn = propagate_nulls(cols)
+                if pn is not None:
+                    bad &= pn
+                info = np.iinfo(t.np_dtype)
+                small = (data64 < info.min) | (data64 > info.max)
+                if pn is not None:
+                    small &= pn
+                if bad.any() or small.any():
+                    kind = {np.dtype(np.int16): "smallint",
+                            np.dtype(np.int32): "integer"}.get(
+                        np.dtype(t.np_dtype), "bigint")
+                    raise errors.SqlError(
+                        "22003", f"{kind} out of range")
+                data = data64.astype(t.np_dtype)
+            elif op == "+":
                 data = a.astype(t.np_dtype) + b.astype(t.np_dtype)
             elif op == "-":
                 data = a.astype(t.np_dtype) - b.astype(t.np_dtype)
@@ -982,6 +1018,101 @@ def _extract(ts):
             raise errors.unsupported(f"extract field {field!r}")
         return _result(dt.DOUBLE, data, cols[1:])
     return FunctionResolution(dt.DOUBLE, impl)
+
+
+_MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+           "August", "September", "October", "November", "December"]
+_DAYS = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+         "Saturday", "Sunday"]
+
+#: to_char template patterns, longest-first (reference: PG formatting.c)
+_TO_CHAR_PATS = [
+    ("HH24", lambda d: f"{d.hour:02d}"),
+    ("HH12", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("YYYY", lambda d: f"{d.year:04d}"),
+    ("MONTH", lambda d: _MONTHS[d.month - 1].upper().ljust(9)),
+    ("Month", lambda d: _MONTHS[d.month - 1].ljust(9)),
+    ("month", lambda d: _MONTHS[d.month - 1].lower().ljust(9)),
+    ("DDD", lambda d: f"{d.timetuple().tm_yday:03d}"),
+    ("DAY", lambda d: _DAYS[d.weekday()].upper().ljust(9)),
+    ("Day", lambda d: _DAYS[d.weekday()].ljust(9)),
+    ("day", lambda d: _DAYS[d.weekday()].lower().ljust(9)),
+    ("MON", lambda d: _MONTHS[d.month - 1][:3].upper()),
+    ("Mon", lambda d: _MONTHS[d.month - 1][:3]),
+    ("mon", lambda d: _MONTHS[d.month - 1][:3].lower()),
+    ("DY", lambda d: _DAYS[d.weekday()][:3].upper()),
+    ("Dy", lambda d: _DAYS[d.weekday()][:3]),
+    ("dy", lambda d: _DAYS[d.weekday()][:3].lower()),
+    ("MS", lambda d: f"{d.microsecond // 1000:03d}"),
+    ("US", lambda d: f"{d.microsecond:06d}"),
+    ("HH", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("MM", lambda d: f"{d.month:02d}"),
+    ("DD", lambda d: f"{d.day:02d}"),
+    ("MI", lambda d: f"{d.minute:02d}"),
+    ("SS", lambda d: f"{d.second:02d}"),
+    ("YY", lambda d: f"{d.year % 100:02d}"),
+    ("AM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("PM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("am", lambda d: "am" if d.hour < 12 else "pm"),
+    ("pm", lambda d: "am" if d.hour < 12 else "pm"),
+    ("Q", lambda d: str((d.month - 1) // 3 + 1)),
+]
+
+
+def _to_char_one(dtv, fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == '"':                 # quoted literal section
+            j = fmt.find('"', i + 1)
+            if j < 0:
+                out.append(fmt[i + 1:])
+                break
+            out.append(fmt[i + 1:j])
+            i = j + 1
+            continue
+        for pat, fn in _TO_CHAR_PATS:
+            if fmt.startswith(pat, i):
+                out.append(fn(dtv))
+                i += len(pat)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    return "".join(out)
+
+
+@register("to_char")
+def _to_char(ts):
+    if len(ts) != 2:
+        return None
+    src = ts[0]
+
+    def impl(cols, n):
+        import datetime as _dtmod
+        fmts = string_values(cols[1])
+        valid = propagate_nulls(cols)
+        out = []
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                out.append("")
+                continue
+            v = cols[0].data[i]
+            if src.id is dt.TypeId.DATE:
+                d = _dtmod.datetime(1970, 1, 1) + \
+                    _dtmod.timedelta(days=int(v))
+            elif src.id is dt.TypeId.TIMESTAMP:
+                d = _dtmod.datetime(1970, 1, 1) + \
+                    _dtmod.timedelta(microseconds=int(v))
+            else:
+                # numeric to_char: render the value through the literal
+                # text of the format's 9/0 slots is overkill — print it
+                out.append(str(cols[0].decode(i)))
+                continue
+            out.append(_to_char_one(d, fmts[i]))
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  valid)
+    return FunctionResolution(dt.VARCHAR, impl)
 
 
 @register("to_timestamp")

@@ -840,12 +840,31 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
     if target.id is dt.TypeId.BOOL:
         return Column(target, col.data.astype(bool), validity)
     if target.is_integer:
+        info = np.iinfo(target.np_dtype)
         if src.is_float:
-            # PG rounds half away from zero (np.round is half-to-even)
+            # PG rounds half away from zero (np.round is half-to-even).
+            # Upper bound compares against max+1 (exactly representable in
+            # float64): 'rounded > float(2**63-1)' would promote the bound
+            # to 2.0**63 and let exactly-2**63 slip through and wrap
             x = col.data
-            data = (np.sign(x) * np.floor(np.abs(x) + 0.5)).astype(target.np_dtype)
+            rounded = np.sign(x) * np.floor(np.abs(x) + 0.5)
+            bad = (rounded < float(info.min)) | \
+                (rounded >= float(info.max) + 1.0) | np.isnan(x)
+            # zero out-of-range slots before astype: NULL rows may carry
+            # arbitrary fill values that would wrap or warn
+            data = np.where(bad | ~np.isfinite(rounded),
+                            0.0, rounded).astype(target.np_dtype)
         else:
-            data = col.data.astype(target.np_dtype)
+            x64 = col.data.astype(np.int64)
+            bad = (x64 < info.min) | (x64 > info.max)
+            data = x64.astype(target.np_dtype)
+        if validity is not None:
+            bad = bad & col.valid_mask()
+        if bad.any():
+            kind = {np.dtype(np.int16): "smallint",
+                    np.dtype(np.int32): "integer"}.get(
+                np.dtype(target.np_dtype), "bigint")
+            raise errors.SqlError("22003", f"{kind} out of range")
         return Column(target, data, validity)
     if target.is_float:
         return Column(target, col.data.astype(target.np_dtype), validity)

@@ -480,11 +480,17 @@ class Parser:
                 if self.accept_kw("NULL"):
                     left = ast.IsNull(left, negated)
                 elif self.accept_kw("TRUE"):
-                    cmp = ast.BinaryOp("=", left, ast.Literal(True))
-                    left = ast.UnaryOp("NOT", cmp) if negated else cmp
+                    # IS [NOT] TRUE is null-safe (PG): NULL IS NOT TRUE
+                    # is true, not NULL — spell it with DISTINCT FROM
+                    left = ast.FuncCall(
+                        "is_distinct_from" if negated
+                        else "is_not_distinct_from",
+                        [left, ast.Literal(True)])
                 elif self.accept_kw("FALSE"):
-                    cmp = ast.BinaryOp("=", left, ast.Literal(False))
-                    left = ast.UnaryOp("NOT", cmp) if negated else cmp
+                    left = ast.FuncCall(
+                        "is_distinct_from" if negated
+                        else "is_not_distinct_from",
+                        [left, ast.Literal(False)])
                 elif self.accept_kw("DISTINCT"):
                     self.expect_kw("FROM")
                     right = self.parse_additive_chain()
@@ -912,7 +918,7 @@ class Parser:
                 idx_name = self.ident()
             self.expect_kw("ON")
             table = self.qualified_name()
-            using = "inverted"
+            using = None   # default resolved by column type at exec
             if self.accept_kw("USING"):
                 using = self.ident().lower()
             self.expect_op("(")
@@ -925,7 +931,7 @@ class Parser:
                 # indexes only (reference: USING inverted(text imdb_en));
                 # ASC/DESC stay syntax errors for other index types
                 if self.peek().kind is T.IDENT and not self.at_op(","):
-                    if using != "inverted":
+                    if using is not None and using != "inverted":
                         raise errors.syntax(
                             f"unexpected {self.peek().value!r} in index "
                             "column list")

@@ -111,16 +111,8 @@ class MaintenanceManager:
                 continue
             with self.db.lock:  # batch + tick captured atomically vs DML
                 # committed-but-unpublished fast-path inserts would be
-                # missing from the batch yet covered by the tick: quiesce
-                # (waiters gate keeps a sustained insert stream from
-                # starving this checkpoint)
-                t._quiesce_waiters = getattr(t, "_quiesce_waiters", 0) + 1
-                try:
-                    while getattr(t, "_inflight", 0):
-                        self.db.publish_cond.wait(timeout=5)
-                finally:
-                    t._quiesce_waiters -= 1
-                    self.db.publish_cond.notify_all()
+                # missing from the batch yet covered by the tick
+                self.db.wait_quiesced(t)
                 batch = t.full_batch()
                 version = t.data_version
                 tick = store.ticks.current()

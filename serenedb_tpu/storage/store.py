@@ -134,12 +134,12 @@ class Store:
 
     # -- commit / checkpoint --------------------------------------------------
 
-    def commit(self, ops: list[WalOp]) -> int:
+    def commit(self, ops: list[WalOp], on_tick=None) -> int:
         """Durably log one commit; returns its tick. The caller applies the
         ops to memory AFTER this returns (WAL-then-publish, §3.4). Tick
         assignment happens inside the WAL's group-commit queue so WAL file
         order always matches tick order."""
-        return self.wal.commit_ops(ops, self.ticks)
+        return self.wal.commit_ops(ops, self.ticks, on_tick=on_tick)
 
     def checkpoint_table(self, key: str, table_id: int, batch: Batch,
                          tick: int) -> None:

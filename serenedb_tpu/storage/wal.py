@@ -171,7 +171,7 @@ class SearchDbWal:
 
     # -- commit ------------------------------------------------------------
 
-    def commit_ops(self, ops: list[WalOp], ticks) -> int:
+    def commit_ops(self, ops: list[WalOp], ticks, on_tick=None) -> int:
         """Durably commit ops; returns the assigned tick. Encoding happens
         before the tick is assigned (parallel across committers); the tick
         is taken under the queue lock so queue order == tick order; a
@@ -187,6 +187,12 @@ class SearchDbWal:
             entry.tick = tick
             entry.payload = payload
             self._pending.append(entry)
+            if on_tick is not None:
+                # runs under the queue lock: callers that sequence their
+                # in-memory publishes by tick see every EARLIER-enqueued
+                # commit's tick already recorded (enqueue order == tick
+                # order, and both happen atomically here)
+                on_tick(tick)
         while not entry.done.is_set():
             with self._lock:
                 if entry.done.is_set():

@@ -499,3 +499,42 @@ def test_fast_path_insert_vs_truncate_and_vacuum(tmp_path):
         "SELECT count(*), coalesce(sum(v), 0) FROM t").rows()
     assert rec == live, (live, rec)
     db2.close()
+
+
+def test_fast_path_publish_order_matches_replay(tmp_path):
+    """Review regression: DELETE WAL records are positional, so fast-path
+    publishes MUST land in tick order — distinct per-thread payloads +
+    a positional delete + crash must replay to the IDENTICAL physical
+    row order, or the delete removes different rows after recovery."""
+    import threading
+
+    from serenedb_tpu.engine import Database
+    d = str(tmp_path / "data")
+    db = Database(d)
+    c0 = db.connect()
+    c0.execute("CREATE TABLE t (tid INT, seq INT)")
+    errs = []
+
+    def worker(tid):
+        try:
+            c = db.connect()
+            for s in range(30):
+                c.execute(f"INSERT INTO t VALUES ({tid}, {s})")
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    # positional delete over the live order
+    c0.execute("DELETE FROM t WHERE seq % 3 = 0")
+    live = c0.execute("SELECT tid, seq FROM t").rows()   # physical order
+    db.crash()   # no checkpoint: reopen replays the WAL from scratch
+
+    db2 = Database(d)
+    rec = db2.connect().execute("SELECT tid, seq FROM t").rows()
+    assert rec == live, "replayed row order diverged from live order"
+    db2.close()

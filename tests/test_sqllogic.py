@@ -50,10 +50,13 @@ def _scratch_cwd(tmp_path):
 
 @pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
 def test_sqllogic_memory(path, tmp_path):
-    conn = Database().connect()
-    with _scratch_cwd(tmp_path):
-        failures = run_test_file(conn, path)
-    assert not failures, "\n".join(failures)
+    db = Database()
+    try:
+        with _scratch_cwd(tmp_path):
+            failures = run_test_file(db.connect(), path)
+        assert not failures, "\n".join(failures)
+    finally:
+        db.close()   # releases process-global analyzer registrations
 
 
 @pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
