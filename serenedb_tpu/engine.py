@@ -138,12 +138,24 @@ class Database(TableResolver):
         while holding self.lock; on return the lock is held and no new
         in-flight commit can register until it is released. The waiters
         gate keeps a sustained insert stream from starving the caller."""
-        table._quiesce_waiters = getattr(table, "_quiesce_waiters", 0) + 1
+        self.wait_quiesced_all([table])
+
+    def wait_quiesced_all(self, tables) -> None:
+        """Quiesce SEVERAL tables at once: the waiters gate is raised on
+        every table before waiting, so a fast-path insert cannot slip onto
+        an already-quiesced table while we wait on another (sequential
+        wait_quiesced calls release self.lock between tables, reopening
+        exactly the publish-ahead-of-earlier-tick window the caller is
+        closing). MUST be called holding self.lock."""
+        tables = list(tables)
+        for t in tables:
+            t._quiesce_waiters = getattr(t, "_quiesce_waiters", 0) + 1
         try:
-            while getattr(table, "_inflight", 0):
+            while any(getattr(t, "_inflight", 0) for t in tables):
                 self.publish_cond.wait(timeout=5)
         finally:
-            table._quiesce_waiters -= 1
+            for t in tables:
+                t._quiesce_waiters -= 1
             self.publish_cond.notify_all()
 
     def crash(self):
@@ -1174,13 +1186,18 @@ class Connection:
             provider.indexes = {}
         idx_name = st.name or f"{st.table[-1]}_{'_'.join(st.columns)}_idx"
         from .search.index import build_index_for_table
+        for c in st.columns:
+            if c not in provider.column_names:
+                raise errors.SqlError(errors.UNDEFINED_COLUMN,
+                                      f'column "{c}" does not exist')
         if st.using is None:
             # no USING clause: text columns get the inverted index (this
             # is a search database), anything else a btree — PG's own
-            # default method
-            first = provider.full_batch([st.columns[0]]) \
-                .column(st.columns[0])
-            st.using = "inverted" if first.type.is_string else "btree"
+            # default method. Decided from the declared schema type, not a
+            # full materialization of the column.
+            first_type = provider.column_types[
+                provider.column_names.index(st.columns[0])]
+            st.using = "inverted" if first_type.is_string else "btree"
         options = dict(st.options)
         if st.column_tokenizers:
             # per-column dictionary names; columns WITHOUT one keep the
@@ -1403,6 +1420,15 @@ class Connection:
             return
         from .storage.wal import WalOp
         with self.db.lock:
+            # Quiesce committed-but-unpublished fast-path inserts first:
+            # such an insert holds an earlier WAL tick but is invisible to
+            # the data_version conflict check, and publishing txn ops ahead
+            # of it would diverge live row order from replay (tick) order,
+            # corrupting positional delete/update records on recovery.
+            # All written tables quiesce TOGETHER — waiting per-table
+            # releases the lock between tables.
+            self.db.wait_quiesced_all(
+                [w["real"] for w in self._txn_writes.values()])
             for key, w in self._txn_writes.items():
                 if w["real"].data_version != w["version"] or \
                         self.db._table_by_key(key) is not w["real"]:
@@ -2314,10 +2340,13 @@ def _refresh_indexes(db: Database, table: MemTable) -> None:
     """Refresh any index whose data_version is stale (the refresh leg of
     the reference's RefreshLoop, task.cpp:237-343): appends publish a new
     segment, mutations trigger the rebuild/merge leg."""
-    from .search.index import refresh_index
+    from .search.index import _repair, refresh_index
     for name, idx in list(getattr(table, "indexes", {}).items()):
         if idx.data_version != table.data_version:
-            table.indexes[name] = refresh_index(table, idx)
+            # shares the per-provider rebuild lock + pre-build version stamp
+            # with the read-repair path so concurrent repairs can't race
+            _repair(table, name, idx,
+                    lambda cur: refresh_index(table, cur))
 
 
 def _coerce(col: Column, target: dt.SqlType) -> Column:

@@ -7,6 +7,8 @@ table contents; the storage layer adds incremental segments + WAL.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .. import errors
@@ -48,13 +50,49 @@ def build_btree_index(provider, column: str, using: str,
                       rows[order].astype(np.int64), provider.data_version)
 
 
+_rebuild_guard = threading.Lock()
+
+
+def _index_lock(provider) -> threading.Lock:
+    """Per-provider rebuild lock (lazily attached) — read-repair rebuilds
+    must not run concurrently (racy duplicate builds) or stamp a version
+    that doesn't match the batch they were built from."""
+    lk = getattr(provider, "_index_rebuild_lock", None)
+    if lk is None:
+        with _rebuild_guard:
+            lk = getattr(provider, "_index_rebuild_lock", None)
+            if lk is None:
+                lk = threading.Lock()
+                provider._index_rebuild_lock = lk
+    return lk
+
+
+def _repair(provider, name, idx, rebuild):
+    """Read-repair `idx` under the provider's rebuild lock. The version is
+    captured BEFORE the data is read: if a concurrent fast-path publish
+    lands mid-build the new index carries the older stamp, so the next
+    reader repairs again instead of trusting an index that may be missing
+    the published rows (an index with EXTRA rows is harmless — those rows
+    exist in the table)."""
+    with _index_lock(provider):
+        cur = provider.indexes.get(name, idx)
+        if cur.data_version == provider.data_version:
+            return cur
+        ver = provider.data_version
+        new = rebuild(cur)
+        new.data_version = ver
+        provider.indexes[name] = new
+        return new
+
+
 def find_btree_index(provider, column: str):
     for name, idx in getattr(provider, "indexes", {}).items():
         if isinstance(idx, BtreeIndex) and idx.column == column:
             if idx.data_version != provider.data_version:
-                idx = build_btree_index(provider, idx.column, idx.using,
-                                        idx.options)
-                provider.indexes[name] = idx
+                idx = _repair(provider, name, idx,
+                              lambda cur: build_btree_index(
+                                  provider, cur.column, cur.using,
+                                  cur.options))
             return idx
     return None
 
@@ -150,7 +188,7 @@ def find_index(provider, column: str):
     for name, idx in getattr(provider, "indexes", {}).items():
         if idx.using == "inverted" and column in idx.columns:
             if idx.data_version != provider.data_version:
-                idx = refresh_index(provider, idx)
-                provider.indexes[name] = idx
+                idx = _repair(provider, name, idx,
+                              lambda cur: refresh_index(provider, cur))
             return idx
     return None

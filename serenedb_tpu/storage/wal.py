@@ -46,6 +46,10 @@ from ..utils import faults, log, metrics
 
 SEGMENT_SEAL_BYTES = 16 << 20   # reference: 16 MB segment seal
 _HDR = struct.Struct("<II")
+# Segment header: magic + format version. Frames follow the 8-byte header.
+# A version bump makes old segments fail loudly ("incompatible WAL version")
+# instead of decoding as torn/corrupt frames.
+SEGMENT_MAGIC = b"SDBWAL\x00\x02"
 
 
 @dataclass
@@ -135,6 +139,7 @@ class SearchDbWal:
         self._fh = None
         self._gen = 0
         self._bytes = 0
+        self._poisoned: Optional[str] = None
         # per-segment max tick, maintained on append so GC doesn't re-read
         # sealed segments; lazily scanned for segments found at boot
         self._seg_max_tick: dict[int, int] = {}
@@ -161,6 +166,22 @@ class SearchDbWal:
             path = self._seg_path(self._gen)
             self._fh = open(path, "ab")
             self._bytes = self._fh.tell()
+            if self._bytes == 0:
+                try:
+                    self._fh.write(SEGMENT_MAGIC)
+                    self._fh.flush()
+                except BaseException:
+                    # a partial header must not stay ahead of later frames
+                    # (the segment would be unrecoverable); reset so the
+                    # next open retries a fresh header, poison if we can't
+                    try:
+                        self._fh.truncate(0)
+                        self._fh.close()
+                    except BaseException as exc2:
+                        self._poisoned = repr(exc2)
+                    self._fh = None
+                    raise
+                self._bytes = len(SEGMENT_MAGIC)
 
     def _seal_if_needed(self):
         if self._bytes >= SEGMENT_SEAL_BYTES:
@@ -201,8 +222,14 @@ class SearchDbWal:
                     batch, self._pending = self._pending, []
                 if not batch:
                     continue
+                start_bytes = None
                 try:
+                    if self._poisoned is not None:
+                        raise errors.SqlError(
+                            "58030", "WAL poisoned by earlier write "
+                            f"failure: {self._poisoned}")
                     self._open_for_append()
+                    start_bytes = self._bytes
                     max_tick = 0
                     for e in batch:
                         tb = struct.pack("<Q", e.tick)
@@ -216,8 +243,24 @@ class SearchDbWal:
                     os.fsync(self._fh.fileno())
                     self._seg_max_tick[self._gen] = max(
                         self._seg_max_tick.get(self._gen, 0), max_tick)
-                    self._seal_if_needed()
                 except BaseException as exc:
+                    # Partially-written frames of the FAILED batch must not
+                    # become durable behind a later commit's fsync — callers
+                    # were told the commit failed and never published it, so
+                    # recovery would replay ghosts. Roll the segment back to
+                    # its pre-batch offset; if even that fails, poison the
+                    # WAL so nothing can append after the garbage.
+                    try:
+                        if self._fh is not None and start_bytes is not None:
+                            self._fh.truncate(start_bytes)
+                            self._fh.seek(start_bytes)
+                            # make the truncation itself durable: without
+                            # this the failed frames may still hit disk via
+                            # background writeback and replay as ghosts
+                            os.fsync(self._fh.fileno())
+                            self._bytes = start_bytes
+                    except BaseException:
+                        self._poisoned = repr(exc)
                     # the leader must fail EVERY drained follower — their
                     # frames were lost with this write and they would
                     # otherwise spin forever on an empty queue
@@ -227,6 +270,17 @@ class SearchDbWal:
                     raise
                 for e in batch:
                     e.done.set()
+                # Seal OUTSIDE the rollback-protected region: the batch IS
+                # durable, and rolling back to the old segment's pre-batch
+                # offset after _fh swapped to the next generation would
+                # zero-extend the fresh segment. A seal failure can leave
+                # the open segment header-less, so poison instead of
+                # letting later appends land in an unrecoverable file.
+                try:
+                    self._seal_if_needed()
+                except BaseException as exc:
+                    self._poisoned = repr(exc)
+                    raise
         if entry.error is not None:
             raise entry.error
         metrics.WAL_COMMITS.add()
@@ -254,14 +308,31 @@ class SearchDbWal:
         in the LAST segment is the uncommitted tail: it is truncated away so
         later appends never land behind garbage (which would make them
         unreachable on the next recovery). Corruption in an earlier, sealed
-        segment aborts replay loudly. Returns the highest tick seen."""
+        segment aborts replay loudly; a segment written by a different WAL
+        format version is an explicit 58030 "incompatible WAL version", not
+        corruption semantics. Returns the highest tick seen."""
         max_tick = 0
         gens = self._generations()
         for gi, gen in enumerate(gens):
             path = self._seg_path(gen)
             with open(path, "rb") as f:
                 data = f.read()
-            off = 0
+            if len(data) == 0:
+                self._seg_max_tick[gen] = 0
+                continue
+            if data[:len(SEGMENT_MAGIC)] != SEGMENT_MAGIC:
+                if gi == len(gens) - 1 and \
+                        SEGMENT_MAGIC.startswith(data):
+                    # torn header write: the segment holds no frames yet
+                    with open(path, "r+b") as f:
+                        f.truncate(0)
+                    self._seg_max_tick[gen] = 0
+                    continue
+                raise errors.SqlError(
+                    "58030",
+                    f"incompatible WAL version in {path}: expected format "
+                    f"{SEGMENT_MAGIC[-1]} (header {SEGMENT_MAGIC!r})")
+            off = len(SEGMENT_MAGIC)
             seg_max = 0
             while off + _HDR.size + 8 <= len(data):
                 ln, crc = _HDR.unpack_from(data, off)

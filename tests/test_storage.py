@@ -281,3 +281,59 @@ def test_upsert_survives_recovery(tmp_path):
     rows = sorted(db2.connect().execute("SELECT id, v FROM up").rows())
     assert rows == [(1, "b"), (2, "c")]
     db2.close()
+
+
+def test_wal_incompatible_version_rejected(tmp_path):
+    """A segment without the current SEGMENT_MAGIC must fail with an
+    explicit 58030 'incompatible WAL version', not corruption semantics
+    (ADVICE r2: format change silently truncated old-format tails)."""
+    from serenedb_tpu.errors import SqlError
+    from serenedb_tpu.storage.wal import SEGMENT_MAGIC
+    # simulate an old-format segment: frames with no segment header
+    with open(tmp_path / "000000000001.wal", "wb") as f:
+        f.write(b"\x10\x00\x00\x00" + b"x" * 32)
+    wal = SearchDbWal(str(tmp_path))
+    with pytest.raises(SqlError) as e:
+        wal.recover(lambda t: 0, lambda tick, op: None)
+    assert e.value.sqlstate == "58030"
+    assert "incompatible WAL version" in str(e.value)
+    wal.close()
+    # a torn header (strict prefix of the magic) in the LAST segment is an
+    # uncommitted empty segment, not an error
+    with open(tmp_path / "000000000002.wal", "wb") as f:
+        f.write(SEGMENT_MAGIC[:3])
+    os.remove(tmp_path / "000000000001.wal")
+    wal2 = SearchDbWal(str(tmp_path))
+    assert wal2.recover(lambda t: 0, lambda tick, op: None) == 0
+    assert os.path.getsize(tmp_path / "000000000002.wal") == 0
+    wal2.close()
+
+
+def test_wal_failed_group_write_rolled_back(tmp_path, monkeypatch):
+    """Frames of a FAILED group-commit batch must not become durable behind
+    a later commit's fsync (ADVICE r2 medium): the leader truncates the
+    segment back to its pre-batch offset."""
+    wal = SearchDbWal(str(tmp_path))
+    b = Batch.from_pydict({"a": [1]})
+    wal.append_commit(CommitRecord(1, [WalOp("t", "insert", b)]))
+
+    real_fsync = os.fsync
+    calls = {"n": 0}
+
+    def failing_fsync(fd):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("injected fsync failure")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", failing_fsync)
+    with pytest.raises(OSError):
+        wal.append_commit(CommitRecord(2, [WalOp("t", "insert", b)]))
+    # next commit succeeds and recovery must see ONLY ticks 1 and 3
+    wal.append_commit(CommitRecord(3, [WalOp("t", "insert", b)]))
+    wal.close()
+    wal2 = SearchDbWal(str(tmp_path))
+    seen = []
+    wal2.recover(lambda t: 0, lambda tick, op: seen.append(tick))
+    assert seen == [1, 3]
+    wal2.close()
