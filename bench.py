@@ -200,7 +200,7 @@ def bench_bm25() -> float:
                 for a, b in zip(qterms[1::2], qterms[::2])])
 
     # warmup/compile — the QPS regime batches queries per dispatch
-    searcher.topk_batch(queries, 10)
+    out_dev = searcher.topk_batch(queries, 10)
     t0 = time.perf_counter()
     reps = 20
     for _ in range(reps):
@@ -208,16 +208,31 @@ def bench_bm25() -> float:
     t_dev = time.perf_counter() - t0
     qps_dev = reps * len(queries) / t_dev
 
+    # CPU baseline: block-max WAND + MaxScore (cpu_topk_wand) — the same
+    # optimization family the reference's CPU engine runs
+    # (search/block_disjunction.hpp), NOT the exhaustive scorer, so the
+    # reported ratio survives scrutiny. Warm pass first: plan/bucket
+    # caches mirror the device path's compile+upload warmup.
+    shapes = [searcher._query_shape(q) for q in queries]
+    for (tids, req, _, _) in shapes:
+        searcher.cpu_topk_wand(tids, 10, require_all=req)
     t0 = time.perf_counter()
-    # every 4th query: spans all three classes (single/disjunction/
-    # conjunction) so the CPU baseline is an apples-to-apples sample
-    sample = queries[::4]
-    for q in sample:
-        match = searcher.eval_filter(q)
-        tids = searcher.scoring_terms(q)
-        searcher._cpu_score(match, tids, 10)
+    cpu_out = []
+    for (tids, req, _, _) in shapes:
+        cpu_out.append(searcher.cpu_topk_wand(tids, 10, require_all=req))
     t_cpu = time.perf_counter() - t0
-    qps_cpu = len(sample) / t_cpu
+    qps_cpu = len(queries) / t_cpu
+    # top-10 parity device vs CPU on a spanning sample
+    for si in range(0, len(queries), 7):
+        dev_s, dev_d = out_dev[si]
+        ref_s, ref_d = cpu_out[si]
+        assert len(dev_s) == len(ref_s), \
+            f"query {si}: {len(dev_s)} vs {len(ref_s)} results"
+        np.testing.assert_allclose(dev_s, ref_s, rtol=2e-3, atol=1e-3)
+        for j, (dd, rd) in enumerate(zip(dev_d.tolist(), ref_d.tolist())):
+            if dd != rd:  # doc ids may differ only on score ties
+                assert abs(float(ref_s[j]) - float(dev_s[j])) < 1e-3, \
+                    f"query {si} rank {j}: doc {dd} != {rd}"
     return qps_dev / qps_cpu
 
 
@@ -263,14 +278,17 @@ def bench_bm25_1m() -> float:
         searcher.topk_batch(queries, 10)
     qps_dev = reps * len(queries) / (time.perf_counter() - t0)
 
-    # exhaustive CPU reference on a spanning sample + top-10 parity
+    # WAND/MaxScore CPU reference (warm) on a spanning sample + parity
     sample = list(range(0, len(queries), 8))
+    shapes = [searcher._query_shape(queries[si]) for si in sample]
+    for (tids, req, _, _) in shapes:
+        searcher.cpu_topk_wand(tids, 10, require_all=req)
     t0 = time.perf_counter()
-    for si in sample:
-        q = queries[si]
-        match = searcher.eval_filter(q)
-        tids = searcher.scoring_terms(q)
-        ref_s, ref_d = searcher._cpu_score(match, tids, 10)
+    cpu_out = [searcher.cpu_topk_wand(tids, 10, require_all=req)
+               for (tids, req, _, _) in shapes]
+    qps_cpu = len(sample) / (time.perf_counter() - t0)
+    for pos, si in enumerate(sample):
+        ref_s, ref_d = cpu_out[pos]
         dev_s, dev_d = out_dev[si]
         assert len(dev_s) == min(10, len(ref_s)), \
             f"query {si}: {len(dev_s)} results, expected {min(10, len(ref_s))}"
@@ -279,9 +297,105 @@ def bench_bm25_1m() -> float:
         # doc ids must agree except where scores tie at the boundary
         for j, (dd, rd) in enumerate(zip(dev_d.tolist(), ref_d.tolist())):
             if dd != rd:
-                assert abs(float(ref_s[j]) - float(dev_s[j])) < 1e-4, \
+                assert abs(float(ref_s[j]) - float(dev_s[j])) < 1e-3, \
                     f"query {si} rank {j}: doc {dd} != {rd}"
+    return qps_dev / qps_cpu
+
+
+def _synth_posting_index(n_docs: int, vocab: int, total_postings: int,
+                         seed: int):
+    """Build a FieldIndex directly from a synthetic posting distribution
+    (vectorized — no string tokenization; this shape measures scoring QPS,
+    not indexing). Term document-frequencies follow a zipf law, tfs are
+    small-integer zipf, norms are the consistent per-doc tf sums."""
+    import numpy as np
+
+    from serenedb_tpu.search.segment import FieldIndex, _add_block_max
+
+    rng = np.random.default_rng(seed)
+    # zipf df profile scaled to the posting budget
+    raw = 1.0 / np.arange(1, vocab + 1) ** 0.9
+    df_target = np.maximum((raw / raw.sum() * total_postings), 1.0)
+    df_target = np.minimum(df_target, n_docs * 0.8).astype(np.int64)
+    terms_rep = np.repeat(np.arange(vocab, dtype=np.int64), df_target)
+    docs_rnd = rng.integers(0, n_docs, len(terms_rep), dtype=np.int64)
+    keys = terms_rep * n_docs + docs_rnd
+    keys = np.unique(keys)   # sorted by (term, doc); drops dup samples
+    post_terms = (keys // n_docs).astype(np.int64)
+    post_docs = (keys % n_docs).astype(np.int32)
+    post_tfs = np.minimum(rng.zipf(1.7, len(keys)), 64).astype(np.int32)
+    doc_freq = np.bincount(post_terms, minlength=vocab).astype(np.int32)
+    offsets = np.zeros(vocab + 1, dtype=np.int64)
+    np.cumsum(doc_freq, out=offsets[1:])
+    norms = np.bincount(post_docs, weights=post_tfs,
+                        minlength=n_docs).astype(np.int32)
+    fi = FieldIndex(
+        terms=np.asarray([f"w{i:07d}" for i in range(vocab)], dtype=object),
+        doc_freq=doc_freq,
+        offsets=offsets,
+        post_docs=post_docs,
+        post_tfs=post_tfs,
+        pos_offsets=np.zeros(len(post_docs) + 1, dtype=np.int64),
+        positions=np.empty(0, dtype=np.int32),
+        norms=norms,
+        block_max_tf=np.empty(0, dtype=np.int32),
+        block_offsets=np.zeros(vocab + 1, dtype=np.int64),
+        total_tokens=int(post_tfs.sum()),
+    )
+    _add_block_max(fi)
+    return fi
+
+
+def bench_bm25_8m() -> float:
+    """BM25 top-10 at 8M docs — MS-MARCO scale (8.8M passages). Proves the
+    HBM-capped query splitting + WAND planning hold at target size; CPU
+    baseline is the WAND/MaxScore host scorer; asserts top-10 parity."""
+    import numpy as np
+
+    from serenedb_tpu.search.analysis import get_analyzer
+    from serenedb_tpu.search.query import parse_query
+    from serenedb_tpu.search.searcher import SegmentSearcher
+
+    n_docs = 8_000_000
+    vocab = 200_000
+    fi = _synth_posting_index(n_docs, vocab, 120_000_000, seed=9)
+    an = get_analyzer("simple")
+    searcher = SegmentSearcher(fi, an, n_docs)
+
+    idxs = [1 + 97 * i for i in range(48)]
+    qterms = [f"w{i:07d}" for i in idxs]
+    queries = ([parse_query(t, an) for t in qterms] +
+               [parse_query(f"{a} | {b}", an)
+                for a, b in zip(qterms[:24], qterms[24:][::-1])] +
+               [parse_query(f"{a} & {b}", an)
+                for a, b in zip(qterms[1::2], qterms[::2])])
+
+    out_dev = searcher.topk_batch(queries, 10)  # warmup/compile
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        searcher.topk_batch(queries, 10)
+    qps_dev = reps * len(queries) / (time.perf_counter() - t0)
+
+    sample = list(range(0, len(queries), 6))
+    shapes = [searcher._query_shape(queries[si]) for si in sample]
+    for (tids, req, _, _) in shapes:
+        searcher.cpu_topk_wand(tids, 10, require_all=req)
+    t0 = time.perf_counter()
+    cpu_out = [searcher.cpu_topk_wand(tids, 10, require_all=req)
+               for (tids, req, _, _) in shapes]
     qps_cpu = len(sample) / (time.perf_counter() - t0)
+    for pos, si in enumerate(sample):
+        ref_s, ref_d = cpu_out[pos]
+        dev_s, dev_d = out_dev[si]
+        assert len(dev_s) == min(10, len(ref_s)), \
+            f"query {si}: {len(dev_s)} results, expected {min(10, len(ref_s))}"
+        np.testing.assert_allclose(dev_s, ref_s[:len(dev_s)],
+                                   rtol=2e-3, atol=1e-3)
+        for j, (dd, rd) in enumerate(zip(dev_d.tolist(), ref_d.tolist())):
+            if dd != rd:
+                assert abs(float(ref_s[j]) - float(dev_s[j])) < 1e-3, \
+                    f"query {si} rank {j}: doc {dd} != {rd}"
     return qps_dev / qps_cpu
 
 
@@ -290,6 +404,7 @@ SHAPES = {
     "hits": bench_hits,
     "bm25": bench_bm25,
     "bm25_1m": bench_bm25_1m,
+    "bm25_8m": bench_bm25_8m,
 }
 
 

@@ -26,6 +26,7 @@ score = Σ_t idf_t · (k1 + 1) · tf/(tf + k1·(1 − b + b·dl/avgdl)).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -593,6 +594,145 @@ def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
     return vals, docs
 
 
+
+
+# ------------------------------------------------------------ dense path
+#
+# Small-corpus regime (benchmark-game scale): the scatter-accumulate kernel
+# is bound by XLA's serialized scatter, not by FLOPs. When the dense
+# (ndocs_pad, V_pad) saturation matrix fits an HBM budget, scoring becomes
+# ONE MXU matmul: scores = S @ W with W[t, q] = idf weight of term t in
+# query q — the TPU-first re-expression of "score every doc against the
+# query" that turns the memory-bound scatter into compute the systolic
+# array eats for breakfast. S is built ON DEVICE from the already-resident
+# block tiles (+ a one-time light-term tail upload), so no dense matrix
+# ever crosses the host↔device link.
+
+DENSE_HBM_BUDGET = int(float(os.environ.get("SDB_DENSE_HBM_MB", "1024"))
+                       * (1 << 20))
+
+
+@dataclass
+class DenseStore:
+    """Device-resident dense saturation matrix for one (segment, scorer,
+    avgdl) triple. S[d, t] = sat(tf_{d,t}, dl_d); 0 where the term is
+    absent — so scores = S @ W sums exactly the per-term contributions and
+    (S > 0) @ 1_q counts exactly the per-query term hits."""
+
+    S: jax.Array        # (ndocs_pad, V_pad) f32
+    ndocs_pad: int
+    v_pad: int
+
+
+@functools.partial(jax.jit, static_argnames=("ndocs_pad", "v_pad", "scorer"))
+def _build_dense(block_docs, block_tfs, row_tid, light_docs, light_tfs,
+                 light_tid, norms, ndocs_pad: int, v_pad: int, k1: float,
+                 b: float, avgdl: float, scorer: str) -> jax.Array:
+    """One-time scatter of every posting into a dense TF plane, then the
+    scorer's saturation applied elementwise. Runs once per (segment,
+    scorer, avgdl); per-query dispatches touch only the result."""
+    tf = jnp.zeros((ndocs_pad, v_pad), dtype=jnp.float32)
+    bd = block_docs.reshape(-1)
+    bt = block_tfs.reshape(-1)
+    btid = jnp.broadcast_to(row_tid[:, None],
+                            block_docs.shape).reshape(-1)
+    valid = bd >= 0
+    tf = tf.at[jnp.where(valid, bd, 0),
+               jnp.where(valid, btid, 0)].add(
+        jnp.where(valid, bt.astype(jnp.float32), 0.0))
+    lvalid = light_docs >= 0
+    tf = tf.at[jnp.where(lvalid, light_docs, 0),
+               jnp.where(lvalid, light_tid, 0)].add(
+        jnp.where(lvalid, light_tfs.astype(jnp.float32), 0.0))
+    if scorer == "tfidf":
+        return jnp.sqrt(tf)
+    alpha = k1 * (1.0 - b + b * norms[:ndocs_pad].astype(jnp.float32) /
+                  jnp.maximum(jnp.float32(avgdl), 1e-9))
+    return (k1 + 1.0) * tf / jnp.maximum(tf + alpha[:, None], 1e-9)
+
+
+def dense_fits(ndocs_pad: int, vocab: int) -> bool:
+    """True when the (ndocs_pad, V_pad) f32 saturation matrix fits the
+    dense-path HBM budget. ndocs_pad is the block store's own padding so
+    the estimate can't drift from the real allocation."""
+    v_pad = max(128, ((vocab + 127) // 128) * 128)
+    return ndocs_pad * v_pad * 4 <= DENSE_HBM_BUDGET
+
+
+def build_dense_store(store: BlockStore, doc_freq: np.ndarray,
+                      avgdl: float, k1: float, b: float,
+                      scorer: str) -> DenseStore:
+    T = len(doc_freq)
+    v_pad = max(128, ((T + 127) // 128) * 128)
+    nd_pad = store.ndocs_pad
+    # heavy terms: already device-resident as block tiles; ship only the
+    # per-row term id. Light terms: one-time flat upload (df < HEAVY_DF
+    # each, so the tail is small).
+    rows_per_term = np.diff(store.block_offsets).astype(np.int64)
+    row_tid = np.repeat(np.arange(T, dtype=np.int32),
+                        rows_per_term)
+    row_tid = np.concatenate([row_tid, np.zeros(
+        store.block_docs.shape[0] - len(row_tid), dtype=np.int32)])
+    # light terms: one boolean mask over the flat postings (vectorized —
+    # vocab can reach ~260k at the budget boundary)
+    df = np.diff(store.offsets).astype(np.int64)
+    post_tid = np.repeat(np.arange(T, dtype=np.int32), df)
+    light_mask = ~store.heavy[post_tid]
+    light_docs = store.flat_docs[light_mask].astype(np.int32)
+    light_tfs = store.flat_tfs[light_mask].astype(np.int32)
+    light_tid = post_tid[light_mask]
+    n_pad = _pow2(len(light_docs), BLOCK)
+    S = _build_dense(
+        store.block_docs, store.block_tfs, jnp.asarray(row_tid),
+        jnp.asarray(_pad_to(light_docs, n_pad, -1)),
+        jnp.asarray(_pad_to(light_tfs, n_pad, 0)),
+        jnp.asarray(_pad_to(light_tid, n_pad, 0)),
+        store.norms, nd_pad, v_pad, k1, b, avgdl, scorer)
+    return DenseStore(S=S, ndocs_pad=nd_pad, v_pad=v_pad)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "any_require"))
+def dense_topk(S: jax.Array, W: jax.Array, require: jax.Array, k: int,
+               any_require: bool) -> tuple[jax.Array, jax.Array]:
+    """scores = S @ W on the MXU; optional conjunction masking via an
+    indicator matmul (hits = [S>0] @ [W>0]); exact per-query top-k."""
+    scores = jax.lax.dot_general(
+        S, W, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (nd, B)
+    if any_require:
+        hits = jax.lax.dot_general(
+            (S > 0).astype(jnp.float32), (W > 0).astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        scores = jnp.where(
+            jnp.logical_or(require[None, :] <= 0,
+                           hits >= require[None, :].astype(jnp.float32)),
+            scores, 0.0)
+    vals, docs = jax.lax.top_k(scores.T, k)
+    return vals, docs
+
+
+def assemble_dense_weights(v_pad: int,
+                           queries: list[tuple[np.ndarray, int]],
+                           n_docs: int, doc_freq: np.ndarray, scorer: str,
+                           idf_of=None) -> tuple[np.ndarray, np.ndarray, int]:
+    """(W, require, b_pad): W[t, q] = weight of term t in query q (tiny —
+    V_pad × B f32). The batch dim pads to a power of two so jit caches stay
+    small across varying batch sizes."""
+    b_pad = _pow2(len(queries), 8)
+    W = np.zeros((v_pad, b_pad), dtype=np.float32)
+    require = np.zeros(b_pad, dtype=np.int32)
+    for qi, (term_ids, req) in enumerate(queries):
+        require[qi] = req
+        if not len(term_ids):
+            continue
+        tid_arr = np.asarray(term_ids, dtype=np.int64)
+        if idf_of is not None:
+            idf = np.asarray(idf_of(tid_arr), dtype=np.float32)
+        else:
+            idf = idf_for(scorer, n_docs, doc_freq[tid_arr])
+        np.add.at(W[:, qi], tid_arr, idf)
+    return W, require, b_pad
 
 
 @functools.partial(jax.jit, static_argnames=("ndocs_pad",))

@@ -35,6 +35,22 @@ K1 = 1.2
 B = 0.75  # reference defaults: libs/iresearch/search/bm25.hpp
 
 
+def _maxscore_split(plan) -> set:
+    """Non-essential terms of a WandPlan: the ascending-maxscore prefix
+    whose cumulative sum stays below θ — docs containing only those terms
+    can never reach the top-k. Shared by the device candidate generation
+    and the CPU WAND baseline so the split rule cannot diverge."""
+    cum = 0.0
+    non_ess = set()
+    for tid, ms in sorted(plan.maxscore.items(), key=lambda t: t[1]):
+        if cum + ms < plan.theta:
+            cum += ms
+            non_ess.add(tid)
+        else:
+            break
+    return non_ess
+
+
 class SegmentSearcher:
     def __init__(self, index: FieldIndex, analyzer: Analyzer, num_docs: int):
         self.index = index
@@ -51,6 +67,27 @@ class SegmentSearcher:
                 self.index.post_tfs, self.index.doc_freq,
                 self.index.norms, self.num_docs)
         return self._dev
+
+    def _dense_store(self, scorer: str,
+                     avgdl: float) -> bm25_ops.DenseStore:
+        """Dense saturation matrix for the small-corpus matmul path,
+        cached per (scorer shape, avgdl) — segments are immutable, and
+        avgdl only drifts when collection stats change."""
+        cache = getattr(self, "_dense_cache", None)
+        if cache is None:
+            cache = self._dense_cache = {}
+        # tfidf's S (sqrt tf) is avgdl-independent — don't rebuild it when
+        # collection stats drift
+        key = ("tfidf",) if scorer == "tfidf" \
+            else ("bm25", round(avgdl, 6))
+        hit = cache.get(key)
+        if hit is None:
+            if len(cache) >= 2:   # S is the dominant HBM tenant — keep ≤2
+                cache.clear()
+            hit = cache[key] = bm25_ops.build_dense_store(
+                self._device_store(), self.index.doc_freq, avgdl, K1, B,
+                scorer)
+        return hit
 
     # -- filter evaluation (CPU doc-set algebra) --------------------------
 
@@ -288,15 +325,7 @@ class SegmentSearcher:
 
         Reference analog: the max-score optimization of
         block_disjunction.hpp / max_score_iterator."""
-        order = sorted(plan.maxscore.items(), key=lambda t: t[1])
-        cum = 0.0
-        non_ess = set()
-        for tid, ms in order:
-            if cum + ms < plan.theta:
-                cum += ms
-                non_ess.add(tid)
-            else:
-                break
+        non_ess = _maxscore_split(plan)
         if not non_ess:
             return None
         ess = [t for t in tids if int(t) not in non_ess]
@@ -362,6 +391,25 @@ class SegmentSearcher:
         k_true = min(max(k, 1), max(self.num_docs, 1))
         plans: list = [None] * len(nodes)
         host_results: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        use_dense = (scorer not in bm25_ops.LM_SCORERS and
+                     (scorer == "tfidf" or avgdl > 0.0) and
+                     bm25_ops.dense_fits(store.ndocs_pad,
+                                         len(self.index.doc_freq)))
+        if use_dense:
+            # small-corpus matmul path: one MXU dispatch, no host WAND
+            # planning needed (the dense kernel is not scatter-bound)
+            ds = self._dense_store(scorer, avgdl)
+            W, require_arr, _ = bm25_ops.assemble_dense_weights(
+                ds.v_pad, queries, self.num_docs, self.index.doc_freq,
+                scorer, idf_of)
+            kk = min(bm25_ops.pad_k(k_true), store.ndocs_pad)
+            vals, docs = bm25_ops.dense_topk(
+                ds.S, jnp.asarray(W), jnp.asarray(require_arr), kk,
+                bool(require_arr.any()))
+            vals, docs = jax.device_get((vals, docs))
+            return self._finish_batch(nodes, shapes, vals, docs,
+                                      host_results, k, scorer, idf_of,
+                                      avgdl_override, store.ndocs_pad)
         if store.norms_host is not None and \
                 (scorer == "tfidf" or avgdl > 0.0):
             for qi, (tids, req, needs_mask, empty) in enumerate(shapes):
@@ -394,6 +442,16 @@ class SegmentSearcher:
         else:  # every query resolved host-side — skip the dispatch entirely
             vals = np.zeros((nq, kk), dtype=np.float32)
             docs = np.zeros((nq, kk), dtype=np.int32)
+        return self._finish_batch(nodes, shapes, vals, docs, host_results,
+                                  k, scorer, idf_of, avgdl_override, nd_pad)
+
+    def _finish_batch(self, nodes, shapes, vals, docs, host_results, k,
+                      scorer, idf_of, avgdl_override, nd_pad,
+                      ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Shared device-result postprocessing: host-resolved queries,
+        always-empty conjunctions, zero-score matches, exact-match mask
+        application (with CPU rescore when a non-match cracked the
+        device top-k)."""
         out = []
         for qi, (node, (tids, req, needs_mask, empty)) in enumerate(
                 zip(nodes, shapes)):
@@ -430,6 +488,82 @@ class SegmentSearcher:
             scores, dd = scores[keep], dd[keep]
             out.append((scores[:k], dd[:k]))
         return out
+
+    def cpu_topk_wand(self, tids: list[int], k: int, scorer: str = "bm25",
+                      idf_of=None, avgdl_override=None,
+                      require_all: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Host top-k with block-max WAND + MaxScore pruning — the honest
+        CPU competitor (reference: search/block_disjunction.hpp +
+        max_score_iterator; Lucene/Tantivy-class baselines implement the
+        same family). Numpy-vectorized block-at-a-time variant:
+
+        1. champion pass → θ, a lower bound on the k-th score (exact
+           scoring of the best upper-bound block rows + light tails);
+        2. MaxScore split: terms whose max scores cumulatively stay below
+           θ are non-essential — their postings alone can't lift a doc
+           into the top-k, so candidates come from essential terms only;
+        3. block-max pruning: essential heavy terms drop whole 128-doc
+           blocks whose own upper bound plus the OTHER terms' maxscore sum
+           cannot reach θ;
+        4. exact scoring of the surviving candidates over all terms.
+
+        Exact top-k: every dropped doc is provably below θ ≤ true k-th
+        score. Falls back to exhaustive scoring when no safe θ exists.
+        Conjunctions (require_all=N) intersect postings first — WAND is a
+        disjunction optimization (reference: conjunction.hpp is a
+        separate, already-selective iterator)."""
+        store = self._device_store()
+        fi = self.index
+        avgdl = max(avgdl_override if avgdl_override is not None
+                    else fi.avgdl, 1e-9)
+        if require_all > 0:
+            docs = None
+            for tid in tids:
+                pd = fi.postings(int(tid))[0]
+                docs = pd if docs is None else \
+                    np.intersect1d(docs, pd, assume_unique=True)
+            if docs is None:
+                docs = np.empty(0, dtype=np.int32)
+            return self._cpu_score(docs, tids, k, scorer, idf_of,
+                                   avgdl_override)
+        plan = None
+        if scorer not in bm25_ops.LM_SCORERS:
+            plan = self._wand_plan_cached(store, tids, min(k, max(
+                self.num_docs, 1)), avgdl, scorer, idf_of)
+        if plan is None:
+            # no safe threshold (tiny result set / LM scorer): exhaustive
+            docs = self._union_postings([int(t) for t in tids])
+            return self._cpu_score(docs, tids, k, scorer, idf_of,
+                                   avgdl_override)
+        theta = plan.theta
+        non_ess = _maxscore_split(plan)
+        ess = [int(t) for t in tids if int(t) not in non_ess]
+        if not ess:
+            ess = [int(t) for t in tids]
+        parts = []
+        for tid in ess:
+            if store.heavy[tid] and tid in plan.kept:
+                # block-max pruning: plan.kept already dropped rows that
+                # can't reach θ together with the other terms' bounds
+                s = int(store.offsets[tid])
+                b0 = int(store.block_offsets[tid])
+                e = int(store.offsets[tid + 1])
+                loc = plan.kept[tid] - b0
+                if len(loc) == 0:
+                    continue
+                spans = [store.flat_docs[s + i * bm25_ops.BLOCK:
+                                         min(s + (i + 1) * bm25_ops.BLOCK, e)]
+                         for i in loc]
+                parts.append(np.concatenate(spans))
+            else:
+                pd = fi.postings(tid)[0]
+                parts.append(pd)
+        cand = np.unique(np.concatenate(parts)) if parts \
+            else np.empty(0, dtype=np.int32)
+        scores, dd = self._cpu_score(cand, tids, k, scorer, idf_of,
+                                     avgdl_override)
+        keep = scores > 0.0
+        return scores[keep][:k], dd[keep][:k]
 
     def _cpu_score(self, docs: np.ndarray, tids: list[int], k: int,
                    scorer: str = "bm25", idf_of=None,

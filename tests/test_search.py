@@ -350,3 +350,64 @@ def test_query_batch_chunking_parity():
     for (s1, d1), (s2, d2) in zip(base, chunked):
         np.testing.assert_allclose(s1, s2, rtol=1e-6)
         assert d1.tolist() == d2.tolist()
+
+
+def test_dense_path_parity_vs_scatter_and_cpu(monkeypatch):
+    """The dense matmul path (small-corpus regime) must return exactly the
+    scatter path's results, which must match the exhaustive CPU scorer."""
+    from serenedb_tpu.ops import bm25 as bm25_ops
+    searcher, docs, an = _wand_fixture(n_docs=2500, seed=11)
+    qs = ["t0 | t1", "t2", "t3 & t4", "t5 | t6 | t0", "t1 ## t2", "t9"]
+    nodes = [parse_query(q, an) for q in qs]
+    assert bm25_ops.dense_fits(searcher._device_store().ndocs_pad,
+                               len(searcher.index.doc_freq))
+    dense_out = searcher.topk_batch(nodes, 10)
+    monkeypatch.setattr(bm25_ops, "DENSE_HBM_BUDGET", 0)
+    scatter_out = searcher.topk_batch(nodes, 10)
+    for node, (s1, d1), (s2, d2) in zip(nodes, dense_out, scatter_out):
+        match = searcher.eval_filter(node)
+        tids = searcher.scoring_terms(node)
+        ref_s, ref_d = searcher._cpu_score(match, tids, 10)
+        keep = ref_s > 0
+        ref_s, ref_d = ref_s[keep][:10], ref_d[keep][:10]
+        np.testing.assert_allclose(s1, ref_s, rtol=2e-3, atol=1e-3)
+        np.testing.assert_allclose(s2, ref_s, rtol=2e-3, atol=1e-3)
+        for j, (a, b) in enumerate(zip(d1.tolist(), ref_d.tolist())):
+            if a != b:
+                assert abs(float(s1[j]) - float(ref_s[j])) < 1e-3
+        for j, (a, b) in enumerate(zip(d2.tolist(), ref_d.tolist())):
+            if a != b:
+                assert abs(float(s2[j]) - float(ref_s[j])) < 1e-3
+
+
+def test_dense_path_tfidf_parity():
+    searcher, docs, an = _wand_fixture(n_docs=1500, seed=13)
+    nodes = [parse_query(q, an) for q in ["t0 | t3", "t7", "t1 & t2"]]
+    out = searcher.topk_batch(nodes, 8, scorer="tfidf")
+    for node, (s1, d1) in zip(nodes, out):
+        match = searcher.eval_filter(node)
+        tids = searcher.scoring_terms(node)
+        ref_s, ref_d = searcher._cpu_score(match, tids, 8, scorer="tfidf")
+        keep = ref_s > 0
+        ref_s = ref_s[keep][:8]
+        np.testing.assert_allclose(s1, ref_s, rtol=2e-3, atol=1e-3)
+
+
+def test_cpu_wand_topk_matches_exhaustive():
+    """cpu_topk_wand (block-max WAND + MaxScore host scorer — the honest
+    bench baseline) must equal exhaustive scoring exactly."""
+    searcher, docs, an = _wand_fixture(n_docs=4000, seed=17)
+    qs = ["t0 | t1", "t2 | t3 | t4", "t5", "t0 | t6 | t1", "t1 & t3"]
+    for q in qs:
+        node = parse_query(q, an)
+        tids, req, mask, empty = searcher._query_shape(node)
+        assert not (mask or empty)
+        ws, wd = searcher.cpu_topk_wand(tids, 10, require_all=req)
+        match = searcher.eval_filter(node)
+        es, ed = searcher._cpu_score(match, tids, 10)
+        keep = es > 0
+        es, ed = es[keep][:10], ed[keep][:10]
+        np.testing.assert_allclose(ws, es, rtol=1e-6)
+        for j, (a, b) in enumerate(zip(wd.tolist(), ed.tolist())):
+            if a != b:
+                assert abs(float(ws[j]) - float(es[j])) < 1e-6
