@@ -371,6 +371,10 @@ def bench_bm25_8m() -> float:
                 for a, b in zip(qterms[1::2], qterms[::2])])
 
     out_dev = searcher.topk_batch(queries, 10)  # warmup/compile
+    store = searcher._device_store()
+    _EXTRA["hbm_tiles_mb"] = round(store.hbm_bytes / (1 << 20), 1)
+    _EXTRA["hbm_raw_equiv_mb"] = round(
+        store.hbm_bytes_raw_equiv / (1 << 20), 1)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
@@ -410,6 +414,11 @@ SHAPES = {
 
 # ------------------------------------------------------------- harness
 
+#: side-channel for shapes to report extra metrics (HBM footprint, ...);
+#: merged into the parent's detail dict as "<shape>_<key>"
+_EXTRA: dict = {}
+
+
 def _run_shape_child(name: str) -> None:
     """Child mode: run one shape, print its JSON result, exit."""
     try:
@@ -419,7 +428,8 @@ def _run_shape_child(name: str) -> None:
             import jax
             jax.config.update("jax_platforms", "cpu")
         speedup = SHAPES[name]()
-        print(json.dumps({"shape": name, "speedup": round(speedup, 4)}),
+        print(json.dumps({"shape": name, "speedup": round(speedup, 4),
+                          "extra": _EXTRA}),
               flush=True)
     except Exception as e:  # noqa: BLE001 — report, don't crash silently
         print(json.dumps({"shape": name, "error": f"{type(e).__name__}: {e}"}),
@@ -471,6 +481,7 @@ def main() -> None:
         time.sleep(backoff)
 
     results: dict[str, float] = {}
+    extras: dict[str, float] = {}
     errors: dict[str, str] = {}
     if not alive:
         errors["device"] = (
@@ -503,6 +514,8 @@ def main() -> None:
             if rec and isinstance(rec.get("speedup"), (int, float)) \
                     and rec["speedup"] > 0:
                 results[name] = float(rec["speedup"])
+                for ek, ev in (rec.get("extra") or {}).items():
+                    extras[f"{name}_{ek}"] = ev
             else:
                 msg = (rec or {}).get("error") or r.stderr[-400:] or "no output"
                 errors[name] = str(msg)
@@ -517,7 +530,8 @@ def main() -> None:
         "value": value,
         "unit": "x",
         "vs_baseline": value,
-        "detail": {f"{k}_speedup": v for k, v in results.items()},
+        "detail": {**{f"{k}_speedup": v for k, v in results.items()},
+                   **extras},
     }
     if errors:
         out["errors"] = errors

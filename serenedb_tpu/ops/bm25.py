@@ -90,24 +90,53 @@ def term_weight_for(scorer: str, n_docs: int, doc_freq: np.ndarray,
 
 @dataclass
 class BlockStore:
-    """Device-resident posting tiles for one field index."""
+    """Device-resident posting tiles for one field index.
 
-    block_docs: jax.Array      # (NB_total+1, 128) int32, -1 padding; last row all pad
-    block_tfs: jax.Array       # (NB_total+1, 128) int32
+    HBM layout (the reference's block_128 bitpacked format re-expressed for
+    TPU lanes, formats/posting/format_block_128.cpp): each 128-posting row
+    of a heavy term is COMPRESSED as one int32 base doc + 128 uint16
+    doc-gaps + 128 uint8 tfs (7 bytes/posting → vs 8 raw ≈ 2.3×) and
+    decoded INSIDE the scoring kernel (cumsum along the lane axis — a
+    log-step scan the VPU handles without leaving registers). Rows that
+    don't fit (a doc gap ≥ 2^16 or a tf ≥ 2^8) stay in a small raw int32
+    exception plane, mirroring streamvbyte's escape path."""
+
+    block_base: jax.Array      # (NP+1,) int32 — first doc of each packed row
+    block_gaps: jax.Array      # (NP+1, 128) uint16 — doc deltas, slot0 = 0
+    block_tfs8: jax.Array      # (NP+1, 128) uint8 — tf, 0 marks padding
+    raw_docs: jax.Array        # (NR+1, 128) int32, -1 padding
+    raw_tfs: jax.Array         # (NR+1, 128) int32
     norms: jax.Array           # (ndocs_pad,) int32
-    block_offsets: np.ndarray  # (T+1,) int64 — heavy terms' block-row spans
+    block_offsets: np.ndarray  # (T+1,) int64 — heavy terms' GLOBAL row spans
     heavy: np.ndarray          # (T,) bool
     flat_docs: np.ndarray      # host copies for the light-term tail
     flat_tfs: np.ndarray
     offsets: np.ndarray
     ndocs_pad: int
-    pad_row: int               # index of the all-padding block row
+    pad_row: int               # GLOBAL index of the all-padding block row
+    row_plane: np.ndarray      # (NB_total+1,) uint8 — 0 packed, 1 raw
+    row_slot: np.ndarray       # (NB_total+1,) int32 — index within plane
+    n_packed: int              # NP (packed pad slot = NP)
+    n_raw: int                 # NR (raw pad slot = NR)
     # block-max (WAND) metadata, host-resident: per heavy block row the max
     # tf and min doc length — a score upper bound valid for any avgdl
     # (reference: formats/posting/wand_writer.hpp impact pairs)
     block_bmax_tf: np.ndarray = None   # (NB_total+1,) int32
     block_bmin_dl: np.ndarray = None   # (NB_total+1,) int32
     norms_host: np.ndarray = None      # (num_docs,) int32
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Posting-tile HBM footprint (norms excluded — shared)."""
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in (self.block_base, self.block_gaps,
+                             self.block_tfs8, self.raw_docs, self.raw_tfs))
+
+    @property
+    def hbm_bytes_raw_equiv(self) -> int:
+        """What the same rows would cost as raw int32 doc+tf tiles."""
+        n_rows = len(self.row_plane)
+        return n_rows * BLOCK * 8
 
 
 def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
@@ -119,32 +148,74 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
     block_offsets = np.zeros(T + 1, dtype=np.int64)
     np.cumsum(nb_per, out=block_offsets[1:])
     nb_total = int(block_offsets[-1])
+    norms_h = np.ascontiguousarray(norms[:num_docs], dtype=np.int32)
+
+    # Vectorized tile assembly: scatter every heavy posting into its
+    # (row, lane) slot, -1/0 padding elsewhere.
     bdocs = np.full((nb_total + 1, BLOCK), -1, dtype=np.int32)
     btfs = np.zeros((nb_total + 1, BLOCK), dtype=np.int32)
-    norms_h = np.ascontiguousarray(norms[:num_docs], dtype=np.int32)
-    bmax_tf = np.zeros(nb_total + 1, dtype=np.int32)
-    bmin_dl = np.full(nb_total + 1, np.iinfo(np.int32).max, dtype=np.int32)
-    for t in np.flatnonzero(heavy):
-        s, e = int(offsets[t]), int(offsets[t + 1])
-        n = e - s
-        b0 = int(block_offsets[t])
-        nb = int(nb_per[t])
-        pad = nb * BLOCK - n
-        d = np.concatenate([post_docs[s:e],
-                            np.full(pad, -1, dtype=np.int32)])
-        f = np.concatenate([post_tfs[s:e], np.zeros(pad, dtype=np.int32)])
-        bdocs[b0:b0 + nb] = d.reshape(nb, BLOCK)
-        btfs[b0:b0 + nb] = f.reshape(nb, BLOCK)
-        bmax_tf[b0:b0 + nb] = f.reshape(nb, BLOCK).max(axis=1)
-        dl = np.where(d >= 0, norms_h[np.clip(d, 0, None)],
-                      np.iinfo(np.int32).max)
-        bmin_dl[b0:b0 + nb] = dl.reshape(nb, BLOCK).min(axis=1)
+    heavy_tids = np.flatnonzero(heavy)
+    if len(heavy_tids):
+        df_h = doc_freq[heavy_tids].astype(np.int64)
+        pt = np.repeat(heavy_tids, df_h)                # term of each posting
+        within = np.arange(len(pt), dtype=np.int64) - \
+            np.repeat(np.cumsum(df_h) - df_h, df_h)     # rank within term
+        src = np.repeat(offsets[heavy_tids], df_h) + within
+        grow = np.repeat(block_offsets[heavy_tids], df_h) + within // BLOCK
+        lane = within % BLOCK
+        bdocs[grow, lane] = post_docs[src]
+        btfs[grow, lane] = post_tfs[src]
+    bmax_tf = btfs.max(axis=1).astype(np.int32)
+    # bmin_dl without a full-size dl temporary: mask pads to int32-max
+    dl_vals = norms_h[np.clip(bdocs, 0, None)] if num_docs \
+        else np.zeros_like(bdocs)
+    np.putmask(dl_vals, bdocs < 0, np.iinfo(np.int32).max)
+    bmin_dl = dl_vals.min(axis=1).astype(np.int32)
+    del dl_vals
+    bmin_dl[-1] = np.iinfo(np.int32).max   # all-pad row
+
+    # Pack: forward-fill pads with the last real doc so gaps stay small,
+    # then delta-encode along the lane axis (in place — the build holds at
+    # most two full-size temporaries at a time; tiles reach GBs at the 8M
+    # bench shape).
+    docs_ff = np.maximum.accumulate(bdocs, axis=1)
+    base = docs_ff[:, 0].copy()
+    docs_ff[:, 1:] = docs_ff[:, 1:] - docs_ff[:, :-1]
+    docs_ff[:, 0] = 0
+    gaps = docs_ff                      # reuse: docs_ff IS the gap array now
+    packable = ((gaps.max(axis=1) < (1 << 16)) &
+                (bmax_tf < (1 << 8)) & (base >= 0))
+    packable[-1] = False     # keep the global pad row in the raw plane
+    row_plane = np.where(packable, 0, 1).astype(np.uint8)
+    row_slot = np.zeros(nb_total + 1, dtype=np.int32)
+    row_slot[packable] = np.arange(int(packable.sum()), dtype=np.int32)
+    row_slot[~packable] = np.arange(int((~packable).sum()), dtype=np.int32)
+    n_packed = int(packable.sum())
+    n_raw = int((~packable).sum())
+
+    pk_base = np.zeros(n_packed + 1, dtype=np.int32)
+    pk_gaps = np.zeros((n_packed + 1, BLOCK), dtype=np.uint16)
+    pk_tfs = np.zeros((n_packed + 1, BLOCK), dtype=np.uint8)
+    pk_base[:n_packed] = base[packable]
+    pk_gaps[:n_packed] = gaps[packable].astype(np.uint16)
+    del gaps, docs_ff
+    r_docs = np.full((n_raw + 1, BLOCK), -1, dtype=np.int32)
+    r_tfs = np.zeros((n_raw + 1, BLOCK), dtype=np.int32)
+    r_docs[:n_raw] = bdocs[~packable]
+    del bdocs
+    pk_tfs[:n_packed] = btfs[packable].astype(np.uint8)
+    r_tfs[:n_raw] = btfs[~packable]
+    del btfs
+
     nd_pad = max(1024, ((num_docs + 1023) // 1024) * 1024)
     norms_pad = np.zeros(nd_pad, dtype=np.int32)
     norms_pad[:num_docs] = norms[:num_docs]
     return BlockStore(
-        block_docs=jnp.asarray(bdocs),
-        block_tfs=jnp.asarray(btfs),
+        block_base=jnp.asarray(pk_base),
+        block_gaps=jnp.asarray(pk_gaps),
+        block_tfs8=jnp.asarray(pk_tfs),
+        raw_docs=jnp.asarray(r_docs),
+        raw_tfs=jnp.asarray(r_tfs),
         norms=jnp.asarray(norms_pad),
         block_offsets=block_offsets,
         heavy=heavy,
@@ -153,6 +224,10 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
         offsets=offsets,
         ndocs_pad=nd_pad,
         pad_row=nb_total,
+        row_plane=row_plane,
+        row_slot=row_slot,
+        n_packed=n_packed,
+        n_raw=n_raw,
         block_bmax_tf=bmax_tf,
         block_bmin_dl=bmin_dl,
         norms_host=norms_h,
@@ -162,11 +237,15 @@ def build_block_store(offsets: np.ndarray, post_docs: np.ndarray,
 @dataclass
 class QueryBatch:
     """Host-assembled inputs for one scoring dispatch covering B queries.
-    All arrays are tiny relative to the posting store (KBs per query)."""
+    All arrays are tiny relative to the posting store (KBs per query).
+    Heavy-term rows split across the two tile planes (packed / raw)."""
 
-    row_idx: np.ndarray    # (NB,) int32 block-row gather indices
+    row_idx: np.ndarray    # (NB,) int32 PACKED-plane row gather indices
     row_w: np.ndarray      # (NB,) f32 idf weight of the row's term
     row_qid: np.ndarray    # (NB,) int32 query index of the row
+    raw_idx: np.ndarray    # (NR,) int32 RAW-plane row gather indices
+    raw_w: np.ndarray      # (NR,) f32
+    raw_qid: np.ndarray    # (NR,) int32
     tail_docs: np.ndarray  # (TT,) int32 light-term postings (docs)
     tail_tfs: np.ndarray   # (TT,) int32
     tail_w: np.ndarray     # (TT,) f32
@@ -401,6 +480,7 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
     provably unable to reach the top-k before the device gather.
     """
     rows, row_w, row_q = [], [], []
+    rrows, rrow_w, rrow_q = [], [], []
     tails_d, tails_f, tails_w, tails_q = [], [], [], []
     require = []
     for qi, (term_ids, req) in enumerate(queries):
@@ -420,14 +500,23 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
             w = float(idf[k])
             if store.heavy[tid]:
                 if kept is not None:
-                    r = kept[tid].astype(np.int32)
+                    r = kept[tid].astype(np.int64)
                 else:
                     b0 = int(store.block_offsets[tid])
                     b1 = int(store.block_offsets[tid + 1])
-                    r = np.arange(b0, b1, dtype=np.int32)
-                rows.append(r)
-                row_w.append(np.full(len(r), w, dtype=np.float32))
-                row_q.append(np.full(len(r), qi, dtype=np.int32))
+                    r = np.arange(b0, b1, dtype=np.int64)
+                # split the term's global rows across the two planes
+                plane = store.row_plane[r]
+                pk = store.row_slot[r[plane == 0]]
+                rw = store.row_slot[r[plane == 1]]
+                if len(pk):
+                    rows.append(pk)
+                    row_w.append(np.full(len(pk), w, dtype=np.float32))
+                    row_q.append(np.full(len(pk), qi, dtype=np.int32))
+                if len(rw):
+                    rrows.append(rw)
+                    rrow_w.append(np.full(len(rw), w, dtype=np.float32))
+                    rrow_q.append(np.full(len(rw), qi, dtype=np.int32))
             else:
                 s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
                 tails_d.append(store.flat_docs[s:e])
@@ -441,12 +530,17 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
 
     row_idx = cat(rows, np.int32)
     nb_pad = _pow2(len(row_idx), 8)
+    raw_idx = cat(rrows, np.int32)
+    nr_pad = _pow2(len(raw_idx), 8)
     tail_docs = cat(tails_d, np.int32)
     tt_pad = _pow2(len(tail_docs), BLOCK)
     return QueryBatch(
-        row_idx=_pad_to(row_idx, nb_pad, store.pad_row),
+        row_idx=_pad_to(row_idx, nb_pad, store.n_packed),
         row_w=_pad_to(cat(row_w, np.float32), nb_pad, 0.0),
         row_qid=_pad_to(cat(row_q, np.int32), nb_pad, 0),
+        raw_idx=_pad_to(raw_idx, nr_pad, store.n_raw),
+        raw_w=_pad_to(cat(rrow_w, np.float32), nr_pad, 0.0),
+        raw_qid=_pad_to(cat(rrow_q, np.int32), nr_pad, 0),
         tail_docs=_pad_to(tail_docs, tt_pad, -1),
         tail_tfs=_pad_to(cat(tails_f, np.int32), tt_pad, 0),
         tail_w=_pad_to(cat(tails_w, np.float32), tt_pad, 0.0),
@@ -457,18 +551,22 @@ def assemble_query_batch(store: BlockStore, n_docs: int,
 
 
 def pack_query_batch(qb: QueryBatch) -> tuple[np.ndarray, np.ndarray,
-                                              int, int, int]:
+                                              int, int, int, int]:
     """Pack the per-query arrays into ONE int32 + ONE f32 buffer so a
-    dispatch costs two host→device transfers instead of eleven (each
+    dispatch costs two host→device transfers instead of fourteen (each
     transfer pays full RTT on tunneled TPUs).
 
-    ints: [row_idx | row_qid | tail_docs | tail_tfs | tail_qid | require]
-    floats: [row_w | tail_w]
+    ints: [row_idx | row_qid | raw_idx | raw_qid
+           | tail_docs | tail_tfs | tail_qid | require]
+    floats: [row_w | raw_w | tail_w]
     """
-    ints = np.concatenate([qb.row_idx, qb.row_qid, qb.tail_docs, qb.tail_tfs,
+    ints = np.concatenate([qb.row_idx, qb.row_qid, qb.raw_idx, qb.raw_qid,
+                           qb.tail_docs, qb.tail_tfs,
                            qb.tail_qid, qb.require]).astype(np.int32)
-    floats = np.concatenate([qb.row_w, qb.tail_w]).astype(np.float32)
-    return ints, floats, len(qb.row_idx), len(qb.tail_docs), qb.n_queries
+    floats = np.concatenate([qb.row_w, qb.raw_w,
+                             qb.tail_w]).astype(np.float32)
+    return (ints, floats, len(qb.row_idx), len(qb.raw_idx),
+            len(qb.tail_docs), qb.n_queries)
 
 
 def _pow2(n: int, floor: int) -> int:
@@ -482,53 +580,59 @@ def _pad_to(a: np.ndarray, n: int, fill) -> np.ndarray:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nb", "tt", "ndocs_pad", "k",
+                   static_argnames=("nb", "nr", "tt", "ndocs_pad", "k",
                                     "n_queries", "any_require", "scorer"))
-def score_topk_packed(block_docs: jax.Array, block_tfs: jax.Array,
+def score_topk_packed(block_base: jax.Array, block_gaps: jax.Array,
+                      block_tfs8: jax.Array, raw_docs: jax.Array,
+                      raw_tfs: jax.Array,
                       norms: jax.Array, ints: jax.Array, floats: jax.Array,
-                      nb: int, tt: int, ndocs_pad: int, k: int,
+                      nb: int, nr: int, tt: int, ndocs_pad: int, k: int,
                       n_queries: int, any_require: bool, k1: float,
                       b: float, avgdl: float,
                       scorer: str = "bm25") -> tuple[jax.Array, jax.Array]:
     """Packed-argument entry (2 transfers): unpack then score."""
     row_idx = ints[:nb]
     row_qid = ints[nb:2 * nb]
-    tail_docs = ints[2 * nb:2 * nb + tt]
-    tail_tfs = ints[2 * nb + tt:2 * nb + 2 * tt]
-    tail_qid = ints[2 * nb + 2 * tt:2 * nb + 3 * tt]
-    require = ints[2 * nb + 3 * tt:2 * nb + 3 * tt + n_queries]
+    o = 2 * nb
+    raw_idx = ints[o:o + nr]
+    raw_qid = ints[o + nr:o + 2 * nr]
+    o += 2 * nr
+    tail_docs = ints[o:o + tt]
+    tail_tfs = ints[o + tt:o + 2 * tt]
+    tail_qid = ints[o + 2 * tt:o + 3 * tt]
+    require = ints[o + 3 * tt:o + 3 * tt + n_queries]
     row_w = floats[:nb]
-    tail_w = floats[nb:nb + tt]
-    return _score_topk(block_docs, block_tfs, norms, row_idx, row_w,
-                       row_qid, tail_docs, tail_tfs, tail_w, tail_qid,
+    raw_w = floats[nb:nb + nr]
+    tail_w = floats[nb + nr:nb + nr + tt]
+    return _score_topk(block_base, block_gaps, block_tfs8, raw_docs,
+                       raw_tfs, norms, row_idx, row_w, row_qid,
+                       raw_idx, raw_w, raw_qid,
+                       tail_docs, tail_tfs, tail_w, tail_qid,
                        require, ndocs_pad, k, n_queries, any_require,
                        k1, b, avgdl, scorer)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("ndocs_pad", "k", "n_queries",
-                                    "any_require"))
-def score_topk_batch(block_docs: jax.Array, block_tfs: jax.Array,
-                     norms: jax.Array, row_idx: jax.Array, row_w: jax.Array,
-                     row_qid: jax.Array, tail_docs: jax.Array,
-                     tail_tfs: jax.Array, tail_w: jax.Array,
-                     tail_qid: jax.Array, require: jax.Array,
-                     ndocs_pad: int, k: int, n_queries: int,
-                     any_require: bool, k1: float, b: float,
-                     avgdl: float) -> tuple[jax.Array, jax.Array]:
-    return _score_topk(block_docs, block_tfs, norms, row_idx, row_w,
-                       row_qid, tail_docs, tail_tfs, tail_w, tail_qid,
-                       require, ndocs_pad, k, n_queries, any_require,
-                       k1, b, avgdl)
+def _decode_rows(block_base, block_gaps, block_tfs8, row_idx):
+    """In-kernel decompression of packed posting rows: docs = base +
+    lane-axis prefix sum of the uint16 gaps (the TPU analog of the
+    reference's SIMD streamvbyte/bitpack decode, format_block_128.cpp);
+    tf=0 marks padding."""
+    gaps = block_gaps[row_idx].astype(jnp.int32)        # (NB, 128)
+    docs = block_base[row_idx][:, None] + jnp.cumsum(gaps, axis=1)
+    tfs = block_tfs8[row_idx].astype(jnp.int32)
+    valid = tfs > 0
+    return jnp.where(valid, docs, -1), tfs
 
 
-def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
+def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
+                norms, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
                 tail_docs, tail_tfs, tail_w, tail_qid, require,
                 ndocs_pad: int, k: int, n_queries: int, any_require: bool,
                 k1: float, b: float, avgdl: float, scorer: str = "bm25"):
-    """One dispatch scoring B queries: fused gather → score → batched
-    scatter-accumulate into (B, ndocs) → per-query top-k. Batching amortizes
-    host↔device dispatch latency — the QPS regime of the benchmark game.
+    """One dispatch scoring B queries: fused gather+decode → score →
+    batched scatter-accumulate into (B, ndocs) → per-query top-k. Batching
+    amortizes host↔device dispatch latency — the QPS regime of the
+    benchmark game.
 
     scorer: 'bm25' (k1/b saturation + length norm) or 'tfidf'
     (sqrt(tf)·w — the IResearch TFIDF shape, tfidf.cpp; the per-term idf
@@ -536,7 +640,7 @@ def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
     avg = jnp.maximum(jnp.float32(avgdl), 1e-9)
 
     def contrib_of(docs, tfs, w):
-        valid = docs >= 0
+        valid = jnp.logical_and(docs >= 0, tfs > 0)
         safe_docs = jnp.where(valid, docs, 0)
         tfsf = tfs.astype(jnp.float32)
         if scorer == "tfidf":
@@ -572,19 +676,28 @@ def _score_topk(block_docs, block_tfs, norms, row_idx, row_w, row_qid,
             c = w * (k1 + 1.0) * tfsf / jnp.maximum(denom, 1e-9)
         return jnp.where(valid, c, 0.0), valid, safe_docs
 
-    rdocs = block_docs[row_idx]            # (NB, 128)
-    rtfs = block_tfs[row_idx]
-    wc, valid_b, safe_b = contrib_of(rdocs, rtfs, row_w[:, None])
-    bidx = (row_qid[:, None] * ndocs_pad + safe_b).reshape(-1)
     scores = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.float32)
+    hits = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.int32) \
+        if any_require else None
+    # packed plane: gather + in-kernel delta decode
+    pdocs, ptfs = _decode_rows(block_base, block_gaps, block_tfs8, row_idx)
+    wc, valid_b, safe_b = contrib_of(pdocs, ptfs, row_w[:, None])
+    bidx = (row_qid[:, None] * ndocs_pad + safe_b).reshape(-1)
     scores = scores.at[bidx].add(wc.reshape(-1))
+    # raw exception plane (rows whose gaps/tfs overflow the packed widths)
+    rdocs = raw_docs[raw_idx]
+    rtfs = raw_tfs[raw_idx]
+    rc, valid_r, safe_r = contrib_of(rdocs, rtfs, raw_w[:, None])
+    ridx = (raw_qid[:, None] * ndocs_pad + safe_r).reshape(-1)
+    scores = scores.at[ridx].add(rc.reshape(-1))
+    # light-term tails
     tc, valid_t, safe_t = contrib_of(tail_docs, tail_tfs, tail_w)
     tidx = tail_qid * ndocs_pad + safe_t
     scores = scores.at[tidx].add(tc)
     scores = scores.reshape(n_queries, ndocs_pad)
     if any_require:
-        hits = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.int32)
         hits = hits.at[bidx].add(valid_b.reshape(-1).astype(jnp.int32))
+        hits = hits.at[ridx].add(valid_r.reshape(-1).astype(jnp.int32))
         hits = hits.at[tidx].add(valid_t.astype(jnp.int32))
         hits = hits.reshape(n_queries, ndocs_pad)
         need = require[:, None]
@@ -625,21 +738,31 @@ class DenseStore:
 
 
 @functools.partial(jax.jit, static_argnames=("ndocs_pad", "v_pad", "scorer"))
-def _build_dense(block_docs, block_tfs, row_tid, light_docs, light_tfs,
+def _build_dense(block_base, block_gaps, block_tfs8, pk_tid,
+                 raw_docs, raw_tfs, raw_tid, light_docs, light_tfs,
                  light_tid, norms, ndocs_pad: int, v_pad: int, k1: float,
                  b: float, avgdl: float, scorer: str) -> jax.Array:
-    """One-time scatter of every posting into a dense TF plane, then the
-    scorer's saturation applied elementwise. Runs once per (segment,
-    scorer, avgdl); per-query dispatches touch only the result."""
+    """One-time scatter of every posting (decoded from the packed planes)
+    into a dense TF plane, then the scorer's saturation applied
+    elementwise. Runs once per (segment, scorer, avgdl); per-query
+    dispatches touch only the result."""
     tf = jnp.zeros((ndocs_pad, v_pad), dtype=jnp.float32)
-    bd = block_docs.reshape(-1)
-    bt = block_tfs.reshape(-1)
-    btid = jnp.broadcast_to(row_tid[:, None],
-                            block_docs.shape).reshape(-1)
-    valid = bd >= 0
-    tf = tf.at[jnp.where(valid, bd, 0),
-               jnp.where(valid, btid, 0)].add(
-        jnp.where(valid, bt.astype(jnp.float32), 0.0))
+    all_rows = jnp.arange(block_base.shape[0], dtype=jnp.int32)
+    pdocs, ptfs = _decode_rows(block_base, block_gaps, block_tfs8, all_rows)
+    pd = pdocs.reshape(-1)
+    pt = ptfs.reshape(-1)
+    ptid = jnp.broadcast_to(pk_tid[:, None], pdocs.shape).reshape(-1)
+    pvalid = pd >= 0
+    tf = tf.at[jnp.where(pvalid, pd, 0),
+               jnp.where(pvalid, ptid, 0)].add(
+        jnp.where(pvalid, pt.astype(jnp.float32), 0.0))
+    rd = raw_docs.reshape(-1)
+    rt = raw_tfs.reshape(-1)
+    rtid = jnp.broadcast_to(raw_tid[:, None], raw_docs.shape).reshape(-1)
+    rvalid = rd >= 0
+    tf = tf.at[jnp.where(rvalid, rd, 0),
+               jnp.where(rvalid, rtid, 0)].add(
+        jnp.where(rvalid, rt.astype(jnp.float32), 0.0))
     lvalid = light_docs >= 0
     tf = tf.at[jnp.where(lvalid, light_docs, 0),
                jnp.where(lvalid, light_tid, 0)].add(
@@ -669,10 +792,16 @@ def build_dense_store(store: BlockStore, doc_freq: np.ndarray,
     # per-row term id. Light terms: one-time flat upload (df < HEAVY_DF
     # each, so the tail is small).
     rows_per_term = np.diff(store.block_offsets).astype(np.int64)
-    row_tid = np.repeat(np.arange(T, dtype=np.int32),
-                        rows_per_term)
-    row_tid = np.concatenate([row_tid, np.zeros(
-        store.block_docs.shape[0] - len(row_tid), dtype=np.int32)])
+    row_tid = np.zeros(len(store.row_plane), dtype=np.int32)
+    row_tid[:int(rows_per_term.sum())] = np.repeat(
+        np.arange(T, dtype=np.int32), rows_per_term)
+    # split the global row→term map by plane (the planes' extra pad rows
+    # keep tid 0 — their postings decode as invalid and never scatter)
+    pk_tid = np.zeros(store.n_packed + 1, dtype=np.int32)
+    raw_tid = np.zeros(store.n_raw + 1, dtype=np.int32)
+    packed_rows = store.row_plane == 0
+    pk_tid[store.row_slot[packed_rows]] = row_tid[packed_rows]
+    raw_tid[store.row_slot[~packed_rows]] = row_tid[~packed_rows]
     # light terms: one boolean mask over the flat postings (vectorized —
     # vocab can reach ~260k at the budget boundary)
     df = np.diff(store.offsets).astype(np.int64)
@@ -683,7 +812,9 @@ def build_dense_store(store: BlockStore, doc_freq: np.ndarray,
     light_tid = post_tid[light_mask]
     n_pad = _pow2(len(light_docs), BLOCK)
     S = _build_dense(
-        store.block_docs, store.block_tfs, jnp.asarray(row_tid),
+        store.block_base, store.block_gaps, store.block_tfs8,
+        jnp.asarray(pk_tid), store.raw_docs, store.raw_tfs,
+        jnp.asarray(raw_tid),
         jnp.asarray(_pad_to(light_docs, n_pad, -1)),
         jnp.asarray(_pad_to(light_tfs, n_pad, 0)),
         jnp.asarray(_pad_to(light_tid, n_pad, 0)),
@@ -736,11 +867,16 @@ def assemble_dense_weights(v_pad: int,
 
 
 @functools.partial(jax.jit, static_argnames=("ndocs_pad",))
-def match_bitmap(block_docs: jax.Array, row_idx: jax.Array,
+def match_bitmap(block_base: jax.Array, block_gaps: jax.Array,
+                 block_tfs8: jax.Array, row_idx: jax.Array,
+                 raw_docs: jax.Array, raw_idx: jax.Array,
                  tail_docs: jax.Array, ndocs_pad: int) -> jax.Array:
     """Disjunctive match bitmap (unscored filter pushdown)."""
-    rdocs = block_docs[row_idx].reshape(-1)
+    pdocs, _ = _decode_rows(block_base, block_gaps, block_tfs8, row_idx)
+    pdocs = pdocs.reshape(-1)
+    rdocs = raw_docs[raw_idx].reshape(-1)
     m = jnp.zeros((ndocs_pad,), dtype=jnp.bool_)
+    m = m.at[jnp.where(pdocs >= 0, pdocs, 0)].max(pdocs >= 0)
     m = m.at[jnp.where(rdocs >= 0, rdocs, 0)].max(rdocs >= 0)
     m = m.at[jnp.where(tail_docs >= 0, tail_docs, 0)].max(tail_docs >= 0)
     return m

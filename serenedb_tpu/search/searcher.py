@@ -432,10 +432,11 @@ class SegmentSearcher:
         kk = min(kk, nd_pad)
         nq = qb.n_queries
         if any(len(q[0]) > 0 for q in queries):
-            ints, floats, nb, tt, nq = bm25_ops.pack_query_batch(qb)
+            ints, floats, nb, nr, tt, nq = bm25_ops.pack_query_batch(qb)
             vals, docs = bm25_ops.score_topk_packed(
-                store.block_docs, store.block_tfs, store.norms,
-                jnp.asarray(ints), jnp.asarray(floats), nb, tt,
+                store.block_base, store.block_gaps, store.block_tfs8,
+                store.raw_docs, store.raw_tfs, store.norms,
+                jnp.asarray(ints), jnp.asarray(floats), nb, nr, tt,
                 nd_pad, kk, nq, bool(qb.require.any()),
                 bm25_ops.scorer_param(scorer, K1), B, avgdl, scorer)
             vals, docs = jax.device_get((vals, docs))

@@ -286,8 +286,11 @@ def test_wand_pruning_parity_and_reduction():
         k, fi.avgdl, 1.2, 0.75, "bm25") for t, r, _, _ in shapes]
     qb_on = bm25_ops.assemble_query_batch(
         store, searcher.num_docs, queries, fi.doc_freq, plans=plans)
-    rows_off = int((qb_off.row_idx != store.pad_row).sum())
-    rows_on = int((qb_on.row_idx != store.pad_row).sum())
+    def live_rows(qb):
+        return (int((qb.row_idx != store.n_packed).sum()) +
+                int((qb.raw_idx != store.n_raw).sum()))
+    rows_off = live_rows(qb_off)
+    rows_on = live_rows(qb_on)
     assert rows_on < rows_off, (rows_on, rows_off)
 
     # end-to-end parity: device top-k with pruning equals CPU reference
@@ -411,3 +414,71 @@ def test_cpu_wand_topk_matches_exhaustive():
         for j, (a, b) in enumerate(zip(wd.tolist(), ed.tolist())):
             if a != b:
                 assert abs(float(ws[j]) - float(es[j])) < 1e-6
+
+
+def test_packed_store_exception_rows_and_compression():
+    """Posting rows with doc gaps ≥ 2^16 or tf ≥ 2^8 must fall back to the
+    raw exception plane with exact scores, and the packed layout must
+    actually shrink the HBM tile footprint."""
+    from serenedb_tpu.ops import bm25 as bm25_ops
+    rng = np.random.default_rng(3)
+    n_docs = 300_000
+    # term 0: sparse spread over the full doc space → huge gaps (raw rows);
+    # term 1: dense cluster with one giant tf (raw via tf overflow);
+    # term 2: a normal dense term (packed rows)
+    # deterministic gap > 2^16 between the first two postings → the row
+    # must take the raw exception plane
+    d0 = np.concatenate([[0], 70_000 + np.arange(63) * 3000]) \
+        .astype(np.int32)
+    d1 = np.arange(100, 356, dtype=np.int32)
+    d2 = np.sort(rng.choice(5000, 2000, replace=False)).astype(np.int32)
+    post_docs = np.concatenate([d0, d1, d2])
+    t1 = np.ones(len(d1), dtype=np.int32)
+    t1[7] = 5000   # tf overflow
+    post_tfs = np.concatenate([
+        rng.integers(1, 5, len(d0)).astype(np.int32), t1,
+        rng.integers(1, 5, len(d2)).astype(np.int32)])
+    offsets = np.asarray([0, len(d0), len(d0) + len(d1),
+                          len(post_docs)], dtype=np.int64)
+    doc_freq = np.asarray([len(d0), len(d1), len(d2)], dtype=np.int32)
+    norms = rng.integers(5, 60, n_docs).astype(np.int32)
+    store = bm25_ops.build_block_store(offsets, post_docs, post_tfs,
+                                      doc_freq, norms, n_docs)
+    assert store.n_raw > 1, "expected raw exception rows"
+    assert store.n_packed > 0, "expected packed rows"
+    # the gap-overflow row (term 0) and the tf-overflow row (term 1, first
+    # block holds tf=5000) must be in the raw plane
+    assert store.row_plane[int(store.block_offsets[0])] == 1
+    assert store.row_plane[int(store.block_offsets[1])] == 1
+    # term 2 is dense and small-valued → packed
+    assert store.row_plane[int(store.block_offsets[2])] == 0
+    assert store.hbm_bytes < store.hbm_bytes_raw_equiv * 0.6, \
+        (store.hbm_bytes, store.hbm_bytes_raw_equiv)
+
+    from serenedb_tpu.search.segment import FieldIndex, _add_block_max
+    fi = FieldIndex(
+        terms=np.asarray(["aa", "bb", "cc"], dtype=object),
+        doc_freq=doc_freq, offsets=offsets, post_docs=post_docs,
+        post_tfs=post_tfs,
+        pos_offsets=np.zeros(len(post_docs) + 1, dtype=np.int64),
+        positions=np.empty(0, dtype=np.int32), norms=norms,
+        block_max_tf=np.empty(0, dtype=np.int32),
+        block_offsets=np.zeros(4, dtype=np.int64),
+        total_tokens=int(post_tfs.sum()))
+    _add_block_max(fi)
+    s = SegmentSearcher(fi, get_analyzer("simple"), n_docs)
+    s._dev = store
+    for q, req in [(parse_query("aa", s.analyzer), 0),
+                   (parse_query("bb", s.analyzer), 0),
+                   (parse_query("aa | cc", s.analyzer), 0),
+                   (parse_query("bb & cc", s.analyzer), 2)]:
+        tids = s.scoring_terms(q)
+        dev_s, dev_d = s.topk_batch([q], 10)[0]
+        match = s.eval_filter(q)
+        ref_s, ref_d = s._cpu_score(match, tids, 10)
+        keep = ref_s > 0
+        ref_s, ref_d = ref_s[keep][:10], ref_d[keep][:10]
+        np.testing.assert_allclose(dev_s, ref_s, rtol=2e-3, atol=1e-3)
+        for j, (a, b) in enumerate(zip(dev_d.tolist(), ref_d.tolist())):
+            if a != b:
+                assert abs(float(dev_s[j]) - float(ref_s[j])) < 1e-3
