@@ -513,10 +513,13 @@ class ExprBinder:
             import numpy as np
             data = np.zeros(batch.num_rows, dtype=bool)
             valid = np.ones(batch.num_rows, dtype=bool)
+            empty = not values and not has_null
             xv = x.to_pylist()
             for i, v in enumerate(xv):
                 if v is None:
-                    valid[i] = False
+                    # NULL IN (empty set) is false — there is nothing to
+                    # compare against; non-empty sets make it NULL
+                    valid[i] = empty
                 elif v in values:
                     data[i] = True
                 elif has_null:
@@ -547,13 +550,13 @@ class ExprBinder:
             for i, rows in self._correlated_rows(_q, _refs, batch, _pc):
                 vals = [r[0] for r in rows]
                 if xv[i] is None:
-                    valid[i] = False
+                    valid[i] = not vals   # NULL IN (empty set) = false
                 elif xv[i] in set(v for v in vals if v is not None):
                     data[i] = True
                 elif any(v is None for v in vals):
                     valid[i] = False
             if _neg:
-                data = ~data
+                data = ~data & valid
             return Column(dt.BOOL, data,
                           None if valid.all() else valid)
         return BoundFunc("in_subquery", [operand], dt.BOOL, impl)

@@ -121,10 +121,62 @@ def format_value(v, typ=None) -> str:
     if isinstance(v, float):
         if math.isnan(v):
             return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
         if v == int(v) and abs(v) < 1e15:
             return str(int(v))
         return f"{v:.3f}".rstrip("0").rstrip(".")
     return str(v)
+
+
+def compare_query(rec: Record, actual: list[str], where: str,
+                  failures: list[str]) -> None:
+    """Golden comparison shared by the in-process and wire runners."""
+    expected = [e.replace("\t", " ") for e in rec.expected]
+    if rec.rowsort:
+        actual = sorted(actual)
+        expected = sorted(expected)
+    if actual != expected:
+        failures.append(f"{where}: mismatch\n  expected: {expected}\n"
+                        f"  actual:   {actual}")
+
+
+def run_test_file_wire(execute, path: str) -> list[str]:
+    """Run one behavior file over a LIVE pg-wire connection — the parity
+    contract crosses the protocol serde it certifies (reference: the
+    sqllogictest-rs harness runs every file over 4 wire protocol modes,
+    tests/sqllogic/run.sh, CONTRIBUTING.md:57-72).
+
+    `execute(sql) -> (rows, err)`: rows = sqllogic-normalized text values
+    per row; err = None or (sqlstate, message). The protocol mode (simple /
+    extended text / extended binary) lives inside `execute`. Recovery
+    directives are wire-runner failures — those files need process
+    orchestration."""
+    failures = []
+    for rec in parse_test_file(path):
+        where = f"{path}:{rec.line}"
+        if rec.kind == "restart" or rec.expect_error == "__crash__":
+            failures.append(f"{where}: recovery directive in a wire run")
+            break
+        rows, err = execute(rec.sql)
+        if rec.kind == "statement":
+            if rec.expect_error is None:
+                if err is not None:
+                    failures.append(
+                        f"{where}: unexpected error: {err[1]}")
+            elif err is None:
+                failures.append(f"{where}: expected error, got success")
+            elif rec.expect_error and rec.expect_error not in err[1] \
+                    and rec.expect_error != err[0]:
+                failures.append(
+                    f"{where}: error mismatch: wanted "
+                    f"{rec.expect_error!r} in {err[1]!r}")
+            continue
+        if err is not None:
+            failures.append(f"{where}: unexpected error: {err[1]}")
+            continue
+        compare_query(rec, [" ".join(row) for row in rows], where, failures)
+    return failures
 
 
 def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
@@ -168,14 +220,7 @@ def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
                 actual = [" ".join(format_value(v, tys[i])
                                    for i, v in enumerate(row))
                           for row in result.rows()]
-                expected = [e.replace("\t", " ") for e in rec.expected]
-                if rec.rowsort:
-                    actual = sorted(actual)
-                    expected = sorted(expected)
-                if actual != expected:
-                    failures.append(
-                        f"{where}: mismatch\n  expected: {expected}\n"
-                        f"  actual:   {actual}")
+                compare_query(rec, actual, where, failures)
         except SqlError as e:
             if rec.expect_error is None:
                 failures.append(f"{where}: unexpected error: {e.message}")
