@@ -95,6 +95,28 @@ def _make_compare(op: str):
                 data = _CMP_NP[op](av, bv)
             else:
                 data = _CMP_NP[op](a.data, b.data)
+                # PG float total order: NaN = NaN, NaN > everything
+                # (reference: server/pg/serialize.cpp float semantics)
+                anan = (np.isnan(a.data) if a.data.dtype.kind == "f"
+                        else np.zeros(len(a.data), dtype=bool))
+                bnan = (np.isnan(b.data) if b.data.dtype.kind == "f"
+                        else np.zeros(len(b.data), dtype=bool))
+                nan_rows = anan | bnan
+                if nan_rows.any():
+                    both = anan & bnan
+                    if op == "=":
+                        fix = both
+                    elif op in ("<>", "!="):
+                        fix = nan_rows & ~both
+                    elif op == "<":
+                        fix = bnan & ~anan
+                    elif op == "<=":
+                        fix = bnan
+                    elif op == ">":
+                        fix = anan & ~bnan
+                    else:  # >=
+                        fix = anan
+                    data = np.where(nan_rows, fix, data)
             return _result(dt.BOOL, data, cols)
         return FunctionResolution(dt.BOOL, impl)
     return resolver
@@ -311,10 +333,10 @@ def _make_arith(op: str):
                                               "division by zero")
                     aa = a.astype(np.int64)
                     bb64 = b.astype(np.int64)
-                    if op == "/":
-                        data = (np.abs(aa) // np.abs(bb64)) * np.sign(aa) * np.sign(bb64)
-                    else:
-                        data = aa - (np.abs(aa) // np.abs(bb64)) * np.sign(aa) * np.sign(bb64) * bb64
+                    # zeros can remain in NULL rows; divide by 1 there
+                    bsafe = np.where(bb64 == 0, 1, bb64)
+                    q = (np.abs(aa) // np.abs(bsafe)) * np.sign(aa) * np.sign(bsafe)
+                    data = q if op == "/" else aa - q * bb64
                     data = data.astype(t.np_dtype)
                 else:
                     if live_zero.any():
@@ -523,6 +545,29 @@ def _power(ts):
 @register("mod")
 def _mod(ts):
     return _make_arith("%")(ts)
+
+
+@register("div")
+def _div(ts):
+    """PG div(a, b): integer-truncating division (toward zero); 22012 on
+    zero divisor.  Reference: server/connector/functions/math.cpp."""
+    if len(ts) != 2 or not _all_numeric(ts):
+        return None
+    if ts[0].is_integer and ts[1].is_integer:
+        return _make_arith("/")(ts)
+    def impl(cols, n):
+        b = cols[1].data.astype(np.float64)
+        pn = propagate_nulls(cols)
+        zero = b == 0
+        live_zero = zero if pn is None else (zero & pn)
+        if live_zero.any():
+            raise errors.SqlError(errors.DIVISION_BY_ZERO,
+                                  "division by zero")
+        with np.errstate(all="ignore"):
+            data = np.trunc(cols[0].data.astype(np.float64) /
+                            np.where(zero, 1.0, b))
+        return _result(dt.DOUBLE, data, cols)
+    return FunctionResolution(dt.DOUBLE, impl)
 
 
 @register("sign")

@@ -321,13 +321,21 @@ class SearchDbWal:
                 self._seg_max_tick[gen] = 0
                 continue
             if data[:len(SEGMENT_MAGIC)] != SEGMENT_MAGIC:
-                if gi == len(gens) - 1 and \
-                        SEGMENT_MAGIC.startswith(data):
-                    # torn header write: the segment holds no frames yet
-                    with open(path, "r+b") as f:
-                        f.truncate(0)
-                    self._seg_max_tick[gen] = 0
-                    continue
+                if SEGMENT_MAGIC.startswith(data):
+                    # torn header write (strict prefix of the magic)
+                    if gi == len(gens) - 1:
+                        # last segment: the uncommitted tail — truncate
+                        with open(path, "r+b") as f:
+                            f.truncate(0)
+                        self._seg_max_tick[gen] = 0
+                        continue
+                    # a sealed segment can never legitimately hold a bare
+                    # prefix of the magic: that is corruption, not a
+                    # format mismatch
+                    raise errors.SqlError(
+                        "58030",
+                        f"WAL corruption in sealed segment {path}: torn "
+                        "header")
                 raise errors.SqlError(
                     "58030",
                     f"incompatible WAL version in {path}: expected format "

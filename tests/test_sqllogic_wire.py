@@ -48,6 +48,8 @@ _PG_EPOCH_DAYS = 10_957
 def _fmt_float(v: float) -> str:
     if math.isnan(v):
         return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return f"{v:.3f}".rstrip("0").rstrip(".")
