@@ -92,12 +92,14 @@ class Database(TableResolver):
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
+        #: guards the CATALOG (schemas/tables/views dicts), the session
+        #: registry and LISTEN/NOTIFY wiring — NOT data-plane execution.
+        #: Table data is guarded per-table: writers serialize on
+        #: MemTable.write_lock, readers pin the atomic (batch, version,
+        #: epoch) publication without any lock, so concurrent SELECTs and
+        #: DML on different tables never contend process-wide (reference:
+        #: morsel-parallel execution, server_engine.cpp:225-244).
         self.lock = threading.RLock()
-        #: signalled when a parallel-ingest fast-path commit publishes;
-        #: mutating ops / checkpoints wait on it until a table has no
-        #: committed-but-unpublished inserts (shares self.lock so waiting
-        #: releases the DML lock for the publisher)
-        self.publish_cond = threading.Condition(self.lock)
         self.schemas: dict[str, SchemaObj] = {"main": SchemaObj("main")}
         self.sequences: dict[str, dict] = {}
         # parquet providers are cached by path so repeated queries reuse the
@@ -132,31 +134,37 @@ class Database(TableResolver):
             self.maintenance = MaintenanceManager(self)
             self.maintenance.start()
 
-    def wait_quiesced(self, table) -> None:
-        """Block (releasing self.lock via publish_cond) until `table` has
-        no committed-but-unpublished fast-path inserts. MUST be called
-        while holding self.lock; on return the lock is held and no new
-        in-flight commit can register until it is released. The waiters
-        gate keeps a sustained insert stream from starving the caller."""
-        self.wait_quiesced_all([table])
-
-    def wait_quiesced_all(self, tables) -> None:
-        """Quiesce SEVERAL tables at once: the waiters gate is raised on
-        every table before waiting, so a fast-path insert cannot slip onto
-        an already-quiesced table while we wait on another (sequential
-        wait_quiesced calls release self.lock between tables, reopening
-        exactly the publish-ahead-of-earlier-tick window the caller is
-        closing). MUST be called holding self.lock."""
-        tables = list(tables)
+    @contextlib.contextmanager
+    def quiesced(self, tables):
+        """Exclusive writer section over `tables` with fast-path inserts
+        drained: raises the quiesce gate on EVERY table first (so an
+        insert cannot slip onto an already-drained table while a later one
+        is still draining), waits each table's in-flight publishes out
+        holding only THAT table's lock (a publisher needs its table's
+        write_lock — waiting while holding another table's lock would
+        deadlock), then acquires every write_lock in a global order. On
+        exit, locks release and gates lower. Mutating ops and checkpoint
+        capture run inside this so a committed-but-unpublished insert can
+        never order between a commit's WAL tick and its publish (which
+        would make live state diverge from replayed state)."""
+        tables = sorted(set(tables), key=id)
         for t in tables:
-            t._quiesce_waiters = getattr(t, "_quiesce_waiters", 0) + 1
+            with t.write_lock:
+                t._quiesce_waiters = getattr(t, "_quiesce_waiters", 0) + 1
         try:
-            while any(getattr(t, "_inflight", 0) for t in tables):
-                self.publish_cond.wait(timeout=5)
+            for t in tables:
+                with t.write_lock:
+                    while getattr(t, "_inflight", 0):
+                        t.pub_cond.wait(timeout=5)
+            with contextlib.ExitStack() as stack:
+                for t in tables:
+                    stack.enter_context(t.write_lock)
+                yield
         finally:
             for t in tables:
-                t._quiesce_waiters -= 1
-            self.publish_cond.notify_all()
+                with t.write_lock:
+                    t._quiesce_waiters -= 1
+                    t.pub_cond.notify_all()
 
     def crash(self):
         """Abandon this Database as if the process was killed: stop loops
@@ -547,12 +555,14 @@ class Database(TableResolver):
                 return
             key = name.lower()
             if kind == "index":
+                from .search.index import _index_lock
                 removed = False
                 for t in s.tables.values():
                     idxs = getattr(t, "indexes", {})
                     for iname in list(idxs):
                         if iname.lower() == key:
-                            del idxs[iname]
+                            with _index_lock(t):
+                                idxs.pop(iname, None)
                             removed = True
                 if removed or if_exists:
                     return
@@ -1204,8 +1214,11 @@ class Connection:
             # index default ('text' unless WITH tokenizer=... says else)
             options["column_tokenizers"] = dict(st.column_tokenizers)
         with _progress.track("CREATE INDEX", provider.row_count()):
-            provider.indexes[idx_name] = build_index_for_table(
-                provider, st.columns, st.using, options)
+            built = build_index_for_table(provider, st.columns, st.using,
+                                          options)
+            from .search.index import _index_lock
+            with _index_lock(provider):   # serializes registry mutation
+                provider.indexes[idx_name] = built
         if self.db.store is not None and isinstance(provider, StoredTable):
             idef = {"table": provider.key, "columns": list(st.columns),
                     "using": st.using, "options": options}
@@ -1223,8 +1236,11 @@ class Connection:
             if st.if_exists:
                 return QueryResult(Batch([], []), "ALTER TABLE")
             raise
-        with self.db.lock:
-            self._wait_quiesced(table)
+        # LOCK ORDER: write_lock (via quiesced) first, db.lock inner —
+        # the same order DML uses when a WHERE subquery resolves tables
+        # under the write_lock (resolve_table takes db.lock). db.lock is
+        # only taken around the rename's catalog-dict mutation below.
+        with self.db.quiesced([table]):
             full = table.full_batch()
             names = list(full.names)
             if st.action == "add_column":
@@ -1266,18 +1282,19 @@ class Connection:
                               rows_preserved=True)
             elif st.action == "rename_table":
                 schema, name = self.db._split(st.table)
-                s = self.db.schemas[schema]
-                new_key = st.new_name.lower()
-                if new_key in s.tables or new_key in s.views:
-                    raise errors.SqlError(
-                        errors.DUPLICATE_TABLE,
-                        f'relation "{st.new_name}" already exists')
-                del s.tables[name.lower()]
-                table.name = st.new_name
-                s.tables[new_key] = table
-                if isinstance(table, StoredTable):
-                    old_skey = table.key
-                    table.key = f"{schema}.{new_key}"
+                with self.db.lock:   # catalog-dict mutation
+                    s = self.db.schemas[schema]
+                    new_key = st.new_name.lower()
+                    if new_key in s.tables or new_key in s.views:
+                        raise errors.SqlError(
+                            errors.DUPLICATE_TABLE,
+                            f'relation "{st.new_name}" already exists')
+                    del s.tables[name.lower()]
+                    table.name = st.new_name
+                    s.tables[new_key] = table
+                    if isinstance(table, StoredTable):
+                        old_skey = table.key
+                        table.key = f"{schema}.{new_key}"
             # indexes over altered tables rebuild on next refresh; dropped/
             # renamed columns drop their indexes
             if st.action in ("drop_column", "rename_column"):
@@ -1311,12 +1328,6 @@ class Connection:
                                     st.column in v["columns"])}
                 self.db.store.update_meta(mutate)
         return QueryResult(Batch([], []), "ALTER TABLE")
-
-    def _wait_quiesced(self, table) -> None:
-        """Mutating ops and checkpoint capture quiesce fast-path inserts
-        so they never order between a commit's WAL tick and its publish
-        (which would make live state diverge from replayed state)."""
-        self.db.wait_quiesced(table)
 
     def _table_for_dml(self, parts: list[str],
                        privilege: str = "insert",
@@ -1357,33 +1368,41 @@ class Connection:
         meta = getattr(provider, "table_meta", None)
         if meta is not None:
             copy.table_meta = meta
-        if share_indexes and batch is provider.full_batch():
+        if share_indexes:
             # segments are immutable: a pin over the CURRENT batch can
             # share the provider's search indexes (in-txn indexed search);
-            # matching data_version keeps the freshness checks honest
-            copy.data_version = provider.data_version
-            copy.mutation_epoch = provider.mutation_epoch
-            copy.indexes = dict(getattr(provider, "indexes", {}) or {})
+            # batch+version+epoch are ONE atomic observation via pinned()
+            # so the freshness checks stay honest without any lock
+            cur, ver, epoch = provider.pinned()
+            if batch is cur:
+                copy.data_version = ver
+                copy.mutation_epoch = epoch
+                # the per-provider rebuild lock serializes every mutation
+                # of the index registry (CREATE INDEX / read-repair), so
+                # copying under it is deterministic
+                from .search.index import _index_lock
+                with _index_lock(provider):
+                    copy.indexes = dict(getattr(provider, "indexes",
+                                                {}) or {})
         return copy
 
     def _txn_read_provider(self, provider):
-        # pin under db.lock: batch + data_version must be one atomic
-        # observation (a concurrent UPDATE is replace-then-append — an
-        # unlocked read could pair a torn batch with the final version)
+        # _txn_key_of scans the catalog dicts — db.lock guards those; the
+        # data pin itself is the provider's atomic publication
         with self.db.lock:
             key = self._txn_key_of(provider)
-            if key is None:
-                return provider
-            w = self._txn_writes.get(key)
-            if w is not None:
-                return w["work"]          # read-your-writes
-            pin = self._txn_pins.get(key)
-            if pin is None:
-                pin = self._txn_copy(provider, provider.full_batch(),
-                                     share_indexes=True)
-                pin._txn_base_version = provider.data_version
-                self._txn_pins[key] = pin
-            return pin
+        if key is None:
+            return provider
+        w = self._txn_writes.get(key)
+        if w is not None:
+            return w["work"]          # read-your-writes
+        pin = self._txn_pins.get(key)
+        if pin is None:
+            batch, ver, _ = provider.pinned()
+            pin = self._txn_copy(provider, batch, share_indexes=True)
+            pin._txn_base_version = ver
+            self._txn_pins[key] = pin
+        return pin
 
     def _txn_write_provider(self, provider) -> MemTable:
         with self.db.lock:
@@ -1419,16 +1438,16 @@ class Connection:
         if not self._txn_writes:
             return
         from .storage.wal import WalOp
-        with self.db.lock:
-            # Quiesce committed-but-unpublished fast-path inserts first:
-            # such an insert holds an earlier WAL tick but is invisible to
-            # the data_version conflict check, and publishing txn ops ahead
-            # of it would diverge live row order from replay (tick) order,
-            # corrupting positional delete/update records on recovery.
-            # All written tables quiesce TOGETHER — waiting per-table
-            # releases the lock between tables.
-            self.db.wait_quiesced_all(
-                [w["real"] for w in self._txn_writes.values()])
+        # Quiesce committed-but-unpublished fast-path inserts first: such
+        # an insert holds an earlier WAL tick but is invisible to the
+        # data_version conflict check, and publishing txn ops ahead of it
+        # would diverge live row order from replay (tick) order,
+        # corrupting positional delete/update records on recovery.
+        # quiesced() holds every written table's write_lock, so the
+        # conflict check + WAL commit + publish are atomic vs other
+        # writers of those tables; writers of OTHER tables proceed.
+        with self.db.quiesced(
+                [w["real"] for w in self._txn_writes.values()]):
             for key, w in self._txn_writes.items():
                 if w["real"].data_version != w["version"] or \
                         self.db._table_by_key(key) is not w["real"]:
@@ -1533,7 +1552,7 @@ class Connection:
                 return QueryResult(self._returning_batch(
                     st.returning, table, aligned, params), tag)
             return QueryResult(Batch([], []), tag)
-        with self.db.lock:
+        with table.write_lock:
             aligned = _align_to_schema(table, incoming)
             _check_not_null(table, aligned)
             key_cols_new = [aligned.column(c).to_pylist() for c in pk]
@@ -1633,8 +1652,7 @@ class Connection:
         table = self._table_for_dml(st.table, "delete")
         if st.returning:
             self.db.resolve_table(st.table, "select")
-        with self.db.lock:
-            self._wait_quiesced(table)
+        with self.db.quiesced([table]):
             full = table.full_batch()
             if st.where is None:
                 rows = np.arange(full.num_rows, dtype=np.int64)
@@ -1668,8 +1686,7 @@ class Connection:
         table = self._table_for_dml(st.table, "update")
         if st.returning:
             self.db.resolve_table(st.table, "select")
-        with self.db.lock:
-            self._wait_quiesced(table)
+        with self.db.quiesced([table]):
             full = table.full_batch()
             scope = Scope.of(list(full.names), [c.type for c in full.columns],
                              st.table[-1])
@@ -1727,10 +1744,10 @@ class Connection:
                     seen.add(key)
             self._wal_commit(table, [("delete", None, rows),
                                      ("insert", updated, None)])
-            mask_keep = np.ones(full.num_rows, dtype=bool)
-            mask_keep[rows] = False
-            table.replace(full.filter(mask_keep))
-            _append_rows(table, updated)
+            # single-publish delete+reinsert: lock-free readers never see
+            # the intermediate rows-removed state
+            _apply_ops(table, [("delete", None, rows),
+                               ("insert", updated, None)])
         tag = f"UPDATE {n}"
         if st.returning:
             return QueryResult(self._returning_batch(
@@ -1739,8 +1756,7 @@ class Connection:
 
     def _truncate(self, st: ast.Truncate) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
-        with self.db.lock:
-            self._wait_quiesced(table)
+        with self.db.quiesced([table]):
             self._wal_commit(table, [("truncate", None, None)])
             table.replace(table.full_batch().slice(0, 0))
         return QueryResult(Batch([], []), "TRUNCATE TABLE")
@@ -1911,8 +1927,8 @@ class Connection:
         verbs = set(st.verbs) or {"refresh"}
         for t in targets:
             if isinstance(t, StoredTable) and self.db.store is not None:
-                with self.db.lock:  # batch+tick must be captured atomically
-                    self._wait_quiesced(t)
+                # batch+tick must be captured atomically vs writers
+                with self.db.quiesced([t]):
                     batch = t.full_batch()
                     tick = self.db.store.ticks.current()
                 self.db.store.checkpoint_table(t.key, t.table_id, batch,
@@ -2140,7 +2156,7 @@ class Connection:
         return Batch(names, cols)
 
     def _insert_batch(self, table: MemTable, incoming: Batch) -> Batch:
-        with self.db.lock:
+        with table.write_lock:
             aligned = _align_to_schema(table, incoming)
             _check_not_null(table, aligned)
             pk = _pk_of(table)
@@ -2164,7 +2180,7 @@ class Connection:
             # give way to any mutator waiting to quiesce this table —
             # without this gate a sustained insert stream starves it
             while getattr(table, "_quiesce_waiters", 0):
-                self.db.publish_cond.wait(timeout=5)
+                table.pub_cond.wait(timeout=5)
             table._inflight = getattr(table, "_inflight", 0) + 1
             entry = {"tick": None, "done": False}
             if not hasattr(table, "_pub_entries"):
@@ -2183,25 +2199,25 @@ class Connection:
         try:
             self._wal_commit(table, [("insert", aligned, None)],
                              on_tick=lambda t: entry.__setitem__("tick", t))
-            with self.db.lock:
+            with table.write_lock:
                 while any(e is not entry and not e["done"]
                           and e["tick"] is not None
                           and entry["tick"] is not None
                           and e["tick"] < entry["tick"]
                           for e in table._pub_entries):
-                    self.db.publish_cond.wait(timeout=5)
+                    table.pub_cond.wait(timeout=5)
                 _append_rows(table, aligned)
                 entry["done"] = True
-                self.db.publish_cond.notify_all()
+                table.pub_cond.notify_all()
         finally:
-            with self.db.lock:
+            with table.write_lock:
                 entry["done"] = True
                 try:
                     table._pub_entries.remove(entry)
                 except ValueError:
                     pass
                 table._inflight -= 1
-                self.db.publish_cond.notify_all()
+                table.pub_cond.notify_all()
         return aligned
 
     def _wal_commit(self, table: MemTable, ops: list[tuple], on_tick=None):
@@ -2221,19 +2237,25 @@ class Connection:
 
 
 def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
-    """THE op-replay transformation, shared by WAL recovery and txn
-    commit so committed state always matches recovered state."""
+    """THE op-replay transformation, shared by WAL recovery, txn commit
+    and UPDATE/upsert so committed state always matches recovered state.
+    All ops compose on a scratch copy and land in ONE publish: lock-free
+    readers can never observe a delete-without-reinsert intermediate
+    state of a multi-op statement."""
+    scratch = MemTable(table.name, table.full_batch())
     for kind, batch, rows in ops:
         if kind == "insert":
-            _append_rows(table, batch)
+            scratch.append_batch(batch)
         elif kind == "delete":
-            full = table.full_batch()
+            full = scratch.full_batch()
             mask = np.ones(full.num_rows, dtype=bool)
             rows = np.asarray(rows, dtype=np.int64)
             mask[rows[rows < full.num_rows]] = False
-            table.replace(full.filter(mask))
+            scratch.replace(full.filter(mask))
         elif kind == "truncate":
-            table.replace(table.full_batch().slice(0, 0))
+            scratch.replace(scratch.full_batch().slice(0, 0))
+    rows_preserved = all(kind == "insert" for kind, _, _ in ops)
+    table.replace(scratch.full_batch(), rows_preserved=rows_preserved)
 
 
 def _pk_of(table) -> list:

@@ -71,6 +71,21 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
 def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Batch:
     col_names = scan.columns
 
+    # ONE publication observation for the WHOLE query: dictionaries, key
+    # planning, factorized codes, the device column environment and the
+    # row mask must all come from the same (batch, version) — per-column
+    # fetches could straddle a concurrent publish and hand the device
+    # program columns of different lengths/row orders. Immutable
+    # providers (parquet) pin nothing and read per column lazily.
+    pin = provider.try_pin()
+    pin_batch = pin[0] if pin is not None else None
+    dev_ver = pin[1] if pin is not None else provider.data_version
+
+    def host_col(name):
+        if pin_batch is not None:
+            return pin_batch.column(name)
+        return provider.host_column(name)
+
     # only referenced string columns need their dictionary materialized
     referenced: set[int] = set()
     for e in preds + list(node.group_exprs) + \
@@ -80,8 +95,8 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                 referenced.add(sub.index)
     dictionaries: dict[int, np.ndarray] = {}
     for i in sorted(referenced):
-        if provider.type_of(col_names[i]).is_string:
-            col = provider.host_column(col_names[i])
+        if scan.types[i].is_string:
+            col = host_col(col_names[i])
             if col.dictionary is not None:
                 dictionaries[i] = col.dictionary
 
@@ -92,11 +107,12 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     fact = None
     try:
         key_plans, group_space = _plan_direct_keys(
-            node, scan, provider, col_names, dictionaries)
+            node, scan, host_col, col_names, dictionaries)
     except NotCompilable:
         if not node.group_exprs:
             raise
-        fact = _factorize_group_keys(node, scan, provider)
+        fact = _factorize_group_keys(node, scan, provider, pin_batch,
+                                     dev_ver)
         key_plans, group_space = [], max(fact["g"], 1)
 
     agg_plans = []
@@ -119,7 +135,8 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         if ce is not None:
             needed.update(ce.inputs)
     needed = sorted(needed)
-    env_cols = {i: provider.device_column(col_names[i]) for i in needed}
+    by_name = provider.device_columns([col_names[i] for i in needed], pin)
+    env_cols = {i: by_name[col_names[i]] for i in needed}
     metrics.DEVICE_OFFLOADS.add()
 
     import jax.numpy as jnp
@@ -169,7 +186,7 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                     _scalar_agg_device(spec, ce, arrays, mask, env_for))
         return tuple(outputs)
 
-    key = (id(provider), provider.data_version,
+    key = (id(provider), dev_ver,
            tuple(_expr_key(p) for p in preds),
            tuple(_expr_key(g) for g in node.group_exprs),
            tuple((s.func, _expr_key(s.arg)) for s in node.aggs))
@@ -186,15 +203,20 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         flat_args.append(fact["codes2d"])
     # A column's device mask excludes padding but ALSO that column's NULLs —
     # wrong as a row mask for count(*). Use a pure row-validity mask built
-    # from the logical length (cached on the provider: it's per-table state).
-    nrows = provider.row_count()
+    # from the logical length of the SAME publication as the columns
+    # (cached per version on the provider).
+    nrows = pin_batch.num_rows if pin_batch is not None \
+        else provider.row_count()
     prows = pad_len(nrows)
-    rowmask_arr = getattr(provider, "_device_rowmask", None)
-    if rowmask_arr is None or rowmask_arr.shape != (prows // 128, 128):
+    rm_entry = getattr(provider, "_device_rowmask", None)
+    if rm_entry is None or rm_entry[0] != dev_ver or \
+            rm_entry[1].shape != (prows // 128, 128):
         rm = np.zeros(prows, dtype=bool)
         rm[:nrows] = True
         rowmask_arr = jnp.asarray(rm.reshape(-1, 128))
-        provider._device_rowmask = rowmask_arr
+        provider._device_rowmask = (dev_ver, rowmask_arr)
+    else:
+        rowmask_arr = rm_entry[1]
     results = jitted(*flat_args, rowmask_arr)
 
     if group_mode:
@@ -204,9 +226,10 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     return _build_scalar_batch(node, agg_plans, results)
 
 
-def _plan_direct_keys(node, scan, provider, col_names, dictionaries):
+def _plan_direct_keys(node, scan, host_col, col_names, dictionaries):
     """Direct group-key coding: dictionary codes / small-range integers.
-    Raises NotCompilable when any key needs factorization."""
+    Raises NotCompilable when any key needs factorization. host_col reads
+    from the query's pinned publication."""
     key_plans = []
     group_space = 1
     for g in node.group_exprs:
@@ -220,7 +243,7 @@ def _plan_direct_keys(node, scan, provider, col_names, dictionaries):
             size = len(d) + 1      # +1: NULL group
             key_plans.append(("dict", g.index, 0, size))
         elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
-            col = provider.host_column(col_names[g.index])
+            col = host_col(col_names[g.index])
             if col.data.size == 0:
                 lo, hi = 0, 0
             else:
@@ -242,7 +265,7 @@ def _plan_direct_keys(node, scan, provider, col_names, dictionaries):
     return key_plans, group_space
 
 
-def _factorize_group_keys(node, scan, provider) -> dict:
+def _factorize_group_keys(node, scan, provider, pin_batch, dev_ver) -> dict:
     """Composite host factorization of arbitrary GROUP BY keys: evaluate
     the key expressions over the host columns, build dense codes with
     ops_agg.factorize_keys (NULLs group per PG semantics), upload the
@@ -255,7 +278,9 @@ def _factorize_group_keys(node, scan, provider) -> dict:
     import jax.numpy as jnp
 
     ekeys = tuple(_expr_key(g) for g in node.group_exprs)
-    ver = provider.data_version
+    # version + batch are ONE observation (passed in from the query's
+    # pin): codes factorized over batch N+1 must never cache under N
+    ver = dev_ver
     cache = getattr(provider, "_factorize_cache", None)
     if cache is None:
         cache = provider._factorize_cache = {}
@@ -265,7 +290,11 @@ def _factorize_group_keys(node, scan, provider) -> dict:
     hit = cache.get((ver, ekeys))
     if hit is not None:
         return hit
-    full = provider.full_batch(scan.columns)
+    if pin_batch is not None:
+        full = Batch(list(scan.columns),
+                     [pin_batch.column(c) for c in scan.columns])
+    else:
+        full = provider.full_batch(scan.columns)
     try:
         key_cols = [g.eval(full) for g in node.group_exprs]
     except Exception as e:

@@ -49,29 +49,66 @@ class TableProvider:
     #: bumped on every data mutation; device program/column caches key on it
     data_version: int = 0
 
+    def pinned(self):
+        """(batch, data_version, mutation_epoch) observation. MemTable
+        overrides this with a genuinely atomic single-reference read so
+        readers never need a lock against concurrent DML; other providers
+        are immutable and the default composition is safe."""
+        return (self.full_batch(), self.data_version,
+                getattr(self, "mutation_epoch", 0))
+
+    def try_pin(self):
+        """Atomic (batch, data_version, mutation_epoch) observation for
+        MUTABLE providers (MemTable overrides); None for immutable ones,
+        whose per-column reads are torn-free by construction — and which
+        must not pay a whole-file materialization just to pin (a
+        ParquetTable decodes columns lazily)."""
+        return None
+
     def __init_device_cache(self):
         if not hasattr(self, "_device_cache"):
-            self._device_cache: dict[str, DeviceColumn] = {}
+            self._device_cache: dict[str, tuple[int, DeviceColumn]] = {}
             self._device_lock = threading.Lock()
 
-    def device_column(self, name: str) -> DeviceColumn:
+    def device_columns(self, names, pin=None) -> dict:
+        """{name: DeviceColumn} with EVERY entry built from one
+        publication — the given pin (from try_pin()) or per-column reads
+        on immutable providers. A multi-column device program must get
+        its whole environment here: fetching columns one at a time could
+        mix two publications (mismatched lengths / row order) when DML
+        lands between the fetches. Entries are version-stamped so a
+        racing publish can never leave a stale column cached under the
+        new version."""
         self.__init_device_cache()
         with self._device_lock:
-            dc = self._device_cache.get(name)
-            if dc is None:
-                col = self.full_batch([name]).column(name)
-                dc = to_device_column(col)
-                metrics.DEVICE_BYTES.add(int(dc.data.size * dc.data.dtype.itemsize))
-                self._device_cache[name] = dc
-        return dc
+            if pin is not None:
+                batch, ver = pin[0], pin[1]
+            else:
+                batch, ver = None, self.data_version
+            out = {}
+            for name in names:
+                entry = self._device_cache.get(name)
+                if entry is None or entry[0] != ver:
+                    col = (batch.column(name) if batch is not None
+                           else self.full_batch([name]).column(name))
+                    dc = to_device_column(col)
+                    metrics.DEVICE_BYTES.add(
+                        int(dc.data.size * dc.data.dtype.itemsize))
+                    self._device_cache[name] = (ver, dc)
+                    out[name] = dc
+                else:
+                    out[name] = entry[1]
+            return out
+
+    def device_column(self, name: str) -> DeviceColumn:
+        return self.device_columns([name], self.try_pin())[name]
 
     def host_column(self, name: str) -> Column:
         return self.full_batch([name]).column(name)
 
-    def invalidate_device_cache(self):
+    def clear_device_cache(self):
         self.__init_device_cache()
         with self._device_lock:
-            self.data_version += 1
             self._device_cache.clear()
             if hasattr(self, "_device_rowmask"):
                 del self._device_rowmask
@@ -93,39 +130,93 @@ class MemTable(TableProvider):
 
     def __init__(self, name: str, batch: Batch):
         self.name = name
-        self._batch = batch
-        self.column_names = list(batch.names)
-        self.column_types = [c.type for c in batch.columns]
-        self.mutation_epoch = 0
+        #: the table's entire mutable state, published as ONE reference:
+        #: (batch, data_version, mutation_epoch, column_names,
+        #: column_types). Readers observe it with a single attribute read
+        #: — no lock — so SELECTs never wait on DML and can never pair a
+        #: torn batch with the wrong version or schema (reference analog:
+        #: publish-by-swap DirectoryReader snapshots, SURVEY.md §2.7; and
+        #: the morsel-parallel reads of server_engine.cpp:225-244).
+        self._pub = (batch, 0, 0, list(batch.names),
+                     [c.type for c in batch.columns])
+        #: serializes WRITERS of this table only (DML, checkpoint capture,
+        #: ALTER); readers never take it
+        self.write_lock = threading.RLock()
+        #: wakes fast-path-publish waiters / quiescers of THIS table
+        self.pub_cond = threading.Condition(self.write_lock)
+
+    # single-reference publication: all views of the state are slices of
+    # one tuple read
+    @property
+    def _batch(self) -> Batch:
+        return self._pub[0]
+
+    @property
+    def data_version(self) -> int:
+        return self._pub[1]
+
+    @data_version.setter
+    def data_version(self, v: int):
+        b, _, e, n, t = self._pub
+        self._pub = (b, v, e, n, t)
+
+    @property
+    def mutation_epoch(self) -> int:
+        return self._pub[2]
+
+    @mutation_epoch.setter
+    def mutation_epoch(self, e: int):
+        b, v, _, n, t = self._pub
+        self._pub = (b, v, e, n, t)
+
+    @property
+    def column_names(self) -> list:
+        return self._pub[3]
+
+    @property
+    def column_types(self) -> list:
+        return self._pub[4]
+
+    def pinned(self):
+        return self._pub[:3]
+
+    def try_pin(self):
+        return self._pub[:3]
+
+    def type_of(self, name: str) -> dt.SqlType:
+        # one tuple read: two separate property reads could straddle a
+        # publish and pair shifted indices during ALTER
+        _, _, _, names, types = self._pub
+        return types[names.index(name)]
 
     def row_count(self) -> int:
         return self._batch.num_rows
 
     def full_batch(self, columns: Optional[list[str]] = None) -> Batch:
+        batch = self._batch
         if columns is None:
-            return self._batch
-        missing = [c for c in columns if c not in self._batch]
+            return batch
+        missing = [c for c in columns if c not in batch]
         if missing:
             raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                   f"column {missing[0]} does not exist")
-        return Batch(list(columns), [self._batch.column(c) for c in columns])
+        return Batch(list(columns), [batch.column(c) for c in columns])
 
     def replace(self, batch: Batch, *, rows_preserved: bool = False):
-        self._batch = batch
-        self.column_names = list(batch.names)
-        self.column_types = [c.type for c in batch.columns]
-        if not rows_preserved:
-            self.mutation_epoch += 1
-        self.invalidate_device_cache()
+        _, v, e, _, _ = self._pub
+        self._pub = (batch, v + 1, e if rows_preserved else e + 1,
+                     list(batch.names), [c.type for c in batch.columns])
+        self.clear_device_cache()
 
     def append_batch(self, aligned: Batch):
         """Append rows (schema-aligned) without changing existing row
         identity — search indexes stay valid for the old rows."""
         from ..columnar.column import concat_batches
+        batch = self._batch
         cols = []
         for i, name in enumerate(self.column_names):
             merged = concat_batches(
-                [Batch([name], [self._batch.columns[i]]),
+                [Batch([name], [batch.columns[i]]),
                  Batch([name], [aligned.columns[i]])]).columns[0]
             cols.append(merged)
         self.replace(Batch(list(self.column_names), cols),

@@ -116,8 +116,13 @@ class EsApi:
                        text_index: bool = True):
         if name in t.column_names:
             return
-        with self.db.lock:
+        # quiesced([t]) — not db.lock — excludes concurrent DML writers
+        # of THIS table: a read-modify-write under db.lock alone would
+        # republish a stale batch over rows an insert just committed
+        with self.db.quiesced([t]):
             full = t.full_batch()
+            if name in full.names:
+                return
             col = Column.from_pylist([None] * full.num_rows, typ)
             t.replace(Batch(list(full.names) + [name],
                             list(full.columns) + [col]),

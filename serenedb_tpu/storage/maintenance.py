@@ -109,10 +109,10 @@ class MaintenanceManager:
         for t in tables:
             if self._checkpointed_version.get(t.key) == t.data_version:
                 continue
-            with self.db.lock:  # batch + tick captured atomically vs DML
-                # committed-but-unpublished fast-path inserts would be
-                # missing from the batch yet covered by the tick
-                self.db.wait_quiesced(t)
+            # batch + tick captured atomically vs DML of THIS table:
+            # committed-but-unpublished fast-path inserts would be
+            # missing from the batch yet covered by the tick
+            with self.db.quiesced([t]):
                 batch = t.full_batch()
                 version = t.data_version
                 tick = store.ticks.current()
