@@ -33,6 +33,22 @@ from .tables import TableProvider
 MAX_GROUP_PRODUCT = 1 << 21   # combined-key code-space cap
 MAX_INT_KEY_RANGE = 1 << 20   # direct-coding range cap for integer keys
 
+import threading as _threading
+
+_factorize_guard = _threading.Lock()
+
+
+def _factorize_lock(provider) -> "_threading.Lock":
+    """Per-provider lock guarding _factorize_cache (lazily attached)."""
+    lk = getattr(provider, "_factorize_cache_lock", None)
+    if lk is None:
+        with _factorize_guard:
+            lk = getattr(provider, "_factorize_cache_lock", None)
+            if lk is None:
+                lk = _threading.Lock()
+                provider._factorize_cache_lock = lk
+    return lk
+
 _AGG_FUNCS = {"count_star", "count", "sum", "min", "max", "avg"}
 
 
@@ -59,7 +75,8 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
             provider.row_count() < ctx.settings.get("serene_device_min_rows"):
         return None
     for spec in node.aggs:
-        if spec.func not in _AGG_FUNCS or spec.distinct:
+        if spec.func not in _AGG_FUNCS or spec.distinct or \
+                spec.filter is not None:
             return None
     try:
         return _run(node, scan, provider, preds, ctx)
@@ -281,13 +298,18 @@ def _factorize_group_keys(node, scan, provider, pin_batch, dev_ver) -> dict:
     # version + batch are ONE observation (passed in from the query's
     # pin): codes factorized over batch N+1 must never cache under N
     ver = dev_ver
-    cache = getattr(provider, "_factorize_cache", None)
-    if cache is None:
-        cache = provider._factorize_cache = {}
-    stale = [k2 for k2 in cache if k2[0] != ver]
-    for k2 in stale:  # old data versions can never be read again
-        del cache[k2]
-    hit = cache.get((ver, ekeys))
+    lock = _factorize_lock(provider)
+    with lock:
+        # readers are lock-free and concurrent: all cache scans and
+        # mutations go through this per-provider lock (two concurrent
+        # GROUP BYs after an UPDATE would otherwise race the stale purge)
+        cache = getattr(provider, "_factorize_cache", None)
+        if cache is None:
+            cache = provider._factorize_cache = {}
+        stale = [k2 for k2 in cache if k2[0] != ver]
+        for k2 in stale:  # old data versions can never be read again
+            del cache[k2]
+        hit = cache.get((ver, ekeys))
     if hit is not None:
         return hit
     if pin_batch is not None:
@@ -320,7 +342,8 @@ def _factorize_group_keys(node, scan, provider, pin_batch, dev_ver) -> dict:
     }
     if len(cache) >= 16:  # bound HBM held by codes buffers
         cache.pop(next(iter(cache)))
-    cache[(ver, ekeys)] = value
+    with lock:
+        cache[(ver, ekeys)] = value
     return value
 
 

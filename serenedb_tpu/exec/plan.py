@@ -168,6 +168,10 @@ class ProjectNode(PlanNode):
 
 class LimitNode(PlanNode):
     def __init__(self, child: PlanNode, limit: Optional[int], offset: int = 0):
+        if limit is not None and limit < 0:
+            raise errors.SqlError("2201W", "LIMIT must not be negative")
+        if offset and offset < 0:
+            raise errors.SqlError("2201X", "OFFSET must not be negative")
         self.child = child
         self.limit = limit
         self.offset = offset
@@ -273,7 +277,8 @@ class JoinNode(PlanNode):
     def __init__(self, kind: str, left: PlanNode, right: PlanNode,
                  left_keys: list[BoundExpr], right_keys: list[BoundExpr],
                  residual: Optional[BoundExpr], names: list[str],
-                 types: list[dt.SqlType]):
+                 types: list[dt.SqlType],
+                 merge_pairs: Optional[list] = None):
         self.kind = kind
         self.left = left
         self.right = right
@@ -282,6 +287,9 @@ class JoinNode(PlanNode):
         self.residual = residual
         self.names = names
         self.types = types
+        #: FULL JOIN USING: (left col idx, right col idx) pairs whose
+        #: left copy takes the right side's value on right-only rows
+        self.merge_pairs = merge_pairs or []
 
     def children(self):
         return [self.left, self.right]
@@ -299,20 +307,29 @@ class JoinNode(PlanNode):
             c = self.residual.eval(pair)
             keep = c.data.astype(bool) & c.valid_mask()
             li, ri = li[keep], ri[keep]
-        if self.kind == "left":
+        if self.kind in ("left", "full"):
             matched = np.zeros(lb.num_rows, dtype=bool)
             matched[li] = True
             extra = np.flatnonzero(~matched)
             li = np.concatenate([li, extra])
             ri = np.concatenate([ri, np.full(len(extra), -1, dtype=np.int64)])
-        elif self.kind == "right":
+        if self.kind in ("right", "full"):
             matched = np.zeros(rb.num_rows, dtype=bool)
-            matched[ri] = True
+            matched[ri[ri >= 0]] = True
             extra = np.flatnonzero(~matched)
             ri = np.concatenate([ri, extra])
             li = np.concatenate([li, np.full(len(extra), -1, dtype=np.int64)])
         lcols = _take_null_extended(lb, li)
         rcols = _take_null_extended(rb, ri)
+        if self.merge_pairs:
+            right_only = li < 0
+            if right_only.any():
+                for lk, rk in self.merge_pairs:
+                    lvals = lcols[lk].to_pylist()
+                    rvals = rcols[rk].to_pylist()
+                    merged = [rvals[i] if right_only[i] else lvals[i]
+                              for i in range(len(lvals))]
+                    lcols[lk] = Column.from_pylist(merged, lcols[lk].type)
         yield Batch(list(self.names), lcols + rcols)
 
     def _match_inner(self, lb: Batch, rb: Batch) -> tuple[np.ndarray, np.ndarray]:
@@ -412,6 +429,127 @@ class SetOpNode(PlanNode):
         yield Batch(list(self.names), cols)
 
 
+class DistinctOnNode(PlanNode):
+    """SELECT DISTINCT ON (keys): keep the FIRST row (in the incoming,
+    already-sorted order) of each distinct key tuple (PG semantics)."""
+
+    def __init__(self, child: PlanNode, key_indices: list):
+        self.child = child
+        self.key_indices = list(key_indices)
+        self.names = list(child.names)
+        self.types = list(child.types)
+
+    def children(self):
+        return [self.child]
+
+    def label(self):
+        return f"DistinctOn {self.key_indices}"
+
+    def batches(self, ctx):
+        seen: set = set()
+        for b in self.child.batches(ctx):
+            key_cols = [b.columns[i].to_pylist() for i in self.key_indices]
+            keep = np.zeros(b.num_rows, dtype=bool)
+            for r in range(b.num_rows):
+                k = tuple(kc[r] for kc in key_cols)
+                if k not in seen:
+                    seen.add(k)
+                    keep[r] = True
+            yield b if keep.all() else b.filter(keep)
+
+
+class RenameNode(PlanNode):
+    """Output-column rename (CTE column lists: WITH c(a, b) AS ...)."""
+
+    def __init__(self, child: PlanNode, names: list):
+        self.child = child
+        if len(names) != len(child.names):
+            raise errors.SqlError(
+                "42P10", "column list does not match the number of "
+                "output columns")
+        self.names = list(names)
+        self.types = list(child.types)
+
+    def children(self):
+        return [self.child]
+
+    def batches(self, ctx):
+        for b in self.child.batches(ctx):
+            yield Batch(list(self.names), list(b.columns))
+
+
+class RecursiveCteNode(PlanNode):
+    """WITH RECURSIVE fixpoint: run the base term, then re-run the step
+    term against the previous iteration's rows (exposed as the `work`
+    MemTable the step plan scans) until no new rows arrive. UNION (not
+    ALL) deduplicates across ALL accumulated rows, so cyclic graphs
+    terminate (PG semantics, src/backend/executor/nodeRecursiveunion.c
+    re-expressed over columnar batches)."""
+
+    MAX_ITERATIONS = 20_000
+
+    def __init__(self, names, base: PlanNode, step: PlanNode, work,
+                 union_all: bool):
+        self.names = list(names)
+        self.types = list(base.types)
+        self.base = base
+        self.step = step
+        self.work = work
+        self.union_all = union_all
+
+    def children(self):
+        return [self.base, self.step]
+
+    def label(self):
+        return f"RecursiveCte {self.work.name}" + \
+            (" ALL" if self.union_all else "")
+
+    def batches(self, ctx):
+        from ..sql.binder import cast_column
+        seen: set = set()
+        acc: list[Batch] = []
+
+        def conform(b: Batch) -> Batch:
+            cols = [cast_column(c, t) for c, t in zip(b.columns, self.types)]
+            return Batch(list(self.names), cols)
+
+        def dedup(b: Batch) -> Batch:
+            rows = b.rows()
+            keep = np.ones(len(rows), dtype=bool)
+            for i, r in enumerate(rows):
+                if r in seen:
+                    keep[i] = False
+                else:
+                    seen.add(r)
+            return b if keep.all() else b.filter(keep)
+
+        cur = conform(self.base.execute(ctx))
+        if not self.union_all:
+            cur = dedup(cur)
+        it = 0
+        while cur.num_rows:
+            check_cancel()
+            acc.append(cur)
+            it += 1
+            if it > self.MAX_ITERATIONS:
+                raise errors.SqlError(
+                    "54001", "recursive query iteration limit exceeded")
+            self.work.replace(cur)
+            cur = conform(self.step.execute(ctx))
+            if not self.union_all:
+                cur = dedup(cur)
+        # leave the working table empty so a cached plan re-executes from
+        # a clean slate
+        self.work.replace(Batch(list(self.names),
+                                [Column.from_pylist([], t)
+                                 for t in self.types]))
+        if not acc:
+            yield empty_batch(self.names, self.types)
+            return
+        for b in acc:
+            yield b
+
+
 def _unify_setop_type(lt: dt.SqlType, rt: dt.SqlType) -> dt.SqlType:
     if lt.id is dt.TypeId.NULL:
         return rt
@@ -486,7 +624,8 @@ class AggregateNode(PlanNode):
         counting semantics (count_matching) so they can never diverge
         from its row-returning path."""
         if self.group_exprs or not self.aggs or \
-                any(s.func != "count_star" for s in self.aggs):
+                any(s.func != "count_star" or s.filter is not None
+                    for s in self.aggs):
             return None
         count_fn = getattr(self.child, "count_matching", None)
         if count_fn is None:
@@ -521,6 +660,11 @@ class AggregateNode(PlanNode):
 
     def _cpu_group_agg(self, spec: AggSpec, full: Batch, codes: np.ndarray,
                        g: int) -> Column:
+        if spec.filter is not None:
+            c = spec.filter.eval(full)
+            fm = c.data.astype(bool) & c.valid_mask()
+            full = full.filter(fm)
+            codes = codes[fm]
         if spec.func == "count_star":
             data = np.bincount(codes, minlength=g).astype(np.int64)
             return Column(dt.BIGINT, data)
@@ -671,6 +815,9 @@ class _ScalarAcc:
 
     def update(self, b: Batch):
         spec = self.spec
+        if spec.filter is not None:
+            c = spec.filter.eval(b)
+            b = b.filter(c.data.astype(bool) & c.valid_mask())
         if spec.func == "count_star":
             self.count += b.num_rows
             return

@@ -570,6 +570,130 @@ def _div(ts):
     return FunctionResolution(dt.DOUBLE, impl)
 
 
+# -- bitwise operators (parser-desugared: & | # << >> ~) -------------------
+
+def _bitwise(np_fn):
+    def resolver(ts):
+        if len(ts) != 2 or not all(t.is_integer or t.id is dt.TypeId.NULL
+                                   for t in ts):
+            return None
+        t = max(ts, key=lambda x: x.np_dtype.itemsize if x.is_integer
+                else 0)
+        if not t.is_integer:
+            t = dt.INT
+        def impl(cols, n):
+            a = cols[0].data.astype(np.int64)
+            b = cols[1].data.astype(np.int64)
+            with np.errstate(all="ignore"):
+                data = np_fn(a, b)
+            return _result(t, data.astype(t.np_dtype), cols)
+        return FunctionResolution(t, impl)
+    return resolver
+
+
+_REGISTRY["bitand"] = _bitwise(np.bitwise_and)
+_REGISTRY["bitor"] = _bitwise(np.bitwise_or)
+_REGISTRY["bitxor"] = _bitwise(np.bitwise_xor)
+_REGISTRY["bitshiftleft"] = _bitwise(
+    lambda a, b: np.left_shift(a, np.clip(b, 0, 63)))
+_REGISTRY["bitshiftright"] = _bitwise(
+    lambda a, b: np.right_shift(a, np.clip(b, 0, 63)))
+
+
+@register("bitnot")
+def _bitnot(ts):
+    if len(ts) != 1 or not (ts[0].is_integer or ts[0].id is dt.TypeId.NULL):
+        return None
+    t = ts[0] if ts[0].is_integer else dt.INT
+    def impl(cols, n):
+        return _result(t, np.bitwise_not(
+            cols[0].data.astype(np.int64)).astype(t.np_dtype), cols)
+    return FunctionResolution(t, impl)
+
+
+@register("gcd")
+def _gcd(ts):
+    if len(ts) != 2 or not _all_numeric(ts):
+        return None
+    def impl(cols, n):
+        a = cols[0].data.astype(np.int64)
+        b = cols[1].data.astype(np.int64)
+        return _result(dt.BIGINT, np.gcd(a, b), cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("lcm")
+def _lcm(ts):
+    if len(ts) != 2 or not _all_numeric(ts):
+        return None
+    def impl(cols, n):
+        a = cols[0].data.astype(np.int64)
+        b = cols[1].data.astype(np.int64)
+        with np.errstate(all="ignore"):
+            data = np.lcm(a, b)
+        return _result(dt.BIGINT, data, cols)
+    return FunctionResolution(dt.BIGINT, impl)
+
+
+@register("width_bucket")
+def _width_bucket(ts):
+    if len(ts) != 4 or not _all_numeric(ts):
+        return None
+    def impl(cols, n):
+        x = cols[0].data.astype(np.float64)
+        lo = cols[1].data.astype(np.float64)
+        hi = cols[2].data.astype(np.float64)
+        cnt = cols[3].data.astype(np.int64)
+        pn = propagate_nulls(cols)
+        live = np.ones(n, dtype=bool) if pn is None else pn
+        if ((cnt <= 0) & live).any():
+            raise errors.SqlError("2201G",
+                                  "count must be greater than zero")
+        if ((lo == hi) & live).any():
+            raise errors.SqlError("2201G",
+                                  "lower bound cannot equal upper bound")
+        with np.errstate(all="ignore"):
+            frac = (x - lo) / np.where(hi == lo, 1.0, hi - lo)
+            buck = np.floor(frac * cnt).astype(np.int64) + 1
+        buck = np.clip(buck, 0, cnt + 1)
+        # descending ranges mirror (PG: operand < bound counts from top)
+        desc = hi < lo
+        with np.errstate(all="ignore"):
+            fd = (lo - x) / np.where(lo == hi, 1.0, lo - hi)
+            bd = np.floor(fd * cnt).astype(np.int64) + 1
+        buck = np.where(desc, np.clip(bd, 0, cnt + 1), buck)
+        return _result(dt.INT, buck, cols)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("num_nulls")
+def _num_nulls(ts):
+    def impl(cols, n):
+        counts = np.zeros(n, dtype=np.int32)
+        for c in cols:
+            if c.type.id is dt.TypeId.NULL:
+                counts += 1
+            elif c.validity is not None:
+                counts += (~c.valid_mask()).astype(np.int32)
+        return Column(dt.INT, counts)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("num_nonnulls")
+def _num_nonnulls(ts):
+    def impl(cols, n):
+        counts = np.zeros(n, dtype=np.int32)
+        for c in cols:
+            if c.type.id is dt.TypeId.NULL:
+                continue
+            if c.validity is not None:
+                counts += c.valid_mask().astype(np.int32)
+            else:
+                counts += 1
+        return Column(dt.INT, counts)
+    return FunctionResolution(dt.INT, impl)
+
+
 @register("sign")
 def _sign(ts):
     def impl(cols, n):
@@ -624,6 +748,34 @@ def _length(ts):
 @register("substr")
 @register("substring")
 def _substr(ts):
+    if len(ts) == 2 and ts[1].is_string:
+        # substring(str FROM 'regex'): first regex match, NULL if none;
+        # with a capture group, the group (PG semantics)
+        def impl_rx(cols, n):
+            s = string_values(cols[0])
+            pats = string_values(cols[1])
+            out = np.empty(n, dtype=object)
+            miss = np.zeros(n, dtype=bool)
+            for i in range(n):
+                try:
+                    m = re.search(pats[i], s[i])
+                except re.error as e:
+                    raise errors.SqlError(
+                        "2201B", f"invalid regular expression: {e}")
+                if m is None:
+                    out[i] = ""
+                    miss[i] = True
+                else:
+                    out[i] = m.group(1) if m.groups() else m.group(0)
+                    if out[i] is None:
+                        out[i] = ""
+                        miss[i] = True
+            validity = propagate_nulls(cols)
+            if miss.any():
+                validity = (validity if validity is not None
+                            else np.ones(n, dtype=bool)) & ~miss
+            return make_string_column(out.astype(str), validity)
+        return FunctionResolution(dt.VARCHAR, impl_rx)
     def impl(cols, n):
         s = string_values(cols[0])
         start = cols[1].data.astype(np.int64)
@@ -784,6 +936,231 @@ def _md5(ts):
     return FunctionResolution(dt.VARCHAR, impl)
 
 
+@register("octet_length")
+def _octet_length(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        out = np.asarray([len(v.encode()) for v in s], dtype=np.int32)
+        return _result(dt.INT, out, cols)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("bit_length")
+def _bit_length(ts):
+    def impl(cols, n):
+        s = string_values(cols[0])
+        out = np.asarray([8 * len(v.encode()) for v in s], dtype=np.int32)
+        return _result(dt.INT, out, cols)
+    return FunctionResolution(dt.INT, impl)
+
+
+@register("to_hex")
+def _to_hex(ts):
+    if len(ts) != 1 or not (ts[0].is_integer or ts[0].id is dt.TypeId.NULL):
+        return None
+    def impl(cols, n):
+        k = cols[0].data.astype(np.int64)
+        # PG prints the two's-complement hex of the 32/64-bit value
+        width = 32 if ts[0].np_dtype.itemsize <= 4 else 64
+        out = [format(int(v) & ((1 << width) - 1), "x") for v in k]
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("format")
+def _format(ts):
+    if not ts:
+        return None
+    def impl(cols, n):
+        fmt = string_values(cols[0])
+        args = cols[1:]
+        arg_valid = [c.valid_mask() if c.validity is not None else None
+                     for c in args]
+        arg_text = [[_pg_text(v) for v in c.to_pylist()] for c in args]
+        out = []
+        for row in range(n):
+            s, pos, res = fmt[row], 0, []
+            k = 0
+            while k < len(s):
+                ch = s[k]
+                if ch != "%":
+                    res.append(ch)
+                    k += 1
+                    continue
+                if k + 1 >= len(s):
+                    raise errors.SqlError(
+                        "22023", "unterminated format() type specifier")
+                spec = s[k + 1]
+                k += 2
+                if spec == "%":
+                    res.append("%")
+                    continue
+                if spec not in ("s", "I", "L"):
+                    raise errors.SqlError(
+                        "22023",
+                        f'unrecognized format() type specifier "{spec}"')
+                if pos >= len(args):
+                    raise errors.SqlError(
+                        "22023", "too few arguments for format()")
+                is_null = (arg_valid[pos] is not None
+                           and not arg_valid[pos][row]) or \
+                    args[pos].type.id is dt.TypeId.NULL
+                v = None if is_null else arg_text[pos][row]
+                pos += 1
+                if spec == "s":
+                    res.append("" if v is None else v)
+                elif spec == "I":
+                    if v is None:
+                        raise errors.SqlError(
+                            "22004",
+                            "null values cannot be formatted as an "
+                            "SQL identifier")
+                    res.append(v if v.isidentifier() and v == v.lower()
+                               else '"' + v.replace('"', '""') + '"')
+                else:   # %L
+                    res.append("NULL" if v is None
+                               else "'" + v.replace("'", "''") + "'")
+            out.append("".join(res))
+        validity = (cols[0].valid_mask()
+                    if cols[0].validity is not None else None)
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  validity)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("__similar_to")
+def _similar_to(ts):
+    """SQL SIMILAR TO: SQL wildcards (% _) + regex branches, anchored
+    full-match (reference analog: similar_to_escape in PG's regexp.c)."""
+    def impl(cols, n):
+        pats = string_values(cols[1])
+        s = string_values(cols[0])
+        out = np.zeros(n, dtype=bool)
+        cache = {}
+        for i in range(n):
+            p = pats[i]
+            rx = cache.get(p)
+            if rx is None:
+                buf = []
+                k = 0
+                while k < len(p):
+                    c = p[k]
+                    if c == "%":
+                        buf.append(".*")
+                    elif c == "_":
+                        buf.append(".")
+                    elif c == "\\" and k + 1 < len(p):
+                        buf.append(re.escape(p[k + 1]))
+                        k += 1
+                    elif c in ".^$":
+                        buf.append(re.escape(c))
+                    else:
+                        buf.append(c)   # | * + ? { } ( ) [ ] stay regex
+                    k += 1
+                try:
+                    rx = cache[p] = re.compile("(?s)\\A(?:%s)\\Z"
+                                               % "".join(buf))
+                except re.error as e:
+                    raise errors.SqlError(
+                        "2201B", f"invalid SIMILAR TO pattern: {e}")
+            out[i] = rx.match(s[i]) is not None
+        return _result(dt.BOOL, out, cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+#: TypeId → pg_typeof() rendering (PG spellings)
+_PG_TYPE_NAMES = {
+    dt.TypeId.BOOL: "boolean", dt.TypeId.TINYINT: "smallint",
+    dt.TypeId.SMALLINT: "smallint", dt.TypeId.INT: "integer",
+    dt.TypeId.BIGINT: "bigint", dt.TypeId.FLOAT: "real",
+    dt.TypeId.DOUBLE: "double precision", dt.TypeId.VARCHAR: "text",
+    dt.TypeId.TIMESTAMP: "timestamp without time zone",
+    dt.TypeId.DATE: "date", dt.TypeId.INTERVAL: "interval",
+    dt.TypeId.NULL: "unknown", dt.TypeId.OID: "oid",
+    dt.TypeId.REGCLASS: "regclass", dt.TypeId.REGTYPE: "regtype",
+    dt.TypeId.REGPROC: "regproc", dt.TypeId.REGNAMESPACE: "regnamespace",
+}
+
+
+@register("to_date")
+def _to_date(ts):
+    if len(ts) != 2:
+        return None
+    def impl(cols, n):
+        from datetime import date as _date
+        s = string_values(cols[0])
+        fmts = string_values(cols[1])
+        epoch = _date(1970, 1, 1)
+        out = np.zeros(n, dtype=np.int32)
+        import datetime as _dt_mod
+        # longest patterns first: "Month" must map before "Mon", "YYYY"
+        # before "YY"
+        py_map = [("Month", "%B"), ("HH24", "%H"), ("YYYY", "%Y"),
+                  ("Mon", "%b"), ("MM", "%m"), ("DD", "%d"),
+                  ("MI", "%M"), ("SS", "%S"), ("YY", "%y")]
+        for i in range(n):
+            f = fmts[i]
+            for pat, py in py_map:
+                f = f.replace(pat, py)
+            try:
+                d = _dt_mod.datetime.strptime(s[i], f).date()
+            except ValueError as e:
+                raise errors.SqlError("22008",
+                                      f"invalid value for to_date: {e}")
+            out[i] = (d - epoch).days
+        return _result(dt.DATE, out, cols)
+    return FunctionResolution(dt.DATE, impl)
+
+
+@register("make_interval")
+def _make_interval(ts):
+    """make_interval(years, months, weeks, days, hours, mins, secs) —
+    positional prefix; calendar units must be zero (this engine's
+    intervals are fixed-duration micros, binder.parse_interval)."""
+    if len(ts) > 7 or not _all_numeric(ts):
+        return None
+    def impl(cols, n):
+        vals = [c.data.astype(np.float64) for c in cols]
+        while len(vals) < 7:
+            vals.append(np.zeros(n))
+        years, months, weeks, days, hours, mins, secs = vals
+        pn = propagate_nulls(cols)
+        live = np.ones(n, dtype=bool) if pn is None else pn
+        if (((years != 0) | (months != 0)) & live).any():
+            raise errors.unsupported(
+                "calendar interval units (month/year) — use fixed units "
+                "(days/hours/...)")
+        us = ((weeks * 7 + days) * 86_400_000_000 +
+              hours * 3_600_000_000 + mins * 60_000_000 +
+              secs * 1_000_000)
+        return _result(dt.INTERVAL, np.round(us).astype(np.int64), cols)
+    return FunctionResolution(dt.INTERVAL, impl)
+
+
+@register("isfinite")
+def _isfinite(ts):
+    if len(ts) != 1 or ts[0].id not in (dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                                        dt.TypeId.INTERVAL):
+        return None
+    def impl(cols, n):
+        # epoch-int storage has no infinity encoding: always finite
+        return _result(dt.BOOL, np.ones(n, dtype=bool), cols)
+    return FunctionResolution(dt.BOOL, impl)
+
+
+@register("pg_typeof")
+def _pg_typeof(ts):
+    if len(ts) != 1:
+        return None
+    name = _PG_TYPE_NAMES.get(ts[0].id, str(ts[0]).lower())
+    def impl(cols, n):
+        return make_string_column(
+            np.asarray([name] * n, dtype=object).astype(str), None)
+    # rendered as text (PG's regtype output is its textual type name)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
 @register("translate")
 def _translate(ts):
     def impl(cols, n):
@@ -899,16 +1276,99 @@ def like_impl(cols, n, negated=False, ci=False):
 # regex operators now route through the linear-time NFA above)
 
 
+def _pg_regex_replacement(r: str) -> str:
+    """PG replacement syntax → Python re: \\1..\\9 group refs, \\& whole
+    match, literal backslash pairs."""
+    out = []
+    k = 0
+    while k < len(r):
+        c = r[k]
+        if c == "\\" and k + 1 < len(r):
+            nxt = r[k + 1]
+            if nxt.isdigit():
+                out.append("\\" + nxt)
+            elif nxt == "&":
+                out.append("\\g<0>")
+            elif nxt == "\\":
+                out.append("\\\\")
+            else:
+                out.append(re.escape(nxt))
+            k += 2
+            continue
+        out.append(c.replace("\\", "\\\\"))
+        k += 1
+    return "".join(out)
+
+
 @register("regexp_replace")
 def _regexp_replace(ts):
+    if len(ts) not in (3, 4):
+        return None
     def impl(cols, n):
         s = string_values(cols[0])
         pat = string_values(cols[1])
         rep = string_values(cols[2])
-        out = [re.sub(p, r.replace("\\", "\\\\"), v, count=1)
-               for v, p, r in zip(s, pat, rep)]
+        flags = string_values(cols[3]) if len(cols) > 3 else None
+        out = []
+        for i in range(n):
+            fl = 0
+            count = 1
+            if flags is not None:
+                for f in flags[i]:
+                    if f == "g":
+                        count = 0
+                    elif f == "i":
+                        fl |= re.IGNORECASE
+                    elif f == "n" or f == "m":
+                        fl |= re.MULTILINE
+                    elif f == "s":
+                        fl |= re.DOTALL
+                    else:
+                        raise errors.SqlError(
+                            "22023",
+                            f'invalid regular expression option: "{f}"')
+            try:
+                out.append(re.sub(pat[i], _pg_regex_replacement(rep[i]),
+                                  s[i], count=count, flags=fl))
+            except re.error as e:
+                raise errors.SqlError("2201B",
+                                      f"invalid regular expression: {e}")
         return make_string_column(np.asarray(out, dtype=object).astype(str),
                                   propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("regexp_matches")
+@register("regexp_match")
+def _regexp_match(ts):
+    """First-match capture groups (regexp_match); without groups, the
+    whole match. Returns NULL on no match (array rendered PG-style)."""
+    if len(ts) not in (2, 3):
+        return None
+    def impl(cols, n):
+        s = string_values(cols[0])
+        pat = string_values(cols[1])
+        flags = string_values(cols[2]) if len(cols) > 2 else None
+        out = []
+        miss = np.zeros(n, dtype=bool)
+        for i in range(n):
+            fl = re.IGNORECASE if flags is not None and "i" in flags[i] \
+                else 0
+            m = re.search(pat[i], s[i], flags=fl)
+            if m is None:
+                out.append("")
+                miss[i] = True
+            elif m.groups():
+                out.append("{" + ",".join(
+                    "NULL" if g is None else g for g in m.groups()) + "}")
+            else:
+                out.append("{" + m.group(0) + "}")
+        validity = propagate_nulls(cols)
+        if miss.any():
+            validity = (validity if validity is not None
+                        else np.ones(n, dtype=bool)) & ~miss
+        return make_string_column(np.asarray(out, dtype=object).astype(str),
+                                  validity)
     return FunctionResolution(dt.VARCHAR, impl)
 
 

@@ -87,6 +87,7 @@ class FuncCall(Expr):
     args: list[Expr]
     distinct: bool = False
     star: bool = False   # count(*)
+    filter: Optional[Expr] = None   # aggregate FILTER (WHERE ...)
 
 
 @dataclass
@@ -185,6 +186,7 @@ class Select(Statement):
     limit: Optional[Expr] = None
     offset: Optional[Expr] = None
     distinct: bool = False
+    distinct_on: Optional[list[Expr]] = None      # DISTINCT ON (exprs)
     ctes: dict = field(default_factory=dict)      # name -> Select (WITH)
 
 
@@ -198,6 +200,15 @@ class SetOp(Statement):
     limit: Optional[Expr] = None
     offset: Optional[Expr] = None
     ctes: dict = field(default_factory=dict)
+
+
+@dataclass
+class CteDef:
+    """A WITH binding that needs more than plain inlining: an explicit
+    column list and/or RECURSIVE iteration (PG: base UNION [ALL] step)."""
+    query: "Select | SetOp"
+    cols: Optional[list[str]] = None
+    recursive: bool = False
 
 
 @dataclass

@@ -272,6 +272,25 @@ class ExprBinder:
                 raise errors.SqlError("42803",
                                       f"aggregate function {name} not allowed here")
             return self._bind_agg(e)
+        if getattr(e, "filter", None) is not None:
+            raise errors.SqlError(
+                "42809",
+                f"FILTER specified, but {name} is not an aggregate "
+                "function")
+        if name == "coalesce" and len(e.args) > 1:
+            # short-circuit form (PG): later arguments must not be
+            # evaluated on rows an earlier one already decided —
+            # coalesce(x, 1/0) succeeds when x is never NULL
+            bound = [self.bind(a) for a in e.args]
+            t = next((b.type for b in bound
+                      if b.type.id is not dt.TypeId.NULL), dt.NULLTYPE)
+
+            def notnull(b):
+                def impl(cols, batch):
+                    return Column(dt.BOOL, cols[0].valid_mask())
+                return BoundFunc("is_not_null", [b], dt.BOOL, impl)
+            return BoundCase([(notnull(b), b) for b in bound[:-1]],
+                             bound[-1], t)
         from ..search import sqlfuncs
         if sqlfuncs.is_search_function(name):
             return sqlfuncs.bind_function(self, e)
@@ -298,7 +317,10 @@ class ExprBinder:
             arg = self.bind(e.args[0])
             out_t = _agg_result_type(name, arg.type)
             spec = AggSpec(name, arg, e.distinct, out_t)
-        key = repr((spec.func, _expr_key(spec.arg), spec.distinct))
+        if getattr(e, "filter", None) is not None:
+            spec.filter = self.bind(e.filter)
+        key = repr((spec.func, _expr_key(spec.arg), spec.distinct,
+                    _expr_key(spec.filter)))
         if key in self._agg_keys:
             idx = self._agg_keys[key]
             return BoundAggRef(idx, self.aggs[idx].type)
@@ -613,9 +635,10 @@ def _fold_if_const(f: BoundFunc) -> BoundExpr:
         try:
             col = f.eval(Batch(["__one"], [Column.from_pylist([0])]))
             return BoundLiteral(col.decode(0), f.type)
-        except errors.SqlError:
-            raise
         except Exception:
+            # fold errors (1/0, sqrt(-1), ...) must NOT surface at bind
+            # time: PG only raises if the row is actually evaluated —
+            # CASE WHEN true THEN 1 ELSE 1/0 END returns 1
             return f
     return f
 

@@ -75,28 +75,38 @@ class BoundCase(BoundExpr):
     type: dt.SqlType
 
     def eval(self, batch: Batch) -> Column:
+        """Lazy, per-row-masked evaluation (PG semantics): a branch's
+        condition runs only over still-undecided rows and its value only
+        over the rows that branch selected, so errors in untaken branches
+        never fire (CASE WHEN x <> 0 THEN y/x ... must not divide by the
+        zeros)."""
         n = batch.num_rows
-        out: Optional[Column] = None
         decided = np.zeros(n, dtype=bool)
         result_vals: list = [None] * n
         for cond, val in self.branches:
-            c = cond.eval(batch)
-            hit = c.valid_mask() & (c.data.astype(bool)) & ~decided
-            if hit.any():
-                v = val.eval(batch)
-                vals = v.to_pylist()
-                for i in np.flatnonzero(hit):
-                    result_vals[i] = vals[i]
-            decided |= hit
+            undecided = ~decided
+            if not undecided.any():
+                break
+            all_rows = bool(undecided.all())
+            sub = batch if all_rows else batch.filter(undecided)
+            rows = np.flatnonzero(undecided)
+            c = cond.eval(sub)
+            hitl = c.valid_mask() & c.data.astype(bool)
+            if hitl.any():
+                hit_rows = rows[hitl]
+                subhit = sub if hitl.all() else sub.filter(hitl)
+                vals = val.eval(subhit).to_pylist()
+                for j, i in enumerate(hit_rows):
+                    result_vals[i] = vals[j]
+                decided[hit_rows] = True
         if self.else_ is not None:
             rest = ~decided
             if rest.any():
-                v = self.else_.eval(batch)
-                vals = v.to_pylist()
-                for i in np.flatnonzero(rest):
-                    result_vals[i] = vals[i]
-        out = Column.from_pylist(result_vals, self.type)
-        return out
+                sub = batch if rest.all() else batch.filter(rest)
+                vals = self.else_.eval(sub).to_pylist()
+                for j, i in enumerate(np.flatnonzero(rest)):
+                    result_vals[i] = vals[j]
+        return Column.from_pylist(result_vals, self.type)
 
     def children(self):
         out = [c for b in self.branches for c in b]
@@ -125,6 +135,7 @@ class AggSpec:
     distinct: bool
     type: dt.SqlType
     sep: Optional[str] = None      # string_agg separator
+    filter: Optional[BoundExpr] = None   # FILTER (WHERE ...) predicate
 
 
 # -- NULL-aware kernels used by the function library -----------------------

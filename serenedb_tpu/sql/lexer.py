@@ -41,6 +41,8 @@ _OPERATORS = sorted([
     "->>", "->", "#>>", "#>", "?|", "?&", "?", "@>", "<@", "^",
     "(", ")", ",", ";", "+", "-", "*", "/", "%", "<", ">", "=", ".", "~",
     "[", "]", ":",
+    # PG bitwise / math operators: & | # << >> (infix), |/ ||/ @ (prefix)
+    "&", "|", "#", "<<", ">>", "|/", "||/", "@",
 ], key=len, reverse=True)  # longest match first (<=> before <=)
 
 

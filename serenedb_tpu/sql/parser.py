@@ -211,35 +211,41 @@ class Parser:
         """SELECT / VALUES / set-operation chain / WITH prologue."""
         ctes: dict = {}
         if self.accept_kw("WITH"):
-            if self.accept_kw("RECURSIVE"):
-                raise errors.unsupported("WITH RECURSIVE")
+            recursive = bool(self.accept_kw("RECURSIVE"))
             while True:
                 name = self.ident()
+                cols = None
+                if self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
                 self.expect_kw("AS")
                 self.expect_op("(")
-                ctes[name.lower()] = self.parse_select()
+                body = self.parse_select()
                 self.expect_op(")")
+                if recursive or cols is not None:
+                    body = ast.CteDef(body, cols, recursive)
+                ctes[name.lower()] = body
                 if not self.accept_op(","):
                     break
-        node = self._parse_select_core()
-        while self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+        node = self._parse_intersect_chain()
+        while self.at_kw("UNION", "EXCEPT"):
             op = self.ident().lower()
             all_ = bool(self.accept_kw("ALL"))
             self.accept_kw("DISTINCT")
-            if isinstance(node, ast.Select) and \
-                    not getattr(node, "_parens", False) and (
-                    node.order_by or node.limit is not None or
-                    node.offset is not None):
-                raise errors.syntax(
-                    "ORDER BY/LIMIT/OFFSET in a set-operation arm needs "
-                    "parentheses")
-            right = self._parse_select_core()
+            self._reject_unparenthesized_tail(node)
+            # INTERSECT binds tighter than UNION/EXCEPT (PG gram.y)
+            right = self._parse_intersect_chain()
             node = ast.SetOp(op, all_, node, right)
         if isinstance(node, ast.SetOp):
             # PG grammar: a trailing ORDER BY/LIMIT binds to the whole set
             # operation, but the greedy core parse attaches it to the last
-            # arm — steal it back (unless that arm was parenthesized)
+            # arm — steal it back from the rightmost unparenthesized
+            # Select (unless that arm was parenthesized)
             last = node.right
+            while isinstance(last, ast.SetOp):
+                last = last.right
             if isinstance(last, ast.Select) and \
                     not getattr(last, "_parens", False):
                 node.order_by = last.order_by
@@ -264,6 +270,26 @@ class Parser:
             node.ctes = {**ctes, **getattr(node, "ctes", {})}
         return node
 
+    def _parse_intersect_chain(self):
+        node = self._parse_select_core()
+        while self.at_kw("INTERSECT"):
+            self.next()
+            all_ = bool(self.accept_kw("ALL"))
+            self.accept_kw("DISTINCT")
+            self._reject_unparenthesized_tail(node)
+            node = ast.SetOp("intersect", all_, node,
+                             self._parse_select_core())
+        return node
+
+    def _reject_unparenthesized_tail(self, node):
+        if isinstance(node, ast.Select) and \
+                not getattr(node, "_parens", False) and (
+                node.order_by or node.limit is not None or
+                node.offset is not None):
+            raise errors.syntax(
+                "ORDER BY/LIMIT/OFFSET in a set-operation arm needs "
+                "parentheses")
+
     def _parse_select_core(self) -> ast.Select:
         if self.accept_op("("):
             inner = self.parse_select()
@@ -274,8 +300,16 @@ class Parser:
             return self._parse_values_select()
         self.expect_kw("SELECT")
         distinct = False
+        distinct_on = None
         if self.accept_kw("DISTINCT"):
-            distinct = True
+            if self.accept_kw("ON"):
+                self.expect_op("(")
+                distinct_on = [self.parse_expr()]
+                while self.accept_op(","):
+                    distinct_on.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                distinct = True
         else:
             self.accept_kw("ALL")
         items = [self.parse_select_item()]
@@ -307,7 +341,7 @@ class Parser:
                 offset = self.parse_expr()
                 self.accept_kw("ROWS") or self.accept_kw("ROW")
         return ast.Select(items, from_, where, group_by, having, order_by,
-                          limit, offset, distinct)
+                          limit, offset, distinct, distinct_on)
 
     def _parse_values_select(self) -> ast.Select:
         self.expect_kw("VALUES")
@@ -386,7 +420,9 @@ class Parser:
                 self.accept_kw("OUTER")
                 self.expect_kw("JOIN")
             elif self.accept_kw("FULL"):
-                raise errors.unsupported("FULL JOIN not supported yet")
+                kind = "full"
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
             elif self.accept_kw("JOIN"):
                 kind = "inner"
             else:
@@ -472,8 +508,21 @@ class Parser:
             return ast.UnaryOp("NOT", self.parse_not())
         return self.parse_predicate()
 
-    def parse_predicate(self) -> ast.Expr:
+    #: PG "any other operator" precedence level: below + - , above the
+    #: comparisons (gram.y); desugared to functions at parse time
+    _OTHER_OPS = {"&": "bitand", "|": "bitor", "#": "bitxor",
+                  "<<": "bitshiftleft", ">>": "bitshiftright"}
+
+    def parse_other_ops(self) -> ast.Expr:
         left = self.parse_additive_chain()
+        while self.peek().kind is T.OP and \
+                self.peek().value in self._OTHER_OPS:
+            fn = self._OTHER_OPS[self.next().value]
+            left = ast.FuncCall(fn, [left, self.parse_additive_chain()])
+        return left
+
+    def parse_predicate(self) -> ast.Expr:
+        left = self.parse_other_ops()
         while True:
             if self.accept_kw("IS"):
                 negated = bool(self.accept_kw("NOT"))
@@ -529,6 +578,15 @@ class Parser:
             if self.accept_kw("ILIKE"):
                 left = ast.Like(left, self.parse_additive_chain(), negated, True)
                 continue
+            if self.at_kw("SIMILAR") and \
+                    self.peek(1).kind is T.IDENT and \
+                    self.peek(1).value.upper() == "TO":
+                self.next()
+                self.next()
+                e = ast.FuncCall("__similar_to",
+                                 [left, self.parse_additive_chain()])
+                left = ast.UnaryOp("NOT", e) if negated else e
+                continue
             if negated:
                 self.i = save
                 break
@@ -580,7 +638,7 @@ class Parser:
                                     [ast.Literal(op), ast.Literal(quant),
                                      left, arr])
                 continue
-            right = self.parse_additive_chain()
+            right = self.parse_other_ops()
             left = ast.BinaryOp(op, left, right)
             continue
         return left
@@ -631,6 +689,15 @@ class Parser:
             return ast.UnaryOp("-", self._parse_signed())
         if self.accept_op("+"):
             return self._parse_signed()
+        # PG prefix operators: ~ bitwise not, |/ sqrt, ||/ cbrt, @ abs
+        if self.accept_op("~"):
+            return ast.FuncCall("bitnot", [self._parse_signed()])
+        if self.accept_op("|/"):
+            return ast.FuncCall("sqrt", [self._parse_signed()])
+        if self.accept_op("||/"):
+            return ast.FuncCall("cbrt", [self._parse_signed()])
+        if self.accept_op("@"):
+            return ast.FuncCall("abs", [self._parse_signed()])
         return self.parse_postfix()
 
     def parse_postfix(self) -> ast.Expr:
@@ -795,6 +862,33 @@ class Parser:
                 args.append(self.parse_expr())
             self.expect_op(")")
             return ast.FuncCall("position", args)
+        if upper == "TRIM" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            # PG: trim([LEADING|TRAILING|BOTH] [chars] FROM str)
+            #     also trim(str) / trim(str, chars)
+            save = self.i
+            self.next()
+            self.expect_op("(")
+            side = "both"
+            if self.at_kw("LEADING", "TRAILING", "BOTH"):
+                side = self.next().value.lower()
+            if self.accept_kw("FROM"):      # trim(LEADING FROM s)
+                s = self.parse_expr()
+                self.expect_op(")")
+                fn = {"leading": "ltrim", "trailing": "rtrim",
+                      "both": "btrim"}[side]
+                return ast.FuncCall(fn, [s])
+            first = self.parse_expr()
+            if self.accept_kw("FROM"):
+                s = self.parse_expr()
+                self.expect_op(")")
+                fn = {"leading": "ltrim", "trailing": "rtrim",
+                      "both": "btrim"}[side]
+                return ast.FuncCall(fn, [s, first])
+            if side != "both":
+                raise errors.syntax("expected FROM in trim()")
+            # plain call form: rewind and let the generic path handle it
+            self.i = save
         if upper == "SUBSTRING" and self.peek(1).kind is T.OP and \
                 self.peek(1).value == "(":
             # PG: substring(str FROM n [FOR k]) — also plain (s, n[, k])
@@ -850,7 +944,15 @@ class Parser:
                     args.append(self.parse_expr())
             self.expect_op(")")
             call = ast.FuncCall(name, args, distinct, star)
+            if self.at_kw("FILTER"):
+                self.next()
+                self.expect_op("(")
+                self.expect_kw("WHERE")
+                call.filter = self.parse_expr()
+                self.expect_op(")")
             if self.at_kw("OVER"):
+                if call.filter is not None:
+                    raise errors.unsupported("FILTER with window functions")
                 self.next()
                 self.expect_op("(")
                 partition = []

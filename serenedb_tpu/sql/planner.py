@@ -101,6 +101,35 @@ def _resolve_post(e: BoundExpr, n_groups: int,
     return e
 
 
+def _references_cte(node, key: str, depth: int = 0) -> bool:
+    """Does the AST subtree reference table `key`? (Generic dataclass
+    walk — used to decide whether a WITH RECURSIVE member actually
+    iterates.) A nested WITH that rebinds the name shadows it."""
+    import dataclasses
+    if depth > 200 or node is None:
+        return False
+    if isinstance(node, ast.NamedTable):
+        return len(node.parts) == 1 and node.parts[0].lower() == key
+    if isinstance(node, (list, tuple)):
+        return any(_references_cte(v, key, depth + 1) for v in node)
+    if isinstance(node, dict):
+        return any(_references_cte(v, key, depth + 1)
+                   for v in node.values())
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        if key in {k.lower() for k in getattr(node, "ctes", {})}:
+            return False      # shadowed by an inner WITH
+        return any(_references_cte(getattr(node, f.name), key, depth + 1)
+                   for f in dataclasses.fields(node))
+    return False
+
+
+@dataclass
+class _RecursiveIterRef:
+    """CTE-map marker: a self-reference inside a recursive step scans
+    this iteration working table."""
+    provider: "TableProvider"
+
+
 class Planner:
     def __init__(self, resolver: TableResolver, params: Optional[list] = None):
         self.resolver = resolver
@@ -184,6 +213,51 @@ class Planner:
             cols.append(Column.from_pylist(vals, t))
         return ValuesNode(Batch([f"col{k}" for k in range(width)], cols))
 
+    def _plan_cte_def(self, key: str, cte: ast.CteDef) -> PlanNode:
+        """Plan a CTE with a column list and/or RECURSIVE semantics."""
+        from ..exec.plan import RecursiveCteNode, RenameNode
+        from ..exec.tables import MemTable
+        # WITH RECURSIVE marks the whole WITH list; a member is only
+        # iterated when it actually references itself
+        if not cte.recursive or not _references_cte(cte.query, key):
+            self.ctes.pop(key)
+            try:
+                inner = self.plan_select(cte.query)
+            finally:
+                self.ctes[key] = cte
+            return RenameNode(inner, cte.cols) if cte.cols else inner
+        body = cte.query
+        if not isinstance(body, ast.SetOp) or body.op != "union":
+            raise errors.SqlError(
+                "42P19", f'recursive query "{key}" does not have the form '
+                "non-recursive-term UNION [ALL] recursive-term")
+        # base term: the CTE name must not be visible (self-reference in
+        # the base term is 42P19 in PG; here it resolves to 42P01)
+        self.ctes.pop(key)
+        try:
+            base = self.plan_select(body.left)
+        finally:
+            self.ctes[key] = cte
+        names = cte.cols or list(base.names)
+        if cte.cols and len(cte.cols) != len(base.names):
+            raise errors.SqlError(
+                "42P10", f'recursive query "{key}" column list does not '
+                "match the number of output columns")
+        work = MemTable(key, Batch(list(names),
+                                   [Column.from_pylist([], t)
+                                    for t in base.types]))
+        saved = self.ctes[key]
+        self.ctes[key] = _RecursiveIterRef(work)
+        try:
+            step = self.plan_select(body.right)
+        finally:
+            self.ctes[key] = saved
+        if len(step.types) != len(base.types):
+            raise errors.SqlError(
+                "42601", "each UNION query must have the same number of "
+                "columns")
+        return RecursiveCteNode(names, base, step, work, body.all)
+
     def _scan_scope(self, provider: TableProvider, alias: str):
         scan = ScanNode(provider, list(provider.column_names), alias)
         scope = Scope([ScopeColumn(alias, n, t, i)
@@ -193,15 +267,24 @@ class Planner:
     def _plan_from(self, ref: ast.TableRef) -> tuple[PlanNode, Scope]:
         if isinstance(ref, ast.NamedTable):
             if len(ref.parts) == 1 and ref.parts[0].lower() in self.ctes:
-                # shadow the name while planning its body: non-recursive
-                # WITH must not see itself (PG resolves to 42P01 there)
                 key = ref.parts[0].lower()
-                body = self.ctes.pop(key)
-                try:
-                    inner = self.plan_select(body)
-                finally:
-                    self.ctes[key] = body
+                body = self.ctes[key]
                 alias = ref.alias or ref.parts[0]
+                if isinstance(body, _RecursiveIterRef):
+                    # a self-reference inside a recursive step: scan the
+                    # iteration's working table
+                    return self._scan_scope(body.provider, alias)
+                if isinstance(body, ast.CteDef):
+                    inner = self._plan_cte_def(key, body)
+                else:
+                    # shadow the name while planning its body:
+                    # non-recursive WITH must not see itself (PG resolves
+                    # to 42P01 there)
+                    self.ctes.pop(key)
+                    try:
+                        inner = self.plan_select(body)
+                    finally:
+                        self.ctes[key] = body
                 scope = Scope([ScopeColumn(alias, n, t, i)
                                for i, (n, t) in enumerate(
                                    zip(inner.names, inner.types))])
@@ -274,6 +357,7 @@ class Planner:
         left_keys: list[BoundExpr] = []
         right_keys: list[BoundExpr] = []
         residual: Optional[BoundExpr] = None
+        merge_pairs: list[tuple[int, int]] = []
         if ref.using:
             for col in ref.using:
                 lc = lscope.resolve([col])
@@ -282,8 +366,13 @@ class Planner:
                 right_keys.append(BoundColumn(rc.index, rc.type, rc.name))
                 # PG: USING merges the key column — hide the non-merged
                 # side's copy from bare-name resolution and SELECT *
-                # (right joins keep the right side, others the left)
+                # (right joins keep the right side, others the left). A
+                # FULL join's merged key is COALESCE(l, r): the executor
+                # overwrites the left copy with right values on
+                # right-only rows (merge_pairs).
                 hide_right = ref.kind != "right"
+                if ref.kind == "full":
+                    merge_pairs.append((lc.index, rc.index))
                 for c in combined.columns:
                     if c.name.lower() != col.lower():
                         continue
@@ -306,7 +395,7 @@ class Planner:
                 residual = bound[0] if len(bound) == 1 else BoundFunc(
                     "and", bound, dt.BOOL, lambda cols, b: kleene_and(cols))
         node = JoinNode(ref.kind, left, right, left_keys, right_keys,
-                        residual, names, types)
+                        residual, names, types, merge_pairs=merge_pairs)
         return node, combined
 
     def _try_equi_key(self, e: ast.Expr, lscope: Scope, rscope: Scope):
@@ -425,6 +514,32 @@ class Planner:
                 hidden += 1
             sort_indices.append(found)
 
+        on_indices: list[int] = []
+        if sel.distinct_on:
+            for e in sel.distinct_on:
+                found = None
+                for k, it in enumerate(items):
+                    if _ast_eq(e, it.expr):
+                        found = k
+                        break
+                if found is None and isinstance(e, ast.ColumnRef) and \
+                        len(e.parts) == 1:
+                    m = [k for k, it in enumerate(items)
+                         if it.alias and
+                         it.alias.lower() == e.parts[0].lower()]
+                    if m:
+                        found = m[0]
+                if found is None:
+                    proj_exprs.append(bind_order(e))
+                    proj_names.append(f"#on{len(on_indices)}")
+                    hidden += 1
+                    found = len(proj_exprs) - 1
+                on_indices.append(found)
+            if sort_indices and sort_indices[:len(on_indices)] != on_indices:
+                raise errors.SqlError(
+                    "42P10", "SELECT DISTINCT ON expressions must match "
+                    "initial ORDER BY expressions")
+
         plan = ProjectNode(plan, proj_exprs, _dedup_names(proj_names))
         if sel.distinct:
             if hidden:
@@ -433,6 +548,9 @@ class Planner:
             plan = _distinct_node(plan, keep=len(out_names))
         if sort_indices:
             plan = SortNode(plan, sort_indices, descs, nfs)
+        if on_indices:
+            from ..exec.plan import DistinctOnNode
+            plan = DistinctOnNode(plan, on_indices)
         if hidden:
             plan = DropColumnsNode(plan, len(out_names))
 
