@@ -72,8 +72,15 @@ class Column:
             col = Column(typ, data, validity)
         else:
             fill = 0
-            data = np.array([fill if v is None else v for v in values],
-                            dtype=typ.np_dtype)
+            try:
+                data = np.array([fill if v is None else v for v in values],
+                                dtype=typ.np_dtype)
+            except OverflowError:
+                from .. import errors
+                raise errors.SqlError(
+                    "22003",
+                    f"value out of range for type "
+                    f"{typ.id.name.lower()}")
             col = Column(typ, data, validity)
         if n == 0:
             col.validity = None

@@ -1557,10 +1557,14 @@ class Connection:
             _check_not_null(table, aligned)
             key_cols_new = [aligned.column(c).to_pylist() for c in pk]
             _check_pk_not_null(pk, key_cols_new, aligned.num_rows)
-            existing = _pk_map(table, pk)
+            from .columnar import keyenc
+            from .search.pkindex import pk_index
+            idx = pk_index(table)
+            enc_new = keyenc.encode_key_columns(
+                [aligned.column(c) for c in pk])
             fresh_rows, conflicts, seen = [], [], set()
             for i in range(aligned.num_rows):
-                key = tuple(kc[i] for kc in key_cols_new)
+                key = enc_new[i]
                 if key in seen:
                     # second hit on the same key within one statement
                     if action == "update":
@@ -1573,13 +1577,14 @@ class Connection:
                             "unique constraint "
                             f"(key columns: {', '.join(pk)})")
                     continue              # DO NOTHING drops the duplicate
-                if key in existing:
+                hit = idx.get(key)
+                if hit >= 0:
                     if action is None:
                         raise errors.SqlError(
                             "23505", "duplicate key value violates "
                             "unique constraint "
                             f"(key columns: {', '.join(pk)})")
-                    conflicts.append((i, existing[key]))
+                    conflicts.append((i, hit))
                     seen.add(key)
                     continue              # DO NOTHING also lands here: no-op
                 fresh_rows.append(i)
@@ -1597,7 +1602,12 @@ class Connection:
                     table, full.take(old_rows), aligned.take(
                         np.asarray(exc_rows, dtype=np.int64)),
                     assigns, params)
-                ops.append(("delete", None, old_rows))
+                # PK-based remove filter (not positional rows): replay
+                # after a crash resolves the same keys whatever the
+                # physical row order (reference: search_remove_filter)
+                ops.append(("delete_pk", None,
+                            {"cols": list(pk),
+                             "keys": [enc_new[i] for i, _ in conflicts]}))
                 ops.append(("insert", updated, None))
                 affected.append(updated)
             if fresh_rows:
@@ -1667,7 +1677,18 @@ class Connection:
                 c = pred.eval(full)
                 rows = np.flatnonzero(c.data.astype(bool) & c.valid_mask())
             n = len(rows)
-            self._wal_commit(table, [("delete", None, rows)])
+            pk = _pk_of(table)
+            if pk:
+                from .columnar import keyenc
+                # encode ONLY the deleted rows' keys (O(k), not O(N))
+                deleted_rows = full.take(rows)
+                enc_del = keyenc.encode_key_columns(
+                    [deleted_rows.column(c) for c in pk])
+                del_op = ("delete_pk", None,
+                          {"cols": list(pk), "keys": list(enc_del)})
+            else:
+                del_op = ("delete", None, rows)
+            self._wal_commit(table, [del_op])
             mask = np.ones(full.num_rows, dtype=bool)
             mask[rows] = False
             deleted = full.take(rows) if st.returning else None
@@ -1722,32 +1743,42 @@ class Connection:
             updated = Batch(list(updated.names), upd_cols)
             _check_not_null(table, updated)
             pk = _pk_of(table)
+            del_op = ("delete", None, rows)
             if pk:
-                # new keys must be unique among themselves AND against the
-                # untouched rows
+                from .columnar import keyenc
+                from .search.pkindex import pk_index
                 key_cols_u = [updated.column(c).to_pylist() for c in pk]
                 _check_pk_not_null(pk, key_cols_u, updated.num_rows)
-                untouched = set()
-                key_cols_all = [full.column(c).to_pylist() for c in pk]
-                touched = set(int(r) for r in rows)
-                for i in range(full.num_rows):
-                    if i not in touched:
-                        untouched.add(tuple(kc[i] for kc in key_cols_all))
-                seen = set()
-                for i in range(updated.num_rows):
-                    key = tuple(kc[i] for kc in key_cols_u)
-                    if key in untouched or key in seen:
-                        raise errors.SqlError(
-                            "23505", "duplicate key value violates "
-                            "unique constraint "
-                            f"(key columns: {', '.join(pk)})")
-                    seen.add(key)
-            self._wal_commit(table, [("delete", None, rows),
-                                     ("insert", updated, None)])
+                # encode only the touched rows' keys (O(k)); the cached
+                # sorted index answers the uniqueness probes in O(log N)
+                old_rows = full.take(rows)
+                enc_del = keyenc.encode_key_columns(
+                    [old_rows.column(c) for c in pk])
+                pk_lower = {c.lower() for c in pk}
+                if any(a.lower() in pk_lower for a, _ in st.assignments):
+                    # keys may change: new keys must be unique among
+                    # themselves AND against the untouched rows
+                    enc_upd = keyenc.encode_key_columns(
+                        [updated.column(c) for c in pk])
+                    idx = pk_index(table)
+                    touched = set(int(r) for r in rows)
+                    seen = set()
+                    for i in range(updated.num_rows):
+                        key = enc_upd[i]
+                        hit = idx.get(key)
+                        if (hit >= 0 and hit not in touched) or key in seen:
+                            raise errors.SqlError(
+                                "23505", "duplicate key value violates "
+                                "unique constraint "
+                                f"(key columns: {', '.join(pk)})")
+                        seen.add(key)
+                # PK remove filter: replay-robust against row order
+                del_op = ("delete_pk", None,
+                          {"cols": list(pk), "keys": list(enc_del)})
+            self._wal_commit(table, [del_op, ("insert", updated, None)])
             # single-publish delete+reinsert: lock-free readers never see
             # the intermediate rows-removed state
-            _apply_ops(table, [("delete", None, rows),
-                               ("insert", updated, None)])
+            _apply_ops(table, [del_op, ("insert", updated, None)])
         tag = f"UPDATE {n}"
         if st.returning:
             return QueryResult(self._returning_batch(
@@ -2161,21 +2192,24 @@ class Connection:
             _check_not_null(table, aligned)
             pk = _pk_of(table)
             if pk:
+                from .columnar import keyenc
+                from .search.pkindex import pk_extend, pk_index
                 key_cols = [aligned.column(c).to_pylist() for c in pk]
                 _check_pk_not_null(pk, key_cols, aligned.num_rows)
-                existing = _pk_map(table, pk)
-                seen = set()
-                for i in range(aligned.num_rows):
-                    key = tuple(kc[i] for kc in key_cols)
-                    if key in existing or key in seen:
-                        raise errors.SqlError(
-                            "23505", "duplicate key value violates "
-                            "unique constraint "
-                            f"(key columns: {', '.join(pk)})")
-                    seen.add(key)
+                idx = pk_index(table)
+                enc = keyenc.encode_key_columns(
+                    [aligned.column(c) for c in pk])
+                if len(enc) and (idx.contains_any(enc).any() or
+                                 len(set(enc)) != len(enc)):
+                    raise errors.SqlError(
+                        "23505", "duplicate key value violates "
+                        "unique constraint "
+                        f"(key columns: {', '.join(pk)})")
+                n_before = table.row_count()
+                base_ver = table.data_version
                 self._wal_commit(table, [("insert", aligned, None)])
                 _append_rows(table, aligned)
-                _pk_map_extend(table, key_cols, aligned.num_rows)
+                pk_extend(table, enc, n_before, base_ver)
                 return aligned
             # give way to any mutator waiting to quiesce this table —
             # without this gate a sustained insert stream starves it
@@ -2252,6 +2286,33 @@ def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
             rows = np.asarray(rows, dtype=np.int64)
             mask[rows[rows < full.num_rows]] = False
             scratch.replace(full.filter(mask))
+        elif kind == "delete_pk":
+            # PK-based remove filter: resolve key bytes against the
+            # CURRENT state — identical live and in replay, whatever the
+            # physical row order (reference: search_remove_filter.*)
+            full = scratch.full_batch()
+            mask = np.ones(full.num_rows, dtype=bool)
+            idx = None
+            if full is table.full_batch():
+                # first op of the statement: the provider's cached sorted
+                # index covers exactly this batch — O(k log N) resolution
+                from .search.pkindex import pk_index
+                try:
+                    idx = pk_index(table)
+                except Exception:
+                    idx = None
+                if idx is not None and idx.pk_cols != list(rows["cols"]):
+                    idx = None
+            if idx is not None:
+                mask[idx.lookup_rows(rows["keys"])] = False
+            else:
+                from .columnar import keyenc
+                cur = keyenc.encode_key_columns(
+                    [full.column(c) for c in rows["cols"]])
+                kset = set(rows["keys"])
+                mask = np.asarray([k not in kset for k in cur],
+                                  dtype=bool)
+            scratch.replace(full.filter(mask))
         elif kind == "truncate":
             scratch.replace(scratch.full_batch().slice(0, 0))
     rows_preserved = all(kind == "insert" for kind, _, _ in ops)
@@ -2270,35 +2331,6 @@ def _check_pk_not_null(pk: list, key_cols: list, n: int):
                 raise errors.SqlError(
                     "23502", f'null value in column "{c}" violates '
                     "not-null constraint")
-
-
-def _pk_map(table, pk: list) -> dict:
-    """key-tuple → row index for the CURRENT batch, cached on the
-    provider and invalidated by data_version (rebuilt O(N) only after
-    deletes/updates; appends extend it incrementally)."""
-    cache = getattr(table, "_pk_cache", None)
-    if cache is not None and cache[0] == table.data_version:
-        return cache[1]
-    full = table.full_batch()
-    key_cols = [full.column(c).to_pylist() for c in pk]
-    m = {}
-    for i in range(full.num_rows):
-        m[tuple(kc[i] for kc in key_cols)] = i
-    table._pk_cache = (table.data_version, m)
-    return m
-
-
-def _pk_map_extend(table, key_cols: list, n: int):
-    """After an append: extend the cached map in place instead of letting
-    the data_version bump force an O(N) rebuild."""
-    cache = getattr(table, "_pk_cache", None)
-    if cache is None:
-        return
-    m = cache[1]
-    base = table.row_count() - n
-    for i in range(n):
-        m[tuple(kc[i] for kc in key_cols)] = base + i
-    table._pk_cache = (table.data_version, m)
 
 
 def _default_returning_name(e: ast.Expr) -> str:

@@ -340,9 +340,9 @@ def _factorize_group_keys(node, scan, provider, pin_batch, dev_ver) -> dict:
         "g": g_count,
         "key_meta": [(c.type, c.dictionary) for c in key_cols],
     }
-    if len(cache) >= 16:  # bound HBM held by codes buffers
-        cache.pop(next(iter(cache)))
     with lock:
+        if len(cache) >= 16:  # bound HBM held by codes buffers
+            cache.pop(next(iter(cache)))
         cache[(ver, ekeys)] = value
     return value
 

@@ -206,3 +206,59 @@ class BtreeScanNode(PlanNode):
             c = self.residual.eval(out)
             out = out.filter(c.data.astype(bool) & c.valid_mask())
         yield out
+
+
+class PkScanNode(PlanNode):
+    """Primary-key scan through the sorted memcomparable key index
+    (reference: PK point lookups + PK RANGE scans enabled by
+    key_encoding.cpp order-preserving terms). Two modes:
+
+    - "point": equality on EVERY PK column → at most one row
+    - "range": bounds on the LEADING PK column → contiguous key slice
+    """
+
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, mode: str, lo, hi, residual):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.mode = mode
+        self.lo = lo            # encoded key bytes (point: exact key)
+        self.hi = hi            # range: exclusive upper bound or None
+        self.residual = residual
+        self.names = list(columns)
+        self.types = [provider.type_of(c) for c in columns]
+
+    def children(self):
+        return []
+
+    def label(self):
+        return f"PkScan {self.provider.name} {self.mode}"
+
+    def count_matching(self):
+        if self.residual is not None:
+            return None
+        rows = self._rows()
+        return None if rows is None else len(rows)
+
+    def _rows(self):
+        from ..search.pkindex import pk_index
+        idx = pk_index(self.provider)
+        if idx is None:
+            return None
+        if self.mode == "point":
+            r = idx.get(self.lo)
+            return np.asarray([r] if r >= 0 else [], dtype=np.int64)
+        return idx.range_rows(self.lo, self.hi)
+
+    def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
+        rows = self._rows()
+        if rows is None:
+            raise RuntimeError("PK index disappeared under the plan")
+        out = self.provider.full_batch(self.columns).take(rows)
+        if self.residual is not None:
+            c = self.residual.eval(out)
+            out = out.filter(c.data.astype(bool) & c.valid_mask())
+        yield out

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from ..columnar import dtypes as dt
 from ..exec.plan import (AggregateNode, DropColumnsNode, FilterNode, JoinNode,
                          LimitNode, PlanNode, ProjectNode, ScanNode, SortNode)
@@ -45,7 +47,7 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
             if new_child.with_score:
                 _rewire_scorers(plan.exprs, new_child)
             return plan
-        bt = _try_btree_scan(plan.child)
+        bt = _try_btree_scan(plan.child) or _try_pk_scan(plan.child)
         if bt is not None:
             plan.child = bt
             return plan
@@ -54,6 +56,8 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
         replaced = _try_search_scan(plan, want_score=False)
         if replaced is None:
             replaced = _try_btree_scan(plan)
+        if replaced is None:
+            replaced = _try_pk_scan(plan)
         if replaced is not None:
             return replaced
     return plan
@@ -238,6 +242,98 @@ def _try_btree_scan(scan: ScanNode):
             return BtreeScanNode(scan.provider, scan.columns, scan.alias,
                                  col_name, value, residual)
     return None
+
+
+_RANGE_OPS = {"op<": "lt", "op<=": "le", "op>": "gt", "op>=": "ge"}
+
+
+def _try_pk_scan(scan: ScanNode):
+    """PK-index claims (reference: key_encoding.cpp order-preserving PK
+    terms): equality on EVERY PK column → point lookup; equality/range
+    conjuncts on the LEADING PK column → key range scan."""
+    from ..columnar import keyenc
+    from ..exec.search_scan import PkScanNode
+    from .expr import BoundLiteral
+    if scan.filter is None:
+        return None
+    meta = getattr(scan.provider, "table_meta", None) or {}
+    pk = meta.get("primary_key") or []
+    if not pk:
+        return None
+    conjuncts = _conjuncts(scan.filter)
+    # collect (col_name, op, literal) claims
+    claims = []
+    for k, c in enumerate(conjuncts):
+        if not (isinstance(c, BoundFunc) and len(c.args) == 2 and
+                (c.name == "op=" or c.name in _RANGE_OPS)):
+            continue
+        for a, b, flip in ((c.args[0], c.args[1], False),
+                           (c.args[1], c.args[0], True)):
+            if isinstance(a, BoundColumn) and isinstance(b, BoundLiteral) \
+                    and b.value is not None:
+                op = c.name
+                if flip and op in _RANGE_OPS:
+                    op = {"op<": "op>", "op<=": "op>=", "op>": "op<",
+                          "op>=": "op<="}[op]
+                claims.append((k, scan.columns[a.index], op, b.value))
+                break
+
+    def enc(col, v):
+        t = scan.provider.type_of(col)
+        try:
+            if t.is_integer and not isinstance(v, (int, np.integer)):
+                return None
+            return keyenc.encode_value(v, t)
+        except Exception:
+            return None
+
+    # point: one equality per PK column
+    eqs = {col: (k, v) for k, col, op, v in claims if op == "op="}
+    if all(c in eqs for c in pk):
+        parts = []
+        used = []
+        for c in pk:
+            k, v = eqs[c]
+            e = enc(c, v)
+            if e is None:
+                break
+            parts.append(e)
+            used.append(k)
+        else:
+            residual = _and_conjuncts(
+                [c for k, c in enumerate(conjuncts) if k not in used])
+            return PkScanNode(scan.provider, scan.columns, scan.alias,
+                              "point", b"".join(parts), None, residual)
+    # range on the leading PK column
+    lead = pk[0]
+    lo = hi = None
+    used = []
+    for k, col, op, v in claims:
+        if col != lead:
+            continue
+        e = enc(col, v)
+        if e is None:
+            continue
+        if op == "op=":
+            lo, hi = e, keyenc.prefix_upper_bound(e)
+            used = [k]
+            break
+        if op in ("op>", "op>="):
+            b = e if op == "op>=" else keyenc.prefix_upper_bound(e)
+            if b is not None and (lo is None or b > lo):
+                lo = b
+                used.append(k)
+        elif op in ("op<", "op<="):
+            b = e if op == "op<" else keyenc.prefix_upper_bound(e)
+            if b is not None and (hi is None or b < hi):
+                hi = b
+                used.append(k)
+    if lo is None and hi is None:
+        return None
+    residual = _and_conjuncts(
+        [c for k, c in enumerate(conjuncts) if k not in used])
+    return PkScanNode(scan.provider, scan.columns, scan.alias, "range",
+                      lo, hi, residual)
 
 
 def _try_search_scan(scan: ScanNode, want_score: bool,

@@ -49,15 +49,22 @@ _HDR = struct.Struct("<II")
 # Segment header: magic + format version. Frames follow the 8-byte header.
 # A version bump makes old segments fail loudly ("incompatible WAL version")
 # instead of decoding as torn/corrupt frames.
-SEGMENT_MAGIC = b"SDBWAL\x00\x02"
+# v3: delete_pk op kind (PK-based remove filters) — a v2 reader would
+# silently skip the delete and resurrect rows, exactly what versioning
+# is for.
+SEGMENT_MAGIC = b"SDBWAL\x00\x03"
 
 
 @dataclass
 class WalOp:
     table: str
-    kind: str                       # insert | delete | truncate
+    kind: str                       # insert | delete | delete_pk | truncate
     batch: Optional[Batch] = None   # insert payload
-    rows: Optional[np.ndarray] = None  # delete: row keys (engine-defined)
+    #: delete: positional row indices (int64 array);
+    #: delete_pk: {"cols": [pk column names], "keys": [key bytes]} —
+    #: an order-preserving PK remove filter (reference:
+    #: server/connector/key_encoding.cpp + search_remove_filter.*)
+    rows: Optional[object] = None
 
 
 @dataclass
@@ -86,7 +93,12 @@ def _encode_ops(ops: list[WalOp]) -> bytes:
             blob = batch_to_bytes(op.batch)
             entry["blob"] = len(blobs)
             blobs.append(blob)
-        if op.rows is not None:
+        if op.kind == "delete_pk":
+            import base64
+            entry["pk_cols"] = list(op.rows["cols"])
+            entry["keys"] = [base64.b64encode(k).decode()
+                             for k in op.rows["keys"]]
+        elif op.rows is not None:
             entry["rows"] = np.asarray(op.rows, dtype=np.int64).tolist()
         header["ops"].append(entry)
     hj = json.dumps(header).encode()
@@ -118,8 +130,13 @@ def _decode_record(tick: int, payload: bytes) -> CommitRecord:
     for entry in header["ops"]:
         batch = bytes_to_batch(blobs[entry["blob"]]) \
             if "blob" in entry else None
-        rows = np.asarray(entry["rows"], dtype=np.int64) \
-            if "rows" in entry else None
+        if entry["kind"] == "delete_pk":
+            import base64
+            rows = {"cols": entry["pk_cols"],
+                    "keys": [base64.b64decode(k) for k in entry["keys"]]}
+        else:
+            rows = np.asarray(entry["rows"], dtype=np.int64) \
+                if "rows" in entry else None
         ops.append(WalOp(entry["table"], entry["kind"], batch, rows))
     return CommitRecord(tick, ops)
 
