@@ -262,3 +262,45 @@ class PkScanNode(PlanNode):
             c = self.residual.eval(out)
             out = out.filter(c.data.astype(bool) & c.valid_mask())
         yield out
+
+
+class GeoScanNode(PlanNode):
+    """Geo-predicate scan through the cell-term index: candidate rows
+    from the posting lists of the query's probe terms, exact-verified by
+    re-evaluating the ORIGINAL predicates over just the candidates
+    (reference: GeoFilter candidate iteration + exact S2 verification,
+    geo_filter_builder.cpp). Rows whose geometry text failed to parse at
+    index build are not candidates."""
+
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, index_column: str, probe_terms: list,
+                 residual):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.index_column = index_column
+        self.probe_terms = list(probe_terms)
+        self.residual = residual
+        self.names = list(columns)
+        self.types = [provider.type_of(c) for c in columns]
+
+    def children(self):
+        return []
+
+    def label(self):
+        return (f"GeoScan {self.provider.name}.{self.index_column} "
+                f"probes={len(self.probe_terms)}")
+
+    def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
+        from ..search.index import find_geo_index
+        idx = find_geo_index(self.provider, self.index_column)
+        if idx is None:
+            raise RuntimeError("geo index disappeared under the plan")
+        rows = idx.candidates(self.probe_terms)
+        out = self.provider.full_batch(self.columns).take(rows)
+        if self.residual is not None:
+            c = self.residual.eval(out)
+            out = out.filter(c.data.astype(bool) & c.valid_mask())
+        yield out

@@ -98,12 +98,16 @@ def find_btree_index(provider, column: str):
 
 
 def build_index_for_table(provider, columns, using, options) -> SearchIndex:
-    if using not in ("inverted", "btree", "secondary", "ivf"):
+    if using not in ("inverted", "btree", "secondary", "ivf", "geo"):
         raise errors.unsupported(f"index type {using}")
     if using in ("btree", "secondary"):
         if len(columns) != 1:
             raise errors.unsupported("multi-column btree index")
         return build_btree_index(provider, columns[0], using, options)
+    if using == "geo":
+        if len(columns) != 1:
+            raise errors.unsupported("geo index over multiple columns")
+        return build_geo_index(provider, columns[0], options)
     analyzer_name = str(options.get("tokenizer", options.get("analyzer",
                                                              "text")))
     if using == "ivf":
@@ -190,5 +194,79 @@ def find_index(provider, column: str):
             if idx.data_version != provider.data_version:
                 idx = _repair(provider, name, idx,
                               lambda cur: refresh_index(provider, cur))
+            return idx
+    return None
+
+
+class GeoIndex:
+    """Cell-term geo index over one geometry (text) column (reference:
+    geo_filter_builder.cpp + iresearch GeoFilter — S2 cell terms; here
+    the quadtree of geo/cells.py). Candidates come from posting lists
+    keyed by packed cell ids; exact predicates post-verify them."""
+
+    def __init__(self, column: str, options: dict, postings: dict,
+                 n_rows: int, data_version: int):
+        self.column = column
+        self.columns = (column,)
+        self.using = "geo"
+        self.options = dict(options)
+        self.postings = postings       # cell id -> np.int64 row ids
+        self.indexed_rows = n_rows
+        self.data_version = data_version
+        self.analyzer_name = ""
+
+    def candidates(self, probe_terms) -> np.ndarray:
+        hits = [self.postings[t] for t in probe_terms
+                if t in self.postings]
+        if not hits:
+            return np.empty(0, dtype=np.int64)
+        return np.unique(np.concatenate(hits))
+
+
+def build_geo_index(provider, column: str, options: dict) -> GeoIndex:
+    from ..geo import cells as geo_cells
+    from ..geo import shapes as geo_shapes
+    col = provider.full_batch([column]).column(column)
+    if not col.type.is_string:
+        raise errors.SqlError(
+            errors.DATATYPE_MISMATCH,
+            f'geo index requires a geometry text column, "{column}" is '
+            f"{col.type}")
+    texts = col.to_pylist()
+    valid = col.valid_mask()
+    lists: dict = {}
+    import re as _re
+    point_rx = _re.compile(
+        r"^\s*POINT\s*\(\s*(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)\s+"
+        r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)\s*\)\s*$", _re.IGNORECASE)
+    for i, t in enumerate(texts):
+        if t is None or (valid is not None and not valid[i]):
+            continue
+        try:
+            m = point_rx.match(t) if isinstance(t, str) else None
+            if m:
+                # fast path: POINT(x y) terms without a full WKT parse —
+                # same scheme function as every other geometry
+                terms = geo_cells.point_terms(float(m.group(1)),
+                                              float(m.group(2)))
+            else:
+                terms = geo_cells.geometry_terms(geo_shapes.parse_any(t))
+        except Exception:
+            continue            # unparseable cells are simply unindexed
+        for term in terms:
+            lists.setdefault(term, []).append(i)
+    postings = {t: np.asarray(rs, dtype=np.int64)
+                for t, rs in lists.items()}
+    return GeoIndex(column, options, postings, len(texts),
+                    provider.data_version)
+
+
+def find_geo_index(provider, column: str):
+    for name, idx in getattr(provider, "indexes", {}).items():
+        if isinstance(idx, GeoIndex) and idx.column == column:
+            if idx.data_version != provider.data_version:
+                idx = _repair(provider, name, idx,
+                              lambda cur: build_geo_index(
+                                  provider, cur.column, cur.options))
             return idx
     return None

@@ -47,7 +47,8 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
             if new_child.with_score:
                 _rewire_scorers(plan.exprs, new_child)
             return plan
-        bt = _try_btree_scan(plan.child) or _try_pk_scan(plan.child)
+        bt = _try_btree_scan(plan.child) or _try_pk_scan(plan.child) \
+            or _try_geo_scan(plan.child)
         if bt is not None:
             plan.child = bt
             return plan
@@ -58,6 +59,8 @@ def rewrite_search(plan: PlanNode) -> PlanNode:
             replaced = _try_btree_scan(plan)
         if replaced is None:
             replaced = _try_pk_scan(plan)
+        if replaced is None:
+            replaced = _try_geo_scan(plan)
         if replaced is not None:
             return replaced
     return plan
@@ -241,6 +244,56 @@ def _try_btree_scan(scan: ScanNode):
             residual = _and_conjuncts(conjuncts[:k] + conjuncts[k + 1:])
             return BtreeScanNode(scan.provider, scan.columns, scan.alias,
                                  col_name, value, residual)
+    return None
+
+
+_GEO_CLAIM_FNS = {"st_intersects", "st_contains", "st_within",
+                  "st_covers", "st_coveredby", "st_dwithin"}
+
+
+def _try_geo_scan(scan: ScanNode):
+    """Geo conjunct over a geo-indexed column + a constant geometry →
+    cell-term candidate scan with exact post-verification (reference:
+    geo_filter_builder.cpp pushing GeoFilter into the inverted index).
+    The claimed conjunct stays in the residual — the index only narrows
+    the rows it is evaluated over."""
+    from ..exec.search_scan import GeoScanNode
+    from ..geo import cells as geo_cells
+    from ..geo import shapes as geo_shapes
+    from ..search.index import find_geo_index
+    from .expr import BoundLiteral
+    if scan.filter is None:
+        return None
+    conjuncts = _conjuncts(scan.filter)
+    for c in conjuncts:
+        if not (isinstance(c, BoundFunc) and c.name in _GEO_CLAIM_FNS
+                and len(c.args) >= 2):
+            continue
+        radius = 0.0
+        if c.name == "st_dwithin":
+            if len(c.args) < 3 or not isinstance(c.args[2], BoundLiteral) \
+                    or c.args[2].value is None:
+                continue   # NULL/non-constant radius: unindexed path
+            try:
+                radius = float(c.args[2].value)
+            except (TypeError, ValueError):
+                continue
+        for col, lit in ((c.args[0], c.args[1]), (c.args[1], c.args[0])):
+            if not (isinstance(col, BoundColumn) and
+                    isinstance(lit, BoundLiteral) and
+                    isinstance(lit.value, str)):
+                continue
+            col_name = scan.columns[col.index]
+            if find_geo_index(scan.provider, col_name) is None:
+                continue
+            try:
+                probe = geo_cells.query_terms(
+                    geo_shapes.parse_any(lit.value), radius)
+            except Exception:
+                continue
+            # ALL conjuncts (incl. the claimed one) run over candidates
+            return GeoScanNode(scan.provider, scan.columns, scan.alias,
+                               col_name, probe, scan.filter)
     return None
 
 
