@@ -203,14 +203,24 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                     _scalar_agg_device(spec, ce, arrays, mask, env_for))
         return tuple(outputs)
 
+    mesh_n = int(ctx.settings.get("serene_mesh") or 0)
+    if mesh_n > 1 and len(jax.devices()) < mesh_n:
+        mesh_n = 0
     key = (id(provider), dev_ver,
            tuple(_expr_key(p) for p in preds),
            tuple(_expr_key(g) for g in node.group_exprs),
-           tuple((s.func, _expr_key(s.arg)) for s in node.aggs))
+           tuple((s.func, _expr_key(s.arg)) for s in node.aggs), mesh_n)
     from .device import _PROGRAM_CACHE
     jitted = _PROGRAM_CACHE.get(key)
     if jitted is None:
-        jitted = _PROGRAM_CACHE[key] = jax.jit(program)
+        if mesh_n > 1:
+            combines = _out_combines(node, agg_plans, group_mode)
+            jitted = _mesh_wrap(program, mesh_n, combines,
+                                n_inputs=2 * len(needed) +
+                                (1 if fact is not None else 0) + 1)
+        else:
+            jitted = jax.jit(program)
+        _PROGRAM_CACHE[key] = jitted
 
     flat_args = []
     for i in needed:
@@ -218,6 +228,8 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         flat_args.extend([dc.data, dc.mask])
     if fact is not None:
         flat_args.append(fact["codes2d"])
+    if mesh_n > 1:
+        flat_args = [_pad_shard_axis(a, mesh_n) for a in flat_args]
     # A column's device mask excludes padding but ALSO that column's NULLs —
     # wrong as a row mask for count(*). Use a pure row-validity mask built
     # from the logical length of the SAME publication as the columns
@@ -234,6 +246,8 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         provider._device_rowmask = (dev_ver, rowmask_arr)
     else:
         rowmask_arr = rm_entry[1]
+    if mesh_n > 1:
+        rowmask_arr = _pad_shard_axis(rowmask_arr, mesh_n)
     results = jitted(*flat_args, rowmask_arr)
 
     if group_mode:
@@ -241,6 +255,72 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                                   provider, col_names, dictionaries,
                                   group_space, fact)
     return _build_scalar_batch(node, agg_plans, results)
+
+
+def _out_combines(node, agg_plans, group_mode) -> list:
+    """Per-output cross-shard combine kinds for the mesh wrap, mirroring
+    the output order of `program`: 'sum' → psum (counts, float sums, the
+    additive int limb arrays), 'min'/'max' → pmin/pmax, 'rows' → per-row
+    partials that stay sharded (concatenated by the out_spec; the host
+    combiner sums over rows, and zero-padded rows contribute nothing)."""
+    out = ["sum"]        # group counts / scalar row count
+    for spec, ce in agg_plans:
+        if spec.func == "count_star":
+            continue
+        if spec.func == "count":
+            out.append("sum")
+            continue
+        is_float = spec.arg is not None and spec.arg.type.is_float
+        if spec.func in ("sum", "avg"):
+            if group_mode or is_float:
+                out.extend(["sum", "sum"])      # (limbs|float sum) + count
+            else:
+                out.extend(["rows", "sum"])     # per-row int partials
+        elif spec.func in ("min", "max"):
+            out.extend([spec.func, "sum"])
+        else:
+            raise NotCompilable(f"mesh combine for {spec.func}")
+    return out
+
+
+def _pad_shard_axis(arr, mesh_n: int):
+    from ..parallel.mesh import pad_to_multiple
+    return pad_to_multiple(arr, mesh_n)
+
+
+def _mesh_wrap(program, mesh_n: int, combines: list, n_inputs: int):
+    """shard_map the single-device aggregate program over an N-device
+    mesh: row-block inputs shard on the leading axis, reductions merge
+    with psum/pmin/pmax over ICI, per-row partial outputs stay sharded
+    (reference analog: morsel-parallel pipelines re-expressed as XLA
+    collectives — SURVEY.md §2.11/§5.7)."""
+    import functools as _ft
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS, make_mesh
+    mesh = make_mesh(mesh_n)
+
+    def core(*flat):
+        outs = program(*flat)
+        merged = []
+        for o, c in zip(outs, combines):
+            if c == "sum":
+                merged.append(jax.lax.psum(o, AXIS))
+            elif c == "min":
+                merged.append(jax.lax.pmin(o, AXIS))
+            elif c == "max":
+                merged.append(jax.lax.pmax(o, AXIS))
+            else:
+                merged.append(o)
+        return tuple(merged)
+
+    in_specs = tuple(P(AXIS, None) for _ in range(n_inputs))
+    out_specs = tuple(P() if c in ("sum", "min", "max")
+                      else P(AXIS, None) for c in combines)
+    return jax.jit(shard_map(core, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs))
 
 
 def _plan_direct_keys(node, scan, host_col, col_names, dictionaries):

@@ -90,8 +90,10 @@ class SearchScanNode(PlanNode):
             raise RuntimeError("search index disappeared under the plan "
                                "(stale rewrite)")
         full = self.provider.full_batch(self.columns)
+        mesh_n = int(ctx.settings.get("serene_mesh") or 0)
         if self.topk is not None:
-            scores, docs = searcher.topk(self.qnode, self.topk, self.scorer)
+            scores, docs = searcher.topk(self.qnode, self.topk, self.scorer,
+                                         mesh_n=mesh_n)
             out = full.take(docs.astype(np.int64))
             if self.with_score:
                 out = Batch(list(self.names),
@@ -106,7 +108,7 @@ class SearchScanNode(PlanNode):
         out = full.take(docs.astype(np.int64))
         if self.with_score:
             scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1),
-                                          self.scorer)
+                                          self.scorer, mesh_n=mesh_n)
             smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
             smap[sdocs] = scores
             out = Batch(list(self.names),

@@ -20,8 +20,6 @@ from __future__ import annotations
 
 import math
 
-from . import shapes as geo_shapes
-
 LEVELS = (4, 8, 12)
 COVER_CAP = 64          # max cells per covering at the chosen level
 
@@ -102,12 +100,18 @@ def expand_bbox_multi(bbox, radius_m: float) -> list:
     dlat = radius_m / 111_000.0
     lat_lo = max(-90.0, min_lat - dlat)
     lat_hi = min(90.0, max_lat + dlat)
-    max_abs_lat = min(89.9, max(abs(lat_lo), abs(lat_hi)))
-    dlon = radius_m / (111_000.0 * max(0.01,
-                                       math.cos(math.radians(max_abs_lat))))
+    # a circle that reaches a pole spans EVERY longitude (haversine is
+    # periodic over the pole); and near the poles cos() shrinks the
+    # metres-per-degree so fast that any clamped dlon understates the
+    # true extent — widen to the full circle in both cases
+    if lat_hi >= 90.0 - 1e-9 or lat_lo <= -90.0 + 1e-9:
+        return [(-180.0, lat_lo, 180.0, lat_hi)]
+    max_abs_lat = max(abs(lat_lo), abs(lat_hi))
+    cosv = math.cos(math.radians(max_abs_lat))
+    dlon = radius_m / (111_000.0 * cosv) if cosv > 1e-9 else 361.0
     lo = min_lon - dlon
     hi = max_lon + dlon
-    if hi - lo >= 360.0:
+    if hi - lo >= 360.0 or dlon >= 180.0:
         return [(-180.0, lat_lo, 180.0, lat_hi)]
     if lo < -180.0:
         return [(lo + 360.0, lat_lo, 180.0, lat_hi),
@@ -157,7 +161,3 @@ def query_terms(geom, radius_m: float = 0.0) -> list:
             for x, y in _covering(b, lv):
                 terms.add(_cell_id(lv, x, y))
     return sorted(terms)
-
-
-def parse_bbox_of(text: str):
-    return _bbox(geo_shapes.parse_any(text))

@@ -624,19 +624,16 @@ def _decode_rows(block_base, block_gaps, block_tfs8, row_idx):
     return jnp.where(valid, docs, -1), tfs
 
 
-def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
-                norms, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
-                tail_docs, tail_tfs, tail_w, tail_qid, require,
-                ndocs_pad: int, k: int, n_queries: int, any_require: bool,
-                k1: float, b: float, avgdl: float, scorer: str = "bm25"):
-    """One dispatch scoring B queries: fused gather+decode → score →
-    batched scatter-accumulate into (B, ndocs) → per-query top-k. Batching
-    amortizes host↔device dispatch latency — the QPS regime of the
-    benchmark game.
-
-    scorer: 'bm25' (k1/b saturation + length norm) or 'tfidf'
-    (sqrt(tf)·w — the IResearch TFIDF shape, tfidf.cpp; the per-term idf
-    part of w is supplied by the caller per scorer)."""
+def _accumulate_scores(block_base, block_gaps, block_tfs8, raw_docs,
+                       raw_tfs, norms, row_idx, row_w, row_qid, raw_idx,
+                       raw_w, raw_qid, tail_docs, tail_tfs, tail_w,
+                       tail_qid, ndocs_pad: int, n_queries: int,
+                       with_hits: bool, k1: float, b: float, avgdl,
+                       scorer: str = "bm25"):
+    """Fused gather+decode → score → batched scatter-accumulate into
+    (B, ndocs) score planes (+ hit counts when with_hits). Shared by the
+    single-device top-k and the mesh-sharded path, whose shards each
+    accumulate their posting-row slice before a psum merge."""
     avg = jnp.maximum(jnp.float32(avgdl), 1e-9)
 
     def contrib_of(docs, tfs, w):
@@ -678,7 +675,7 @@ def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
 
     scores = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.float32)
     hits = jnp.zeros((n_queries * ndocs_pad,), dtype=jnp.int32) \
-        if any_require else None
+        if with_hits else None
     # packed plane: gather + in-kernel delta decode
     pdocs, ptfs = _decode_rows(block_base, block_gaps, block_tfs8, row_idx)
     wc, valid_b, safe_b = contrib_of(pdocs, ptfs, row_w[:, None])
@@ -695,16 +692,99 @@ def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
     tidx = tail_qid * ndocs_pad + safe_t
     scores = scores.at[tidx].add(tc)
     scores = scores.reshape(n_queries, ndocs_pad)
-    if any_require:
+    if with_hits:
         hits = hits.at[bidx].add(valid_b.reshape(-1).astype(jnp.int32))
         hits = hits.at[ridx].add(valid_r.reshape(-1).astype(jnp.int32))
         hits = hits.at[tidx].add(valid_t.astype(jnp.int32))
         hits = hits.reshape(n_queries, ndocs_pad)
+    return scores, hits
+
+
+def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
+                norms, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
+                tail_docs, tail_tfs, tail_w, tail_qid, require,
+                ndocs_pad: int, k: int, n_queries: int, any_require: bool,
+                k1: float, b: float, avgdl: float, scorer: str = "bm25"):
+    """One dispatch scoring B queries: accumulate score planes →
+    require-mask → per-query top-k. Batching amortizes host↔device
+    dispatch latency — the QPS regime of the benchmark game.
+
+    scorer: 'bm25' (k1/b saturation + length norm) or 'tfidf'
+    (sqrt(tf)·w — the IResearch TFIDF shape, tfidf.cpp; the per-term idf
+    part of w is supplied by the caller per scorer)."""
+    scores, hits = _accumulate_scores(
+        block_base, block_gaps, block_tfs8, raw_docs, raw_tfs, norms,
+        row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
+        tail_docs, tail_tfs, tail_w, tail_qid, ndocs_pad, n_queries,
+        any_require, k1, b, avgdl, scorer)
+    if any_require:
         need = require[:, None]
         scores = jnp.where(jnp.logical_or(need <= 0, hits >= need),
                            scores, 0.0)
     vals, docs = jax.lax.top_k(scores, k)
     return vals, docs
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_score_fn(mesh_n: int, ndocs_pad: int, k: int, n_queries: int,
+                   scorer: str, k1: float, b: float):
+    """Mesh-sharded scoring program (cached per shape): posting-row
+    sections shard across devices, each shard accumulates its slice with
+    the SAME kernel as the single-device path, score planes psum over
+    ICI, one top-k on the merged plane (reference analog: parallel
+    per-segment top-k collectors, SURVEY.md §2.11 — re-expressed as XLA
+    collectives; see also parallel/mesh.py)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS, make_mesh
+    mesh = make_mesh(mesh_n)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=((P(),) * 6 + (P(), ) +            # store + avgdl
+                  (P(AXIS),) * 10),                 # posting-row sections
+        out_specs=(P(), P()))
+    def step(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs, norms,
+             avgdl, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
+             tail_docs, tail_tfs, tail_w, tail_qid):
+        scores, _ = _accumulate_scores(
+            block_base, block_gaps, block_tfs8, raw_docs, raw_tfs, norms,
+            row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
+            tail_docs, tail_tfs, tail_w, tail_qid, ndocs_pad, n_queries,
+            False, k1, b, avgdl, scorer)
+        scores = jax.lax.psum(scores, AXIS)
+        return jax.lax.top_k(scores, k)
+
+    return jax.jit(step)
+
+
+def score_topk_mesh(store, qb: "QueryBatch", ndocs_pad: int, k: int,
+                    mesh_n: int, k1: float, b: float, avgdl: float,
+                    scorer: str = "bm25"):
+    """Score a require-free query batch over an N-device mesh. Sections
+    pad to a mesh multiple with the no-op fills the packer already uses
+    (w=0 rows contribute nothing)."""
+    from ..parallel.mesh import pad_to_multiple
+
+    def pad_sec(a, fill):
+        return pad_to_multiple(np.asarray(a), mesh_n, fill)
+
+    fn = _mesh_score_fn(mesh_n, ndocs_pad, k, qb.n_queries, scorer,
+                        float(k1), float(b))
+    return fn(store.block_base, store.block_gaps, store.block_tfs8,
+              store.raw_docs, store.raw_tfs, store.norms,
+              jnp.float32(avgdl),
+              jnp.asarray(pad_sec(qb.row_idx, store.n_packed)),
+              jnp.asarray(pad_sec(qb.row_w, np.float32(0.0))),
+              jnp.asarray(pad_sec(qb.row_qid, 0)),
+              jnp.asarray(pad_sec(qb.raw_idx, store.n_raw)),
+              jnp.asarray(pad_sec(qb.raw_w, np.float32(0.0))),
+              jnp.asarray(pad_sec(qb.raw_qid, 0)),
+              jnp.asarray(pad_sec(qb.tail_docs, -1)),
+              jnp.asarray(pad_sec(qb.tail_tfs, 0)),
+              jnp.asarray(pad_sec(qb.tail_w, np.float32(0.0))),
+              jnp.asarray(pad_sec(qb.tail_qid, 0)))
 
 
 

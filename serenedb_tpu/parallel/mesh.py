@@ -33,15 +33,23 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devs[:n]), (AXIS,))
 
 
-def shard_rows(arr: np.ndarray, mesh: Mesh) -> np.ndarray:
-    """Pad the leading (row-block) axis to a multiple of the mesh size."""
-    n = mesh.shape[AXIS]
+def pad_to_multiple(arr, n: int, fill=0):
+    """Pad the leading axis to a multiple of n (THE shard-padding helper:
+    data pads with `fill`, masks with False — padded rows never count).
+    Works on numpy and jax arrays alike."""
     rows = arr.shape[0]
     pad = (-rows) % n
-    if pad:
-        padding = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
-        arr = np.pad(arr, padding)
-    return arr
+    if not pad:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, widths, constant_values=fill)
+    return jnp.pad(arr, widths, constant_values=fill)
+
+
+def shard_rows(arr: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Pad the leading (row-block) axis to a multiple of the mesh size."""
+    return pad_to_multiple(arr, mesh.shape[AXIS])
 
 
 def sharded_agg_step(mesh: Mesh):

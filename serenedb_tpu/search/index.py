@@ -242,17 +242,18 @@ def build_geo_index(provider, column: str, options: dict) -> GeoIndex:
     for i, t in enumerate(texts):
         if t is None or (valid is not None and not valid[i]):
             continue
-        try:
-            m = point_rx.match(t) if isinstance(t, str) else None
-            if m:
-                # fast path: POINT(x y) terms without a full WKT parse —
-                # same scheme function as every other geometry
-                terms = geo_cells.point_terms(float(m.group(1)),
-                                              float(m.group(2)))
-            else:
-                terms = geo_cells.geometry_terms(geo_shapes.parse_any(t))
-        except Exception:
-            continue            # unparseable cells are simply unindexed
+        m = point_rx.match(t) if isinstance(t, str) else None
+        if m:
+            # fast path: POINT(x y) terms without a full WKT parse —
+            # same scheme function as every other geometry
+            terms = geo_cells.point_terms(float(m.group(1)),
+                                          float(m.group(2)))
+        else:
+            # unparseable geometry FAILS the build (like a functional
+            # index in PG): silently skipping the row would make index
+            # presence flip the query outcome — the unindexed path
+            # raises on that row, the indexed one would exclude it
+            terms = geo_cells.geometry_terms(geo_shapes.parse_any(t))
         for term in terms:
             lists.setdefault(term, []).append(i)
     postings = {t: np.asarray(rs, dtype=np.int64)

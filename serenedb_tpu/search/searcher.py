@@ -341,9 +341,9 @@ class SegmentSearcher:
             return None  # too few candidates to fill k exact slots
         return cand.astype(np.int32)
 
-    def topk(self, node: QNode, k: int,
-             scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
-        return self.topk_batch([node], k, scorer)[0]
+    def topk(self, node: QNode, k: int, scorer: str = "bm25",
+             mesh_n: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        return self.topk_batch([node], k, scorer, mesh_n=mesh_n)[0]
 
     # cap on per-dispatch accumulator entries (B × ndocs_pad f32): bounds
     # HBM at large corpora — the batch splits into query chunks instead of
@@ -351,7 +351,7 @@ class SegmentSearcher:
     ACC_ENTRY_CAP = 128 * 1024 * 1024
 
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
-                   idf_of=None, avgdl_override=None,
+                   idf_of=None, avgdl_override=None, mesh_n: int = 0,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
         """Top-k (scores, doc ids) for a batch of queries in ONE device
         dispatch (amortizes dispatch latency — the QPS regime). Pure term
@@ -373,7 +373,7 @@ class SegmentSearcher:
             out = []
             for i in range(0, len(nodes), max_b):
                 out.extend(self.topk_batch(nodes[i:i + max_b], k, scorer,
-                                           idf_of, avgdl_override))
+                                           idf_of, avgdl_override, mesh_n))
             return out
         nd_pad = store.ndocs_pad
         shapes = [self._query_shape(n) for n in nodes]
@@ -389,6 +389,26 @@ class SegmentSearcher:
         avgdl = (avgdl_override if avgdl_override is not None
                  else self.index.avgdl)
         k_true = min(max(k, 1), max(self.num_docs, 1))
+        if mesh_n > 1 and len(jax.devices()) >= mesh_n and \
+                not any(req for _, req in queries):
+            # mesh-sharded scoring: posting-row sections shard across the
+            # devices, score planes psum over ICI (SURVEY §5.7 — "scale
+            # one query across all compute"). require-free shapes only;
+            # _finish_batch applies exact-match masks as usual.
+            qb = bm25_ops.assemble_query_batch(
+                store, self.num_docs, queries, self.index.doc_freq,
+                scorer, idf_of=idf_of)
+            kk = min(bm25_ops.pad_k(k_true), nd_pad)
+            if any(len(q[0]) > 0 for q in queries):
+                vals, docs = jax.device_get(bm25_ops.score_topk_mesh(
+                    store, qb, nd_pad, kk, mesh_n,
+                    bm25_ops.scorer_param(scorer, K1), B, avgdl, scorer))
+            else:
+                vals = np.zeros((qb.n_queries, kk), dtype=np.float32)
+                docs = np.zeros((qb.n_queries, kk), dtype=np.int32)
+            return self._finish_batch(nodes, shapes, vals, docs, {}, k,
+                                      scorer, idf_of, avgdl_override,
+                                      nd_pad)
         plans: list = [None] * len(nodes)
         host_results: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         use_dense = (scorer not in bm25_ops.LM_SCORERS and
@@ -666,15 +686,16 @@ class MultiSearcher:
         return np.concatenate(parts).astype(np.int64) if parts \
             else np.empty(0, dtype=np.int64)
 
-    def topk(self, node: QNode, k: int,
-             scorer: str = "bm25") -> tuple[np.ndarray, np.ndarray]:
-        return self.topk_batch([node], k, scorer)[0]
+    def topk(self, node: QNode, k: int, scorer: str = "bm25",
+             mesh_n: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        return self.topk_batch([node], k, scorer, mesh_n=mesh_n)[0]
 
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
+                   mesh_n: int = 0,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
         if len(self.segments) == 1:
             seg, base = self.segments[0]
-            out = seg.topk_batch(nodes, k, scorer)
+            out = seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n)
             return [(s, d.astype(np.int64) + base) for s, d in out]
         n_total = max(self.num_docs, 1)
         # one pass: global df per query term STRING (terms have different
@@ -707,7 +728,8 @@ class MultiSearcher:
                 return bm25_ops.idf_for(scorer, n_total, dfs)
 
             out = seg.topk_batch(nodes, k, scorer, idf_of=idf_of,
-                                 avgdl_override=self.global_avgdl)
+                                 avgdl_override=self.global_avgdl,
+                                 mesh_n=mesh_n)
             for qi, (sc, dd) in enumerate(out):
                 merged[qi].extend(zip(sc.tolist(),
                                       (dd.astype(np.int64) + base).tolist()))

@@ -131,3 +131,7 @@ declare("serene_device", "auto", str,
         "and batch is large enough)")
 declare("serene_device_min_rows", 16384, int,
         "below this row count the CPU path is used even when device=auto")
+declare("serene_mesh", 0, int,
+        "shard device programs across an N-device jax mesh (0 = single "
+        "device); grouped aggregates and BM25 top-k run as shard_map "
+        "programs with psum/pmin/pmax merges over ICI")

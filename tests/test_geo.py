@@ -438,3 +438,30 @@ class TestGeoIndexRegressions:
         c.execute("CREATE INDEX ON nr USING geo (loc)")
         assert c.execute("SELECT count(*) FROM nr WHERE "
                          "st_dwithin(loc, 'POINT(0 0)', NULL)").scalar() == 0
+
+
+class TestGeoPoleAndErrors:
+    def test_dwithin_over_the_pole(self):
+        from serenedb_tpu.engine import Database
+        db = Database(None)
+        c = db.connect()
+        c.execute("CREATE TABLE pp (loc TEXT)")
+        c.execute("INSERT INTO pp VALUES ('POINT(0 89.9)'), "
+                  "('POINT(180 89.9)')")
+        q = ("SELECT count(*) FROM pp WHERE "
+             "st_dwithin(loc, 'POINT(0 89.9)', 30000)")
+        full = c.execute(q).scalar()
+        c.execute("CREATE INDEX ON pp USING geo (loc)")
+        assert c.execute(q).scalar() == full == 2
+
+    def test_unparseable_geometry_fails_build(self):
+        import pytest
+
+        from serenedb_tpu.engine import Database
+        from serenedb_tpu.errors import SqlError
+        db = Database(None)
+        c = db.connect()
+        c.execute("CREATE TABLE bad (loc TEXT)")
+        c.execute("INSERT INTO bad VALUES ('POINT(1 1)'), ('not wkt')")
+        with pytest.raises(SqlError):
+            c.execute("CREATE INDEX ON bad USING geo (loc)")

@@ -86,3 +86,70 @@ def test_shard_rows_pads():
     a = np.ones((13, 4))
     out = shard_rows(a, m)
     assert out.shape[0] % 8 == 0
+
+
+class TestShardedSql:
+    """End-to-end SQL over the mesh (SURVEY §5.7): GROUP BY + BM25 top-k
+    through Connection.execute with SET serene_mesh, parity-checked
+    against the single-device path. conftest forces 8 virtual CPU
+    devices, matching the driver's dryrun."""
+
+    def _db(self):
+        from serenedb_tpu.engine import Database
+        import random
+        db = Database(None)
+        c = db.connect()
+        c.execute("CREATE TABLE st (k INT, v INT, f DOUBLE, body TEXT)")
+        rng = random.Random(5)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon", "common"]
+        c.execute("INSERT INTO st VALUES " + ", ".join(
+            f"({rng.randint(0, 9)}, {rng.randint(-500, 500)}, "
+            f"{rng.random() * 10:.4f}, "
+            f"'{' '.join(rng.choices(words, k=6))}')"
+            for _ in range(20000)))
+        c.execute("CREATE INDEX ON st USING inverted (body)")
+        c.execute("SET serene_device = 'device'")
+        return db, c
+
+    def test_group_by_parity(self):
+        db, c = self._db()
+        q = ("SELECT k, count(*), sum(v), min(v), max(v) FROM st "
+             "WHERE v > -300 GROUP BY k ORDER BY k")
+        single = c.execute(q).rows()
+        c.execute("SET serene_mesh = 8")
+        mesh = c.execute(q).rows()
+        assert mesh == single     # int aggregates are exact on both paths
+
+    def test_scalar_agg_parity(self):
+        db, c = self._db()
+        q = "SELECT count(*), sum(v), min(v), max(v) FROM st WHERE k < 7"
+        single = c.execute(q).rows()
+        c.execute("SET serene_mesh = 8")
+        assert c.execute(q).rows() == single
+
+    def test_float_agg_close(self):
+        db, c = self._db()
+        q = "SELECT k, avg(f) FROM st GROUP BY k ORDER BY k"
+        single = c.execute(q).rows()
+        c.execute("SET serene_mesh = 8")
+        mesh = c.execute(q).rows()
+        for s, m in zip(single, mesh):
+            assert s[0] == m[0]
+            assert abs(s[1] - m[1]) / max(abs(s[1]), 1e-9) < 1e-4
+
+    def test_bm25_topk_parity(self):
+        db, c = self._db()
+        q = ("SELECT k, bm25(body, 'common alpha') AS s FROM st "
+             "WHERE body @@ 'common alpha' ORDER BY s DESC, k LIMIT 10")
+        single = c.execute(q).rows()
+        c.execute("SET serene_mesh = 8")
+        mesh = c.execute(q).rows()
+        assert [r[0] for r in single] == [r[0] for r in mesh]
+        for s, m in zip(single, mesh):
+            assert abs(s[1] - m[1]) < 1e-3
+
+    def test_mesh_larger_than_devices_falls_back(self):
+        db, c = self._db()
+        c.execute("SET serene_mesh = 4096")   # > devices: single-device
+        q = "SELECT count(*) FROM st WHERE v > 0"
+        assert c.execute(q).scalar() > 0
