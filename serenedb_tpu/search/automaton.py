@@ -1,0 +1,194 @@
+"""Automaton ∩ sorted-term-dictionary intersection.
+
+Reference analog: the burst-trie term dictionary intersected with openfst
+automata (levenshtein/wildcard/regexp —
+libs/iresearch/include/iresearch/formats/index/burst_trie.cpp). The TPU
+build's term dictionary is a SORTED string array, so the equivalent is
+the classic sorted-seek walk (Lucene's TermsEnum.seekCeil pattern):
+
+    walk the current term through the automaton; if rejected, compute a
+    SEEK TARGET — the smallest string greater than the term that could
+    still be accepted given the shared prefix — and binary-search the
+    dictionary to it, skipping every term in between.
+
+Soundness of the skip: the target is t[:j] + c where j is the DEEPEST
+position with a transition on some c > t[j] (or an extension char when t
+walked fully). Any term u strictly between t and the target either dies
+at the same failed transition as t, or diverges at a depth where no
+transition above t's char exists — both rejected. No completion of the
+target is needed (and none is computed: lexicographically-minimal
+completions need not exist under cycles); the next loop iteration walks
+whatever real term the seek lands on.
+
+Works over the regexp module's NFA states (subset construction memoized
+per state-set), and over a Levenshtein NFA built here from the same
+_State/_Char/_Dot atoms — one intersection routine serves regex,
+prefix/wildcard and fuzzy expansion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .regexp import Regexp, _Char, _Class, _Dot, _State
+
+_MAXCHAR = 0x10FFFF
+
+
+class _Dfa:
+    """On-the-fly subset construction over an NFA with transition and
+    min-successor memoization."""
+
+    def __init__(self, start: _State, end: _State):
+        self.end = end
+        self._ids: dict[frozenset, int] = {}
+        self._sets: list[frozenset] = []
+        self._trans: dict[tuple[int, str], int] = {}
+        self._accept: dict[int, bool] = {}
+        s0 = frozenset(Regexp._closure({start}, True, False))
+        self.start_id = self._intern(s0)
+        # acceptance must also consider the empty string (at_start=True)
+        self._accept_start = end in Regexp._closure({start}, True, True)
+
+    def _intern(self, ss: frozenset) -> int:
+        sid = self._ids.get(ss)
+        if sid is None:
+            sid = len(self._sets)
+            self._ids[ss] = sid
+            self._sets.append(ss)
+        return sid
+
+    def step(self, sid: int, ch: str) -> int:
+        """Transition; -1 = dead."""
+        key = (sid, ch)
+        hit = self._trans.get(key)
+        if hit is not None:
+            return hit
+        nxt = {t for st in self._sets[sid] for atom, t in st.edges
+               if Regexp._atom_matches(atom, ch)}
+        out = -1 if not nxt \
+            else self._intern(frozenset(Regexp._closure(nxt, False, False)))
+        self._trans[key] = out
+        return out
+
+    def accepts(self, sid: int) -> bool:
+        hit = self._accept.get(sid)
+        if hit is None:
+            hit = self._accept[sid] = self.end in Regexp._closure(
+                set(self._sets[sid]), False, True)
+        return hit
+
+    def min_char_above(self, sid: int, bound: Optional[str]) -> Optional[str]:
+        """Smallest char strictly greater than `bound` (None = any) with
+        an outgoing transition from this state set."""
+        lo = -1 if bound is None else ord(bound)
+        best = None
+        for st in self._sets[sid]:
+            for atom, _t in st.edges:
+                c = _atom_min_above(atom, lo)
+                if c is not None and (best is None or c < best):
+                    best = c
+        return best
+
+
+def _atom_min_above(atom, lo: int) -> Optional[str]:
+    """Smallest char with code > lo that the atom matches."""
+    if isinstance(atom, _Char):
+        return atom.c if ord(atom.c) > lo else None
+    if isinstance(atom, _Dot):
+        return chr(lo + 1) if lo + 1 <= _MAXCHAR else None
+    # character class
+    if not atom.negated:
+        best = None
+        for a, b in atom.ranges:
+            if ord(b) <= lo:
+                continue
+            c = chr(max(ord(a), lo + 1))
+            if best is None or c < best:
+                best = c
+        return best
+    # negated class: first code > lo not inside any range
+    code = lo + 1
+    while code <= _MAXCHAR:
+        for a, b in atom.ranges:
+            if ord(a) <= code <= ord(b):
+                code = ord(b) + 1
+                break
+        else:
+            return chr(code)
+    return None
+
+
+def intersect_sorted(start: _State, end: _State,
+                     terms: np.ndarray) -> list[int]:
+    """Ids of sorted `terms` accepted by the NFA, via seek-skipping."""
+    dfa = _Dfa(start, end)
+    n = len(terms)
+    out: list[int] = []
+    i = 0
+    while i < n:
+        t = str(terms[i])
+        # walk as deep as transitions allow, keeping the state at each depth
+        states = [dfa.start_id]
+        sid = dfa.start_id
+        d = len(t)
+        for j, ch in enumerate(t):
+            nxt = dfa.step(sid, ch)
+            if nxt < 0:
+                d = j
+                break
+            sid = nxt
+            states.append(sid)
+        else:
+            accepted = dfa.accepts(sid) if t else dfa._accept_start
+            if accepted:
+                out.append(i)
+                i += 1
+                continue
+        # rejected: seek target = deepest divergence with a live transition
+        target = None
+        for j in range(d, -1, -1):
+            bound = t[j] if j < len(t) else None
+            c = dfa.min_char_above(states[j], bound)
+            if c is not None:
+                target = t[:j] + c
+                break
+        if target is None:
+            break
+        # max(..., i+1): numpy's fixed-width unicode comparison pads with
+        # NULs, so a target like "abc\x00" compares EQUAL to "abc" and
+        # the seek could stall; the current term is rejected, so
+        # advancing one slot is always sound
+        i = max(int(np.searchsorted(terms, target, side="left")), i + 1)
+    return out
+
+
+# -- Levenshtein NFA ---------------------------------------------------------
+
+def levenshtein_nfa(term: str, max_edits: int) -> tuple[_State, _State]:
+    """NFA accepting strings within `max_edits` edits of `term`
+    (insert/delete/substitute), built from the regexp module's state
+    atoms so intersect_sorted serves fuzzy expansion too (reference:
+    levenshtein parametric automata over the burst trie)."""
+    m = len(term)
+    grid = [[_State() for _ in range(max_edits + 1)] for _ in range(m + 1)]
+    end = _State()
+    for i in range(m + 1):
+        for e in range(max_edits + 1):
+            st = grid[i][e]
+            if i < m:
+                # match
+                st.edges.append((_Char(term[i]), grid[i + 1][e]))
+                if e < max_edits:
+                    # substitution
+                    st.edges.append((_Dot(), grid[i + 1][e + 1]))
+                    # deletion of term[i] (consume no input)
+                    st.eps.append(grid[i + 1][e + 1])
+            if e < max_edits:
+                # insertion (consume one input char, stay at i)
+                st.edges.append((_Dot(), grid[i][e + 1]))
+            if i == m:
+                st.eps.append(end)
+    return grid[0][0], end

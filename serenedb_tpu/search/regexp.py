@@ -245,7 +245,6 @@ class Regexp:
             _fold_ast(ast)
         self._n_states = 0
         self.start, self.end = self._build_alt(ast)
-        self.literal_prefix = _literal_prefix(ast)
 
     def _new_state(self) -> _State:
         self._n_states += 1
@@ -357,19 +356,6 @@ def _fold_ast(node):
                  and lo.lower() <= hi.lower()]
         node.ranges.extend(extra)
 
-
-def _literal_prefix(ast: _Alt) -> str:
-    """The mandatory literal prefix every match must start with — used to
-    narrow the sorted-term-dictionary scan. Empty when the pattern starts
-    with anything non-literal."""
-    if len(ast.branches) != 1:
-        return ""
-    out = []
-    for atom, lo, hi in ast.branches[0]:
-        if not isinstance(atom, _Char) or lo != 1 or hi != 1:
-            break
-        out.append(atom.c)
-    return "".join(out)
 
 
 def compile_regexp(pattern: str, case_fold: bool = False) -> Regexp:

@@ -26,9 +26,9 @@ import numpy as np
 from ..columnar.device import pad_len
 from ..ops import bm25 as bm25_ops
 from .analysis import Analyzer
+from .automaton import intersect_sorted, levenshtein_nfa
 from .query import (QAnd, QFuzzy, QNode, QNot, QNothing, QOr, QPhrase,
-                    QPrefix, QRegex, QTerm, edit_distance_at_most,
-                    parse_query)
+                    QPrefix, QRegex, QTerm, parse_query)
 from .segment import BLOCK, FieldIndex
 
 K1 = 1.2
@@ -195,13 +195,8 @@ class SegmentSearcher:
         hit = cache.get(key)
         if hit is not None:
             return hit
-        ts = self.index.terms_str
-        lens = np.char.str_len(ts)
-        band = np.flatnonzero(np.abs(lens - len(node.term))
-                              <= node.max_edits)
-        out = [int(tid) for tid in band
-               if edit_distance_at_most(str(ts[tid]), node.term,
-                                        node.max_edits)]
+        start, end = levenshtein_nfa(node.term, node.max_edits)
+        out = intersect_sorted(start, end, self.index.terms_str)
         cache[key] = out
         return out
 
@@ -215,17 +210,8 @@ class SegmentSearcher:
         hit = cache.get(node.pattern)
         if hit is not None:
             return hit
-        prefix = node.compiled.literal_prefix
-        if prefix:
-            # every match starts with the pattern's mandatory literal
-            # prefix, so only the contiguous sorted-dictionary band needs
-            # the NFA (mirrors _fuzzy_term_ids' length-band prefilter)
-            cand = self.index.prefix_term_ids(prefix)
-        else:
-            cand = range(len(self.index.terms_str))
-        ts = self.index.terms_str
-        out = [int(tid) for tid in cand
-               if node.compiled.fullmatch(str(ts[tid]))]
+        rx = node.compiled
+        out = intersect_sorted(rx.start, rx.end, self.index.terms_str)
         cache[node.pattern] = out
         return out
 

@@ -85,16 +85,6 @@ def test_case_fold_literals_and_ranges():
     assert r.fullmatch("abc")
 
 
-def test_literal_prefix_extraction():
-    assert compile_regexp("rest.*").literal_prefix == "rest"
-    assert compile_regexp("abc").literal_prefix == "abc"
-    assert compile_regexp(".*x").literal_prefix == ""
-    assert compile_regexp("a|b").literal_prefix == ""
-    assert compile_regexp("ab(c|d)").literal_prefix == "ab"
-    assert compile_regexp("ab+c").literal_prefix == "a"
-    assert compile_regexp(r"a\.b").literal_prefix == "a.b"
-
-
 def test_anchor_assertions():
     # ^/$ are zero-width assertions, composing with unanchored wrappers
     r = compile_regexp("(.|\n)*(^a|b)(.|\n)*")
