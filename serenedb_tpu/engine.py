@@ -13,6 +13,7 @@ import contextlib
 import contextvars
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
@@ -704,7 +705,6 @@ class Connection:
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
-        import time
         import weakref
         with db.lock:
             db._session_seq += 1
@@ -850,20 +850,34 @@ class Connection:
         """Cooperative cancellation point (reference: the session's
         interrupt check inside DuckDB execution tasks,
         pg_wire_session.h:205-220). Executors call this at batch
-        boundaries."""
+        boundaries AND between chunked device dispatches, so cancel and
+        statement_timeout fire mid-aggregate within one chunk's
+        latency."""
         if self._cancel_event.is_set():
             self._cancel_event.clear()
             raise errors.SqlError(
                 "57014", "canceling statement due to user request")
+        deadline = getattr(self, "_deadline", None)
+        if deadline is not None:
+            if time.monotonic() > deadline:
+                self._deadline = None
+                raise errors.SqlError(
+                    "57014", "canceling statement due to statement timeout")
 
     @contextlib.contextmanager
     def _session_scope(self, label: str):
         """pg_stat_activity bookkeeping + active-query metrics + txn-abort
         marking shared by the materializing and streaming paths."""
         self._cancel_event.clear()   # cancel targets the CURRENT statement
+        timeout_ms = int(self.settings.get("statement_timeout") or 0)
+        # save/restore: a statement interleaved with a SUSPENDED streaming
+        # portal (extended protocol) must not clobber the portal's
+        # deadline — scopes nest, each restores what it found
+        prev_deadline = getattr(self, "_deadline", None)
+        self._deadline = (time.monotonic() + timeout_ms / 1000.0
+                          if timeout_ms > 0 else None)
         sess = self.db.sessions.get(self._session_id)
         if sess is not None:
-            import time
             sess["state"] = "active"
             sess["query"] = label
             sess["query_start"] = time.time()
@@ -877,6 +891,7 @@ class Connection:
                 self.txn_failed = True
             raise
         finally:
+            self._deadline = prev_deadline
             if sess is not None:
                 sess["state"] = ("idle in transaction"
                                  if self.in_txn else "idle")

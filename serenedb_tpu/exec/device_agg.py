@@ -248,7 +248,23 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         rowmask_arr = rm_entry[1]
     if mesh_n > 1:
         rowmask_arr = _pad_shard_axis(rowmask_arr, mesh_n)
-    results = jitted(*flat_args, rowmask_arr)
+    chunk_rows = int(ctx.settings.get("serene_device_chunk_rows") or 0)
+    # clamp to one tile: tiny values must mean "maximum responsiveness",
+    # never silently disable chunking
+    chunk_tiles = max(1, chunk_rows // 128) if chunk_rows > 0 else 0
+    n_tiles = int(rowmask_arr.shape[0])
+    if chunk_tiles and n_tiles > chunk_tiles:
+        # chunked dispatch: cancel/statement_timeout can fire between
+        # chunks instead of waiting out one monolithic program
+        # (reference: the session interrupt check inside execution
+        # tasks, pg_wire_session.h:205-220)
+        if mesh_n > 1:
+            chunk_tiles += (-chunk_tiles) % mesh_n
+        combines = _out_combines(node, agg_plans, group_mode)
+        results = _chunked_dispatch(jitted, flat_args, rowmask_arr,
+                                    chunk_tiles, combines, mesh_n)
+    else:
+        results = jitted(*flat_args, rowmask_arr)
 
     if group_mode:
         return _build_group_batch(node, key_plans, agg_plans, results,
@@ -286,6 +302,50 @@ def _out_combines(node, agg_plans, group_mode) -> list:
 def _pad_shard_axis(arr, mesh_n: int):
     from ..parallel.mesh import pad_to_multiple
     return pad_to_multiple(arr, mesh_n)
+
+
+def _chunked_dispatch(jitted, flat_args, rowmask_arr, chunk_tiles: int,
+                      combines: list, mesh_n: int):
+    """Run the aggregate program chunk by chunk over the row-block axis,
+    combining per-output partials on host ('sum' adds exactly in
+    int64/float64, 'min'/'max' fold elementwise, 'rows' concatenates).
+    check_cancel() runs between dispatches, so a cancel or a statement
+    timeout interrupts a long aggregate within one chunk's latency. All
+    chunks share one compiled shape (the tail pads with empty rows)."""
+    from .plan import check_cancel
+    import jax.numpy as jnp
+    n_tiles = int(rowmask_arr.shape[0])
+    acc = None
+    for start in range(0, n_tiles, chunk_tiles):
+        check_cancel()
+        end = min(start + chunk_tiles, n_tiles)
+
+        def cut(a):
+            part = a[start:end]
+            if end - start < chunk_tiles:
+                pad = chunk_tiles - (end - start)
+                widths = [(0, pad)] + [(0, 0)] * (part.ndim - 1)
+                part = jnp.pad(part, widths)
+            return part
+
+        outs = jitted(*[cut(a) for a in flat_args], cut(rowmask_arr))
+        outs = [np.asarray(o) for o in outs]
+        if acc is None:
+            acc = [o.astype(np.int64) if c == "sum" and
+                   o.dtype.kind in "iu" else o
+                   for o, c in zip(outs, combines)]
+            continue
+        for k, (o, c) in enumerate(zip(outs, combines)):
+            if c == "sum":
+                acc[k] = acc[k] + (o.astype(np.int64)
+                                   if o.dtype.kind in "iu" else o)
+            elif c == "min":
+                acc[k] = np.minimum(acc[k], o)
+            elif c == "max":
+                acc[k] = np.maximum(acc[k], o)
+            else:   # per-row partials: stack chunks back together
+                acc[k] = np.concatenate([acc[k], o])
+    return tuple(acc)
 
 
 def _mesh_wrap(program, mesh_n: int, combines: list, n_inputs: int):

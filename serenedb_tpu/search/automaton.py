@@ -36,6 +36,16 @@ from .regexp import Regexp, _Char, _Class, _Dot, _State
 
 _MAXCHAR = 0x10FFFF
 
+#: cap on interned DFA state sets: adversarial patterns (counting
+#: constructs like `.*a.{20}`) blow up subset construction exponentially;
+#: past the cap the walk degrades to per-term NFA matching, which is
+#: O(pattern states) memory like the pre-automaton scan
+MAX_DFA_STATES = 10_000
+
+
+class _DfaBudget(Exception):
+    pass
+
 
 class _Dfa:
     """On-the-fly subset construction over an NFA with transition and
@@ -55,6 +65,8 @@ class _Dfa:
     def _intern(self, ss: frozenset) -> int:
         sid = self._ids.get(ss)
         if sid is None:
+            if len(self._sets) >= MAX_DFA_STATES:
+                raise _DfaBudget
             sid = len(self._sets)
             self._ids[ss] = sid
             self._sets.append(ss)
@@ -121,9 +133,33 @@ def _atom_min_above(atom, lo: int) -> Optional[str]:
     return None
 
 
+def _nfa_fullmatch(start: _State, end: _State, s: str) -> bool:
+    """Direct NFA matching (the budget fallback): O(states) memory."""
+    n = len(s)
+    cur = Regexp._closure({start}, True, n == 0)
+    for i, ch in enumerate(s):
+        nxt = {t for st in cur for atom, t in st.edges
+               if Regexp._atom_matches(atom, ch)}
+        if not nxt:
+            return False
+        cur = Regexp._closure(nxt, False, i + 1 == n)
+    return end in cur
+
+
 def intersect_sorted(start: _State, end: _State,
                      terms: np.ndarray) -> list[int]:
-    """Ids of sorted `terms` accepted by the NFA, via seek-skipping."""
+    """Ids of sorted `terms` accepted by the NFA, via seek-skipping.
+    Patterns whose subset construction exceeds MAX_DFA_STATES finish
+    with a plain per-term NFA scan of the remaining band."""
+    try:
+        return _intersect_dfa(start, end, terms)
+    except _DfaBudget:
+        return [i for i in range(len(terms))
+                if _nfa_fullmatch(start, end, str(terms[i]))]
+
+
+def _intersect_dfa(start: _State, end: _State,
+                   terms: np.ndarray) -> list[int]:
     dfa = _Dfa(start, end)
     n = len(terms)
     out: list[int] = []

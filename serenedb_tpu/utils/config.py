@@ -131,6 +131,10 @@ declare("serene_device", "auto", str,
         "and batch is large enough)")
 declare("serene_device_min_rows", 16384, int,
         "below this row count the CPU path is used even when device=auto")
+declare("serene_device_chunk_rows", 1 << 21, int,
+        "device aggregate dispatches split into row chunks of this size "
+        "so cancel/statement_timeout fire between chunks (~one chunk's "
+        "latency); 0 disables chunking")
 declare("serene_mesh", 0, int,
         "shard device programs across an N-device jax mesh (0 = single "
         "device); grouped aggregates and BM25 top-k run as shard_map "

@@ -94,3 +94,18 @@ class TestIntersection:
         got_set = set(got)
         missing = [i for i in want_band if i not in got_set]
         assert not missing, "false negatives (over-skipping)"
+
+
+class TestDfaBudget:
+    def test_blowup_pattern_falls_back(self):
+        """Counting patterns explode subset construction; the walk must
+        degrade to per-term NFA matching, not allocate without bound."""
+        from serenedb_tpu.search import automaton as am
+        terms = np.asarray(sorted(
+            {"".join(np.random.default_rng(i).choice(
+                list("ab"), 24)) for i in range(4000)}))
+        rx = compile_regexp(".*a.{18}")
+        got = am.intersect_sorted(rx.start, rx.end, terms)
+        want = [i for i, t in enumerate(terms) if rx.fullmatch(str(t))]
+        assert got == want
+        assert want, "test vector should have matches"
