@@ -48,17 +48,34 @@ _DEVICE_DTYPE = {
 
 @dataclass
 class DeviceColumn:
-    """A column resident on device as (n_pad/128, 128) tiles."""
+    """A column resident on device as (n_pad/128, 128) tiles.
+
+    Integer tiles whose value RANGE fits 8/16 bits ship compressed as
+    frame-of-reference deltas (scheme 'for8'/'for16': stored = value -
+    offset in uint8/uint16) and decode in-kernel with one add — a 2-4×
+    HBM footprint cut on the analytics working set (reference analog:
+    the adaptive-compressed column formats of
+    libs/iresearch/include/iresearch/formats/column/). Consumers that
+    need the logical values call decode(x) on the gathered tiles."""
 
     type: dt.SqlType
     data: jax.Array                 # 2-D (rows, LANES)
     mask: jax.Array                 # 2-D bool, same shape; False on padding
     length: int                     # logical row count
+    scheme: str = "raw"             # raw | for8 | for16
+    offset: int = 0                 # frame of reference (for8/for16)
     wide: Optional[jax.Array] = None  # optional i64-precision residual (unused yet)
 
     @property
     def padded_rows(self) -> int:
         return self.data.shape[0] * LANES
+
+    def decode(self, tiles: jax.Array) -> jax.Array:
+        """Decompress (a slice of) this column's tiles to logical values
+        — traced inside jitted programs; one widen + add."""
+        if self.scheme == "raw":
+            return tiles
+        return tiles.astype(jnp.int32) + jnp.int32(self.offset)
 
 
 class DeviceNarrowingError(ValueError):
@@ -82,13 +99,26 @@ def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColum
                 "int64 column with |values| >= 2^31: no exact device "
                 "representation")
     dev_dt = _DEVICE_DTYPE.get(arr.dtype, jnp.float32)
+    scheme, offset = "raw", 0
+    if arr.dtype.kind == "i" and arr.dtype.itemsize > 1 and n:
+        # frame-of-reference narrowing: range-fitting int tiles ship as
+        # uint8/uint16 deltas and decode in-kernel (+offset)
+        vmin = int(arr.min())
+        vmax = int(arr.max())
+        rng = vmax - vmin
+        if rng < (1 << 8):
+            scheme, offset, dev_dt = "for8", vmin, jnp.uint8
+            arr = (arr.astype(np.int64) - vmin).astype(np.uint8)
+        elif rng < (1 << 16):
+            scheme, offset, dev_dt = "for16", vmin, jnp.uint16
+            arr = (arr.astype(np.int64) - vmin).astype(np.uint16)
     padded = np.zeros(n_pad, dtype=arr.dtype)
     padded[:n] = arr
     mask = np.zeros(n_pad, dtype=bool)
     mask[:n] = col.valid_mask()
     data2d = jnp.asarray(padded.reshape(-1, LANES), dtype=dev_dt)
     mask2d = jnp.asarray(mask.reshape(-1, LANES))
-    return DeviceColumn(col.type, data2d, mask2d, n)
+    return DeviceColumn(col.type, data2d, mask2d, n, scheme, offset)
 
 
 def to_device_batch(batch: Batch, columns: Optional[list[str]] = None) -> dict:

@@ -166,10 +166,19 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     # program cache and must not pin the codes buffer in HBM
     has_fact = fact is not None
 
+    # frame-of-reference columns decode in-kernel right at program entry
+    # (one widen+add), so every downstream op sees logical int32 values
+    decode_specs = [(env_cols[i].scheme, env_cols[i].offset)
+                    for i in needed]
+
     def program(*flat):
         arrays = {}
         for k, i in enumerate(needed):
-            arrays[i] = (flat[2 * k], flat[2 * k + 1])
+            data = flat[2 * k]
+            scheme, off = decode_specs[k]
+            if scheme != "raw":
+                data = data.astype(jnp.int32) + jnp.int32(off)
+            arrays[i] = (data, flat[2 * k + 1])
         rowmask = flat[-1]
         mask = rowmask
         for ce in compiled_preds:
@@ -330,15 +339,20 @@ def _chunked_dispatch(jitted, flat_args, rowmask_arr, chunk_tiles: int,
 
         outs = jitted(*[cut(a) for a in flat_args], cut(rowmask_arr))
         outs = [np.asarray(o) for o in outs]
+        def widen(o, c):
+            if c != "sum":
+                return o
+            # chunk-size-stable host accumulation: ints widen to int64,
+            # floats to float64
+            return o.astype(np.int64 if o.dtype.kind in "iu"
+                            else np.float64)
+
         if acc is None:
-            acc = [o.astype(np.int64) if c == "sum" and
-                   o.dtype.kind in "iu" else o
-                   for o, c in zip(outs, combines)]
+            acc = [widen(o, c) for o, c in zip(outs, combines)]
             continue
         for k, (o, c) in enumerate(zip(outs, combines)):
             if c == "sum":
-                acc[k] = acc[k] + (o.astype(np.int64)
-                                   if o.dtype.kind in "iu" else o)
+                acc[k] = acc[k] + widen(o, c)
             elif c == "min":
                 acc[k] = np.minimum(acc[k], o)
             elif c == "max":

@@ -32,7 +32,7 @@ from typing import Optional
 
 import numpy as np
 
-from .regexp import Regexp, _Char, _Class, _Dot, _State
+from .regexp import Regexp, _Char, _Class, _Dot, _State, nfa_fullmatch
 
 _MAXCHAR = 0x10FFFF
 
@@ -133,38 +133,29 @@ def _atom_min_above(atom, lo: int) -> Optional[str]:
     return None
 
 
-def _nfa_fullmatch(start: _State, end: _State, s: str) -> bool:
-    """Direct NFA matching (the budget fallback): O(states) memory."""
-    n = len(s)
-    cur = Regexp._closure({start}, True, n == 0)
-    for i, ch in enumerate(s):
-        nxt = {t for st in cur for atom, t in st.edges
-               if Regexp._atom_matches(atom, ch)}
-        if not nxt:
-            return False
-        cur = Regexp._closure(nxt, False, i + 1 == n)
-    return end in cur
-
-
 def intersect_sorted(start: _State, end: _State,
                      terms: np.ndarray) -> list[int]:
     """Ids of sorted `terms` accepted by the NFA, via seek-skipping.
     Patterns whose subset construction exceeds MAX_DFA_STATES finish
-    with a plain per-term NFA scan of the remaining band."""
+    with a plain per-term NFA scan of the REMAINING band — matches the
+    DFA already confirmed are kept, not recomputed."""
+    out: list[int] = []
+    resume = [0]
     try:
-        return _intersect_dfa(start, end, terms)
+        _intersect_dfa(start, end, terms, out, resume)
     except _DfaBudget:
-        return [i for i in range(len(terms))
-                if _nfa_fullmatch(start, end, str(terms[i]))]
+        out.extend(i for i in range(resume[0], len(terms))
+                   if nfa_fullmatch(start, end, str(terms[i])))
+    return out
 
 
-def _intersect_dfa(start: _State, end: _State,
-                   terms: np.ndarray) -> list[int]:
+def _intersect_dfa(start: _State, end: _State, terms: np.ndarray,
+                   out: list, resume: list) -> list[int]:
     dfa = _Dfa(start, end)
     n = len(terms)
-    out: list[int] = []
     i = 0
     while i < n:
+        resume[0] = i
         t = str(terms[i])
         # walk as deep as transitions allow, keeping the state at each depth
         states = [dfa.start_id]
@@ -198,6 +189,7 @@ def _intersect_dfa(start: _State, end: _State,
         # the seek could stall; the current term is rejected, so
         # advancing one slot is always sound
         i = max(int(np.searchsorted(terms, target, side="left")), i + 1)
+    resume[0] = n
     return out
 
 

@@ -332,15 +332,7 @@ class Regexp:
         return out
 
     def fullmatch(self, s: str) -> bool:
-        n = len(s)
-        cur = self._closure({self.start}, True, n == 0)
-        for i, ch in enumerate(s):
-            nxt = {t for st in cur for atom, t in st.edges
-                   if self._atom_matches(atom, ch)}
-            if not nxt:
-                return False
-            cur = self._closure(nxt, False, i + 1 == n)
-        return self.end in cur
+        return nfa_fullmatch(self.start, self.end, s)
 
 
 def _fold_ast(node):
@@ -356,6 +348,21 @@ def _fold_ast(node):
                  and lo.lower() <= hi.lower()]
         node.ranges.extend(extra)
 
+
+
+def nfa_fullmatch(start: _State, end: _State, s: str) -> bool:
+    """Match a whole string against an NFA fragment — shared by
+    Regexp.fullmatch and the automaton module's budget fallback so the
+    two can never disagree."""
+    n = len(s)
+    cur = Regexp._closure({start}, True, n == 0)
+    for i, ch in enumerate(s):
+        nxt = {t for st in cur for atom, t in st.edges
+               if Regexp._atom_matches(atom, ch)}
+        if not nxt:
+            return False
+        cur = Regexp._closure(nxt, False, i + 1 == n)
+    return end in cur
 
 
 def compile_regexp(pattern: str, case_fold: bool = False) -> Regexp:

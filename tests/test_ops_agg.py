@@ -13,14 +13,14 @@ def dev(vals, validity=None):
 def test_masked_count_and_sum_int():
     dc = dev(np.arange(1000, dtype=np.int64))
     assert int(agg.masked_count(dc.mask)) == 1000
-    assert agg.masked_sum_int(dc.data, dc.mask) == 499500
+    assert agg.masked_sum_int(dc.decode(dc.data), dc.mask) == 499500
 
 
 def test_masked_sum_int_negative_and_large():
     rng = np.random.default_rng(0)
     vals = rng.integers(-2**30, 2**30, size=5000, dtype=np.int64)
     dc = dev(vals)
-    assert agg.masked_sum_int(dc.data, dc.mask) == int(vals.sum())
+    assert agg.masked_sum_int(dc.decode(dc.data), dc.mask) == int(vals.sum())
 
 
 def test_masked_sum_float_and_minmax():
@@ -35,7 +35,7 @@ def test_nulls_excluded():
     validity = np.array([True, False, True, True])
     dc = dev(np.array([10, 99, 20, 30], dtype=np.int64), validity)
     assert int(agg.masked_count(dc.mask)) == 3
-    assert agg.masked_sum_int(dc.data, dc.mask) == 60
+    assert agg.masked_sum_int(dc.decode(dc.data), dc.mask) == 60
 
 
 @pytest.mark.parametrize("num_groups", [3, 2000])  # onehot path and scatter path
@@ -43,7 +43,7 @@ def test_group_count_paths(num_groups):
     rng = np.random.default_rng(1)
     codes_np = rng.integers(0, num_groups, size=4000).astype(np.int64)
     dc = dev(codes_np)
-    counts = agg.group_count(dc.data, dc.mask, num_groups)
+    counts = agg.group_count(dc.decode(dc.data), dc.mask, num_groups)
     expected = np.bincount(codes_np, minlength=num_groups)
     np.testing.assert_array_equal(counts, expected)
 
