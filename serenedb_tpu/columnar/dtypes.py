@@ -41,6 +41,9 @@ class TypeId(enum.Enum):
     REGTYPE = "REGTYPE"
     REGPROC = "REGPROC"
     REGNAMESPACE = "REGNAMESPACE"
+    ARRAY = "ARRAY"          # element-typed; physically JSON text in a
+                             # dictionary column (wire layer renders/encodes
+                             # PG {…} text and the binary array format)
 
 
 _NUMPY_OF = {
@@ -56,6 +59,7 @@ _NUMPY_OF = {
     TypeId.DATE: np.dtype(np.int32),
     TypeId.INTERVAL: np.dtype(np.int64),
     TypeId.NULL: np.dtype(np.int32),
+    TypeId.ARRAY: np.dtype(np.int32),     # dictionary codes (JSON text)
     TypeId.OID: np.dtype(np.int64),
     TypeId.REGCLASS: np.dtype(np.int64),
     TypeId.REGTYPE: np.dtype(np.int64),
@@ -75,6 +79,9 @@ class SqlType:
     (DECIMAL(p,s), VARCHAR(n)) can be added without changing call sites."""
 
     id: TypeId
+    #: ARRAY element type (None elsewhere); frozen+defaulted so equality
+    #: and hashing of existing scalar types are unchanged
+    elem: "TypeId | None" = None
 
     @property
     def np_dtype(self) -> np.dtype:
@@ -94,9 +101,12 @@ class SqlType:
 
     @property
     def is_string(self) -> bool:
-        return self.id is TypeId.VARCHAR
+        # ARRAY shares the dictionary-string physical representation
+        return self.id in (TypeId.VARCHAR, TypeId.ARRAY)
 
     def __str__(self) -> str:  # PG-style rendering
+        if self.id is TypeId.ARRAY:
+            return f"{(self.elem or TypeId.VARCHAR).value}[]"
         return self.id.value
 
 
@@ -117,6 +127,15 @@ REGTYPE = SqlType(TypeId.REGTYPE)
 REGPROC = SqlType(TypeId.REGPROC)
 REGNAMESPACE = SqlType(TypeId.REGNAMESPACE)
 NULLTYPE = SqlType(TypeId.NULL)
+
+
+def array_of(elem: "SqlType | TypeId | None") -> SqlType:
+    """Element-typed array (TEXT elements when unknown)."""
+    if isinstance(elem, SqlType):
+        elem = elem.id
+    if elem in (None, TypeId.NULL, TypeId.ARRAY):
+        elem = TypeId.VARCHAR
+    return SqlType(TypeId.ARRAY, elem)
 
 _BY_NAME = {
     "BOOLEAN": BOOL, "BOOL": BOOL,

@@ -53,7 +53,47 @@ def encode_value(v, typ: dt.SqlType) -> Optional[bytes]:
         return struct.pack("!qii", int(v), 0, 0)
     if tid in _OID_IDS:
         return struct.pack("!I", int(v) & 0xFFFFFFFF)
+    if tid is dt.TypeId.ARRAY:
+        return _encode_array_binary(str(v), typ.elem or dt.TypeId.VARCHAR)
     return str(v).encode()
+
+
+#: element TypeId → (element OID, element SqlType) for array binary sends
+_ARRAY_ELEM = {
+    dt.TypeId.BOOL: 16, dt.TypeId.TINYINT: 21, dt.TypeId.SMALLINT: 21,
+    dt.TypeId.INT: 23, dt.TypeId.BIGINT: 20, dt.TypeId.FLOAT: 700,
+    dt.TypeId.DOUBLE: 701, dt.TypeId.VARCHAR: 25,
+    dt.TypeId.DATE: 1082, dt.TypeId.TIMESTAMP: 1114,
+}
+
+
+def _encode_array_binary(json_text: str, elem: dt.TypeId) -> bytes:
+    """PG binary array format: ndim, hasnull, elem oid, (dim, lbound),
+    then length-prefixed elements (reference: server/pg/serialize.cpp
+    array_send). One-dimensional; the physical JSON representation."""
+    import json as _json
+    try:
+        vals = _json.loads(json_text)
+    except Exception:
+        vals = None
+    if not isinstance(vals, list):
+        # not an array payload after all — send as text elements
+        vals = [json_text]
+    hasnull = any(v is None for v in vals)
+    et = dt.SqlType(elem)
+    out = [struct.pack("!iiI", 1, 1 if hasnull else 0,
+                       _ARRAY_ELEM.get(elem, 25)),
+           struct.pack("!ii", len(vals), 1)]
+    for v in vals:
+        if v is None:
+            out.append(struct.pack("!i", -1))
+            continue
+        if isinstance(v, list):
+            payload = _json.dumps(v).encode()   # nested: text fallback
+        else:
+            payload = encode_value(v, et)
+        out.append(struct.pack("!i", len(payload)) + payload)
+    return b"".join(out)
 
 
 def decode_value(raw: bytes, typ: dt.SqlType):

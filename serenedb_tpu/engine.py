@@ -103,6 +103,10 @@ class Database(TableResolver):
         self.lock = threading.RLock()
         self.schemas: dict[str, SchemaObj] = {"main": SchemaObj("main")}
         self.sequences: dict[str, dict] = {}
+        #: user-defined types: name -> {"kind": "enum"|"domain",
+        #: "labels": [...], "base": str} (reference: catalog UserType,
+        #: server/catalog/object.h:82-94)
+        self.types: dict[str, dict] = {}
         # parquet providers are cached by path so repeated queries reuse the
         # provider's HBM column cache and compiled XLA programs
         self._parquet_cache: dict[str, ParquetTable] = {}
@@ -234,6 +238,7 @@ class Database(TableResolver):
                              for n, b in
                              (tdef.get("defaults") or {}).items()},
                 "tokenizers": tdef.get("tokenizers", {}),
+                "enums": tdef.get("enums", {}),
                 "options": tdef.get("options", {}),
             }
             self.schemas[schema].tables[name.lower()] = t
@@ -244,6 +249,7 @@ class Database(TableResolver):
             q = pickle.loads(base64.b64decode(vdef["ast_b64"]))
             self.schemas[schema].views[name.lower()] = ViewDef(name, q, "")
 
+        self.types = dict(meta.get("types", {}))
         self.roles.load_meta(meta.get("auth", {}))
         from .search.analysis import register_dictionary
         for dname, dopts in meta.get("tsdicts", {}).items():
@@ -419,7 +425,9 @@ class Database(TableResolver):
     def resolve_table_function(self, name: str, args: list) -> TableProvider:
         if name in ("read_parquet", "parquet_scan"):
             from .exec.filesource import parquet_source
-            return parquet_source(self, str(args[0]))
+            pinned = len(args) > 1 and \
+                str(args[1]).lower() in ("pinned", "snapshot")
+            return parquet_source(self, str(args[0]), pinned=pinned)
         if name in ("read_csv", "read_csv_auto", "csv_scan"):
             from .exec.filesource import csv_source
             header = None
@@ -633,6 +641,19 @@ class Database(TableResolver):
                         return self.oid_of("index", sn, tl)
         raise errors.SqlError(errors.UNDEFINED_TABLE,
                               f'relation "{text}" does not exist')
+
+    def resolve_type_name(self, name: str):
+        """(SqlType, enum_labels|None) for a declared column/cast type,
+        consulting user-defined types (enums store as validated text,
+        domains alias their base)."""
+        tdef = self.types.get(name.lower())
+        if tdef is None:
+            return dt.type_from_name(name), None
+        if tdef["kind"] == "enum":
+            return dt.VARCHAR, list(tdef["labels"])
+        # domains may stack over other user types (incl. enums): recurse
+        # so the base's physical type AND its labels carry through
+        return self.resolve_type_name(tdef["base"])
 
     def connect(self) -> "Connection":
         return Connection(self)
@@ -1017,6 +1038,30 @@ class Connection:
                         lambda m: m.setdefault("tsdicts", {}).__setitem__(
                             st.name.lower(), opts))
             return QueryResult(Batch([], []), "CREATE TEXT SEARCH DICTIONARY")
+        if isinstance(st, ast.CreateType):
+            key = st.name.lower()
+            builtin = True
+            try:
+                dt.type_from_name(st.name)
+            except Exception:
+                builtin = False
+            if key in self.db.types or builtin:
+                if st.if_not_exists:
+                    return QueryResult(Batch([], []), "CREATE TYPE")
+                raise errors.SqlError(errors.DUPLICATE_OBJECT,
+                                      f'type "{st.name}" already exists')
+            if st.kind == "domain":
+                self.db.resolve_type_name(st.base)   # base must exist
+            tdef = {"kind": st.kind, "labels": list(st.labels),
+                    "base": st.base}
+            self.db.types[key] = tdef
+            if self.db.store is not None:
+                self.db.store.update_meta(
+                    lambda m: m.setdefault("types", {}).__setitem__(
+                        key, tdef))
+            return QueryResult(Batch([], []),
+                               "CREATE TYPE" if st.kind == "enum"
+                               else "CREATE DOMAIN")
         if isinstance(st, ast.CreateSequence):
             self.db.create_sequence(".".join(st.name), st.start,
                                     st.increment, st.if_not_exists)
@@ -1056,6 +1101,45 @@ class Connection:
             if st.kind == "sequence":
                 self.db.drop_sequence(".".join(st.name), st.if_exists)
                 return QueryResult(Batch([], []), "DROP SEQUENCE")
+            if st.kind == "type":
+                key = st.name[-1].lower()
+                if key not in self.db.types:
+                    if st.if_exists:
+                        return QueryResult(Batch([], []), "DROP TYPE")
+                    raise errors.SqlError(
+                        errors.UNDEFINED_OBJECT,
+                        f'type "{st.name[-1]}" does not exist')
+                def _chain(name):
+                    # the type name plus every domain base it resolves
+                    # through — dropping ANY link breaks the column
+                    out = []
+                    seen = set()
+                    cur = name
+                    while cur and cur not in seen:
+                        seen.add(cur)
+                        out.append(cur)
+                        td = self.db.types.get(cur)
+                        cur = (td.get("base") or "").lower() \
+                            if td and td["kind"] == "domain" else None
+                    return out
+                with self.db.lock:
+                    for s_ in self.db.schemas.values():
+                        for t in s_.tables.values():
+                            used = (getattr(t, "table_meta", None)
+                                    or {}).get("enums", {})
+                            for uname in used.values():
+                                if key in _chain(uname):
+                                    raise errors.SqlError(
+                                        "2BP01",
+                                        f'cannot drop type '
+                                        f'"{st.name[-1]}" because column '
+                                        f'of table "{t.name}" depends '
+                                        "on it")
+                del self.db.types[key]
+                if self.db.store is not None:
+                    self.db.store.update_meta(
+                        lambda m: m.setdefault("types", {}).pop(key, None))
+                return QueryResult(Batch([], []), "DROP TYPE")
             self.db.drop(st.kind, st.name, st.if_exists, st.cascade)
             if self.db.store is not None:
                 schema, name = self.db._split(st.name)
@@ -1157,18 +1241,21 @@ class Connection:
 
     def _create_table(self, st: ast.CreateTable, params: list) -> QueryResult:
         schema, name = self.db._split(st.name)
-        if st.as_query is not None:
-            batch = self._run_select(st.as_query, params)
-        else:
+        enums: dict = {}
+        if st.as_query is None:
             cols = []
             names = []
             for cd in st.columns:
-                t = dt.type_from_name(cd.type_name)
+                t, labels = self.db.resolve_type_name(cd.type_name)
+                if labels is not None:
+                    enums[cd.name] = cd.type_name.lower()
                 names.append(cd.name)
                 cols.append(Column(t, np.empty(0, dtype=t.np_dtype), None,
                                    np.empty(0, dtype=object)
                                    if t.is_string else None))
             batch = Batch(names, cols)
+        else:
+            batch = self._run_select(st.as_query, params)
         key = f"{schema}.{name.lower()}"
         if self.db.store is not None:
             table_id = self.db.store.new_table_id()
@@ -1183,6 +1270,7 @@ class Connection:
             "tokenizers": {c.name: c.tokenizer for c in st.columns
                            if c.tokenizer},
             "options": st.options,
+            "enums": enums,
         }
         created = self.db.create_table(schema, name, provider,
                                        st.if_not_exists)
@@ -1570,6 +1658,7 @@ class Connection:
         with table.write_lock:
             aligned = _align_to_schema(table, incoming)
             _check_not_null(table, aligned)
+            _check_enums(self.db, table, aligned)
             key_cols_new = [aligned.column(c).to_pylist() for c in pk]
             _check_pk_not_null(pk, key_cols_new, aligned.num_rows)
             from .columnar import keyenc
@@ -1757,6 +1846,7 @@ class Connection:
                         for nm, c in zip(updated.names, updated.columns)]
             updated = Batch(list(updated.names), upd_cols)
             _check_not_null(table, updated)
+            _check_enums(self.db, table, updated)
             pk = _pk_of(table)
             del_op = ("delete", None, rows)
             if pk:
@@ -2205,6 +2295,7 @@ class Connection:
         with table.write_lock:
             aligned = _align_to_schema(table, incoming)
             _check_not_null(table, aligned)
+            _check_enums(self.db, table, aligned)
             pk = _pk_of(table)
             if pk:
                 from .columnar import keyenc
@@ -2369,6 +2460,40 @@ def _default_typed(table: MemTable, name: str):
     one = Batch(["__d"], [Column.from_pylist([0])])
     bound = b.bind(e)
     return bound.eval(one).decode(0), bound.type
+
+
+def _check_enums(db: "Database", table: MemTable, aligned: Batch):
+    """Enum-typed columns accept only their declared labels (22P02).
+    Dictionary-encoded columns validate O(unique labels): only the
+    dictionary entries actually referenced by valid rows are checked."""
+    enums = (getattr(table, "table_meta", None) or {}).get("enums") or {}
+    for cname, tname in enums.items():
+        if cname not in aligned:
+            continue
+        try:
+            _, labels_list = db.resolve_type_name(tname)
+        except Exception:
+            continue
+        if labels_list is None:
+            continue        # domain over a plain base: nothing to check
+        labels = set(labels_list)
+        col = aligned.column(cname)
+        if col.dictionary is not None:
+            codes = col.data
+            if col.validity is not None:
+                codes = codes[col.valid_mask()]
+            for code in np.unique(codes):
+                v = col.dictionary[int(code)]
+                if v not in labels:
+                    raise errors.SqlError(
+                        "22P02",
+                        f'invalid input value for enum {tname}: "{v}"')
+            continue
+        for v in col.to_pylist():
+            if v is not None and v not in labels:
+                raise errors.SqlError(
+                    "22P02",
+                    f'invalid input value for enum {tname}: "{v}"')
 
 
 def _check_not_null(table: MemTable, aligned: Batch):

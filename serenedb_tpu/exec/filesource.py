@@ -73,10 +73,29 @@ def expand_glob(path: str) -> list[str]:
     return [path]
 
 
-def parquet_source(db, path: str) -> TableProvider:
+def parquet_source(db, path: str, pinned: bool = False) -> TableProvider:
     """read_parquet over a path, glob, or URL. Single local files reuse
     the provider cache (HBM column cache + compiled programs); multi-file
-    globs materialize a unioned table cached by (paths, mtimes)."""
+    globs materialize a unioned table cached by (paths, mtimes).
+
+    pinned=True: iceberg-style snapshot pinning (reference:
+    index_source_view_file.cpp pinned snapshots) — the FIRST resolution
+    freezes the file list and contents; files added, changed or removed
+    later never alter results. Pins live for the Database's lifetime
+    (a fresh Database re-resolves)."""
+    if pinned:
+        pins = getattr(db, "_pinned_snapshots", None)
+        if pins is None:
+            pins = db._pinned_snapshots = {}
+        hit = pins.get(("parquet", path))
+        if hit is not None:
+            return hit
+        provider = parquet_source(db, path, pinned=False)
+        # materialize NOW: later mtime/file changes must not show through
+        frozen = MemTable(os.path.basename(path),
+                          provider.full_batch())
+        pins[("parquet", path)] = frozen
+        return frozen
     paths = [resolve_path(p) for p in expand_glob(path)]
     if len(paths) == 1:
         with db.lock:

@@ -2434,9 +2434,15 @@ def _make_array_spliced(ts):
                             f"invalid array element: {v[:40]!r}")
                 row.append(v)
             out.append(json.dumps(row))
-        return make_string_column(
+        col = make_string_column(
             np.asarray(out, dtype=object).astype(str), None)
-    return FunctionResolution(dt.VARCHAR, impl)
+        col.type = t
+        return col
+    # element type: first non-NULL argument after the splice map
+    elem = next((x for x in ts[1:]
+                 if x.id is not dt.TypeId.NULL), dt.VARCHAR)
+    t = dt.array_of(elem)
+    return FunctionResolution(t, impl)
 
 
 @register("__quant_cmp")
@@ -2585,9 +2591,12 @@ def _array_append(ts):
             a = list(arrs[i]) if arrs[i] is not None else []
             a.append(_json_scalar(vals, i))
             out.append(json.dumps(a))
-        return make_string_column(
+        col = make_string_column(
             np.asarray(out, dtype=object).astype(str), None)
-    return FunctionResolution(dt.VARCHAR, impl)
+        col.type = t
+        return col
+    t = ts[0] if ts[0].id is dt.TypeId.ARRAY else dt.array_of(ts[1])
+    return FunctionResolution(t, impl)
 
 
 @register("array_cat")
@@ -2602,10 +2611,14 @@ def _array_cat(ts):
         out = [json.dumps((x or []) + (y or [])) for x, y in zip(a1, a2)]
         both_null = np.asarray([x is None and y is None
                                 for x, y in zip(a1, a2)])
-        return make_string_column(
+        col = make_string_column(
             np.asarray(out, dtype=object).astype(str),
             None if not both_null.any() else ~both_null)
-    return FunctionResolution(dt.VARCHAR, impl)
+        col.type = t
+        return col
+    t = next((x for x in ts if x.id is dt.TypeId.ARRAY),
+             dt.array_of(None))
+    return FunctionResolution(t, impl)
 
 
 @register("array_position")
@@ -2664,10 +2677,13 @@ def _string_to_array(ts):
                 parts = s[i].split(d[i])
             out.append(json.dumps(parts))
         # NULL only when the input string is NULL (non-strict in delim)
-        return make_string_column(
+        col = make_string_column(
             np.asarray(out, dtype=object).astype(str),
             propagate_nulls(cols[:1]))
-    return FunctionResolution(dt.VARCHAR, impl)
+        col.type = t
+        return col
+    t = dt.array_of(dt.VARCHAR)
+    return FunctionResolution(t, impl)
 
 
 @register("array_to_string")

@@ -36,6 +36,9 @@ def main(argv=None):
     ap.add_argument("--tls-key", default=None, help="PEM private key")
     ap.add_argument("--hba-config", default=None,
                     help="pg_hba.conf-style rules file")
+    ap.add_argument("--proxy-protocol", default="off",
+                    choices=["off", "optional", "require"],
+                    help="HAProxy PROXY v1/v2 preface handling")
     args = ap.parse_args(argv)
     if bool(args.tls_cert) != bool(args.tls_key):
         ap.error("--tls-cert and --tls-key must be given together")
@@ -46,7 +49,8 @@ def main(argv=None):
     http.start()
     pg = PgServer(db, args.host, args.pg_port, args.password,
                   tls_cert=args.tls_cert, tls_key=args.tls_key,
-                  hba_conf=args.hba_config)
+                  hba_conf=args.hba_config,
+                  proxy_protocol=args.proxy_protocol)
 
     async def run():
         stop = asyncio.Event()

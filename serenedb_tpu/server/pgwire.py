@@ -49,12 +49,65 @@ _OID = {
 _TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
            1082: 4, 1186: 16, 26: 4, 2205: 4, 2206: 4, 24: 4, 4089: 4}
 
+#: element TypeId → array OID (PG catalog values)
+_ARRAY_OID = {
+    dt.TypeId.BOOL: 1000, dt.TypeId.SMALLINT: 1005, dt.TypeId.TINYINT: 1005,
+    dt.TypeId.INT: 1007, dt.TypeId.BIGINT: 1016, dt.TypeId.FLOAT: 1021,
+    dt.TypeId.DOUBLE: 1022, dt.TypeId.VARCHAR: 1009,
+    dt.TypeId.DATE: 1182, dt.TypeId.TIMESTAMP: 1115,
+}
+
+
+def oid_of_type(t: dt.SqlType) -> int:
+    if t.id is dt.TypeId.ARRAY:
+        return _ARRAY_OID.get(t.elem or dt.TypeId.VARCHAR, 1009)
+    return _OID.get(t.id, 25)
+
+
+def _pg_array_text(json_text: str, elem=None, db=None) -> bytes:
+    """JSON array text (the physical representation) → PG {...} output
+    (reference: server/pg/serialize.cpp array_out). Temporal elements
+    render through the scalar pg_text of their element type — the
+    declared date[]/timestamp[] OIDs must match the payload."""
+    import json as _json
+    try:
+        vals = _json.loads(json_text)
+    except Exception:
+        return json_text.encode()
+    elem_t = (dt.SqlType(elem) if elem is not None and elem in
+              (dt.TypeId.DATE, dt.TypeId.TIMESTAMP, dt.TypeId.INTERVAL)
+              else None)
+
+    def one(v):
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return "t" if v else "f"
+        if isinstance(v, list):
+            return "{" + ",".join(one(x) for x in v) + "}"
+        if elem_t is not None and isinstance(v, int):
+            return pg_text(v, elem_t, db).decode()
+        if isinstance(v, str):
+            if v == "" or any(ch in v for ch in ',{}"\\ ') or \
+                    v.upper() == "NULL":
+                return '"' + v.replace("\\", "\\\\").replace(
+                    '"', '\\"') + '"'
+            return v
+        if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+            return str(int(v))    # PG float8[] text: {2}, not {2.0}
+        return str(v)
+    if not isinstance(vals, list):
+        return json_text.encode()
+    return ("{" + ",".join(one(v) for v in vals) + "}").encode()
+
 
 def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:
     """PG text-format encoding (reference: server/pg/serialize.cpp)."""
     if value is None:
         return None
     tid = typ.id
+    if tid is dt.TypeId.ARRAY:
+        return _pg_array_text(str(value), typ.elem, db)
     if tid is dt.TypeId.BOOL:
         return b"t" if value else b"f"
     if tid in (dt.TypeId.REGCLASS, dt.TypeId.REGTYPE, dt.TypeId.REGPROC,
@@ -158,7 +211,7 @@ class Writer:
                         fmts: tuple = ()):
         out = [struct.pack("!H", len(names))]
         for i, (name, t) in enumerate(zip(names, types)):
-            oid = _OID.get(t.id, 25)
+            oid = oid_of_type(t)
             out.append(name.encode() + b"\x00")
             out.append(struct.pack("!IHIhih", 0, 0, oid,
                                    _TYPLEN.get(oid, -1), -1,
@@ -268,6 +321,8 @@ class PgSession:
     async def run(self):
         with metrics.PG_CONNECTIONS.scoped():
             try:
+                if not await self._consume_proxy_preface():
+                    return
                 if not await self._startup():
                     return
                 await self._command_loop()
@@ -281,9 +336,86 @@ class PgSession:
                     self.conn.close()
                 self.w.t.close()
 
+    #: PROXY v2 signature (HAProxy spec); v1 is the ASCII "PROXY " line
+    _PP2_SIG = b"\r\n\r\n\x00\r\nQUIT\n"
+
+    async def _consume_proxy_preface(self) -> bool:
+        """HAProxy PROXY protocol v1/v2 (reference:
+        server/network/proxy_protocol.cpp). off: never read one;
+        optional: consume if present; require: reject clients without
+        one. The advertised source address replaces the socket peer for
+        HBA matching and pg_stat_activity."""
+        mode = self.server.proxy_protocol
+        if mode == "off":
+            return True
+        # peek: v2 starts with a 12-byte binary signature, v1 with
+        # ASCII "PROXY "; anything else is a plain client
+        head = await self.reader.readexactly(1)
+        if head == b"\r":
+            sig = head + await self.reader.readexactly(11)
+            if sig != self._PP2_SIG:
+                self.w.t.close()
+                return False
+            vercmd = await self.reader.readexactly(1)
+            fam = await self.reader.readexactly(1)
+            (plen,) = struct.unpack("!H", await self.reader.readexactly(2))
+            payload = await self.reader.readexactly(plen)
+            if vercmd[0] >> 4 != 2:
+                self.w.t.close()
+                return False
+            if (vercmd[0] & 0xF) == 1 and fam[0] >> 4 == 1 and plen >= 12:
+                import socket as _socket
+                src = _socket.inet_ntoa(payload[0:4])
+                sport = struct.unpack("!H", payload[8:10])[0]
+                self.proxied_peer = (src, sport)
+            elif (vercmd[0] & 0xF) == 1 and fam[0] >> 4 == 2 and plen >= 36:
+                import socket as _socket
+                src = _socket.inet_ntop(_socket.AF_INET6, payload[0:16])
+                sport = struct.unpack("!H", payload[32:34])[0]
+                self.proxied_peer = (src, sport)
+            # LOCAL command / UNSPEC: keep the socket peer
+            return True
+        if head == b"P":
+            rest = await self.reader.readexactly(5)
+            if head + rest != b"PROXY ":
+                self.w.t.close()
+                return False
+            line = bytearray()
+            while not line.endswith(b"\r\n"):
+                line += await self.reader.readexactly(1)
+                if len(line) > 100:          # spec: max 107 bytes total
+                    self.w.t.close()
+                    return False
+            parts = line[:-2].decode("ascii", "replace").split(" ")
+            # TCP4/TCP6 src dst sport dport; UNKNOWN keeps the peer;
+            # malformed fields drop the connection cleanly (spec) —
+            # never an unhandled task exception an unauthenticated
+            # peer can spam
+            if parts and parts[0] in ("TCP4", "TCP6"):
+                try:
+                    self.proxied_peer = (parts[1], int(parts[3]))
+                except (IndexError, ValueError):
+                    self.w.t.close()
+                    return False
+            return True
+        if mode == "require":
+            self.w.t.close()
+            return False
+        # optional + not a preface: stash the byte for the startup reader
+        self._preread = head
+        return True
+
+    async def _read_exactly(self, n: int) -> bytes:
+        """readexactly honoring a byte pre-read by the proxy sniffer."""
+        pre = getattr(self, "_preread", b"")
+        if pre:
+            self._preread = b""
+            return pre + await self.reader.readexactly(n - len(pre))
+        return await self.reader.readexactly(n)
+
     async def _startup(self) -> bool:
         while True:
-            raw = await self.reader.readexactly(4)
+            raw = await self._read_exactly(4)
             (ln,) = struct.unpack("!I", raw)
             body = await self.reader.readexactly(ln - 4)
             (code,) = struct.unpack("!I", body[:4])
@@ -333,7 +465,8 @@ class PgSession:
         # the implicit policy (server password / role password / trust).
         method = None
         if self.server.hba_rules is not None:
-            peer = self.w.t.get_extra_info("peername")
+            peer = getattr(self, "proxied_peer", None) or \
+                self.w.t.get_extra_info("peername")
             addr = peer[0] if isinstance(peer, tuple) else None
             rule = hba.match_rule(self.server.hba_rules, database, user,
                                   addr, self.tls_active)
@@ -988,8 +1121,12 @@ class PgServer:
                  port: int = 5432, password: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 hba_conf: Optional[str] = None):
+                 hba_conf: Optional[str] = None,
+                 proxy_protocol: str = "off"):
         self.db = db
+        #: HAProxy PROXY preface handling: off | optional | require
+        #: (reference: server/network/proxy_protocol.cpp)
+        self.proxy_protocol = proxy_protocol
         self.host = host
         self.port = port
         self.password = password

@@ -294,6 +294,16 @@ class CreateTsDictionary(Statement):
 
 
 @dataclass
+class CreateType(Statement):
+    """CREATE TYPE name AS ENUM (labels) / CREATE DOMAIN name AS base."""
+    name: str
+    kind: str                     # 'enum' | 'domain'
+    labels: list = field(default_factory=list)   # enum labels, in order
+    base: Optional[str] = None    # domain base type name
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateSequence(Statement):
     name: list[str]
     start: int = 1

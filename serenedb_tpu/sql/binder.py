@@ -331,7 +331,36 @@ class ExprBinder:
 
     def _bind_cast(self, e: ast.Cast) -> BoundExpr:
         arg = self.bind(e.operand)
-        target = dt.type_from_name(e.type_name)
+        try:
+            target = dt.type_from_name(e.type_name)
+        except (errors.SqlError, ValueError):
+            # user-defined type (enum/domain): resolve via the planner's
+            # database handle; enum casts validate labels (22P02)
+            r = getattr(self.planner, "resolver", None) if self.planner \
+                else None
+            db = getattr(r, "db", None) or (r if hasattr(r, "types")
+                                            else None)
+            if db is None:
+                raise
+            target, labels = db.resolve_type_name(e.type_name)
+            if labels is not None:
+                lset = set(labels)
+                tname = e.type_name.lower()
+
+                def impl_enum(cols, batch, _t=target):
+                    c = cast_column(cols[0], _t)
+                    valid = c.valid_mask() if c.validity is not None \
+                        else None
+                    for i, v in enumerate(c.to_pylist()):
+                        if v is None or (valid is not None
+                                         and not valid[i]):
+                            continue
+                        if v not in lset:
+                            raise errors.SqlError(
+                                "22P02", "invalid input value for enum "
+                                f'{tname}: "{v}"')
+                    return c
+                return BoundFunc("cast", [arg], target, impl_enum)
 
         def impl(cols, batch, _t=target):
             return cast_column(cols[0], _t)
@@ -669,8 +698,10 @@ def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
                 errors.UNDEFINED_FUNCTION,
                 f"function {name}({arg_t.id.name.lower()}) does not exist")
         return dt.BOOL
-    if name in ("string_agg", "array_agg"):
-        return dt.VARCHAR   # array_agg renders as a JSON array (no ARRAY type yet)
+    if name == "string_agg":
+        return dt.VARCHAR
+    if name == "array_agg":
+        return dt.array_of(arg_t)   # physically a JSON-text array
     raise errors.unsupported(f"aggregate {name}")
 
 

@@ -1090,6 +1090,32 @@ class Parser:
                 else:
                     break
             return ast.CreateRole(name, password, login, superuser, ine)
+        if self.accept_kw("TYPE"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_kw("AS")
+            self.expect_kw("ENUM")
+            self.expect_op("(")
+            labels = []
+            if not self.at_op(")"):
+                t = self.next()
+                if t.kind is not T.STRING:
+                    raise errors.syntax("enum labels must be string literals")
+                labels.append(t.value)
+                while self.accept_op(","):
+                    t = self.next()
+                    if t.kind is not T.STRING:
+                        raise errors.syntax(
+                            "enum labels must be string literals")
+                    labels.append(t.value)
+            self.expect_op(")")
+            return ast.CreateType(name, "enum", labels, None, ine)
+        if self.accept_kw("DOMAIN"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_kw("AS")
+            base = self._type_name()
+            return ast.CreateType(name, "domain", [], base, ine)
         if self.accept_kw("SEQUENCE"):
             ine = self._if_not_exists()
             name = self.qualified_name()
@@ -1199,6 +1225,8 @@ class Parser:
             kind = "view"
         elif self.accept_kw("SEQUENCE"):
             kind = "sequence"
+        elif self.accept_kw("TYPE") or self.accept_kw("DOMAIN"):
+            kind = "type"
         elif self.accept_kw("ROLE") or self.accept_kw("USER"):
             if_exists = False
             if self.accept_kw("IF"):

@@ -197,5 +197,6 @@ def table_def(name_key: str, table_id: int, names: list[str],
         "defaults": {n: base64.b64encode(pickle.dumps(e)).decode()
                      for n, e in (meta.get("defaults") or {}).items()},
         "tokenizers": meta.get("tokenizers", {}),
+        "enums": meta.get("enums", {}),
         "checkpoint_tick": start_tick,
     }

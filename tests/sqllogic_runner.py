@@ -116,6 +116,9 @@ def format_value(v, typ=None) -> str:
         if typ.id is dt.TypeId.INTERVAL:
             from serenedb_tpu.sql.binder import format_interval
             return format_interval(int(v))
+        if typ.id is dt.TypeId.ARRAY:
+            from serenedb_tpu.server.pgwire import _pg_array_text
+            return _pg_array_text(str(v)).decode()
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):

@@ -791,3 +791,96 @@ def test_streaming_select_midstream_error():
         pg.close()
     finally:
         stop()
+
+
+class TestProxyProtocol:
+    """HAProxy PROXY v1/v2 preface (reference: proxy_protocol.cpp)."""
+
+    def _server(self, mode):
+        import asyncio
+        import threading
+
+        from serenedb_tpu.engine import Database
+        from serenedb_tpu.server.pgwire import PgServer
+        db = Database(None)
+        srv = PgServer(db, "127.0.0.1", 0, proxy_protocol=mode)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        port = {}
+
+        async def boot():
+            server = await asyncio.start_server(
+                lambda r, w: __import__(
+                    "serenedb_tpu.server.pgwire",
+                    fromlist=["PgSession"]).PgSession(srv, r, w).run(),
+                "127.0.0.1", 0)
+            port["p"] = server.sockets[0].getsockname()[1]
+            started.set()
+            async with server:
+                await server.serve_forever()
+
+        t = threading.Thread(target=lambda: loop.run_until_complete(boot()),
+                             daemon=True)
+        t.start()
+        started.wait(10)
+        return port["p"]
+
+    def _query(self, port, sql, preface=b""):
+        import socket
+        import struct as st
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        if preface:
+            s.sendall(preface)
+        body = st.pack("!i", 196608) + b"user\x00u\x00database\x00d\x00\x00"
+        s.sendall(st.pack("!i", len(body) + 4) + body)
+
+        def read_msg():
+            t = s.recv(1)
+            if not t:
+                raise ConnectionError("closed")
+            ln = st.unpack("!i", s.recv(4))[0]
+            p = b""
+            while len(p) < ln - 4:
+                p += s.recv(ln - 4 - len(p))
+            return t, p
+
+        while True:
+            t, p = read_msg()
+            if t == b"Z":
+                break
+        b2 = sql.encode() + b"\x00"
+        s.sendall(b"Q" + st.pack("!i", len(b2) + 4) + b2)
+        rows = []
+        while True:
+            t, p = read_msg()
+            if t == b"D":
+                rows.append(p)
+            elif t == b"Z":
+                s.close()
+                return rows
+
+    def test_v1_preface(self):
+        port = self._server("optional")
+        rows = self._query(port, "SELECT 1",
+                           b"PROXY TCP4 10.1.2.3 10.0.0.1 5555 5432\r\n")
+        assert len(rows) == 1
+
+    def test_v2_preface(self):
+        import struct as st
+        port = self._server("optional")
+        sig = b"\r\n\r\n\x00\r\nQUIT\n"
+        addr = (bytes([10, 1, 2, 3]) + bytes([10, 0, 0, 1]) +
+                st.pack("!HH", 5555, 5432))
+        preface = sig + bytes([0x21, 0x11]) + st.pack("!H", len(addr)) + addr
+        rows = self._query(port, "SELECT 1", preface=preface)
+        assert len(rows) == 1
+
+    def test_optional_without_preface(self):
+        port = self._server("optional")
+        assert len(self._query(port, "SELECT 1")) == 1
+
+    def test_require_rejects_plain(self):
+        import pytest
+        port = self._server("require")
+        with pytest.raises((ConnectionError, OSError)):
+            self._query(port, "SELECT 1")
