@@ -167,7 +167,12 @@ _RANK = {
 
 
 def type_from_name(name: str) -> SqlType:
-    t = _BY_NAME.get(name.upper().strip())
+    key = name.upper().strip()
+    if key.endswith("[]"):
+        return array_of(type_from_name(key[:-2]))
+    if key == "ARRAY":          # legacy/unparameterized
+        return array_of(None)
+    t = _BY_NAME.get(key)
     if t is None:
         raise ValueError(f"unknown type name: {name!r}")
     return t

@@ -648,7 +648,12 @@ class Database(TableResolver):
         domains alias their base)."""
         tdef = self.types.get(name.lower())
         if tdef is None:
-            return dt.type_from_name(name), None
+            try:
+                return dt.type_from_name(name), None
+            except ValueError:
+                raise errors.SqlError(
+                    errors.UNDEFINED_OBJECT,
+                    f'type "{name}" does not exist')
         if tdef["kind"] == "enum":
             return dt.VARCHAR, list(tdef["labels"])
         # domains may stack over other user types (incl. enums): recurse
@@ -1122,6 +1127,13 @@ class Connection:
                         cur = (td.get("base") or "").lower() \
                             if td and td["kind"] == "domain" else None
                     return out
+                for dname, td in self.db.types.items():
+                    if td["kind"] == "domain" and \
+                            (td.get("base") or "").lower() == key:
+                        raise errors.SqlError(
+                            "2BP01",
+                            f'cannot drop type "{st.name[-1]}" because '
+                            f'type "{dname}" depends on it')
                 with self.db.lock:
                     for s_ in self.db.schemas.values():
                         for t in s_.tables.values():
@@ -1352,7 +1364,12 @@ class Connection:
                         return QueryResult(Batch([], []), "ALTER TABLE")
                     raise errors.SqlError(
                         "42701", f'column "{st.column}" already exists')
-                t = dt.type_from_name(st.type_name)
+                t, labels = self.db.resolve_type_name(st.type_name)
+                if labels is not None:
+                    meta_t = getattr(table, "table_meta", None)
+                    if meta_t is not None:
+                        meta_t.setdefault("enums", {})[st.column] = \
+                            st.type_name.lower()
                 col = Column.from_pylist([None] * full.num_rows, t)
                 table.replace(Batch(names + [st.column],
                                     list(full.columns) + [col]),

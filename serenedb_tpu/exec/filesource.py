@@ -84,18 +84,21 @@ def parquet_source(db, path: str, pinned: bool = False) -> TableProvider:
     later never alter results. Pins live for the Database's lifetime
     (a fresh Database re-resolves)."""
     if pinned:
-        pins = getattr(db, "_pinned_snapshots", None)
-        if pins is None:
-            pins = db._pinned_snapshots = {}
-        hit = pins.get(("parquet", path))
+        with db.lock:
+            pins = getattr(db, "_pinned_snapshots", None)
+            if pins is None:
+                pins = db._pinned_snapshots = {}
+            hit = pins.get(("parquet", path))
         if hit is not None:
             return hit
         provider = parquet_source(db, path, pinned=False)
         # materialize NOW: later mtime/file changes must not show through
         frozen = MemTable(os.path.basename(path),
                           provider.full_batch())
-        pins[("parquet", path)] = frozen
-        return frozen
+        with db.lock:
+            # two concurrent first resolutions: FIRST pin wins, both
+            # serve the same snapshot thereafter
+            return pins.setdefault(("parquet", path), frozen)
     paths = [resolve_path(p) for p in expand_glob(path)]
     if len(paths) == 1:
         with db.lock:

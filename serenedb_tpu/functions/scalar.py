@@ -2595,7 +2595,16 @@ def _array_append(ts):
             np.asarray(out, dtype=object).astype(str), None)
         col.type = t
         return col
-    t = ts[0] if ts[0].id is dt.TypeId.ARRAY else dt.array_of(ts[1])
+    if ts[0].id is dt.TypeId.ARRAY and ts[0].elem is not None:
+        elem = dt.SqlType(ts[0].elem)
+        v = ts[1]
+        # appended value must fit the element type (PG: 42883 otherwise)
+        if v.id is not dt.TypeId.NULL and \
+                elem.is_numeric != v.is_numeric:
+            return None
+        t = ts[0]
+    else:
+        t = ts[0] if ts[0].id is dt.TypeId.ARRAY else dt.array_of(ts[1])
     return FunctionResolution(t, impl)
 
 

@@ -205,12 +205,35 @@ class Planner:
         cols = []
         width = len(rows[0])
         one = Batch(["__dummy"], [Column.from_pylist([0])])
+        from ..exec.plan import _unify_setop_type
+        from .binder import cast_column
         for k in range(width):
             exprs = [binder.bind(r[k]) for r in rows]
             vals = [e.eval(one).decode(0) for e in exprs]
-            t = next((e.type for e in exprs if e.type.id is not dt.TypeId.NULL),
-                     dt.NULLTYPE)
-            cols.append(Column.from_pylist(vals, t))
+            # unify across ALL rows (PG: VALUES (1), (2.5) is numeric,
+            # not the first row's int). A string literal mixed with one
+            # typed row acts as PG's unknown literal: it coerces toward
+            # the typed side instead of failing the unification.
+            t = dt.NULLTYPE
+            strings_seen = False
+            for e in exprs:
+                et = e.type
+                if et.id is dt.TypeId.NULL:
+                    continue
+                if et.is_string and not (t.is_string or
+                                         t.id is dt.TypeId.NULL):
+                    strings_seen = True
+                    continue
+                if t.is_string and not et.is_string:
+                    strings_seen = True
+                    t = et
+                    continue
+                t = _unify_setop_type(t, et)
+            if strings_seen and not t.is_string:
+                col = Column.from_pylist(vals, dt.VARCHAR)
+                cols.append(cast_column(col, t))
+            else:
+                cols.append(Column.from_pylist(vals, t))
         return ValuesNode(Batch([f"col{k}" for k in range(width)], cols))
 
     def _plan_cte_def(self, key: str, cte: ast.CteDef) -> PlanNode:

@@ -124,7 +124,14 @@ class Store:
         if not os.path.exists(path):
             from ..exec.plan import empty_batch
             return empty_batch(names, types)
-        return read_parquet_snapshot(path)
+        batch = read_parquet_snapshot(path)
+        # re-stamp logical types the physical snapshot can't carry
+        # (ARRAY columns are stored as their JSON text): the catalog's
+        # declared type wins over arrow inference
+        for name, t in zip(names, types):
+            if t.id is dt.TypeId.ARRAY and name in batch:
+                batch.column(name).type = t
+        return batch
 
     def drop_snapshot(self, table_id: int) -> None:
         try:
@@ -174,7 +181,8 @@ def _pid_alive(pid: int) -> bool:
 
 
 def serialize_type(t: dt.SqlType) -> str:
-    return t.id.value
+    # "ELEM[]" for arrays so the element type round-trips through boot
+    return str(t)
 
 
 def table_def(name_key: str, table_id: int, names: list[str],
