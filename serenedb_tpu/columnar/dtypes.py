@@ -178,6 +178,27 @@ def type_from_name(name: str) -> SqlType:
     return t
 
 
+def unify_pair(a: SqlType, b: SqlType) -> SqlType:
+    """Branch-type unification (CASE/COALESCE/VALUES arms): NULL yields
+    the other side, equal types stay, numerics widen via common_numeric,
+    and any other mix keeps the first typed side (text-vs-x arms render
+    through the first type, matching the engine's historical behavior)."""
+    if a.id is TypeId.NULL:
+        return b
+    if b.id is TypeId.NULL or a == b:
+        return a
+    if a.is_numeric and b.is_numeric:
+        return common_numeric(a, b)
+    return a
+
+
+def unify_all(types) -> SqlType:
+    t = NULLTYPE
+    for x in types:
+        t = unify_pair(t, x)
+    return t
+
+
 def common_numeric(a: SqlType, b: SqlType) -> SqlType:
     """Widening for arithmetic/comparison between numeric types."""
     if a.id is TypeId.NULL:

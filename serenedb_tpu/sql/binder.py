@@ -282,8 +282,7 @@ class ExprBinder:
             # evaluated on rows an earlier one already decided —
             # coalesce(x, 1/0) succeeds when x is never NULL
             bound = [self.bind(a) for a in e.args]
-            t = next((b.type for b in bound
-                      if b.type.id is not dt.TypeId.NULL), dt.NULLTYPE)
+            t = dt.unify_all(b.type for b in bound)
 
             def notnull(b):
                 def impl(cols, batch):
@@ -374,14 +373,12 @@ class ExprBinder:
             branches = e.branches
         bound = [(self.bind(c), self.bind(v)) for c, v in branches]
         else_b = self.bind(e.else_) if e.else_ is not None else None
-        t = dt.NULLTYPE
-        for _, v in bound:
-            if v.type.id is not dt.TypeId.NULL:
-                t = v.type if t.id is dt.TypeId.NULL else (
-                    dt.common_numeric(t, v.type) if t.is_numeric and v.type.is_numeric
-                    else t)
-        if t.id is dt.TypeId.NULL and else_b is not None:
-            t = else_b.type
+        # result type unifies over EVERY branch INCLUDING ELSE (PG):
+        # CASE WHEN .. THEN 1 ELSE 2.5 END is double precision, never a
+        # truncating int
+        arms = [v for _, v in bound] + ([else_b] if else_b is not None
+                                        else [])
+        t = dt.unify_all(v.type for v in arms)
         return BoundCase(bound, else_b, t)
 
     # -- subqueries --------------------------------------------------------
