@@ -641,12 +641,37 @@ class ExprBinder:
             return Column.const(v, batch.num_rows, dt.BOOL)
         return BoundFunc("exists", [], dt.BOOL, impl)
 
+    #: comparison-family functions whose mixed text/typed operands
+    #: coerce the TEXT side toward the typed side at BIND time (PG
+    #: unknown-literal resolution). Binding once keeps every consumer —
+    #: kernels, is_distinct/nullif, btree/PK/geo index claims — on the
+    #: same coerced operand, and literal casts fold to typed literals.
+    _COERCE_CMP = {"op=", "op<>", "op!=", "op<", "op<=", "op>", "op>=",
+                   "is_distinct_from", "is_not_distinct_from", "nullif"}
+    _COERCIBLE_IDS = (dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                      dt.TypeId.INTERVAL)
+
     def _call(self, name: str, args: list[BoundExpr]) -> BoundExpr:
         if name == "opnot":
             def impl(cols, batch):
                 c = cols[0]
                 return Column(dt.BOOL, ~c.data.astype(bool), c.validity)
             return BoundFunc("not", args, dt.BOOL, impl)
+        if name in self._COERCE_CMP and len(args) == 2:
+            a, b = args
+            if a.type.is_string != b.type.is_string:
+                typed = b if a.type.is_string else a
+                if typed.type.is_numeric or typed.type.id in \
+                        self._COERCIBLE_IDS:
+                    def coerced(arg, _t=typed.type):
+                        def impl(cols, batch):
+                            return cast_column(cols[0], _t)
+                        return _fold_if_const(
+                            BoundFunc("cast", [arg], _t, impl))
+                    if a.type.is_string:
+                        args = [coerced(a), b]
+                    else:
+                        args = [a, coerced(b)]
         res = fnlib.resolve(name, [a.type for a in args])
 
         def impl2(cols, batch, _impl=res.impl):
@@ -963,13 +988,23 @@ def _cast_text_to(v: str, target: dt.SqlType):
                 return False
             raise ValueError(s)
         if target.is_integer:
-            return int(float(s)) if ("." in s or "e" in s.lower()) else int(s)
+            # PG: text→int accepts only an optional sign + digits; '2.7'
+            # is 22P02, never a silent truncation
+            if not re.fullmatch(r"[+-]?\d+", s):
+                raise ValueError(s)
+            return int(s)
         if target.is_float:
             return float(s)
         if target.id is dt.TypeId.TIMESTAMP:
-            return int(np.datetime64(s).astype("datetime64[us]").astype(np.int64))
+            ts64 = np.datetime64(s)
+            if np.isnat(ts64):
+                raise ValueError(s)   # '' parses as NaT — PG: 22007
+            return int(ts64.astype("datetime64[us]").astype(np.int64))
         if target.id is dt.TypeId.DATE:
-            return int(np.datetime64(s, "D").astype(np.int64))
+            d64 = np.datetime64(s, "D")
+            if np.isnat(d64):
+                raise ValueError(s)
+            return int(d64.astype(np.int64))
         if target.id is dt.TypeId.INTERVAL:
             return parse_interval(s)
     except ValueError:
