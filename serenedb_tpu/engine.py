@@ -2613,8 +2613,11 @@ def _setting_text(v) -> str:
     return str(v)
 
 
-def _inline_view(sel: ast.Select, view: ViewDef) -> ast.Select:
-    """Replace references to the view with a subquery ref."""
+def _inline_view(sel, view: ViewDef):
+    """Replace references to the view with a subquery ref — in every
+    Select leaf of the statement (set-op arms included) and in CTE
+    bodies, so a view used anywhere in the query resolves instead of
+    spinning _plan's inline-retry loop."""
     def rewrite(ref: ast.TableRef) -> ast.TableRef:
         if isinstance(ref, ast.NamedTable) and \
                 ref.parts[-1].lower() == view.name.lower():
@@ -2622,15 +2625,30 @@ def _inline_view(sel: ast.Select, view: ViewDef) -> ast.Select:
         if isinstance(ref, ast.JoinRef):
             ref.left = rewrite(ref.left)
             ref.right = rewrite(ref.right)
-        if isinstance(ref, ast.SubqueryRef) and ref.query.from_ is not None:
+        if isinstance(ref, ast.SubqueryRef):
             # view-over-view: an earlier inlining produced this subquery;
-            # the view reference to replace now lives inside it
-            ref.query.from_ = rewrite(ref.query.from_)
+            # the reference to replace now lives inside it
+            _rewrite_leaves(ref.query)
         return ref
+
+    def _rewrite_leaves(q) -> None:
+        """Rewrite from_ of every Select leaf under q (Select|SetOp),
+        and recurse into WITH bodies."""
+        stack = [q]
+        while stack:
+            node = stack.pop()
+            for body in getattr(node, "ctes", {}).values():
+                stack.append(body.query if isinstance(body, ast.CteDef)
+                             else body)
+            if isinstance(node, ast.SetOp):
+                stack.append(node.left)
+                stack.append(node.right)
+            elif getattr(node, "from_", None) is not None:
+                node.from_ = rewrite(node.from_)
+
     import copy
     sel2 = copy.deepcopy(sel)
-    if sel2.from_ is not None:
-        sel2.from_ = rewrite(sel2.from_)
+    _rewrite_leaves(sel2)
     return sel2
 
 
