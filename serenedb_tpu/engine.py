@@ -1592,10 +1592,16 @@ class Connection:
         if st.returning:
             self.db.resolve_table(st.table, "select")   # PG: RETURNING reads
         target_names = st.columns or table.column_names
+        seen_targets = set()
         for c in target_names:
             if c not in table.column_names:
                 raise errors.SqlError(errors.UNDEFINED_COLUMN,
                                       f'column "{c}" does not exist')
+            if c.lower() in seen_targets:
+                raise errors.SqlError(
+                    "42701",
+                    f'column "{c}" specified more than once')
+            seen_targets.add(c.lower())
         if st.query is not None:
             incoming = self._run_select(st.query, params)
             if incoming.num_columns != len(target_names):
