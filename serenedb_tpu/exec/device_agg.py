@@ -522,9 +522,17 @@ def _scalar_agg_device(spec: AggSpec, ce, arrays, mask, env_for):
         else:
             info = jnp.iinfo(v.dtype)
             ident = info.max if spec.func == "min" else info.min
+        cnt = jnp.sum(m, dtype=jnp.int32)
+        if is_float and spec.func == "min":
+            m_nn = jnp.logical_and(m, jnp.logical_not(jnp.isnan(v)))
+            red = jnp.min(jnp.where(m_nn, v, ident))
+            red = jnp.where(jnp.logical_and(
+                cnt > 0, jnp.sum(m_nn, dtype=jnp.int32) == 0),
+                jnp.nan, red)
+            return [red, cnt]
         vv = jnp.where(m, v, ident)
         red = jnp.min(vv) if spec.func == "min" else jnp.max(vv)
-        return [red, jnp.sum(m, dtype=jnp.int32)]
+        return [red, cnt]
     raise NotCompilable(spec.func)
 
 
@@ -545,6 +553,18 @@ def _group_agg_device(spec: AggSpec, ce, arrays, codes, mask, env_for, g):
             return [ops_agg.group_sum_int_limbs_chunked(codes, m, v, g), cnt]
         return [ops_agg.group_sum_int_limbs(codes, m, v, g), cnt]
     if spec.func in ("min", "max"):
+        if is_float and spec.func == "min":
+            # PG: NaN is the greatest float — MIN skips NaN unless a
+            # group is ALL NaN (then it IS NaN). Counts keep the
+            # original mask so NULL detection is untouched. (Under the
+            # mesh, a group all-NaN on one shard only is a known edge.)
+            counts = ops_agg.group_count_scatter(codes, m, g)
+            m_nn = jnp.logical_and(m, jnp.logical_not(jnp.isnan(v)))
+            nonnan = ops_agg.group_count_scatter(codes, m_nn, g)
+            red = ops_agg.group_min_max(codes, m_nn, v, g, "min")
+            red = jnp.where(jnp.logical_and(counts > 0, nonnan == 0),
+                            jnp.nan, red)
+            return [red, counts]
         return [ops_agg.group_min_max(codes, m, v, g, spec.func),
                 ops_agg.group_count_scatter(codes, m, g)]
     raise NotCompilable(spec.func)

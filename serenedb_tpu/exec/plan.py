@@ -714,8 +714,20 @@ class AggregateNode(PlanNode):
                 info = np.iinfo(np.int64)
                 ident = info.max if spec.func == "min" else info.min
                 acc = np.full(g, ident, dtype=np.int64)
-            ufunc = np.minimum if spec.func == "min" else np.maximum
-            ufunc.at(acc, vc, vals)
+            # PG float total order: NaN is the greatest — np.fmin skips
+            # NaN for min; np.maximum propagates it for max
+            if spec.func == "min":
+                ufunc = np.fmin if arg.type.is_float else np.minimum
+            else:
+                ufunc = np.maximum
+            with np.errstate(invalid="ignore"):   # NaN propagation is wanted
+                ufunc.at(acc, vc, vals)
+            if spec.func == "min" and arg.type.is_float:
+                # all-NaN groups keep the identity: stamp them NaN
+                # (~empty already says which groups have valid rows)
+                has_non_nan = np.zeros(g, dtype=bool)
+                np.logical_or.at(has_non_nan, vc, ~np.isnan(vals))
+                acc = np.where(~empty & ~has_non_nan, np.nan, acc)
             acc = np.where(empty, 0, acc).astype(arg.type.np_dtype)
             return Column(arg.type, acc, ~empty if empty.any() else None)
         if spec.func in ("stddev", "stddev_samp", "var_samp", "variance",
@@ -842,11 +854,26 @@ class _ScalarAcc:
             if col.type.is_string:
                 vals = [v for v in col.to_pylist() if v is not None]
                 lo, hi = min(vals), max(vals)
+                self.min_v = lo if self.min_v is None \
+                    else min(self.min_v, lo)
+                self.max_v = hi if self.max_v is None \
+                    else max(self.max_v, hi)
             else:
                 vals = col.data[valid]
-                lo, hi = vals.min(), vals.max()
-            self.min_v = lo if self.min_v is None else min(self.min_v, lo)
-            self.max_v = hi if self.max_v is None else max(self.max_v, hi)
+                # PG float total order: NaN is the GREATEST value — max
+                # returns NaN when any NaN exists, min skips NaN unless
+                # every value is NaN
+                if vals.dtype.kind == "f" and np.isnan(vals).any():
+                    nn = vals[~np.isnan(vals)]
+                    lo = nn.min() if len(nn) else np.nan
+                    hi = np.nan
+                else:
+                    lo, hi = vals.min(), vals.max()
+                self.min_v = lo if self.min_v is None \
+                    else np.fmin(self.min_v, lo)
+                # np.maximum propagates NaN — exactly PG's max
+                self.max_v = hi if self.max_v is None \
+                    else np.maximum(self.max_v, hi)
         elif spec.func in ("bool_and", "bool_or"):
             vals = col.data[valid].astype(bool)
             v = vals.all() if spec.func == "bool_and" else vals.any()

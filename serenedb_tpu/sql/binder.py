@@ -10,6 +10,7 @@ BoundAggRef placeholders.
 from __future__ import annotations
 
 import copy
+import math
 import re
 from dataclasses import dataclass, field
 from typing import Optional
@@ -972,6 +973,10 @@ def _cast_to_text(v, src: dt.SqlType) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
         if v == int(v) and abs(v) < 1e15:
             return f"{v:.1f}" if "." not in repr(v) else repr(v)
         return repr(v)
