@@ -12,6 +12,15 @@ value = geometric mean speedup (device vs single-socket CPU paths) over
 the shapes that completed; vs_baseline = the same ratio (BASELINE.json
 targets 3x / 2x on these shapes).
 
+Cold vs warm: for the analytics shapes (q1, hits) the HEADLINE number is
+the COLD device run — first dispatch after data lands in the engine,
+including host→HBM upload, tile compression and key factorization —
+because BASELINE.md's ClickBench target says "cold". A persistent XLA
+compilation cache (.jax_cache/) keeps the *binary* warm across
+processes, mirroring the reference's cold runs with a prebuilt release
+build (scripts/perf/run_hits_perf.sh: release binary, 3 timed runs,
+cold first). Warm numbers are reported alongside in detail.
+
 Robustness: the tunneled TPU on this rig can hang any dispatch forever
 during tunnel outages (not an error — a hang). So the driver process
 never dispatches to the device itself. Instead it:
@@ -20,7 +29,11 @@ never dispatches to the device itself. Instead it:
   2. runs each bench shape in its own subprocess with a hard timeout, so
      one mid-shape hang costs that shape, not the round;
   3. always prints the one JSON line, with per-shape partial results and
-     errors, before exiting.
+     errors, before exiting;
+  4. falls back to BENCH_LEDGER.json — device results captured
+     opportunistically DURING the round via `python bench.py --ledger`
+     — marking them "stale": true, so a round-end tunnel outage reports
+     the freshest real device evidence instead of 0.0.
 Budget via SDB_BENCH_BUDGET_S (default 1200s total).
 """
 
@@ -74,12 +87,19 @@ def bench_q1() -> float:
     t_cpu = time.perf_counter() - t0
 
     c.execute("SET serene_device = 'tpu'")
-    run_all()  # compile + upload
+    t0 = time.perf_counter()
+    dev_cold = run_all()  # upload + (cached-)compile + first dispatch
+    t_cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     dev_res = run_all()
     t_dev = time.perf_counter() - t0
-    assert cpu_res == dev_res, "device/CPU result mismatch in Q1 bench"
-    return t_cpu / t_dev
+    assert cpu_res == dev_res == dev_cold, \
+        "device/CPU result mismatch in Q1 bench"
+    _EXTRA["cold_s"] = round(t_cold, 3)
+    _EXTRA["warm_s"] = round(t_dev, 3)
+    _EXTRA["cpu_s"] = round(t_cpu, 3)
+    _EXTRA["speedup_warm"] = round(t_cpu / t_dev, 3)
+    return t_cpu / t_cold
 
 
 def bench_hits() -> float:
@@ -182,8 +202,8 @@ def bench_hits() -> float:
     _EXTRA["cold_s"] = round(t_dev_cold, 3)
     _EXTRA["warm_s"] = round(t_dev, 3)
     _EXTRA["cpu_s"] = round(t_cpu, 3)
-    _EXTRA["speedup_cold"] = round(t_cpu / t_dev_cold, 3)
-    return t_cpu / t_dev
+    _EXTRA["speedup_warm"] = round(t_cpu / t_dev, 3)
+    return t_cpu / t_dev_cold
 
 
 def bench_bm25() -> float:
@@ -442,12 +462,25 @@ _EXTRA: dict = {}
 def _run_shape_child(name: str) -> None:
     """Child mode: run one shape, print its JSON result, exit."""
     try:
+        import jax
         if os.environ.get("SDB_BENCH_FORCE_CPU") == "1":
             # test hook: sitecustomize overrides JAX_PLATFORMS, so force
             # the CPU backend explicitly (harness validation off-device)
-            import jax
             jax.config.update("jax_platforms", "cpu")
+        # Persistent XLA compilation cache: "cold" means the DATA is cold
+        # (upload + compress + factorize + first dispatch), not that the
+        # binary recompiles — the reference's cold runs use a prebuilt
+        # release build too (scripts/perf/run_hits_perf.sh).
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache")
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            pass
         speedup = SHAPES[name]()
+        _EXTRA["platform"] = jax.default_backend()
         print(json.dumps({"shape": name, "speedup": round(speedup, 4),
                           "extra": _EXTRA}),
               flush=True)
@@ -455,6 +488,137 @@ def _run_shape_child(name: str) -> None:
         print(json.dumps({"shape": name, "error": f"{type(e).__name__}: {e}"}),
               flush=True)
         sys.exit(1)
+
+
+LEDGER_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_LEDGER.json")
+_LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench.lock")
+_STOP_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".ledger_stop")
+
+
+def _acquire_bench_lock(wait_s: float):
+    """One bench at a time on this machine: the opportunistic ledger loop
+    and the round-end run must not contend for the single TPU (a ledger
+    child holding the device would make the official probe fail and the
+    round report stale numbers). Returns the held fd, or None."""
+    import fcntl
+    fd = os.open(_LOCK_PATH, os.O_CREAT | os.O_RDWR)
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fd
+        except OSError:
+            if time.monotonic() >= deadline:
+                os.close(fd)
+                return None
+            time.sleep(2.0)
+
+
+def _load_ledger() -> dict:
+    try:
+        with open(LEDGER_PATH) as f:
+            led = json.load(f)
+        return led if isinstance(led.get("entries"), dict) else {"entries": {}}
+    except (OSError, json.JSONDecodeError):
+        return {"entries": {}}
+
+
+def _save_ledger(led: dict) -> None:
+    tmp = f"{LEDGER_PATH}.{os.getpid()}.tmp"  # unique per writer
+    with open(tmp, "w") as f:
+        json.dump(led, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, LEDGER_PATH)  # last-writer-wins, never corrupt
+
+
+def _git_head() -> str:
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=10,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        return r.stdout.strip()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _run_shape_subprocess(name: str, timeout_s: float) -> tuple[dict, str]:
+    """Run one shape in a child process; returns (record, error)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--shape", name],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # typed prefix — _infra_failure keys on it, never on stderr text
+        return {}, "timeout: shape timed out (device hang mid-run?)"
+    rec = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            rec = parsed
+            break
+    if rec and isinstance(rec.get("speedup"), (int, float)) \
+            and rec["speedup"] > 0:
+        return rec, ""
+    msg = (rec or {}).get("error") or r.stderr[-400:] or "no output"
+    return {}, str(msg)
+
+
+def ledger_main(shape_names: list[str]) -> None:
+    """Opportunistic device-evidence capture: probe once (short), then run
+    the requested shapes and persist every success into BENCH_LEDGER.json
+    with a timestamp + git sha. Safe to run repeatedly in a loop during
+    the round — each success overwrites that shape's entry with fresher
+    evidence. Prints a one-line JSON status."""
+    import datetime
+
+    names = shape_names or list(SHAPES)
+    bad = [n for n in names if n not in SHAPES]
+    if bad:
+        print(json.dumps({"ledger": "error", "unknown_shapes": bad}))
+        sys.exit(2)
+    if os.path.exists(_STOP_PATH):
+        print(json.dumps({"ledger": "stopped", "reason": ".ledger_stop"}))
+        sys.exit(4)
+    lock = _acquire_bench_lock(0.0)
+    if lock is None:
+        print(json.dumps({"ledger": "busy",
+                          "reason": "another bench holds the device lock"}))
+        sys.exit(4)
+    alive, _, err = _probe_device(75.0)
+    if not alive:
+        print(json.dumps({"ledger": "device-down", "error": err}),
+              flush=True)
+        sys.exit(3)
+    git = _git_head()
+    updated, errors = [], {}
+    for name in names:
+        if os.path.exists(_STOP_PATH):  # round-end run preempts us
+            errors[name] = "stopped: .ledger_stop appeared"
+            break
+        rec, err = _run_shape_subprocess(name, 900.0)
+        if not rec:
+            errors[name] = err
+            continue
+        led = _load_ledger()  # reload each time: concurrent-writer safe
+        led["entries"][name] = {
+            "speedup": rec["speedup"],
+            "extra": rec.get("extra") or {},
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"),
+            "git": git,
+        }
+        _save_ledger(led)
+        updated.append(name)
+    out = {"ledger": "ok" if updated else "no-results", "updated": updated}
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out), flush=True)
 
 
 def _probe_device(timeout_s: float = 75.0) -> tuple[bool, bool, str]:
@@ -482,6 +646,16 @@ def main() -> None:
     deadline = time.monotonic() + budget
     t_start = time.monotonic()
 
+    # The official run preempts the opportunistic ledger loop: signal it
+    # to stop, then wait (bounded) for any in-flight ledger child to
+    # release the single device before probing.
+    try:
+        with open(_STOP_PATH, "w") as f:
+            f.write("round-end bench run\n")
+    except OSError:
+        pass
+    _acquire_bench_lock(min(300.0, budget / 4))  # held till process exit
+
     # 1. liveness: retry across a possible transient outage, but keep at
     # least ~2/3 of the budget for the shapes themselves; scale the probe
     # timeout down for small validation budgets
@@ -503,6 +677,7 @@ def main() -> None:
     results: dict[str, float] = {}
     extras: dict[str, float] = {}
     errors: dict[str, str] = {}
+    stale_shapes: list[str] = []
     if not alive:
         errors["device"] = (
             f"device liveness probe failed {probes}x: {probe_err}")
@@ -513,32 +688,57 @@ def main() -> None:
             if remaining < shape_floor:
                 errors[name] = "skipped: bench budget exhausted"
                 continue
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--shape", name],
-                    capture_output=True, text=True,
-                    timeout=min(600.0, remaining))
-            except subprocess.TimeoutExpired:
-                errors[name] = "shape timed out (device hang mid-run?)"
-                continue
-            rec = None
-            for line in reversed(r.stdout.strip().splitlines()):
-                try:
-                    parsed = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(parsed, dict):
-                    rec = parsed
-                    break
-            if rec and isinstance(rec.get("speedup"), (int, float)) \
-                    and rec["speedup"] > 0:
+            rec, err = _run_shape_subprocess(name, min(600.0, remaining))
+            if rec:
                 results[name] = float(rec["speedup"])
                 for ek, ev in (rec.get("extra") or {}).items():
                     extras[f"{name}_{ek}"] = ev
             else:
-                msg = (rec or {}).get("error") or r.stderr[-400:] or "no output"
-                errors[name] = str(msg)
+                errors[name] = err
+
+    # Ledger fallback: a shape without a live result falls back to the
+    # freshest opportunistic device run captured during the round
+    # (bench.py --ledger), clearly marked stale — but ONLY when the live
+    # attempt failed for infrastructure reasons (device unreachable,
+    # hang/timeout, budget exhausted). A deterministic in-shape failure
+    # (parity assertion, crash) means the CURRENT code is broken and must
+    # not be papered over by an older passing number. Entries also expire
+    # (default 24h) so a later blind round can't resurrect ancient runs.
+    def _infra_failure(name: str) -> bool:
+        if not alive:
+            return True
+        e = errors.get(name, "")
+        return e.startswith("timeout:") or e.startswith("skipped:")
+
+    max_age_h = float(os.environ.get("SDB_BENCH_LEDGER_MAX_AGE_H", "24"))
+    ledger = _load_ledger()["entries"]
+    for name in SHAPES:
+        if name in results or name not in ledger:
+            continue
+        if not _infra_failure(name):
+            continue
+        ent = ledger[name]
+        if not isinstance(ent.get("speedup"), (int, float)):
+            continue
+        try:
+            import datetime
+            ts = datetime.datetime.fromisoformat(ent["ts"])
+            age_h = (datetime.datetime.now(datetime.timezone.utc)
+                     - ts).total_seconds() / 3600.0
+            expiry = f"ledger entry expired: {age_h:.0f}h old"
+        except (KeyError, TypeError, ValueError):
+            age_h = float("inf")
+            expiry = "ledger entry has no parsable timestamp"
+        if age_h > max_age_h:
+            base = errors.get(name) or "device unreachable"
+            errors[name] = f"{base} [{expiry}]"
+            continue
+        results[name] = float(ent["speedup"])
+        stale_shapes.append(name)
+        for ek, ev in (ent.get("extra") or {}).items():
+            extras[f"{name}_{ek}"] = ev
+        extras[f"{name}_ledger_ts"] = ent.get("ts", "")
+        extras[f"{name}_ledger_git"] = ent.get("git", "")
 
     if results:
         logs = [math.log(v) for v in results.values()]
@@ -553,6 +753,9 @@ def main() -> None:
         "detail": {**{f"{k}_speedup": v for k, v in results.items()},
                    **extras},
     }
+    if stale_shapes:
+        out["stale"] = True
+        out["stale_shapes"] = stale_shapes
     if errors:
         out["errors"] = errors
         if results:
@@ -563,6 +766,8 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) == 3 and sys.argv[1] == "--shape":
         _run_shape_child(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--ledger":
+        ledger_main(sys.argv[2:])
     else:
         try:
             main()
