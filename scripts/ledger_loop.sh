@@ -16,6 +16,7 @@ MAX_HOURS="${LEDGER_MAX_HOURS:-11}"
 SLEEP_DOWN="${LEDGER_SLEEP_DOWN:-240}"
 SLEEP_OK="${LEDGER_SLEEP_OK:-3600}"
 STOP_FILE=".ledger_stop"
+rm -f "$STOP_FILE"   # a previous round's stop must not disable this one
 end=$(( $(date +%s) + MAX_HOURS * 3600 ))
 while [ "$(date +%s)" -lt "$end" ] && [ ! -f "$STOP_FILE" ]; do
   echo "[$(date -u +%FT%TZ)] ledger attempt"

@@ -43,7 +43,7 @@ def load() -> Optional[ctypes.CDLL]:
             if not os.path.exists(so) or \
                     os.path.getmtime(so) < os.path.getmtime(src):
                 cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                       "-o", so + ".tmp", src]
+                       "-pthread", "-o", so + ".tmp", src]
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=120)
                 os.replace(so + ".tmp", so)
@@ -55,6 +55,10 @@ def load() -> Optional[ctypes.CDLL]:
         lib.sdb_build_index.argtypes = [ctypes.c_char_p,
                                         ctypes.POINTER(ctypes.c_int64),
                                         ctypes.c_int64]
+        lib.sdb_build_index_mt.restype = ctypes.c_void_p
+        lib.sdb_build_index_mt.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_int64),
+                                           ctypes.c_int64, ctypes.c_int32]
         for name in ("sdb_num_terms", "sdb_postings_len",
                      "sdb_positions_len", "sdb_terms_bytes",
                      "sdb_total_tokens"):
@@ -75,9 +79,26 @@ def load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def build_field_index_native(texts) -> Optional["FieldIndex"]:
-    """Build a FieldIndex with the C++ one-pass indexer. Returns None when
-    the native library is unavailable (caller falls back to Python)."""
+def ingest_threads() -> int:
+    """Parallel-ingest width: SDB_INGEST_THREADS overrides, else all
+    cores (the reference's ParallelSink uses the scheduler's thread
+    count the same way)."""
+    env = os.environ.get("SDB_INGEST_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, os.cpu_count() or 1)
+
+
+def build_field_index_native(texts,
+                             n_threads: Optional[int] = None
+                             ) -> Optional["FieldIndex"]:
+    """Build a FieldIndex with the C++ one-pass indexer (multithreaded —
+    the ctypes call drops the GIL and the shards tokenize on std::threads).
+    Returns None when the native library is unavailable (caller falls back
+    to Python)."""
     lib = load()
     if lib is None:
         return None
@@ -94,9 +115,10 @@ def build_field_index_native(texts) -> Optional["FieldIndex"]:
         doc_offsets[i + 1] = total
     buf = b"".join(parts)
 
-    handle = lib.sdb_build_index(
+    handle = lib.sdb_build_index_mt(
         buf, doc_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        len(texts))
+        len(texts),
+        ingest_threads() if n_threads is None else max(1, int(n_threads)))
     try:
         t_count = lib.sdb_num_terms(handle)
         p_len = lib.sdb_postings_len(handle)

@@ -78,3 +78,49 @@ def test_native_speedup(native_available):
     _python_build(docs, an)
     t_py = time.perf_counter() - t0
     assert t_nat < t_py, (t_nat, t_py)  # native must actually be faster
+
+
+def _assert_fi_equal(a, b):
+    assert list(a.terms) == list(b.terms)
+    np.testing.assert_array_equal(a.doc_freq, b.doc_freq)
+    np.testing.assert_array_equal(a.offsets, b.offsets)
+    np.testing.assert_array_equal(a.post_docs, b.post_docs)
+    np.testing.assert_array_equal(a.post_tfs, b.post_tfs)
+    np.testing.assert_array_equal(a.pos_offsets, b.pos_offsets)
+    np.testing.assert_array_equal(a.positions, b.positions)
+    np.testing.assert_array_equal(a.norms, b.norms)
+    assert a.total_tokens == b.total_tokens
+
+
+def test_parallel_build_matches_single_thread(native_available):
+    """ParallelSink analog: sharded multithreaded build must be byte-
+    identical to the 1-thread build (contiguous shards, in-order merge)."""
+    docs = make_docs(n=2000, seed=11)
+    one = build_field_index_native(docs, n_threads=1)
+    for nt in (2, 3, 4, 7):
+        mt = build_field_index_native(docs, n_threads=nt)
+        _assert_fi_equal(one, mt)
+
+
+def test_parallel_build_more_threads_than_docs(native_available):
+    docs = ["alpha beta", None, "beta gamma"]
+    one = build_field_index_native(docs, n_threads=1)
+    mt = build_field_index_native(docs, n_threads=16)
+    _assert_fi_equal(one, mt)
+
+
+def test_parallel_build_empty_and_null_heavy(native_available):
+    docs = [None, "", None, "", "x"] * 50
+    one = build_field_index_native(docs, n_threads=1)
+    mt = build_field_index_native(docs, n_threads=5)
+    _assert_fi_equal(one, mt)
+
+
+def test_ingest_threads_env(monkeypatch):
+    from serenedb_tpu.native import ingest_threads
+    monkeypatch.setenv("SDB_INGEST_THREADS", "3")
+    assert ingest_threads() == 3
+    monkeypatch.setenv("SDB_INGEST_THREADS", "bogus")
+    assert ingest_threads() >= 1
+    monkeypatch.delenv("SDB_INGEST_THREADS")
+    assert ingest_threads() >= 1
