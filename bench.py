@@ -725,9 +725,11 @@ def main() -> None:
             f.write("round-end bench run\n")
     except OSError:
         pass
-    # wait must exceed the ledger child timeout (480s) so an in-flight
-    # ledger dispatch always drains before we probe the device
-    _acquire_bench_lock(min(600.0, budget / 2))  # held till process exit
+    # wait should exceed the ledger child timeout (480s) so an in-flight
+    # ledger dispatch drains before we probe; with a small budget the
+    # wait is clipped and a lock miss is surfaced in the output instead
+    lock = _acquire_bench_lock(min(600.0, budget / 2))  # held till exit
+    lock_missed = lock is None
 
     # 1. liveness: retry across a possible transient outage, but keep at
     # least ~2/3 of the budget for the shapes themselves; scale the probe
@@ -751,6 +753,9 @@ def main() -> None:
     extras: dict[str, float] = {}
     errors: dict[str, str] = {}
     stale_shapes: list[str] = []
+    if lock_missed:
+        errors["lock"] = ("bench lock busy past the wait window: a "
+                          "ledger child may contend for the device")
     if not alive:
         errors["device"] = (
             f"device liveness probe failed {probes}x: {probe_err}")

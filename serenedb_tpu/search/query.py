@@ -11,6 +11,8 @@ libs/iresearch/parser/lucene_*.
 
 from __future__ import annotations
 
+import re as _re
+
 import numpy as np
 
 from .. import errors
@@ -41,7 +43,8 @@ def match_phrase_brute(texts: np.ndarray, phrases: np.ndarray) -> np.ndarray:
     return out
 
 
-def _phrase_in(an, text: str, groups: list[list[str]]) -> bool:
+def _phrase_in(an, text: str, groups: list[list[str]],
+               slop: int = 0) -> bool:
     if not groups:
         return False
     toks = an.tokenize(text)
@@ -59,8 +62,35 @@ def _phrase_in(an, text: str, groups: list[list[str]]) -> bool:
     if len(groups) == 1:
         return bool(first)
     rest = [positions(g) for g in groups[1:]]
-    return any(all((p + k) in ps for k, ps in enumerate(rest, 1))
-               for p in first)
+    if slop <= 0:
+        return any(all((p + k) in ps for k, ps in enumerate(rest, 1))
+                   for p in first)
+    return _sloppy_match(first, rest, slop)
+
+
+def _sloppy_match(first: set, rest: list[set], slop: int) -> bool:
+    """In-order slot positions with total extra gap <= slop: for each
+    start p0, greedily take the smallest admissible position per slot —
+    greedy is optimal here because a smaller current position never
+    shrinks the set of choices for later slots."""
+    for p0 in sorted(first):
+        prev = p0
+        budget = slop
+        ok = True
+        for k, ps in enumerate(rest, 1):
+            # smallest position > prev; gap beyond +1 eats budget
+            best = None
+            for p in ps:
+                if p > prev and (best is None or p < best):
+                    best = p
+            if best is None or (best - prev - 1) > budget:
+                ok = False
+                break
+            budget -= best - prev - 1
+            prev = best
+        if ok:
+            return True
+    return False
 
 
 # -- tsquery-style boolean query parsing ----------------------------------
@@ -78,11 +108,16 @@ class QPhrase(QNode):
     """Consecutive-position phrase. `groups` holds the alternatives at
     each position (synonym analyzers emit expansions at the same position,
     so one phrase slot may accept several terms); `terms` stays the flat
-    list for scoring."""
+    list for scoring.
 
-    def __init__(self, terms, groups=None):
+    `slop` relaxes adjacency the Lucene `"..."~N` way (approximated as:
+    slots must appear in order, total extra gap <= slop; Lucene's full
+    semantics also admit bounded reorders, which we do not)."""
+
+    def __init__(self, terms, groups=None, slop=0):
         self.terms = terms
         self.groups = groups if groups is not None else [[t] for t in terms]
+        self.slop = slop
 
 
 class QNothing(QNode):
@@ -159,8 +194,17 @@ def _qlex(q: str) -> list[str]:
         elif c == '"':
             j = q.find('"', i + 1)
             j = len(q) if j < 0 else j
-            out.append('"' + q[i + 1:j])
+            tok = '"' + q[i + 1:j] + '"'
             i = j + 1
+            # Lucene proximity: "..."~N
+            if i < len(q) and q[i] == "~":
+                k = i + 1
+                while k < len(q) and q[k].isdigit():
+                    k += 1
+                if k > i + 1:
+                    tok += q[i:k]
+                    i = k
+            out.append(tok)
         elif c == "/":
             # scan for the closing '/', honoring backslash escapes so
             # patterns may contain literal slashes (`/etc\/[a-z]+/`)
@@ -176,6 +220,31 @@ def _qlex(q: str) -> list[str]:
             out.append(q[i:j])
             i = j
     return out
+
+
+def _has_inner_wildcard(t: str) -> bool:
+    """Wildcard metachars anywhere but a single trailing `*` (which has a
+    faster QPrefix path)."""
+    return "?" in t or "*" in t
+
+
+_RX_META = set("\\^$.[]()*+?{}|/")
+
+
+def _wildcard_to_regex(t: str) -> str:
+    """Lucene wildcard token → anchored regex source: `*` → `.*`,
+    `?` → `.`, everything else literal."""
+    out = []
+    for c in t:
+        if c == "*":
+            out.append(".*")
+        elif c == "?":
+            out.append(".")
+        elif c in _RX_META:
+            out.append("\\" + c)
+        else:
+            out.append(c)
+    return "".join(out)
 
 
 def _folds_case(an) -> bool:
@@ -225,11 +294,20 @@ def _parse_unary(toks, an):
             rest = rest[1:]
         return node, rest
     if t.startswith('"'):
-        terms = [tok.term for tok in an.tokenize(t[1:])]
-        return QPhrase(terms), toks[1:]
+        body = t[1:]
+        slop = 0
+        close = body.rfind('"')
+        if close >= 0:
+            tail = body[close + 1:]
+            if tail.startswith("~") and tail[1:].isdigit():
+                slop = int(tail[1:])
+            body = body[:close]
+        terms = [tok.term for tok in an.tokenize(body)]
+        return QPhrase(terms, slop=slop), toks[1:]
     if t.startswith("/") and t.endswith("/") and len(t) > 1:
         return QRegex(t[1:-1], case_fold=_folds_case(an)), toks[1:]
-    if (t.endswith("*") or t.endswith(":*")) and len(t) > 1:
+    if (t.endswith("*") or t.endswith(":*")) and len(t) > 1 and \
+            not _has_inner_wildcard(t[:-1]):
         # Lucene-style `pre*` and PG tsquery `pre:*` both spell prefix.
         # Fold only when the analyzer folds bare terms: under keyword/
         # whitespace analyzers stored terms keep their case
@@ -237,6 +315,19 @@ def _parse_unary(toks, an):
         base = base.lower() if _folds_case(an) else base
         if base:
             return QPrefix(base), toks[1:]
+    if _has_inner_wildcard(t):
+        if set(t) <= {"*", "?"}:
+            # a bare `*` would expand the entire term dictionary; keep
+            # the pre-wildcard behavior (token contributes nothing)
+            return None, toks[1:]
+        # Lucene wildcards beyond trailing-star prefix (`te?t`, `t*e`,
+        # `*ing`) compile to an anchored term regex (the reference's
+        # by_wildcard filter is the same automaton machinery). A fuzzy
+        # suffix cannot combine with wildcards — strip it (ES drops it
+        # the same way).
+        base = _re.sub(r"~\d*$", "", t) or t
+        pat = _wildcard_to_regex(base.lower() if _folds_case(an) else base)
+        return QRegex(pat, case_fold=_folds_case(an)), toks[1:]
     if "~" in t and len(t) > 1:
         base, _, edits = t.partition("~")
         terms_f = [tok.term for tok in an.tokenize(base)]
@@ -262,7 +353,7 @@ def eval_query_on_text(node: QNode, an, text: str) -> bool:
         if isinstance(nd, QTerm):
             return nd.term in terms
         if isinstance(nd, QPhrase):
-            return _phrase_in(an, text, nd.groups)
+            return _phrase_in(an, text, nd.groups, nd.slop)
         if isinstance(nd, QNothing):
             return False
         if isinstance(nd, QAnd):

@@ -106,7 +106,7 @@ class SegmentSearcher:
         if isinstance(node, QRegex):
             return self._union_postings(self._regex_term_ids(node))
         if isinstance(node, QPhrase):
-            return self._eval_phrase(node.groups)
+            return self._eval_phrase(node.groups, node.slop)
         if isinstance(node, QNothing):
             return np.empty(0, dtype=np.int32)
         if isinstance(node, QAnd):
@@ -142,10 +142,13 @@ class SegmentSearcher:
         return np.unique(np.concatenate(parts)) if parts \
             else np.empty(0, dtype=np.int32)
 
-    def _eval_phrase(self, groups: list[list[str]]) -> np.ndarray:
+    def _eval_phrase(self, groups: list[list[str]],
+                     slop: int = 0) -> np.ndarray:
         """Phrase over per-position alternative groups: each slot is the
         union of its alternatives' postings (synonym expansions), slots
-        must land on consecutive doc positions."""
+        must land on consecutive doc positions — or, with slop > 0, in
+        order with total extra gap <= slop (Lucene `"..."~N`, minus its
+        bounded-reorder allowance; same contract as query._sloppy_match)."""
         if not groups:
             return np.empty(0, dtype=np.int32)
         gtids = [[t for t in (self.index.term_id(a) for a in g) if t >= 0]
@@ -167,6 +170,7 @@ class SegmentSearcher:
                     merged.setdefault(int(d), set()).update(
                         int(p) for p in ps)
             pos_maps.append(merged)
+        from .query import _sloppy_match
         out = []
         for d in cand:
             d = int(d)
@@ -176,8 +180,13 @@ class SegmentSearcher:
             rest = [pm.get(d) for pm in pos_maps[1:]]
             if any(r is None for r in rest):
                 continue
-            if any(all((p + k1) in rs for k1, rs in enumerate(rest, 1))
-                   for p in first):
+            if slop > 0:
+                hit = _sloppy_match(first, rest, slop)
+            else:
+                hit = any(all((p + k1) in rs
+                              for k1, rs in enumerate(rest, 1))
+                          for p in first)
+            if hit:
                 out.append(d)
         return np.asarray(out, dtype=np.int32)
 

@@ -359,26 +359,56 @@ class EsApi:
         if "knn" in body:
             return self._search_knn(index, body, size, from_)
         where, score_col = self._translate_query(body.get("query"))
+        multi_claims = score_col if isinstance(score_col, list) else None
         cols = '"_id", "_source"'
         order = ""
-        if score_col:
+        if score_col and multi_claims is None:
             cols += f", {score_col} AS _score"
             order = " ORDER BY _score DESC"
         sort = body.get("sort")
         if sort:
             order = " ORDER BY " + ", ".join(_sort_clause(s) for s in sort)
+            multi_claims = None     # explicit sort: no score ordering
         sql = f'SELECT {cols} FROM "{index}"'
         if where:
             sql += f" WHERE {where}"
-        sql += order + f" LIMIT {size} OFFSET {from_}"
-        res = self.conn.execute(sql)
-        total_sql = f'SELECT count(*) FROM "{index}"'
-        if where:
-            total_sql += f" WHERE {where}"
-        total = int(self.conn.execute(total_sql).scalar())
+        if multi_claims is not None:
+            # multi-field scoring, rank-first (Lucene BooleanQuery: doc
+            # score = sum of its matching clauses' scores): one scored
+            # pass per claim builds the score map, every WHERE match is
+            # ranked globally, then only the page's _source is fetched.
+            scores: dict[str, float] = {}
+            for f, w, pred in multi_claims:
+                pass_sql = (f'SELECT "_id", bm25({_ident(f)}) '
+                            f'FROM "{index}" WHERE {pred}')
+                for did, sc in self.conn.execute(pass_sql).rows():
+                    if sc:
+                        scores[did] = scores.get(did, 0.0) + w * float(sc)
+            id_sql = f'SELECT "_id" FROM "{index}"'
+            if where:
+                id_sql += f" WHERE {where}"
+            all_ids = [r[0] for r in self.conn.execute(id_sql).rows()]
+            total = len(all_ids)
+            all_ids.sort(key=lambda d: (-scores.get(d, 0.0), d))
+            page = all_ids[from_:from_ + size]
+            rows = []
+            if page:
+                lits = ", ".join(_sql_str(d) for d in page)
+                src = dict(self.conn.execute(
+                    f'SELECT "_id", "_source" FROM "{index}" '
+                    f'WHERE "_id" IN ({lits})').rows())
+                rows = [(d, src.get(d), scores.get(d, 0.0)) for d in page]
+            score_col = "multi"
+        else:
+            sql += order + f" LIMIT {size} OFFSET {from_}"
+            rows = list(self.conn.execute(sql).rows())
+            total_sql = f'SELECT count(*) FROM "{index}"'
+            if where:
+                total_sql += f" WHERE {where}"
+            total = int(self.conn.execute(total_sql).scalar())
         hits = []
         max_score = 0.0
-        for row in res.rows():
+        for row in rows:
             score = float(row[2]) if score_col and len(row) > 2 and \
                 row[2] is not None else 1.0
             max_score = max(max_score, score)
@@ -708,9 +738,9 @@ class EsApi:
         translation state."""
         if q is None:
             return "", None
-        score_fields: list[str] = []
+        score_fields: list = []     # (field, boost, predicate_sql) triples
         where = self._tr(q, score_fields)
-        score = f'bm25({_ident(score_fields[0])})' if score_fields else None
+        score = _score_expr(score_fields)
         return where, score
 
     def _tr(self, q: dict, score_fields: list[str]) -> str:
@@ -726,22 +756,35 @@ class EsApi:
                   else "or").lower()
             terms = [w for w in re.findall(r"\w+", str(text))]
             joiner = " & " if op == "and" else " | "
-            score_fields.append(field)
-            return _ts_query(field, joiner.join(terms) or '""')
+            pred = _ts_query(field, joiner.join(terms) or '""')
+            score_fields.append((field, 1.0, pred))
+            return pred
         if kind == "match_phrase":
             field, spec = next(iter(body.items()))
             text = spec.get("query") if isinstance(spec, dict) else spec
-            score_fields.append(field)
-            return f'{_ident(field)} ## {_sql_str(str(text))}'
+            pred = f'{_ident(field)} ## {_sql_str(str(text))}'
+            score_fields.append((field, 1.0, pred))
+            return pred
         if kind == "query_string":
             field = body.get("default_field", "_all")
             query = body.get("query", "")
-            lucene = _lucene_to_tsquery(str(query))
             if field == "_all":
                 raise EsError(400, "parsing_exception",
-                              "query_string requires default_field (v1)")
-            score_fields.append(field)
-            return _ts_query(field, lucene)
+                              "query_string requires default_field")
+            from ..search.lucene import (LuceneError, lower_to_sql,
+                                         parse_lucene)
+            try:
+                ast = parse_lucene(
+                    str(query),
+                    str(body.get("default_operator", "OR")))
+                sql, claims = lower_to_sql(ast, field, _ident)
+            except LuceneError as e:
+                raise EsError(400, "parsing_exception", str(e))
+            # boost-weighted score claims: each scoring text leaf carries
+            # its own predicate, so multi-field queries can score via
+            # per-claim passes (Lucene: score = sum of matching clauses)
+            score_fields.extend(claims)
+            return sql
         if kind == "term":
             field, spec = next(iter(body.items()))
             value = spec.get("value") if isinstance(spec, dict) else spec
@@ -769,13 +812,16 @@ class EsApi:
             if shoulds:
                 clauses.append("(" + " OR ".join(shoulds) + ")")
             for must_not in _as_list(body.get("must_not")):
-                clauses.append(f"NOT ({self._tr(must_not, score_fields)})")
+                # prohibited clauses never score (ES occur semantics) —
+                # and must not drag their fields into the multi-claim path
+                clauses.append(f"NOT ({self._tr(must_not, [])})")
             return "(" + " AND ".join(clauses) + ")" if clauses else "TRUE"
         if kind == "prefix":
             field, spec = next(iter(body.items()))
             value = spec.get("value") if isinstance(spec, dict) else spec
-            score_fields.append(field)
-            return _ts_query(field, f"{value}*")
+            pred = _ts_query(field, f"{value}*")
+            score_fields.append((field, 1.0, pred))
+            return pred
         if kind == "ids":
             lits = ", ".join(_sql_lit(v) for v in body.get("values", []))
             return f'"_id" IN ({lits})'
@@ -941,14 +987,24 @@ def _sort_clause(s) -> str:
     return f'{_ident(field)} {str(order).upper()}'
 
 
-def _lucene_to_tsquery(q: str) -> str:
-    """Lucene-ish query string → our tsquery syntax (AND/OR/NOT keywords)."""
-    out = q
-    out = re.sub(r"\bAND\b", "&", out)
-    out = re.sub(r"\bOR\b", "|", out)
-    out = re.sub(r"\bNOT\b", "!", out)
-    out = out.replace("+", "").replace("-", "!")
-    return out
+def _score_expr(score_fields: list):
+    """Scoring plan from (field, boost, predicate) text claims.
+
+    One distinct field → a SQL score expression (`bm25(f) [* w]`) the
+    engine evaluates inline, pushing top-k into the index scan. Several
+    fields → the claims list itself: the caller runs one scored pass per
+    claim and sums weighted scores per doc (Lucene: a document's score
+    is the sum of its matching clauses' scores; bm25() on a cross-field
+    scan would be unclaimable and evaluate to 0)."""
+    if not score_fields:
+        return None
+    fields = {f for f, _, _ in score_fields}
+    if len(fields) == 1:
+        f = next(iter(fields))
+        w = max(b for _, b, _ in score_fields)
+        term = f"bm25({_ident(f)})"
+        return f"{term} * {w!r}" if w != 1.0 else term
+    return list(score_fields)
 
 
 def _value_sql_type(v) -> dt.SqlType:

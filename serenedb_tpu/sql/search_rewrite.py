@@ -451,6 +451,17 @@ def _conjuncts(e: BoundExpr) -> list[BoundExpr]:
 
 def _to_qnode(e: BoundExpr, col_idx: int, analyzer) -> Optional[QNode]:
     from .expr import BoundLiteral
+    if isinstance(e, BoundFunc) and e.name == "or":
+        # same-column disjunction of ts predicates claims as QOr (the ES
+        # query_string path emits these; Lucene BooleanQuery SHOULD).
+        # NULL-safe: a NULL document matches no branch under both the
+        # index eval and SQL three-valued OR. Cross-column disjunctions
+        # stay unclaimed (scoring would need multi-index evaluation).
+        from ..search.query import QOr
+        subs = [_to_qnode(a, col_idx, analyzer) for a in e.args]
+        if subs and all(s is not None for s in subs):
+            return QOr(subs)
+        return None
     if not (isinstance(e, BoundFunc) and e.name in _TS_FUNCS and
             len(e.args) == 2):
         return None

@@ -1,0 +1,320 @@
+"""Lucene query-string parser (search/lucene.py) + ES query_string
+end-to-end.
+
+Reference analog: libs/iresearch/include/iresearch/parser/lucene_parser
+— boosts, field groups, ranges, occurs (+/-), fuzzy, proximity,
+wildcards, escapes.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from serenedb_tpu.search.lucene import (LBool, LMatchAll, LPhrase, LRange,
+                                        LRegex, LTerm, LuceneError,
+                                        lower_to_sql, parse_lucene)
+
+
+def qi(name):
+    return '"' + name + '"'
+
+
+# ------------------------------------------------------------ parse only
+
+def test_single_term():
+    n = parse_lucene("hello")
+    assert isinstance(n, LTerm) and n.text == "hello" and n.boost == 1.0
+
+
+def test_default_operator_or_and():
+    n = parse_lucene("a b")
+    assert isinstance(n, LBool) and n.occur == ["", ""]
+    n = parse_lucene("a b", default_operator="AND")
+    assert isinstance(n, LBool) and n.occur == ["+", "+"]
+
+
+def test_explicit_and_requires_both_sides():
+    n = parse_lucene("a AND b")
+    assert isinstance(n, LBool) and n.occur == ["+", "+"]
+
+
+def test_or_groups():
+    n = parse_lucene("a OR b OR c")
+    assert isinstance(n, LBool) and len(n.clauses) == 3
+    assert all(o == "" for o in n.occur)
+
+
+def test_plus_minus_not():
+    n = parse_lucene("+must -banned plain")
+    assert n.occur == ["+", "-", ""]
+    n2 = parse_lucene("NOT x")
+    assert n2.occur == ["-"] if isinstance(n2, LBool) else True
+
+
+def test_boost():
+    n = parse_lucene("title:fox^2.5")
+    assert isinstance(n, LTerm) and n.field == "title" and n.boost == 2.5
+
+
+def test_field_group():
+    n = parse_lucene("title:(quick OR brown)")
+    assert isinstance(n, LBool)
+    assert all(c.field == "title" for c in n.clauses)
+
+
+def test_field_group_does_not_override_inner_field():
+    n = parse_lucene("a:(x OR b:y)")
+    assert n.clauses[0].field == "a"
+    assert n.clauses[1].field == "b"
+
+
+def test_phrase_and_slop():
+    n = parse_lucene('"quick fox"')
+    assert isinstance(n, LPhrase) and n.slop == 0
+    n = parse_lucene('"quick fox"~3')
+    assert n.slop == 3
+
+
+def test_fuzzy():
+    n = parse_lucene("roam~")
+    assert isinstance(n, LTerm) and n.fuzzy == 1
+    n = parse_lucene("roam~2")
+    assert n.fuzzy == 2
+
+
+def test_ranges():
+    n = parse_lucene("pages:[100 TO 200]")
+    assert isinstance(n, LRange)
+    assert (n.lo, n.hi, n.incl_lo, n.incl_hi) == ("100", "200", True, True)
+    n = parse_lucene("pages:{100 TO 200}")
+    assert (n.incl_lo, n.incl_hi) == (False, False)
+    n = parse_lucene("pages:[* TO 200}")
+    assert n.lo is None and n.incl_hi is False
+    n = parse_lucene("date:[2020-01-01 TO 2020-12-31]")
+    assert n.lo == "2020-01-01"
+    n = parse_lucene("delta:[-5 TO 5]")
+    assert n.lo == "-5"
+
+
+def test_wildcards_and_regex():
+    n = parse_lucene("te?t")
+    assert isinstance(n, LTerm) and n.text == "te?t"
+    n = parse_lucene("/fo[xo]/")
+    assert isinstance(n, LRegex) and n.pattern == "fo[xo]"
+
+
+def test_hyphen_inside_word_is_literal():
+    n = parse_lucene("state-of-the-art")
+    assert isinstance(n, LTerm) and n.text == "state-of-the-art"
+
+
+def test_escapes():
+    n = parse_lucene(r"foo\:bar")
+    assert isinstance(n, LTerm) and n.text == "foo:bar"
+
+
+def test_match_all():
+    assert isinstance(parse_lucene("*"), LMatchAll)
+    assert isinstance(parse_lucene(""), LMatchAll)
+
+
+def test_parse_errors():
+    with pytest.raises(LuceneError):
+        parse_lucene("(a OR b")
+    with pytest.raises(LuceneError):
+        parse_lucene("pages:[1 200]")
+    with pytest.raises(LuceneError):
+        parse_lucene("a AND")
+
+
+# -------------------------------------------------------------- lowering
+
+def test_lower_term_and_range():
+    sql, claims = lower_to_sql(
+        parse_lucene("title:fox AND pages:[10 TO 20]"), "body", qi)
+    assert '"title" @@ \'fox\'' in sql
+    assert '"pages" >= 10.0' in sql and '"pages" <= 20.0' in sql
+    assert [(f, b) for f, b, _ in claims] == [("title", 1.0)]
+    assert claims[0][2] == '"title" @@ \'fox\''
+
+
+def test_lower_boost_claims():
+    _, claims = lower_to_sql(parse_lucene("title:a^3 body:b"), "body", qi)
+    pairs = [(f, b) for f, b, _ in claims]
+    assert ("title", 3.0) in pairs and ("body", 1.0) in pairs
+
+
+def test_lower_must_not_never_claims():
+    _, claims = lower_to_sql(parse_lucene("title:a -body:b"), "body", qi)
+    assert [f for f, _, _ in claims] == ["title"]
+
+
+def test_lower_field_star_is_exists():
+    sql, claims = lower_to_sql(parse_lucene("title:* AND x"), "f", qi)
+    assert '"title" IS NOT NULL' in sql
+    assert [f for f, _, _ in claims] == ["f"]
+
+
+def test_lower_should_with_must_is_scoring_only():
+    sql, _ = lower_to_sql(parse_lucene("+a b"), "f", qi)
+    # must present -> should dropped from the filter
+    assert sql.count("@@") >= 1
+    assert "'b'" not in sql
+
+
+def test_lower_prohibit():
+    sql, _ = lower_to_sql(parse_lucene("a -b"), "f", qi)
+    assert "NOT (" in sql
+
+
+def test_lower_slop_phrase_and_fuzzy():
+    sql, _ = lower_to_sql(parse_lucene('"a b"~2 x~1'), "f", qi)
+    assert '"a b"~2' in sql and "x~1" in sql
+
+
+# ------------------------------------------------- end-to-end over HTTP
+
+def _put(srv, path, body):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT")
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.server.http_server import HttpServer
+    db = Database()
+    s = HttpServer(db, port=0)
+    s.start()
+    docs = [
+        (1, "quick brown fox", "the quick brown fox jumps", 100),
+        (2, "lazy dog", "a lazy dog sleeps all day", 150),
+        (3, "quick dog", "the quick dog runs far away", 200),
+        (4, "brown bear", "a big brown bear eats honey", 250),
+    ]
+    for i, title, body, pages in docs:
+        _put(s, f"/lqs/_doc/{i}", {"id": i, "title": title,
+                                   "body": body, "pages": pages})
+    yield s
+    s.stop()
+
+
+def search(srv, q):
+    body = json.dumps({"query": q, "size": 10}).encode()
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/lqs/_search", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        out = json.loads(resp.read().decode())
+    return sorted(int(h["_source"]["id"])
+                  for h in out["hits"]["hits"]), out
+
+
+def test_e2e_simple_term(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "quick"}})
+    assert ids == [1, 3]
+
+
+def test_e2e_boolean_and_field(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body",
+        "query": "title:quick AND body:runs"}})
+    assert ids == [3]
+
+
+def test_e2e_default_operator_and(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "quick fox",
+        "default_operator": "AND"}})
+    assert ids == [1]
+
+
+def test_e2e_prohibit(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "quick -fox"}})
+    assert ids == [3]
+
+
+def test_e2e_range(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "pages:[150 TO 250}"}})
+    assert ids == [2, 3]
+
+
+def test_e2e_phrase_slop(srv):
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": '"quick jumps"'}})
+    assert ids == []
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": '"quick jumps"~2'}})
+    assert ids == [1]
+
+
+def test_e2e_wildcard_and_fuzzy(srv):
+    # wildcards match ANALYZED terms (stemmed): d?g -> 'dog'
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "d?g"}})
+    assert ids == [2, 3]
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "b*wn"}})
+    assert ids == [1, 4]
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "quikc~2"}})
+    assert ids == [1, 3]
+
+
+def test_e2e_field_group_with_boost_scores(srv):
+    ids, out = search(srv, {"query_string": {
+        "default_field": "body", "query": "title:(fox^5 OR dog)"}})
+    assert ids == [1, 2, 3]
+    # same-column OR is index-claimed, so scores are real (nonzero) and
+    # the 5x fox boost must put doc 1 on top
+    top = out["hits"]["hits"][0]
+    assert int(top["_source"]["id"]) == 1
+    assert top["_score"] > 0
+
+
+def test_e2e_parse_error_is_400(srv):
+    import urllib.error
+    body = json.dumps({"query": {"query_string": {
+        "default_field": "body", "query": "(broken"}}}).encode()
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/lqs/_search", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(r, timeout=30)
+    assert ei.value.code == 400
+
+
+def test_e2e_multifield_scoring(srv):
+    """Cross-field OR must produce real summed scores (not 0.0) via the
+    per-claim scoring passes."""
+    ids, out = search(srv, {"query_string": {
+        "default_field": "body", "query": "title:bear OR jumps"}})
+    assert ids == [1, 4]
+    for h in out["hits"]["hits"]:
+        assert h["_score"] > 0, h
+    # doc 4 matches on the boosted field when boosted -> outranks doc 1
+    ids, out = search(srv, {"query_string": {
+        "default_field": "body", "query": "title:bear^20 OR jumps"}})
+    assert int(out["hits"]["hits"][0]["_source"]["id"]) == 4
+
+
+def test_e2e_wildcard_fuzzy_combo_is_wildcard(srv):
+    # `d?g~2` — fuzzy cannot combine with wildcards; the suffix drops
+    ids, _ = search(srv, {"query_string": {
+        "default_field": "body", "query": "d?g~2"}})
+    assert ids == [2, 3]
+
+
+def test_float_fuzziness_legacy():
+    n = parse_lucene("title:foo~0.8", default_operator="AND")
+    assert isinstance(n, LTerm) and n.fuzzy == 1 and n.field == "title"
+    n = parse_lucene('"a b"~1.5')
+    assert isinstance(n, LPhrase) and n.slop == 1
