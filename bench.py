@@ -659,21 +659,32 @@ def ledger_main(shape_names: list[str]) -> None:
     alive, _, err = _probe_device(75.0)
     if not alive:
         # host-only shapes don't need the device — capture them, but only
-        # when the ledger lacks a reasonably fresh entry (each attempt
-        # costs real CPU; don't starve the build host every cycle)
-        import datetime
+        # when the ledger entry is missing, stale (>6h) or from another
+        # commit (each attempt costs real CPU on the build host)
         led = _load_ledger()["entries"]
+        head = _git_head()
 
         def fresh(n: str) -> bool:
             try:
+                if head and led[n].get("git") != head:
+                    return False
                 ts = datetime.datetime.fromisoformat(led[n]["ts"])
                 age = datetime.datetime.now(datetime.timezone.utc) - ts
                 return age.total_seconds() < 6 * 3600
             except (KeyError, TypeError, ValueError):
                 return False
 
-        names = [n for n in names if n in HOST_SHAPES and not fresh(n)]
+        host_stale = [n for n in names
+                      if n in HOST_SHAPES and not fresh(n)]
+        host_fresh = [n for n in names if n in HOST_SHAPES and fresh(n)]
+        names = host_stale
         if not names:
+            if host_fresh:
+                # nonzero exit keeps the loop on the short retry cadence
+                # so a tunnel-up moment is still caught quickly
+                print(json.dumps({"ledger": "fresh", "skipped": host_fresh,
+                                  "device_error": err}), flush=True)
+                sys.exit(3)
             print(json.dumps({"ledger": "device-down", "error": err}),
                   flush=True)
             sys.exit(3)

@@ -77,12 +77,42 @@ SV_STOPWORDS = frozenset(
 FI_STOPWORDS = frozenset(
     "ei en että he hän ja jo jos kanssa kun me mikä minä mutta myös ne "
     "niin nyt ole oli on ovat se sen siellä sinä tai tämä vain voi".split())
+DA_STOPWORDS = frozenset(
+    "af alle at blev da de dem den denne der deres det dette dig din dog "
+    "du efter eller en end er et for fra ham han hans har havde have hun "
+    "hvad hvis hvor i ikke ind jeg jer kan man med meget men mig min "
+    "mine mit nogle nu når og også om op os over på selv sig skal skulle "
+    "som sådan thi til ud under var vi vil ville vor være været".split())
+NO_STOPWORDS = frozenset(
+    "alle at av da de deg den denne der dere deres det dette du eller en "
+    "er et etter for fra ha hadde han hans har hun hva hvis hvor i ikke "
+    "jeg kan man med meg men mer min mitt mot noe noen nå og også om opp "
+    "oss over på seg selv sin sitt skal skulle som så til ut var vi vil "
+    "ville vår være vært".split())
+RO_STOPWORDS = frozenset(
+    "acea aceasta această al ale am ar are as au că ce cel cu da dar de "
+    "din dintre doar după ei el ele este eu fi fie fost iar în între la "
+    "le lor lui mai mult nu o ori pe pentru prin sa să sau se si și sunt "
+    "tot un una unei unui va voi vor".split())
+TR_STOPWORDS = frozenset(
+    "acaba ama ancak bana bazı belki ben beni bir biri birkaç biz bu "
+    "çok çünkü da daha de defa diye en gibi hem hep hepsi her hiç için "
+    "ile ise kez ki kim mi mu mü nasıl ne neden nerde nerede nereye niye "
+    "o sanki şey siz şu tüm ve veya ya yani".split())
+HU_STOPWORDS = frozenset(
+    "a az abban ahhoz ahogy aki akik akkor amely amelyek ami amit arra "
+    "azok azonban be csak de e egy egyéb egyik el ez ezek ezen ezt fel "
+    "hogy ha hanem hiszen igen ill illetve is ki le lehet maga más meg "
+    "mert mi mint mintha nem nincs olyan ott össze pedig s saját sem "
+    "semmi sok szerint szinte talán úgy új vagy van volt".split())
 
 STOPWORDS_BY_LANG = {
     "en": EN_STOPWORDS, "de": DE_STOPWORDS, "fr": FR_STOPWORDS,
     "es": ES_STOPWORDS, "ru": RU_STOPWORDS, "it": IT_STOPWORDS,
     "pt": PT_STOPWORDS, "nl": NL_STOPWORDS, "sv": SV_STOPWORDS,
-    "fi": FI_STOPWORDS,
+    "fi": FI_STOPWORDS, "da": DA_STOPWORDS, "no": NO_STOPWORDS,
+    "nb": NO_STOPWORDS, "nn": NO_STOPWORDS, "ro": RO_STOPWORDS,
+    "tr": TR_STOPWORDS, "hu": HU_STOPWORDS,
 }
 
 
@@ -574,6 +604,100 @@ class MinHashAnalyzer(Analyzer):
                 for i, h in enumerate(hashes)]
 
 
+class ClassificationAnalyzer(Analyzer):
+    """Model-backed classification analyzer (reference:
+    analysis/classification_stream.cpp — fastText emits the model's
+    top-k predicted labels as tokens). The model here is a centroid
+    classifier over the deterministic local char-trigram embedding
+    (functions/embedfns.local_embed): each label's centroid is the mean
+    embedding of its example texts (the label name itself is always
+    included, so querying by label is stable). tokenize() emits the
+    top-k label names as tokens."""
+
+    name = "classification"
+
+    def __init__(self, labels: dict[str, str], top: int = 1,
+                 dim: int = 64):
+        import numpy as _np
+
+        from ..functions.embedfns import local_embed
+        if not labels:
+            raise errors.SqlError(
+                "22023", "classification tokenizer needs labels")
+        self._embed = local_embed
+        self.top = max(1, int(top))
+        self.dim = int(dim)
+        self.label_names = sorted(labels)
+        cents = []
+        for lab in self.label_names:
+            examples = [lab] + [w for w in str(labels[lab]).split() if w]
+            m = _np.stack([local_embed(e, self.dim) for e in examples])
+            c = m.mean(axis=0)
+            n = float((c * c).sum()) ** 0.5
+            cents.append(c / n if n > 0 else c)
+        self._centroids = _np.stack(cents)
+
+    def classify(self, text: str) -> list[str]:
+        sims = self._centroids @ self._embed(text, self.dim)
+        order = sims.argsort()[::-1][: self.top]
+        return [self.label_names[i] for i in order]
+
+    def tokenize(self, text: str) -> list[Token]:
+        if not text or not text.strip():
+            return []
+        return [Token(lab, i, 0, len(text))
+                for i, lab in enumerate(self.classify(text))]
+
+
+class NearestNeighborsAnalyzer(Analyzer):
+    """Model-backed term-expansion analyzer (reference:
+    analysis/nearest_neighbors_stream.cpp — fastText emits each token's
+    nearest model terms). Vocabulary words are embedded with the local
+    char-trigram model; each input token (tokenized by `inner`) is
+    replaced by its top-k nearest vocabulary terms, emitted at the
+    token's position (synonym-style expansion)."""
+
+    name = "nearest_neighbors"
+
+    def __init__(self, vocab: list[str], top: int = 2, dim: int = 64,
+                 inner: Optional[Analyzer] = None):
+        import numpy as _np
+
+        from ..functions.embedfns import local_embed
+        vocab = [w for w in vocab if w]
+        if not vocab:
+            raise errors.SqlError(
+                "22023", "nearest_neighbors tokenizer needs a vocabulary")
+        self._embed = local_embed
+        self.top = max(1, int(top))
+        self.dim = int(dim)
+        self.inner = inner or SimpleTextAnalyzer()
+        self.vocab = sorted(set(w.lower() for w in vocab))
+        self._matrix = _np.stack(
+            [local_embed(w, self.dim) for w in self.vocab])
+        self._memo: dict[str, list[str]] = {}
+
+    def neighbors(self, term: str) -> list[str]:
+        # terms repeat heavily (Zipf) and this sits on the ingest hot
+        # path — memoize per distinct term
+        hit = self._memo.get(term)
+        if hit is not None:
+            return hit
+        sims = self._matrix @ self._embed(term, self.dim)
+        order = sims.argsort()[::-1][: self.top]
+        out = [self.vocab[i] for i in order]
+        if len(self._memo) < 1_000_000:
+            self._memo[term] = out
+        return out
+
+    def tokenize(self, text: str) -> list[Token]:
+        out = []
+        for t in self.inner.tokenize(text):
+            for nb in self.neighbors(t.term):
+                out.append(Token(nb, t.position, t.start, t.end))
+        return out
+
+
 _BUILTINS: dict[str, Callable[[], Analyzer]] = {
     "keyword": KeywordAnalyzer,
     "whitespace": WhitespaceAnalyzer,
@@ -592,7 +716,8 @@ _BUILTINS: dict[str, Callable[[], Analyzer]] = {
 }
 # locale text analyzers: text_en … text_fi (reference registers per-locale
 # text tokenizers the same way)
-for _lang in ("en", "de", "fr", "es", "it", "pt", "nl", "ru", "sv", "fi"):
+for _lang in ("en", "de", "fr", "es", "it", "pt", "nl", "ru", "sv", "fi",
+              "da", "no", "ro", "tr", "hu"):
     _BUILTINS[f"text_{_lang}"] = (
         lambda _l=_lang: TextAnalyzer(locale=_l))
 
@@ -606,6 +731,8 @@ _KNOWN_DICT_OPTIONS = {
     "delimiter", "delimiters", "locale", "case", "break", "pattern",
     "mode", "synonyms", "stages", "analyzers", "hashes", "shingle",
     "reverse", "analyzer",
+    # model-backed analyzers
+    "labels", "top", "vocab", "dim",
     # accepted reference options that are defaults/no-ops here
     "frequency", "position", "norm",
 }
@@ -706,6 +833,29 @@ def register_dictionary(name: str, options: dict,
             k=int(options.get("hashes", 32)),
             inner=get_analyzer(str(options.get("analyzer", "simple"))),
             shingle=int(options.get("shingle", 3)))
+    elif template == "classification":
+        raw = options.get("labels", "")
+        labels: dict[str, str] = {}
+        if isinstance(raw, dict):
+            labels = {str(k).strip().lower(): str(v)
+                      for k, v in raw.items()}
+        else:
+            # "sports: football goal; tech: compiler kernel"
+            for part in re.split(r"[;\n]", str(raw)):
+                lab, _, examples = part.partition(":")
+                if lab.strip():
+                    labels[lab.strip().lower()] = examples.strip()
+        a = ClassificationAnalyzer(labels,
+                                   top=int(options.get("top", 1)),
+                                   dim=int(options.get("dim", 64)))
+    elif template == "nearest_neighbors":
+        raw = options.get("vocab", "")
+        vocab = ([str(w) for w in raw] if isinstance(raw, (list, tuple))
+                 else re.split(r"[\s,;]+", str(raw)))
+        a = NearestNeighborsAnalyzer(
+            vocab, top=int(options.get("top", 2)),
+            dim=int(options.get("dim", 64)),
+            inner=get_analyzer(str(options.get("analyzer", "simple"))))
     else:
         raise errors.SqlError(errors.UNDEFINED_OBJECT,
                               f'tokenizer template "{template}" does not '

@@ -296,6 +296,60 @@ def stem_fi(w: str) -> str:
             "na", "a", "i", "t", "n"), 3)
 
 
+def stem_da(w: str) -> str:
+    w = w.replace("æ", "a").replace("ø", "o").replace("å", "a")
+    return _strip_suffixes(
+        w, ("hederne", "erende", "hedens", "heder", "heden", "endes",
+            "erede", "ernes", "erens", "erets", "ande", "ende", "erne",
+            "eres", "eren", "eret", "enes", "ene", "ens", "ers", "ets",
+            "en", "er", "es", "et", "e", "s"), 3)
+
+
+def stem_no(w: str) -> str:
+    w = w.replace("æ", "a").replace("ø", "o").replace("å", "a")
+    return _strip_suffixes(
+        w, ("hetene", "hetens", "endes", "heter", "heten", "ande",
+            "ende", "edes", "enes", "erte", "ede", "ane", "ene", "ens",
+            "ers", "ets", "ert", "et", "en", "ar", "er", "as", "es",
+            "a", "e", "s"), 3)
+
+
+def stem_ro(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    return _strip_suffixes(
+        w, ("abilitate", "ibilitate", "ivitate", "atoare", "urilor",
+            "itate", "atori", "iune", "iuni", "ator", "ilor", "elor",
+            "ism", "ist", "ului", "uri", "ul", "ea", "ele", "ie", "ii",
+            "le", "a", "e", "i", "u"), 4)
+
+
+def stem_tr(w: str) -> str:
+    # Turkish-specific letters fold for matching (ı has no combining
+    # mark, so the analyzer's NFD accent fold does not catch it)
+    w = (w.replace("ı", "i").replace("ğ", "g").replace("ş", "s")
+          .replace("ç", "c").replace("ö", "o").replace("ü", "u"))
+    return _strip_suffixes(
+        w, ("larindan", "lerinden", "larinda", "lerinde", "larin",
+            "lerin", "lardan", "lerden", "larda", "lerde", "lari",
+            "leri", "lar", "ler", "dan", "den", "tan", "ten", "nin",
+            "nun", "da", "de", "ta", "te", "in", "un", "i", "u", "a",
+            "e"), 3)
+
+
+def stem_hu(w: str) -> str:
+    import unicodedata
+    w = "".join(c for c in unicodedata.normalize("NFD", w)
+                if not unicodedata.combining(c))
+    return _strip_suffixes(
+        w, ("sagok", "segek", "saga", "sege", "eket", "akat", "okat",
+            "knak", "knek", "sag", "seg", "val", "vel", "ban", "ben",
+            "nak", "nek", "bol", "tol", "rol", "hoz", "hez", "ott",
+            "ok", "ek", "ak", "at", "et", "ot", "ni", "va", "ve", "k",
+            "t", "a", "e", "o"), 3)
+
+
 STEMMERS = {
     "en": porter2, "english": porter2,
     "de": stem_de, "german": stem_de,
@@ -307,6 +361,11 @@ STEMMERS = {
     "ru": stem_ru, "russian": stem_ru,
     "sv": stem_sv, "swedish": stem_sv,
     "fi": stem_fi, "finnish": stem_fi,
+    "da": stem_da, "danish": stem_da,
+    "no": stem_no, "nb": stem_no, "nn": stem_no, "norwegian": stem_no,
+    "ro": stem_ro, "romanian": stem_ro,
+    "tr": stem_tr, "turkish": stem_tr,
+    "hu": stem_hu, "hungarian": stem_hu,
 }
 
 

@@ -232,3 +232,92 @@ def test_locale_dictionary_option(conn):
     # stopword never indexed
     assert conn.execute("SELECT id FROM fr_docs WHERE body ## 'les'"
                         ).rows() == []
+
+
+def test_classification_analyzer():
+    from serenedb_tpu.search.analysis import (drop_dictionary,
+                                              register_dictionary)
+    a = register_dictionary("t_cls", {
+        "template": "classification",
+        "labels": "sports: football basketball goalkeeper; "
+                  "tech: compiler software kernel"})
+    try:
+        assert [t.term for t in a.tokenize("football match")] == ["sports"]
+        assert [t.term for t in a.tokenize("compiler bug")] == ["tech"]
+        # label names classify to themselves (centroid includes the label)
+        assert [t.term for t in a.tokenize("sports")] == ["sports"]
+        assert a.tokenize("") == []
+        # top=2 emits both labels, best first
+        b = register_dictionary("t_cls2", {
+            "template": "classification", "top": 2,
+            "labels": "sports: football; tech: compiler"})
+        terms = [t.term for t in b.tokenize("football")]
+        assert terms[0] == "sports" and sorted(terms) == ["sports", "tech"]
+    finally:
+        drop_dictionary("t_cls")
+        drop_dictionary("t_cls2")
+
+
+def test_classification_requires_labels():
+    import pytest as _pytest
+
+    from serenedb_tpu import errors
+    from serenedb_tpu.search.analysis import register_dictionary
+    with _pytest.raises(errors.SqlError):
+        register_dictionary("t_cls3", {"template": "classification"})
+
+
+def test_nearest_neighbors_analyzer():
+    from serenedb_tpu.search.analysis import (drop_dictionary,
+                                              register_dictionary)
+    a = register_dictionary("t_nn", {
+        "template": "nearest_neighbors", "top": 1,
+        "vocab": "football basketball compiler software kernel"})
+    try:
+        # typo maps to its orthographic nearest vocabulary term
+        assert [t.term for t in a.tokenize("footbal")] == ["football"]
+        out = a.tokenize("compilr kernel")
+        assert [(t.term, t.position) for t in out] == \
+            [("compiler", 0), ("kernel", 1)]
+    finally:
+        drop_dictionary("t_nn")
+
+
+def test_new_locale_stemmers():
+    from serenedb_tpu.search.stemmers import (stem_da, stem_hu, stem_no,
+                                              stem_ro, stem_tr,
+                                              stemmer_for)
+    # each language: inflected forms collapse onto one stem
+    assert stem_da("hastighederne") == stem_da("hastigheden")
+    assert stem_no("hemmeligheten") == stem_no("hemmelighetene")
+    assert stem_ro("abilitățile")[:7] == stem_ro("abilității")[:7]
+    assert stem_tr("kitaplardan") == stem_tr("kitaplar")
+    assert stem_hu("szabadságok") == stem_hu("szabadság")
+    for loc in ("da", "no", "nb", "ro", "tr", "hu", "danish", "turkish"):
+        assert stemmer_for(loc) is not None
+
+
+def test_new_locale_text_analyzers():
+    from serenedb_tpu.search.analysis import get_analyzer
+    for lang, stop, keep in [
+        ("da", "ikke", "hastighed"), ("no", "ikke", "hemmelighet"),
+        ("ro", "pentru", "libertate"), ("tr", "için", "kitap"),
+        ("hu", "hogy", "szabadság"),
+    ]:
+        a = get_analyzer(f"text_{lang}")
+        terms = [t.term for t in a.tokenize(f"{stop} {keep}")]
+        assert len(terms) == 1, (lang, terms)  # stopword removed
+
+
+def test_locale_dictionary_new_languages():
+    from serenedb_tpu.search.analysis import (drop_dictionary,
+                                              register_dictionary)
+    a = register_dictionary("t_tr", {"template": "text", "locale": "tr",
+                                     "stopwords": True})
+    try:
+        # Turkish dotless ı folds in the stemmer: kitabı ~ kitab
+        t1 = [t.term for t in a.tokenize("kitaplardan")]
+        t2 = [t.term for t in a.tokenize("kitaplar")]
+        assert t1 == t2 and t1
+    finally:
+        drop_dictionary("t_tr")
