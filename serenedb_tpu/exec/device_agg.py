@@ -32,6 +32,7 @@ from .tables import TableProvider
 
 MAX_GROUP_PRODUCT = 1 << 21   # combined-key code-space cap
 MAX_INT_KEY_RANGE = 1 << 20   # direct-coding range cap for integer keys
+MAX_DISTINCT_CELLS = 1 << 22  # (group_space x value_space) presence cap
 
 import threading as _threading
 
@@ -75,8 +76,12 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
             provider.row_count() < ctx.settings.get("serene_device_min_rows"):
         return None
     for spec in node.aggs:
-        if spec.func not in _AGG_FUNCS or spec.distinct or \
-                spec.filter is not None:
+        if spec.func not in _AGG_FUNCS or spec.filter is not None:
+            return None
+        if spec.distinct and spec.func in ("count", "sum", "avg") and \
+                not isinstance(spec.arg, BoundColumn):
+            # DISTINCT runs as a (group, value)-presence scatter; value
+            # coding needs a plain column (min/max ignore DISTINCT)
             return None
     try:
         return _run(node, scan, provider, preds, ctx)
@@ -142,6 +147,41 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
             agg_plans.append((spec, compile_expr(spec.arg, scan.types,
                                                  dictionaries)))
 
+    # DISTINCT value plans: each count/sum/avg DISTINCT column gets a
+    # direct value coding (dict codes / small-range ints); the program
+    # scatters a (group, value) presence matrix and shards combine it
+    # with max (reference analog: DuckDB's distinct hash aggregate —
+    # re-expressed as a dense presence bitmap so the per-row work is one
+    # scatter on the device and the cross-shard merge one pmax)
+    distinct_plans: dict[int, tuple] = {}
+    for si, (spec, ce) in enumerate(agg_plans):
+        if not (spec.distinct and spec.func in ("count", "sum", "avg")):
+            continue
+        vi = spec.arg.index
+        vt = scan.types[vi]
+        if vt.is_string:
+            d = dictionaries.get(vi)
+            if d is None:
+                raise NotCompilable("DISTINCT string without dictionary")
+            distinct_plans[si] = ("dict", vi, 0, len(d) + 1)
+        elif vt.is_integer or vt.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
+            col = host_col(col_names[vi])
+            if col.data.size == 0:
+                lo, hi = 0, 0
+            else:
+                lo, hi = int(col.data.min()), int(col.data.max())
+            rng = hi - lo + 1
+            if rng > MAX_INT_KEY_RANGE:
+                raise NotCompilable("DISTINCT value range too large")
+            if not (-2**31 <= lo and hi < 2**31):
+                raise NotCompilable("DISTINCT value offset beyond int32")
+            distinct_plans[si] = ("int", vi, lo, rng + 1)
+        else:
+            raise NotCompilable(f"DISTINCT over {vt}")
+    for si in distinct_plans:
+        if max(group_space, 1) * distinct_plans[si][3] > MAX_DISTINCT_CELLS:
+            raise NotCompilable("DISTINCT presence matrix too large")
+
     # collect needed device columns
     needed: set[int] = set()
     for ce in compiled_preds:
@@ -201,15 +241,26 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                     codes = codes * jnp.int32(size) + jnp.clip(c, 0, size - 1)
             outputs.append(
                 ops_agg.group_count_scatter(codes, mask, group_space))
-            for spec, ce in agg_plans:
-                outputs.extend(
-                    _group_agg_device(spec, ce, arrays, codes, mask,
-                                      env_for, group_space))
+            for si, (spec, ce) in enumerate(agg_plans):
+                if si in distinct_plans:
+                    outputs.append(_presence_scatter(
+                        distinct_plans[si], arrays, codes, mask,
+                        group_space))
+                else:
+                    outputs.extend(
+                        _group_agg_device(spec, ce, arrays, codes, mask,
+                                          env_for, group_space))
         else:
             outputs.append(jnp.sum(mask, dtype=jnp.int32))
-            for spec, ce in agg_plans:
-                outputs.extend(
-                    _scalar_agg_device(spec, ce, arrays, mask, env_for))
+            for si, (spec, ce) in enumerate(agg_plans):
+                if si in distinct_plans:
+                    zc = jnp.zeros_like(mask, dtype=jnp.int32)
+                    outputs.append(_presence_scatter(
+                        distinct_plans[si], arrays, zc, mask, 1))
+                else:
+                    outputs.extend(
+                        _scalar_agg_device(spec, ce, arrays, mask,
+                                           env_for))
         return tuple(outputs)
 
     mesh_n = int(ctx.settings.get("serene_mesh") or 0)
@@ -218,7 +269,8 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     key = (id(provider), dev_ver,
            tuple(_expr_key(p) for p in preds),
            tuple(_expr_key(g) for g in node.group_exprs),
-           tuple((s.func, _expr_key(s.arg)) for s in node.aggs), mesh_n)
+           tuple((s.func, s.distinct, _expr_key(s.arg))
+                 for s in node.aggs), mesh_n)
     from .device import _PROGRAM_CACHE
     jitted = _PROGRAM_CACHE.get(key)
     if jitted is None:
@@ -278,8 +330,26 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     if group_mode:
         return _build_group_batch(node, key_plans, agg_plans, results,
                                   provider, col_names, dictionaries,
-                                  group_space, fact)
-    return _build_scalar_batch(node, agg_plans, results)
+                                  group_space, fact, distinct_plans)
+    return _build_scalar_batch(node, agg_plans, results, distinct_plans)
+
+
+def _presence_scatter(dplan, arrays, gcodes, mask, group_space):
+    """(group, value) presence matrix for one DISTINCT aggregate: int32
+    0/1 cells, scatter-max over the coded pairs. NULL values contribute 0
+    (their row mask is False), so no cell lights up for them."""
+    import jax.numpy as jnp
+    kind, vi, lo, vsize = dplan
+    data, ok = arrays[vi]
+    vc = data.astype(jnp.int32)
+    if kind == "int":
+        vc = vc - jnp.int32(lo)
+    vc = jnp.clip(vc, 0, vsize - 1)
+    m = jnp.logical_and(mask, ok)
+    pair = (gcodes * jnp.int32(vsize) + vc).ravel()
+    pres = jnp.zeros((group_space * vsize,), jnp.int32)
+    pres = pres.at[pair].max(m.ravel().astype(jnp.int32))
+    return pres.reshape(group_space, vsize)
 
 
 def _out_combines(node, agg_plans, group_mode) -> list:
@@ -291,6 +361,9 @@ def _out_combines(node, agg_plans, group_mode) -> list:
     out = ["sum"]        # group counts / scalar row count
     for spec, ce in agg_plans:
         if spec.func == "count_star":
+            continue
+        if spec.distinct and spec.func in ("count", "sum", "avg"):
+            out.append("max")    # presence matrix: cross-shard union
             continue
         if spec.func == "count":
             out.append("sum")
@@ -570,13 +643,44 @@ def _group_agg_device(spec: AggSpec, ce, arrays, codes, mask, env_for, g):
     raise NotCompilable(spec.func)
 
 
-def _build_scalar_batch(node, agg_plans, results) -> Batch:
+def _build_scalar_batch(node, agg_plans, results,
+                        distinct_plans=None) -> Batch:
     ri = iter(results)
     total = int(np.asarray(next(ri)))
     cols = []
-    for spec, ce in agg_plans:
-        cols.append(_scalar_result_col(spec, ri, total))
+    for si, (spec, ce) in enumerate(agg_plans):
+        dplan = (distinct_plans or {}).get(si)
+        if dplan is not None:
+            pres = np.asarray(next(ri)).reshape(1, -1)
+            cols.append(_distinct_result_col(spec, dplan, pres,
+                                             np.asarray([0]))[0])
+        else:
+            cols.append(_scalar_result_col(spec, ri, total))
     return Batch(list(node.names), cols)
+
+
+def _distinct_result_col(spec: AggSpec, dplan, pres: np.ndarray,
+                         present: np.ndarray):
+    """Presence matrix -> one result column, rows selected by `present`.
+    Returns a 1-element list for uniform use."""
+    kind, vi, lo, vsize = dplan
+    sub = pres[present].astype(np.int64)
+    cnt = sub.sum(axis=1)
+    if spec.func == "count":
+        return [Column(dt.BIGINT, cnt)]
+    vals = (lo + np.arange(vsize, dtype=np.int64))
+    sums = sub @ vals
+    empty = cnt == 0
+    if spec.func == "avg":
+        with np.errstate(invalid="ignore", divide="ignore"):
+            data = np.where(empty, 0.0, sums / np.maximum(cnt, 1))
+        return [Column(dt.DOUBLE, data, ~empty if empty.any() else None)]
+    t = spec.type
+    if t.is_integer:
+        return [Column(dt.BIGINT, sums,
+                       ~empty if empty.any() else None)]
+    return [Column(dt.DOUBLE, sums.astype(np.float64),
+                   ~empty if empty.any() else None)]
 
 
 def _scalar_result_col(spec: AggSpec, ri, total: int) -> Column:
@@ -611,7 +715,8 @@ def _scalar_result_col(spec: AggSpec, ri, total: int) -> Column:
 
 
 def _build_group_batch(node, key_plans, agg_plans, results, provider,
-                       col_names, dictionaries, g, fact=None) -> Batch:
+                       col_names, dictionaries, g, fact=None,
+                       distinct_plans=None) -> Batch:
     ri = iter(results)
     counts = np.asarray(next(ri)).astype(np.int64)
     present = np.flatnonzero(counts > 0)
@@ -624,8 +729,14 @@ def _build_group_batch(node, key_plans, agg_plans, results, provider,
             if validity is not None and validity.all():
                 validity = None
             cols.append(Column(t, uv, validity, d))
-        for spec, ce in agg_plans:
-            cols.append(_group_result_col(spec, ri, counts, present))
+        for si, (spec, ce) in enumerate(agg_plans):
+            dplan = (distinct_plans or {}).get(si)
+            if dplan is not None:
+                pres = np.asarray(next(ri))
+                cols.extend(_distinct_result_col(spec, dplan, pres,
+                                                 present))
+            else:
+                cols.append(_group_result_col(spec, ri, counts, present))
         return Batch(list(node.names), cols)
     # decode combined codes back to per-key codes
     sizes = [kp[3] for kp in key_plans]
@@ -648,8 +759,13 @@ def _build_group_batch(node, key_plans, agg_plans, results, provider,
             data = np.where(null_mask, 0, data).astype(t.np_dtype)
             cols.append(Column(t, data,
                                ~null_mask if null_mask.any() else None))
-    for spec, ce in agg_plans:
-        cols.append(_group_result_col(spec, ri, counts, present))
+    for si, (spec, ce) in enumerate(agg_plans):
+        dplan = (distinct_plans or {}).get(si)
+        if dplan is not None:
+            pres = np.asarray(next(ri))
+            cols.extend(_distinct_result_col(spec, dplan, pres, present))
+        else:
+            cols.append(_group_result_col(spec, ri, counts, present))
     return Batch(list(node.names), cols)
 
 

@@ -182,6 +182,12 @@ class LimitNode(PlanNode):
         return [self.child]
 
     def batches(self, ctx):
+        if isinstance(self.child, SortNode):
+            from .device_topn import try_device_topn
+            out = try_device_topn(self, ctx)
+            if out is not None:
+                yield out
+                return
         skipped = 0
         emitted = 0
         for b in self.child.batches(ctx):
@@ -573,6 +579,11 @@ def _dedup(rows: list[tuple]) -> list[tuple]:
     return out
 
 
+#: aggregates whose result is unchanged by duplicate elimination — a
+#: DISTINCT qualifier on them runs the plain accumulator
+_DISTINCT_INVARIANT = {"min", "max", "bool_and", "bool_or", "every"}
+
+
 class AggregateNode(PlanNode):
     def __init__(self, child: PlanNode, group_exprs: list[BoundExpr],
                  aggs: list[AggSpec], names: list[str] = None):
@@ -671,7 +682,12 @@ class AggregateNode(PlanNode):
         arg = spec.arg.eval(full)
         valid = arg.valid_mask()
         if spec.distinct:
-            return self._cpu_group_distinct(spec, arg, codes, g)
+            if spec.func in ("count", "sum", "avg"):
+                return self._cpu_group_distinct(spec, arg, codes, g)
+            if spec.func not in _DISTINCT_INVARIANT:
+                # string_agg/array_agg/stddev & co. would need real dedup
+                raise errors.unsupported(f"DISTINCT {spec.func}")
+            # min/max/bool aggs are DISTINCT-invariant: run them plain
         vc = codes[valid]
         if spec.func == "count":
             data = np.bincount(vc, minlength=g).astype(np.int64)
@@ -793,14 +809,20 @@ class AggregateNode(PlanNode):
         if spec.func == "count":
             data = np.bincount(uc, minlength=g).astype(np.int64)
             return Column(dt.BIGINT, data)
-        if spec.func == "sum":
-            if arg.type.is_integer:
-                acc = np.zeros(g, dtype=np.int64)
-                np.add.at(acc, uc, uv.astype(np.int64))
-                return Column(dt.BIGINT, acc)
-            acc = np.zeros(g, dtype=np.float64)
-            np.add.at(acc, uc, uv.astype(np.float64))
-            return Column(dt.DOUBLE, acc)
+        if spec.func in ("sum", "avg"):
+            cnt = np.bincount(uc, minlength=g).astype(np.int64)
+            empty = cnt == 0    # all-NULL group: SUM/AVG are NULL (PG)
+            validity = ~empty if empty.any() else None
+            if spec.func == "avg" or not arg.type.is_integer:
+                acc = np.zeros(g, dtype=np.float64)
+                np.add.at(acc, uc, uv.astype(np.float64))
+                if spec.func == "avg":
+                    with np.errstate(invalid="ignore", divide="ignore"):
+                        acc = np.where(empty, 0.0, acc / np.maximum(cnt, 1))
+                return Column(dt.DOUBLE, acc, validity)
+            acc = np.zeros(g, dtype=np.int64)
+            np.add.at(acc, uc, uv.astype(np.int64))
+            return Column(dt.BIGINT, acc, validity)
         raise errors.unsupported(f"DISTINCT {spec.func}")
 
     def _cpu_scalar_agg(self, ctx) -> Batch:
@@ -821,7 +843,13 @@ class _ScalarAcc:
         self.sum_sq = 0.0
         self.min_v = None
         self.max_v = None
-        self.distinct: Optional[set] = set() if spec.distinct else None
+        if spec.distinct and spec.func not in ("count", "sum", "avg") \
+                and spec.func not in _DISTINCT_INVARIANT:
+            raise errors.unsupported(f"DISTINCT {spec.func}")
+        # min/max & friends are DISTINCT-invariant — no dedup set needed
+        self.distinct: Optional[set] = set() \
+            if spec.distinct and spec.func in ("count", "sum", "avg") \
+            else None
         self.strings: list[str] = []
         self.bool_acc = None
 
@@ -900,6 +928,10 @@ class _ScalarAcc:
             if spec.func == "sum":
                 s = sum(self.distinct) if self.distinct else None
                 return Column.from_pylist([s], t)
+            if spec.func == "avg":
+                a = (sum(self.distinct) / len(self.distinct)
+                     if self.distinct else None)
+                return Column.from_pylist([a], t)
             raise errors.unsupported(f"DISTINCT {spec.func}")
         if spec.func == "count":
             return Column.from_pylist([self.count], t)

@@ -51,6 +51,16 @@ QUERIES = [
     "SELECT count(*) FROM h WHERE k * 2 <= 40 OR v < 0",
     "SELECT count(*) FROM h WHERE NOT (k > 10)",
     "SELECT count(*) FROM h WHERE nv IS NULL",
+    # DISTINCT aggregates: (group, value) presence scatter on device
+    "SELECT count(DISTINCT k) FROM h",
+    "SELECT count(DISTINCT g) FROM h WHERE k > 10",
+    "SELECT sum(DISTINCT k), avg(DISTINCT k) FROM h",
+    "SELECT count(DISTINCT nv) FROM h",
+    "SELECT g, count(DISTINCT k) FROM h GROUP BY g ORDER BY g",
+    "SELECT k, count(DISTINCT g), sum(DISTINCT k) FROM h WHERE k < 25 "
+    "GROUP BY k ORDER BY k",
+    "SELECT g, count(DISTINCT nv), min(DISTINCT k) FROM h "
+    "GROUP BY g ORDER BY g",
 ]
 
 
@@ -222,3 +232,125 @@ class TestCompressedTiles:
                            ("w", "uint16")]:
             dc = t.device_column(name)
             assert dc.data.dtype.name == want, (name, dc.data.dtype)
+
+
+
+def test_distinct_device_path_used(conn):
+    from serenedb_tpu.utils import metrics
+    conn.execute("SET serene_device = 'tpu'")
+    before = metrics.DEVICE_OFFLOADS.value
+    conn.execute("SELECT g, count(DISTINCT k) FROM h GROUP BY g")
+    assert metrics.DEVICE_OFFLOADS.value > before
+
+
+def test_distinct_all_null_group_is_null_sum(conn):
+    conn.execute("CREATE TABLE dn (k INT, v INT)")
+    conn.execute("INSERT INTO dn VALUES (1, NULL), (1, NULL), (2, 5)")
+    for dev in ("cpu", "tpu"):
+        conn.execute(f"SET serene_device = '{dev}'")
+        rows = conn.execute(
+            "SELECT k, count(DISTINCT v), sum(DISTINCT v), "
+            "avg(DISTINCT v) FROM dn GROUP BY k ORDER BY k").rows()
+        assert rows == [(1, 0, None, None), (2, 1, 5, 5.0)], (dev, rows)
+    conn.execute("DROP TABLE dn")
+
+
+# -- device/mesh top-N (ORDER BY col LIMIT k) ------------------------------
+
+TOPN_QUERIES = [
+    "SELECT k, v FROM h ORDER BY v DESC LIMIT 8",
+    "SELECT k, v FROM h ORDER BY v LIMIT 8",
+    "SELECT v FROM h ORDER BY v DESC LIMIT 5 OFFSET 2",
+    "SELECT f, k FROM h ORDER BY f LIMIT 6",
+]
+
+
+@pytest.mark.parametrize("q", TOPN_QUERIES)
+def test_topn_device_cpu_parity(conn, q):
+    conn.execute("SET serene_device = 'cpu'")
+    cpu = conn.execute(q).rows()
+    conn.execute("SET serene_device = 'tpu'")
+    dev = conn.execute(q).rows()
+    # the sort key is the first ORDER BY column; non-key columns may
+    # differ on exact key ties, so compare the key sequences and row sets
+    assert len(cpu) == len(dev)
+    assert cpu == dev, q
+
+
+def test_topn_mesh_parity(conn):
+    conn.execute("SET serene_device = 'tpu'")
+    conn.execute("SET serene_mesh = 8")
+    try:
+        for q in TOPN_QUERIES:
+            mesh = conn.execute(q).rows()
+            conn.execute("SET serene_mesh = 0")
+            single = conn.execute(q).rows()
+            conn.execute("SET serene_mesh = 8")
+            assert mesh == single, q
+    finally:
+        conn.execute("SET serene_mesh = 0")
+
+
+def test_topn_fallback_shapes(conn):
+    """NULLs / strings / filters / explicit NULLS placement fall back to
+    the CPU sort and stay correct."""
+    conn.execute("SET serene_device = 'tpu'")
+    for q in [
+        "SELECT nv FROM h ORDER BY nv LIMIT 5",           # has NULLs
+        "SELECT g FROM h ORDER BY g LIMIT 5",             # string key
+        "SELECT v FROM h WHERE k > 25 ORDER BY v LIMIT 5",  # filter
+        "SELECT v FROM h ORDER BY v DESC NULLS LAST LIMIT 5",
+        "SELECT k, v FROM h ORDER BY k, v LIMIT 5",       # two keys
+    ]:
+        dev = conn.execute(q).rows()
+        conn.execute("SET serene_device = 'cpu'")
+        cpu = conn.execute(q).rows()
+        conn.execute("SET serene_device = 'tpu'")
+        assert [r[0] for r in dev] == [r[0] for r in cpu], q
+
+
+def test_topn_mesh_underfilled_shards(conn):
+    """A table smaller than mesh_n * k leaves most shards all-padding;
+    their sentinel candidates must not leak into the merged top-k."""
+    conn.execute("CREATE TABLE small (v INT)")
+    conn.execute("INSERT INTO small VALUES " + ", ".join(
+        f"({i * 3 - 50})" for i in range(100)))
+    conn.execute("SET serene_device = 'tpu'")
+    conn.execute("SET serene_mesh = 8")
+    try:
+        got = conn.execute(
+            "SELECT v FROM small ORDER BY v DESC LIMIT 10").rows()
+        conn.execute("SET serene_device = 'cpu'")
+        want = conn.execute(
+            "SELECT v FROM small ORDER BY v DESC LIMIT 10").rows()
+        assert got == want
+        conn.execute("SET serene_device = 'tpu'")
+        got_asc = conn.execute(
+            "SELECT v FROM small ORDER BY v LIMIT 10").rows()
+        conn.execute("SET serene_device = 'cpu'")
+        want_asc = conn.execute(
+            "SELECT v FROM small ORDER BY v LIMIT 10").rows()
+        assert got_asc == want_asc
+    finally:
+        conn.execute("SET serene_mesh = 0")
+        conn.execute("DROP TABLE small")
+
+
+def test_distinct_unsupported_aggs_still_error(conn):
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    for q in ["SELECT string_agg(DISTINCT g, ',') FROM h",
+              "SELECT stddev(DISTINCT v) FROM h",
+              "SELECT g, string_agg(DISTINCT g, ',') FROM h GROUP BY g"]:
+        with _pytest.raises(_errors.SqlError):
+            conn.execute(q)
+
+
+def test_distinct_invariant_minmax(conn):
+    for dev in ("cpu", "tpu"):
+        conn.execute(f"SET serene_device = '{dev}'")
+        a = conn.execute("SELECT min(DISTINCT v), max(DISTINCT v) "
+                         "FROM h").rows()
+        b = conn.execute("SELECT min(v), max(v) FROM h").rows()
+        assert a == b, dev
