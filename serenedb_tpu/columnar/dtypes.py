@@ -44,6 +44,10 @@ class TypeId(enum.Enum):
     ARRAY = "ARRAY"          # element-typed; physically JSON text in a
                              # dictionary column (wire layer renders/encodes
                              # PG {…} text and the binary array format)
+    RECORD = "RECORD"        # anonymous composite (ROW(...)); physically
+                             # JSON {"o":[oid,...],"v":[...]} text in a
+                             # dictionary column; wire layer renders PG
+                             # (…) text / the binary record format (2249)
 
 
 _NUMPY_OF = {
@@ -60,6 +64,7 @@ _NUMPY_OF = {
     TypeId.INTERVAL: np.dtype(np.int64),
     TypeId.NULL: np.dtype(np.int32),
     TypeId.ARRAY: np.dtype(np.int32),     # dictionary codes (JSON text)
+    TypeId.RECORD: np.dtype(np.int32),    # dictionary codes (JSON text)
     TypeId.OID: np.dtype(np.int64),
     TypeId.REGCLASS: np.dtype(np.int64),
     TypeId.REGTYPE: np.dtype(np.int64),
@@ -101,12 +106,14 @@ class SqlType:
 
     @property
     def is_string(self) -> bool:
-        # ARRAY shares the dictionary-string physical representation
-        return self.id in (TypeId.VARCHAR, TypeId.ARRAY)
+        # ARRAY/RECORD share the dictionary-string physical representation
+        return self.id in (TypeId.VARCHAR, TypeId.ARRAY, TypeId.RECORD)
 
     def __str__(self) -> str:  # PG-style rendering
         if self.id is TypeId.ARRAY:
             return f"{(self.elem or TypeId.VARCHAR).value}[]"
+        if self.id is TypeId.RECORD:
+            return "record"
         return self.id.value
 
 
@@ -127,6 +134,7 @@ REGTYPE = SqlType(TypeId.REGTYPE)
 REGPROC = SqlType(TypeId.REGPROC)
 REGNAMESPACE = SqlType(TypeId.REGNAMESPACE)
 NULLTYPE = SqlType(TypeId.NULL)
+RECORD = SqlType(TypeId.RECORD)
 
 
 def array_of(elem: "SqlType | TypeId | None") -> SqlType:

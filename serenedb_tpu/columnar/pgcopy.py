@@ -55,7 +55,162 @@ def encode_value(v, typ: dt.SqlType) -> Optional[bytes]:
         return struct.pack("!I", int(v) & 0xFFFFFFFF)
     if tid is dt.TypeId.ARRAY:
         return _encode_array_binary(str(v), typ.elem or dt.TypeId.VARCHAR)
+    if tid is dt.TypeId.RECORD:
+        return _encode_record_binary(str(v))
     return str(v).encode()
+
+
+#: element TypeId → array OID (PG catalog values; record fields carry
+#: these so nested arrays render/encode as real arrays)
+_ARRAY_OID_OF_ELEM = {
+    dt.TypeId.BOOL: 1000, dt.TypeId.SMALLINT: 1005, dt.TypeId.TINYINT: 1005,
+    dt.TypeId.INT: 1007, dt.TypeId.BIGINT: 1016, dt.TypeId.FLOAT: 1021,
+    dt.TypeId.DOUBLE: 1022, dt.TypeId.VARCHAR: 1009,
+    dt.TypeId.DATE: 1182, dt.TypeId.TIMESTAMP: 1115,
+}
+
+#: OID → SqlType for record field encoding/rendering (record values
+#: carry per-field OIDs in their physical JSON)
+_TYPE_OF_OID = {
+    16: dt.BOOL, 21: dt.SMALLINT, 23: dt.INT, 20: dt.BIGINT,
+    700: dt.FLOAT, 701: dt.DOUBLE, 25: dt.VARCHAR,
+    1082: dt.DATE, 1114: dt.TIMESTAMP, 1186: dt.INTERVAL,
+    2249: dt.RECORD,
+}
+for _e, _oid in _ARRAY_OID_OF_ELEM.items():
+    _TYPE_OF_OID.setdefault(_oid, dt.SqlType(dt.TypeId.ARRAY, _e))
+
+#: TypeId → field OID for ROW(...) construction (scalars; arrays and
+#: records go through field_oid below)
+FIELD_OID = {
+    dt.TypeId.BOOL: 16, dt.TypeId.TINYINT: 21, dt.TypeId.SMALLINT: 21,
+    dt.TypeId.INT: 23, dt.TypeId.BIGINT: 20, dt.TypeId.FLOAT: 700,
+    dt.TypeId.DOUBLE: 701, dt.TypeId.VARCHAR: 25, dt.TypeId.NULL: 25,
+    dt.TypeId.DATE: 1082, dt.TypeId.TIMESTAMP: 1114,
+    dt.TypeId.INTERVAL: 1186, dt.TypeId.RECORD: 2249,
+}
+
+
+def field_oid(t: dt.SqlType) -> int:
+    if t.id is dt.TypeId.ARRAY:
+        return _ARRAY_OID_OF_ELEM.get(t.elem or dt.TypeId.VARCHAR, 1009)
+    return FIELD_OID.get(t.id, 25)
+
+
+def record_parts(json_text: str):
+    """Physical record JSON → ([oid, ...], [value, ...]); None when the
+    payload is not a record."""
+    import json as _json
+    try:
+        obj = _json.loads(json_text)
+    except Exception:
+        return None
+    if not (isinstance(obj, dict) and isinstance(obj.get("o"), list)
+            and isinstance(obj.get("v"), list)
+            and len(obj["o"]) == len(obj["v"])):
+        return None
+    return obj["o"], obj["v"]
+
+
+def _scalar_field_text(t: dt.SqlType, v) -> str:
+    if t.id is dt.TypeId.BOOL or isinstance(v, bool):
+        return "t" if v else "f"
+    if t.id is dt.TypeId.TIMESTAMP:
+        from ..sql.binder import format_timestamp
+        return format_timestamp(int(v))
+    if t.id is dt.TypeId.DATE:
+        import numpy as _np
+        return str(_np.datetime64(int(v), "D"))
+    if t.id is dt.TypeId.INTERVAL:
+        from ..sql.binder import format_interval
+        return format_interval(int(v))
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _array_field_text(json_text: str, elem) -> str:
+    """JSON array payload → PG {…} text (element-level; no reg* types
+    inside records)."""
+    import json as _json
+    try:
+        vals = _json.loads(json_text)
+    except Exception:
+        return json_text
+    if not isinstance(vals, list):
+        return json_text
+    et = dt.SqlType(elem) if elem is not None else dt.VARCHAR
+
+    def one(v):
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return "t" if v else "f"
+        if isinstance(v, list):
+            return "{" + ",".join(one(x) for x in v) + "}"
+        if et.id in (dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                     dt.TypeId.INTERVAL) and isinstance(v, int):
+            return _scalar_field_text(et, v)
+        if isinstance(v, str):
+            if v == "" or any(ch in v for ch in ',{}"\\ ') or \
+                    v.upper() == "NULL":
+                return '"' + v.replace("\\", "\\\\").replace(
+                    '"', '\\"') + '"'
+            return v
+        if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return str(v)
+    return "{" + ",".join(one(v) for v in vals) + "}"
+
+
+def record_text(json_text: str) -> str:
+    """Physical record JSON → PG (…) output (reference:
+    server/pg/serialize.cpp record_out): NULL fields are empty; fields
+    containing , ( ) " \\ or any whitespace (or empty strings) are quoted
+    with doubled quotes. Nested records and arrays render recursively."""
+    parts = record_parts(json_text)
+    if parts is None:
+        return json_text
+    oids, vals = parts
+    out = []
+    for oid, v in zip(oids, vals):
+        if v is None:
+            out.append("")
+            continue
+        t = _TYPE_OF_OID.get(int(oid), dt.VARCHAR)
+        if t.id is dt.TypeId.RECORD:
+            s = record_text(str(v))
+        elif t.id is dt.TypeId.ARRAY:
+            s = _array_field_text(str(v), t.elem)
+        else:
+            s = _scalar_field_text(t, v)
+        if s == "" or any(ch in s for ch in ',()"\\') or \
+                any(ch.isspace() for ch in s):
+            s = '"' + s.replace("\\", "\\\\").replace('"', '""') + '"'
+        out.append(s)
+    return "(" + ",".join(out) + ")"
+
+
+def _encode_record_binary(json_text: str) -> bytes:
+    """PG binary record format: int32 nfields, then per field int32 OID +
+    length-prefixed binary payload (reference: server/pg/serialize.cpp
+    record_send)."""
+    parts = record_parts(json_text)
+    if parts is None:
+        # not a record payload — one text field
+        payload = json_text.encode()
+        return struct.pack("!i", 1) + struct.pack("!Ii", 25, len(payload)) \
+            + payload
+    oids, vals = parts
+    out = [struct.pack("!i", len(vals))]
+    for oid, v in zip(oids, vals):
+        t = _TYPE_OF_OID.get(int(oid), dt.VARCHAR)
+        if v is None:
+            out.append(struct.pack("!Ii", int(oid), -1))
+            continue
+        payload = encode_value(v, t)
+        out.append(struct.pack("!Ii", int(oid), len(payload)) + payload)
+    return b"".join(out)
 
 
 #: element TypeId → (element OID, element SqlType) for array binary sends

@@ -2107,20 +2107,25 @@ class Connection:
             with _progress.track("COPY FROM"):
                 return self._copy_from(st, table, fmt)
         # COPY TO
-        provider = self.db.resolve_table(st.table)
-        if self.in_txn:
-            provider = self._txn_read_provider(provider)
-        full = provider.full_batch(st.columns)
+        if st.query is not None:
+            full = self._run_select(st.query, [])
+        else:
+            provider = self.db.resolve_table(st.table)
+            if self.in_txn:
+                provider = self._txn_read_provider(provider)
+            full = provider.full_batch(st.columns)
         with _progress.track("COPY TO", full.num_rows):
             if fmt == "parquet":
-                _write_parquet(st.target, full)
+                # records export as PG (…) text — the physical JSON is a
+                # private encoding and must not leak into interchange files
+                _write_parquet(st.target, _records_as_text(full))
             elif fmt == "binary":
                 from .columnar import pgcopy
                 with open(st.target, "wb") as f:
                     for chunk in pgcopy.encode_full(full):
                         f.write(chunk)
             else:
-                _write_csv(st.target, full, st.options)
+                _write_csv(st.target, _records_as_text(full), st.options)
         return QueryResult(Batch([], []), f"COPY {full.num_rows}")
 
     def copy_in_data(self, st: ast.CopyStmt, data: bytes) -> QueryResult:
@@ -2192,17 +2197,24 @@ class Connection:
         self._insert_batch(table, incoming)
         return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
 
-    def copy_out_data(self, st: ast.CopyStmt) -> tuple[list[bytes], int]:
-        """COPY ... TO STDOUT: PG text format by default, or csv with the
-        same options copy_in_data honors."""
-        provider = self.db.resolve_table(st.table)
-        if self.in_txn:
-            provider = self._txn_read_provider(provider)
-        full = provider.full_batch(st.columns)
+    def copy_out_data(self, st: ast.CopyStmt,
+                      ) -> tuple[list[bytes], int, int]:
+        """COPY ... TO STDOUT → (encoded rows, row count, column count):
+        PG text format by default, or csv with the same options
+        copy_in_data honors."""
+        if st.query is not None:
+            full = self._run_select(st.query, [])
+        else:
+            provider = self.db.resolve_table(st.table)
+            if self.in_txn:
+                provider = self._txn_read_provider(provider)
+            full = provider.full_batch(st.columns)
+        ncols = len(full.columns)
         fmt = str(st.options.get("format", "text")).lower()
         if fmt == "binary":
             from .columnar import pgcopy
-            return pgcopy.encode_full(full), full.num_rows
+            return pgcopy.encode_full(full), full.num_rows, ncols
+        full = _records_as_text(full)
         cols = [c.to_pylist() for c in full.columns]
         if fmt == "csv":
             import csv as _csv
@@ -2216,7 +2228,7 @@ class Connection:
                 w.writerow([null_s if v is None else v
                             for v in (col[i] for col in cols)])
                 out.append(buf.getvalue().encode())
-            return out, full.num_rows
+            return out, full.num_rows, ncols
         delim = str(st.options.get("delimiter", "\t"))
         null_s = str(st.options.get("null", "\\N"))
         out = []
@@ -2231,7 +2243,7 @@ class Connection:
                          .replace("\n", "\\n").replace("\r", "\\r")
                     parts.append(s)
             out.append((delim.join(parts) + "\n").encode())
-        return out, full.num_rows
+        return out, full.num_rows, ncols
 
     def _copy_from(self, st: ast.CopyStmt, table: MemTable,
                    fmt: str) -> QueryResult:
@@ -2678,6 +2690,30 @@ def _read_csv(path: str, names: list, types: list, options: dict) -> Batch:
                 vals.append(raw if t.is_string else _cast_text_to(raw, t))
         cols.append(Column.from_pylist(vals, t))
     return Batch(list(names), cols)
+
+
+def _records_as_text(batch: Batch) -> Batch:
+    """Record columns render as PG (…) text for text/csv COPY output
+    (binary keeps the record codec; reference: record_out)."""
+    from .columnar import dtypes as _dt
+    from .columnar.pgcopy import record_text
+    from .sql.expr import make_string_column
+    if not any(c.type.id is _dt.TypeId.RECORD for c in batch.columns):
+        return batch
+    cols = []
+    for c in batch.columns:
+        if c.type.id is _dt.TypeId.RECORD:
+            vals = [None if v is None else record_text(str(v))
+                    for v in c.to_pylist()]
+            import numpy as _np
+            validity = _np.asarray([v is not None for v in vals])
+            cols.append(make_string_column(
+                _np.asarray(["" if v is None else v for v in vals],
+                            dtype=object),
+                None if validity.all() else validity))
+        else:
+            cols.append(c)
+    return Batch(list(batch.names), cols)
 
 
 def _write_csv(path: str, batch: Batch, options: dict):

@@ -2805,3 +2805,27 @@ from . import pgsys  # noqa: E402,F401  (registration side effects)
 from . import geofns  # noqa: E402,F401  (registration side effects)
 # Embedding provider layer (ai_embed + secrets)
 from . import embedfns  # noqa: E402,F401  (registration side effects)
+
+
+# -- ROW(...) anonymous composites (reference: server/pg/serialize.cpp
+# record path; record values render as (f1,f2) text and the binary
+# record format with per-field OIDs) --------------------------------------
+
+@register("row")
+def _row(ts):
+    from ..columnar.pgcopy import field_oid
+    oids = [field_oid(t) for t in ts]
+
+    def impl(cols, n):
+        # to_pylist() yields pure Python scalars (it .item()s numpy
+        # values), so rows JSON-encode directly
+        pylists = [c.to_pylist() for c in cols]
+        out = []
+        for i in range(n):
+            out.append(json.dumps({"o": oids,
+                                   "v": [pl[i] for pl in pylists]},
+                                  separators=(",", ":")))
+        col = make_string_column(np.asarray(out, dtype=object), None)
+        return Column(dt.RECORD, col.data, col.validity, col.dictionary)
+
+    return FunctionResolution(dt.RECORD, impl)

@@ -44,10 +44,11 @@ _OID = {
     dt.TypeId.INTERVAL: 1186, dt.TypeId.NULL: 25,
     dt.TypeId.OID: 26, dt.TypeId.REGCLASS: 2205,
     dt.TypeId.REGTYPE: 2206, dt.TypeId.REGPROC: 24,
-    dt.TypeId.REGNAMESPACE: 4089,
+    dt.TypeId.REGNAMESPACE: 4089, dt.TypeId.RECORD: 2249,
 }
 _TYPLEN = {16: 1, 21: 2, 23: 4, 20: 8, 700: 4, 701: 8, 25: -1, 1114: 8,
-           1082: 4, 1186: 16, 26: 4, 2205: 4, 2206: 4, 24: 4, 4089: 4}
+           1082: 4, 1186: 16, 26: 4, 2205: 4, 2206: 4, 24: 4, 4089: 4,
+           2249: -1}
 
 #: element TypeId → array OID (PG catalog values)
 _ARRAY_OID = {
@@ -108,6 +109,9 @@ def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:
     tid = typ.id
     if tid is dt.TypeId.ARRAY:
         return _pg_array_text(str(value), typ.elem, db)
+    if tid is dt.TypeId.RECORD:
+        from ..columnar.pgcopy import record_text
+        return record_text(str(value)).encode()
     if tid is dt.TypeId.BOOL:
         return b"t" if value else b"f"
     if tid in (dt.TypeId.REGCLASS, dt.TypeId.REGTYPE, dt.TypeId.REGPROC,
@@ -720,10 +724,8 @@ class PgSession:
             self.w.command_complete(res.command_tag)
             return
         # COPY TO STDOUT
-        rows, n = await loop.run_in_executor(
+        rows, n, ncols = await loop.run_in_executor(
             self.server.pool, self.conn.copy_out_data, st)
-        ncols = len(st.columns) if st.columns else \
-            len(self.conn.db.resolve_table(st.table).column_names)
         self.w.msg(b"H", struct.pack("!bH", ov_fmt, ncols) +
                    struct.pack("!h", ov_fmt) * ncols)
         for row in rows:

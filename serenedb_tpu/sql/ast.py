@@ -396,6 +396,7 @@ class CopyStmt(Statement):
     direction: str                    # 'from' | 'to'
     target: str                       # filename or STDIN/STDOUT
     options: dict = field(default_factory=dict)
+    query: Optional[Statement] = None  # COPY (SELECT ...) TO ...
 
 
 @dataclass

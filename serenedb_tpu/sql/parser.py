@@ -1464,14 +1464,24 @@ class Parser:
 
     def parse_copy(self) -> ast.CopyStmt:
         self.expect_kw("COPY")
-        table = self.qualified_name()
+        query = None
+        table: list[str] = []
         columns = None
-        if self.accept_op("("):
-            columns = [self.ident()]
-            while self.accept_op(","):
-                columns.append(self.ident())
+        if self.at_op("("):
+            # COPY ( query ) TO ... (PG: queries export, never import)
+            self.accept_op("(")
+            query = self.parse_select()
             self.expect_op(")")
+        else:
+            table = self.qualified_name()
+            if self.accept_op("("):
+                columns = [self.ident()]
+                while self.accept_op(","):
+                    columns.append(self.ident())
+                self.expect_op(")")
         if self.accept_kw("FROM"):
+            if query is not None:
+                raise errors.syntax("COPY query is only allowed with TO")
             direction = "from"
         else:
             self.expect_kw("TO")
@@ -1507,7 +1517,8 @@ class Parser:
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
-        return ast.CopyStmt(table, columns, direction, target, opts)
+        return ast.CopyStmt(table, columns, direction, target, opts,
+                            query=query)
 
     def parse_vacuum(self) -> ast.VacuumStmt:
         self.expect_kw("VACUUM")

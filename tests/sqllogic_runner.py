@@ -119,6 +119,9 @@ def format_value(v, typ=None) -> str:
         if typ.id is dt.TypeId.ARRAY:
             from serenedb_tpu.server.pgwire import _pg_array_text
             return _pg_array_text(str(v)).decode()
+        if typ.id is dt.TypeId.RECORD:
+            from serenedb_tpu.columnar.pgcopy import record_text
+            return record_text(str(v))
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):

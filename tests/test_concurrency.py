@@ -197,7 +197,7 @@ class TestSnapshotIsolation:
         c1.execute("BEGIN")
         c1.execute("INSERT INTO co VALUES (2)")
         c2.execute("INSERT INTO co VALUES (99)")
-        lines, n = c1.copy_out_data(
+        lines, n, _ = c1.copy_out_data(
             __import__("serenedb_tpu.sql.ast", fromlist=["ast"]).CopyStmt(
                 ["co"], None, True, {}))
         vals = sorted(int(ln.strip()) for ln in lines)

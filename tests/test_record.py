@@ -1,0 +1,134 @@
+"""ROW(...) anonymous composites: text rendering, binary record format
+(oid 2249), COPY (query) TO. Reference: server/pg/serialize.cpp record
+path (record_out / record_send)."""
+
+import struct
+
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.pgcopy import (FIELD_OID, record_parts,
+                                          record_text)
+from serenedb_tpu.engine import Database
+
+
+@pytest.fixture
+def conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT, b TEXT, f DOUBLE, ts TIMESTAMP)")
+    c.execute("INSERT INTO t VALUES "
+              "(1, 'plain', 1.5, '2020-01-02 03:04:05'), "
+              "(2, 'needs,quote', -2.25, NULL), "
+              "(3, NULL, NULL, NULL)")
+    return c
+
+
+def test_row_returns_record_type(conn):
+    r = conn.execute("SELECT ROW(1, 'x')")
+    assert str(r.batch.columns[0].type) == "record"
+    oids, vals = record_parts(r.batch.columns[0].to_pylist()[0])
+    assert oids == [23, 25] and vals == [1, "x"]
+
+
+def test_record_text_rendering(conn):
+    rows = conn.execute(
+        "SELECT ROW(a, b) FROM t ORDER BY a").batch.columns[0].to_pylist()
+    assert [record_text(v) for v in rows] == [
+        "(1,plain)", '(2,"needs,quote")', "(3,)"]
+
+
+def test_record_text_quoting_rules():
+    import json
+    def rec(oids, vals):
+        return record_text(json.dumps({"o": oids, "v": vals}))
+    assert rec([25], [""]) == '("")'
+    assert rec([25], ['has"quote']) == '("has""quote")'
+    assert rec([25], ["back\\slash"]) == '("back\\\\slash")'
+    assert rec([25], ["a b"]) == '("a b")'
+    assert rec([16, 16], [True, False]) == "(t,f)"
+    assert rec([701], [2.5]) == "(2.5)"
+    assert rec([1082], [0]) == "(1970-01-01)"
+    assert rec([23, 25], [None, None]) == "(,)"
+
+
+def test_record_binary_format(conn):
+    from serenedb_tpu.columnar.pgcopy import encode_value
+    val = conn.execute(
+        "SELECT ROW(7, 'ab', NULL)").batch.columns[0].to_pylist()[0]
+    raw = encode_value(val, dt.RECORD)
+    (nf,) = struct.unpack_from("!i", raw, 0)
+    assert nf == 3
+    off = 4
+    fields = []
+    for _ in range(nf):
+        oid, ln = struct.unpack_from("!Ii", raw, off)
+        off += 8
+        payload = raw[off:off + max(ln, 0)]
+        off += max(ln, 0)
+        fields.append((oid, ln, payload))
+    assert fields[0][0] == 23 and fields[0][2] == struct.pack("!i", 7)
+    assert fields[1][0] == 25 and fields[1][2] == b"ab"
+    assert fields[2][1] == -1   # NULL field
+    assert off == len(raw)
+
+
+def test_record_over_wire_text_and_binary(conn):
+    from serenedb_tpu.server.pgwire import oid_of_type, pg_text
+    val = conn.execute("SELECT ROW(1, 'x y')").batch.columns[0]
+    assert oid_of_type(val.type) == 2249
+    assert pg_text(val.to_pylist()[0], val.type) == b'(1,"x y")'
+
+
+def test_copy_query_to_csv(conn, tmp_path):
+    p = tmp_path / "rec.csv"
+    conn.execute(f"COPY (SELECT a, ROW(a, b) FROM t ORDER BY a) "
+                 f"TO '{p}' (FORMAT csv)")
+    lines = p.read_text().splitlines()
+    assert lines[0] == '1,"(1,plain)"'
+    assert lines[1] == '2,"(2,""needs,quote"")"'
+
+
+def test_copy_query_to_binary_roundtrip_scalar(conn, tmp_path):
+    """COPY (query) TO binary with scalar output decodes back exactly."""
+    p = tmp_path / "q.bin"
+    conn.execute(f"COPY (SELECT a, b FROM t ORDER BY a) TO '{p}' "
+                 "(FORMAT binary)")
+    conn.execute("CREATE TABLE t2 (a INT, b TEXT)")
+    conn.execute(f"COPY t2 FROM '{p}' (FORMAT binary)")
+    assert conn.execute("SELECT * FROM t2 ORDER BY a").rows() == \
+        conn.execute("SELECT a, b FROM t ORDER BY a").rows()
+
+
+def test_copy_query_from_is_an_error(conn, tmp_path):
+    from serenedb_tpu import errors
+    with pytest.raises(errors.SqlError):
+        conn.execute("COPY (SELECT 1) FROM 'x.csv'")
+
+
+def test_row_field_oids_cover_scalar_types():
+    for tid in (dt.TypeId.BOOL, dt.TypeId.INT, dt.TypeId.BIGINT,
+                dt.TypeId.DOUBLE, dt.TypeId.VARCHAR, dt.TypeId.DATE,
+                dt.TypeId.TIMESTAMP):
+        assert tid in FIELD_OID
+
+
+def test_row_in_where_and_equality(conn):
+    # records compare via their canonical physical text
+    r = conn.execute("SELECT count(*) FROM t "
+                     "WHERE ROW(a, b) = ROW(a, b)").scalar()
+    assert r == 3
+
+
+def test_nested_record_and_array_fields(conn):
+    v = conn.execute("SELECT ROW(ROW(1,2),3)").batch.columns[0].to_pylist()[0]
+    assert record_text(v) == '("(1,2)",3)'
+    v2 = conn.execute(
+        "SELECT ROW(ARRAY[1,2],'x')").batch.columns[0].to_pylist()[0]
+    assert record_text(v2) == '("{1,2}",x)'
+
+
+def test_record_field_whitespace_quoting(conn):
+    v = conn.execute(
+        "SELECT ROW('a' || chr(9) || 'b')").batch.columns[0].to_pylist()[0]
+    assert record_text(v) == '("a\tb")'
