@@ -125,6 +125,13 @@ def _scalar_field_text(t: dt.SqlType, v) -> str:
         from ..sql.binder import format_interval
         return format_interval(int(v))
     if isinstance(v, float):
+        import math as _math
+        if _math.isnan(v):
+            return "NaN"
+        if _math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))   # PG float8 out: 2, not 2.0
         return repr(v)
     return str(v)
 
@@ -161,6 +168,78 @@ def _array_field_text(json_text: str, elem) -> str:
             return str(int(v))
         return str(v)
     return "{" + ",".join(one(v) for v in vals) + "}"
+
+
+def _field_rank(v):
+    """Type-class rank for cross-kind total ordering inside records."""
+    if isinstance(v, bool):
+        return 0
+    if isinstance(v, (int, float)):
+        return 1
+    if isinstance(v, str):
+        return 2
+    return 3
+
+
+def _cmp_fields(x, y) -> int:
+    if isinstance(x, bool) or isinstance(y, bool):
+        x, y = bool(x), bool(y)
+    rx, ry = _field_rank(x), _field_rank(y)
+    if rx != ry:
+        return -1 if rx < ry else 1
+    if x == y:
+        return 0
+    try:
+        return -1 if x < y else 1
+    except TypeError:
+        sx, sy = str(x), str(y)
+        return -1 if sx < sy else (1 if sx > sy else 0)
+
+
+def record_cmp_sql(ta: str, tb: str):
+    """SQL-operator record comparison: field-wise, first difference
+    decides; a NULL field reached before a decision makes the result
+    SQL NULL (returns None). PG: ROW(1,NULL)=ROW(2,NULL) is false,
+    ROW(1,NULL)=ROW(1,NULL) is NULL. Raises on arity mismatch like PG's
+    'cannot compare dissimilar column types'."""
+    from .. import errors
+    pa, pb = record_parts(ta), record_parts(tb)
+    if pa is None or pb is None:
+        return _cmp_fields(ta, tb)
+    va, vb = pa[1], pb[1]
+    if len(va) != len(vb):
+        raise errors.SqlError(
+            "42804", "cannot compare records with different numbers "
+                     "of columns")
+    for x, y in zip(va, vb):
+        if x is None or y is None:
+            return None
+        c = _cmp_fields(x, y)
+        if c != 0:
+            return c
+    return 0
+
+
+def record_cmp_total(ta: str, tb: str) -> int:
+    """Btree-style total order for sorting records (PG record_cmp):
+    NULL fields sort after every value; NULL == NULL for ordering."""
+    pa, pb = record_parts(ta), record_parts(tb)
+    if pa is None or pb is None:
+        return _cmp_fields(ta, tb)
+    va, vb = pa[1], pb[1]
+    if len(va) != len(vb):
+        return -1 if len(va) < len(vb) else 1
+    for x, y in zip(va, vb):
+        if x is None and y is None:
+            continue
+        if x is None:
+            return 1
+        if y is None:
+            return -1
+        c = _cmp_fields(x, y)
+        if c != 0:
+            return c
+    return 0
 
 
 def record_text(json_text: str) -> str:

@@ -210,6 +210,27 @@ class LimitNode(PlanNode):
         return f"Limit {self.limit} offset {self.offset}"
 
 
+def _record_sort_ranks(col: Column) -> np.ndarray:
+    """Dense field-wise sort ranks for a record column (PG record_cmp
+    order, not physical-text order — text would put ROW(10) before
+    ROW(2))."""
+    import functools
+
+    from ..columnar.pgcopy import record_cmp_total
+    vals = [str(v) for v in col.to_pylist()]
+    n = len(vals)
+    order = sorted(range(n),
+                   key=functools.cmp_to_key(
+                       lambda i, j: record_cmp_total(vals[i], vals[j])))
+    ranks = np.zeros(n, dtype=np.int64)
+    r = 0
+    for k, i in enumerate(order):
+        if k > 0 and record_cmp_total(vals[order[k - 1]], vals[i]) != 0:
+            r += 1
+        ranks[i] = r
+    return ranks
+
+
 class SortNode(PlanNode):
     """Full materializing sort. keys are column indices into the child
     output; PG default null ordering: NULLS LAST asc, NULLS FIRST desc."""
@@ -240,7 +261,10 @@ class SortNode(PlanNode):
                                 reversed(self.nulls_first)):
             col = full.columns[ki]
             null_first = nf if nf is not None else desc
-            _, ranks = np.unique(col.data, return_inverse=True)
+            if col.type.id is dt.TypeId.RECORD:
+                ranks = _record_sort_ranks(col)
+            else:
+                _, ranks = np.unique(col.data, return_inverse=True)
             ranks = ranks.astype(np.int64)
             if desc:
                 ranks = -ranks

@@ -88,6 +88,9 @@ _CMP_NP = {
 
 def _make_compare(op: str):
     def resolver(ts: list[dt.SqlType]):
+        if len(ts) == 2 and all(t.id is dt.TypeId.RECORD for t in ts):
+            return _record_compare(op)
+
         def impl(cols, n):
             a, b = cols
             if a.type.is_string or b.type.is_string:
@@ -120,6 +123,35 @@ def _make_compare(op: str):
             return _result(dt.BOOL, data, cols)
         return FunctionResolution(dt.BOOL, impl)
     return resolver
+
+
+def _record_compare(op: str) -> FunctionResolution:
+    """Field-wise record comparison (PG record_eq/record_cmp family):
+    physical-text compare would order ROW(10) before ROW(2) and miss
+    cross-width equality, so records parse and compare by value."""
+    def impl(cols, n):
+        from ..columnar.pgcopy import record_cmp_sql
+        av, bv = string_values(cols[0]), string_values(cols[1])
+        data = np.zeros(n, dtype=bool)
+        sqlnull = np.zeros(n, dtype=bool)
+        for i in range(n):
+            c = record_cmp_sql(str(av[i]), str(bv[i]))
+            if c is None:
+                sqlnull[i] = True
+            elif op == "=":
+                data[i] = c == 0
+            elif op in ("<>", "!="):
+                data[i] = c != 0
+            elif op == "<":
+                data[i] = c < 0
+            elif op == "<=":
+                data[i] = c <= 0
+            elif op == ">":
+                data[i] = c > 0
+            else:
+                data[i] = c >= 0
+        return _result(dt.BOOL, data, cols, extra_invalid=sqlnull)
+    return FunctionResolution(dt.BOOL, impl)
 
 
 for _op in _CMP_NP:

@@ -20,7 +20,16 @@ from __future__ import annotations
 
 import math
 
-LEVELS = (4, 8, 12)
+#: covering levels, coarse → fine. Level L tiles are 360/2^L degrees
+#: wide: ~22°, 1.4°, 5.3' (~9.8km), 20" (~600m), 1.2" (~38m). Level
+#: selection is adaptive per geometry extent (_chosen_level picks the
+#: finest level whose covering stays within COVER_CAP — the S2
+#: RegionCoverer analog, reference: server/connector/
+#: geo_filter_builder.cpp), so point-ish data lands on ~38m tiles while
+#: continental polygons stay coarse. Extending this tuple is
+#: backward-compatible with already-indexed terms: queries probe every
+#: coarser level, a superset of any older scheme's levels.
+LEVELS = (4, 8, 12, 16, 20)
 COVER_CAP = 64          # max cells per covering at the chosen level
 
 

@@ -67,39 +67,11 @@ def oid_of_type(t: dt.SqlType) -> int:
 
 def _pg_array_text(json_text: str, elem=None, db=None) -> bytes:
     """JSON array text (the physical representation) → PG {...} output
-    (reference: server/pg/serialize.cpp array_out). Temporal elements
-    render through the scalar pg_text of their element type — the
-    declared date[]/timestamp[] OIDs must match the payload."""
-    import json as _json
-    try:
-        vals = _json.loads(json_text)
-    except Exception:
-        return json_text.encode()
-    elem_t = (dt.SqlType(elem) if elem is not None and elem in
-              (dt.TypeId.DATE, dt.TypeId.TIMESTAMP, dt.TypeId.INTERVAL)
-              else None)
-
-    def one(v):
-        if v is None:
-            return "NULL"
-        if isinstance(v, bool):
-            return "t" if v else "f"
-        if isinstance(v, list):
-            return "{" + ",".join(one(x) for x in v) + "}"
-        if elem_t is not None and isinstance(v, int):
-            return pg_text(v, elem_t, db).decode()
-        if isinstance(v, str):
-            if v == "" or any(ch in v for ch in ',{}"\\ ') or \
-                    v.upper() == "NULL":
-                return '"' + v.replace("\\", "\\\\").replace(
-                    '"', '\\"') + '"'
-            return v
-        if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
-            return str(int(v))    # PG float8[] text: {2}, not {2.0}
-        return str(v)
-    if not isinstance(vals, list):
-        return json_text.encode()
-    return ("{" + ",".join(one(v) for v in vals) + "}").encode()
+    (reference: server/pg/serialize.cpp array_out). One renderer for
+    arrays everywhere — record fields included — lives in
+    columnar/pgcopy so the two can never drift."""
+    from ..columnar.pgcopy import _array_field_text
+    return _array_field_text(json_text, elem).encode()
 
 
 def pg_text(value, typ: dt.SqlType, db=None) -> Optional[bytes]:

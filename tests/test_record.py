@@ -114,10 +114,26 @@ def test_row_field_oids_cover_scalar_types():
 
 
 def test_row_in_where_and_equality(conn):
-    # records compare via their canonical physical text
+    # field-wise comparison; row 3 has b NULL, so its self-comparison is
+    # SQL NULL and the row filters out (PG record_eq semantics)
     r = conn.execute("SELECT count(*) FROM t "
                      "WHERE ROW(a, b) = ROW(a, b)").scalar()
-    assert r == 3
+    assert r == 2
+
+
+def test_record_fieldwise_compare_and_order(conn):
+    assert conn.execute("SELECT ROW(10) > ROW(2)").scalar() is True
+    assert conn.execute("SELECT ROW(1, 'a') < ROW(1, 'b')").scalar() is True
+    assert conn.execute("SELECT ROW(1,NULL) = ROW(2,NULL)").scalar() is False
+    assert conn.execute("SELECT ROW(1,NULL) = ROW(1,NULL)").scalar() is None
+    rows = [r[0] for r in conn.execute(
+        "SELECT a FROM t ORDER BY ROW(a) DESC").rows()]
+    assert rows == [3, 2, 1]
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    with _pytest.raises(_errors.SqlError):
+        conn.execute("SELECT ROW(1) = ROW(1, 2)")
 
 
 def test_nested_record_and_array_fields(conn):
