@@ -22,6 +22,32 @@ Layer map (mirrors SURVEY.md §1, re-expressed for TPU):
 
 __version__ = "0.1.0"
 
+
+def build_id() -> str:
+    """Version + git revision, the reference's build-id stamp analog
+    (libs/basics/build_id). The revision is taken only when this package
+    itself lives inside the git checkout (a venv nested under someone
+    else's repo must not report that repo's HEAD); 'unknown' otherwise."""
+    import os
+    import subprocess
+    rev = "unknown"
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=5, cwd=pkg_root)
+        if top.returncode == 0 and \
+                os.path.realpath(top.stdout.strip()) == \
+                os.path.realpath(pkg_root):
+            r = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5, cwd=pkg_root)
+            if r.returncode == 0 and r.stdout.strip():
+                rev = r.stdout.strip()
+    except Exception:  # noqa: BLE001 — build id must never break boot
+        pass
+    return f"serenedb-tpu {__version__} ({rev})"
+
 # Import pyarrow EAGERLY, on whatever thread first imports this package
 # (normally the main thread). pyarrow's C++ initialization must not happen
 # lazily inside a short-lived request/worker thread: when the importing

@@ -1193,7 +1193,9 @@ class Connection:
                 dropped_ids: list[int] = []
                 store.update_meta(mutate)
                 for tid in dropped_ids:
-                    store.drop_snapshot(tid)
+                    # async drop: tombstone now (O(1) rename), reclaim in
+                    # the maintenance loop (reference: drop_task.cpp)
+                    store.tombstone_snapshot(tid)
             return QueryResult(Batch([], []), f"DROP {st.kind.upper()}")
         if isinstance(st, ast.Insert):
             return self._insert(st, params)

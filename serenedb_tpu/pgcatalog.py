@@ -775,6 +775,206 @@ def _pg_settings(db) -> MemTable:
 
 # information_schema ------------------------------------------------------
 
+#: ISO SQL feature taxonomy rows with THIS ENGINE's honest support flags
+#: (reference: server/pg/information_schema/sql_features.txt). A curated
+#: representative subset of the standard's feature list.
+_SQL_FEATURES = [
+    ("B012", "Embedded C", "NO"),
+    ("E011", "Numeric data types", "YES"),
+    ("E011-01", "INTEGER and SMALLINT data types", "YES"),
+    ("E011-02", "REAL, DOUBLE PRECISION and FLOAT data types", "YES"),
+    ("E011-04", "Arithmetic operators", "YES"),
+    ("E011-05", "Numeric comparison", "YES"),
+    ("E011-06", "Implicit casting among the numeric data types", "YES"),
+    ("E021", "Character string types", "YES"),
+    ("E021-01", "CHARACTER data type", "YES"),
+    ("E021-02", "CHARACTER VARYING data type", "YES"),
+    ("E021-03", "Character literals", "YES"),
+    ("E021-04", "CHARACTER_LENGTH function", "YES"),
+    ("E021-05", "OCTET_LENGTH function", "YES"),
+    ("E021-06", "SUBSTRING function", "YES"),
+    ("E021-07", "Character concatenation", "YES"),
+    ("E021-08", "UPPER and LOWER functions", "YES"),
+    ("E021-09", "TRIM function", "YES"),
+    ("E021-10", "Implicit casting among character types", "YES"),
+    ("E021-11", "POSITION function", "YES"),
+    ("E031", "Identifiers", "YES"),
+    ("E031-01", "Delimited identifiers", "YES"),
+    ("E031-02", "Lower case identifiers", "YES"),
+    ("E051", "Basic query specification", "YES"),
+    ("E051-01", "SELECT DISTINCT", "YES"),
+    ("E051-02", "GROUP BY clause", "YES"),
+    ("E051-04", "GROUP BY can contain columns not in select list", "YES"),
+    ("E051-05", "Select list items can be renamed", "YES"),
+    ("E051-06", "HAVING clause", "YES"),
+    ("E051-07", "Qualified * in select list", "YES"),
+    ("E061", "Basic predicates and search conditions", "YES"),
+    ("E061-01", "Comparison predicate", "YES"),
+    ("E061-02", "BETWEEN predicate", "YES"),
+    ("E061-03", "IN predicate with list of values", "YES"),
+    ("E061-04", "LIKE predicate", "YES"),
+    ("E061-05", "LIKE predicate: ESCAPE clause", "YES"),
+    ("E061-06", "NULL predicate", "YES"),
+    ("E061-08", "EXISTS predicate", "YES"),
+    ("E061-09", "Subqueries in comparison predicate", "YES"),
+    ("E061-11", "Subqueries in IN predicate", "YES"),
+    ("E061-13", "Correlated subqueries", "YES"),
+    ("E061-14", "Search condition", "YES"),
+    ("E071", "Basic query expressions", "YES"),
+    ("E071-01", "UNION DISTINCT table operator", "YES"),
+    ("E071-02", "UNION ALL table operator", "YES"),
+    ("E071-03", "EXCEPT DISTINCT table operator", "YES"),
+    ("E071-05", "Columns combined via table operators need not have "
+                "exactly the same data type", "YES"),
+    ("E071-06", "Table operators in subqueries", "YES"),
+    ("E081", "Basic privileges", "YES"),
+    ("E081-01", "SELECT privilege at the table level", "YES"),
+    ("E081-02", "DELETE privilege", "YES"),
+    ("E081-03", "INSERT privilege at the table level", "YES"),
+    ("E081-04", "UPDATE privilege at the table level", "YES"),
+    ("E091", "Set functions", "YES"),
+    ("E091-01", "AVG", "YES"),
+    ("E091-02", "COUNT", "YES"),
+    ("E091-03", "MAX", "YES"),
+    ("E091-04", "MIN", "YES"),
+    ("E091-05", "SUM", "YES"),
+    ("E091-06", "ALL quantifier", "YES"),
+    ("E091-07", "DISTINCT quantifier", "YES"),
+    ("E101", "Basic data manipulation", "YES"),
+    ("E101-01", "INSERT statement", "YES"),
+    ("E101-03", "Searched UPDATE statement", "YES"),
+    ("E101-04", "Searched DELETE statement", "YES"),
+    ("E111", "Single row SELECT statement", "YES"),
+    ("E121", "Basic cursor support", "NO"),
+    ("E131", "Null value support (nulls in lieu of values)", "YES"),
+    ("E141", "Basic integrity constraints", "YES"),
+    ("E141-01", "NOT NULL constraints", "YES"),
+    ("E141-03", "PRIMARY KEY constraints", "YES"),
+    ("E141-04", "Basic FOREIGN KEY constraint", "NO"),
+    ("E151", "Transaction support", "YES"),
+    ("E151-01", "COMMIT statement", "YES"),
+    ("E151-02", "ROLLBACK statement", "YES"),
+    ("E152", "Basic SET TRANSACTION statement", "NO"),
+    ("E153", "Updatable queries with subqueries", "YES"),
+    ("E161", "SQL comments using leading double minus", "YES"),
+    ("E171", "SQLSTATE support", "YES"),
+    ("F031", "Basic schema manipulation", "YES"),
+    ("F031-01", "CREATE TABLE statement to create persistent base "
+                "tables", "YES"),
+    ("F031-02", "CREATE VIEW statement", "YES"),
+    ("F031-03", "GRANT statement", "YES"),
+    ("F031-04", "ALTER TABLE statement: ADD COLUMN clause", "YES"),
+    ("F041", "Basic joined table", "YES"),
+    ("F041-01", "Inner join (but not necessarily the INNER keyword)",
+     "YES"),
+    ("F041-02", "INNER keyword", "YES"),
+    ("F041-03", "LEFT OUTER JOIN", "YES"),
+    ("F041-04", "RIGHT OUTER JOIN", "YES"),
+    ("F041-05", "Outer joins can be nested", "YES"),
+    ("F041-07", "The inner table in a left or right outer join can also "
+                "be used in an inner join", "YES"),
+    ("F051", "Basic date and time", "YES"),
+    ("F051-01", "DATE data type", "YES"),
+    ("F051-02", "TIME data type", "NO"),
+    ("F051-03", "TIMESTAMP data type", "YES"),
+    ("F081", "UNION and EXCEPT in views", "YES"),
+    ("F131", "Grouped operations", "YES"),
+    ("F181", "Multiple module support", "NO"),
+    ("F201", "CAST function", "YES"),
+    ("F221", "Explicit defaults", "YES"),
+    ("F261", "CASE expression", "YES"),
+    ("F311", "Schema definition statement", "YES"),
+    ("F401", "Extended joined table", "YES"),
+    ("F401-01", "NATURAL JOIN", "YES"),
+    ("F401-02", "FULL OUTER JOIN", "YES"),
+    ("F401-04", "CROSS JOIN", "YES"),
+    ("F471", "Scalar subquery values", "YES"),
+    ("F481", "Expanded NULL predicate", "YES"),
+    ("S071", "SQL paths in function and type name resolution", "NO"),
+    ("T031", "BOOLEAN data type", "YES"),
+    ("T051", "Row types", "YES"),
+    ("T071", "BIGINT data type", "YES"),
+    ("T121", "WITH (excluding RECURSIVE) in query expression", "YES"),
+    ("T321", "Basic SQL-invoked routines", "NO"),
+    ("T611", "Elementary OLAP operations", "YES"),
+    ("T621", "Enhanced numeric functions", "YES"),
+]
+
+
+def _info_sql_features() -> MemTable:
+    spec = [("feature_id", dt.VARCHAR), ("feature_name", dt.VARCHAR),
+            ("sub_feature_id", dt.VARCHAR),
+            ("sub_feature_name", dt.VARCHAR),
+            ("is_supported", dt.VARCHAR),
+            ("is_verified_by", dt.VARCHAR), ("comments", dt.VARCHAR)]
+    rows: dict[str, list] = {c: [] for c, _ in spec}
+    for fid, fname, supported in _SQL_FEATURES:
+        # PG keeps the dashed id in feature_id and leaves the
+        # sub_feature columns empty strings
+        rows["feature_id"].append(fid)
+        rows["feature_name"].append(fname)
+        rows["sub_feature_id"].append("")
+        rows["sub_feature_name"].append("")
+        rows["is_supported"].append(supported)
+        rows["is_verified_by"].append(None)
+        rows["comments"].append(None)
+    return _typed("sql_features", spec, rows)
+
+
+def _info_sql_implementation_info() -> MemTable:
+    items = [
+        ("10003", "CATALOG NAME", None, "Y"),
+        ("10004", "COLLATING SEQUENCE", None, "UCS_BASIC"),
+        ("23", "MAXIMUM COLUMN NAME LENGTH", 63, None),
+        ("17", "MAXIMUM COLUMNS IN GROUP BY", 0, None),
+        ("18", "MAXIMUM COLUMNS IN ORDER BY", 0, None),
+        ("19", "MAXIMUM COLUMNS IN SELECT", 0, None),
+        ("30", "MAXIMUM ROW SIZE", 0, None),
+        ("46", "MAXIMUM TABLE NAME LENGTH", 63, None),
+        ("35", "MAXIMUM SCHEMA NAME LENGTH", 63, None),
+        ("107", "MAXIMUM USER NAME LENGTH", 63, None),
+        ("26", "MAXIMUM IDENTIFIER LENGTH", 63, None),
+        ("85", "NULL COLLATION", 0, None),
+        ("13", "CORRELATION NAME", None, "Y"),
+    ]
+    spec = [("implementation_info_id", dt.VARCHAR),
+            ("implementation_info_name", dt.VARCHAR),
+            ("integer_value", dt.INT), ("character_value", dt.VARCHAR),
+            ("comments", dt.VARCHAR)]
+    rows: dict[str, list] = {c: [] for c, _ in spec}
+    for iid, name, iv, cv in items:
+        rows["implementation_info_id"].append(iid)
+        rows["implementation_info_name"].append(name)
+        rows["integer_value"].append(iv)
+        rows["character_value"].append(cv)
+        rows["comments"].append(None)
+    return _typed("sql_implementation_info", spec, rows)
+
+
+def _info_sql_sizing() -> MemTable:
+    items = [
+        (34, "MAXIMUM CATALOG NAME LENGTH", 63),
+        (30, "MAXIMUM ROW SIZE", 0),
+        (25, "MAXIMUM IDENTIFIER LENGTH", 63),
+        (97, "MAXIMUM COLUMNS IN TABLE", 1600),
+        (99, "MAXIMUM TABLES IN SELECT", 0),
+        (20, "MAXIMUM COLUMNS IN GROUP BY", 0),
+        (21, "MAXIMUM COLUMNS IN INDEX", 32),
+        (22, "MAXIMUM COLUMNS IN ORDER BY", 0),
+        (23, "MAXIMUM COLUMNS IN SELECT", 0),
+        (100, "MAXIMUM VALUE EXPRESSION LENGTH", 0),
+    ]
+    spec = [("sizing_id", dt.INT), ("sizing_name", dt.VARCHAR),
+            ("supported_value", dt.INT), ("comments", dt.VARCHAR)]
+    rows: dict[str, list] = {c: [] for c, _ in spec}
+    for sid, name, val in items:
+        rows["sizing_id"].append(sid)
+        rows["sizing_name"].append(name)
+        rows["supported_value"].append(val)
+        rows["comments"].append(None)
+    return _typed("sql_sizing", spec, rows)
+
+
 def _info_tables(db) -> MemTable:
     rows = db.table_list()
     return _typed("tables", [
@@ -969,6 +1169,9 @@ _BUILDERS: dict[str, Callable] = {
     "schemata": _info_schemata,
     "table_constraints": _info_table_constraints,
     "key_column_usage": _info_key_column_usage,
+    "sql_features": lambda db: _info_sql_features(),
+    "sql_implementation_info": lambda db: _info_sql_implementation_info(),
+    "sql_sizing": lambda db: _info_sql_sizing(),
 }
 
 

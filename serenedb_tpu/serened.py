@@ -39,7 +39,24 @@ def main(argv=None):
     ap.add_argument("--proxy-protocol", default="off",
                     choices=["off", "optional", "require"],
                     help="HAProxy PROXY v1/v2 preface handling")
+    ap.add_argument("--listen", action="append", default=[],
+                    metavar="SPEC",
+                    help="additional PG listener: tcp://HOST:PORT or "
+                         "unix:///path.sock (repeatable; reference: "
+                         "listen_spec.h multi-spec --listen)")
+    ap.add_argument("--version", action="store_true",
+                    help="print version/build id and exit")
     args = ap.parse_args(argv)
+    if args.version:
+        from . import build_id
+        print(build_id())
+        return
+    from .server.listen import parse_listen_spec
+    for spec in args.listen:
+        try:
+            parse_listen_spec(spec, default_host=args.host)
+        except ValueError as e:
+            ap.error(str(e))
     if bool(args.tls_cert) != bool(args.tls_key):
         ap.error("--tls-cert and --tls-key must be given together")
 
@@ -50,7 +67,8 @@ def main(argv=None):
     pg = PgServer(db, args.host, args.pg_port, args.password,
                   tls_cert=args.tls_cert, tls_key=args.tls_key,
                   hba_conf=args.hba_config,
-                  proxy_protocol=args.proxy_protocol)
+                  proxy_protocol=args.proxy_protocol,
+                  listen=args.listen)
 
     async def run():
         stop = asyncio.Event()

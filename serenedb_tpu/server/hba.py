@@ -10,8 +10,9 @@ connection by first match. This module re-implements that contract:
     hostssl all       app   0.0.0.0/0     scram-sha-256
     host    all       all   all           reject
 
-- type: local (unix socket — mapped to loopback here), host (TCP),
-  hostssl (TLS only), hostnossl (non-TLS only)
+- type: local (unix-socket peers only — PG semantics), host (TCP),
+  hostssl (TLS only), hostnossl (non-TLS only); host-family rules never
+  match unix peers and local rules never match TCP peers
 - database/user: 'all', a name, or a comma-separated list
 - address: CIDR ('10.0.0.0/8'), bare IP (host mask), 'all', or
   'samehost' (any of this machine's addresses); 'samenet' is rejected
@@ -48,12 +49,18 @@ class HbaRule:
 
     def matches(self, database: str, user: str, addr: Optional[str],
                 tls: bool) -> bool:
+        is_unix = addr is not None and not _is_ip(addr)
         if self.conn_type == "hostssl" and not tls:
             return False
         if self.conn_type == "hostnossl" and tls:
             return False
-        if self.conn_type == "local" and addr is not None and \
-                not _is_loopback(addr):
+        if self.conn_type == "local":
+            # PG: local rules match unix-socket peers ONLY
+            if addr is not None and not is_unix:
+                return False
+        elif is_unix:
+            # PG: host/hostssl/hostnossl never match unix peers — a
+            # 'host all all all trust' line must not fail open for them
             return False
         if "all" not in self.databases and database not in self.databases:
             return False
@@ -79,6 +86,14 @@ class HbaRule:
             if ip not in self.network:
                 return False
         return True
+
+
+def _is_ip(addr: str) -> bool:
+    try:
+        ipaddress.ip_address(addr)
+        return True
+    except ValueError:
+        return False
 
 
 def _is_loopback(addr: str) -> bool:

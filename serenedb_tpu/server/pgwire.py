@@ -443,7 +443,12 @@ class PgSession:
         if self.server.hba_rules is not None:
             peer = getattr(self, "proxied_peer", None) or \
                 self.w.t.get_extra_info("peername")
-            addr = peer[0] if isinstance(peer, tuple) else None
+            if isinstance(peer, tuple):
+                addr = peer[0]
+            else:
+                # unix-socket peers have a path (or empty) peername —
+                # they match `local` HBA rules
+                addr = str(peer) if peer else "/unix-socket"
             rule = hba.match_rule(self.server.hba_rules, database, user,
                                   addr, self.tls_active)
             if rule is None or rule.method == "reject":
@@ -1090,14 +1095,48 @@ def _count_params(st: ast.Statement) -> int:
     return mx
 
 
+def _remove_stale_unix_socket(path: str) -> None:
+    """Unlink `path` only when it is a socket nobody answers on — a live
+    server's socket raises 98 (address in use) instead of being stolen,
+    and a regular file at the path is never deleted."""
+    import socket as _socket
+    import stat as _stat
+    try:
+        st = os.stat(path)
+    except OSError:
+        return
+    if not _stat.S_ISSOCK(st.st_mode):
+        raise errors.SqlError(
+            "58030", f"listen path {path!r} exists and is not a socket")
+    probe = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    probe.settimeout(1.0)
+    try:
+        probe.connect(path)
+        probe.close()
+        raise errors.SqlError(
+            "55006", f"unix socket {path!r} is in use by a live server")
+    except (ConnectionRefusedError, _socket.timeout, FileNotFoundError):
+        probe.close()
+        try:
+            os.unlink(path)   # stale socket from a crashed process
+        except OSError:
+            pass
+    except OSError:
+        probe.close()
+
+
 class PgServer:
     def __init__(self, db: Database, host: str = "127.0.0.1",
                  port: int = 5432, password: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
                  hba_conf: Optional[str] = None,
-                 proxy_protocol: str = "off"):
+                 proxy_protocol: str = "off",
+                 listen: Optional[list[str]] = None):
         self.db = db
+        #: extra listener specs (tcp://… / unix://…) beyond host:port
+        #: (reference: listen_spec.h multi-spec --listen)
+        self.listen_specs = list(listen or [])
         #: HAProxy PROXY preface handling: off | optional | require
         #: (reference: server/network/proxy_protocol.cpp)
         self.proxy_protocol = proxy_protocol
@@ -1151,19 +1190,59 @@ class PgServer:
         session.conn.request_cancel()
 
     async def _client(self, reader, writer):
-        await PgSession(self, reader, writer).run()
+        conns = getattr(self, "_live_writers", None)
+        if conns is None:
+            conns = self._live_writers = set()
+        conns.add(writer)
+        try:
+            await PgSession(self, reader, writer).run()
+        finally:
+            conns.discard(writer)
 
     async def start(self):
+        from .listen import parse_listen_spec
         self._server = await asyncio.start_server(
             self._client, self.host, self.port)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("pg", f"listening on {addr[0]}:{addr[1]}")
+        self._extra_servers = []
+        self._unix_paths = []
+        for raw in self.listen_specs:
+            spec = parse_listen_spec(raw, default_host=self.host)
+            if spec.kind == "unix":
+                _remove_stale_unix_socket(spec.path)
+                srv = await asyncio.start_unix_server(
+                    self._client, path=spec.path)
+                self._unix_paths.append(spec.path)
+            else:
+                srv = await asyncio.start_server(
+                    self._client, spec.host, spec.port)
+            self._extra_servers.append(srv)
+            log.info("pg", f"listening on {spec}")
 
     async def stop(self):
+        # ordered teardown (reference serened.cpp): stop accepting, then
+        # close live client transports — wait_closed() would otherwise
+        # block forever on an idle connected client
         if self._server is not None:
             self._server.close()
+        for srv in getattr(self, "_extra_servers", []):
+            srv.close()
+        for w in list(getattr(self, "_live_writers", ())):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._server is not None:
             await self._server.wait_closed()
+        for srv in getattr(self, "_extra_servers", []):
+            await srv.wait_closed()
+        for path in getattr(self, "_unix_paths", []):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         self.pool.shutdown(wait=False)
 
     def run_forever(self):

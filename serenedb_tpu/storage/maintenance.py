@@ -73,7 +73,19 @@ class MaintenanceManager:
             return False  # abandoned db must not checkpoint post-"kill"
         did = self._refresh_pass()
         did = self._checkpoint_pass() or did
+        did = self._drop_gc_pass() or did
         return did
+
+    def _drop_gc_pass(self) -> bool:
+        """Reclaim tombstoned snapshots of dropped tables (the async-drop
+        background half; reference: server/catalog/drop_task.cpp)."""
+        store = self.db.store
+        if store is None:
+            return False
+        n = store.gc_tombstones()
+        if n:
+            log.info("maintenance", f"reclaimed {n} dropped snapshot(s)")
+        return bool(n)
 
     def _refresh_pass(self) -> bool:
         from ..engine import _refresh_indexes

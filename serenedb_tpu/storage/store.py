@@ -49,6 +49,9 @@ class Store:
         self._lock = threading.RLock()
         self.meta: dict = {"next_table_id": 1, "schemas": ["main"],
                            "tables": {}, "views": {}, "indexes": {}}
+        # a crash between DROP's tombstone rename and the maintenance GC
+        # leaves .dropped files — reclaim them on boot
+        self.gc_tombstones()
 
     def _acquire_lock(self):
         # datadir lockfile (reference: libs/basics lockfile)
@@ -138,6 +141,38 @@ class Store:
             os.remove(self.snapshot_path(table_id))
         except OSError:
             pass
+
+    # -- async drops (reference: server/catalog/drop_task.cpp — the DROP
+    # statement only tombstones data files; a background task reclaims
+    # them, so large drops never stall the DDL path) -----------------------
+
+    def tombstone_snapshot(self, table_id: int) -> None:
+        """Rename the snapshot to a .dropped tombstone (atomic, O(1));
+        gc_tombstones() reclaims it from the maintenance loop."""
+        path = self.snapshot_path(table_id)
+        try:
+            os.replace(path, f"{path}.dropped")
+        except OSError:
+            pass   # no snapshot yet (never checkpointed) — nothing to do
+
+    def gc_tombstones(self) -> int:
+        """Delete tombstoned snapshots; returns the number reclaimed.
+        Also called at startup, so tombstones from a crash between DROP
+        and GC are reclaimed on the next boot."""
+        tables_dir = os.path.join(self.path, "tables")
+        n = 0
+        try:
+            entries = os.listdir(tables_dir)
+        except OSError:
+            return 0
+        for name in entries:
+            if name.endswith(".dropped"):
+                try:
+                    os.remove(os.path.join(tables_dir, name))
+                    n += 1
+                except OSError:
+                    pass
+        return n
 
     # -- commit / checkpoint --------------------------------------------------
 

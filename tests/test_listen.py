@@ -1,0 +1,186 @@
+"""Multi-spec listeners: tcp:// and unix:// endpoints (reference:
+server/network/listen_spec.h) + build id."""
+
+import asyncio
+import socket
+import struct
+import threading
+
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.server.listen import ListenSpec, parse_listen_spec
+from serenedb_tpu.server.pgwire import PgServer
+
+
+def test_parse_listen_specs():
+    assert parse_listen_spec("tcp://0.0.0.0:5433") == \
+        ListenSpec("tcp", host="0.0.0.0", port=5433)
+    assert parse_listen_spec("127.0.0.1:9") == \
+        ListenSpec("tcp", host="127.0.0.1", port=9)
+    assert parse_listen_spec(":7777") == \
+        ListenSpec("tcp", host="0.0.0.0", port=7777)
+    assert parse_listen_spec("5433", default_host="10.0.0.1") == \
+        ListenSpec("tcp", host="10.0.0.1", port=5433)
+    assert parse_listen_spec("unix:///tmp/s.sock") == \
+        ListenSpec("unix", path="/tmp/s.sock")
+    assert parse_listen_spec("unix:/tmp/s2.sock") == \
+        ListenSpec("unix", path="/tmp/s2.sock")
+    assert parse_listen_spec("[::1]:6000") == \
+        ListenSpec("tcp", host="::1", port=6000)
+    for bad in ("unix://", "nonsense", ""):
+        with pytest.raises(ValueError):
+            parse_listen_spec(bad)
+
+
+@pytest.fixture
+def multi_server(tmp_path):
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t (a INT)")
+    c.execute("INSERT INTO t VALUES (42)")
+    sock_path = str(tmp_path / "pg.sock")
+    srv = PgServer(db, port=0,
+                   listen=["tcp://127.0.0.1:0", f"unix://{sock_path}"])
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            await srv.start()
+            started.set()
+            await asyncio.Event().wait()
+        try:
+            loop.run_until_complete(go())
+        except RuntimeError:
+            pass
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield srv, sock_path, loop
+    fut = asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+    fut.result(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+from test_pgwire import RawPg  # noqa: E402  (proven raw-wire client)
+
+
+def test_unix_socket_listener(multi_server):
+    srv, sock_path, _ = multi_server
+    # reuse RawPg's protocol implementation over an AF_UNIX transport
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(15)
+    sock.connect(sock_path)
+    orig = socket.create_connection
+    socket.create_connection = lambda *a, **k: sock
+    try:
+        cl = RawPg(0)
+    finally:
+        socket.create_connection = orig
+    hdr, rows, tags, errs = cl.query("SELECT a FROM t")
+    assert rows == [("42",)], rows
+    sock.close()
+
+
+def test_extra_tcp_listener(multi_server):
+    srv, _, _ = multi_server
+    port = srv._extra_servers[0].sockets[0].getsockname()[1]
+    cl = RawPg(port)
+    assert cl.query("SELECT a FROM t")[1] == [("42",)]
+    cl2 = RawPg(srv.port)   # the primary listener still answers too
+    assert cl2.query("SELECT a FROM t")[1] == [("42",)]
+
+
+def test_unix_socket_removed_on_stop(tmp_path):
+    import os
+    db = Database()
+    path = str(tmp_path / "gone.sock")
+
+    async def cycle():
+        srv = PgServer(db, port=0, listen=[f"unix://{path}"])
+        await srv.start()
+        assert os.path.exists(path)
+        await srv.stop()
+
+    asyncio.run(cycle())
+    assert not os.path.exists(path)
+
+
+def test_build_id():
+    import serenedb_tpu
+    s = serenedb_tpu.build_id()
+    assert s.startswith("serenedb-tpu 0.1.0")
+    assert "(" in s
+
+
+def test_hba_unix_vs_host_rules():
+    from serenedb_tpu.server.hba import match_rule, parse_hba
+    rules = parse_hba("host all all all trust\n"
+                      "local all all scram-sha-256\n")
+    # TCP peer hits the host rule
+    assert match_rule(rules, "db", "u", "10.0.0.1", False).method == "trust"
+    # unix peer must NOT fail open through 'host all all all'
+    r = match_rule(rules, "db", "u", "/unix-socket", False)
+    assert r.method == "scram-sha-256"
+    # and local rules never match TCP peers
+    rules2 = parse_hba("local all all trust\n")
+    assert match_rule(rules2, "db", "u", "10.0.0.1", False) is None
+
+
+def test_stale_socket_guard(tmp_path):
+    import os
+
+    from serenedb_tpu import errors
+    from serenedb_tpu.server.pgwire import _remove_stale_unix_socket
+    # regular file at the path: refuse to delete
+    f = tmp_path / "not_a_socket"
+    f.write_text("precious")
+    with pytest.raises(errors.SqlError):
+        _remove_stale_unix_socket(str(f))
+    assert f.read_text() == "precious"
+    # stale socket: removed
+    import socket as s
+    sp = str(tmp_path / "stale.sock")
+    sk = s.socket(s.AF_UNIX)
+    sk.bind(sp)
+    sk.close()   # bound but never listened/closed -> connect refused
+    _remove_stale_unix_socket(sp)
+    assert not os.path.exists(sp)
+
+
+def test_live_socket_not_stolen(tmp_path):
+    from serenedb_tpu import errors
+    from serenedb_tpu.server.pgwire import _remove_stale_unix_socket
+    db = Database()
+    path = str(tmp_path / "live.sock")
+
+    async def check():
+        srv = PgServer(db, port=0, listen=[f"unix://{path}"])
+        await srv.start()
+        try:
+            with pytest.raises(errors.SqlError):
+                _remove_stale_unix_socket(path)
+        finally:
+            await srv.stop()
+
+    asyncio.run(check())
+
+
+def test_sql_features_dashed_ids():
+    db = Database()
+    c = db.connect()
+    r = c.execute("SELECT is_supported FROM information_schema."
+                  "sql_features WHERE feature_id = 'E061-04'").rows()
+    assert r == [("YES",)]
+
+
+def test_serened_rejects_bad_listen_spec(capsys):
+    from serenedb_tpu.serened import main
+    with pytest.raises(SystemExit):
+        main(["--listen", "unix://", "--pg-port", "0",
+              "--http-port", "0"])
+    with pytest.raises(SystemExit):
+        main(["--listen", "[::1", "--pg-port", "0", "--http-port", "0"])

@@ -337,3 +337,54 @@ def test_wal_failed_group_write_rolled_back(tmp_path, monkeypatch):
     wal2.recover(lambda t: 0, lambda tick, op: seen.append(tick))
     assert seen == [1, 3]
     wal2.close()
+
+
+def test_async_drop_tombstones(tmp_path):
+    """DROP tombstones the snapshot (O(1) rename); the maintenance GC
+    pass reclaims it; a boot after a crash-between also reclaims
+    (reference: server/catalog/drop_task.cpp)."""
+    import os
+
+    from serenedb_tpu.engine import Database
+    d = str(tmp_path / "dd")
+    db = Database(d)
+    c = db.connect()
+    c.execute("CREATE TABLE victim (a INT)")
+    c.execute("INSERT INTO victim VALUES (1), (2)")
+    c.execute("VACUUM")  # force a checkpoint so a snapshot exists
+    tdir = os.path.join(d, "tables")
+    snaps = [f for f in os.listdir(tdir) if f.endswith(".parquet")]
+    assert snaps
+    c.execute("DROP TABLE victim")
+    dropped = [f for f in os.listdir(tdir) if f.endswith(".dropped")]
+    live = [f for f in os.listdir(tdir) if f.endswith(".parquet")]
+    assert dropped and not live
+    n = db.store.gc_tombstones()
+    assert n == len(dropped)
+    assert not [f for f in os.listdir(tdir) if f.endswith(".dropped")]
+    db.close()
+    # crash-between simulation: plant a tombstone, re-open reclaims it
+    with open(os.path.join(tdir, "999.parquet.dropped"), "w") as f:
+        f.write("x")
+    db2 = Database(d)
+    assert not [f for f in os.listdir(tdir) if f.endswith(".dropped")]
+    db2.close()
+
+
+def test_maintenance_runs_drop_gc(tmp_path):
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.storage.maintenance import MaintenanceManager
+    d = str(tmp_path / "dd2")
+    db = Database(d)
+    c = db.connect()
+    c.execute("CREATE TABLE v2 (a INT)")
+    c.execute("INSERT INTO v2 VALUES (1)")
+    c.execute("VACUUM")
+    c.execute("DROP TABLE v2")
+    import os
+    tdir = os.path.join(d, "tables")
+    assert [f for f in os.listdir(tdir) if f.endswith(".dropped")]
+    mm = MaintenanceManager(db)
+    assert mm.run_once() is True
+    assert not [f for f in os.listdir(tdir) if f.endswith(".dropped")]
+    db.close()
