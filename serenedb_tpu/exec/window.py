@@ -34,6 +34,9 @@ class WindowSpec:
     order_by: list[tuple[BoundExpr, bool]]   # (expr, desc)
     type: dt.SqlType
     default: Optional[object] = None   # lag/lead 3rd arg (PG default NULL)
+    #: ROWS frame (start_off, end_off), None member = unbounded; None =
+    #: default frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)
+    frame: Optional[tuple] = None
 
 
 def window_result_type(func: str, arg_type: Optional[dt.SqlType]) -> dt.SqlType:
@@ -182,6 +185,14 @@ class WindowNode(PlanNode):
             if spec.default is not None:
                 # rows outside the partition take the default VALUE
                 res_valid = res_valid | ~same_part
+        elif f in ("first_value", "last_value") and spec.frame is not None:
+            starts_f, ends_f = _frame_bounds(spec.frame, boundaries, n)
+            empty = starts_f > ends_f
+            pick = starts_f if f == "first_value" else ends_f
+            pick = np.clip(pick, 0, max(n - 1, 0))
+            result = vals[pick] if vals is not None else np.zeros(n)
+            res_valid = (valid[pick] if valid is not None
+                         else np.ones(n, dtype=bool)) & ~empty
         elif f in ("first_value", "last_value"):
             if f == "first_value":
                 result = vals[part_start] if vals is not None else None
@@ -200,7 +211,11 @@ class WindowNode(PlanNode):
                         part_end[st:en + 1] = en
                     result = vals[part_end]
                     res_valid = valid[part_end]
-        else:  # count/sum/min/max/avg
+        elif spec.frame is not None:  # framed count/sum/min/max/avg
+            result, res_valid = _window_agg_framed(
+                f, vals, valid, boundaries, spec.frame, n,
+                integer=spec.type.is_integer)
+        else:  # count/sum/min/max/avg, default frame
             running = bool(spec.order_by)
             result, res_valid = _window_agg(
                 f, vals, valid, boundaries, same_peer, running, n,
@@ -281,4 +296,113 @@ def _window_agg(f, vals, valid, boundaries, same_peer,
                 i = j - 1
             else:
                 i -= 1
+    return result, res_valid
+
+
+def _frame_bounds(frame, boundaries, n):
+    """Per-row inclusive [start, end] row indexes of a ROWS frame,
+    clamped to the row's partition."""
+    part_start = np.maximum.accumulate(
+        np.where(boundaries, np.arange(n), 0))
+    part_end = np.zeros(n, dtype=np.int64)
+    ends = np.flatnonzero(np.concatenate([boundaries[1:], [True]]))
+    starts = np.flatnonzero(boundaries)
+    for st, en in zip(starts, ends):
+        part_end[st:en + 1] = en
+    idx = np.arange(n)
+    s_off, e_off = frame
+    lo = part_start if s_off is None else np.maximum(part_start,
+                                                     idx + s_off)
+    hi = part_end if e_off is None else np.minimum(part_end, idx + e_off)
+    return lo, hi
+
+
+def _window_agg_framed(f, vals, valid, boundaries, frame, n,
+                       integer: bool = False):
+    """ROWS-framed aggregates: prefix sums give count/sum/avg in O(n);
+    min/max reduce each frame slice directly (frames are small in
+    practice — bounded by the offsets)."""
+    lo, hi = _frame_bounds(frame, boundaries, n)
+    empty = lo > hi
+    result = np.zeros(n, dtype=np.int64 if integer else np.float64)
+    res_valid = np.ones(n, dtype=bool)
+    if vals is None:    # count(*)
+        result = np.where(empty, 0, hi - lo + 1)
+        return result, res_valid
+    v_ok = valid if valid is not None else np.ones(n, dtype=bool)
+    if f in ("count", "sum", "avg"):
+        acc = np.where(v_ok, vals, 0)
+        ps = np.concatenate([[0], np.cumsum(
+            acc.astype(np.int64 if integer else np.float64))])
+        pc = np.concatenate([[0], np.cumsum(v_ok.astype(np.int64))])
+        lo_c = np.clip(lo, 0, n)
+        hi_c = np.clip(hi + 1, 0, n)
+        cnt = np.where(empty, 0, pc[hi_c] - pc[lo_c])
+        if f == "count":
+            return cnt, res_valid
+        ssum = np.where(empty, 0, ps[hi_c] - ps[lo_c])
+        if f == "sum":
+            return ssum, cnt > 0
+        with np.errstate(invalid="ignore", divide="ignore"):
+            av = np.where(cnt > 0, ssum / np.maximum(cnt, 1), 0.0)
+        return av, cnt > 0
+    # min/max. Unbounded sides use per-partition prefix/suffix scans
+    # (O(n)); only genuinely bounded two-sided frames take the per-row
+    # slice loop, whose width is capped by the constant offsets.
+    s_off, e_off = frame
+    if integer:   # int64 end-to-end: float64 would round past 2^53
+        iv = vals.astype(np.int64)
+        info = np.iinfo(np.int64)
+        sent_min = np.where(v_ok, iv, info.max)
+        sent_max = np.where(v_ok, iv, info.min)
+    else:
+        fv = vals.astype(np.float64)
+        sent_min = np.where(v_ok, fv, np.inf)
+        sent_max = np.where(v_ok, fv, -np.inf)
+    pc = np.concatenate([[0], np.cumsum(v_ok.astype(np.int64))])
+    lo_c = np.clip(lo, 0, n)
+    hi_c = np.clip(hi + 1, 0, n)
+    any_valid = (pc[hi_c] - pc[lo_c]) > 0
+    res_valid = any_valid & ~empty
+
+    def scan_fwd(a, op):
+        out = a.copy()
+        for i in range(1, n):
+            if not boundaries[i]:
+                out[i] = op(out[i], out[i - 1])
+        return out
+
+    def scan_bwd(a, op):
+        out = a.copy()
+        part_next = np.concatenate([boundaries[1:], [True]])
+        for i in range(n - 2, -1, -1):
+            if not part_next[i]:
+                out[i] = op(out[i], out[i + 1])
+        return out
+
+    npop = np.minimum if f == "min" else np.maximum
+    src = sent_min if f == "min" else sent_max
+    if s_off is None and e_off is None:
+        run = scan_fwd(src, npop)
+        ends = np.flatnonzero(np.concatenate([boundaries[1:], [True]]))
+        starts = np.flatnonzero(boundaries)
+        for st, en in zip(starts, ends):
+            run[st:en + 1] = run[en]
+        result = run
+    elif s_off is None:
+        run = scan_fwd(src, npop)          # min/max from partition start
+        result = run[np.clip(hi, 0, n - 1)]
+    elif e_off is None:
+        run = scan_bwd(src, npop)          # min/max to partition end
+        result = run[np.clip(lo, 0, n - 1)]
+    else:
+        result = np.zeros(n, dtype=src.dtype)
+        for i in range(n):
+            if not res_valid[i]:
+                continue
+            sl = slice(int(lo[i]), int(hi[i]) + 1)
+            result[i] = src[sl].min() if f == "min" else src[sl].max()
+    result = np.where(res_valid, result, 0)
+    if integer:
+        result = result.astype(np.int64)
     return result, res_valid

@@ -460,6 +460,39 @@ def _concat_skip_nulls(ts):
 _REGISTRY["concat"] = _concat_skip_nulls
 
 
+def _concat_ws(ts):
+    """concat_ws(sep, ...) joins non-NULL arguments with the separator
+    (PG); a NULL separator yields NULL."""
+    if len(ts) < 1:
+        return None
+
+    def impl(cols, n):
+        sep_col = cols[0]
+        sep_valid = sep_col.valid_mask()
+        seps = string_values(sep_col) if sep_col.type.is_string else \
+            np.asarray([_pg_text(v) for v in sep_col.to_pylist()],
+                       dtype=object).astype(str)
+        pieces = []
+        for c in cols[1:]:
+            valid = c.valid_mask()
+            if c.type.is_string:
+                vals = string_values(c)
+            else:
+                vals = np.asarray([_pg_text(v) for v in c.to_pylist()],
+                                  dtype=object).astype(str)
+            pieces.append((vals, valid))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            parts = [str(v[i]) for v, valid in pieces if valid[i]]
+            out[i] = str(seps[i]).join(parts)
+        return make_string_column(out, None if sep_valid.all()
+                                  else sep_valid)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+_REGISTRY["concat_ws"] = _concat_ws
+
+
 def _pg_text(v) -> str:
     if v is None:
         return ""
@@ -518,8 +551,26 @@ for name, fn in [("floor", np.floor), ("ceil", np.ceil), ("ceiling", np.ceil),
                  ("exp", np.exp), ("sin", np.sin), ("cos", np.cos),
                  ("tan", np.tan), ("atan", np.arctan),
                  ("degrees", np.degrees), ("radians", np.radians),
-                 ("trunc", np.trunc), ("cbrt", np.cbrt)]:
+                 ("cbrt", np.cbrt)]:
     _REGISTRY[name] = _unary_math(fn)
+
+
+@register("trunc")
+def _trunc(ts):
+    """trunc(x[, digits]): toward zero, optional decimal places (PG
+    trunc(numeric, int))."""
+    if len(ts) not in (1, 2):
+        return None
+    if len(ts) == 1:
+        return _unary_math(np.trunc)(ts)
+
+    def impl(cols, n):
+        x = cols[0].data.astype(np.float64)
+        d = cols[1].data.astype(np.int64)
+        scale = np.power(10.0, d)
+        data = np.trunc(x * scale) / scale
+        return _result(dt.DOUBLE, data, cols)
+    return FunctionResolution(dt.DOUBLE, impl)
 
 _REGISTRY["sqrt"] = _unary_math(
     np.sqrt, domain=lambda x: x >= 0,
@@ -1402,6 +1453,34 @@ def _regexp_match(ts):
         return make_string_column(np.asarray(out, dtype=object).astype(str),
                                   validity)
     return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("regexp_split_to_array")
+def _regexp_split_to_array(ts):
+    """regexp_split_to_array(text, pattern[, flags]) → text array
+    (physical JSON, rendered PG-style)."""
+    if len(ts) not in (2, 3):
+        return None
+
+    def impl(cols, n):
+        s = string_values(cols[0])
+        pat = string_values(cols[1])
+        flags = string_values(cols[2]) if len(cols) > 2 else None
+        out = []
+        for i in range(n):
+            fl = re.IGNORECASE if flags is not None and "i" in flags[i] \
+                else 0
+            try:
+                out.append(json.dumps(re.split(pat[i], s[i], flags=fl)))
+            except re.error as e:
+                raise errors.SqlError(
+                    "2201B", f"invalid regular expression: {e}")
+        col = make_string_column(
+            np.asarray(out, dtype=object).astype(str),
+            propagate_nulls(cols))
+        return Column(dt.array_of(dt.VARCHAR), col.data, col.validity,
+                      col.dictionary)
+    return FunctionResolution(dt.array_of(dt.VARCHAR), impl)
 
 
 # -- conditionals ----------------------------------------------------------

@@ -901,6 +901,32 @@ _SQL_FEATURES = [
 ]
 
 
+def _info_role_table_grants(db) -> MemTable:
+    """information_schema.role_table_grants / table_privileges
+    (reference: server/pg/information_schema — ACL rows per grantee)."""
+    spec = [("grantor", dt.VARCHAR), ("grantee", dt.VARCHAR),
+            ("table_catalog", dt.VARCHAR), ("table_schema", dt.VARCHAR),
+            ("table_name", dt.VARCHAR), ("privilege_type", dt.VARCHAR),
+            ("is_grantable", dt.VARCHAR)]
+    rows: dict[str, list] = {c: [] for c, _ in spec}
+    roles = db.roles
+    with roles._lock:
+        acls = {k: {r: set(p) for r, p in v.items()}
+                for k, v in roles.acls.items()}
+    for tkey, acl in sorted(acls.items()):
+        schema, _, tname = tkey.rpartition(".")
+        for role, privs in sorted(acl.items()):
+            for p in sorted(privs):
+                rows["grantor"].append("serene")
+                rows["grantee"].append(role)
+                rows["table_catalog"].append("serene")
+                rows["table_schema"].append(schema or "main")
+                rows["table_name"].append(tname)
+                rows["privilege_type"].append(p.upper())
+                rows["is_grantable"].append("NO")
+    return _typed("role_table_grants", spec, rows)
+
+
 def _info_sql_features() -> MemTable:
     spec = [("feature_id", dt.VARCHAR), ("feature_name", dt.VARCHAR),
             ("sub_feature_id", dt.VARCHAR),
@@ -1169,6 +1195,8 @@ _BUILDERS: dict[str, Callable] = {
     "schemata": _info_schemata,
     "table_constraints": _info_table_constraints,
     "key_column_usage": _info_key_column_usage,
+    "role_table_grants": lambda db: _info_role_table_grants(db),
+    "table_privileges": lambda db: _info_role_table_grants(db),
     "sql_features": lambda db: _info_sql_features(),
     "sql_implementation_info": lambda db: _info_sql_implementation_info(),
     "sql_sizing": lambda db: _info_sql_sizing(),

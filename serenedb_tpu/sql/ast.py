@@ -95,6 +95,10 @@ class WindowFunc(Expr):
     func: "FuncCall"
     partition_by: list = None
     order_by: list = None     # list[OrderItem]
+    #: ROWS frame as (start, end) row offsets relative to the current
+    #: row; None member = unbounded in that direction; whole-field None =
+    #: the default frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)
+    frame: "tuple | None" = None
 
     def __post_init__(self):
         if self.partition_by is None:

@@ -832,6 +832,87 @@ def format_timestamp(us: int) -> str:
     return s.rstrip("0") if "." in s else s
 
 
+def _array_text_to_json(s: str) -> str:
+    """Array text input → the physical JSON form. Accepts the JSON form
+    itself and PG '{a,b}' literals (quotes, escapes, NULL, nesting);
+    anything else is 22P02."""
+    import json as _json
+    t = s.strip()
+    if t.startswith("["):
+        try:
+            v = _json.loads(t)
+            if isinstance(v, list):
+                return _json.dumps(v)
+        except _json.JSONDecodeError:
+            pass
+        raise errors.SqlError("22P02", f"invalid array literal: {s!r}")
+    if not t.startswith("{"):
+        raise errors.SqlError("22P02", f"invalid array literal: {s!r}")
+
+    pos = [0]
+
+    def parse_list():
+        assert t[pos[0]] == "{"
+        pos[0] += 1
+        out = []
+        while True:
+            while pos[0] < len(t) and t[pos[0]].isspace():
+                pos[0] += 1
+            if pos[0] >= len(t):
+                raise errors.SqlError("22P02",
+                                      f"invalid array literal: {s!r}")
+            ch = t[pos[0]]
+            if ch == "}":
+                pos[0] += 1
+                return out
+            if ch == "{":
+                out.append(parse_list())
+            elif ch == '"':
+                pos[0] += 1
+                buf = []
+                while pos[0] < len(t) and t[pos[0]] != '"':
+                    if t[pos[0]] == "\\" and pos[0] + 1 < len(t):
+                        pos[0] += 1
+                    buf.append(t[pos[0]])
+                    pos[0] += 1
+                if pos[0] >= len(t):
+                    raise errors.SqlError(
+                        "22P02", f"invalid array literal: {s!r}")
+                pos[0] += 1
+                out.append("".join(buf))
+            else:
+                j = pos[0]
+                while j < len(t) and t[j] not in ",}":
+                    j += 1
+                token = t[pos[0]:j].strip()
+                pos[0] = j
+                if token.upper() == "NULL":
+                    out.append(None)
+                else:
+                    try:
+                        out.append(int(token))
+                    except ValueError:
+                        try:
+                            out.append(float(token))
+                        except ValueError:
+                            out.append(token)
+            while pos[0] < len(t) and t[pos[0]].isspace():
+                pos[0] += 1
+            if pos[0] < len(t) and t[pos[0]] == ",":
+                pos[0] += 1
+            elif pos[0] < len(t) and t[pos[0]] == "}":
+                continue
+            elif pos[0] >= len(t):
+                raise errors.SqlError("22P02",
+                                      f"invalid array literal: {s!r}")
+
+    v = parse_list()
+    if t[pos[0]:].strip():
+        raise errors.SqlError("22P02", f"invalid array literal: {s!r}")
+    import json as _json
+    return _json.dumps(v)
+
+
 def cast_column(col: Column, target: dt.SqlType) -> Column:
     """PG-style CAST between supported types."""
     src = col.type
@@ -892,6 +973,24 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
         from .expr import make_string_column
         return make_string_column(np.asarray(out, dtype=object).astype(str),
                                   validity)
+    if target.id is dt.TypeId.ARRAY:
+        # array targets carry the ARRAY type (the generic to-string
+        # branch below would degrade INT[] to VARCHAR on INSERT); text
+        # input is normalized: PG '{...}' literals parse to the physical
+        # JSON form, JSON arrays pass through, garbage raises 22P02
+        if src.id is dt.TypeId.ARRAY:
+            return Column(target, col.data, validity, col.dictionary)
+        if src.is_string:
+            from .expr import make_string_column, string_values
+            vals = string_values(col)
+            ok = col.valid_mask()
+            out = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                out[i] = _array_text_to_json(str(v)) if ok[i] else ""
+            c2 = make_string_column(out, validity)
+            return Column(target, c2.data, validity, c2.dictionary)
+        raise errors.SqlError(
+            "42846", f"cannot cast type {src} to {target}")
     if target.is_string:
         if src.id is dt.TypeId.TIMESTAMP:
             out = [format_timestamp(v) for v in col.data]

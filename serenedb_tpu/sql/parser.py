@@ -20,7 +20,8 @@ from .lexer import T, Token, tokenize
 _KEYWORDS_STOP_ALIAS = {
     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
     "EXCEPT", "INTERSECT", "ON", "USING", "JOIN", "INNER", "LEFT", "RIGHT",
-    "FULL", "CROSS", "AS", "AND", "OR", "NOT", "SET", "WITH", "ASC", "DESC",
+    "FULL", "CROSS", "NATURAL", "AS", "AND", "OR", "NOT", "SET", "WITH",
+    "ASC", "DESC",
     "NULLS", "INTO", "VALUES", "RETURNING", "THEN", "ELSE", "END", "WHEN",
     "CASE", "IS", "IN", "BETWEEN", "LIKE", "ILIKE", "BY",
 }
@@ -404,10 +405,15 @@ class Parser:
                 ref = ast.JoinRef("cross", ref, right)
                 continue
             kind = None
+            natural = False
             if self.accept_kw("CROSS"):
                 self.expect_kw("JOIN")
                 ref = ast.JoinRef("cross", ref, self.parse_table_ref())
                 continue
+            if self.accept_kw("NATURAL"):
+                # NATURAL [INNER|LEFT|RIGHT|FULL [OUTER]] JOIN: USING
+                # over the shared column names, resolved at bind time
+                natural = True
             if self.accept_kw("INNER"):
                 kind = "inner"
                 self.expect_kw("JOIN")
@@ -426,8 +432,13 @@ class Parser:
             elif self.accept_kw("JOIN"):
                 kind = "inner"
             else:
+                if natural:
+                    raise errors.syntax("expected JOIN after NATURAL")
                 break
             right = self.parse_table_ref()
+            if natural:
+                ref = ast.JoinRef(kind, ref, right, using=["*natural*"])
+                continue
             if self.accept_kw("ON"):
                 cond = self.parse_expr()
                 ref = ast.JoinRef(kind, ref, right, condition=cond)
@@ -540,6 +551,9 @@ class Parser:
                         "is_distinct_from" if negated
                         else "is_not_distinct_from",
                         [left, ast.Literal(False)])
+                elif self.accept_kw("UNKNOWN"):
+                    # IS [NOT] UNKNOWN == IS [NOT] NULL over a boolean
+                    left = ast.IsNull(left, negated)
                 elif self.accept_kw("DISTINCT"):
                     self.expect_kw("FROM")
                     right = self.parse_additive_chain()
@@ -738,6 +752,10 @@ class Parser:
             while not self.at_op(")"):
                 self.next()
             self.expect_op(")")
+        if self.at_op("["):      # INT[] array type
+            self.next()
+            self.expect_op("]")
+            name = name + "[]"
         return name
 
     def parse_primary(self) -> ast.Expr:
@@ -967,12 +985,70 @@ class Parser:
                     order.append(self.parse_order_item())
                     while self.accept_op(","):
                         order.append(self.parse_order_item())
+                frame = None
                 if self.at_kw("ROWS", "RANGE", "GROUPS"):
-                    raise errors.unsupported("window frames")
+                    frame = self.parse_window_frame()
                 self.expect_op(")")
-                return ast.WindowFunc(call, partition, order)
+                return ast.WindowFunc(call, partition, order, frame)
             return call
         return ast.ColumnRef(parts)
+
+    def parse_window_frame(self):
+        """ROWS frames: (start_off, end_off) offsets, None = unbounded.
+        RANGE is accepted only in its default-frame spellings; GROUPS is
+        unsupported (PG parity: ROWS covers the reference workloads)."""
+        mode = self.ident().upper()
+        if mode == "GROUPS":
+            raise errors.unsupported("GROUPS window frames")
+
+        def bound(is_end: bool):
+            if self.accept_kw("UNBOUNDED"):
+                if self.accept_kw("PRECEDING"):
+                    return None, "preceding"
+                self.expect_kw("FOLLOWING")
+                return None, "following"
+            if self.accept_kw("CURRENT"):
+                self.expect_kw("ROW")
+                return 0, "current"
+            t = self.peek()
+            if t.kind is not T.NUMBER:
+                raise errors.syntax("expected frame bound")
+            nv = self.next().value
+            if self.accept_kw("PRECEDING"):
+                return -int(nv), "preceding"
+            self.expect_kw("FOLLOWING")
+            return int(nv), "following"
+
+        if self.accept_kw("BETWEEN"):
+            s_off, s_kind = bound(False)
+            self.expect_kw("AND")
+            e_off, e_kind = bound(True)
+        else:
+            s_off, s_kind = bound(False)
+            e_off, e_kind = 0, "current"
+        if s_kind == "following" and s_off is None:
+            raise errors.syntax(
+                "frame start cannot be UNBOUNDED FOLLOWING")
+        if e_kind == "preceding" and e_off is None:
+            raise errors.syntax(
+                "frame end cannot be UNBOUNDED PRECEDING")
+        # PG 42P20: the frame start may not lie after the frame end
+        if s_kind == "current" and e_kind == "preceding":
+            raise SqlError("42P20", "frame starting from current row "
+                                    "cannot have preceding rows")
+        if s_kind == "following" and e_kind in ("current", "preceding"):
+            raise SqlError("42P20", "frame starting from following row "
+                                    "cannot have preceding rows")
+        if s_off is not None and e_off is not None and s_off > e_off:
+            raise SqlError("42P20", "frame start cannot be after "
+                                    "frame end")
+        if mode == "RANGE":
+            # only the default-frame spellings of RANGE are supported
+            if (s_off, e_off) == (None, 0) and s_kind == "preceding":
+                return None
+            raise errors.unsupported(
+                "RANGE window frames (use ROWS)")
+        return (s_off, e_off)
 
     def parse_case(self) -> ast.Expr:
         self.expect_kw("CASE")

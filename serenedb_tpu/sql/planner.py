@@ -381,8 +381,28 @@ class Planner:
         right_keys: list[BoundExpr] = []
         residual: Optional[BoundExpr] = None
         merge_pairs: list[tuple[int, int]] = []
-        if ref.using:
-            for col in ref.using:
+        using = ref.using
+        kind = ref.kind
+        if using == ["*natural*"]:
+            # NATURAL JOIN: USING over the column names both sides share,
+            # in left-side order (PG). Resolved into LOCALS — the AST is
+            # shared by views/prepared statements and must stay pristine
+            # so each re-plan sees the current schemas. No shared
+            # columns → cross join.
+            rnames = {c.name.lower() for c in rscope.columns
+                      if not c.hidden}
+            shared = []
+            seen = set()
+            for c in lscope.columns:
+                nl = c.name.lower()
+                if not c.hidden and nl in rnames and nl not in seen:
+                    shared.append(c.name)
+                    seen.add(nl)
+            using = shared or None
+            if using is None:
+                kind = "cross"
+        if using:
+            for col in using:
                 lc = lscope.resolve([col])
                 rc = rscope.resolve([col])
                 left_keys.append(BoundColumn(lc.index, lc.type, lc.name))
@@ -393,8 +413,8 @@ class Planner:
                 # FULL join's merged key is COALESCE(l, r): the executor
                 # overwrites the left copy with right values on
                 # right-only rows (merge_pairs).
-                hide_right = ref.kind != "right"
-                if ref.kind == "full":
+                hide_right = kind != "right"
+                if kind == "full":
                     merge_pairs.append((lc.index, rc.index))
                 for c in combined.columns:
                     if c.name.lower() != col.lower():
@@ -417,7 +437,7 @@ class Planner:
                 bound = [binder.bind(p) for p in residual_parts]
                 residual = bound[0] if len(bound) == 1 else BoundFunc(
                     "and", bound, dt.BOOL, lambda cols, b: kleene_and(cols))
-        node = JoinNode(ref.kind, left, right, left_keys, right_keys,
+        node = JoinNode(kind, left, right, left_keys, right_keys,
                         residual, names, types, merge_pairs=merge_pairs)
         return node, combined
 
@@ -646,7 +666,7 @@ class Planner:
             specs.append(WindowSpec(
                 fname, arg, extra, partition, order,
                 window_result_type(fname, arg.type if arg else None),
-                default=default))
+                default=default, frame=w.frame))
         node = WindowNode(plan, specs)
         # preserve the child scope's table qualifiers; only the appended
         # #winN columns are unqualified

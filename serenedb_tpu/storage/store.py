@@ -136,12 +136,6 @@ class Store:
                 batch.column(name).type = t
         return batch
 
-    def drop_snapshot(self, table_id: int) -> None:
-        try:
-            os.remove(self.snapshot_path(table_id))
-        except OSError:
-            pass
-
     # -- async drops (reference: server/catalog/drop_task.cpp — the DROP
     # statement only tombstones data files; a background task reclaims
     # them, so large drops never stall the DDL path) -----------------------

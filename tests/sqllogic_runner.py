@@ -65,6 +65,12 @@ def parse_test_file(path: str) -> list[Record]:
             records.append(Record("restart", "", start_line))
             i += 1
             continue
+        if header[0] == "connection":
+            # multi-connection directive (reference: concurrency corpus):
+            # switches the active session; new names open new sessions
+            records.append(Record("connection", header[1], start_line))
+            i += 1
+            continue
         if header[0] == "statement":
             expect_error = None
             if len(header) > 1 and header[1] == "error":
@@ -161,8 +167,10 @@ def run_test_file_wire(execute, path: str) -> list[str]:
     failures = []
     for rec in parse_test_file(path):
         where = f"{path}:{rec.line}"
-        if rec.kind == "restart" or rec.expect_error == "__crash__":
-            failures.append(f"{where}: recovery directive in a wire run")
+        if rec.kind == "restart" or rec.kind == "connection" or \
+                rec.expect_error == "__crash__":
+            failures.append(f"{where}: recovery/connection directive in "
+                            "a wire run")
             break
         rows, err = execute(rec.sql)
         if rec.kind == "statement":
@@ -196,13 +204,21 @@ def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
     from serenedb_tpu.errors import SqlError
     from serenedb_tpu.utils.faults import FaultInjected
     failures = []
+    conns = {"default": conn}
     for rec in parse_test_file(path):
         where = f"{path}:{rec.line}"
+        if rec.kind == "connection":
+            name = rec.sql
+            if name not in conns:
+                conns[name] = conn.db.connect()
+            conn = conns[name]
+            continue
         if rec.kind == "restart":
             if reopen is None:
                 failures.append(f"{where}: restart in non-durable run")
                 break
             conn = reopen()
+            conns = {"default": conn}
             continue
         if rec.kind == "statement" and rec.expect_error == "__crash__":
             try:

@@ -347,3 +347,70 @@ def test_update_returning_zero_rows_keeps_shape():
     c.execute("CREATE TABLE zr (a INT)")
     r = c.execute("UPDATE zr SET a = 1 WHERE false RETURNING a")
     assert r.names == ["a"] and r.rows() == []
+
+
+def test_window_frame_validation_and_framed_minmax():
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    from serenedb_tpu.engine import Database
+    c = Database().connect()
+    c.execute("CREATE TABLE wf (t INT, v INT)")
+    c.execute("INSERT INTO wf VALUES (1, 5), (2, 1), (3, 9), (4, 3)")
+    # invalid frames raise 42P20 like PG
+    for bad in [
+        "SELECT sum(v) OVER (ORDER BY t ROWS BETWEEN CURRENT ROW AND "
+        "1 PRECEDING) FROM wf",
+        "SELECT sum(v) OVER (ORDER BY t ROWS 2 FOLLOWING) FROM wf",
+        "SELECT sum(v) OVER (ORDER BY t ROWS BETWEEN 3 PRECEDING AND "
+        "5 PRECEDING) FROM wf",
+    ]:
+        with _pytest.raises(_errors.SqlError):
+            c.execute(bad)
+    # unbounded-side framed min/max use the linear scan paths
+    r = [x[0] for x in c.execute(
+        "SELECT min(v) OVER (ORDER BY t ROWS BETWEEN UNBOUNDED PRECEDING "
+        "AND CURRENT ROW) FROM wf ORDER BY t").rows()]
+    assert r == [5, 1, 1, 1]
+    r = [x[0] for x in c.execute(
+        "SELECT max(v) OVER (ORDER BY t ROWS BETWEEN CURRENT ROW AND "
+        "UNBOUNDED FOLLOWING) FROM wf ORDER BY t").rows()]
+    assert r == [9, 9, 9, 3]
+    r = [x[0] for x in c.execute(
+        "SELECT max(v) OVER (ORDER BY t ROWS BETWEEN UNBOUNDED PRECEDING "
+        "AND UNBOUNDED FOLLOWING) FROM wf ORDER BY t").rows()]
+    assert r == [9, 9, 9, 9]
+
+
+def test_array_literal_cast_and_errors():
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    from serenedb_tpu.engine import Database
+    c = Database().connect()
+    c.execute("CREATE TABLE al (a INT[])")
+    c.execute("INSERT INTO al VALUES ('{1,2,3}'), ('[4,5]'), (NULL)")
+    r = sorted(x[0] for x in c.execute(
+        "SELECT array_length(a, 1) FROM al WHERE a IS NOT NULL").rows())
+    assert r == [2, 3]
+    with _pytest.raises(_errors.SqlError):
+        c.execute("INSERT INTO al VALUES ('nonsense')")
+    with _pytest.raises(_errors.SqlError):
+        c.execute("SELECT regexp_split_to_array('a', '[')")
+    with _pytest.raises(_errors.SqlError):
+        c.execute("SELECT trunc()")
+
+
+def test_natural_join_view_replans_after_alter():
+    """NATURAL JOIN resolution must not freeze into shared ASTs (views
+    re-plan against the live schema)."""
+    from serenedb_tpu.engine import Database
+    c = Database().connect()
+    c.execute("CREATE TABLE na (id INT, x TEXT)")
+    c.execute("CREATE TABLE nb (id INT, y TEXT)")
+    c.execute("INSERT INTO na VALUES (1, 'p'), (2, 'q')")
+    c.execute("INSERT INTO nb VALUES (2, 'Q')")
+    c.execute("CREATE VIEW nv AS SELECT * FROM na NATURAL JOIN nb")
+    assert c.execute("SELECT count(*) FROM nv").scalar() == 1
+    # run twice: the second plan must re-resolve, not reuse mutated state
+    assert c.execute("SELECT count(*) FROM nv").scalar() == 1

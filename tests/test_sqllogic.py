@@ -27,7 +27,11 @@ _ROOT = os.path.join(os.path.dirname(__file__), "sqllogic")
 FILES = sorted(
     glob.glob(os.path.join(_ROOT, "*.test"))
     + glob.glob(os.path.join(_ROOT, "any", "**", "*.test"), recursive=True)
-    + glob.glob(os.path.join(_ROOT, "sdb", "**", "*.test"), recursive=True))
+    + glob.glob(os.path.join(_ROOT, "sdb", "**", "*.test"), recursive=True)
+    # concurrency/: multi-session files using the `connection` directive
+    # (direct runners only — one wire socket is one session)
+    + glob.glob(os.path.join(_ROOT, "concurrency", "**", "*.test"),
+                recursive=True))
 
 RECOVERY_FILES = sorted(glob.glob(os.path.join(_ROOT, "recovery", "*.test")))
 
