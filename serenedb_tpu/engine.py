@@ -62,6 +62,26 @@ class ViewDef:
     sql: str
 
 
+def _view_references(node, table_key: str, depth: int = 0) -> bool:
+    """Does a view's AST reference the table (unqualified or any-schema
+    qualified last part)? Generic dataclass walk."""
+    import dataclasses
+    if depth > 200 or node is None:
+        return False
+    if isinstance(node, ast.NamedTable):
+        return node.parts[-1].lower() == table_key
+    if isinstance(node, (list, tuple)):
+        return any(_view_references(v, table_key, depth + 1) for v in node)
+    if isinstance(node, dict):
+        return any(_view_references(v, table_key, depth + 1)
+                   for v in node.values())
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return any(_view_references(getattr(node, f.name), table_key,
+                                    depth + 1)
+                   for f in dataclasses.fields(node))
+    return False
+
+
 class SchemaObj:
     def __init__(self, name: str):
         self.name = name
@@ -367,7 +387,17 @@ class Database(TableResolver):
         t = self._table_by_key(op.table)
         if t is None:
             return
-        _apply_ops(t, [(op.kind, op.batch, op.rows)])
+        batch = op.batch
+        if batch is not None:
+            # arrow WAL serde can't carry logical types the physical
+            # layout doesn't (ARRAY/RECORD ride as their text payloads) —
+            # re-stamp from the catalog schema so replayed appends don't
+            # degrade the table's column types
+            for name, ct in zip(t.column_names, t.column_types):
+                if ct.id in (dt.TypeId.ARRAY, dt.TypeId.RECORD) and \
+                        name in batch:
+                    batch.column(name).type = ct
+        _apply_ops(t, [(op.kind, batch, op.rows)])
 
     def _persist_catalog(self):
         if self.store is not None:
@@ -578,6 +608,30 @@ class Database(TableResolver):
                 raise errors.SqlError(errors.UNDEFINED_OBJECT,
                                       f'index "{name}" does not exist')
             store = s.views if kind == "view" else s.tables
+            if kind == "table" and key in s.tables and not cascade:
+                # PG 2BP01: views depending on the table block the drop
+                # (CASCADE drops them along)
+                for sname2, s2 in self.schemas.items():
+                    for vname, vdef in s2.views.items():
+                        if _view_references(vdef.query, key):
+                            raise errors.SqlError(
+                                "2BP01",
+                                f'cannot drop table "{name}" because '
+                                f'view "{vname}" depends on it')
+            if kind == "table" and key in s.tables and cascade:
+                for sname2, s2 in self.schemas.items():
+                    for vname in [v for v, d in s2.views.items()
+                                  if _view_references(d.query, key)]:
+                        del s2.views[vname]
+            if kind == "view" and key in s.views and not cascade:
+                for sname2, s2 in self.schemas.items():
+                    for vname, vdef in s2.views.items():
+                        if vname != key and \
+                                _view_references(vdef.query, key):
+                            raise errors.SqlError(
+                                "2BP01",
+                                f'cannot drop view "{name}" because '
+                                f'view "{vname}" depends on it')
             if key not in store:
                 if if_exists:
                     return
@@ -2499,6 +2553,33 @@ def _default_typed(table: MemTable, name: str):
     return bound.eval(one).decode(0), bound.type
 
 
+def _default_is_volatile(table: MemTable, name: str) -> bool:
+    """Defaults like nextval()/random() must evaluate once PER ROW (PG);
+    constant defaults evaluate once per statement."""
+    d = (getattr(table, "table_meta", None) or {}).get("defaults", {})
+    e = d.get(name)
+    if e is None:
+        return False
+    _VOLATILE = {"nextval", "random", "gen_random_uuid", "now",
+                 "clock_timestamp", "uuid_generate_v4"}
+
+    def walk(n) -> bool:
+        if isinstance(n, ast.FuncCall):
+            if n.name.lower() in _VOLATILE:
+                return True
+            return any(walk(a) for a in n.args)
+        for attr in ("operand", "left", "right", "expr"):
+            c = getattr(n, attr, None)
+            if isinstance(c, ast.Expr) and walk(c):
+                return True
+        args = getattr(n, "args", None)
+        if isinstance(args, list) and any(
+                isinstance(a, ast.Expr) and walk(a) for a in args):
+            return True
+        return False
+    return walk(e)
+
+
 def _check_enums(db: "Database", table: MemTable, aligned: Batch):
     """Enum-typed columns accept only their declared labels (22P02).
     Dictionary-encoded columns validate O(unique labels): only the
@@ -2554,6 +2635,13 @@ def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:
     for name, t in zip(table.column_names, table.column_types):
         if name in incoming.names:
             cols.append(_coerce(incoming.column(name), t))
+        elif _default_is_volatile(table, name):
+            # nextval()-style defaults: one evaluation PER ROW (PG)
+            vals, dvt = [], None
+            for _ in range(incoming.num_rows):
+                dv, dvt = _default_typed(table, name)
+                vals.append(dv)
+            cols.append(_coerce(Column.from_pylist(vals, dvt), t))
         else:
             dv, dvt = _default_typed(table, name)
             cols.append(_coerce(

@@ -603,6 +603,28 @@ def _dedup(rows: list[tuple]) -> list[tuple]:
     return out
 
 
+def _sort_key(v, desc: bool):
+    """Orderable wrapper for aggregate ORDER BY keys (None sorts last
+    asc / first desc, mirroring the default NULL placement)."""
+    null_rank = 1 if not desc else -1
+    if v is None:
+        return (null_rank, 0)
+    return (0, _Rev(v) if desc else v)
+
+
+class _Rev:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v
+
+
 #: aggregates whose result is unchanged by duplicate elimination — a
 #: DISTINCT qualifier on them runs the plain accumulator
 _DISTINCT_INVARIANT = {"min", "max", "bool_and", "bool_or", "every"}
@@ -800,8 +822,20 @@ class AggregateNode(PlanNode):
         if spec.func in ("string_agg", "array_agg"):
             import json as _json
             vals_all = arg.to_pylist()
+            row_order = range(len(codes))
+            if spec.order_by:
+                # aggregate ORDER BY: feed rows in key order (PG)
+                keys = []
+                for e, desc in reversed(spec.order_by):
+                    c = e.eval(full)
+                    _, rk = np.unique(c.data, return_inverse=True)
+                    rk = rk.astype(np.int64)
+                    rk = np.where(c.valid_mask(), rk, rk.max(initial=0) + 1)
+                    keys.append(-rk if desc else rk)
+                row_order = np.lexsort(tuple(keys))
             groups: dict[int, list] = {}
-            for i, code in enumerate(codes):
+            for i in row_order:
+                code = codes[i]
                 v = vals_all[i]
                 if v is None:
                     continue
@@ -935,7 +969,17 @@ class _ScalarAcc:
                 self.bool_acc = (self.bool_acc and bool(v)) \
                     if spec.func == "bool_and" else (self.bool_acc or bool(v))
         elif spec.func in ("string_agg", "array_agg"):
-            self.strings.extend(v for v in col.to_pylist() if v is not None)
+            if spec.order_by:
+                keycols = [(e.eval(b).to_pylist(), desc)
+                           for e, desc in spec.order_by]
+                for i, v in enumerate(col.to_pylist()):
+                    if v is not None:
+                        self.strings.append(
+                            (tuple(_sort_key(kc[i], desc)
+                                   for kc, desc in keycols), v))
+            else:
+                self.strings.extend(
+                    v for v in col.to_pylist() if v is not None)
         elif spec.func == "count":
             pass
         else:
@@ -983,13 +1027,15 @@ class _ScalarAcc:
             return Column.from_pylist([v], t)
         if spec.func in ("bool_and", "bool_or"):
             return Column.from_pylist([self.bool_acc], t)
-        if spec.func == "string_agg":
-            sep = spec.sep if spec.sep is not None else ""
-            v = sep.join(str(x) for x in self.strings) if self.strings \
-                else None
-            return Column.from_pylist([v], t)
-        if spec.func == "array_agg":
+        if spec.func in ("string_agg", "array_agg"):
+            items = self.strings
+            if spec.order_by and items:
+                items = [v for _k, v in sorted(items, key=lambda p: p[0])]
+            if spec.func == "string_agg":
+                sep = spec.sep if spec.sep is not None else ""
+                v = sep.join(str(x) for x in items) if items else None
+                return Column.from_pylist([v], t)
             import json as _json
-            v = _json.dumps(self.strings) if self.strings else None
+            v = _json.dumps(items) if items else None
             return Column.from_pylist([v], t)
         raise errors.unsupported(f"aggregate {spec.func}")

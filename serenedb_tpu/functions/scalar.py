@@ -2829,6 +2829,38 @@ def _array_to_string(ts):
     return FunctionResolution(dt.VARCHAR, impl)
 
 
+@register("json_build_object")
+def _json_build_object(ts):
+    """json_build_object(k1, v1, ...) — PG variadic builder."""
+    if len(ts) % 2 != 0:
+        return None
+
+    def impl(cols, n):
+        lists = [c.to_pylist() for c in cols]
+        out = []
+        for i in range(n):
+            obj = {}
+            for k in range(0, len(lists), 2):
+                key = lists[k][i]
+                if key is None:
+                    raise errors.SqlError(
+                        "22004",
+                        "null value not allowed for object key")
+                obj[str(key)] = lists[k + 1][i]
+            out.append(json.dumps(obj))
+        return make_string_column(np.asarray(out, dtype=object), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("json_build_array")
+def _json_build_array(ts):
+    def impl(cols, n):
+        lists = [c.to_pylist() for c in cols]
+        out = [json.dumps([lst[i] for lst in lists]) for i in range(n)]
+        return make_string_column(np.asarray(out, dtype=object), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
 @register("json_typeof")
 def _json_typeof(ts):
     if not ts or not _stringish(ts[0]):

@@ -88,6 +88,7 @@ class FuncCall(Expr):
     distinct: bool = False
     star: bool = False   # count(*)
     filter: Optional[Expr] = None   # aggregate FILTER (WHERE ...)
+    agg_order: Optional[list] = None  # string_agg(x, s ORDER BY ...)
 
 
 @dataclass

@@ -82,7 +82,7 @@ def _subst_colrefs(node, mapping: dict):
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_star",
              "stddev", "stddev_samp", "var_samp", "variance",
              "stddev_pop", "var_pop",
-             "string_agg", "array_agg", "bool_and", "bool_or"}
+             "string_agg", "array_agg", "bool_and", "bool_or", "every"}
 AGG_TWO_ARG = {"string_agg"}
 
 
@@ -299,6 +299,8 @@ class ExprBinder:
 
     def _bind_agg(self, e: ast.FuncCall) -> BoundExpr:
         name = e.name
+        if name == "every":   # SQL-standard alias of bool_and
+            name = "bool_and"
         if e.star or (name == "count" and not e.args):
             spec = AggSpec("count_star", None, False, dt.BIGINT)
         elif name in AGG_TWO_ARG and len(e.args) == 2:
@@ -319,8 +321,16 @@ class ExprBinder:
             spec = AggSpec(name, arg, e.distinct, out_t)
         if getattr(e, "filter", None) is not None:
             spec.filter = self.bind(e.filter)
+        if getattr(e, "agg_order", None):
+            if name not in ("string_agg", "array_agg"):
+                raise errors.unsupported(
+                    f"ORDER BY inside {name}()")
+            spec.order_by = [(self.bind(oi.expr), oi.desc)
+                             for oi in e.agg_order]
         key = repr((spec.func, _expr_key(spec.arg), spec.distinct,
-                    _expr_key(spec.filter)))
+                    _expr_key(spec.filter),
+                    tuple((_expr_key(k), d)
+                          for k, d in (spec.order_by or []))))
         if key in self._agg_keys:
             idx = self._agg_keys[key]
             return BoundAggRef(idx, self.aggs[idx].type)

@@ -136,6 +136,7 @@ class AggSpec:
     type: dt.SqlType
     sep: Optional[str] = None      # string_agg separator
     filter: Optional[BoundExpr] = None   # FILTER (WHERE ...) predicate
+    order_by: Optional[list] = None      # [(BoundExpr, desc)] agg ORDER BY
 
 
 # -- NULL-aware kernels used by the function library -----------------------

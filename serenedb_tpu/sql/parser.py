@@ -960,8 +960,16 @@ class Parser:
                 args.append(self.parse_expr())
                 while self.accept_op(","):
                     args.append(self.parse_expr())
+            agg_order = None
+            if self.accept_kw("ORDER"):
+                # ordered-set aggregates: string_agg(x, s ORDER BY k)
+                self.expect_kw("BY")
+                agg_order = [self.parse_order_item()]
+                while self.accept_op(","):
+                    agg_order.append(self.parse_order_item())
             self.expect_op(")")
-            call = ast.FuncCall(name, args, distinct, star)
+            call = ast.FuncCall(name, args, distinct, star,
+                                agg_order=agg_order)
             if self.at_kw("FILTER"):
                 self.next()
                 self.expect_op("(")
