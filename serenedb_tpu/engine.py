@@ -62,22 +62,29 @@ class ViewDef:
     sql: str
 
 
-def _view_references(node, table_key: str, depth: int = 0) -> bool:
-    """Does a view's AST reference the table (unqualified or any-schema
-    qualified last part)? Generic dataclass walk."""
+def _view_references(node, schema: str, table_key: str,
+                     depth: int = 0) -> bool:
+    """Does a view's AST reference relation (schema, name)? Unqualified
+    references resolve to schema "main" (the engine's _split rule), so a
+    view over s1.dup never blocks dropping s2.dup. Generic dataclass
+    walk."""
     import dataclasses
     if depth > 200 or node is None:
         return False
     if isinstance(node, ast.NamedTable):
-        return node.parts[-1].lower() == table_key
+        parts = node.parts
+        ref_schema = parts[-2].lower() if len(parts) >= 2 else "main"
+        return (parts[-1].lower() == table_key and
+                ref_schema == schema.lower())
     if isinstance(node, (list, tuple)):
-        return any(_view_references(v, table_key, depth + 1) for v in node)
+        return any(_view_references(v, schema, table_key, depth + 1)
+                   for v in node)
     if isinstance(node, dict):
-        return any(_view_references(v, table_key, depth + 1)
+        return any(_view_references(v, schema, table_key, depth + 1)
                    for v in node.values())
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
-        return any(_view_references(getattr(node, f.name), table_key,
-                                    depth + 1)
+        return any(_view_references(getattr(node, f.name), schema,
+                                    table_key, depth + 1)
                    for f in dataclasses.fields(node))
     return False
 
@@ -608,36 +615,45 @@ class Database(TableResolver):
                 raise errors.SqlError(errors.UNDEFINED_OBJECT,
                                       f'index "{name}" does not exist')
             store = s.views if kind == "view" else s.tables
-            if kind == "table" and key in s.tables and not cascade:
-                # PG 2BP01: views depending on the table block the drop
-                # (CASCADE drops them along)
-                for sname2, s2 in self.schemas.items():
-                    for vname, vdef in s2.views.items():
-                        if _view_references(vdef.query, key):
-                            raise errors.SqlError(
-                                "2BP01",
-                                f'cannot drop table "{name}" because '
-                                f'view "{vname}" depends on it')
-            if kind == "table" and key in s.tables and cascade:
-                for sname2, s2 in self.schemas.items():
-                    for vname in [v for v, d in s2.views.items()
-                                  if _view_references(d.query, key)]:
-                        del s2.views[vname]
-            if kind == "view" and key in s.views and not cascade:
-                for sname2, s2 in self.schemas.items():
-                    for vname, vdef in s2.views.items():
-                        if vname != key and \
-                                _view_references(vdef.query, key):
-                            raise errors.SqlError(
-                                "2BP01",
-                                f'cannot drop view "{name}" because '
-                                f'view "{vname}" depends on it')
+            if kind in ("table", "view") and key in store:
+                deps = self._dependent_views(schema, key,
+                                             exclude=(schema, key)
+                                             if kind == "view" else None)
+                if deps and not cascade:
+                    dn = deps[0][1]
+                    raise errors.SqlError(
+                        "2BP01",
+                        f'cannot drop {kind} "{name}" because view '
+                        f'"{dn}" depends on it')
+                for dschema, dname in deps:     # CASCADE: drop dependents
+                    self.schemas[dschema].views.pop(dname, None)
             if key not in store:
                 if if_exists:
                     return
                 raise errors.SqlError(errors.UNDEFINED_TABLE,
                                       f'{kind} "{name}" does not exist')
             del store[key]
+
+    def _dependent_views(self, schema: str, key: str,
+                         exclude=None) -> list[tuple[str, str]]:
+        """Transitive closure of views depending on relation (schema,
+        key) — view-on-view chains included, so CASCADE never dangles a
+        second-level view. Caller holds self.lock."""
+        out: list[tuple[str, str]] = []
+        frontier = [(schema, key)]
+        seen = {(schema.lower(), key)}
+        while frontier:
+            tschema, tkey = frontier.pop()
+            for sname2, s2 in self.schemas.items():
+                for vname, vdef in s2.views.items():
+                    ident = (sname2.lower(), vname)
+                    if ident in seen or ident == exclude:
+                        continue
+                    if _view_references(vdef.query, tschema, tkey):
+                        seen.add(ident)
+                        out.append((sname2, vname))
+                        frontier.append((sname2, vname))
+        return out
 
     def _schema(self, name: str, if_exists_ok: bool = False):
         s = self.schemas.get(name)
@@ -2553,14 +2569,27 @@ def _default_typed(table: MemTable, name: str):
     return bound.eval(one).decode(0), bound.type
 
 
+def _default_column(table: MemTable, name: str, n: int):
+    """Evaluate a volatile DEFAULT once per row: bind ONCE, evaluate over
+    an n-row dummy batch (row-vectorized impls like nextval() assign per
+    row)."""
+    d = (getattr(table, "table_meta", None) or {}).get("defaults", {})
+    e = d.get(name)
+    from .sql.binder import ExprBinder, Scope
+    bound = ExprBinder(Scope([]), []).bind(e)
+    rows = Batch(["__d"], [Column.from_pylist([0] * n)])
+    return bound.eval(rows), bound.type
+
+
 def _default_is_volatile(table: MemTable, name: str) -> bool:
     """Defaults like nextval()/random() must evaluate once PER ROW (PG);
-    constant defaults evaluate once per statement."""
+    constant defaults evaluate once per statement. now() is deliberately
+    absent: PG keeps it statement-stable."""
     d = (getattr(table, "table_meta", None) or {}).get("defaults", {})
     e = d.get(name)
     if e is None:
         return False
-    _VOLATILE = {"nextval", "random", "gen_random_uuid", "now",
+    _VOLATILE = {"nextval", "random", "gen_random_uuid",
                  "clock_timestamp", "uuid_generate_v4"}
 
     def walk(n) -> bool:
@@ -2636,12 +2665,10 @@ def _align_to_schema(table: MemTable, incoming: Batch) -> Batch:
         if name in incoming.names:
             cols.append(_coerce(incoming.column(name), t))
         elif _default_is_volatile(table, name):
-            # nextval()-style defaults: one evaluation PER ROW (PG)
-            vals, dvt = [], None
-            for _ in range(incoming.num_rows):
-                dv, dvt = _default_typed(table, name)
-                vals.append(dv)
-            cols.append(_coerce(Column.from_pylist(vals, dvt), t))
+            # nextval()-style defaults: one evaluation PER ROW (PG),
+            # bound once and vectorized over the row count
+            col, _dvt = _default_column(table, name, incoming.num_rows)
+            cols.append(_coerce(col, t))
         else:
             dv, dvt = _default_typed(table, name)
             cols.append(_coerce(

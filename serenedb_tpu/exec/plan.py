@@ -603,12 +603,12 @@ def _dedup(rows: list[tuple]) -> list[tuple]:
     return out
 
 
-def _sort_key(v, desc: bool):
-    """Orderable wrapper for aggregate ORDER BY keys (None sorts last
-    asc / first desc, mirroring the default NULL placement)."""
-    null_rank = 1 if not desc else -1
+def _sort_key(v, desc: bool, nulls_first=None):
+    """Orderable wrapper for aggregate ORDER BY keys; NULL placement
+    defaults to last asc / first desc, override via NULLS FIRST/LAST."""
+    null_first = nulls_first if nulls_first is not None else desc
     if v is None:
-        return (null_rank, 0)
+        return (-1 if null_first else 1, 0)
     return (0, _Rev(v) if desc else v)
 
 
@@ -824,14 +824,22 @@ class AggregateNode(PlanNode):
             vals_all = arg.to_pylist()
             row_order = range(len(codes))
             if spec.order_by:
-                # aggregate ORDER BY: feed rows in key order (PG)
+                # aggregate ORDER BY: feed rows in key order (PG),
+                # honoring NULLS FIRST/LAST (default: last asc, first
+                # desc)
                 keys = []
-                for e, desc in reversed(spec.order_by):
+                for e, desc, nf in reversed(spec.order_by):
                     c = e.eval(full)
                     _, rk = np.unique(c.data, return_inverse=True)
                     rk = rk.astype(np.int64)
-                    rk = np.where(c.valid_mask(), rk, rk.max(initial=0) + 1)
-                    keys.append(-rk if desc else rk)
+                    if desc:
+                        rk = -rk
+                    null_first = nf if nf is not None else desc
+                    nulls = ~c.valid_mask()
+                    keys.append(np.where(nulls, 0, rk))
+                    keys.append(np.where(nulls,
+                                         -1 if null_first else 1,
+                                         1 if null_first else -1))
                 row_order = np.lexsort(tuple(keys))
             groups: dict[int, list] = {}
             for i in row_order:
@@ -970,13 +978,13 @@ class _ScalarAcc:
                     if spec.func == "bool_and" else (self.bool_acc or bool(v))
         elif spec.func in ("string_agg", "array_agg"):
             if spec.order_by:
-                keycols = [(e.eval(b).to_pylist(), desc)
-                           for e, desc in spec.order_by]
+                keycols = [(e.eval(b).to_pylist(), desc, nf)
+                           for e, desc, nf in spec.order_by]
                 for i, v in enumerate(col.to_pylist()):
                     if v is not None:
                         self.strings.append(
-                            (tuple(_sort_key(kc[i], desc)
-                                   for kc, desc in keycols), v))
+                            (tuple(_sort_key(kc[i], desc, nf)
+                                   for kc, desc, nf in keycols), v))
             else:
                 self.strings.extend(
                     v for v in col.to_pylist() if v is not None)

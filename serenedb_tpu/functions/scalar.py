@@ -2829,6 +2829,19 @@ def _array_to_string(ts):
     return FunctionResolution(dt.VARCHAR, impl)
 
 
+def _json_values(col) -> list:
+    """Column → JSON-ready python values: temporal internals render as
+    their PG text (PG to_json semantics), everything else passes
+    through."""
+    vals = col.to_pylist()
+    if col.type.id in (dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                       dt.TypeId.INTERVAL):
+        from ..columnar.pgcopy import _scalar_field_text
+        return [None if v is None else _scalar_field_text(col.type, v)
+                for v in vals]
+    return vals
+
+
 @register("json_build_object")
 def _json_build_object(ts):
     """json_build_object(k1, v1, ...) — PG variadic builder."""
@@ -2836,7 +2849,7 @@ def _json_build_object(ts):
         return None
 
     def impl(cols, n):
-        lists = [c.to_pylist() for c in cols]
+        lists = [_json_values(c) for c in cols]
         out = []
         for i in range(n):
             obj = {}
@@ -2855,7 +2868,7 @@ def _json_build_object(ts):
 @register("json_build_array")
 def _json_build_array(ts):
     def impl(cols, n):
-        lists = [c.to_pylist() for c in cols]
+        lists = [_json_values(c) for c in cols]
         out = [json.dumps([lst[i] for lst in lists]) for i in range(n)]
         return make_string_column(np.asarray(out, dtype=object), None)
     return FunctionResolution(dt.VARCHAR, impl)

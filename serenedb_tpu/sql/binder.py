@@ -278,6 +278,11 @@ class ExprBinder:
                 "42809",
                 f"FILTER specified, but {name} is not an aggregate "
                 "function")
+        if getattr(e, "agg_order", None):
+            raise errors.SqlError(
+                "42809",
+                f"ORDER BY specified, but {name} is not an ordered-set "
+                "aggregate function")
         if name == "coalesce" and len(e.args) > 1:
             # short-circuit form (PG): later arguments must not be
             # evaluated on rows an earlier one already decided —
@@ -325,12 +330,13 @@ class ExprBinder:
             if name not in ("string_agg", "array_agg"):
                 raise errors.unsupported(
                     f"ORDER BY inside {name}()")
-            spec.order_by = [(self.bind(oi.expr), oi.desc)
+            spec.order_by = [(self.bind(oi.expr), oi.desc,
+                              oi.nulls_first)
                              for oi in e.agg_order]
         key = repr((spec.func, _expr_key(spec.arg), spec.distinct,
-                    _expr_key(spec.filter),
-                    tuple((_expr_key(k), d)
-                          for k, d in (spec.order_by or []))))
+                    spec.sep, _expr_key(spec.filter),
+                    tuple((_expr_key(k), d, nf)
+                          for k, d, nf in (spec.order_by or []))))
         if key in self._agg_keys:
             idx = self._agg_keys[key]
             return BoundAggRef(idx, self.aggs[idx].type)
@@ -691,7 +697,14 @@ class ExprBinder:
         return _fold_if_const(f)
 
 
+#: never constant-fold: each evaluation must run (PG volatility class)
+_VOLATILE_FUNCS = {"nextval", "setval", "random", "gen_random_uuid",
+                   "clock_timestamp", "uuid_generate_v4", "ai_embed"}
+
+
 def _fold_if_const(f: BoundFunc) -> BoundExpr:
+    if f.name in _VOLATILE_FUNCS:
+        return f
     if all(isinstance(a, BoundLiteral) for a in f.args):
         from ..columnar.column import Batch
         try:

@@ -979,6 +979,9 @@ class Parser:
             if self.at_kw("OVER"):
                 if call.filter is not None:
                     raise errors.unsupported("FILTER with window functions")
+                if call.agg_order:
+                    raise errors.unsupported(
+                        "ORDER BY inside a window function call")
                 self.next()
                 self.expect_op("(")
                 partition = []

@@ -414,3 +414,49 @@ def test_natural_join_view_replans_after_alter():
     assert c.execute("SELECT count(*) FROM nv").scalar() == 1
     # run twice: the second plan must re-resolve, not reuse mutated state
     assert c.execute("SELECT count(*) FROM nv").scalar() == 1
+
+
+def test_review_fixes_wave2():
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    from serenedb_tpu.engine import Database
+    c = Database().connect()
+    # cascade recursion through view chains
+    c.execute("CREATE TABLE base (v INT)")
+    c.execute("CREATE VIEW va AS SELECT * FROM base")
+    c.execute("CREATE VIEW vb AS SELECT * FROM va")
+    with _pytest.raises(_errors.SqlError):
+        c.execute("DROP VIEW va")             # vb depends
+    c.execute("DROP TABLE base CASCADE")
+    with _pytest.raises(_errors.SqlError):
+        c.execute("SELECT * FROM vb")         # dropped along
+    # same-named tables in different schemas don't cross-block
+    c.execute("CREATE SCHEMA s1")
+    c.execute("CREATE SCHEMA s2")
+    c.execute("CREATE TABLE s1.dup (v INT)")
+    c.execute("CREATE TABLE s2.dup (v INT)")
+    c.execute("CREATE VIEW vd AS SELECT * FROM s1.dup")
+    c.execute("DROP TABLE s2.dup")            # must not 2BP01
+    with _pytest.raises(_errors.SqlError):
+        c.execute("DROP TABLE s1.dup")
+    # separator is part of the aggregate identity
+    c.execute("CREATE TABLE sg (s TEXT)")
+    c.execute("INSERT INTO sg VALUES ('a'), ('b')")
+    r = c.execute("SELECT string_agg(s, ',' ORDER BY s), "
+                  "string_agg(s, ';' ORDER BY s) FROM sg").rows()[0]
+    assert r == ("a,b", "a;b")
+    # NULLS FIRST inside aggregate ORDER BY
+    c.execute("CREATE TABLE nf (x INT, s TEXT)")
+    c.execute("INSERT INTO nf VALUES (1, 'a'), (NULL, 'n'), (2, 'b')")
+    assert c.execute("SELECT string_agg(s, ',' ORDER BY x NULLS FIRST) "
+                     "FROM nf").scalar() == "n,a,b"
+    assert c.execute("SELECT string_agg(s, ',' ORDER BY x) "
+                     "FROM nf").scalar() == "a,b,n"
+    # ORDER BY rejected in non-aggregate calls
+    with _pytest.raises(_errors.SqlError):
+        c.execute("SELECT upper(s ORDER BY s) FROM sg")
+    # temporal values in json builders render as text
+    assert c.execute(
+        "SELECT json_build_object('d', DATE '2024-01-02')").scalar() \
+        == '{"d": "2024-01-02"}'
