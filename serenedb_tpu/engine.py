@@ -397,12 +397,16 @@ class Database(TableResolver):
         batch = op.batch
         if batch is not None:
             # arrow WAL serde can't carry logical types the physical
-            # layout doesn't (ARRAY/RECORD ride as their text payloads) —
-            # re-stamp from the catalog schema so replayed appends don't
-            # degrade the table's column types
+            # layout doesn't (ARRAY/RECORD ride as text payloads,
+            # INTERVAL as int64 micros) — re-stamp from the catalog
+            # schema so replayed appends don't degrade column types
             for name, ct in zip(t.column_names, t.column_types):
-                if ct.id in (dt.TypeId.ARRAY, dt.TypeId.RECORD) and \
-                        name in batch:
+                if name in batch and batch.column(name).type != ct and \
+                        ct.id in (dt.TypeId.ARRAY, dt.TypeId.RECORD,
+                                  dt.TypeId.INTERVAL, dt.TypeId.OID,
+                                  dt.TypeId.REGCLASS, dt.TypeId.REGTYPE,
+                                  dt.TypeId.REGPROC,
+                                  dt.TypeId.REGNAMESPACE):
                     batch.column(name).type = ct
         _apply_ops(t, [(op.kind, batch, op.rows)])
 

@@ -1037,6 +1037,92 @@ def _bit_length(ts):
     return FunctionResolution(dt.INT, impl)
 
 
+@register("overlay")
+def _overlay(ts):
+    """overlay(str, repl, start[, count]) — 1-based; count defaults to
+    the replacement length (PG)."""
+    if len(ts) not in (3, 4):
+        return None
+
+    def impl(cols, n):
+        sv = string_values(cols[0])
+        rv = string_values(cols[1])
+        starts = cols[2].data.astype(np.int64)
+        counts = cols[3].data.astype(np.int64) if len(cols) > 3 else None
+        out = []
+        for i in range(n):
+            s0, r0 = str(sv[i]), str(rv[i])
+            st = max(int(starts[i]), 1)
+            cnt = int(counts[i]) if counts is not None else len(r0)
+            out.append(s0[: st - 1] + r0 + s0[st - 1 + max(cnt, 0):])
+        return make_string_column(np.asarray(out, dtype=object),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("encode")
+def _encode(ts):
+    """encode(text, format): base64 / hex / escape over the UTF-8 bytes
+    (PG encode over bytea; text input is its byte form here)."""
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        import base64 as _b64
+        data = string_values(cols[0])
+        fmts = string_values(cols[1])
+        out = []
+        for i in range(n):
+            raw = str(data[i]).encode("utf-8")
+            f = str(fmts[i]).lower()
+            if f == "base64":
+                out.append(_b64.b64encode(raw).decode())
+            elif f == "hex":
+                out.append(raw.hex())
+            elif f == "escape":
+                out.append("".join(
+                    chr(b) if 32 <= b < 127 and b != 92
+                    else f"\\{b:03o}" for b in raw))
+            else:
+                raise errors.SqlError(
+                    "22023", f"unrecognized encoding: {f!r}")
+        return make_string_column(np.asarray(out, dtype=object),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("decode")
+def _decode(ts):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        import base64 as _b64
+        data = string_values(cols[0])
+        fmts = string_values(cols[1])
+        out = []
+        for i in range(n):
+            f = str(fmts[i]).lower()
+            s0 = str(data[i])
+            try:
+                if f == "base64":
+                    raw = _b64.b64decode(s0, validate=True)
+                elif f == "hex":
+                    raw = bytes.fromhex(s0)
+                else:
+                    raise errors.SqlError(
+                        "22023", f"unrecognized encoding: {f!r}")
+            except (ValueError, Exception) as e:
+                if isinstance(e, errors.SqlError):
+                    raise
+                raise errors.SqlError("22023",
+                                      f"invalid {f} input: {s0!r}")
+            out.append(raw.decode("utf-8", errors="replace"))
+        return make_string_column(np.asarray(out, dtype=object),
+                                  propagate_nulls(cols))
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
 @register("to_hex")
 def _to_hex(ts):
     if len(ts) != 1 or not (ts[0].is_integer or ts[0].id is dt.TypeId.NULL):

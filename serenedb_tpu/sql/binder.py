@@ -1025,6 +1025,11 @@ def cast_column(col: Column, target: dt.SqlType) -> Column:
             from .expr import make_string_column
             return make_string_column(
                 np.asarray(out, dtype=object).astype(str), validity)
+        if src.id is dt.TypeId.INTERVAL:
+            out = [format_interval(int(v)) for v in col.data]
+            from .expr import make_string_column
+            return make_string_column(
+                np.asarray(out, dtype=object).astype(str), validity)
         vals = col.to_pylist()
         out = ["" if v is None else _cast_to_text(v, src) for v in vals]
         from .expr import make_string_column

@@ -931,6 +931,23 @@ class Parser:
                 args.append(self.parse_expr())
             self.expect_op(")")
             return ast.FuncCall("substr", args)
+        if upper == "OVERLAY" and self.peek(1).kind is T.OP and \
+                self.peek(1).value == "(":
+            # PG: overlay(str PLACING repl FROM n [FOR k])
+            save = self.i
+            self.next()
+            self.expect_op("(")
+            s = self.parse_expr()
+            if self.accept_kw("PLACING"):
+                repl = self.parse_expr()
+                self.expect_kw("FROM")
+                start = self.parse_expr()
+                args = [s, repl, start]
+                if self.accept_kw("FOR"):
+                    args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.FuncCall("overlay", args)
+            self.i = save   # plain overlay(a, b, c[, d]) call form
         if upper in ("DATE", "TIMESTAMP") and self.peek(1).kind is T.STRING:
             self.next()
             lit = self.next()

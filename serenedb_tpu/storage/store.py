@@ -129,10 +129,14 @@ class Store:
             return empty_batch(names, types)
         batch = read_parquet_snapshot(path)
         # re-stamp logical types the physical snapshot can't carry
-        # (ARRAY columns are stored as their JSON text): the catalog's
-        # declared type wins over arrow inference
+        # (ARRAY/RECORD as JSON text, INTERVAL as int64 micros, reg* as
+        # int64 oids): the catalog's declared type wins over inference
+        _RESTAMP = (dt.TypeId.ARRAY, dt.TypeId.RECORD, dt.TypeId.INTERVAL,
+                    dt.TypeId.OID, dt.TypeId.REGCLASS, dt.TypeId.REGTYPE,
+                    dt.TypeId.REGPROC, dt.TypeId.REGNAMESPACE)
         for name, t in zip(names, types):
-            if t.id is dt.TypeId.ARRAY and name in batch:
+            if t.id in _RESTAMP and name in batch and \
+                    batch.column(name).type != t:
                 batch.column(name).type = t
         return batch
 
