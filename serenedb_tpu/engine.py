@@ -851,6 +851,8 @@ class Connection:
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         params = params or []
+        import time as _time
+        self.stmt_now_us = int(_time.time() * 1e6)  # now() stability
         token = CURRENT_CONNECTION.set(self)
         try:
             plan = self._plan(st, params)   # binding enforces ACLs here
@@ -933,6 +935,10 @@ class Connection:
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block")
         token = CURRENT_CONNECTION.set(self)
+        import time as _time
+        # PG: now()/current_timestamp are statement-stable — every call
+        # within one statement sees this timestamp
+        self.stmt_now_us = int(_time.time() * 1e6)
         try:
             with self._session_scope(sql_text if sql_text is not None
                                      else type(st).__name__):

@@ -2028,9 +2028,24 @@ def _date_trunc(ts):
 def _now_resolver(ts):
     def impl(cols, n):
         import time as _time
+        conn = _current_conn()
+        v = getattr(conn, "stmt_now_us", None) if conn is not None \
+            else None
+        if v is None:    # outside a statement (tests, internal evals)
+            v = int(_time.time() * 1e6)
+        return Column(dt.TIMESTAMP, np.full(max(n, 1), v, dtype=np.int64))
+    return FunctionResolution(dt.TIMESTAMP, impl)
+
+
+def _clock_timestamp_resolver(ts):
+    def impl(cols, n):
+        import time as _time
         v = int(_time.time() * 1e6)
         return Column(dt.TIMESTAMP, np.full(max(n, 1), v, dtype=np.int64))
     return FunctionResolution(dt.TIMESTAMP, impl)
+
+
+_REGISTRY["clock_timestamp"] = _clock_timestamp_resolver
 
 
 _REGISTRY["now"] = _now_resolver
@@ -2049,12 +2064,90 @@ def _current_date(ts):
 
 @register("age")
 def _age(ts):
+    """age(ts, ts) → INTERVAL (micros; PG renders day/time parts)."""
     def impl(cols, n):
-        a = cols[0].data.astype("datetime64[us]")
-        b = cols[1].data.astype("datetime64[us]")
-        secs = (a.astype(np.int64) - b.astype(np.int64)) / 1e6
-        return _result(dt.DOUBLE, secs, cols)  # seconds (interval-lite)
+        a = cols[0].data.astype(np.int64)
+        b = cols[1].data.astype(np.int64)
+        return _result(dt.INTERVAL, a - b, cols)
+    return FunctionResolution(dt.INTERVAL, impl)
+
+
+@register("random")
+def _random(ts):
+    if ts:
+        return None
+
+    def impl(cols, n):
+        rng = np.random.default_rng()
+        return Column(dt.DOUBLE, rng.random(max(n, 1)))
     return FunctionResolution(dt.DOUBLE, impl)
+
+
+@register("gen_random_uuid")
+def _gen_random_uuid(ts):
+    if ts:
+        return None
+
+    def impl(cols, n):
+        import uuid as _uuid
+        out = [str(_uuid.uuid4()) for _ in range(max(n, 1))]
+        return make_string_column(np.asarray(out, dtype=object), None)
+    return FunctionResolution(dt.VARCHAR, impl)
+
+
+@register("array_remove")
+def _array_remove(ts):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        vals = cols[0].to_pylist()
+        rem = cols[1].to_pylist()
+        out = []
+        for i in range(n):
+            v = vals[i]
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                arr = json.loads(str(v))
+            except json.JSONDecodeError:
+                arr = None
+            if not isinstance(arr, list):
+                out.append(v)
+                continue
+            out.append(json.dumps([x for x in arr if x != rem[i]]))
+        col = make_string_column(
+            np.asarray(["" if v is None else v for v in out],
+                       dtype=object),
+            np.asarray([v is not None for v in out]))
+        t = ts[0] if ts[0].id is dt.TypeId.ARRAY else dt.array_of(None)
+        return Column(t, col.data, col.validity, col.dictionary)
+    return FunctionResolution(
+        ts[0] if ts[0].id is dt.TypeId.ARRAY else dt.array_of(None), impl)
+
+
+@register("array_upper")
+def _array_upper(ts):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        vals = cols[0].to_pylist()
+        out = np.zeros(n, dtype=np.int64)
+        invalid = np.zeros(n, dtype=bool)
+        for i in range(n):
+            try:
+                arr = json.loads(str(vals[i])) if vals[i] is not None \
+                    else None
+            except json.JSONDecodeError:
+                arr = None
+            if isinstance(arr, list) and arr:
+                out[i] = len(arr)
+            else:
+                invalid[i] = True
+        return _result(dt.INT, out, cols, extra_invalid=invalid)
+    return FunctionResolution(dt.INT, impl)
 
 
 @register("make_date")

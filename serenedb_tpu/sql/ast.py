@@ -155,6 +155,7 @@ class TableFunction(TableRef):
     name: str
     args: list[Expr]
     alias: Optional[str] = None
+    col_aliases: Optional[list[str]] = None   # FROM fn(...) t(a, b)
 
 
 @dataclass

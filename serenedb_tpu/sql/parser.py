@@ -479,7 +479,14 @@ class Parser:
                     args.append(self.parse_expr())
             self.expect_op(")")
             alias = self._table_alias()
-            return ast.TableFunction(".".join(parts).lower(), args, alias)
+            col_aliases = None
+            if alias is not None and self.accept_op("("):
+                col_aliases = [self.ident()]
+                while self.accept_op(","):
+                    col_aliases.append(self.ident())
+                self.expect_op(")")
+            return ast.TableFunction(".".join(parts).lower(), args, alias,
+                                     col_aliases)
         alias = self._table_alias()
         return ast.NamedTable(parts, alias)
 

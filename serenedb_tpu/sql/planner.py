@@ -332,6 +332,26 @@ class Planner:
             provider = self.resolver.resolve_table_function(ref.name, args)
             node, scope = self._scan_scope(
                 provider, ref.alias or ref.name.split(".")[-1])
+            if ref.col_aliases:
+                # FROM fn(...) t(a, b): rename output columns (PG)
+                if len(ref.col_aliases) > len(scope.columns):
+                    raise errors.SqlError(
+                        "42P10",
+                        f"table function {ref.name} has "
+                        f"{len(scope.columns)} columns available but "
+                        f"{len(ref.col_aliases)} specified")
+                cols2 = []
+                exprs = []
+                names = []
+                for i, c in enumerate(scope.columns):
+                    nm = ref.col_aliases[i] if i < len(ref.col_aliases) \
+                        else c.name
+                    cols2.append(ScopeColumn(c.table, nm, c.type, i))
+                    exprs.append(BoundColumn(c.index, c.type, nm))
+                    names.append(nm)
+                scope = Scope(cols2)
+                node = ProjectNode(node, exprs, names)
+                return node, scope
             if ref.alias and ref.name in ("unnest", "generate_series") \
                     and len(scope.columns) == 1:
                 # PG: an alias on a single-column table function renames
