@@ -1867,13 +1867,66 @@ class Connection:
                      [new_cols.get(n, c)
                       for n, c in zip(old.names, old.columns)])
 
+    def _dml_join(self, table: MemTable, tparts: list[str], extra_ref,
+                  where_ast, value_exprs: list, params: list):
+        """UPDATE ... FROM / DELETE ... USING core: plan a real join of
+        the row-numbered target against the extra FROM tables, evaluate
+        the value expressions in the joined scope, and keep the FIRST
+        match per target row (PG: which match wins is unspecified).
+        Returns (rows int64 sorted-unique, [Column per value expr])."""
+        full = table.full_batch()
+        rowcol = Column.from_numpy(
+            np.arange(full.num_rows, dtype=np.int64))
+        ext = Batch(list(full.names) + ["__dml_row"],
+                    list(full.columns) + [rowcol])
+        target = MemTable(tparts[-1], ext)
+        base = _ResolverShim(self.db, params, self)
+        db = self.db
+        # the interception key is the RESOLVED identity — a same-named
+        # table in another schema must hit the real catalog, not the
+        # row-numbered target copy
+        t_ident = db._split(tparts)
+        t_ident = (t_ident[0].lower(), t_ident[1].lower())
+
+        class _TargetShim(TableResolver):
+            def resolve_table(self, parts):
+                schema2, name2 = db._split(parts)
+                if (schema2.lower(), name2.lower()) == t_ident:
+                    return target
+                return base.resolve_table(parts)
+
+            def resolve_table_function(self, name, args):
+                return base.resolve_table_function(name, args)
+
+        # qualified: a self-join alias of the target table would carry
+        # its own __dml_row copy and make the bare name ambiguous
+        items = [ast.SelectItem(
+            ast.ColumnRef([tparts[-1], "__dml_row"]), "__dml_row")]
+        for k, e in enumerate(value_exprs):
+            items.append(ast.SelectItem(e, f"__v{k}"))
+        sel = ast.Select(
+            items=items,
+            from_=ast.JoinRef("cross", ast.NamedTable(list(tparts)),
+                              extra_ref),
+            where=where_ast)
+        plan = Planner(_TargetShim(), params).plan_select(sel)
+        out = plan.execute(ExecContext(self.settings, params))
+        arr = out.column("__dml_row").data.astype(np.int64)
+        uniq, first = np.unique(arr, return_index=True)
+        vals = [out.columns[1 + k].take(first)
+                for k in range(len(value_exprs))]
+        return uniq, vals
+
     def _delete(self, st: ast.Delete, params: list) -> QueryResult:
         table = self._table_for_dml(st.table, "delete")
         if st.returning:
             self.db.resolve_table(st.table, "select")
         with self.db.quiesced([table]):
             full = table.full_batch()
-            if st.where is None:
+            if st.using_ref is not None:
+                rows, _ = self._dml_join(table, st.table, st.using_ref,
+                                         st.where, [], params)
+            elif st.where is None:
                 rows = np.arange(full.num_rows, dtype=np.int64)
             else:
                 scope = Scope.of(list(full.names),
@@ -1886,6 +1939,8 @@ class Connection:
                 c = pred.eval(full)
                 rows = np.flatnonzero(c.data.astype(bool) & c.valid_mask())
             n = len(rows)
+            if st.returning:
+                self._validate_returning(st.returning, table, params)
             pk = _pk_of(table)
             if pk:
                 from .columnar import keyenc
@@ -1922,12 +1977,19 @@ class Connection:
                              st.table[-1])
             planner = Planner(_ResolverShim(self.db, params, self), params)
             binder = ExprBinder(scope, params, planner=planner)
-            if st.where is not None:
+            join_vals = None
+            if st.from_ref is not None:
+                value_exprs = [e for _cn, e in st.assignments
+                               if not isinstance(e, ast.DefaultMarker)]
+                rows, jv = self._dml_join(table, st.table, st.from_ref,
+                                          st.where, value_exprs, params)
+                join_vals = iter(jv)
+            elif st.where is not None:
                 c = binder.bind(st.where).eval(full)
                 mask = c.data.astype(bool) & c.valid_mask()
+                rows = np.flatnonzero(mask)
             else:
-                mask = np.ones(full.num_rows, dtype=bool)
-            rows = np.flatnonzero(mask)
+                rows = np.arange(full.num_rows, dtype=np.int64)
             n = len(rows)
             if n == 0 and not st.returning:
                 return QueryResult(Batch([], []), "UPDATE 0")
@@ -1945,11 +2007,17 @@ class Connection:
                         if dv is not None else \
                         Column.from_pylist([None] * n, target_t)
                     continue
+                if join_vals is not None:
+                    # evaluated in the joined scope, first match per row
+                    new_cols[col_name] = _coerce(next(join_vals), target_t)
+                    continue
                 val = _coerce(binder.bind(e).eval(full), target_t)
                 new_cols[col_name] = val.take(rows)
             upd_cols = [new_cols.get(nm, c)
                         for nm, c in zip(updated.names, updated.columns)]
             updated = Batch(list(updated.names), upd_cols)
+            if st.returning:
+                self._validate_returning(st.returning, table, params)
             _check_not_null(table, updated)
             _check_enums(self.db, table, updated)
             pk = _pk_of(table)
@@ -2388,6 +2456,18 @@ class Connection:
             names.append(it.alias or _default_returning_name(it.expr))
             types.append(b.type)
         return names, types
+
+    def _validate_returning(self, items, table: MemTable, params: list):
+        """Bind RETURNING against the target schema BEFORE mutating:
+        a bad reference must abort the statement atomically, never after
+        the WAL commit. (Join-table columns in RETURNING are not
+        supported — they fail here, pre-mutation.)"""
+        scope = Scope.of(list(table.column_names),
+                         list(table.column_types), table.name)
+        binder = ExprBinder(scope, params)
+        for it in items:
+            if not isinstance(it.expr, ast.Star):
+                binder.bind(it.expr)
 
     def _returning_batch(self, items, table: MemTable, affected: Batch,
                          params: list) -> Batch:

@@ -342,6 +342,7 @@ class Delete(Statement):
     table: list[str]
     where: Optional[Expr] = None
     returning: list = field(default_factory=list)
+    using_ref: Optional[TableRef] = None   # DELETE ... USING <tables>
 
 
 @dataclass
@@ -350,6 +351,7 @@ class Update(Statement):
     assignments: list[tuple[str, Expr]]
     where: Optional[Expr] = None
     returning: list = field(default_factory=list)
+    from_ref: Optional[TableRef] = None    # UPDATE ... FROM <tables>
 
 
 @dataclass

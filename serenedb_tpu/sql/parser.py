@@ -1444,9 +1444,13 @@ class Parser:
         self.expect_kw("DELETE")
         self.expect_kw("FROM")
         table = self.qualified_name()
+        using_ref = None
+        if self.accept_kw("USING"):
+            using_ref = self.parse_from()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
         return ast.Delete(table, where,
-                          returning=self._parse_returning())
+                          returning=self._parse_returning(),
+                          using_ref=using_ref)
 
     def parse_update(self) -> ast.Update:
         self.expect_kw("UPDATE")
@@ -1463,9 +1467,13 @@ class Parser:
                 assigns.append((col, self.parse_expr()))
             if not self.accept_op(","):
                 break
+        from_ref = None
+        if self.accept_kw("FROM"):
+            from_ref = self.parse_from()
         where = self.parse_expr() if self.accept_kw("WHERE") else None
         return ast.Update(table, assigns, where,
-                          returning=self._parse_returning())
+                          returning=self._parse_returning(),
+                          from_ref=from_ref)
 
     def parse_set(self) -> ast.Statement:
         self.expect_kw("SET")

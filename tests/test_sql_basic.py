@@ -460,3 +460,33 @@ def test_review_fixes_wave2():
     assert c.execute(
         "SELECT json_build_object('d', DATE '2024-01-02')").scalar() \
         == '{"d": "2024-01-02"}'
+
+
+def test_dml_join_schema_qualified_and_atomic_returning():
+    import pytest as _pytest
+
+    from serenedb_tpu import errors as _errors
+    from serenedb_tpu.engine import Database
+    c = Database().connect()
+    c.execute("CREATE SCHEMA s1")
+    c.execute("CREATE SCHEMA s2")
+    c.execute("CREATE TABLE s1.t (id INT, v INT)")
+    c.execute("CREATE TABLE s2.t (id INT, v INT)")
+    c.execute("INSERT INTO s1.t VALUES (1, 100), (2, 200)")
+    c.execute("INSERT INTO s2.t VALUES (1, 999)")
+    c.execute("UPDATE s1.t SET v = x.v FROM s2.t x WHERE s1.t.id = x.id")
+    assert sorted(c.execute("SELECT id, v FROM s1.t").rows()) == \
+        [(1, 999), (2, 200)]
+    # an invalid RETURNING aborts BEFORE the mutation applies
+    c.execute("CREATE TABLE tgt (id INT, v INT)")
+    c.execute("CREATE TABLE src (id INT, w INT)")
+    c.execute("INSERT INTO tgt VALUES (1, 0)")
+    c.execute("INSERT INTO src VALUES (1, 10)")
+    with _pytest.raises(_errors.SqlError):
+        c.execute("UPDATE tgt SET v = src.w FROM src "
+                  "WHERE tgt.id = src.id RETURNING src.w")
+    assert c.execute("SELECT v FROM tgt WHERE id = 1").scalar() == 0
+    with _pytest.raises(_errors.SqlError):
+        c.execute("DELETE FROM tgt USING src "
+                  "WHERE tgt.id = src.id RETURNING src.w")
+    assert c.execute("SELECT count(*) FROM tgt").scalar() == 1
