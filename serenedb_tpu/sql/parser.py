@@ -258,13 +258,25 @@ class Parser:
                 node.order_by.append(self.parse_order_item())
                 while self.accept_op(","):
                     node.order_by.append(self.parse_order_item())
-            while self.at_kw("LIMIT", "OFFSET"):
+            while self.at_kw("LIMIT", "OFFSET", "FETCH"):
                 if self.accept_kw("LIMIT"):
                     if not self.accept_kw("ALL"):
                         node.limit = self.parse_expr()
                 elif self.accept_kw("OFFSET"):
                     node.offset = self.parse_expr()
                     self.accept_kw("ROWS") or self.accept_kw("ROW")
+                elif self.accept_kw("FETCH"):
+                    # FETCH {FIRST|NEXT} [n] {ROW|ROWS} ONLY (SQL std)
+                    if not (self.accept_kw("FIRST") or
+                            self.accept_kw("NEXT")):
+                        raise errors.syntax(
+                            "expected FIRST or NEXT after FETCH")
+                    if self.at_kw("ROW", "ROWS"):
+                        node.limit = ast.Literal(1)
+                    else:
+                        node.limit = self.parse_expr()
+                    self.accept_kw("ROWS") or self.accept_kw("ROW")
+                    self.expect_kw("ONLY")
         if ctes:
             # inner (more deeply scoped) CTEs shadow outer ones; never
             # clobber a parenthesized arm's own WITH bindings
@@ -334,13 +346,25 @@ class Parser:
             while self.accept_op(","):
                 order_by.append(self.parse_order_item())
         limit = offset = None
-        while self.at_kw("LIMIT", "OFFSET"):
+        while self.at_kw("LIMIT", "OFFSET", "FETCH"):
             if self.accept_kw("LIMIT"):
                 if not self.accept_kw("ALL"):
                     limit = self.parse_expr()
             elif self.accept_kw("OFFSET"):
                 offset = self.parse_expr()
                 self.accept_kw("ROWS") or self.accept_kw("ROW")
+            elif self.accept_kw("FETCH"):
+                # FETCH {FIRST|NEXT} [n] {ROW|ROWS} ONLY (SQL std)
+                if not (self.accept_kw("FIRST") or
+                        self.accept_kw("NEXT")):
+                    raise errors.syntax(
+                        "expected FIRST or NEXT after FETCH")
+                if self.at_kw("ROW", "ROWS"):
+                    limit = ast.Literal(1)
+                else:
+                    limit = self.parse_expr()
+                self.accept_kw("ROWS") or self.accept_kw("ROW")
+                self.expect_kw("ONLY")
         return ast.Select(items, from_, where, group_by, having, order_by,
                           limit, offset, distinct, distinct_on)
 
