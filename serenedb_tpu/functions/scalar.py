@@ -417,13 +417,7 @@ def _neg(ts):
 
 def _concat_resolver(ts):
     def impl(cols, n):
-        parts = []
-        for c in cols:
-            if c.type.is_string:
-                parts.append(string_values(c))
-            else:
-                parts.append(np.asarray([_pg_text(v) for v in c.to_pylist()],
-                                        dtype=object).astype(str))
+        parts = [_col_text_values(c) for c in cols]
         data = parts[0]
         for p in parts[1:]:
             data = np.char.add(data, p)
@@ -442,11 +436,7 @@ def _concat_skip_nulls(ts):
         parts = []
         for c in cols:
             valid = c.valid_mask() if c.validity is not None else None
-            if c.type.is_string:
-                vals = string_values(c)
-            else:
-                vals = np.asarray([_pg_text(v) for v in c.to_pylist()],
-                                  dtype=object).astype(str)
+            vals = _col_text_values(c)
             if valid is not None:
                 vals = np.where(valid, vals, "")
             parts.append(vals)
@@ -501,6 +491,26 @@ def _pg_text(v) -> str:
     if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
         return str(int(v)) if v == int(v) else str(v)
     return str(v)
+
+
+def _col_text_values(c) -> np.ndarray:
+    """Column → PG cast-to-text renderings (DATE/TIMESTAMP/INTERVAL as
+    their text, bool as true/false — expression-context semantics, not
+    the wire's t/f)."""
+    if c.type.is_string:
+        return string_values(c)
+    if c.type.id in (dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                     dt.TypeId.INTERVAL):
+        from ..columnar.pgcopy import _scalar_field_text
+        return np.asarray(
+            ["" if v is None else _scalar_field_text(c.type, v)
+             for v in c.to_pylist()], dtype=object).astype(str)
+    if c.type.id is dt.TypeId.BOOL:
+        return np.asarray(
+            ["" if v is None else ("true" if v else "false")
+             for v in c.to_pylist()], dtype=object).astype(str)
+    return np.asarray([_pg_text(v) for v in c.to_pylist()],
+                      dtype=object).astype(str)
 
 
 # -- math functions --------------------------------------------------------
