@@ -713,7 +713,14 @@ def ledger_main(shape_names: list[str]) -> None:
     out = {"ledger": "ok" if updated else "no-results", "updated": updated}
     if errors:
         out["errors"] = errors
+    if not alive:
+        out["device"] = "down"
     print(json.dumps(out), flush=True)
+    if not alive:
+        # host-only capture with the device down: nonzero keeps the
+        # retry loop on its short cadence so a tunnel-up moment is
+        # caught within minutes, not an hour
+        sys.exit(3)
 
 
 def _probe_device(timeout_s: float = 75.0) -> tuple[bool, bool, str]:
