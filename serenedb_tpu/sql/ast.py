@@ -79,6 +79,7 @@ class Like(Expr):
     pattern: Expr
     negated: bool = False
     case_insensitive: bool = False
+    escape: Optional[str] = None   # LIKE ... ESCAPE 'c' 
 
 
 @dataclass

@@ -219,7 +219,30 @@ class ExprBinder:
                 both = ast.UnaryOp("NOT", both)
             return self.bind(both)
         if isinstance(e, ast.Like):
-            args = [self.bind(e.operand), self.bind(e.pattern)]
+            pattern = e.pattern
+            esc = getattr(e, "escape", None)
+            if esc is not None and isinstance(pattern, ast.Literal) \
+                    and isinstance(pattern.value, str):
+                # normalize a custom ESCAPE char to the impl's backslash
+                out = []
+                i = 0
+                pv = pattern.value
+                while i < len(pv):
+                    ch = pv[i]
+                    if ch == esc and i + 1 < len(pv):
+                        out.append("\\" + pv[i + 1])
+                        i += 2
+                        continue
+                    if ch == "\\":
+                        out.append("\\\\")
+                    else:
+                        out.append(ch)
+                    i += 1
+                pattern = ast.Literal("".join(out))
+            elif esc is not None:
+                raise errors.unsupported(
+                    "ESCAPE with a non-constant pattern")
+            args = [self.bind(e.operand), self.bind(pattern)]
             negated, ci = e.negated, e.case_insensitive
 
             def impl(cols, batch, _n=negated, _ci=ci):

@@ -421,6 +421,14 @@ class Parser:
                 nulls_first = False
         return ast.OrderItem(e, desc, nulls_first)
 
+    def _parse_like_escape(self):
+        if self.accept_kw("ESCAPE"):
+            t = self.next()
+            if t.kind is not T.STRING or len(t.value) != 1:
+                raise errors.syntax("ESCAPE must be a single character")
+            return t.value
+        return None
+
     def parse_from(self) -> ast.TableRef:
         ref = self.parse_table_ref()
         while True:
@@ -618,10 +626,14 @@ class Parser:
                 left = ast.Between(left, low, high, negated)
                 continue
             if self.accept_kw("LIKE"):
-                left = ast.Like(left, self.parse_additive_chain(), negated, False)
+                left = ast.Like(left, self.parse_additive_chain(),
+                                negated, False,
+                                escape=self._parse_like_escape())
                 continue
             if self.accept_kw("ILIKE"):
-                left = ast.Like(left, self.parse_additive_chain(), negated, True)
+                left = ast.Like(left, self.parse_additive_chain(),
+                                negated, True,
+                                escape=self._parse_like_escape())
                 continue
             if self.at_kw("SIMILAR") and \
                     self.peek(1).kind is T.IDENT and \
