@@ -2997,21 +2997,30 @@ def _string_to_array(ts):
 
 @register("array_to_string")
 def _array_to_string(ts):
-    if len(ts) != 2 or not _stringish(ts[0]) or not _stringish(ts[1]):
+    """array_to_string(arr, delim[, null_string]) — PG skips NULL
+    elements unless a null replacement is given."""
+    if len(ts) not in (2, 3) or not _stringish(ts[0]) or \
+            not _stringish(ts[1]):
         return None
 
     def impl(cols, n):
         arrs = _array_rows(cols[0], n)
         d = string_values(cols[1])
+        nulls = string_values(cols[2]) if len(cols) > 2 else None
         out = []
         for i in range(n):
             a = arrs[i] or []
-            # PG skips NULL elements in array_to_string
-            out.append(d[i].join(
-                v if isinstance(v, str)
-                else json.dumps(v) if isinstance(v, (list, dict))
-                else _pg_text(v)
-                for v in a if v is not None))
+            parts = []
+            for v in a:
+                if v is None:
+                    if nulls is not None:
+                        parts.append(str(nulls[i]))
+                    continue
+                parts.append(v if isinstance(v, str)
+                             else json.dumps(v)
+                             if isinstance(v, (list, dict))
+                             else _pg_text(v))
+            out.append(d[i].join(parts))
         return make_string_column(
             np.asarray(out, dtype=object).astype(str),
             propagate_nulls(cols))
