@@ -3000,20 +3000,25 @@ def _array_to_string(ts):
     """array_to_string(arr, delim[, null_string]) — PG skips NULL
     elements unless a null replacement is given."""
     if len(ts) not in (2, 3) or not _stringish(ts[0]) or \
-            not _stringish(ts[1]):
+            not _stringish(ts[1]) or \
+            (len(ts) == 3 and not (_stringish(ts[2]) or
+                                   ts[2].id is dt.TypeId.NULL)):
         return None
 
     def impl(cols, n):
         arrs = _array_rows(cols[0], n)
         d = string_values(cols[1])
-        nulls = string_values(cols[2]) if len(cols) > 2 else None
+        nulls = _col_text_values(cols[2]) if len(cols) > 2 else None
+        # PG: a NULL null_string means NULL elements are simply omitted
+        # — it must NOT null the whole result
+        nulls_ok = cols[2].valid_mask() if len(cols) > 2 else None
         out = []
         for i in range(n):
             a = arrs[i] or []
             parts = []
             for v in a:
                 if v is None:
-                    if nulls is not None:
+                    if nulls is not None and nulls_ok[i]:
                         parts.append(str(nulls[i]))
                     continue
                 parts.append(v if isinstance(v, str)
@@ -3023,7 +3028,7 @@ def _array_to_string(ts):
             out.append(d[i].join(parts))
         return make_string_column(
             np.asarray(out, dtype=object).astype(str),
-            propagate_nulls(cols))
+            propagate_nulls(cols[:2]))
     return FunctionResolution(dt.VARCHAR, impl)
 
 

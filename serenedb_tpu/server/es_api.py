@@ -358,6 +358,14 @@ class EsApi:
         from_ = int(body.get("from", 0))
         if "knn" in body:
             return self._search_knn(index, body, size, from_)
+        if "_id" not in t.column_names or "_source" not in t.column_names:
+            # a plain SQL table is not an ES document index — surface a
+            # clear contract error instead of a cryptic 42703
+            raise EsError(
+                400, "illegal_argument_exception",
+                f"[{index}] is a SQL table, not an ES document index — "
+                "query it over the PG wire, or ingest documents through "
+                "the ES API (_doc/_bulk) to search here")
         where, score_col = self._translate_query(body.get("query"))
         multi_claims = score_col if isinstance(score_col, list) else None
         cols = '"_id", "_source"'
