@@ -535,6 +535,38 @@ class Database(TableResolver):
             vals = np.arange(start, start + n * step, step, dtype=np.int64)
             return MemTable("generate_series", Batch(
                 ["generate_series"], [Column.from_numpy(vals)]))
+        if name == "sdb_terms":
+            # term-enumeration scan over an inverted index (reference:
+            # the TsDict full-scan mode of
+            # server/connector/duckdb_search_full_scan.hpp:54-76 — the
+            # dictionary itself is a queryable relation)
+            if len(args) < 2:
+                raise errors.SqlError(
+                    "42883", "sdb_terms(table, column) requires a table "
+                             "and column name")
+            provider = self.resolve_table([str(args[0])])
+            col = str(args[1])
+            from .search.index import find_index
+            idx = find_index(provider, col)
+            if idx is None:
+                raise errors.SqlError(
+                    errors.UNDEFINED_OBJECT,
+                    f'no inverted index on "{args[0]}"."{col}"')
+            # find_index read-repaired above, so segments carry no
+            # deleted docs (mutations rebuild; appends add segments)
+            terms: dict[str, int] = {}
+            for seg, _base in idx.searchers[col].segments:
+                fi = seg.index
+                for t, df in zip(fi.terms_str.tolist(),
+                                 fi.doc_freq.tolist()):
+                    terms[t] = terms.get(t, 0) + int(df)
+            items = sorted(terms.items())
+            return MemTable("sdb_terms", Batch.from_pydict({
+                "term": Column.from_pylist([t for t, _ in items],
+                                           dt.VARCHAR),
+                "doc_freq": Column.from_pylist([d for _, d in items],
+                                               dt.BIGINT),
+            }))
         if name == "sdb_log":
             from .pgcatalog import log_table
             return log_table()
