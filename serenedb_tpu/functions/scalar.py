@@ -2082,6 +2082,18 @@ def _age(ts):
     return FunctionResolution(dt.INTERVAL, impl)
 
 
+@register("atan2")
+def _atan2(ts):
+    if len(ts) != 2:
+        return None
+
+    def impl(cols, n):
+        y = cols[0].data.astype(np.float64)
+        x = cols[1].data.astype(np.float64)
+        return _result(dt.DOUBLE, np.arctan2(y, x), cols)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
 @register("random")
 def _random(ts):
     if ts:
