@@ -772,10 +772,13 @@ def register_dictionary(name: str, options: dict,
     locale = str(options.get("locale", "en"))
     if template in ("text", "simple"):
         want_stop = truthy(options.get("stopwords"), False)
+        # reference contract (text_tokenizer.hpp:61, normalizing_
+        # tokenizer.hpp:49): accent=true KEEPS accents, accent=false /
+        # unset removes them
         a = TextAnalyzer(
             stopwords=(None if want_stop else frozenset()),
             stem=truthy(options.get("stemming"), template == "text"),
-            accent_fold=truthy(options.get("accent"), True),
+            accent_fold=not truthy(options.get("accent"), False),
             locale=locale)
     elif template == "whitespace":
         a = WhitespaceAnalyzer()

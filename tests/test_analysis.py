@@ -321,3 +321,26 @@ def test_locale_dictionary_new_languages():
         assert t1 == t2 and t1
     finally:
         drop_dictionary("t_tr")
+
+
+def test_accent_option_reference_contract():
+    """accent=true keeps accents; accent=false/unset removes them
+    (text_tokenizer.hpp:61, normalizing_tokenizer.hpp:49)."""
+    from serenedb_tpu.search.analysis import (drop_dictionary,
+                                              register_dictionary)
+    keep = register_dictionary("t_acc_keep", {"template": "text",
+                                              "accent": True,
+                                              "stemming": False})
+    strip = register_dictionary("t_acc_strip", {"template": "text",
+                                                "accent": False,
+                                                "stemming": False})
+    default = register_dictionary("t_acc_def", {"template": "text",
+                                                "stemming": False})
+    try:
+        assert [t.term for t in keep.tokenize("café")] == ["café"]
+        assert [t.term for t in strip.tokenize("café")] == ["cafe"]
+        assert [t.term for t in default.tokenize("café")] == ["cafe"]
+    finally:
+        drop_dictionary("t_acc_keep")
+        drop_dictionary("t_acc_strip")
+        drop_dictionary("t_acc_def")
