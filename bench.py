@@ -491,6 +491,60 @@ def bench_ingest() -> float:
     return ratio
 
 
+def bench_host_agg() -> float:
+    """Host morsel-parallel hash-GROUP-BY scaling (reference: DuckDB's
+    morsel-driven pipeline workers; ISSUE 1 tentpole): one
+    Scan→Filter→GroupBy shape through the engine with the device path
+    disabled, at serene_workers=1 vs all cores. Returns the scaling
+    ratio t_1t/t_mt; extras carry the full worker→seconds curve so the
+    ledger shows the curve, not a flat 1t≈mt. Results must be
+    bit-identical across worker counts (asserted)."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+
+    n_cores = os.cpu_count() or 1
+    rng = np.random.default_rng(13)
+    n = 6_000_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE hits (k INT, v BIGINT, f DOUBLE)")
+    batch = Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 1000, n).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(-(10 ** 6), 10 ** 6, n, dtype=np.int64)),
+        "f": Column.from_numpy(rng.normal(size=n)),
+    })
+    db.schemas["main"].tables["hits"] = MemTable("hits", batch)
+    c.execute("SET serene_device = 'cpu'")
+    q = ("SELECT k, count(*), sum(v), min(f), max(f), avg(f), stddev(f) "
+         "FROM hits WHERE v % 7 <> 0 GROUP BY k")
+
+    workers = sorted({1, 2, n_cores} - {0})
+    workers = [w for w in workers if w <= n_cores]
+    curve: dict[str, float] = {}
+    results: dict[int, list] = {}
+    for w in workers:
+        c.execute(f"SET serene_workers = {w}")
+        results[w] = c.execute(q).rows()      # warm + correctness capture
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            c.execute(q)
+        curve[str(w)] = round((time.perf_counter() - t0) / reps, 4)
+    for w in workers[1:]:
+        assert results[w] == results[workers[0]], \
+            f"workers={w} diverged from workers=1"
+    _EXTRA["rows"] = n
+    _EXTRA["threads"] = n_cores
+    _EXTRA["curve_s"] = curve
+    _EXTRA["t_1t_s"] = curve[str(workers[0])]
+    _EXTRA["t_mt_s"] = curve[str(workers[-1])]
+    return curve[str(workers[0])] / curve[str(workers[-1])]
+
+
 SHAPES = {
     "q1": bench_q1,
     "hits": bench_hits,
@@ -498,6 +552,7 @@ SHAPES = {
     "bm25_1m": bench_bm25_1m,
     "bm25_8m": bench_bm25_8m,
     "ingest": bench_ingest,
+    "host_agg": bench_host_agg,
 }
 
 #: shapes whose ratio is a device-vs-CPU speedup and enters the headline
@@ -507,7 +562,7 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 
 #: shapes that never touch the device — they run even when the liveness
 #: probe fails (a dead tunnel must not blind the round on host numbers)
-HOST_SHAPES = ("ingest",)
+HOST_SHAPES = ("ingest", "host_agg")
 
 
 # ------------------------------------------------------------- harness

@@ -99,7 +99,35 @@ class Column:
 
     @staticmethod
     def const(value, n: int, typ: Optional[dt.SqlType] = None) -> "Column":
-        return Column.from_pylist([value] * n, typ)
+        """Constant column without the python-list round-trip: literals
+        sit in EVERY expression eval, so this is np.full/np.zeros (which
+        release the GIL) instead of from_pylist's per-element list build
+        — the difference between host pipelines scaling and serializing
+        on literal materialization."""
+        if typ is None:
+            typ = _infer_type([] if value is None else [value])
+        if value is None:
+            if typ.is_string:
+                return Column(typ, np.zeros(n, dtype=np.int32),
+                              np.zeros(n, dtype=bool),
+                              np.asarray([""], dtype=object))
+            return Column(typ, np.zeros(n, dtype=typ.np_dtype),
+                          np.zeros(n, dtype=bool))
+        if typ.is_string:
+            return Column(typ, np.zeros(n, dtype=np.int32), None,
+                          np.asarray([str(value)], dtype=object))
+        if typ.id is dt.TypeId.BOOL:
+            return Column(typ, np.full(n, bool(value), dtype=np.bool_))
+        try:
+            npd = np.dtype(typ.np_dtype)
+            if npd.kind in "iu" and isinstance(value, int) and \
+                    not (np.iinfo(npd).min <= value <= np.iinfo(npd).max):
+                # np.full would silently wrap (np.array raises) — keep
+                # from_pylist's 22003 out-of-range behavior
+                raise OverflowError(value)
+            return Column(typ, np.full(n, value, dtype=typ.np_dtype))
+        except (OverflowError, ValueError, TypeError):
+            return Column.from_pylist([value] * n, typ)
 
     # -- accessors ---------------------------------------------------------
 

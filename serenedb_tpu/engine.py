@@ -2357,25 +2357,29 @@ class Connection:
                 # raw split: null markers compare BEFORE unescaping so a
                 # literal backslash-N value (escaped as \\N) round-trips
                 rows.append(_copy_text_split_raw(line, delim))
-        cols_vals: list[list] = [[] for _ in target_names]
         from .sql.binder import _cast_text_to
-        for r in rows:
-            if len(r) != len(target_names):
-                raise errors.SqlError(
-                    "22P04", f"row has {len(r)} columns, expected "
-                             f"{len(target_names)}")
-            for k, raw in enumerate(r):
-                if raw == null_s:
-                    cols_vals[k].append(None)
-                    continue
-                val = raw if is_csv else _copy_text_unescape(raw)
-                if types[k].is_string:
-                    cols_vals[k].append(val)
-                else:
-                    cols_vals[k].append(_cast_text_to(val, types[k]))
-        incoming = Batch(list(target_names),
+
+        def parse_chunk(chunk):
+            cols_vals: list[list] = [[] for _ in target_names]
+            for r in chunk:
+                if len(r) != len(target_names):
+                    raise errors.SqlError(
+                        "22P04", f"row has {len(r)} columns, expected "
+                                 f"{len(target_names)}")
+                for k, raw in enumerate(r):
+                    if raw == null_s:
+                        cols_vals[k].append(None)
+                        continue
+                    val = raw if is_csv else _copy_text_unescape(raw)
+                    if types[k].is_string:
+                        cols_vals[k].append(val)
+                    else:
+                        cols_vals[k].append(_cast_text_to(val, types[k]))
+            return Batch(list(target_names),
                          [Column.from_pylist(v, t)
                           for v, t in zip(cols_vals, types)])
+
+        incoming = _parse_chunked(rows, parse_chunk, self.settings)
         self._insert_batch(table, incoming)
         return QueryResult(Batch([], []), f"COPY {incoming.num_rows}")
 
@@ -2464,7 +2468,8 @@ class Connection:
         elif fmt in ("csv", "text"):
             # csv/text files are headerless positional data over exactly
             # the listed columns (PG COPY semantics)
-            sub = _read_csv(st.target, names, types, st.options)
+            sub = _read_csv(st.target, names, types, st.options,
+                            self.settings)
         else:
             raise errors.unsupported(f"COPY format {fmt}")
         self._insert_batch(table, sub)
@@ -2909,7 +2914,29 @@ def _inline_view(sel, view: ViewDef):
     return sel2
 
 
-def _read_csv(path: str, names: list, types: list, options: dict) -> Batch:
+#: rows per COPY/CSV parse chunk — fixed (worker-count independent) so
+#: the chunk split, and with it every parse error and dictionary merge,
+#: is deterministic
+COPY_PARSE_CHUNK_ROWS = 16384
+
+
+def _parse_chunked(rows: list, parse_chunk, settings) -> Batch:
+    """Chunk-parallel ingest parsing (reference ParallelSink analog:
+    per-thread sink writers building column fragments, merged in order).
+    parse_chunk(list-of-raw-rows) → Batch; chunks concatenate in row
+    order so the result is identical to one serial parse. With a worker
+    cap of 1 the whole input parses in one pass — per-chunk dictionary
+    encodes + a merge would be pure overhead with zero parallelism."""
+    from .parallel.pool import parallel_map, session_workers
+    if len(rows) <= COPY_PARSE_CHUNK_ROWS or session_workers(settings) <= 1:
+        return parse_chunk(rows)
+    chunks = [rows[i:i + COPY_PARSE_CHUNK_ROWS]
+              for i in range(0, len(rows), COPY_PARSE_CHUNK_ROWS)]
+    return concat_batches(parallel_map(settings, parse_chunk, chunks))
+
+
+def _read_csv(path: str, names: list, types: list, options: dict,
+              settings=None) -> Batch:
     import csv as _csv
     delim = str(options.get("delimiter", ","))
     header = str(options.get("header", "false")).lower() in ("true", "on", "1")
@@ -2917,18 +2944,23 @@ def _read_csv(path: str, names: list, types: list, options: dict) -> Batch:
         rows = list(_csv.reader(f, delimiter=delim))
     if header and rows:
         rows = rows[1:]
-    cols = []
-    for k, (nm, t) in enumerate(zip(names, types)):
-        vals = []
-        for r in rows:
-            raw = r[k] if k < len(r) else ""
-            if raw == "" or raw == "\\N":
-                vals.append(None)
-            else:
-                from .sql.binder import _cast_text_to
-                vals.append(raw if t.is_string else _cast_text_to(raw, t))
-        cols.append(Column.from_pylist(vals, t))
-    return Batch(list(names), cols)
+
+    def parse_chunk(chunk):
+        from .sql.binder import _cast_text_to
+        cols = []
+        for k, (nm, t) in enumerate(zip(names, types)):
+            vals = []
+            for r in chunk:
+                raw = r[k] if k < len(r) else ""
+                if raw == "" or raw == "\\N":
+                    vals.append(None)
+                else:
+                    vals.append(raw if t.is_string
+                                else _cast_text_to(raw, t))
+            cols.append(Column.from_pylist(vals, t))
+        return Batch(list(names), cols)
+
+    return _parse_chunked(rows, parse_chunk, settings)
 
 
 def _records_as_text(batch: Batch) -> Batch:

@@ -551,9 +551,11 @@ def _factorize_group_keys(node, scan, provider, pin_batch, dev_ver) -> dict:
         # eval error on a filtered-out row (e.g. division by zero) must
         # fall back, not surface
         raise NotCompilable(f"group key eval over unfiltered rows: {e}")
-    codes, uniq_vals, uniq_valid = ops_agg.factorize_keys(
-        [c.data for c in key_cols], [c.validity for c in key_cols])
-    g_count = len(uniq_vals[0]) if uniq_vals else 0
+    # shared with the host morsel sink: direct (perfect-hash) coding for
+    # small int/dict key spaces — no composite sort — with the factorize
+    # fallback for arbitrary keys; group order is identical either way
+    from .morsel import _group_codes
+    codes, uniq_vals, uniq_valid, g_count = _group_codes(key_cols)
     if g_count > MAX_GROUP_PRODUCT:
         raise NotCompilable(
             f"{g_count} distinct groups exceeds the device code-space cap")

@@ -1,0 +1,465 @@
+"""Morsel-driven parallel host pipelines: Scan→Filter→Project→partial-Agg.
+
+Reference analog: DuckDB's morsel-driven parallelism (SURVEY.md §3.2) — a
+table scan splits into fixed-size row morsels, each worker runs the WHOLE
+operator chain over its morsel and feeds a partial-aggregate sink, and a
+single combine step merges the partials. This is the host-CPU half of the
+engine's headline ratios; the device offload (exec/device_agg.py) claims
+the pipeline first and this path takes over whenever the device declines.
+
+Determinism contract (the bench ledger asserts device-vs-CPU parity, so
+the CPU result must not wobble):
+
+- the morsel split is a pure function of (row count, serene_morsel_rows)
+  — never of worker count or scheduling;
+- partial batches merge in MORSEL ORDER via one vectorized second-level
+  aggregation whose group order comes from the same composite-key
+  factorization the serial path uses (ops/agg.py factorize_keys), so
+  `serene_workers = 1` and `= N` produce bit-identical batches;
+- exact combiners: integer SUM/COUNT merge in int64, MIN/MAX are
+  selections, float partials accumulate in float64 with a fixed
+  association.
+
+Anything outside the supported shape (DISTINCT, ordered string_agg, record
+keys, custom providers) falls back to the serial CPU oracle in plan.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column, concat_batches
+from ..ops.agg import factorize_keys
+from ..parallel.pool import parallel_map
+from ..sql.expr import AggSpec
+
+#: aggregate functions with an exact partial/combine decomposition
+_PARALLEL_FUNCS = {
+    "count_star", "count", "sum", "min", "max", "avg",
+    "bool_and", "bool_or",
+    "stddev", "stddev_samp", "var_samp", "variance", "stddev_pop",
+    "var_pop",
+}
+
+_STDDEV = {"stddev", "stddev_samp", "var_samp", "variance", "stddev_pop",
+           "var_pop"}
+
+
+class _Fallback(Exception):
+    """Shape turned out unsupported mid-flight — use the serial path."""
+
+
+def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
+    """Morsel-parallel execution of an AggregateNode; None → serial CPU."""
+    from .plan import FilterNode, ProjectNode, ScanNode, check_cancel
+
+    settings = ctx.settings
+    stages = []
+    child = node.child
+    while isinstance(child, (FilterNode, ProjectNode)):
+        stages.append(child)
+        child = child.child
+    if type(child) is not ScanNode:
+        return None
+    scan = child
+    stages.reverse()
+    for spec in node.aggs:
+        if spec.func not in _PARALLEL_FUNCS or spec.distinct \
+                or spec.order_by:
+            return None
+    for g in node.group_exprs:
+        if g.type.id is dt.TypeId.RECORD:
+            return None
+    # two classes of expression pin a pipeline to serial execution:
+    # subquery impls carry lazily-computed one-shot caches that are not
+    # synchronized (every worker would run the inner plan), and volatile
+    # / sequence functions (nextval & co.) draw from shared mutable
+    # state whose interleaving would break the workers=1 == workers=N
+    # bit-identity contract.
+    from ..sql.binder import _VOLATILE_FUNCS
+    serial_only = _VOLATILE_FUNCS | {
+        "scalar_subquery", "array_subquery", "in_subquery", "exists",
+        "currval", "lastval"}
+    exprs = ([scan.filter] if scan.filter is not None else []) + \
+        [st.pred for st in stages if isinstance(st, FilterNode)] + \
+        [e for st in stages if isinstance(st, ProjectNode)
+         for e in st.exprs] + \
+        list(node.group_exprs) + \
+        [e for s in node.aggs for e in (s.arg, s.filter) if e is not None]
+    for e in exprs:
+        for sub in e.walk():
+            if getattr(sub, "name", None) in serial_only:
+                return None
+    provider = scan.provider
+    try:
+        nrows = provider.row_count()
+    except NotImplementedError:
+        return None
+    morsel_rows = int(settings.get("serene_morsel_rows"))
+    if nrows < int(settings.get("serene_parallel_min_rows")) or \
+            nrows <= morsel_rows:
+        return None
+    # ONE publication observation for the whole pipeline (same rule as the
+    # device path): every morsel slices the same batch reference.
+    full = provider.full_batch(scan.columns)
+    nrows = full.num_rows
+    spans = [(s, min(s + morsel_rows, nrows))
+             for s in range(0, nrows, morsel_rows)]
+
+    def run_morsel(span):
+        check_cancel()
+        b = full.slice(span[0], span[1])
+        if scan.filter is not None:
+            c = scan.filter.eval(b)
+            b = b.filter(c.data.astype(bool) & c.valid_mask())
+        for st in stages:
+            if isinstance(st, FilterNode):
+                c = st.pred.eval(b)
+                b = b.filter(c.data.astype(bool) & c.valid_mask())
+            else:
+                b = Batch(list(st.names), [e.eval(b) for e in st.exprs])
+        return _morsel_partials(node, b)
+
+    try:
+        partials = parallel_map(settings, run_morsel, spans)
+        return _merge_partials(node, partials)
+    except _Fallback:
+        return None
+
+
+# -- per-morsel partial states ----------------------------------------------
+#
+# Each morsel reduces to a tiny Batch: one row per (group seen in the
+# morsel), key columns first (real Columns, so dictionary-encoded string
+# keys merge through the normal concat machinery), then fixed-width state
+# columns per aggregate.
+
+
+#: combined slot-space cap for the direct (perfect-hash) key coding
+_DIRECT_SPACE_CAP = 1 << 16
+
+
+def _direct_key_plan(key_cols: list[Column]) -> Optional[list[tuple]]:
+    """[(lo, range)] per key when every key direct-codes into a small
+    slot space (dict codes / small-range ints), else None. Mirrors the
+    device path's perfect-hash key coding (device_agg._plan_direct_keys)
+    so the host morsel sink skips the composite lexsort entirely."""
+    plan: list[tuple] = []
+    space = 1
+    for kc in key_cols:
+        d = kc.data
+        if kc.type.is_string and kc.dictionary is not None:
+            lo, r = 0, len(kc.dictionary)
+        elif d.dtype.kind in "iu":
+            vd = d if kc.validity is None else d[kc.validity]
+            if not len(vd):
+                lo, r = 0, 0
+            else:
+                lo = int(vd.min())
+                r = int(vd.max()) - lo + 1
+        else:
+            return None
+        plan.append((lo, r))
+        space *= r + 1          # one extra slot per key: NULL sorts last
+        if space > _DIRECT_SPACE_CAP:
+            return None
+    return plan
+
+
+def _direct_codes(key_cols: list[Column], plan: list[tuple],
+                  ) -> tuple[np.ndarray, list[np.ndarray], np.ndarray, int]:
+    """Dense group codes via direct slot coding — no sort. Slot order per
+    key is (valid values ascending, NULL last), the exact composite order
+    factorize_keys produces, so group order is identical either way."""
+    n = len(key_cols[0].data)
+    codes = np.zeros(n, dtype=np.int64)
+    for kc, (lo, r) in zip(key_cols, plan):
+        slot = kc.data.astype(np.int64) - lo
+        if kc.validity is not None:
+            slot = np.where(kc.validity, slot, r)
+        codes = codes * (r + 1) + slot
+    space = 1
+    for _, r in plan:
+        space *= r + 1
+    occ = np.bincount(codes, minlength=space)
+    present = np.flatnonzero(occ)
+    remap = np.zeros(space, dtype=np.int64)
+    remap[present] = np.arange(len(present))
+    dense = remap[codes].astype(np.int32)
+    uniq_vals: list[np.ndarray] = []
+    valids: list[np.ndarray] = []
+    rem = present.copy()
+    for kc, (lo, r) in zip(reversed(key_cols), reversed(plan)):
+        slot = rem % (r + 1)
+        rem = rem // (r + 1)
+        valid = slot != r
+        vals = np.where(valid, slot + lo, 0).astype(kc.data.dtype)
+        uniq_vals.append(vals)
+        valids.append(valid)
+    uniq_vals.reverse()
+    valids.reverse()
+    uniq_valid = np.stack(valids) if valids \
+        else np.ones((0, len(present)), dtype=bool)
+    return dense, uniq_vals, uniq_valid, len(present)
+
+
+def _group_codes(key_cols: list[Column],
+                 ) -> tuple[np.ndarray, list[np.ndarray], np.ndarray, int]:
+    n = len(key_cols[0].data)
+    if n:
+        plan = _direct_key_plan(key_cols)
+        if plan is not None:
+            return _direct_codes(key_cols, plan)
+    codes, uniq_vals, uniq_valid = factorize_keys(
+        [c.data for c in key_cols],
+        [c.validity for c in key_cols])
+    g = len(uniq_vals[0]) if uniq_vals else 0
+    return codes, uniq_vals, uniq_valid, g
+
+
+def _morsel_partials(node, b: Batch) -> Batch:
+    key_cols = [g.eval(b) for g in node.group_exprs]
+    if key_cols:
+        codes, uniq_vals, uniq_valid, g = _group_codes(key_cols)
+    else:
+        codes = np.zeros(b.num_rows, dtype=np.int32)
+        uniq_vals, uniq_valid = [], np.ones((0, 1), dtype=bool)
+        g = 1
+    names: list[str] = []
+    cols: list[Column] = []
+    for k, kc in enumerate(key_cols):
+        validity = uniq_valid[k] if uniq_valid.size else None
+        if validity is not None and validity.all():
+            validity = None
+        names.append(f"#k{k}")
+        cols.append(Column(kc.type, uniq_vals[k], validity, kc.dictionary))
+    for j, spec in enumerate(node.aggs):
+        for m, c in enumerate(_partial_state(spec, b, codes, g)):
+            names.append(f"#s{j}_{m}")
+            cols.append(c)
+    return Batch(names, cols)
+
+
+def _partial_state(spec: AggSpec, b: Batch, codes: np.ndarray,
+                   g: int) -> list[Column]:
+    if spec.filter is not None:
+        c = spec.filter.eval(b)
+        fm = c.data.astype(bool) & c.valid_mask()
+        b = b.filter(fm)
+        codes = codes[fm]
+    if spec.func == "count_star":
+        return [_i64(np.bincount(codes, minlength=g))]
+    arg = spec.arg.eval(b)
+    valid = arg.valid_mask()
+    vc = codes[valid]
+    cnt = np.bincount(vc, minlength=g).astype(np.int64)
+    if spec.func == "count":
+        return [_i64(cnt)]
+    vals = arg.data[valid]
+    empty = cnt == 0
+    if spec.func in ("sum", "avg") or spec.func in _STDDEV:
+        # keyed off the DECLARED result type: sum(bool) binds as DOUBLE
+        # (BOOL is not is_integer), so its partials must be float or the
+        # result batch would contradict the RowDescription type
+        int_sum = spec.func == "sum" and spec.type.is_integer
+        if int_sum:
+            acc = np.zeros(g, dtype=np.int64)
+            np.add.at(acc, vc, vals.astype(np.int64))
+            return [_i64(acc), _i64(cnt)]
+        s1 = np.zeros(g, dtype=np.float64)
+        fv = vals.astype(np.float64)
+        np.add.at(s1, vc, fv)
+        if spec.func in _STDDEV:
+            s2 = np.zeros(g, dtype=np.float64)
+            np.add.at(s2, vc, fv * fv)
+            return [_f64(s1), _f64(s2), _i64(cnt)]
+        return [_f64(s1), _i64(cnt)]
+    if spec.func in ("min", "max"):
+        if arg.type.is_string:
+            if arg.dictionary is None:
+                raise _Fallback("string min/max without dictionary")
+            # sorted dictionary ⇒ code order == string order; ship the
+            # per-group champion as a real VARCHAR column so concat
+            # re-encodes codes onto the merged dictionary
+            ident = np.iinfo(np.int64).max if spec.func == "min" else -1
+            acc = np.full(g, ident, dtype=np.int64)
+            ufunc = np.minimum if spec.func == "min" else np.maximum
+            ufunc.at(acc, vc, vals.astype(np.int64))
+            acc = np.where(empty, 0, acc).astype(np.int32)
+            return [Column(dt.VARCHAR, acc,
+                           ~empty if empty.any() else None, arg.dictionary),
+                    _i64(cnt)]
+        if arg.type.is_float:
+            if spec.func == "min":
+                # PG float order: min skips NaN unless the group is
+                # all-NaN — track has-non-NaN alongside (serial path's
+                # np.fmin + has_non_nan stamp, decomposed)
+                acc = np.full(g, np.inf, dtype=np.float64)
+                with np.errstate(invalid="ignore"):
+                    np.fmin.at(acc, vc, vals.astype(np.float64))
+                nonnan = np.zeros(g, dtype=bool)
+                np.logical_or.at(nonnan, vc, ~np.isnan(vals))
+                return [_f64(acc), _i64(nonnan.astype(np.int64)),
+                        _i64(cnt)]
+            acc = np.full(g, -np.inf, dtype=np.float64)
+            with np.errstate(invalid="ignore"):   # NaN propagation wanted
+                np.maximum.at(acc, vc, vals.astype(np.float64))
+            return [_f64(acc), _i64(cnt)]
+        ident = np.iinfo(np.int64).max if spec.func == "min" else \
+            np.iinfo(np.int64).min
+        acc = np.full(g, ident, dtype=np.int64)
+        ufunc = np.minimum if spec.func == "min" else np.maximum
+        ufunc.at(acc, vc, vals.astype(np.int64))
+        return [_i64(acc), _i64(cnt)]
+    if spec.func in ("bool_and", "bool_or"):
+        vb = vals.astype(bool)
+        if spec.func == "bool_and":
+            acc = np.ones(g, dtype=bool)
+            np.logical_and.at(acc, vc, vb)
+        else:
+            acc = np.zeros(g, dtype=bool)
+            np.logical_or.at(acc, vc, vb)
+        return [Column(dt.BOOL, acc), _i64(cnt)]
+    raise _Fallback(f"aggregate {spec.func}")
+
+
+def _i64(a: np.ndarray) -> Column:
+    return Column(dt.BIGINT, a.astype(np.int64))
+
+
+def _f64(a: np.ndarray) -> Column:
+    return Column(dt.DOUBLE, a.astype(np.float64))
+
+
+_STATE_WIDTH = {"count_star": 1, "count": 1, "sum": 2, "avg": 2,
+                "min": 2, "max": 2, "bool_and": 2, "bool_or": 2}
+
+
+def _state_width(spec: AggSpec) -> int:
+    if spec.func in _STDDEV:
+        return 3
+    if spec.func == "min" and spec.arg is not None and \
+            spec.arg.type.is_float:
+        return 3
+    return _STATE_WIDTH[spec.func]
+
+
+# -- merge sink --------------------------------------------------------------
+
+
+def _merge_partials(node, partials: list[Batch]) -> Batch:
+    nk = len(node.group_exprs)
+    merged = concat_batches(partials)
+    if nk:
+        key_cols = merged.columns[:nk]
+        codes, uniq_vals, uniq_valid = factorize_keys(
+            [c.data for c in key_cols],
+            [c.validity for c in key_cols])
+        g = len(uniq_vals[0]) if uniq_vals else 0
+    else:
+        codes = np.zeros(merged.num_rows, dtype=np.int32)
+        uniq_vals, uniq_valid = [], np.ones((0, 1), dtype=bool)
+        g = 1
+    out_cols: list[Column] = []
+    for k in range(nk):
+        kc = key_cols[k]
+        validity = uniq_valid[k] if uniq_valid.size else None
+        if validity is not None and validity.all():
+            validity = None
+        out_cols.append(Column(kc.type, uniq_vals[k], validity,
+                               kc.dictionary))
+    ci = nk
+    for spec in node.aggs:
+        w = _state_width(spec)
+        out_cols.append(_combine(spec, merged.columns[ci:ci + w], codes, g))
+        ci += w
+    return Batch(list(node.names), out_cols)
+
+
+def _combine(spec: AggSpec, states: list[Column], codes: np.ndarray,
+             g: int) -> Column:
+    if spec.func in ("count_star", "count"):
+        acc = np.zeros(g, dtype=np.int64)
+        np.add.at(acc, codes, states[0].data)
+        return Column(dt.BIGINT, acc)
+    cnt = np.zeros(g, dtype=np.int64)
+    np.add.at(cnt, codes, states[-1].data)
+    empty = cnt == 0
+    validity = ~empty if empty.any() else None
+    # value scatters only take partial rows that actually saw valid input
+    live = states[-1].data > 0
+    lc = codes[live]
+    if spec.func == "sum":
+        v = states[0]
+        if v.data.dtype.kind == "i":
+            acc = np.zeros(g, dtype=np.int64)
+            np.add.at(acc, lc, v.data[live])
+            return Column(dt.BIGINT, acc, validity)
+        acc = np.zeros(g, dtype=np.float64)
+        np.add.at(acc, lc, v.data[live])
+        return Column(dt.DOUBLE, acc, validity)
+    if spec.func == "avg":
+        acc = np.zeros(g, dtype=np.float64)
+        np.add.at(acc, lc, states[0].data[live])
+        with np.errstate(invalid="ignore", divide="ignore"):
+            data = acc / cnt
+        return Column(dt.DOUBLE, np.where(empty, 0.0, data), validity)
+    if spec.func in _STDDEV:
+        pop = spec.func.endswith("_pop")
+        s1 = np.zeros(g, dtype=np.float64)
+        s2 = np.zeros(g, dtype=np.float64)
+        np.add.at(s1, lc, states[0].data[live])
+        np.add.at(s2, lc, states[1].data[live])
+        fc = cnt.astype(np.float64)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = (s2 - s1 * s1 / fc) / (fc if pop else fc - 1)
+        var = np.maximum(var, 0.0)     # float cancellation clamp (PG)
+        bad = cnt < (1 if pop else 2)
+        data = np.sqrt(var) if spec.func.startswith("stddev") else var
+        return Column(dt.DOUBLE, np.where(bad, 0.0, data),
+                      ~bad if bad.any() else None)
+    if spec.func in ("min", "max"):
+        t = spec.arg.type
+        if t.is_string:
+            v = states[0]
+            ident = np.iinfo(np.int64).max if spec.func == "min" else -1
+            acc = np.full(g, ident, dtype=np.int64)
+            ufunc = np.minimum if spec.func == "min" else np.maximum
+            ufunc.at(acc, lc, v.data[live].astype(np.int64))
+            acc = np.where(empty, 0, acc).astype(np.int32)
+            return Column(dt.VARCHAR, acc, validity, v.dictionary)
+        if t.is_float:
+            if spec.func == "min":
+                acc = np.full(g, np.inf, dtype=np.float64)
+                # partial mins never hold NaN (fmin skips; all-NaN groups
+                # hold the +inf identity) so plain minimum is exact here
+                np.minimum.at(acc, lc, states[0].data[live])
+                nonnan = np.zeros(g, dtype=bool)
+                np.logical_or.at(nonnan, lc, states[1].data[live] > 0)
+                acc = np.where(~empty & ~nonnan, np.nan, acc)
+            else:
+                acc = np.full(g, -np.inf, dtype=np.float64)
+                with np.errstate(invalid="ignore"):
+                    np.maximum.at(acc, lc, states[0].data[live])
+            acc = np.where(empty, 0, acc).astype(t.np_dtype)
+            return Column(t, acc, validity)
+        ident = np.iinfo(np.int64).max if spec.func == "min" else \
+            np.iinfo(np.int64).min
+        acc = np.full(g, ident, dtype=np.int64)
+        ufunc = np.minimum if spec.func == "min" else np.maximum
+        ufunc.at(acc, lc, states[0].data[live])
+        acc = np.where(empty, 0, acc).astype(t.np_dtype)
+        return Column(t, acc, validity)
+    if spec.func in ("bool_and", "bool_or"):
+        v = states[0].data.astype(bool)
+        if spec.func == "bool_and":
+            acc = np.ones(g, dtype=bool)
+            np.logical_and.at(acc, lc, v[live])
+        else:
+            acc = np.zeros(g, dtype=bool)
+            np.logical_or.at(acc, lc, v[live])
+        return Column(dt.BOOL, acc, validity)
+    raise _Fallback(f"aggregate {spec.func}")

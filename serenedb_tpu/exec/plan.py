@@ -672,6 +672,13 @@ class AggregateNode(PlanNode):
         if result is not None:
             yield result
             return
+        # the device path declined the pipeline — morsel-parallel host
+        # execution over the shared worker pool, serial oracle last
+        from .morsel import try_parallel_aggregate
+        result = try_parallel_aggregate(self, ctx)
+        if result is not None:
+            yield result
+            return
         yield self._cpu_aggregate(ctx)
 
     def _try_count_fast_path(self, ctx):

@@ -301,7 +301,14 @@ class ParquetTable(TableProvider):
                 # use_threads=False: pyarrow's internal CPU pool segfaults when a
                 # write happened on another (daemon) server thread earlier in
                 # this process; single-threaded decode is safe and the column
-                # cache amortizes it (see test_filesource server drive)
+                # cache amortizes it (see test_filesource server drive).
+                # Column BUILDING stays serial for the same reason:
+                # _arrow_to_column runs pyarrow compute (combine_chunks,
+                # cast, dictionary_encode) that may touch the same native
+                # pool — handing it to worker threads would reintroduce
+                # exactly the multithreaded-pyarrow state this workaround
+                # exists to avoid. Ingest parallelism lives in the COPY
+                # text/csv chunk parser instead (engine._parse_chunked).
                 tbl = self._pf.read(columns=to_read, use_threads=False)
                 for cname in to_read:
                     self._columns[cname] = _arrow_to_column(tbl.column(cname))

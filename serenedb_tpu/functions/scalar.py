@@ -2074,7 +2074,31 @@ def _current_date(ts):
 
 @register("age")
 def _age(ts):
-    """age(ts, ts) → INTERVAL (micros; PG renders day/time parts)."""
+    """age(ts, ts) → INTERVAL (micros; PG renders day/time parts).
+    age(ts) → midnight of current_date minus ts (PG 1-arg form)."""
+    if len(ts) == 1:
+        if ts[0].id not in (dt.TypeId.TIMESTAMP, dt.TypeId.DATE):
+            return None
+        arg_is_date = ts[0].id is dt.TypeId.DATE
+
+        def impl1(cols, n, _date=arg_is_date):
+            # statement-stable reference (like now()): every batch/morsel
+            # of one statement sees the same "today's midnight"
+            conn = _current_conn()
+            now_us = getattr(conn, "stmt_now_us", None) \
+                if conn is not None else None
+            if now_us is None:
+                import time as _time
+                now_us = int(_time.time() * 1e6)
+            midnight = (now_us // 86_400_000_000) * 86_400_000_000
+            a = cols[0].data.astype(np.int64)
+            if _date:          # DATE stores days-since-epoch, not micros
+                a = a * 86_400_000_000
+            return _result(dt.INTERVAL, midnight - a, cols)
+        return FunctionResolution(dt.INTERVAL, impl1)
+    if len(ts) != 2:
+        return None   # clean 42883 undefined-function, not an IndexError
+
     def impl(cols, n):
         a = cols[0].data.astype(np.int64)
         b = cols[1].data.astype(np.int64)
@@ -2156,6 +2180,7 @@ def _array_upper(ts):
 
     def impl(cols, n):
         vals = cols[0].to_pylist()
+        dims = cols[1].to_pylist()
         out = np.zeros(n, dtype=np.int64)
         invalid = np.zeros(n, dtype=bool)
         for i in range(n):
@@ -2164,7 +2189,8 @@ def _array_upper(ts):
                     else None
             except json.JSONDecodeError:
                 arr = None
-            if isinstance(arr, list) and arr:
+            # arrays here are 1-D: any dim other than 1 is NULL (PG)
+            if dims[i] == 1 and isinstance(arr, list) and arr:
                 out[i] = len(arr)
             else:
                 invalid[i] = True

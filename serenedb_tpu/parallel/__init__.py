@@ -1,5 +1,7 @@
 from .mesh import (combine_agg_partials, make_mesh, sharded_agg_step,
                    sharded_bm25_topk, sharded_query_step, shard_rows)
+from .pool import (WorkerPool, get_pool, parallel_map, session_workers)
 
 __all__ = ["combine_agg_partials", "make_mesh", "sharded_agg_step",
-           "sharded_bm25_topk", "sharded_query_step", "shard_rows"]
+           "sharded_bm25_topk", "sharded_query_step", "shard_rows",
+           "WorkerPool", "get_pool", "parallel_map", "session_workers"]

@@ -1,0 +1,248 @@
+"""Shared morsel worker pool: one process-wide set of execution threads.
+
+Reference analog: the reference runs ALL intra-node parallelism over shared
+thread pools (DuckDB's TaskScheduler morsel workers plus the iresearch
+search/consolidation pools; SURVEY.md §3.2). Concurrent sessions therefore
+share ONE pool instead of spawning per-query threads and oversubscribing
+the host — the same policy here: a lazily-started singleton sized by the
+`serene_workers` global (default = CPU count).
+
+Scheduling is a work-stealing design scaled to morsel granularity: each
+worker owns a deque, submissions land round-robin, and an idle worker
+steals from the opposite end of a sibling's deque. Tasks capture the
+submitter's contextvars (`contextvars.copy_context`), so executor-level
+facilities keyed on the current connection — cooperative cancellation
+(`plan.check_cancel`), statement-stable `now()` — keep working on worker
+threads exactly as they do inline.
+
+Determinism contract: the pool never reorders RESULTS. `map_ordered`
+returns results in submission order and raises the lowest-index failure
+after every submitted task has drained, so a cancelled/failed query can
+never leave orphan morsels behind to poison a later query.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import os
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+from typing import Callable, Iterable, Optional, Sequence
+
+from ..utils import metrics
+
+
+class _Task:
+    __slots__ = ("fn", "args", "future", "ctx", "t_submit")
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+        self.future: Future = Future()
+        self.ctx = contextvars.copy_context()
+        self.t_submit = time.perf_counter()
+
+
+class WorkerPool:
+    """Work-stealing thread pool; see module docstring for the contract."""
+
+    def __init__(self, size: int):
+        self.size = max(1, int(size))
+        self._deques: list[collections.deque] = [
+            collections.deque() for _ in range(self.size)]
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._threads: list[threading.Thread] = []
+        self._worker_ids: set[int] = set()
+        self._rr = 0
+        self._shutdown = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def ensure_started(self) -> "WorkerPool":
+        with self._lock:
+            if self._threads or self._shutdown:
+                return self
+            for wid in range(self.size):
+                t = threading.Thread(target=self._worker, args=(wid,),
+                                     name=f"sdb-morsel-{wid}", daemon=True)
+                self._threads.append(t)
+            for t in self._threads:
+                t.start()
+        return self
+
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    @property
+    def in_worker(self) -> bool:
+        """True when the calling thread IS a pool worker — nested fan-out
+        must run inline (a saturated pool waiting on itself deadlocks)."""
+        return threading.get_ident() in self._worker_ids
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, fn: Callable, *args) -> Future:
+        task = _Task(fn, args)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("worker pool is shut down")
+            self._deques[self._rr % self.size].append(task)
+            self._rr += 1
+            self._cv.notify()
+        if not self._threads:
+            self.ensure_started()
+        return task.future
+
+    def map_ordered(self, fn: Callable, items: Sequence,
+                    parallelism: Optional[int] = None) -> list:
+        """Run fn over items on the pool; results in ITEM order.
+
+        Every submitted task drains (runs or is cancelled-before-start)
+        before this returns or raises; on failure the lowest-index
+        exception is raised. parallelism bounds this CALL's in-flight
+        tasks (per-session `serene_workers` cap) without resizing the
+        shared pool.
+        """
+        items = list(items)
+        cap = self.size if parallelism is None else min(parallelism, self.size)
+        if len(items) <= 1 or cap <= 1 or self.in_worker:
+            return [fn(it) for it in items]
+        # window == cap: at most `cap` tasks in flight (queued + running),
+        # so a session's serene_workers cap truly bounds its parallelism
+        # even when more pool workers are idle
+        window = cap
+        futs: list[Optional[Future]] = [None] * len(items)
+        results: list = [None] * len(items)
+        first_exc: Optional[BaseException] = None
+        submitted = 0
+
+        def pump():
+            nonlocal submitted
+            while submitted < len(items) and first_exc is None and \
+                    submitted - drained < window:
+                futs[submitted] = self.submit(fn, items[submitted])
+                submitted += 1
+
+        drained = 0
+        pump()
+        while drained < submitted:
+            f = futs[drained]
+            try:
+                results[drained] = f.result()
+            except CancelledError:
+                pass  # cancelled after an earlier failure: already drained
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_exc is None:
+                    first_exc = e
+                    for g in futs[drained + 1:submitted]:
+                        if g is not None:
+                            g.cancel()
+            drained += 1
+            pump()
+        if first_exc is not None:
+            raise first_exc
+        return results
+
+    # -- worker loop -------------------------------------------------------
+
+    def _pop_task(self, wid: int) -> Optional[_Task]:
+        dq = self._deques[wid]
+        if dq:
+            return dq.popleft()
+        for off in range(1, self.size):
+            other = self._deques[(wid + off) % self.size]
+            if other:
+                task = other.pop()       # steal from the opposite end
+                metrics.POOL_STEALS.add()
+                return task
+        return None
+
+    def _worker(self, wid: int):
+        self._worker_ids.add(threading.get_ident())
+        while True:
+            with self._cv:
+                task = self._pop_task(wid)
+                while task is None and not self._shutdown:
+                    self._cv.wait()
+                    task = self._pop_task(wid)
+                if task is None:   # shutdown
+                    return
+            f = task.future
+            if not f.set_running_or_notify_cancel():
+                continue           # cancelled while queued: drained, no run
+            t0 = time.perf_counter()
+            metrics.POOL_QUEUE_WAIT_US.add(int((t0 - task.t_submit) * 1e6))
+            try:
+                result = task.ctx.run(task.fn, *task.args)
+            except BaseException as e:  # noqa: BLE001 — delivered via future
+                f.set_exception(e)
+            else:
+                f.set_result(result)
+            finally:
+                metrics.POOL_MORSELS.add()
+                metrics.POOL_BUSY_US.add(
+                    int((time.perf_counter() - t0) * 1e6))
+
+
+# -- process-wide singleton -------------------------------------------------
+
+_POOL: Optional[WorkerPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def default_workers() -> int:
+    return os.cpu_count() or 1
+
+
+def get_pool() -> WorkerPool:
+    """The process-wide shared pool, sized from the `serene_workers`
+    GLOBAL at first use (sessions cap their own parallelism per query via
+    the session-scope value; the pool itself is shared and fixed)."""
+    global _POOL
+    pool = _POOL
+    if pool is not None:
+        return pool
+    with _POOL_LOCK:
+        if _POOL is None:
+            from ..utils.config import REGISTRY
+            try:
+                size = int(REGISTRY.get_global("serene_workers"))
+            except KeyError:
+                size = default_workers()
+            _POOL = WorkerPool(size)
+        return _POOL
+
+
+def session_workers(settings) -> int:
+    """Per-query parallelism cap (>=1). settings=None → the executing
+    connection's session settings when inside a statement, else the
+    global default (library callers outside any session)."""
+    if settings is None:
+        from ..engine import CURRENT_CONNECTION
+        conn = CURRENT_CONNECTION.get()
+        if conn is not None:
+            settings = conn.settings
+    try:
+        if settings is not None:
+            w = int(settings.get("serene_workers"))
+        else:
+            from ..utils.config import REGISTRY
+            w = int(REGISTRY.get_global("serene_workers"))
+    except KeyError:
+        w = default_workers()
+    return max(1, w)
+
+
+def parallel_map(settings, fn: Callable, items: Iterable) -> list:
+    """map_ordered over the shared pool, capped by the session's
+    `serene_workers`; runs inline when the cap (or item count) is 1."""
+    items = list(items)
+    cap = session_workers(settings)
+    if cap <= 1 or len(items) <= 1:
+        return [fn(it) for it in items]
+    return get_pool().ensure_started().map_ordered(fn, items, cap)

@@ -632,6 +632,29 @@ class SegmentSearcher:
                 docs[order].astype(np.int32))
 
 
+def merge_segment_topk(seg_outs: list, bases: list[int], n_queries: int,
+                       k: int) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Single-heap merge of per-segment top-k collector outputs.
+
+    seg_outs[si][qi] = (scores, local doc ids) for segment si. Ordering
+    is (score desc, global doc id asc) — the doc-id tie-break makes the
+    merged ranking a pure function of the data, independent of segment
+    count, arrival order, or worker scheduling."""
+    import heapq
+    results = []
+    for qi in range(n_queries):
+        entries: list[tuple[float, int]] = []
+        for out, base in zip(seg_outs, bases):
+            sc, dd = out[qi]
+            entries.extend(zip(sc.tolist(),
+                               (dd.astype(np.int64) + base).tolist()))
+        cand = heapq.nlargest(k, entries, key=lambda t: (t[0], -t[1]))
+        results.append((
+            np.asarray([c[0] for c in cand], dtype=np.float32),
+            np.asarray([c[1] for c in cand], dtype=np.int64)))
+    return results
+
+
 class MultiSearcher:
     """Searches across immutable segments of one column (reference:
     DirectoryReader over segment readers, SURVEY.md §2.7). Doc ids are
@@ -692,9 +715,35 @@ class MultiSearcher:
             seg, base = self.segments[0]
             out = seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n)
             return [(s, d.astype(np.int64) + base) for s, d in out]
+        idf_factory = self._segment_idf_factory(nodes, scorer)
+        avgdl = self.global_avgdl
+
+        def run_segment(seg_base):
+            seg, _base = seg_base
+            return seg.topk_batch(nodes, k, scorer,
+                                  idf_of=idf_factory(seg),
+                                  avgdl_override=avgdl, mesh_n=mesh_n)
+
+        # segments are independent top-k collectors: search them on the
+        # shared worker pool (reference: parallel scored collectors over
+        # the search thread pool). With a device mesh active the mesh IS
+        # the parallelism — keep the segment loop serial then.
+        from ..parallel.pool import get_pool, session_workers
+        cap = 1 if mesh_n > 1 else session_workers(None)
+        if cap > 1 and len(self.segments) > 1:
+            seg_outs = get_pool().ensure_started().map_ordered(
+                run_segment, list(self.segments), cap)
+        else:
+            seg_outs = [run_segment(sb) for sb in self.segments]
+        return merge_segment_topk(seg_outs,
+                                  [b for _, b in self.segments],
+                                  len(nodes), k)
+
+    def _segment_idf_factory(self, nodes: list[QNode], scorer: str):
+        """seg → idf_of closure over GLOBAL collection stats. One pass:
+        global df per query term STRING (terms have different ids per
+        segment), shared by every segment's closure."""
         n_total = max(self.num_docs, 1)
-        # one pass: global df per query term STRING (terms have different
-        # ids per segment), shared by every segment's idf closure
         term_strings: set[str] = set()
         for node in nodes:
             for seg, _ in self.segments:
@@ -707,8 +756,8 @@ class MultiSearcher:
                       if lm else {})
         total_tokens = (float(sum(s.index.total_tokens
                                   for s, _ in self.segments)) if lm else 0.0)
-        merged: list[list[tuple]] = [[] for _ in nodes]
-        for seg, base in self.segments:
+
+        def factory(seg):
             terms_str = seg.index.terms_str
 
             def idf_of(tids, _ts=terms_str):
@@ -722,19 +771,48 @@ class MultiSearcher:
                                  dtype=np.int64)
                 return bm25_ops.idf_for(scorer, n_total, dfs)
 
-            out = seg.topk_batch(nodes, k, scorer, idf_of=idf_of,
-                                 avgdl_override=self.global_avgdl,
-                                 mesh_n=mesh_n)
-            for qi, (sc, dd) in enumerate(out):
-                merged[qi].extend(zip(sc.tolist(),
-                                      (dd.astype(np.int64) + base).tolist()))
-        results = []
-        for qi in range(len(nodes)):
-            cand = sorted(merged[qi], key=lambda t: -t[0])[:k]
-            results.append((
-                np.asarray([c[0] for c in cand], dtype=np.float32),
-                np.asarray([c[1] for c in cand], dtype=np.int64)))
-        return results
+            return idf_of
+        return factory
+
+    def cpu_topk(self, node: QNode, k: int, scorer: str = "bm25",
+                 ) -> tuple[np.ndarray, np.ndarray]:
+        """Host-only top-k: block-max WAND per segment on the worker
+        pool, merged by one heap — the multi-segment analog of
+        SegmentSearcher.cpu_topk_wand (reference: ScanMode::TopK parallel
+        scored collectors). Exact-match-mask shapes score their match set
+        directly; pure negations return zero-scored matches."""
+        idf_factory = self._segment_idf_factory([node], scorer)
+        avgdl = self.global_avgdl
+
+        def run_segment(seg_base):
+            seg, _base = seg_base
+            idf_of = idf_factory(seg)
+            tids, req, needs_mask, empty = seg._query_shape(node)
+            if empty:
+                return (np.empty(0, dtype=np.float32),
+                        np.empty(0, dtype=np.int32))
+            if not tids:
+                match = seg.eval_filter(node)[:k]
+                return (np.zeros(len(match), dtype=np.float32),
+                        match.astype(np.int32))
+            if needs_mask:
+                match = seg.eval_filter(node)
+                sc, dd = seg._cpu_score(match, tids, k, scorer, idf_of,
+                                        avgdl)
+                keep = sc > 0.0
+                return (sc[keep][:k], dd[keep][:k])
+            return seg.cpu_topk_wand(tids, k, scorer, idf_of=idf_of,
+                                     avgdl_override=avgdl, require_all=req)
+
+        from ..parallel.pool import get_pool, session_workers
+        cap = session_workers(None)
+        if cap > 1 and len(self.segments) > 1:
+            outs = get_pool().ensure_started().map_ordered(
+                run_segment, list(self.segments), cap)
+        else:
+            outs = [run_segment(sb) for sb in self.segments]
+        return merge_segment_topk([[o] for o in outs],
+                                  [b for _, b in self.segments], 1, k)[0]
 
 
 @dataclass

@@ -1115,7 +1115,15 @@ def _remove_stale_unix_socket(path: str) -> None:
         probe.close()
         raise errors.SqlError(
             "55006", f"unix socket {path!r} is in use by a live server")
-    except (ConnectionRefusedError, _socket.timeout, FileNotFoundError):
+    except _socket.timeout:
+        # a connect timeout is NOT proof of death — a live server with a
+        # full accept backlog looks exactly like this. Never steal the
+        # path; report it busy (reference: 55006 object_in_use).
+        probe.close()
+        raise errors.SqlError(
+            "55006", f"unix socket {path!r} did not answer within 1s; "
+            "assuming a live (busy) server owns it")
+    except (ConnectionRefusedError, FileNotFoundError):
         probe.close()
         try:
             os.unlink(path)   # stale socket from a crashed process
@@ -1201,6 +1209,13 @@ class PgServer:
 
     async def start(self):
         from .listen import parse_listen_spec
+
+        # warm the SHARED morsel worker pool at server start: every
+        # session's parallel pipelines run on this one pool, so worker
+        # count never multiplies with connection count (reference: one
+        # TaskScheduler shared by all DuckDB connections)
+        from ..parallel.pool import get_pool
+        get_pool().ensure_started()
         self._server = await asyncio.start_server(
             self._client, self.host, self.port)
         addr = self._server.sockets[0].getsockname()

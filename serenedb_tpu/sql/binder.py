@@ -223,22 +223,31 @@ class ExprBinder:
             esc = getattr(e, "escape", None)
             if esc is not None and isinstance(pattern, ast.Literal) \
                     and isinstance(pattern.value, str):
-                # normalize a custom ESCAPE char to the impl's backslash
-                out = []
-                i = 0
                 pv = pattern.value
-                while i < len(pv):
-                    ch = pv[i]
-                    if ch == esc and i + 1 < len(pv):
-                        out.append("\\" + pv[i + 1])
-                        i += 2
-                        continue
-                    if ch == "\\":
-                        out.append("\\\\")
-                    else:
-                        out.append(ch)
-                    i += 1
-                pattern = ast.Literal("".join(out))
+                if esc == "":
+                    # ESCAPE '' disables escaping (PG): every character,
+                    # including backslash, is literal to the impl
+                    pattern = ast.Literal(pv.replace("\\", "\\\\"))
+                else:
+                    # normalize a custom ESCAPE char to the impl's backslash
+                    out = []
+                    i = 0
+                    while i < len(pv):
+                        ch = pv[i]
+                        if ch == esc:
+                            if i + 1 >= len(pv):
+                                raise errors.SqlError(
+                                    "22025", "LIKE pattern must not end "
+                                    "with escape character")
+                            out.append("\\" + pv[i + 1])
+                            i += 2
+                            continue
+                        if ch == "\\":
+                            out.append("\\\\")
+                        else:
+                            out.append(ch)
+                        i += 1
+                    pattern = ast.Literal("".join(out))
             elif esc is not None:
                 raise errors.unsupported(
                     "ESCAPE with a non-constant pattern")

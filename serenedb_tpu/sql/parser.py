@@ -424,7 +424,8 @@ class Parser:
     def _parse_like_escape(self):
         if self.accept_kw("ESCAPE"):
             t = self.next()
-            if t.kind is not T.STRING or len(t.value) != 1:
+            # ESCAPE '' is valid PG: it DISABLES escaping
+            if t.kind is not T.STRING or len(t.value) > 1:
                 raise errors.syntax("ESCAPE must be a single character")
             return t.value
         return None

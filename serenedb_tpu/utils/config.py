@@ -139,3 +139,26 @@ declare("serene_mesh", 0, int,
         "shard device programs across an N-device jax mesh (0 = single "
         "device); grouped aggregates and BM25 top-k run as shard_map "
         "programs with psum/pmin/pmax merges over ICI")
+
+
+def _cpu_count() -> int:
+    import os
+    return os.cpu_count() or 1
+
+
+declare("serene_workers", _cpu_count(), int,
+        "host worker-pool parallelism for morsel-driven execution "
+        "(scans/aggregates, segment search, ingest parsing); the process "
+        "pool is sized from the global value, sessions cap their own "
+        "queries with SET serene_workers; 1 disables parallel scheduling "
+        "(the same morsel plan runs inline — results are identical)",
+        validator=lambda v: max(1, int(v)))
+declare("serene_morsel_rows", 1 << 19, int,
+        "rows per morsel for parallel host pipelines; the split is "
+        "fixed-size and independent of worker count so partial-merge "
+        "order (and thus every result bit) never depends on scheduling; "
+        "large morsels amortize python dispatch overhead per task",
+        validator=lambda v: max(1024, int(v)))
+declare("serene_parallel_min_rows", 1 << 16, int,
+        "below this input row count host pipelines stay single-threaded "
+        "(morsel setup costs more than it buys)")

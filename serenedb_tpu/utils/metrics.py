@@ -69,3 +69,11 @@ CLEANUP_ACTIVE = REGISTRY.gauge("CleanupActive", "running cleanup tasks")
 DEVICE_OFFLOADS = REGISTRY.gauge("DeviceOffloads", "batches dispatched to TPU")
 DEVICE_BYTES = REGISTRY.gauge("DeviceBytesMoved", "bytes copied host->device")
 WAL_COMMITS = REGISTRY.gauge("WalCommits", "search WAL commit records written")
+POOL_MORSELS = REGISTRY.gauge("PoolMorselsExecuted",
+                              "morsel tasks executed by the worker pool")
+POOL_QUEUE_WAIT_US = REGISTRY.gauge("PoolQueueWaitUs",
+                                    "cumulative µs tasks waited queued")
+POOL_BUSY_US = REGISTRY.gauge("PoolBusyUs",
+                              "cumulative µs workers spent running tasks")
+POOL_STEALS = REGISTRY.gauge("PoolSteals",
+                             "tasks stolen from a sibling worker's deque")

@@ -1,0 +1,284 @@
+"""Parity suite for the morsel-driven parallel execution layer.
+
+Contract under test (ISSUE 1): `serene_workers = 1` and `= N` must
+produce IDENTICAL results — aggregates bit-for-bit, top-k including
+tie order, ingest row-for-row — because the morsel split and merge
+order are pure functions of the data, never of scheduling. Plus pool
+behavior: ordered results, lowest-index error, cancellation draining
+without poisoning the shared pool.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.errors import SqlError
+from serenedb_tpu.exec.tables import MemTable
+
+
+def _mk_conn(n=60_000, seed=5):
+    rng = np.random.default_rng(seed)
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE t (k INT, g TEXT, v BIGINT, f DOUBLE, nv INT)")
+    validity = rng.random(n) > 0.15
+    nv = rng.integers(0, 7, n).astype(np.int32)
+    batch = Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 40, n).astype(np.int32)),
+        "g": Column.from_numpy(
+            rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+        "v": Column.from_numpy(
+            rng.integers(-(10 ** 6), 10 ** 6, n, dtype=np.int64)),
+        "f": Column.from_numpy(rng.normal(size=n)),
+        "nv": Column(dt.INT, nv, validity),
+    })
+    db.schemas["main"].tables["t"] = MemTable("t", batch)
+    c.execute("SET serene_device = 'cpu'")
+    # engage the morsel path at test-sized data
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_morsel_rows = 4096")
+    return c
+
+
+AGG_QUERIES = [
+    "SELECT count(*) FROM t",
+    "SELECT count(*), sum(v), min(v), max(v), avg(v) FROM t",
+    "SELECT sum(f), min(f), max(f), avg(f), stddev(f) FROM t",
+    "SELECT count(nv), sum(nv), avg(nv) FROM t",          # NULLs in agg arg
+    "SELECT k, count(*), sum(v) FROM t GROUP BY k ORDER BY k",
+    "SELECT g, min(g), max(g), count(*) FROM t GROUP BY g ORDER BY g",
+    "SELECT nv, count(*), sum(v) FROM t GROUP BY nv ORDER BY nv NULLS LAST",
+    ("SELECT k, g, sum(v) FILTER (WHERE f > 0), avg(f), bool_and(v > -999999)"
+     " FROM t GROUP BY k, g ORDER BY k, g"),
+    ("SELECT k, count(*), stddev_pop(f), var_samp(f) FROM t "
+     "WHERE v % 3 <> 0 GROUP BY k ORDER BY k"),
+    # expression keys defeat the direct coding → factorize merge path
+    "SELECT k % 7, count(*), sum(v) FROM t GROUP BY k % 7 ORDER BY k % 7",
+]
+
+
+@pytest.mark.parametrize("q", AGG_QUERIES)
+def test_aggregate_parity_workers_1_vs_n(q):
+    c = _mk_conn()
+    c.execute("SET serene_workers = 4")
+    par = c.execute(q).rows()
+    c.execute("SET serene_workers = 1")
+    one = c.execute(q).rows()
+    assert par == one  # bit-identical, including float bits and order
+
+
+def test_parallel_path_actually_engages():
+    from serenedb_tpu.parallel.pool import get_pool
+    from serenedb_tpu.utils import metrics
+    if get_pool().size < 2:
+        pytest.skip("shared pool has a single worker on this host")
+    c = _mk_conn()
+    c.execute("SET serene_workers = 4")
+    before = metrics.POOL_MORSELS.value
+    c.execute("SELECT k, sum(v) FROM t GROUP BY k")
+    assert metrics.POOL_MORSELS.value > before
+
+
+def test_aggregate_matches_serial_oracle(monkeypatch):
+    """The morsel path must agree with the serial CPU oracle on exact
+    (integer / selection) results."""
+    from serenedb_tpu.exec import morsel
+    c = _mk_conn()
+    q = ("SELECT k, g, count(*), sum(v), min(v), max(v), min(g), max(g) "
+         "FROM t GROUP BY k, g ORDER BY k, g")
+    c.execute("SET serene_workers = 4")
+    par = c.execute(q).rows()
+    monkeypatch.setattr(morsel, "try_parallel_aggregate",
+                        lambda node, ctx: None)
+    ser = c.execute(q).rows()
+    assert par == ser
+
+
+# -- top-k over parallel segment collectors ---------------------------------
+
+
+def _mk_multi(texts_per_seg):
+    from serenedb_tpu.search.analysis import get_analyzer
+    from serenedb_tpu.search.index import build_field_index
+    from serenedb_tpu.search.searcher import MultiSearcher, SegmentSearcher
+    an = get_analyzer("text")
+    ms = MultiSearcher(an)
+    base = 0
+    for texts in texts_per_seg:
+        fi = build_field_index(texts, an)
+        ms.add_segment(SegmentSearcher(fi, an, len(texts)), base)
+        base += len(texts)
+    return ms
+
+
+def _set_global_workers(n):
+    from serenedb_tpu.utils.config import REGISTRY
+    old = REGISTRY.get_global("serene_workers")
+    REGISTRY.set_global("serene_workers", n)
+    return old
+
+
+def test_topk_parity_with_ties_across_segments():
+    """Identical documents in different segments score identically; the
+    merged ranking must break those ties by ascending global doc id, at
+    any worker count."""
+    from serenedb_tpu.search.query import parse_query
+    seg_texts = [
+        ["quick brown fox", "lazy dog sleeps", "quick fox again"],
+        ["quick brown fox", "dog and fox play", "nothing here"],
+        ["quick brown fox", "brown bear", "fox fox fox den"],
+    ]
+    ms = _mk_multi(seg_texts)
+    node = parse_query("quick fox")
+    old = _set_global_workers(4)
+    try:
+        s4, d4 = ms.topk(node, 6)
+        _set_global_workers(1)
+        s1, d1 = ms.topk(node, 6)
+    finally:
+        _set_global_workers(old)
+    np.testing.assert_array_equal(d4, d1)
+    np.testing.assert_array_equal(s4, s1)
+    # the three identical "quick brown fox" docs (rows 0, 3, 6) tie —
+    # they must appear in ascending doc-id order
+    tie_pos = [list(d4).index(i) for i in (0, 3, 6)]
+    assert tie_pos == sorted(tie_pos)
+    for a, b in zip(tie_pos, tie_pos[1:]):
+        assert s4[a] == s4[b]
+
+
+def test_cpu_topk_parallel_matches_single_heap():
+    from serenedb_tpu.search.query import parse_query
+    rng = np.random.default_rng(9)
+    vocab = [f"w{i}" for i in range(50)]
+    seg_texts = [[" ".join(rng.choice(vocab, 12)) for _ in range(200)]
+                 for _ in range(4)]
+    ms = _mk_multi(seg_texts)
+    node = parse_query("w1 w2 w3")
+    old = _set_global_workers(4)
+    try:
+        s4, d4 = ms.cpu_topk(node, 10)
+        _set_global_workers(1)
+        s1, d1 = ms.cpu_topk(node, 10)
+    finally:
+        _set_global_workers(old)
+    np.testing.assert_array_equal(d4, d1)
+    np.testing.assert_array_equal(s4, s1)
+    # cpu path and device-route path agree on the ranked doc set
+    sd, dd = ms.topk(node, 10)
+    np.testing.assert_allclose(s1, sd, rtol=2e-3, atol=1e-3)
+
+
+# -- ingest ------------------------------------------------------------------
+
+
+def test_copy_ingest_parity(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 40_000   # > 2 parse chunks of 16384
+    path = tmp_path / "in.csv"
+    with open(path, "w") as f:
+        for i in range(n):
+            s = "" if i % 97 == 0 else f"name{int(rng.integers(0, 500))}"
+            f.write(f"{i},{s},{float(rng.normal()):.6f}\n")
+
+    def ingest(workers):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE imp (i INT, s TEXT, x DOUBLE)")
+        c.execute(f"SET serene_workers = {workers}")
+        res = c.execute(f"COPY imp FROM '{path}' WITH (format csv)")
+        rows = c.execute("SELECT * FROM imp").rows()
+        return res.command_tag, rows
+
+    tag4, rows4 = ingest(4)
+    tag1, rows1 = ingest(1)
+    assert tag4 == tag1 == f"COPY {n}"
+    assert rows4 == rows1
+    assert len(rows4) == n
+
+
+# -- cancellation / pool hygiene --------------------------------------------
+
+
+def test_cancel_drains_morsels_without_poisoning_pool():
+    rng = np.random.default_rng(1)
+    n = 1_500_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE big (k INT, v BIGINT, f DOUBLE)")
+    batch = Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 100, n).astype(np.int32)),
+        "v": Column.from_numpy(rng.integers(0, 10 ** 6, n, dtype=np.int64)),
+        "f": Column.from_numpy(rng.normal(size=n)),
+    })
+    db.schemas["main"].tables["big"] = MemTable("big", batch)
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_workers = 4")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_morsel_rows = 2048")   # ~700 morsels to drain
+    q = ("SELECT k, sum(v), avg(f), stddev(f) FROM big "
+         "WHERE v % 7 <> 0 AND f * f < 9 GROUP BY k")
+    timer = threading.Timer(0.05, c.request_cancel)
+    timer.start()
+    try:
+        c.execute(q)
+        cancelled = False   # machine fast enough to finish: still valid
+    except SqlError as e:
+        assert e.sqlstate == "57014"
+        cancelled = True
+    timer.cancel()
+    # the pool must be fully drained — no orphan morsels left queued
+    from serenedb_tpu.parallel.pool import get_pool
+    pool = get_pool()
+    deadline = time.monotonic() + 5.0
+    while any(dq for dq in pool._deques) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not any(dq for dq in pool._deques)
+    # and the NEXT parallel query on the same pool runs clean
+    c.execute("SET serene_morsel_rows = 65536")
+    out = c.execute("SELECT count(*), sum(v) FROM big").rows()
+    c.execute("SET serene_workers = 1")
+    assert c.execute("SELECT count(*), sum(v) FROM big").rows() == out
+    assert cancelled or out[0][0] == n
+
+
+# -- pool unit behavior ------------------------------------------------------
+
+
+def test_map_ordered_preserves_order_and_raises_lowest_index():
+    from serenedb_tpu.parallel.pool import WorkerPool
+    pool = WorkerPool(4).ensure_started()
+    try:
+        out = pool.map_ordered(lambda x: x * x, list(range(100)))
+        assert out == [x * x for x in range(100)]
+
+        def boom(x):
+            if x in (7, 13):
+                raise ValueError(f"bad {x}")
+            time.sleep(0.001)
+            return x
+
+        with pytest.raises(ValueError, match="bad 7"):
+            pool.map_ordered(boom, list(range(50)))
+        # pool still serviceable after the failure drained
+        assert pool.map_ordered(lambda x: x + 1, [1, 2, 3]) == [2, 3, 4]
+    finally:
+        pool.shutdown()
+
+
+def test_nested_map_runs_inline_no_deadlock():
+    from serenedb_tpu.parallel.pool import WorkerPool
+    pool = WorkerPool(2).ensure_started()
+    try:
+        def outer(x):
+            # nested fan-out from a worker thread must run inline
+            return sum(pool.map_ordered(lambda y: y * 2, [x, x + 1]))
+
+        assert pool.map_ordered(outer, [1, 2, 3, 4]) == [6, 10, 14, 18]
+    finally:
+        pool.shutdown()
