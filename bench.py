@@ -545,6 +545,69 @@ def bench_host_agg() -> float:
     return curve[str(workers[0])] / curve[str(workers[-1])]
 
 
+def bench_filter_scan() -> float:
+    """Zone-map skip-scan (ISSUE 2 tentpole): one selective-filter
+    aggregate over a position-clustered column at selectivities 100%,
+    10%, 1%, 0.1% with `serene_zonemap` on vs off. Returns the off/on
+    speedup at 1% selectivity; extras carry the full
+    selectivity→seconds curve for both settings. Results must be
+    bit-identical on/off (asserted), and 100% selectivity must not
+    regress (all-match blocks skip predicate evaluation, so the on path
+    is never slower than off)."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+
+    rng = np.random.default_rng(17)
+    n = 6_000_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE fs (ts BIGINT, v BIGINT, f DOUBLE)")
+    batch = Batch.from_pydict({
+        # clustered scan axis (ingest order / time): the realistic shape
+        # zone maps exist for
+        "ts": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "v": Column.from_numpy(
+            rng.integers(-(10 ** 6), 10 ** 6, n, dtype=np.int64)),
+        "f": Column.from_numpy(rng.normal(size=n)),
+    })
+    db.schemas["main"].tables["fs"] = MemTable("fs", batch)
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_morsel_rows = 65536")   # ~92 prunable blocks
+    selectivities = [1.0, 0.1, 0.01, 0.001]
+    curve: dict[str, dict[str, float]] = {}
+    reps = 3
+    for sel in selectivities:
+        cut = int(n * sel)
+        q = (f"SELECT count(*), sum(v), max(f) FROM fs "
+             f"WHERE ts < {cut}")
+        entry: dict[str, float] = {}
+        rows = {}
+        for zm in ("on", "off"):
+            c.execute(f"SET serene_zonemap = {zm}")
+            rows[zm] = repr(c.execute(q).rows())    # warm + correctness
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                c.execute(q)
+            entry[zm] = round((time.perf_counter() - t0) / reps, 5)
+        assert rows["on"] == rows["off"], f"zonemap diverged at sel={sel}"
+        curve[str(sel)] = entry
+    _EXTRA["rows"] = n
+    _EXTRA["curve_s"] = curve
+    speedup_1pct = curve["0.01"]["off"] / curve["0.01"]["on"]
+    _EXTRA["speedup_0.1pct"] = round(
+        curve["0.001"]["off"] / curve["0.001"]["on"], 2)
+    _EXTRA["full_scan_ratio"] = round(
+        curve["1.0"]["on"] / curve["1.0"]["off"], 3)
+    assert speedup_1pct >= 3.0, \
+        f"zone maps under-deliver: {speedup_1pct:.2f}x at 1% selectivity"
+    assert curve["1.0"]["on"] <= curve["1.0"]["off"] * 1.25, \
+        "zone maps regress the 100%-selectivity scan"
+    return speedup_1pct
+
+
 SHAPES = {
     "q1": bench_q1,
     "hits": bench_hits,
@@ -553,6 +616,7 @@ SHAPES = {
     "bm25_8m": bench_bm25_8m,
     "ingest": bench_ingest,
     "host_agg": bench_host_agg,
+    "filter_scan": bench_filter_scan,
 }
 
 #: shapes whose ratio is a device-vs-CPU speedup and enters the headline
@@ -562,7 +626,7 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 
 #: shapes that never touch the device — they run even when the liveness
 #: probe fails (a dead tunnel must not blind the round on host numbers)
-HOST_SHAPES = ("ingest", "host_agg")
+HOST_SHAPES = ("ingest", "host_agg", "filter_scan")
 
 
 # ------------------------------------------------------------- harness

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Tier-1 verification (ROADMAP.md) plus one structural zone-map pass.
+#
+# Pass 1 is the canonical tier-1 suite. Pass 2 re-runs the zone-map and
+# morsel parity suites with SERENE_ZONEMAP_VERIFY=1 (tests/conftest.py
+# arms the serene_zonemap_verify global): every morsel the zone maps
+# prune is re-scanned with the real predicate, so block-statistics/data
+# divergence fails the run loudly instead of hiding behind whatever
+# queries happened to sample the stale blocks.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+
+echo "== zone-map structural verification pass (serene_zonemap_verify=on) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_ZONEMAP_VERIFY=1 \
+    python -m pytest tests/test_zonemap.py tests/test_parallel_exec.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc2=$?
+
+[ "$rc" -ne 0 ] && exit "$rc"
+exit "$rc2"

@@ -1499,9 +1499,12 @@ class Connection:
                     raise errors.SqlError(
                         "0A000", "cannot drop the only column of a table")
                 keep = [i for i, n in enumerate(names) if n != st.column]
+                # NOT rows_preserved: dropping a column changes column
+                # identity — caches keyed per column name under an
+                # unchanged epoch (zone maps) must not survive a later
+                # re-add of the same name with different values
                 table.replace(Batch([names[i] for i in keep],
-                                    [full.columns[i] for i in keep]),
-                              rows_preserved=True)
+                                    [full.columns[i] for i in keep]))
             elif st.action == "rename_column":
                 if st.column not in names:
                     raise errors.SqlError(
@@ -1512,8 +1515,9 @@ class Connection:
                         "42701", f'column "{st.new_name}" already exists')
                 new_names = [st.new_name if n == st.column else n
                              for n in names]
-                table.replace(Batch(new_names, list(full.columns)),
-                              rows_preserved=True)
+                # NOT rows_preserved: renaming moves values under a new
+                # name — per-column-name caches (zone maps) must rebuild
+                table.replace(Batch(new_names, list(full.columns)))
             elif st.action == "rename_table":
                 schema, name = self.db._split(st.table)
                 with self.db.lock:   # catalog-dict mutation

@@ -182,6 +182,18 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         if max(group_space, 1) * distinct_plans[si][3] > MAX_DISTINCT_CELLS:
             raise NotCompilable("DISTINCT presence matrix too large")
 
+    # zone maps: when the filter conjuncts prove a prefix/suffix of
+    # morsel blocks can't match, upload (and aggregate) only the
+    # surviving contiguous row range — the skip-scan analog of the
+    # chunked dispatch, applied to the transfer itself. The factorized
+    # code buffer is whole-table, so the shrink only engages on directly
+    # coded keys.
+    nrows = pin_batch.num_rows if pin_batch is not None \
+        else provider.row_count()
+    zrange = None
+    if preds and fact is None:
+        zrange = _zonemap_range(scan, provider, preds, pin, nrows, ctx)
+
     # collect needed device columns
     needed: set[int] = set()
     for ce in compiled_preds:
@@ -192,7 +204,12 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         if ce is not None:
             needed.update(ce.inputs)
     needed = sorted(needed)
-    by_name = provider.device_columns([col_names[i] for i in needed], pin)
+    if zrange is None:
+        by_name = provider.device_columns([col_names[i] for i in needed],
+                                          pin)
+    else:
+        by_name = _range_device_columns(
+            provider, [col_names[i] for i in needed], pin, zrange)
     env_cols = {i: by_name[col_names[i]] for i in needed}
     metrics.DEVICE_OFFLOADS.add()
 
@@ -266,11 +283,15 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     mesh_n = int(ctx.settings.get("serene_mesh") or 0)
     if mesh_n > 1 and len(jax.devices()) < mesh_n:
         mesh_n = 0
+    # zrange is part of the key: the frame-of-reference scheme/offset of a
+    # sliced upload differs from the whole column's, and the range itself
+    # flips with SET serene_zonemap — a cached program must never decode
+    # an environment built under the other setting
     key = (id(provider), dev_ver,
            tuple(_expr_key(p) for p in preds),
            tuple(_expr_key(g) for g in node.group_exprs),
            tuple((s.func, s.distinct, _expr_key(s.arg))
-                 for s in node.aggs), mesh_n)
+                 for s in node.aggs), mesh_n, zrange)
     from .device import _PROGRAM_CACHE
     jitted = _PROGRAM_CACHE.get(key)
     if jitted is None:
@@ -295,16 +316,15 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
     # wrong as a row mask for count(*). Use a pure row-validity mask built
     # from the logical length of the SAME publication as the columns
     # (cached per version on the provider).
-    nrows = pin_batch.num_rows if pin_batch is not None \
-        else provider.row_count()
-    prows = pad_len(nrows)
+    mask_rows = nrows if zrange is None else zrange[1] - zrange[0]
+    prows = pad_len(mask_rows)
     rm_entry = getattr(provider, "_device_rowmask", None)
-    if rm_entry is None or rm_entry[0] != dev_ver or \
+    if rm_entry is None or rm_entry[0] != (dev_ver, zrange) or \
             rm_entry[1].shape != (prows // 128, 128):
         rm = np.zeros(prows, dtype=bool)
-        rm[:nrows] = True
+        rm[:mask_rows] = True
         rowmask_arr = jnp.asarray(rm.reshape(-1, 128))
-        provider._device_rowmask = (dev_ver, rowmask_arr)
+        provider._device_rowmask = ((dev_ver, zrange), rowmask_arr)
     else:
         rowmask_arr = rm_entry[1]
     if mesh_n > 1:
@@ -332,6 +352,82 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
                                   provider, col_names, dictionaries,
                                   group_space, fact, distinct_plans)
     return _build_scalar_batch(node, agg_plans, results, distinct_plans)
+
+
+def _zonemap_range(scan, provider, preds, pin, nrows,
+                   ctx) -> Optional[tuple[int, int]]:
+    """Contiguous surviving row range [lo, hi) under the filter
+    conjuncts' zone-map verdicts, or None when nothing prunes. Raises
+    NotCompilable when EVERY block is pruned — the morsel path then
+    resolves the query from the same verdicts without touching data.
+    lo is block-aligned and therefore a multiple of the 128-lane tile."""
+    from . import zonemap
+    block_rows = int(ctx.settings.get("serene_morsel_rows"))
+    verdicts = zonemap.block_verdicts(provider, ctx.settings, preds,
+                                      scan.columns, block_rows, pin)
+    if verdicts is None:
+        return None
+    lo, hi = zonemap.surviving_range(verdicts, block_rows, nrows)
+    if hi <= lo:
+        # don't touch the counters here: the host morsel path resolves
+        # the query from the same verdict vector and does the counting
+        raise NotCompilable("zone maps pruned every block")
+    if (lo, hi) == (0, nrows):
+        return None
+    # only the envelope shrink is real pruning on the device path —
+    # interior SKIP blocks inside [lo, hi) still upload and scan
+    n_blocks = len(verdicts)
+    lo_b, hi_b = lo // block_rows, (hi + block_rows - 1) // block_rows
+    metrics.ZONEMAP_PRUNED.add(n_blocks - (hi_b - lo_b))
+    metrics.ZONEMAP_SCANNED.add(hi_b - lo_b)
+    if zonemap.verify_enabled(ctx.settings):
+        full = pin[0] if pin is not None else \
+            provider.full_batch(scan.columns)
+        from ..columnar.column import Batch as _B
+        full = _B(list(scan.columns),
+                  [full.column(c) for c in scan.columns])
+        spans = [(s, e) for s, e in ((0, lo), (hi, nrows)) if e > s]
+        zonemap.verify_pruned_blocks(preds, full, spans,
+                                     f"device aggregate {provider.name}")
+    return lo, hi
+
+
+def _range_device_columns(provider, names, pin, zrange) -> dict:
+    """{name: DeviceColumn} for a row subrange, one publication
+    observation (mirrors TableProvider.device_columns). Cached per
+    (version, range) with one entry per column — repeated queries with
+    the same shape reuse the upload, a different range rebuilds."""
+    from . import zonemap as _zm
+    from ..columnar.device import to_device_column
+    lo, hi = zrange
+    lock = _zm._zone_lock(provider)
+    if pin is not None:
+        batch, ver = pin[0], pin[1]
+    else:
+        batch, ver = None, provider.data_version
+    with lock:
+        cache = getattr(provider, "_zonemap_devcache", None)
+        if cache is None:
+            cache = provider._zonemap_devcache = {}
+        hits = {n: e[1] for n in names
+                if (e := cache.get(n)) is not None and e[0] == (ver, lo, hi)}
+    out = dict(hits)
+    # uploads run OUTSIDE the lock: a multi-hundred-MB host→device copy
+    # must not serialize every other query's zone-stats access on this
+    # provider (a racing duplicate upload is wasted work, never wrong —
+    # entries are (version, range)-stamped either way)
+    for name in names:
+        if name in out:
+            continue
+        col = (batch.column(name) if batch is not None
+               else provider.full_batch([name]).column(name))
+        dc = to_device_column(col.slice(lo, hi))
+        metrics.DEVICE_BYTES.add(
+            int(dc.data.size * dc.data.dtype.itemsize))
+        with lock:
+            cache[name] = ((ver, lo, hi), dc)
+        out[name] = dc
+    return out
 
 
 def _presence_scatter(dplan, arrays, gcodes, mask, group_space):

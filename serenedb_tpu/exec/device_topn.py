@@ -122,10 +122,29 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
     if mesh_n > 1 and len(jax.devices()) < mesh_n:
         mesh_n = 0
 
+    # zone-map skip-scan: block bounds alone can prove a prefix/suffix
+    # of blocks holds no top-k candidate (cover k rows with the best
+    # blocks' worst values, prune blocks strictly beyond that
+    # threshold); only the surviving contiguous range uploads. Pruned
+    # rows are strictly outside the top-k, so result AND tie order are
+    # untouched — indices just shift by the range start.
+    from . import zonemap
+    block_rows = int(ctx.settings.get("serene_morsel_rows"))
+    zrange = zonemap.topn_block_range(provider, ctx.settings, col_name,
+                                      block_rows, desc, k, pin)
+
     from .device import _PROGRAM_CACHE
-    cache_key = ("topn", id(provider), dev_ver, col_name, desc, k, mesh_n)
+    # the range keys the program: a sliced upload's frame-of-reference
+    # scheme can differ from the whole column's
+    cache_key = ("topn", id(provider), dev_ver, col_name, desc, k, mesh_n,
+                 zrange)
     jitted = _PROGRAM_CACHE.get(cache_key)
-    dc = provider.device_columns([col_name], pin)[col_name]
+    if zrange is None:
+        dc = provider.device_columns([col_name], pin)[col_name]
+    else:
+        from .device_agg import _range_device_columns
+        dc = _range_device_columns(provider, [col_name], pin,
+                                   zrange)[col_name]
     is_float = dc.data.dtype.kind == "f"
 
     if jitted is None:
@@ -193,6 +212,8 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
         kkw, ii = kkw[valid], ii[valid]
         order = np.argsort(-kkw, kind="stable")[: k]
         ii = ii[order]
+    if zrange is not None:
+        ii = ii + zrange[0]     # slice-relative → table row ids
     metrics.DEVICE_OFFLOADS.add()
     k_eff = min(k, n)
     return ii[:k_eff]

@@ -34,7 +34,7 @@ from ..columnar import dtypes as dt
 from ..columnar.column import Batch, Column, concat_batches
 from ..ops.agg import factorize_keys
 from ..parallel.pool import parallel_map
-from ..sql.expr import AggSpec
+from ..sql.expr import AggSpec, BoundColumn
 
 #: aggregate functions with an exact partial/combine decomposition
 _PARALLEL_FUNCS = {
@@ -103,20 +103,68 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
             nrows <= morsel_rows:
         return None
     # ONE publication observation for the whole pipeline (same rule as the
-    # device path): every morsel slices the same batch reference.
-    full = provider.full_batch(scan.columns)
-    nrows = full.num_rows
+    # device path): every morsel slices the same batch reference, and the
+    # zone-map verdicts are built from the same pin so a racing publish
+    # can never pair fresh data with stale block stats.
+    from . import zonemap
+    pin = provider.try_pin()
+    if pin is not None:
+        nrows = pin[0].num_rows
+
+    # scan-schema-bound predicates: the pushed-down scan filter plus every
+    # FilterNode ahead of the first projection (after a Project, column
+    # indices refer to the projected batch, not the scan)
+    first_proj = next((i for i, st in enumerate(stages)
+                       if isinstance(st, ProjectNode)), len(stages))
+    scan_preds = ([scan.filter] if scan.filter is not None else []) + \
+        [st.pred for st in stages[:first_proj]]
+    leading = frozenset(id(st) for st in stages[:first_proj])
+
+    verdicts = zonemap.block_verdicts(provider, settings, scan_preds,
+                                      scan.columns, morsel_rows, pin)
     spans = [(s, min(s + morsel_rows, nrows))
              for s in range(0, nrows, morsel_rows)]
+    verify = verdicts is not None and zonemap.verify_enabled(settings)
+    if verdicts is not None:
+        zonemap.count_pruned(verdicts)
+        keep = [(sp, int(v)) for sp, v in zip(spans, verdicts)
+                if v != zonemap.SKIP]
+    else:
+        keep = [(sp, zonemap.SCAN) for sp in spans]
 
-    def run_morsel(span):
+    # late materialization: only columns the scan-bound expressions
+    # actually read are fetched before morsels run; the rest never
+    # materialize (pinned providers hand out column references for free,
+    # so the pin batch is used whole there)
+    full = None
+    if keep or verify:
+        full = _scan_batch(provider, scan, stages, node, first_proj,
+                           scan_preds, pin)
+    if verify:
+        pruned = [sp for sp, v in zip(spans, verdicts)
+                  if v == zonemap.SKIP]
+        zonemap.verify_pruned_blocks(scan_preds, full, pruned,
+                                     "morsel aggregate")
+    if not keep:
+        # every block pruned: one empty morsel keeps the merge shape
+        # (zero groups / NULL scalar aggregates) without touching data
+        from .plan import empty_batch
+        empty = empty_batch(list(scan.columns), list(scan.types))
+        keep = [((0, 0), zonemap.SCAN)]
+        full = empty
+
+    def run_morsel(item):
+        span, verdict = item
         check_cancel()
         b = full.slice(span[0], span[1])
-        if scan.filter is not None:
+        all_match = verdict == zonemap.ALL
+        if scan.filter is not None and not all_match:
             c = scan.filter.eval(b)
             b = b.filter(c.data.astype(bool) & c.valid_mask())
         for st in stages:
             if isinstance(st, FilterNode):
+                if all_match and id(st) in leading:
+                    continue     # zone maps proved every row matches
                 c = st.pred.eval(b)
                 b = b.filter(c.data.astype(bool) & c.valid_mask())
             else:
@@ -124,10 +172,55 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
         return _morsel_partials(node, b)
 
     try:
-        partials = parallel_map(settings, run_morsel, spans)
+        partials = parallel_map(settings, run_morsel, keep)
         return _merge_partials(node, partials)
     except _Fallback:
         return None
+
+
+def _scan_batch(provider, scan, stages, node, first_proj: int,
+                scan_preds: list, pin) -> Batch:
+    """The pipeline's input batch under one publication observation.
+    Pinned (mutable) providers hand back their published batch — column
+    references, zero cost. Pin-less providers (parquet) decode columns
+    lazily, so only the columns the scan-bound expressions actually
+    reference are fetched; unreferenced positions get zero-byte
+    broadcast placeholders that keep Batch geometry without
+    materializing (they are provably never evaluated)."""
+    names = scan.columns
+    if pin is not None:
+        batch = pin[0]
+        if all(c in batch for c in names):
+            return Batch(list(names), [batch.column(c) for c in names])
+        return provider.full_batch(names)     # surface the proper error
+    scan_bound = list(scan_preds)
+    if first_proj < len(stages):
+        scan_bound += list(stages[first_proj].exprs)
+    else:
+        scan_bound += list(node.group_exprs)
+        scan_bound += [e for s in node.aggs
+                       for e in (s.arg, s.filter) if e is not None]
+    referenced: set[int] = set()
+    for e in scan_bound:
+        for sub in e.walk():
+            if isinstance(sub, BoundColumn):
+                referenced.add(sub.index)
+    if len(referenced) >= len(names):
+        return provider.full_batch(names)
+    need = [names[i] for i in sorted(referenced)]
+    fetched = provider.full_batch(need) if need else None
+    n = fetched.num_rows if fetched is not None else provider.row_count()
+    cols = []
+    for i, c in enumerate(names):
+        if i in referenced:
+            cols.append(fetched.column(c))
+        else:
+            t = scan.types[i]
+            cols.append(Column(
+                t, np.broadcast_to(np.zeros(1, dtype=t.np_dtype), (n,)),
+                None,
+                np.asarray([""], dtype=object) if t.is_string else None))
+    return Batch(list(names), cols)
 
 
 # -- per-morsel partial states ----------------------------------------------

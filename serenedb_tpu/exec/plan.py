@@ -85,6 +85,11 @@ class ScanNode(PlanNode):
         self.types = [provider.type_of(c) for c in columns]
 
     def batches(self, ctx: ExecContext) -> Iterator[Batch]:
+        if self.filter is not None:
+            pruned = self._pruned_batches(ctx)
+            if pruned is not None:
+                yield from pruned
+                return
         for b in self.provider.batches(self.columns):
             check_cancel()
             if self.filter is not None:
@@ -92,6 +97,48 @@ class ScanNode(PlanNode):
                 mask = mask_col.data.astype(bool) & mask_col.valid_mask()
                 b = b.filter(mask)
             yield b
+
+    def _pruned_batches(self, ctx: ExecContext):
+        """Zone-map skip-scan for a filtered serial scan: blocks whose
+        stats prove no row matches are never sliced, blocks that provably
+        match whole skip predicate evaluation. None → plain scan."""
+        from . import zonemap
+        pin = self.provider.try_pin()
+        block_rows = int(ctx.settings.get("serene_morsel_rows"))
+        verdicts = zonemap.block_verdicts(
+            self.provider, ctx.settings, [self.filter], self.columns,
+            block_rows, pin)
+        if verdicts is None:
+            return None
+        zonemap.count_pruned(verdicts)
+        if pin is not None and all(c in pin[0] for c in self.columns):
+            full = Batch(list(self.columns),
+                         [pin[0].column(c) for c in self.columns])
+        else:
+            full = self.provider.full_batch(self.columns)
+        nrows = full.num_rows
+
+        def gen():
+            if zonemap.verify_enabled(ctx.settings):
+                spans = [(b * block_rows, min((b + 1) * block_rows, nrows))
+                         for b in np.flatnonzero(verdicts == zonemap.SKIP)]
+                zonemap.verify_pruned_blocks([self.filter], full, spans,
+                                             f"scan {self.provider.name}")
+            emitted = False
+            for b, v in enumerate(verdicts):
+                check_cancel()
+                if v == zonemap.SKIP:
+                    continue
+                sl = full.slice(b * block_rows,
+                                min((b + 1) * block_rows, nrows))
+                if v != zonemap.ALL:
+                    c = self.filter.eval(sl)
+                    sl = sl.filter(c.data.astype(bool) & c.valid_mask())
+                emitted = True
+                yield sl
+            if not emitted:
+                yield full.slice(0, 0)
+        return gen()
 
     def label(self) -> str:
         f = " filter=yes" if self.filter is not None else ""

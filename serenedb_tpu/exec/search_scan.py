@@ -89,7 +89,16 @@ class SearchScanNode(PlanNode):
         if searcher is None:
             raise RuntimeError("search index disappeared under the plan "
                                "(stale rewrite)")
-        full = self.provider.full_batch(self.columns)
+        # ONE publication observation: the batch being materialized and
+        # the zone-map verdicts pruning its candidate docs must come
+        # from the same pin, or a racing publish could prune docs whose
+        # values in the batch actually being scanned still match
+        pin = self.provider.try_pin()
+        if pin is not None and all(c in pin[0] for c in self.columns):
+            full = Batch(list(self.columns),
+                         [pin[0].column(c) for c in self.columns])
+        else:
+            full = self.provider.full_batch(self.columns)
         mesh_n = int(ctx.settings.get("serene_mesh") or 0)
         if self.topk is not None:
             scores, docs = searcher.topk(self.qnode, self.topk, self.scorer,
@@ -105,18 +114,71 @@ class SearchScanNode(PlanNode):
             yield out
             return
         docs = self._matching_docs(searcher)
+        # the score pass ranks ALL index matches (it knows nothing of
+        # the residual), so k must cover the PRE-prune candidate count —
+        # otherwise pruned high-score docs would occupy the k slots and
+        # surviving docs would read 0.0 off the score map
+        n_candidates = len(docs)
+        # zone maps on the column-filter side: candidate docs landing in
+        # blocks the residual provably can't match are dropped BEFORE
+        # materialization, and residual evaluation is skipped entirely
+        # when every surviving doc sits in an all-match block (stream
+        # mode only — top-k applies its residual after ranking)
+        docs, residual_decided = self._prune_docs_by_zones(ctx, full, docs,
+                                                           pin)
         out = full.take(docs.astype(np.int64))
         if self.with_score:
-            scores, sdocs = searcher.topk(self.qnode, max(len(docs), 1),
+            scores, sdocs = searcher.topk(self.qnode,
+                                          max(n_candidates, 1),
                                           self.scorer, mesh_n=mesh_n)
             smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
             smap[sdocs] = scores
             out = Batch(list(self.names),
                         out.columns + [Column(dt.FLOAT, smap[docs])])
-        if self.residual is not None:
+        if self.residual is not None and not residual_decided:
             c = self.residual.eval(out)
             out = out.filter(c.data.astype(bool) & c.valid_mask())
         yield out
+
+    def _prune_docs_by_zones(self, ctx, full: Batch, docs: np.ndarray,
+                             pin) -> tuple[np.ndarray, bool]:
+        """(surviving docs, residual_decided). residual_decided is True
+        when zone maps proved the residual holds for every survivor.
+        `pin` is the SAME publication observation `full` was built from."""
+        if self.residual is None or not len(docs):
+            return docs, False
+        from . import zonemap
+        block_rows = int(ctx.settings.get("serene_morsel_rows"))
+        verdicts = zonemap.block_verdicts(
+            self.provider, ctx.settings, [self.residual], self.columns,
+            block_rows, pin)
+        if verdicts is None:
+            return docs, False
+        bidx = docs // block_rows
+        # an index refreshed past the pinned publication can hold docs
+        # beyond the stats tail: treat those as must-scan
+        v = np.where(bidx < len(verdicts),
+                     verdicts[np.minimum(bidx, len(verdicts) - 1)],
+                     np.int8(zonemap.SCAN))
+        keep = v != zonemap.SKIP
+        if not keep.all():
+            from ..utils import metrics
+            scanned_blocks = np.unique(bidx[keep])
+            pruned_blocks = np.setdiff1d(np.unique(bidx[~keep]),
+                                         scanned_blocks)
+            metrics.ZONEMAP_PRUNED.add(len(pruned_blocks))
+            metrics.ZONEMAP_SCANNED.add(len(scanned_blocks))
+            if zonemap.verify_enabled(ctx.settings):
+                dropped = full.take(docs[~keep].astype(np.int64))
+                c = self.residual.eval(dropped)
+                if (c.data.astype(bool) & c.valid_mask()).any():
+                    raise AssertionError(
+                        "serene_zonemap_verify: zone map dropped a "
+                        f"matching candidate doc in search scan of "
+                        f"{self.provider.name}")
+            docs = docs[keep]
+            v = v[keep]
+        return docs, bool(len(v)) and bool((v == zonemap.ALL).all())
 
 
 class IvfScanNode(PlanNode):

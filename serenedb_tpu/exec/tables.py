@@ -112,6 +112,11 @@ class TableProvider:
             self._device_cache.clear()
             if hasattr(self, "_device_rowmask"):
                 del self._device_rowmask
+        # range-sliced uploads (zone-map prefix/suffix pruning) are
+        # version-stamped like the main cache, but drop them with it so
+        # stale HBM is released on mutation
+        if hasattr(self, "_zonemap_devcache"):
+            self._zonemap_devcache.clear()
 
     def type_of(self, name: str) -> dt.SqlType:
         return self.column_types[self.column_names.index(name)]
@@ -123,10 +128,13 @@ class MemTable(TableProvider):
 
     Two change counters steer index maintenance:
     - data_version: bumps on ANY change (freshness checks)
-    - mutation_epoch: bumps only when existing row identity/order changes
-      (delete/update/truncate). Pure appends keep the epoch, which lets
-      search indexes refresh incrementally with a new segment instead of a
-      full rebuild (the reference's segment model, SURVEY.md §2.7)."""
+    - mutation_epoch: bumps when existing row identity/order changes
+      (delete/update/truncate) or when COLUMN identity changes
+      (drop/rename — per-column-name caches like zone maps must not
+      survive values moving under an old name). Pure appends and
+      column ADDs keep the epoch, which lets search indexes refresh
+      incrementally with a new segment instead of a full rebuild (the
+      reference's segment model, SURVEY.md §2.7)."""
 
     def __init__(self, name: str, batch: Batch):
         self.name = name

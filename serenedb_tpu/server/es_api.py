@@ -383,8 +383,11 @@ class EsApi:
         if multi_claims is not None:
             # multi-field scoring, rank-first (Lucene BooleanQuery: doc
             # score = sum of its matching clauses' scores): one scored
-            # pass per claim builds the score map, every WHERE match is
-            # ranked globally, then only the page's _source is fetched.
+            # pass per claim builds the score map, then the page is
+            # assembled with BOUNDED fetches — scored candidates probe
+            # WHERE membership in rank-ordered chunks with early exit,
+            # and the zero-score tail pages through ORDER BY/LIMIT. No
+            # whole-table id fetch, whatever the index size.
             scores: dict[str, float] = {}
             for f, w, pred in multi_claims:
                 pass_sql = (f'SELECT "_id", bm25({_ident(f)}) '
@@ -392,13 +395,12 @@ class EsApi:
                 for did, sc in self.conn.execute(pass_sql).rows():
                     if sc:
                         scores[did] = scores.get(did, 0.0) + w * float(sc)
-            id_sql = f'SELECT "_id" FROM "{index}"'
+            total_sql = f'SELECT count(*) FROM "{index}"'
             if where:
-                id_sql += f" WHERE {where}"
-            all_ids = [r[0] for r in self.conn.execute(id_sql).rows()]
-            total = len(all_ids)
-            all_ids.sort(key=lambda d: (-scores.get(d, 0.0), d))
-            page = all_ids[from_:from_ + size]
+                total_sql += f" WHERE {where}"
+            total = int(self.conn.execute(total_sql).scalar())
+            page = self._multi_claim_page(index, where, scores,
+                                          from_ + size)[from_:from_ + size]
             rows = []
             if page:
                 lits = ", ".join(_sql_str(d) for d in page)
@@ -430,6 +432,53 @@ class EsApi:
                      "max_score": max_score if hits else None,
                      "hits": hits},
         }
+
+    def _multi_claim_page(self, index: str, where: str,
+                          scores: dict[str, float],
+                          needed: int) -> list[str]:
+        """First `needed` WHERE-matching ids in (-score, id) order,
+        fetched boundedly: positive-scored candidates are membership-
+        checked in rank-ordered chunks (early exit once the page is
+        covered), the zero-score middle pages via ORDER BY "_id" LIMIT,
+        and negative-scored candidates close the ranking."""
+        ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+        pos = [d for d, s in ranked if s > 0]
+        neg = [d for d, s in ranked if s < 0]
+
+        def matching(cands: list, stop_at) -> list:
+            out: list[str] = []
+            for i in range(0, len(cands), 500):
+                if stop_at is not None and len(out) >= stop_at:
+                    break
+                chunk = cands[i:i + 500]
+                cond = '"_id" IN (%s)' % ", ".join(
+                    _sql_str(d) for d in chunk)
+                if where:
+                    cond = f"({where}) AND {cond}"
+                hit = {r[0] for r in self.conn.execute(
+                    f'SELECT "_id" FROM "{index}" WHERE {cond}').rows()}
+                out.extend(d for d in chunk if d in hit)
+            return out
+
+        head = matching(pos, needed)
+        if len(head) >= needed:
+            return head[:needed]
+        # ids whose accumulated score is exactly 0.0 (zero boosts) rank
+        # with the unscored tail — they must stay IN the ORDER BY window
+        scored_set = {d for d, s in scores.items() if s != 0.0}
+        rest = needed - len(head)
+        mid_sql = f'SELECT "_id" FROM "{index}"'
+        if where:
+            mid_sql += f" WHERE {where}"
+        # over-fetch by the candidate count: every scored id that sneaks
+        # into the window gets filtered back out client-side
+        mid_sql += f' ORDER BY "_id" LIMIT {rest + len(scored_set)}'
+        mid = [r[0] for r in self.conn.execute(mid_sql).rows()
+               if r[0] not in scored_set][:rest]
+        seq = head + mid
+        if len(seq) < needed and neg:
+            seq += matching(neg, needed - len(seq))
+        return seq[:needed]
 
     def _search_knn(self, index: str, body: dict, size: int,
                     from_: int) -> dict:

@@ -750,6 +750,79 @@ def _fold_if_const(f: BoundFunc) -> BoundExpr:
     return f
 
 
+# -- interval extraction (zone-map predicate analysis) ----------------------
+#
+# exec/zonemap.py turns filter conjuncts into per-block verdicts; these
+# helpers own the expression-shape side of that: recognizing a
+# `column <cmp> constant` leaf and folding the constant side to a python
+# value with the binder's own evaluation semantics.
+
+#: comparison function names the interval analyzer understands, mapped to
+#: their mirror when the column sits on the RIGHT (5 < x  ≡  x > 5)
+_CMP_MIRROR = {"op=": "op=", "op<>": "op<>", "op!=": "op!=",
+               "op<": "op>", "op<=": "op>=", "op>": "op<", "op>=": "op<="}
+
+_CMP_CANON = {"op=": "=", "op<>": "<>", "op!=": "<>", "op<": "<",
+              "op<=": "<=", "op>": ">", "op>=": ">="}
+
+#: function names whose evaluation draws on shared mutable state or
+#: lazily-cached subplans — never safe to fold during analysis
+_UNFOLDABLE = _VOLATILE_FUNCS | {
+    "scalar_subquery", "array_subquery", "in_subquery", "exists",
+    "currval", "lastval", "nextval", "now", "statement_timestamp",
+    "current_timestamp", "transaction_timestamp",
+    # wall-clock reads without statement pinning: folding one at
+    # analysis time could disagree with the per-row evaluation (a scan
+    # crossing midnight must not prune blocks with the stale day)
+    "current_date", "age", "timeofday", "localtimestamp", "current_time"}
+
+_NOT_CONST = object()
+
+
+def fold_constant(e: BoundExpr):
+    """Evaluate a column-free, non-volatile expression to its python
+    value (None == SQL NULL). Returns the _NOT_CONST sentinel when the
+    expression references columns/aggregates or isn't safely foldable."""
+    if isinstance(e, BoundLiteral):
+        return e.value
+    for sub in e.walk():
+        if isinstance(sub, (BoundColumn, BoundAggRef)):
+            return _NOT_CONST
+        if isinstance(sub, BoundFunc) and sub.name in _UNFOLDABLE:
+            return _NOT_CONST
+    from ..columnar.column import Batch
+    try:
+        col = e.eval(Batch(["__one"], [Column.from_pylist([0])]))
+        if len(col.data) != 1:
+            return _NOT_CONST
+        return col.decode(0)
+    except Exception:
+        # fold errors (cast('x' as int), 1/0, ...) leave the leaf opaque
+        return _NOT_CONST
+
+
+def comparison_parts(e: BoundExpr):
+    """(column_index, canonical_op, constant) for a comparison leaf of
+    shape `column <cmp> constant` (either side), else None. The constant
+    is a decoded python value in the column's PHYSICAL value space (str
+    for VARCHAR, int days/micros for DATE/TIMESTAMP)."""
+    if not isinstance(e, BoundFunc) or e.name not in _CMP_MIRROR or \
+            len(e.args) != 2:
+        return None
+    a, b = e.args
+    if isinstance(a, BoundColumn):
+        v = fold_constant(b)
+        if v is _NOT_CONST:
+            return None
+        return (a.index, _CMP_CANON[e.name], v)
+    if isinstance(b, BoundColumn):
+        v = fold_constant(a)
+        if v is _NOT_CONST:
+            return None
+        return (b.index, _CMP_CANON[_CMP_MIRROR[e.name]], v)
+    return None
+
+
 def _agg_result_type(name: str, arg_t: dt.SqlType) -> dt.SqlType:
     if name == "count":
         return dt.BIGINT

@@ -162,3 +162,15 @@ declare("serene_morsel_rows", 1 << 19, int,
 declare("serene_parallel_min_rows", 1 << 16, int,
         "below this input row count host pipelines stay single-threaded "
         "(morsel setup costs more than it buys)")
+declare("serene_zonemap", True, bool,
+        "zone maps: per-morsel block min/max/null statistics consulted "
+        "before scanning — filter conjuncts that provably match no row "
+        "of a block skip it entirely, conjuncts that provably match "
+        "every row skip predicate evaluation, and the device paths "
+        "shrink uploads to the surviving block range; off scans "
+        "everything (results are identical either way)")
+declare("serene_zonemap_verify", False, bool,
+        "debug assert mode: re-scan every zone-map-pruned block with "
+        "the real predicate and fail the query loudly if any row "
+        "matched (catches block-statistics/data divergence "
+        "structurally; the tier-1 verify script arms this once)")

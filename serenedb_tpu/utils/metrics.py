@@ -77,3 +77,14 @@ POOL_BUSY_US = REGISTRY.gauge("PoolBusyUs",
                               "cumulative µs workers spent running tasks")
 POOL_STEALS = REGISTRY.gauge("PoolSteals",
                              "tasks stolen from a sibling worker's deque")
+ZONEMAP_PRUNED = REGISTRY.gauge(
+    "ZonemapMorselsPruned",
+    "scan/aggregate morsels skipped because block statistics proved no "
+    "row could match")
+ZONEMAP_SCANNED = REGISTRY.gauge(
+    "ZonemapMorselsScanned",
+    "morsels that passed zone-map analysis and were actually scanned")
+ZONEMAP_STALE_REBUILDS = REGISTRY.gauge(
+    "ZonemapStaleRebuilds",
+    "zone-map column stats rebuilt from scratch after a non-append "
+    "mutation invalidated the cached version")

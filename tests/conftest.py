@@ -26,6 +26,14 @@ assert len(jax.devices()) == 8, "virtual CPU mesh not active"
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# scripts/verify_tier1.sh arms the zone-map debug assert for one extra
+# parity pass: every pruned morsel is re-scanned and any block-stats/data
+# divergence fails the query loudly instead of sampling its way past.
+if os.environ.get("SERENE_ZONEMAP_VERIFY"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REGISTRY
+
+    _SDB_REGISTRY.set_global("serene_zonemap_verify", True)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,318 @@
+"""Zone-map subsystem parity and pruning suite (ISSUE 2).
+
+Contract under test: `serene_zonemap = on` and `= off` must be
+bit-identical at ANY worker count — pruning is an optimization layer,
+never a semantics layer — including over NULLs, NaNs, dictionary
+strings, and after UPDATE/DELETE/append invalidation. The debug assert
+mode (`serene_zonemap_verify`) re-scans every pruned morsel and must
+fail loudly when block statistics diverge from table data.
+"""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec import zonemap
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.utils import metrics
+
+
+def _mk_conn(n=120_000, seed=11, morsel_rows=4096):
+    """Mixed-type table: clustered ts (the pruning axis), random values,
+    NULLs in nv/f, NaNs in f, dictionary strings in g."""
+    rng = np.random.default_rng(seed)
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE z (ts BIGINT, v BIGINT, g TEXT, f DOUBLE, "
+              "nv INT, b BOOLEAN)")
+    f = rng.normal(size=n)
+    f[rng.random(n) < 0.02] = np.nan
+    fvalid = rng.random(n) > 0.1
+    nv = rng.integers(0, 9, n).astype(np.int32)
+    batch = Batch.from_pydict({
+        "ts": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "v": Column.from_numpy(
+            rng.integers(-(10 ** 6), 10 ** 6, n, dtype=np.int64)),
+        "g": Column.from_numpy(
+            rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+        "f": Column(dt.DOUBLE, f, fvalid),
+        "nv": Column(dt.INT, nv, rng.random(n) > 0.2),
+        "b": Column.from_numpy(rng.random(n) > 0.5),
+    })
+    db.schemas["main"].tables["z"] = MemTable("z", batch)
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute(f"SET serene_morsel_rows = {morsel_rows}")
+    return c
+
+
+PRUNE_QUERIES = [
+    "SELECT count(*), sum(v) FROM z WHERE ts < 5000",
+    "SELECT count(*), sum(v), avg(f) FROM z WHERE ts BETWEEN 7000 AND 9000",
+    "SELECT g, count(*), sum(v) FROM z WHERE ts >= 110000 "
+    "GROUP BY g ORDER BY g",
+    "SELECT count(*) FROM z WHERE ts IN (3, 4096, 100000)",
+    "SELECT count(*), min(f), max(f) FROM z WHERE ts > 115000 OR ts < 100",
+    "SELECT count(*) FROM z WHERE nv IS NULL AND ts < 3000",
+    "SELECT count(*) FROM z WHERE nv IS NOT NULL AND ts < 3000",
+    "SELECT count(*) FROM z WHERE g = 'alpha' AND ts < 2500",
+    "SELECT count(*) FROM z WHERE g > 'gamma'",          # no prunable range
+    "SELECT count(*) FROM z WHERE NOT (ts >= 2000)",
+    "SELECT count(*) FROM z WHERE ts NOT IN (1, 2)",
+    "SELECT count(*) FROM z WHERE b AND ts < 1500",
+    "SELECT count(*) FROM z WHERE f > 1e12",             # NaN blocks survive
+    "SELECT count(*) FROM z WHERE ts < 0",               # everything pruned
+    "SELECT * FROM z WHERE ts = 54321",                  # serial scan path
+    "SELECT ts, v FROM z WHERE ts >= 119000 ORDER BY ts LIMIT 7",
+]
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+@pytest.mark.parametrize("q", PRUNE_QUERIES)
+def test_parity_zonemap_on_off_x_workers(q, workers):
+    c = _mk_conn()
+    c.execute(f"SET serene_workers = {workers}")
+    c.execute("SET serene_zonemap = on")
+    on = repr(c.execute(q).rows())
+    c.execute("SET serene_zonemap = off")
+    off = repr(c.execute(q).rows())
+    assert on == off  # bit-identical, incl. float bits, NaNs, order
+
+
+def test_parity_after_update_delete_append():
+    c = _mk_conn(n=40_000)
+    steps = [
+        "UPDATE z SET ts = 1000000 + v WHERE ts >= 39000",
+        "DELETE FROM z WHERE ts < 2000",
+        "INSERT INTO z SELECT ts + 2000000, v, g, f, nv, b FROM z "
+        "WHERE ts < 10000",
+    ]
+    probes = [
+        "SELECT count(*), sum(v) FROM z WHERE ts < 8000",
+        "SELECT count(*) FROM z WHERE ts >= 1000000",
+        "SELECT g, count(*) FROM z WHERE ts >= 2000000 GROUP BY g "
+        "ORDER BY g",
+    ]
+    for step in steps:
+        # warm the stats, mutate, then every probe must match zonemap=off
+        for p in probes:
+            c.execute(p)
+        c.execute(step)
+        for p in probes:
+            on = repr(c.execute(p).rows())
+            c.execute("SET serene_zonemap = off")
+            off = repr(c.execute(p).rows())
+            c.execute("SET serene_zonemap = on")
+            assert on == off, (step, p)
+
+
+def test_metrics_move_under_selective_filter():
+    c = _mk_conn()
+    pruned0 = metrics.ZONEMAP_PRUNED.value
+    scanned0 = metrics.ZONEMAP_SCANNED.value
+    c.execute("SELECT count(*), sum(v) FROM z WHERE ts < 4000")
+    assert metrics.ZONEMAP_PRUNED.value > pruned0
+    assert metrics.ZONEMAP_SCANNED.value > scanned0
+
+
+def test_stale_rebuild_metric_update_vs_append():
+    c = _mk_conn(n=30_000)
+    c.execute("SELECT count(*) FROM z WHERE ts < 1000")   # build stats
+    stale0 = metrics.ZONEMAP_STALE_REBUILDS.value
+    # pure append: prefix block stats extend, no stale rebuild
+    c.execute("INSERT INTO z VALUES (900000, 1, 'tail', 0.5, 1, true)")
+    assert c.execute(
+        "SELECT count(*) FROM z WHERE ts = 900000").scalar() == 1
+    assert metrics.ZONEMAP_STALE_REBUILDS.value == stale0
+    # UPDATE bumps the mutation epoch: next build is from scratch
+    c.execute("UPDATE z SET ts = 0 WHERE ts = 900000")
+    assert c.execute("SELECT count(*) FROM z WHERE ts = 0").scalar() == 2
+    assert metrics.ZONEMAP_STALE_REBUILDS.value > stale0
+
+
+def test_incremental_append_extends_blocks():
+    rng = np.random.default_rng(0)
+    t = MemTable("m", Batch.from_pydict(
+        {"x": Column.from_numpy(np.arange(10_000, dtype=np.int64))}))
+    z1 = zonemap.column_zones(t, "x", 1024, t.try_pin())
+    assert z1.n_blocks == 10 and z1.mins[0] == 0 and z1.maxs[-1] == 9999
+    t.append_batch(Batch.from_pydict(
+        {"x": Column.from_numpy(
+            rng.integers(20_000, 30_000, 5000, dtype=np.int64))}))
+    z2 = zonemap.column_zones(t, "x", 1024, t.try_pin())
+    assert z2.n_blocks == 15 and z2.nrows == 15_000
+    # complete prefix blocks carried over verbatim
+    assert z2.mins[:9] == z1.mins[:9] and z2.maxs[:9] == z1.maxs[:9]
+    assert min(z2.mins[9:]) >= 9216 and max(z2.maxs[10:]) < 30_000
+
+
+def test_verify_mode_catches_corrupt_stats():
+    c = _mk_conn(n=20_000)
+    c.execute("SET serene_zonemap_verify = on")
+    q = "SELECT count(*), sum(v) FROM z WHERE ts < 3000"
+    expect = c.execute(q).rows()    # clean stats: no error, right answer
+    assert expect[0][0] == 3000
+    # corrupt the cached stats so a matching block looks prunable
+    t = c.db.schemas["main"].tables["z"]
+    for (name, _), (ver, ep, pos, zones) in t._zonemap_cache.items():
+        if name == "ts" and zones is not None:
+            zones.mins = [10 ** 9] * zones.n_blocks
+            zones.maxs = [10 ** 9 + 1] * zones.n_blocks
+    with pytest.raises(AssertionError, match="zonemap_verify"):
+        c.execute(q)
+    # without verify, the corruption would return wrong results —
+    # proving the assert mode is the structural guard
+    c.execute("SET serene_zonemap_verify = off")
+    assert c.execute(q).rows()[0][0] != 3000
+    # invalidation clears the corruption: UPDATE bumps the mutation
+    # epoch, forcing a from-scratch rebuild of the stats
+    c.execute("INSERT INTO z VALUES (0, 0, 'x', 0, 0, true)")
+    c.execute("UPDATE z SET v = v + 0 WHERE ts = 0")
+    assert c.execute(q).rows()[0][0] == 3001
+
+
+def test_scan_node_prunes_serial_path():
+    c = _mk_conn()
+    c.execute("SET serene_workers = 1")
+    pruned0 = metrics.ZONEMAP_PRUNED.value
+    rows = c.execute("SELECT ts, g FROM z WHERE ts BETWEEN 50000 AND 50004 "
+                     "ORDER BY ts").rows()
+    assert [r[0] for r in rows] == list(range(50000, 50005))
+    assert metrics.ZONEMAP_PRUNED.value > pruned0
+
+
+def test_parquet_scan_prunes(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+    n = 40_000
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "ts": np.arange(n, dtype=np.int64),
+        "v": np.random.default_rng(1).integers(0, 100, n),
+        "s": np.array(["ab", "cd"] * (n // 2)),
+    }), path)
+    db = Database()
+    c = db.connect()
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_morsel_rows = 4096")
+    q = (f"SELECT count(*), sum(v) FROM read_parquet('{path}') "
+         f"WHERE ts < 5000")
+    on = c.execute(q).rows()
+    c.execute("SET serene_zonemap = off")
+    off = c.execute(q).rows()
+    assert on == off and on[0][0] == 5000
+
+
+def test_alter_rename_invalidates_stats():
+    """Epoch-preserving ALTERs move values under old names; zone stats
+    must never survive them (review finding: RENAME swap returned wrong
+    counts before drop/rename bumped the mutation epoch)."""
+    c = _mk_conn(n=30_000)
+    assert c.execute(
+        "SELECT count(*) FROM z WHERE ts >= 1000").scalar() == 29_000
+    c.execute("ALTER TABLE z RENAME COLUMN ts TO old_ts")
+    c.execute("ALTER TABLE z RENAME COLUMN v TO ts")
+    on = c.execute("SELECT count(*) FROM z WHERE ts >= 1000").scalar()
+    c.execute("SET serene_zonemap = off")
+    off = c.execute("SELECT count(*) FROM z WHERE ts >= 1000").scalar()
+    c.execute("SET serene_zonemap = on")
+    assert on == off
+    # drop + re-add the same name: fresh all-NULL column, fresh stats
+    c.execute("ALTER TABLE z DROP COLUMN ts")
+    c.execute("ALTER TABLE z ADD COLUMN ts BIGINT")
+    assert c.execute("SELECT count(*) FROM z WHERE ts >= 1000").scalar() == 0
+    assert c.execute(
+        "SELECT count(*) FROM z WHERE ts IS NULL").scalar() == 30_000
+
+
+def test_search_scores_survive_doc_pruning():
+    """Stream-mode bm25() scores must be identical with zone maps on/off
+    even when the residual prunes candidate docs (review finding: the
+    score pass was sized by the post-prune count, zeroing survivors)."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE d (id INT, body TEXT, v INT)")
+    for i in range(0, 30_000, 2000):
+        vals = ",".join(
+            f"({j}, '{'apple pie' if j % 4 == 0 else 'banana split'}', {j})"
+            for j in range(i, i + 2000))
+        c.execute(f"INSERT INTO d VALUES {vals}")
+    c.execute("CREATE INDEX ON d USING inverted (body)")
+    c.execute("SET serene_morsel_rows = 2048")
+    q = ("SELECT id, bm25(body) FROM d WHERE body @@ 'apple' AND v < 3000 "
+         "ORDER BY id LIMIT 10")
+    on = repr(c.execute(q).rows())
+    c.execute("SET serene_zonemap = off")
+    off = repr(c.execute(q).rows())
+    c.execute("SET serene_zonemap = on")
+    assert on == off
+    assert "0.0)" not in on     # survivors keep their real scores
+
+
+# -- analyzer unit coverage ---------------------------------------------------
+
+
+def test_analyzer_three_state_semantics():
+    n = 8192
+    t = MemTable("a", Batch.from_pydict({
+        "x": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "s": Column.from_numpy(np.array(["aa", "bb"] * (n // 2),
+                                        dtype=object)),
+    }))
+    pin = t.try_pin()
+    zx = zonemap.column_zones(t, "x", 1024, pin)
+    zs = zonemap.column_zones(t, "s", 1024, pin)
+    assert zx.n_blocks == 8
+    # numeric three-state: block 0 is [0,1023]
+    assert zonemap._cmp_set("<", zx, 0, 5000) == zonemap._T
+    assert zonemap._cmp_set("<", zx, 4, 4096) == zonemap._F
+    assert zonemap._cmp_set("=", zx, 0, 500) == (zonemap._T | zonemap._F)
+    assert zonemap._cmp_set(">", zx, 7, 7167) == zonemap._T
+    # string stats decode through the dictionary
+    assert zs.mins[0] == "aa" and zs.maxs[0] == "bb"
+    assert zonemap._cmp_set("<", zs, 0, "zz") == zonemap._T
+    assert zonemap._cmp_set(">", zs, 0, "cc") == zonemap._F
+    # type confusion degrades to unknown, never to a wrong prune
+    assert zonemap._cmp_set("<", zx, 0, "text") == zonemap._TFN
+    assert zonemap._cmp_set("<", zs, 0, 7) == zonemap._TFN
+
+
+def test_analyzer_nan_and_null_sets():
+    f = np.array([1.0, 2.0, np.nan, 3.0] * 256)
+    t = MemTable("f", Batch.from_pydict({
+        "f": Column(dt.DOUBLE, f, np.array([True, True, True, False] * 256)),
+    }))
+    zf = zonemap.column_zones(t, "f", 1024, t.try_pin())
+    assert bool(zf.nans[0]) and int(zf.nulls[0]) == 256
+    # NaN is the PG-greatest float: f > 100 can still be true via NaN
+    s = zonemap._cmp_set(">", zf, 0, 100.0)
+    assert s & zonemap._T and s & zonemap._N
+    # f < 0: no value (NaN included) can satisfy it → F/N only
+    s = zonemap._cmp_set("<", zf, 0, 0.0)
+    assert not (s & zonemap._T)
+
+
+def test_fold_constant_and_comparison_parts():
+    from serenedb_tpu.sql import binder
+    from serenedb_tpu.sql.expr import BoundColumn, BoundLiteral
+    from serenedb_tpu.functions import scalar as fnlib
+    from serenedb_tpu.sql.expr import BoundFunc
+
+    col = BoundColumn(2, dt.BIGINT, "x")
+    lit = BoundLiteral(41, dt.INT)
+
+    def cmp_f(name, a, b):
+        res = fnlib.resolve(name, [a.type, b.type])
+        return BoundFunc(name, [a, b],
+                         dt.BOOL, lambda cols, bt, _i=res.impl:
+                         _i(cols, bt.num_rows))
+
+    assert binder.comparison_parts(cmp_f("op<", col, lit)) == (2, "<", 41)
+    # mirrored: 41 > x  ≡  x < 41
+    assert binder.comparison_parts(cmp_f("op>", lit, col)) == (2, "<", 41)
+    assert binder.comparison_parts(cmp_f("op<", col, col)) is None
+    assert binder.fold_constant(lit) == 41
+    assert binder.fold_constant(col) is binder._NOT_CONST
