@@ -24,5 +24,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_ZONEMAP_VERIFY=1 \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc2=$?
 
+# Pass 3 mirrors pass 2 for the join filter: the sideways min/max
+# pushdown is forced ON with the zone-map verifier armed, so every
+# probe morsel the build-key range prunes is re-scanned with the real
+# conjuncts — a range/stats divergence fails the join parity suite
+# loudly instead of silently dropping matched rows.
+echo "== join-filter structural verification pass (serene_join_filter=on) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_JOIN_FILTER=on \
+    SERENE_ZONEMAP_VERIFY=1 \
+    python -m pytest tests/test_join_exec.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc3=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
-exit "$rc2"
+[ "$rc2" -ne 0 ] && exit "$rc2"
+exit "$rc3"

@@ -31,8 +31,9 @@ from typing import Optional
 import numpy as np
 
 from ..columnar import dtypes as dt
-from ..columnar.column import Batch, Column, concat_batches
-from ..ops.agg import factorize_keys
+from ..columnar.column import (Batch, Column, concat_batches,
+                               merge_dictionaries)
+from ..ops.agg import factorize_codes, factorize_keys
 from ..parallel.pool import parallel_map
 from ..sql.expr import AggSpec, BoundColumn
 
@@ -417,6 +418,181 @@ def _partial_state(spec: AggSpec, b: Batch, codes: np.ndarray,
             np.logical_or.at(acc, vc, vb)
         return [Column(dt.BOOL, acc), _i64(cnt)]
     raise _Fallback(f"aggregate {spec.func}")
+
+
+# -- vectorized relational tier (hash join / set ops / DISTINCT ON) ----------
+#
+# Shared key machinery for the operators above the scan (ISSUE 3): factorize
+# composite keys from BOTH inputs into ONE dense int64 code space, then do
+# all matching with array kernels — the batched-codes trick GPUSparse uses
+# for accelerator-side postings intersection, applied host-side. The legacy
+# row-tuple interpreters in plan.py stay as the parity oracle behind
+# `SET serene_join_vectorized = off`.
+
+
+def vectorized_enabled(settings) -> bool:
+    try:
+        return bool(settings.get("serene_join_vectorized"))
+    except KeyError:  # pragma: no cover — registry always declares it
+        return False
+
+
+def combined_codes(cols_a: list[Column], cols_b: list[Column]
+                   ) -> Optional[tuple[np.ndarray, np.ndarray, int]]:
+    """Dense int64 codes over the CONCATENATION of two equal-arity column
+    lists (a-rows first), in one shared code space: equal code ⟺ the
+    legacy python row tuples would compare equal. Dictionary-encoded
+    string pairs re-encode onto one merged dictionary first (code order
+    is irrelevant here, only equality); numeric pairs concatenate under
+    numpy promotion (int vs float keys compare by value, like python).
+    Returns (codes_a, codes_b, num_codes), or None when a column pair
+    has no sound array representation (mixed string/non-string keys,
+    dictionary-less strings) — callers fall back to the row-tuple path.
+    """
+    if not cols_a or len(cols_a) != len(cols_b):
+        return None
+    arrays: list[np.ndarray] = []
+    valids: list[Optional[np.ndarray]] = []
+    for ca, cb in zip(cols_a, cols_b):
+        if ca.type.is_string or cb.type.is_string:
+            if not (ca.type.is_string and cb.type.is_string) or \
+                    ca.dictionary is None or cb.dictionary is None:
+                return None
+            ma, mb = merge_dictionaries([ca, cb])
+            data = np.concatenate([ma.data, mb.data])
+        else:
+            if ca.data.dtype.kind not in "biuf" or \
+                    cb.data.dtype.kind not in "biuf":
+                return None
+            data = np.concatenate([ca.data, cb.data])
+            if data.dtype.kind == "f":
+                # an integer side promoted to float64 meets its partner
+                # exactly only below 2**53 — python row tuples compare
+                # int == float losslessly, so beyond that bound the
+                # array path must defer to the oracle
+                for side in (ca.data, cb.data):
+                    if side.dtype.kind in "iu" and len(side) and \
+                            (int(side.max()) > 2 ** 53 or
+                             int(side.min()) < -(2 ** 53)):
+                        return None
+        if ca.validity is None and cb.validity is None:
+            valid = None
+        else:
+            valid = np.concatenate([ca.valid_mask(), cb.valid_mask()])
+        arrays.append(data)
+        valids.append(valid)
+    codes, g = factorize_codes(arrays, valids)
+    na = len(cols_a[0])
+    return codes[:na], codes[na:], g
+
+
+def rows_valid(cols: list[Column]) -> Optional[np.ndarray]:
+    """AND of the columns' validities (None ⇒ every row fully valid)."""
+    valid: Optional[np.ndarray] = None
+    for c in cols:
+        if c.validity is not None:
+            valid = c.validity if valid is None else (valid & c.validity)
+    return valid
+
+
+def first_occurrence_mask(codes: np.ndarray, g: int) -> np.ndarray:
+    """True at the FIRST row of each code, in row order."""
+    n = len(codes)
+    first = np.full(g, n, dtype=np.int64)
+    np.minimum.at(first, codes, np.arange(n, dtype=np.int64))
+    return first[codes] == np.arange(n, dtype=np.int64)
+
+
+def occurrence_ranks(codes: np.ndarray, g: int) -> np.ndarray:
+    """0-based occurrence number of each row within its code, in row
+    order (row i holding code c ranks k when it is the (k+1)-th row with
+    c) — the vectorized form of the bag-semantics counters the legacy
+    INTERSECT/EXCEPT ALL paths kept per row."""
+    n = len(codes)
+    order = np.argsort(codes, kind="stable")
+    sorted_codes = codes[order]
+    counts = np.bincount(codes, minlength=g)
+    group_start = np.concatenate([[0], np.cumsum(counts)[:-1]]) \
+        if g else np.zeros(0, dtype=np.int64)
+    pos_sorted = np.arange(n, dtype=np.int64) - \
+        np.repeat(group_start, counts) if n else \
+        np.zeros(0, dtype=np.int64)
+    ranks = np.empty(n, dtype=np.int64)
+    ranks[order] = pos_sorted
+    return ranks
+
+
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
+
+def join_pairs(lkeys: list[Column], rkeys: list[Column], settings,
+               nl: int, nr: int
+               ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Candidate (left, right) index pairs of the equi-join, vectorized.
+
+    Build side (right): rows grouped by key code via one stable argsort +
+    bincount prefix sums — a dense offset/payload index, no python dicts.
+    Probe side (left): morsel tasks over the shared worker pool expand
+    matches with repeat/cumsum arithmetic; partial pair vectors merge in
+    MORSEL ORDER, so the pair stream is bit-identical to the serial scan
+    at any worker count and exactly matches the legacy interpreter's
+    (left row, right insertion order) emission. NULL keys never match
+    (masked out per side, NOT grouped). None → caller uses the legacy
+    row-tuple path.
+    """
+    if nl == 0 or nr == 0:
+        return _EMPTY_I64, _EMPTY_I64
+    pair = combined_codes(lkeys, rkeys)
+    if pair is None:
+        return None
+    cl, cr, g = pair
+    lvalid = rows_valid(lkeys)
+    rvalid = rows_valid(rkeys)
+
+    # build: right row ids grouped by code, plus per-code [offset, count)
+    if rvalid is None:
+        bidx = np.arange(nr, dtype=np.int64)
+        crv = cr
+    else:
+        bidx = np.flatnonzero(rvalid).astype(np.int64)
+        crv = cr[bidx]
+    order = np.argsort(crv, kind="stable")
+    sorted_right = bidx[order]
+    counts = np.bincount(crv, minlength=g)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]) \
+        if g else np.zeros(0, dtype=np.int64)
+
+    def probe(span: tuple[int, int]) -> tuple[np.ndarray, np.ndarray]:
+        from .plan import check_cancel
+        check_cancel()
+        s, e = span
+        if lvalid is None:
+            pidx = np.arange(s, e, dtype=np.int64)
+        else:
+            pidx = np.flatnonzero(lvalid[s:e]).astype(np.int64) + s
+        pc = cl[pidx]
+        cnt = counts[pc]
+        li = np.repeat(pidx, cnt)
+        total = int(cnt.sum())
+        if total == 0:
+            return li, _EMPTY_I64
+        cum = np.cumsum(cnt)
+        within = np.arange(total, dtype=np.int64) - \
+            np.repeat(cum - cnt, cnt)
+        ri = sorted_right[np.repeat(offsets[pc], cnt) + within]
+        return li, ri
+
+    morsel_rows = int(settings.get("serene_morsel_rows"))
+    spans = [(s, min(s + morsel_rows, nl))
+             for s in range(0, nl, morsel_rows)]
+    if nl > morsel_rows and \
+            nl >= int(settings.get("serene_parallel_min_rows")):
+        parts = parallel_map(settings, probe, spans)
+    else:
+        parts = [probe(sp) for sp in spans]
+    li = np.concatenate([p[0] for p in parts])
+    ri = np.concatenate([p[1] for p in parts])
+    return li, ri
 
 
 def _i64(a: np.ndarray) -> Column:

@@ -18,7 +18,7 @@ import numpy as np
 from .. import errors
 from ..columnar import dtypes as dt
 from ..columnar.column import Batch, Column, concat_batches, merge_dictionaries
-from ..sql.expr import AggSpec, BoundExpr
+from ..sql.expr import AggSpec, BoundColumn, BoundExpr
 from ..utils.config import SessionSettings
 from .tables import TableProvider
 
@@ -27,6 +27,11 @@ from .tables import TableProvider
 class ExecContext:
     settings: SessionSettings = field(default_factory=SessionSettings)
     params: list = field(default_factory=list)
+    #: sideways information passing (JoinNode → probe-side ScanNode):
+    #: id(scan node) → synthetic build-key-range conjuncts. Keyed on the
+    #: EXECUTION context, never on plan nodes — cached plans execute
+    #: concurrently and must not see each other's filters.
+    join_filters: dict = field(default_factory=dict)
 
 
 def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
@@ -85,8 +90,10 @@ class ScanNode(PlanNode):
         self.types = [provider.type_of(c) for c in columns]
 
     def batches(self, ctx: ExecContext) -> Iterator[Batch]:
-        if self.filter is not None:
-            pruned = self._pruned_batches(ctx)
+        join_filters = ctx.join_filters.get(id(self)) \
+            if ctx.join_filters else None
+        if self.filter is not None or join_filters:
+            pruned = self._pruned_batches(ctx, join_filters)
             if pruned is not None:
                 yield from pruned
                 return
@@ -98,18 +105,28 @@ class ScanNode(PlanNode):
                 b = b.filter(mask)
             yield b
 
-    def _pruned_batches(self, ctx: ExecContext):
-        """Zone-map skip-scan for a filtered serial scan: blocks whose
-        stats prove no row matches are never sliced, blocks that provably
-        match whole skip predicate evaluation. None → plain scan."""
+    def _pruned_batches(self, ctx: ExecContext, join_filters=None):
+        """Zone-map skip-scan for a serial scan: blocks whose stats prove
+        no row matches are never sliced, blocks that provably match whole
+        skip predicate evaluation. `join_filters` are build-key-range
+        conjuncts a JoinNode published for this scan (probe side of an
+        inner/right hash join) — they prune blocks like filter conjuncts
+        but never run per row: rows in surviving blocks that miss the
+        range are simply non-matching probe rows. None → plain scan."""
         from . import zonemap
         pin = self.provider.try_pin()
         block_rows = int(ctx.settings.get("serene_morsel_rows"))
-        verdicts = zonemap.block_verdicts(
+        v_scan = zonemap.block_verdicts(
             self.provider, ctx.settings, [self.filter], self.columns,
-            block_rows, pin)
+            block_rows, pin) if self.filter is not None else None
+        v_join = zonemap.block_verdicts(
+            self.provider, ctx.settings, list(join_filters), self.columns,
+            block_rows, pin) if join_filters else None
+        verdicts = zonemap.combine_verdicts(v_scan, v_join)
         if verdicts is None:
             return None
+        if v_join is not None:
+            zonemap.count_join_filter(v_join)
         zonemap.count_pruned(verdicts)
         if pin is not None and all(c in pin[0] for c in self.columns):
             full = Batch(list(self.columns),
@@ -117,12 +134,14 @@ class ScanNode(PlanNode):
         else:
             full = self.provider.full_batch(self.columns)
         nrows = full.num_rows
+        exprs = ([self.filter] if self.filter is not None else []) + \
+            list(join_filters or [])
 
         def gen():
             if zonemap.verify_enabled(ctx.settings):
                 spans = [(b * block_rows, min((b + 1) * block_rows, nrows))
                          for b in np.flatnonzero(verdicts == zonemap.SKIP)]
-                zonemap.verify_pruned_blocks([self.filter], full, spans,
+                zonemap.verify_pruned_blocks(exprs, full, spans,
                                              f"scan {self.provider.name}")
             emitted = False
             for b, v in enumerate(verdicts):
@@ -131,7 +150,12 @@ class ScanNode(PlanNode):
                     continue
                 sl = full.slice(b * block_rows,
                                 min((b + 1) * block_rows, nrows))
-                if v != zonemap.ALL:
+                # the filter-skip decision reads the SCAN verdict: a
+                # join-range SCAN must not force a re-eval the zone maps
+                # already proved all-match, and a join-range ALL says
+                # nothing about the scan filter
+                if self.filter is not None and \
+                        (v_scan is None or v_scan[b] != zonemap.ALL):
                     c = self.filter.eval(sl)
                     sl = sl.filter(c.data.astype(bool) & c.valid_mask())
                 emitted = True
@@ -158,6 +182,40 @@ def _take_null_extended(batch: Batch, idx: np.ndarray) -> list[Column]:
         out.append(Column(t.type, t.data,
                           None if validity.all() else validity, t.dictionary))
     return out
+
+
+def _merge_using_columns(lc: Column, rc: Column,
+                         right_only: np.ndarray) -> Column:
+    """FULL JOIN USING merged key: COALESCE(l, r) realized as one
+    np.where over the null-extended sides (right-only rows take the
+    right value). Dictionary strings re-encode onto a shared dictionary
+    first so the select works on codes."""
+    from ..columnar.column import merge_dictionaries
+    if lc.type.is_string and rc.type.is_string:
+        ml, mr = merge_dictionaries([lc, rc])
+        data = np.where(right_only, mr.data, ml.data).astype(ml.data.dtype)
+        validity = np.where(right_only, mr.valid_mask(), ml.valid_mask())
+        return Column(lc.type, data,
+                      None if validity.all() else validity, ml.dictionary)
+    if lc.type.is_string != rc.type.is_string:   # heterogeneous USING pair
+        lvals, rvals = lc.to_pylist(), rc.to_pylist()
+        merged = [rvals[i] if right_only[i] else lvals[i]
+                  for i in range(len(lvals))]
+        return Column.from_pylist(merged, lc.type)
+    if rc.data.dtype != lc.data.dtype and lc.data.dtype.kind in "iu":
+        # astype would WRAP a wider right value that overflows the left
+        # key's physical type; the row merge this replaced raised 22003
+        merged = rc.data[right_only & rc.valid_mask()]
+        if len(merged):
+            info = np.iinfo(lc.data.dtype)
+            if merged.min() < info.min or merged.max() > info.max:
+                raise errors.SqlError(
+                    "22003", f"value out of range for type "
+                    f"{lc.type.id.name.lower()}")
+    data = np.where(right_only, rc.data.astype(lc.data.dtype), lc.data)
+    validity = np.where(right_only, rc.valid_mask(), lc.valid_mask())
+    return Column(lc.type, data,
+                  None if validity.all() else validity, lc.dictionary)
 
 
 class ValuesNode(PlanNode):
@@ -348,8 +406,19 @@ class DropColumnsNode(PlanNode):
 
 
 class JoinNode(PlanNode):
-    """CPU hash join (inner/left/cross). Equi-keys are extracted by the
-    planner; residual predicates run as a post-filter."""
+    """Hash join (inner/left/right/full/cross). Equi-keys are extracted
+    by the planner; residual predicates run over candidate pairs.
+
+    The default path is vectorized (ISSUE 3): both sides' keys factorize
+    into one dense int64 code space (ops/agg.factorize_codes via
+    morsel.combined_codes), the build side becomes an argsort/bincount
+    offset index, and probe morsels expand matches on the shared worker
+    pool with repeat/cumsum arithmetic — no python dicts or row tuples.
+    The build side also publishes its key min/max to the probe scan's
+    zone-map analyzer (`serene_join_filter`) so provably partner-less
+    probe morsels are never enqueued (inner/right only: left/full must
+    emit unmatched probe rows). `SET serene_join_vectorized = off` runs
+    the legacy row-tuple interpreter; results are bit-identical."""
 
     def __init__(self, kind: str, left: PlanNode, right: PlanNode,
                  left_keys: list[BoundExpr], right_keys: list[BoundExpr],
@@ -372,9 +441,32 @@ class JoinNode(PlanNode):
         return [self.left, self.right]
 
     def batches(self, ctx):
-        lb = concat_batches(list(self.left.batches(ctx)))
-        rb = concat_batches(list(self.right.batches(ctx)))
-        li, ri = self._match_inner(lb, rb)
+        scan = self._join_filter_target(ctx)
+        scan_id = None
+        rkey_cols = None
+        if scan is None:
+            # no sideways filter possible: keep the pre-filter left-then-
+            # right evaluation order (side-effect parity with the oracle)
+            lb = concat_batches(list(self.left.batches(ctx)))
+            rb = concat_batches(list(self.right.batches(ctx)))
+        else:
+            # build side (right) materializes FIRST so its key range can
+            # prune the probe scan's morsels before they are enqueued
+            rb = concat_batches(list(self.right.batches(ctx)))
+            if rb.num_rows:
+                from . import zonemap
+                rkey_cols = [k.eval(rb) for k in self.right_keys]
+                exprs = zonemap.build_key_range_exprs(
+                    self.left_keys, rkey_cols)
+                if exprs:
+                    ctx.join_filters[id(scan)] = exprs
+                    scan_id = id(scan)
+            try:
+                lb = concat_batches(list(self.left.batches(ctx)))
+            finally:
+                if scan_id is not None:
+                    ctx.join_filters.pop(scan_id, None)
+        li, ri = self._match_inner(lb, rb, ctx, rkey_cols)
         # ON-clause residual applies to *candidate pairs* (outer-join
         # semantics: a pair failing the residual is unmatched, the left row
         # survives null-extended — PG LEFT JOIN ... ON a AND b)
@@ -402,25 +494,64 @@ class JoinNode(PlanNode):
             right_only = li < 0
             if right_only.any():
                 for lk, rk in self.merge_pairs:
-                    lvals = lcols[lk].to_pylist()
-                    rvals = rcols[rk].to_pylist()
-                    merged = [rvals[i] if right_only[i] else lvals[i]
-                              for i in range(len(lvals))]
-                    lcols[lk] = Column.from_pylist(merged, lcols[lk].type)
+                    lcols[lk] = _merge_using_columns(
+                        lcols[lk], rcols[rk], right_only)
         yield Batch(list(self.names), lcols + rcols)
 
-    def _match_inner(self, lb: Batch, rb: Batch) -> tuple[np.ndarray, np.ndarray]:
+    def _join_filter_target(self, ctx) -> Optional["ScanNode"]:
+        """The probe-side scan the build key range could prune, when the
+        sideways filter is sound: inner/right joins only (left/full emit
+        unmatched probe rows and must scan everything), at least one
+        bare-column probe key, a probe subtree whose scan indices are
+        stable (Filter chains only), and no volatile build-key
+        expressions (pre-probe evaluation would double-draw their
+        state). None ⇒ run the join in plain left-then-right order."""
+        from . import zonemap
+        if self.kind not in ("inner", "right") or not self.left_keys:
+            return None
+        if not zonemap.join_filter_enabled(ctx.settings) or \
+                not zonemap.enabled(ctx.settings):
+            return None
+        if not any(isinstance(k, BoundColumn) for k in self.left_keys):
+            return None
+        scan = self.left
+        while isinstance(scan, FilterNode):
+            scan = scan.child
+        if type(scan) is not ScanNode:
+            return None
+        from ..sql.binder import _VOLATILE_FUNCS
+        for k in self.right_keys:
+            for sub in k.walk():
+                if getattr(sub, "name", None) in _VOLATILE_FUNCS:
+                    return None
+        return scan
+
+    def _match_inner(self, lb: Batch, rb: Batch, ctx,
+                     rkey_cols=None) -> tuple[np.ndarray, np.ndarray]:
         """Candidate (inner) pairs; left-join null extension happens later."""
         if self.kind == "cross" or not self.left_keys:
             li = np.repeat(np.arange(lb.num_rows), rb.num_rows)
             ri = np.tile(np.arange(rb.num_rows), lb.num_rows)
             return li, ri
         lkeys = [k.eval(lb) for k in self.left_keys]
-        rkeys = [k.eval(rb) for k in self.right_keys]
+        rkeys = rkey_cols if rkey_cols is not None \
+            else [k.eval(rb) for k in self.right_keys]
+        from .morsel import join_pairs, vectorized_enabled
+        if vectorized_enabled(ctx.settings):
+            out = join_pairs(lkeys, rkeys, ctx.settings,
+                             lb.num_rows, rb.num_rows)
+            if out is not None:
+                return out
+        return self._match_legacy(lkeys, rkeys, lb.num_rows, rb.num_rows)
+
+    def _match_legacy(self, lkeys: list[Column], rkeys: list[Column],
+                      nl: int, nr: int) -> tuple[np.ndarray, np.ndarray]:
+        """Row-tuple parity oracle (pre-ISSUE-3 interpreter): a python
+        dict of build-side tuples probed row by row."""
         lt = list(zip(*(c.to_pylist() for c in lkeys))) \
-            if lkeys else [()] * lb.num_rows
+            if lkeys else [()] * nl
         rt = list(zip(*(c.to_pylist() for c in rkeys))) \
-            if rkeys else [()] * rb.num_rows
+            if rkeys else [()] * nr
         table: dict = {}
         for j, key in enumerate(rt):
             if any(k is None for k in key):
@@ -469,6 +600,77 @@ class SetOpNode(PlanNode):
                             for c, t in zip(b.columns, self.types)]
                     yield Batch(list(self.names), cols)
             return
+        from .morsel import vectorized_enabled
+        if vectorized_enabled(ctx.settings):
+            out = self._batches_vectorized(ctx)
+            if out is not None:
+                yield out
+                return
+        yield from self._batches_legacy(ctx)
+
+    def _batches_vectorized(self, ctx) -> Optional[Batch]:
+        """Set semantics over dense key codes (ISSUE 3): both arms cast
+        to the unified types, factorize into ONE code space, and every
+        variant becomes bincount/first-occurrence arithmetic — identical
+        row selection and order to the row-tuple oracle (NULL = NULL,
+        each NaN occurrence distinct). None → unsupported column shape,
+        run the legacy path."""
+        from ..sql.binder import cast_column
+        from .morsel import (combined_codes, first_occurrence_mask,
+                             occurrence_ranks)
+        if any(t.id is dt.TypeId.NULL for t in self.types):
+            return None
+        lb = self.left.execute(ctx)
+        rb = self.right.execute(ctx)
+        for arm in (lb, rb):
+            for c, t in zip(arm.columns, self.types):
+                # an integer arm unified to DOUBLE collapses beyond 2**53
+                # under the cast; the row-tuple oracle compares int ==
+                # float exactly, so those shapes stay on it
+                if t.is_float and c.data.dtype.kind in "iu" and \
+                        len(c.data) and \
+                        (int(c.data.max()) > 2 ** 53 or
+                         int(c.data.min()) < -(2 ** 53)):
+                    return None
+        try:
+            lcols = [cast_column(c, t)
+                     for c, t in zip(lb.columns, self.types)]
+            rcols = [cast_column(c, t)
+                     for c, t in zip(rb.columns, self.types)]
+        except errors.SqlError:
+            return None
+        pair = combined_codes(lcols, rcols)
+        if pair is None:
+            return None
+        cl, cr, g = pair
+        nl = len(cl)
+        if self.op == "union":                      # UNION (distinct)
+            codes = np.concatenate([cl, cr])
+            keep = first_occurrence_mask(codes, g)
+            both = concat_batches([Batch(list(self.names), lcols),
+                                   Batch(list(self.names), rcols)])
+            return both if keep.all() else both.filter(keep)
+        counts_r = np.bincount(cr, minlength=g)
+        if self.all:
+            # bag semantics: the k-th occurrence of a value on the left
+            # pairs off against (INTERSECT) or outlives (EXCEPT) the
+            # right side's multiplicity
+            occ = occurrence_ranks(cl, g)
+            if self.op == "intersect":
+                keep = occ < counts_r[cl]
+            else:                                   # except
+                keep = occ >= counts_r[cl]
+        else:
+            first = first_occurrence_mask(cl, g)
+            if self.op == "intersect":
+                keep = first & (counts_r[cl] > 0)
+            else:                                   # except
+                keep = first & (counts_r[cl] == 0)
+        left = Batch(list(self.names), lcols)
+        return left if keep.all() else left.filter(keep)
+
+    def _batches_legacy(self, ctx):
+        """Row-tuple parity oracle (pre-ISSUE-3 interpreter)."""
         lrows = self.left.execute(ctx).rows()
         rrows = self.right.execute(ctx).rows()
         if self.op == "union":
@@ -523,12 +725,64 @@ class DistinctOnNode(PlanNode):
         return f"DistinctOn {self.key_indices}"
 
     def batches(self, ctx):
-        seen: set = set()
+        from .morsel import (factorize_codes, first_occurrence_mask,
+                             vectorized_enabled)
+        vectorized = vectorized_enabled(ctx.settings) and \
+            bool(self.key_indices)
+        # cross-batch dedup state: within-batch duplicates fall to one
+        # code-based first-occurrence pass; across batches only the
+        # WINNERS' decoded keys enter a python set (O(distinct keys)
+        # total, never O(rows)). The set is seeded lazily so the common
+        # single-batch plan never decodes a key at all.
+        seen: Optional[set] = None
+        pending: Optional[list[Column]] = None   # first batch's winners
+
+        def flush_pending():
+            nonlocal seen, pending
+            if seen is None:
+                seen = set()
+            if pending is not None:
+                seen.update(zip(*(c.to_pylist() for c in pending)))
+                pending = None
+
         for b in self.child.batches(ctx):
-            key_cols = [b.columns[i].to_pylist() for i in self.key_indices]
+            key_cols = [b.columns[i] for i in self.key_indices]
+            supported = vectorized and all(
+                (c.type.is_string and c.dictionary is not None) or
+                (not c.type.is_string and c.data.dtype.kind in "biuf")
+                for c in key_cols)
+            if supported:
+                codes, g = factorize_codes(
+                    [c.data for c in key_cols],
+                    [c.validity for c in key_cols])
+                all_unique = g == b.num_rows
+                keep = None if all_unique \
+                    else first_occurrence_mask(codes, g)
+                if seen is None and pending is None:
+                    pending = key_cols if keep is None \
+                        else [c.filter(keep) for c in key_cols]
+                    yield b if keep is None else b.filter(keep)
+                    continue
+                flush_pending()
+                if keep is None:
+                    keep = np.ones(b.num_rows, dtype=bool)
+                cand = np.flatnonzero(keep)
+                if len(cand):
+                    rows = zip(*(kc.take(cand).to_pylist()
+                                 for kc in key_cols))
+                    for j, row in enumerate(rows):
+                        if row in seen:
+                            keep[cand[j]] = False
+                        else:
+                            seen.add(row)
+                yield b if keep.all() else b.filter(keep)
+                continue
+            # row-tuple path (legacy mode or unsupported key shape)
+            flush_pending()
+            key_vals = [kc.to_pylist() for kc in key_cols]
             keep = np.zeros(b.num_rows, dtype=bool)
             for r in range(b.num_rows):
-                k = tuple(kc[r] for kc in key_cols)
+                k = tuple(kc[r] for kc in key_vals)
                 if k not in seen:
                     seen.add(k)
                     keep[r] = True

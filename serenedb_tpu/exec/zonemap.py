@@ -46,6 +46,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from .. import errors
 from ..columnar import dtypes as dt
 from ..columnar.column import Batch, Column
 from ..sql.binder import _CMP_CANON, comparison_parts
@@ -80,6 +81,13 @@ def enabled(settings) -> bool:
 def verify_enabled(settings) -> bool:
     try:
         return bool(settings.get("serene_zonemap_verify"))
+    except KeyError:  # pragma: no cover
+        return False
+
+
+def join_filter_enabled(settings) -> bool:
+    try:
+        return bool(settings.get("serene_join_filter"))
     except KeyError:  # pragma: no cover
         return False
 
@@ -486,6 +494,102 @@ def count_pruned(verdicts: np.ndarray) -> None:
     scanned = len(verdicts) - pruned
     if scanned:
         metrics.ZONEMAP_SCANNED.add(scanned)
+
+
+def count_join_filter(verdicts: np.ndarray) -> None:
+    """Bump the join-filter sideways-pushdown counters (verdicts from the
+    published build-key range alone, so pruning is attributed exactly)."""
+    pruned = int((verdicts == SKIP).sum())
+    if pruned:
+        metrics.JOIN_FILTER_PRUNED.add(pruned)
+    scanned = len(verdicts) - pruned
+    if scanned:
+        metrics.JOIN_FILTER_SCANNED.add(scanned)
+
+
+def combine_verdicts(a: Optional[np.ndarray],
+                     b: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """Conjunction of two per-block verdict vectors. SKIP < SCAN < ALL by
+    value, and conjunction is exactly the minimum: SKIP if either side
+    skips, ALL iff both prove every row matches."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return np.minimum(a, b)
+
+
+#: key column families the join filter can publish a range for: the
+#: range literal must both zone-compare (exec/zonemap._cmp_set) and
+#: evaluate through the engine's comparison kernels in verify mode
+_JF_RANGEABLE = {dt.TypeId.BOOL, dt.TypeId.TINYINT, dt.TypeId.SMALLINT,
+                 dt.TypeId.INT, dt.TypeId.BIGINT, dt.TypeId.FLOAT,
+                 dt.TypeId.DOUBLE, dt.TypeId.DATE, dt.TypeId.TIMESTAMP,
+                 dt.TypeId.VARCHAR}
+
+
+def build_key_range_exprs(probe_keys, build_key_cols) -> list[BoundExpr]:
+    """Min/max sideways information passing (the SereneDB/DuckDB join
+    filter): for every equi-key whose probe side is a bare scan column,
+    fold the build side's observed key range into two synthetic
+    comparison conjuncts `col >= lo AND col <= hi`, bound with the same
+    scalar kernels the binder would use. The exprs feed `block_verdicts`
+    on the probe scan, so morsels whose block stats can't overlap the
+    build keys are never enqueued — and `serene_zonemap_verify` re-scans
+    them structurally like any other pruned block.
+
+    NULL and NaN build keys never find a partner (row-tuple semantics),
+    so they are excluded from the published range; probe blocks that are
+    all-NULL or all-NaN on the key prune as a consequence. Returns []
+    when no key is rangeable (caller scans normally)."""
+    from ..functions import scalar as fnlib
+
+    exprs: list[BoundExpr] = []
+    for pk, kc in zip(probe_keys, build_key_cols):
+        if not isinstance(pk, BoundColumn) or \
+                pk.type.id not in _JF_RANGEABLE or \
+                kc.type.id not in _JF_RANGEABLE:
+            continue
+        valid = kc.valid_mask()
+        if kc.type.is_string:
+            if kc.dictionary is None:
+                continue
+            vals = kc.data[valid]
+            if not len(vals):
+                continue
+            lo = str(kc.dictionary[int(vals.min())])
+            hi = str(kc.dictionary[int(vals.max())])
+            lit_t = dt.VARCHAR
+        else:
+            vals = kc.data[valid]
+            if vals.dtype.kind == "f":
+                vals = vals[~np.isnan(vals)]
+            if not len(vals):
+                continue
+            lo, hi = vals.min().item(), vals.max().item()
+            if vals.dtype.kind == "f":
+                lit_t = dt.DOUBLE
+            elif kc.type.id in (dt.TypeId.DATE, dt.TypeId.TIMESTAMP):
+                lit_t = kc.type
+            elif kc.type.id is dt.TypeId.BOOL:
+                lit_t = dt.BOOL
+            else:
+                lit_t = dt.BIGINT
+        try:
+            pair = []
+            for op, v in (("op>=", lo), ("op<=", hi)):
+                res = fnlib.resolve(op, [pk.type, lit_t])
+
+                def impl(cols, batch, _impl=res.impl):
+                    return _impl(cols, batch.num_rows)
+
+                pair.append(BoundFunc(
+                    op, [BoundColumn(pk.index, pk.type, pk.name),
+                         BoundLiteral(v, lit_t)], dt.BOOL, impl))
+        except errors.SqlError:
+            continue          # no comparison kernel for this type pair
+        exprs.extend(pair)
+    return exprs
 
 
 def surviving_range(verdicts: np.ndarray, block_rows: int,

@@ -252,6 +252,43 @@ def factorize_keys(key_arrays: list[np.ndarray],
     return codes, uniq_cols, uniq_valid
 
 
+def factorize_codes(key_arrays: list[np.ndarray],
+                    valids: list[Optional[np.ndarray]]
+                    ) -> tuple[np.ndarray, int]:
+    """Composite keys → (dense int64 codes, group count), skipping the
+    unique-key-value materialization `factorize_keys` does — the join /
+    set-op / DISTINCT ON consumers only need the equality classes.
+
+    Equality semantics match the legacy row-tuple tier exactly: NULL keys
+    group together (set ops / DISTINCT ON treat NULL = NULL; the join
+    masks NULL-key rows out separately so NULL never matches), and every
+    NaN occurrence is its own group (the lexsort `!=` comparison keeps
+    NaN ≠ NaN, the same way python tuple equality does). Each key
+    factorizes in its OWN dtype and only the resulting int64 code rows
+    stack — a composite mixing int64 and float keys must never promote
+    the ints to float64, where values beyond 2**53 would collapse.
+    """
+    code_rows = []
+    for arr, valid in zip(key_arrays, valids):
+        a = np.asarray(arr)
+        if a.dtype == np.bool_:
+            a = a.astype(np.int8)
+        rows = []
+        if valid is not None:
+            a = np.where(valid, a, np.zeros((), dtype=a.dtype))
+            rows.append((~valid).astype(a.dtype))
+        rows.append(a)
+        _, codes_k = _unique_columns(np.stack(rows))
+        code_rows.append(codes_k)
+    if not code_rows:
+        return np.zeros(0, dtype=np.int64), 0
+    if len(code_rows) == 1:
+        inverse = code_rows[0]
+        return inverse, int(inverse.max()) + 1 if len(inverse) else 0
+    first_idx, inverse = _unique_columns(np.stack(code_rows))
+    return inverse, len(first_idx)
+
+
 def _unique_columns(composite: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Unique over columns of a (k, n) matrix → (first-occurrence idx, inverse)."""
     n = composite.shape[1]

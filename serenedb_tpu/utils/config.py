@@ -169,6 +169,20 @@ declare("serene_zonemap", True, bool,
         "every row skip predicate evaluation, and the device paths "
         "shrink uploads to the surviving block range; off scans "
         "everything (results are identical either way)")
+declare("serene_join_vectorized", True, bool,
+        "vectorized relational tier: hash joins, set operations and "
+        "DISTINCT ON run over dense int64 key codes with numpy array "
+        "kernels (build-side offset index + morsel-parallel probe "
+        "expansion on the shared worker pool); off interprets the same "
+        "operators row-tuple-at-a-time in python (the parity oracle) — "
+        "results are bit-identical either way")
+declare("serene_join_filter", True, bool,
+        "min/max sideways-information-passing join filter: after the "
+        "build side of an inner/right hash join materializes, its key "
+        "range is published to the zone-map analyzer so probe-side scan "
+        "morsels whose block statistics prove no key can match are "
+        "never enqueued; requires serene_zonemap, results are "
+        "identical on or off")
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

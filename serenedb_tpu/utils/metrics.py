@@ -84,6 +84,14 @@ ZONEMAP_PRUNED = REGISTRY.gauge(
 ZONEMAP_SCANNED = REGISTRY.gauge(
     "ZonemapMorselsScanned",
     "morsels that passed zone-map analysis and were actually scanned")
+JOIN_FILTER_PRUNED = REGISTRY.gauge(
+    "JoinFilterMorselsPruned",
+    "probe-side scan morsels skipped because the build side's published "
+    "key range proved no row of the block could find a join partner")
+JOIN_FILTER_SCANNED = REGISTRY.gauge(
+    "JoinFilterMorselsScanned",
+    "probe-side morsels that passed the join-filter key-range analysis "
+    "and were actually scanned")
 ZONEMAP_STALE_REBUILDS = REGISTRY.gauge(
     "ZonemapStaleRebuilds",
     "zone-map column stats rebuilt from scratch after a non-append "

@@ -34,6 +34,17 @@ if os.environ.get("SERENE_ZONEMAP_VERIFY"):
 
     _SDB_REGISTRY.set_global("serene_zonemap_verify", True)
 
+# scripts/verify_tier1.sh join-filter parity leg: force the sideways
+# min/max join filter to the given value ("on"/"off") for a whole run —
+# the off pass proves the filter is an optimization layer only (results
+# identical without it), the on pass combines with SERENE_ZONEMAP_VERIFY
+# so every join-filter-pruned probe morsel is re-scanned structurally.
+if os.environ.get("SERENE_JOIN_FILTER"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_JF
+
+    _SDB_REG_JF.set_global("serene_join_filter",
+                           os.environ["SERENE_JOIN_FILTER"])
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,375 @@
+"""Parity suite for the vectorized relational tier (ISSUE 3).
+
+Contract under test: the vectorized hash join / set-op / DISTINCT ON
+paths (`serene_join_vectorized = on`, the default) must produce results
+BIT-IDENTICAL to the legacy row-tuple interpreter across the full
+matrix — inner/left/right/full/cross joins × NULL keys × mixed key
+dtypes (int / dictionary-string / float-with-NaN) × residual ON
+predicates × `serene_workers` 1 vs N × `serene_join_filter` on/off.
+Plus join-filter behavior: pruning fires only where it is sound
+(inner/right), never changes results, and bumps its own gauges.
+"""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.utils import metrics
+
+
+def _mk_conn(nl=3000, nr=2000, seed=2):
+    """Two joinable tables with every key dtype the matrix needs: INT
+    with NULLs, dictionary TEXT, DOUBLE with NULLs and NaNs, plus BIGINT
+    payloads."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE l (ik INT, sk TEXT, fk DOUBLE, v BIGINT)")
+    c.execute("CREATE TABLE r (ik INT, sk TEXT, fk DOUBLE, w BIGINT)")
+
+    def mk(n, null_frac, sd, payload):
+        rng = np.random.default_rng(sd)
+        ik = rng.integers(0, 50, n).astype(np.int32)
+        ikv = rng.random(n) > null_frac
+        fk = np.round(rng.normal(size=n), 1)     # rounding ⇒ cross-side dups
+        fk[rng.random(n) < 0.05] = np.nan
+        fkv = rng.random(n) > 0.1
+        return Batch.from_pydict({
+            "ik": Column(dt.INT, ik, ikv),
+            "sk": Column.from_numpy(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+            "fk": Column(dt.DOUBLE, fk, fkv),
+            payload: Column.from_numpy(
+                rng.integers(0, 1000, n, dtype=np.int64)),
+        })
+
+    db.schemas["main"].tables["l"] = MemTable("l", mk(nl, 0.1, seed, "v"))
+    db.schemas["main"].tables["r"] = MemTable("r", mk(nr, 0.15, seed + 1, "w"))
+    c.execute("SET serene_device = 'cpu'")
+    # engage morsel-parallel probes and zone maps at test-sized data
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_morsel_rows = 1024")
+    return c
+
+
+JOIN_QUERIES = [
+    # kinds × key dtypes
+    "SELECT * FROM l JOIN r ON l.ik = r.ik ORDER BY v, w, l.sk, r.sk, l.fk, r.fk",
+    "SELECT * FROM l LEFT JOIN r ON l.ik = r.ik ORDER BY v, w, l.sk, r.sk, l.fk, r.fk",
+    "SELECT * FROM l RIGHT JOIN r ON l.ik = r.ik ORDER BY v, w, l.sk, r.sk, l.fk, r.fk",
+    "SELECT count(*), sum(v), sum(w), sum(ik) FROM l FULL JOIN r USING (ik)",
+    "SELECT count(*), sum(v+w) FROM l JOIN r ON l.sk = r.sk",
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.fk = r.fk",
+    # multi-column keys, mixed dtypes in one composite
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik AND l.sk = r.sk",
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r USING (ik, sk, fk)",
+    # residual ON predicates (candidate-pair semantics, outer variants)
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik AND v < w",
+    "SELECT count(*), sum(v), sum(w) FROM l LEFT JOIN r ON l.ik = r.ik AND v < w",
+    "SELECT count(*), sum(v), sum(w) FROM l RIGHT JOIN r ON l.sk = r.sk AND v % 3 = w % 3",
+    "SELECT count(*), sum(v), sum(w), sum(l.ik) FROM l FULL JOIN r ON l.ik = r.ik AND v + w < 900",
+    # cross join
+    "SELECT count(*), sum(v*w) FROM l CROSS JOIN r WHERE v = w",
+    # int key against float key (numeric promotion must match python ==)
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.fk",
+]
+
+
+def _rows(c, q):
+    """repr-keyed row capture: bit-identical comparison that still treats
+    a NaN as equal to itself (tuple == would fail rows CONTAINING NaN
+    payloads even when both sides are the same bits)."""
+    return repr(c.execute(q).rows())
+
+
+@pytest.mark.parametrize("q", JOIN_QUERIES)
+def test_join_parity_vectorized_vs_legacy(q):
+    c = _mk_conn()
+    c.execute("SET serene_join_vectorized = off")
+    oracle = _rows(c, q)
+    c.execute("SET serene_join_vectorized = on")
+    for workers in (1, 4):
+        c.execute(f"SET serene_workers = {workers}")
+        for jf in ("on", "off"):
+            c.execute(f"SET serene_join_filter = {jf}")
+            got = _rows(c, q)
+            assert got == oracle, \
+                f"vectorized join diverged (workers={workers}, filter={jf})"
+
+
+SETOP_QUERIES = [
+    "SELECT ik, sk FROM l UNION SELECT ik, sk FROM r ORDER BY ik NULLS LAST, sk",
+    "SELECT ik FROM l UNION ALL SELECT ik FROM r ORDER BY ik NULLS LAST LIMIT 50",
+    "SELECT ik, sk FROM l INTERSECT SELECT ik, sk FROM r ORDER BY ik NULLS LAST, sk",
+    "SELECT ik FROM l INTERSECT ALL SELECT ik FROM r ORDER BY ik NULLS LAST",
+    "SELECT ik, sk FROM l EXCEPT SELECT ik, sk FROM r ORDER BY ik NULLS LAST, sk",
+    "SELECT sk FROM l EXCEPT ALL SELECT sk FROM r ORDER BY sk",
+    # NaN / NULL float semantics: every NaN occurrence is distinct,
+    # NULL = NULL (python row-tuple semantics preserved exactly)
+    "SELECT count(*) FROM (SELECT fk FROM l EXCEPT SELECT fk FROM r) t",
+    "SELECT count(*) FROM (SELECT fk FROM l INTERSECT ALL SELECT fk FROM r) t",
+    # numeric type unification across arms (INT vs BIGINT)
+    "SELECT ik FROM l INTERSECT SELECT w FROM r ORDER BY ik NULLS LAST",
+]
+
+
+@pytest.mark.parametrize("q", SETOP_QUERIES)
+def test_setop_parity_vectorized_vs_legacy(q):
+    c = _mk_conn()
+    c.execute("SET serene_join_vectorized = off")
+    oracle = _rows(c, q)
+    c.execute("SET serene_join_vectorized = on")
+    assert _rows(c, q) == oracle
+
+
+DISTINCT_ON_QUERIES = [
+    "SELECT DISTINCT ON (ik) ik, v FROM l ORDER BY ik NULLS LAST, v DESC",
+    "SELECT DISTINCT ON (sk) sk, v FROM l ORDER BY sk, v",
+    "SELECT DISTINCT ON (ik, sk) ik, sk, v FROM l ORDER BY ik NULLS LAST, sk, v",
+    "SELECT DISTINCT ON (fk) fk, v FROM l ORDER BY fk, v LIMIT 40",
+]
+
+
+@pytest.mark.parametrize("q", DISTINCT_ON_QUERIES)
+def test_distinct_on_parity_vectorized_vs_legacy(q):
+    c = _mk_conn()
+    c.execute("SET serene_join_vectorized = off")
+    oracle = _rows(c, q)
+    c.execute("SET serene_join_vectorized = on")
+    assert _rows(c, q) == oracle
+
+
+def test_distinct_on_cross_batch_dedup():
+    """Cross-batch first-occurrence: the columnar winners accumulator
+    must dedup against EVERY prior batch, not just the current one."""
+    from serenedb_tpu.exec.plan import DistinctOnNode, ExecContext, PlanNode
+
+    class MultiBatch(PlanNode):
+        def __init__(self, batches):
+            self._batches = batches
+            self.names = list(batches[0].names)
+            self.types = [c.type for c in batches[0].columns]
+
+        def batches(self, ctx):
+            yield from self._batches
+
+    def mk(vals, payload):
+        return Batch.from_pydict({
+            "k": Column.from_pylist(vals, dt.BIGINT),
+            "v": Column.from_pylist(payload, dt.BIGINT)})
+
+    batches = [mk([1, 2, 2, None], [10, 20, 21, 30]),
+               mk([2, 3, None, 1], [22, 40, 31, 11]),
+               mk([4, 4, 3], [50, 51, 41])]
+    node = DistinctOnNode(MultiBatch(batches), [0])
+    got = node.execute(ExecContext()).to_pydict()
+    assert got == {"k": [1, 2, None, 3, 4], "v": [10, 20, 30, 40, 50]}
+
+    # string keys: dictionaries re-encode across batches
+    def mks(vals, payload):
+        return Batch.from_pydict({
+            "k": Column.from_pylist(vals, dt.VARCHAR),
+            "v": Column.from_pylist(payload, dt.BIGINT)})
+
+    sbatches = [mks(["b", "a", "b"], [1, 2, 3]),
+                mks(["c", "a", "d"], [4, 5, 6]),
+                mks(["d", "b", "e"], [7, 8, 9])]
+    node = DistinctOnNode(MultiBatch(sbatches), [0])
+    got = node.execute(ExecContext()).to_pydict()
+    assert got == {"k": ["b", "a", "c", "d", "e"], "v": [1, 2, 4, 6, 9]}
+
+
+def _mk_clustered(n=100_000, nb=500, lo=40_000, hi=42_000):
+    """Probe table clustered on the key (the shape zone maps exist for)
+    plus a small build table confined to [lo, hi) — the join filter must
+    prune every probe morsel outside that window."""
+    db = Database()
+    c = db.connect()
+    rng = np.random.default_rng(31)
+    c.execute("CREATE TABLE p (k BIGINT, v BIGINT)")
+    c.execute("CREATE TABLE b (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["p"] = MemTable("p", Batch.from_pydict({
+        "k": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "v": Column.from_numpy(rng.integers(0, 100, n, dtype=np.int64))}))
+    db.schemas["main"].tables["b"] = MemTable("b", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(lo, hi, nb, dtype=np.int64)),
+        "w": Column.from_numpy(rng.integers(0, 100, nb, dtype=np.int64))}))
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_morsel_rows = 4096")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_join_filter = on")
+    return c
+
+
+def test_join_filter_prunes_probe_morsels():
+    c = _mk_clustered()
+    q = "SELECT count(*), sum(v+w) FROM p JOIN b ON p.k = b.k"
+    p0 = metrics.JOIN_FILTER_PRUNED.value
+    on = c.execute(q).rows()
+    pruned = metrics.JOIN_FILTER_PRUNED.value - p0
+    assert pruned > 0, "join filter never pruned a clustered probe scan"
+    c.execute("SET serene_join_filter = off")
+    p1 = metrics.JOIN_FILTER_PRUNED.value
+    off = c.execute(q).rows()
+    assert metrics.JOIN_FILTER_PRUNED.value == p1
+    assert on == off
+    assert on[0][0] == 500          # every build row found its partner
+
+
+def test_join_filter_right_join_prunes_left_and_full_never():
+    c = _mk_clustered()
+    qr = "SELECT count(*), sum(w) FROM p RIGHT JOIN b ON p.k = b.k"
+    p0 = metrics.JOIN_FILTER_PRUNED.value
+    r_on = c.execute(qr).rows()
+    assert metrics.JOIN_FILTER_PRUNED.value > p0
+    c.execute("SET serene_join_filter = off")
+    assert c.execute(qr).rows() == r_on
+    c.execute("SET serene_join_filter = on")
+    # left/full joins emit unmatched probe rows — pruning would drop them
+    for q in ("SELECT count(*), sum(v) FROM p LEFT JOIN b ON p.k = b.k",
+              "SELECT count(*), sum(v) FROM p FULL JOIN b ON p.k = b.k"):
+        before = metrics.JOIN_FILTER_PRUNED.value
+        rows = c.execute(q).rows()
+        assert metrics.JOIN_FILTER_PRUNED.value == before
+        assert rows[0][0] >= 100_000      # every probe row survived
+
+
+def test_join_filter_legacy_match_still_prunes_identically():
+    c = _mk_clustered()
+    q = ("SELECT count(*), sum(v+w) FROM p JOIN b ON p.k = b.k "
+         "AND v + w > 20")
+    c.execute("SET serene_join_vectorized = on")
+    vec = c.execute(q).rows()
+    c.execute("SET serene_join_vectorized = off")
+    p0 = metrics.JOIN_FILTER_PRUNED.value
+    leg = c.execute(q).rows()
+    assert metrics.JOIN_FILTER_PRUNED.value > p0
+    assert vec == leg
+
+
+def test_join_filter_empty_and_null_build_side():
+    c = _mk_clustered()
+    c.execute("DELETE FROM b")
+    q = "SELECT count(*) FROM p JOIN b ON p.k = b.k"
+    assert c.execute(q).rows() == [(0,)]
+    c.execute("INSERT INTO b VALUES (NULL, 1), (NULL, 2)")
+    assert c.execute(q).rows() == [(0,)]       # NULL keys never match
+
+
+def test_full_join_using_merges_right_only_rows():
+    """merge_pairs (np.where path): the USING column must carry the
+    right side's key on right-only rows, for numeric AND string keys."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE a (k BIGINT, s TEXT, v BIGINT)")
+    c.execute("CREATE TABLE z (k BIGINT, s TEXT, w BIGINT)")
+    c.execute("INSERT INTO a VALUES (1, 'x', 10), (2, 'y', 20)")
+    c.execute("INSERT INTO z VALUES (2, 'y', 200), (3, 'z', 300)")
+    for vec in ("on", "off"):
+        c.execute(f"SET serene_join_vectorized = {vec}")
+        rows = c.execute(
+            "SELECT k, v, w FROM a FULL JOIN z USING (k) "
+            "ORDER BY k").rows()
+        assert rows == [(1, 10, None), (2, 20, 200), (3, None, 300)]
+        rows = c.execute(
+            "SELECT s, k, v, w FROM a FULL JOIN z USING (s, k) "
+            "ORDER BY s").rows()
+        assert rows == [("x", 1, 10, None), ("y", 2, 20, 200),
+                        ("z", 3, None, 300)]
+
+
+def test_huge_int_keys_never_collapse_through_float():
+    """BIGINT keys beyond 2**53 must not meet each other (or a float
+    partner) through float64 promotion: 2**53 and 2**53 + 1 are distinct
+    ints but the same double. Composite int+float keys and int-vs-float
+    key pairs both fall back to exact comparison."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE hl (k BIGINT, f DOUBLE, v BIGINT)")
+    c.execute("CREATE TABLE hr (k BIGINT, f DOUBLE, g DOUBLE, w BIGINT)")
+    base = 2 ** 53
+    c.execute(f"INSERT INTO hl VALUES ({base}, 1.5, 1), "
+              f"({base + 1}, 1.5, 2), (7, 2.5, 3)")
+    c.execute(f"INSERT INTO hr VALUES ({base + 1}, 1.5, {float(base)}, 10), "
+              f"(7, 2.5, 7.0, 30)")
+    queries = [
+        # composite int64+float key: a mixed-dtype stack must not
+        # promote the int row
+        ("SELECT v, w FROM hl JOIN hr ON hl.k = hr.k AND hl.f = hr.f "
+         "ORDER BY v", [(2, 10), (3, 30)]),
+        # int key against float key across sides: 2**53 equals the
+        # double exactly, 2**53 + 1 must NOT
+        ("SELECT v, w FROM hl JOIN hr ON hl.k = hr.g ORDER BY v",
+         [(1, 10), (3, 30)]),
+        ("SELECT count(*) FROM (SELECT k, f FROM hl INTERSECT "
+         "SELECT k, f FROM hr) t", [(2,)]),
+    ]
+    for q, expected in queries:
+        for vec in ("on", "off"):
+            c.execute(f"SET serene_join_vectorized = {vec}")
+            assert c.execute(q).rows() == expected, (q, vec)
+
+
+def test_setop_huge_int_vs_float_arm_stays_exact():
+    """An integer arm unified to DOUBLE must not collapse 2**53-adjacent
+    values through the cast — those shapes defer to the row-tuple
+    oracle (python int == float compares exactly)."""
+    db = Database()
+    c = db.connect()
+    big = 2 ** 53 + 1
+    for vec in ("on", "off"):
+        c.execute(f"SET serene_join_vectorized = {vec}")
+        assert c.execute(
+            f"SELECT {big} INTERSECT SELECT {float(2 ** 53)!r}"
+        ).rows() == [], vec
+        assert len(c.execute(
+            f"SELECT {big} EXCEPT SELECT {float(2 ** 53)!r}"
+        ).rows()) == 1, vec
+
+
+def test_full_join_using_overflow_raises_not_wraps():
+    """A right-only USING key too wide for the left column's type must
+    raise 22003 (as the row-wise merge did), never wrap through astype."""
+    from serenedb_tpu.errors import SqlError
+
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE na (k INT, v BIGINT)")
+    c.execute("CREATE TABLE nb (k BIGINT, w BIGINT)")
+    c.execute("INSERT INTO na VALUES (1, 10)")
+    c.execute(f"INSERT INTO nb VALUES ({2 ** 33}, 20)")
+    for vec in ("on", "off"):
+        c.execute(f"SET serene_join_vectorized = {vec}")
+        with pytest.raises(SqlError) as exc:
+            c.execute("SELECT k, v, w FROM na FULL JOIN nb USING (k)")
+        assert exc.value.sqlstate == "22003"
+
+
+def test_join_workers_parity_large_probe():
+    """Morsel-parallel probe expansion merges in morsel order: workers=1
+    and =N must be bit-identical on a probe spanning many morsels."""
+    db = Database()
+    c = db.connect()
+    rng = np.random.default_rng(41)
+    n, nb = 200_000, 30_000
+    c.execute("CREATE TABLE p (k BIGINT, v BIGINT)")
+    c.execute("CREATE TABLE b (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["p"] = MemTable("p", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 60_000, n, dtype=np.int64)),
+        "v": Column.from_numpy(rng.integers(0, 100, n, dtype=np.int64))}))
+    db.schemas["main"].tables["b"] = MemTable("b", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 60_000, nb, dtype=np.int64)),
+        "w": Column.from_numpy(rng.integers(0, 100, nb, dtype=np.int64))}))
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_morsel_rows = 16384")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    q = ("SELECT count(*), sum(v*w), min(v-w), max(v+w) "
+         "FROM p JOIN b ON p.k = b.k")
+    c.execute("SET serene_workers = 4")
+    par = c.execute(q).rows()
+    c.execute("SET serene_workers = 1")
+    assert c.execute(q).rows() == par
+    c.execute("SET serene_join_vectorized = off")
+    assert c.execute(q).rows() == par
