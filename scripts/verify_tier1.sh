@@ -36,6 +36,19 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_JOIN_FILTER=on \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc3=$?
 
+# Pass 4 is the profiler parity leg: the per-operator span collector is
+# forced ON (the conftest env hook arms the serene_profile global) over
+# the profiler suite plus the morsel/join parity suites, proving the
+# instrumentation observes without changing a single result bit at any
+# worker count.
+echo "== profiler parity pass (serene_profile=on) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_PROFILE=on \
+    python -m pytest tests/test_profile.py tests/test_parallel_exec.py \
+    tests/test_join_exec.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc4=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
-exit "$rc3"
+[ "$rc3" -ne 0 ] && exit "$rc3"
+exit "$rc4"

@@ -55,6 +55,17 @@ class QueryResult:
         return rs[0][0] if rs else None
 
 
+def _result_rows(res: "QueryResult") -> int:
+    """Rows a statement produced/affected, for statement stats: result
+    rows when any came back, else the count off the PG command tag
+    ('INSERT 0 5' → 5, 'DELETE 3' → 3, 'SET' → 0)."""
+    n = res.batch.num_rows
+    if n:
+        return n
+    parts = res.command_tag.split()
+    return int(parts[-1]) if parts and parts[-1].isdigit() else 0
+
+
 @dataclass
 class ViewDef:
     name: str
@@ -573,6 +584,9 @@ class Database(TableResolver):
         if name == "sdb_metrics":
             from .pgcatalog import metrics_table
             return metrics_table()
+        if name == "sdb_stat_statements":
+            from .pgcatalog import stat_statements_table
+            return stat_statements_table()
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 
@@ -837,6 +851,14 @@ class Connection:
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
+        #: last executed plan + its span profile (serene_profile on):
+        #: read by the statement-end observability hook for the
+        #: slow-query log's annotated tree. Best effort — a suspended
+        #: streaming portal interleaved with another statement may
+        #: overwrite it; the stats/stat_statements path never depends
+        #: on it.
+        self._active_profile = None
+        self._active_plan = None
         import weakref
         with db.lock:
             db._session_seq += 1
@@ -890,9 +912,11 @@ class Connection:
             plan = self._plan(st, params)   # binding enforces ACLs here
         finally:
             CURRENT_CONNECTION.reset(token)
-        ctx = ExecContext(self.settings, params)
+        ctx = self._exec_ctx(params)
 
         def run():
+            t0 = time.perf_counter_ns()
+            nrows = 0
             with self._session_scope(sql_text if sql_text is not None
                                      else "SELECT"):
                 it = plan.batches(ctx)
@@ -904,9 +928,12 @@ class Connection:
                     try:
                         b = next(it)
                     except StopIteration:
+                        self._obs_record(sql_text, t0, nrows,
+                                         ctx.profile, plan)
                         return
                     finally:
                         CURRENT_CONNECTION.reset(tok)
+                    nrows += b.num_rows
                     yield b
 
         return plan.names, plan.types, run()
@@ -974,7 +1001,13 @@ class Connection:
         try:
             with self._session_scope(sql_text if sql_text is not None
                                      else type(st).__name__):
-                return self._dispatch(st, params, sql_text)
+                self._active_profile = None
+                self._active_plan = None
+                t0 = time.perf_counter_ns()
+                res = self._dispatch(st, params, sql_text)
+                self._obs_record(sql_text, t0, _result_rows(res),
+                                 self._active_profile, self._active_plan)
+                return res
         finally:
             CURRENT_CONNECTION.reset(token)
 
@@ -1358,10 +1391,63 @@ class Connection:
             except _ViewRef as vr:
                 sel = _inline_view(sel, vr.view)
 
+    def _profile_enabled(self) -> bool:
+        try:
+            return bool(self.settings.get("serene_profile"))
+        except KeyError:  # pragma: no cover — registry always declares it
+            return False
+
+    def _exec_ctx(self, params: list) -> ExecContext:
+        """Execution context with a span collector attached when
+        `serene_profile` is on (obs/trace.py); the collector observes
+        only, so results are identical either way."""
+        ctx = ExecContext(self.settings, params)
+        if self._profile_enabled():
+            from .obs.trace import QueryProfile
+            ctx.profile = QueryProfile()
+            self._active_profile = ctx.profile
+        return ctx
+
     def _run_select(self, sel: ast.Select, params: list) -> Batch:
         plan = self._plan(sel, params)
-        ctx = ExecContext(self.settings, params)
+        ctx = self._exec_ctx(params)
+        if ctx.profile is not None:
+            self._active_plan = plan
         return plan.execute(ctx)
+
+    def _obs_record(self, sql_text: Optional[str], t0_ns: int, rows: int,
+                    profile, plan) -> None:
+        """Statement-end observability hook (begin is _session_scope):
+        query gauges, sdb_stat_statements, the slow-query log and the
+        session's pg_stat_activity query id. Everything is behind
+        `serene_profile`; failures here must never fail the statement's
+        own result path, so this is called only after success."""
+        if not self._profile_enabled():
+            return
+        now = metrics.QUERY_TIME_NS.add_time_ns(t0_ns)
+        metrics.QUERIES_EXECUTED.add()
+        elapsed_ns = now - t0_ns
+        pruned = 0
+        if profile is not None:
+            t = profile.totals()
+            pruned = t.morsels_pruned + t.morsels_jf_pruned
+        if sql_text:
+            from .obs.statements import STATEMENTS
+            cap = int(self.settings.get("serene_stat_statements_max"))
+            qid = STATEMENTS.record(sql_text, elapsed_ns, rows, pruned,
+                                    cap)
+            sess = self.db.sessions.get(self._session_id)
+            if sess is not None:
+                sess["query_id"] = qid
+        thresh = int(self.settings.get("serene_log_min_duration_ms"))
+        if thresh >= 0 and elapsed_ns >= thresh * 1_000_000:
+            metrics.SLOW_QUERIES.add()
+            msg = (f"duration: {elapsed_ns / 1e6:.3f} ms  "
+                   f"statement: {sql_text or '<internal>'}")
+            if profile is not None and plan is not None:
+                from .obs.trace import annotate_plan
+                msg += "\n" + "\n".join(annotate_plan(plan, profile))
+            log.info("slow_query", msg)
 
     # -- DDL/DML -----------------------------------------------------------
 
@@ -2239,21 +2325,73 @@ class Connection:
         return QueryResult(Batch([], []), "ROLLBACK")
 
     def _explain(self, st: ast.Explain, params: list) -> QueryResult:
-        if not isinstance(st.inner, (ast.Select, ast.SetOp)):
-            raise errors.unsupported("EXPLAIN of non-SELECT")
-        plan = self._plan(st.inner, params)
-        lines = plan.explain()
-        if st.analyze:
-            import time as _time
-            t0 = _time.perf_counter()
-            result = plan.execute(ExecContext(self.settings, params))
-            elapsed = (_time.perf_counter() - t0) * 1000
-            lines = lines + [
-                f"Execution Time: {elapsed:.3f} ms",
-                f"Rows Returned: {result.num_rows}",
-            ]
+        if isinstance(st.inner, (ast.Select, ast.SetOp)):
+            plan = self._plan(st.inner, params)
+            if not st.analyze:
+                lines = plan.explain()
+            else:
+                # ANALYZE always instruments (PG semantics), independent
+                # of the serene_profile session setting
+                from .obs.trace import QueryProfile, annotate_plan
+                prof = QueryProfile()
+                t0 = time.perf_counter()
+                result = plan.execute(
+                    ExecContext(self.settings, params, profile=prof))
+                elapsed = (time.perf_counter() - t0) * 1000
+                lines = annotate_plan(plan, prof) + [
+                    f"Execution Time: {elapsed:.3f} ms",
+                    f"Rows Returned: {result.num_rows}",
+                ]
+        elif isinstance(st.inner, (ast.Insert, ast.Update, ast.Delete)):
+            lines = self._explain_dml(st, params)
+        else:
+            raise errors.unsupported(
+                f"EXPLAIN of {type(st.inner).__name__}")
         b = Batch.from_pydict({"QUERY PLAN": lines})
         return QueryResult(b, f"SELECT {len(lines)}")
+
+    def _explain_dml(self, st: ast.Explain, params: list) -> list[str]:
+        """EXPLAIN [ANALYZE] of INSERT/UPDATE/DELETE, PG's shape: the
+        target operator line (`Insert on t`) with the source subplan
+        under it when one exists; ANALYZE really executes the DML (side
+        effects included, exactly like PG) and stamps the affected-row
+        count and wall time on the target line."""
+        inner = st.inner
+        verb = type(inner).__name__              # Insert / Update / Delete
+        schema, name = self.db._split(inner.table)
+        target = name if schema == "main" else f"{schema}.{name}"
+        lines = [f"{verb} on {target}"]
+        if isinstance(inner, ast.Insert):
+            if inner.query is not None:
+                sub = self._plan(inner.query, params)
+                lines += ["  ->  " + sub.explain()[0]] + \
+                         ["  " + ln for ln in sub.explain()[1:]]
+            elif inner.values is not None:
+                lines.append(f"  ->  Values ({len(inner.values)} rows)")
+        else:
+            # UPDATE/DELETE source: plan the equivalent row-selection
+            # SELECT so the subplan shows the real scan + pushed-down
+            # filter (PG's shape); statements the planner can't express
+            # this way (USING/FROM joins, etc.) keep the one-line plan
+            try:
+                src = ast.Select(
+                    items=[ast.SelectItem(ast.Star())],
+                    from_=ast.NamedTable(list(inner.table)),
+                    where=inner.where)
+                sub = self._plan(src, params)
+                lines += ["  ->  " + sub.explain()[0]] + \
+                         ["  " + ln for ln in sub.explain()[1:]]
+            except errors.SqlError:
+                pass
+        if st.analyze:
+            t0 = time.perf_counter()
+            res = self._dispatch(inner, params)
+            elapsed = (time.perf_counter() - t0) * 1000
+            affected = _result_rows(res)
+            lines[0] += (f" (actual time=0.000..{elapsed:.3f} "
+                         f"rows={affected} loops=1)")
+            lines.append(f"Execution Time: {elapsed:.3f} ms")
+        return lines
 
     def _vacuum(self, st: ast.VacuumStmt) -> QueryResult:
         """VACUUM verbs (reference: SearchTable VACUUM refresh/compact/

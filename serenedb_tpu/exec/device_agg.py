@@ -84,7 +84,17 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
             # coding needs a plain column (min/max ignore DISTINCT)
             return None
     try:
-        return _run(node, scan, provider, preds, ctx)
+        prof = getattr(ctx, "profile", None)
+        if prof is None:
+            return _run(node, scan, provider, preds, ctx)
+        # host-vs-device attribution: everything inside _run (upload,
+        # compile-cache lookup, dispatch, readback) is device-path time,
+        # stamped on the aggregate node the offload replaced
+        import time as _time
+        t0 = _time.perf_counter_ns()
+        out = _run(node, scan, provider, preds, ctx)
+        prof.add_device_ns(id(node), _time.perf_counter_ns() - t0)
+        return out
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"aggregate fell back to CPU: {e}")
         return None

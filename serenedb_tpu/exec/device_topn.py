@@ -74,9 +74,17 @@ def try_device_topn(limit_node, ctx) -> Optional[Batch]:
             provider.row_count() < ctx.settings.get("serene_device_min_rows"):
         return None
     from ..columnar.device import DeviceNarrowingError
+    prof = getattr(ctx, "profile", None)
     try:
+        import time as _time
+        t0 = _time.perf_counter_ns() if prof is not None else 0
         idx = _topn_indices(provider, scan, scan.columns[col_idx],
                             bool(sort.descs[0]), k, ctx)
+        if prof is not None:
+            # device-path time lands on the Limit node that claimed the
+            # Sort pipeline (the offload replaced its whole subtree)
+            prof.add_device_ns(id(limit_node),
+                               _time.perf_counter_ns() - t0)
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"top-N fell back to CPU: {e}")
         return None

@@ -26,6 +26,7 @@ keys, custom providers) falls back to the serial CPU oracle in plan.py.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -33,6 +34,7 @@ import numpy as np
 from ..columnar import dtypes as dt
 from ..columnar.column import (Batch, Column, concat_batches,
                                merge_dictionaries)
+from ..obs.trace import batch_nbytes
 from ..ops.agg import factorize_codes, factorize_keys
 from ..parallel.pool import parallel_map
 from ..sql.expr import AggSpec, BoundColumn
@@ -51,6 +53,20 @@ _STDDEV = {"stddev", "stddev_samp", "var_samp", "variance", "stddev_pop",
 
 class _Fallback(Exception):
     """Shape turned out unsupported mid-flight — use the serial path."""
+
+
+def _stage_clocks() -> tuple[int, int]:
+    return time.perf_counter_ns(), time.thread_time_ns()
+
+
+def _stage_stamp(prof, key: int, b: Batch,
+                 clocks: tuple[int, int]) -> tuple[int, int]:
+    """One morsel × one fused stage → one add_stage() span; returns fresh
+    clocks so consecutive stages chain without double counting."""
+    t1, c1 = time.perf_counter_ns(), time.thread_time_ns()
+    prof.add_stage(key, b.num_rows, t1 - clocks[0], c1 - clocks[1],
+                   batch_nbytes(b))
+    return t1, c1
 
 
 def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
@@ -132,6 +148,10 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                 if v != zonemap.SKIP]
     else:
         keep = [(sp, zonemap.SCAN) for sp in spans]
+    prof = getattr(ctx, "profile", None)
+    if prof is not None:
+        prof.add_scan_morsels(id(scan), scheduled=len(keep),
+                              pruned=len(spans) - len(keep))
 
     # late materialization: only columns the scan-bound expressions
     # actually read are fetched before morsels run; the rest never
@@ -155,21 +175,33 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
         full = empty
 
     def run_morsel(item):
+        # per-stage span stamps (profile on): the fused pipeline is the
+        # only execution these operators get, so each stage's rows/time
+        # accumulate under the PLAN NODE's id from every worker thread —
+        # the sink merge sums them, giving exact per-operator actual
+        # rows at any worker count
         span, verdict = item
         check_cancel()
         b = full.slice(span[0], span[1])
         all_match = verdict == zonemap.ALL
+        clocks = _stage_clocks() if prof is not None else None
         if scan.filter is not None and not all_match:
             c = scan.filter.eval(b)
             b = b.filter(c.data.astype(bool) & c.valid_mask())
+        if clocks is not None:
+            clocks = _stage_stamp(prof, id(scan), b, clocks)
         for st in stages:
             if isinstance(st, FilterNode):
                 if all_match and id(st) in leading:
+                    if clocks is not None:
+                        clocks = _stage_stamp(prof, id(st), b, clocks)
                     continue     # zone maps proved every row matches
                 c = st.pred.eval(b)
                 b = b.filter(c.data.astype(bool) & c.valid_mask())
             else:
                 b = Batch(list(st.names), [e.eval(b) for e in st.exprs])
+            if clocks is not None:
+                clocks = _stage_stamp(prof, id(st), b, clocks)
         return _morsel_partials(node, b)
 
     try:

@@ -32,6 +32,10 @@ class ExecContext:
     #: EXECUTION context, never on plan nodes — cached plans execute
     #: concurrently and must not see each other's filters.
     join_filters: dict = field(default_factory=dict)
+    #: per-query span collector (obs/trace.QueryProfile) or None.
+    #: Observation only: executors stamp rows/time/prune counters into
+    #: it but never read it back, so a profile can't perturb results.
+    profile: object = None
 
 
 def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
@@ -41,9 +45,37 @@ def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
     return Batch(list(names), cols)
 
 
+def _profiled_batches(fn):
+    """Wrap one node class's raw batch generator with the span collector.
+    With no profile on the context this is a single attribute check that
+    returns the raw generator — zero extra frames during iteration, so
+    `serene_profile = off` costs nothing in the hot loop."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, ctx):
+        prof = getattr(ctx, "profile", None)
+        if prof is None:
+            return fn(self, ctx)
+        return prof.wrap_batches(self, fn, ctx)
+
+    wrapper._obs_wrapped = True
+    wrapper._obs_raw = fn
+    return wrapper
+
+
 class PlanNode:
     names: list[str]
     types: list[dt.SqlType]
+
+    def __init_subclass__(cls, **kwargs):
+        # every operator that defines its own batches() is profiled
+        # automatically (search_scan/window nodes included) — the span
+        # layer can never drift out of sync with new operators
+        super().__init_subclass__(**kwargs)
+        impl = cls.__dict__.get("batches")
+        if impl is not None and not getattr(impl, "_obs_wrapped", False):
+            cls.batches = _profiled_batches(impl)
 
     def batches(self, ctx: ExecContext) -> Iterator[Batch]:
         raise NotImplementedError
@@ -128,6 +160,17 @@ class ScanNode(PlanNode):
         if v_join is not None:
             zonemap.count_join_filter(v_join)
         zonemap.count_pruned(verdicts)
+        prof = getattr(ctx, "profile", None)
+        if prof is not None:
+            # disjoint attribution (scheduled + pruned + jf_pruned =
+            # total blocks): a block both analyses would skip counts
+            # once, under the join filter
+            total = int((verdicts == zonemap.SKIP).sum())
+            jf = int((v_join == zonemap.SKIP).sum()) \
+                if v_join is not None else 0
+            prof.add_scan_morsels(id(self),
+                                  scheduled=len(verdicts) - total,
+                                  pruned=total - jf, jf_pruned=jf)
         if pin is not None and all(c in pin[0] for c in self.columns):
             full = Batch(list(self.columns),
                          [pin[0].column(c) for c in self.columns])

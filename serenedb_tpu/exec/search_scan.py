@@ -168,6 +168,11 @@ class SearchScanNode(PlanNode):
                                          scanned_blocks)
             metrics.ZONEMAP_PRUNED.add(len(pruned_blocks))
             metrics.ZONEMAP_SCANNED.add(len(scanned_blocks))
+            prof = getattr(ctx, "profile", None)
+            if prof is not None:
+                prof.add_scan_morsels(id(self),
+                                      scheduled=len(scanned_blocks),
+                                      pruned=len(pruned_blocks))
             if zonemap.verify_enabled(ctx.settings):
                 dropped = full.take(docs[~keep].astype(np.int64))
                 c = self.residual.eval(dropped)

@@ -1,0 +1,12 @@
+"""Query observability: per-operator profiling, statement statistics,
+metrics export (ISSUE 4).
+
+The instrument panel for every later perf PR: `obs.trace` collects
+per-operator spans (rows, wall+CPU time, morsel prune counters, bytes,
+device time) with per-worker-thread accumulation and a deterministic
+sink merge, `obs.statements` keeps the `sdb_stat_statements` registry
+keyed by normalized query fingerprint, and `obs.export` renders the
+Prometheus `/metrics` and JSON `/_stats` payloads. Everything is gated
+by `serene_profile` (default on) and observes only — results are
+bit-identical with profiling on or off, at any worker count.
+"""

@@ -1,0 +1,58 @@
+"""Prometheus-text `/metrics` and JSON `/_stats` payload rendering.
+
+Reference analog: the reference's monitoring endpoint surface — here the
+fixed gauge registry (utils/metrics.py) plus the statement store render
+into the Prometheus exposition format (text/plain; version=0.0.4) and a
+JSON object the ES-compatible `/_stats` route merges in.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..utils import metrics as _metrics
+from .statements import STATEMENTS
+
+_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+
+
+def _prom_name(gauge_name: str) -> str:
+    return "serenedb_" + _CAMEL.sub("_", gauge_name).lower()
+
+
+def _label_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prometheus_text() -> str:
+    """The whole registry as Prometheus gauges (one consistent
+    Registry.snapshot(), not per-gauge reads mid-scrape) plus per-
+    statement call/time/row series labeled by queryid."""
+    lines: list[str] = []
+    snap = _metrics.REGISTRY.snapshot()
+    descs = {g.name: g.description for g in _metrics.REGISTRY.all()}
+    for name in sorted(snap):
+        pname = _prom_name(name)
+        if descs.get(name):
+            lines.append(f"# HELP {pname} {descs[name]}")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {snap[name]}")
+    stmts = STATEMENTS.snapshot()
+    if stmts:
+        for series, key in (("statement_calls", "calls"),
+                            ("statement_total_ms", "total_ms"),
+                            ("statement_rows", "rows")):
+            pname = f"serenedb_{series}"
+            lines.append(f"# TYPE {pname} counter")
+            for e in stmts:
+                q = _label_escape(e["query"][:200])
+                lines.append(
+                    f'{pname}{{queryid="{e["queryid"]}",query="{q}"}} '
+                    f"{e[key]}")
+    return "\n".join(lines) + "\n"
+
+
+def stats_json() -> dict:
+    """Gauge snapshot + statement stats for the JSON `/_stats` route."""
+    return {"metrics": _metrics.REGISTRY.snapshot(),
+            "statements": STATEMENTS.snapshot()}

@@ -1,0 +1,209 @@
+"""Lock-cheap per-query span/profile collector.
+
+Reference analog: ClickHouse's per-query ProfileEvents and PG's
+EXPLAIN ANALYZE instrumentation, re-expressed for the morsel/batch
+executor: every PlanNode's batch generator is wrapped (exec/plan.py
+auto-wraps subclasses), and the fused morsel pipeline stamps its stage
+work directly (exec/morsel.py), so both the streaming operator tree and
+the worker-pool path are covered by ONE collector.
+
+Determinism contract: profiling observes, never steers. Each executing
+thread accumulates into its own bucket (a thread-local dict — no lock on
+the hot path after first touch); the sink merges buckets by summing
+integer counters, so the merged numbers are independent of scheduling
+order and the query result is bit-identical with profiling on or off at
+any `serene_workers`. Wall/CPU nanoseconds in morsel pipelines are
+summed per-worker task times (they can exceed elapsed wall clock on
+purpose — that is the work the pool did).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, Optional
+
+#: additive per-operator counters (merge = sum; scheduling-order free)
+_COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
+             "loops", "morsels_scheduled", "morsels_pruned",
+             "morsels_jf_pruned", "device_ns")
+
+
+class OpStats:
+    """One operator's accumulated span counters (one bucket's view)."""
+
+    __slots__ = _COUNTERS + ("first_ns",)
+
+    def __init__(self):
+        for f in _COUNTERS:
+            setattr(self, f, 0)
+        #: the operator's accumulated wall ns at its FIRST emitted batch
+        #: (PG "startup time"; merge = min, thread-order free)
+        self.first_ns: Optional[int] = None
+
+    def merge(self, other: "OpStats") -> None:
+        for f in _COUNTERS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        if other.first_ns is not None:
+            self.first_ns = other.first_ns if self.first_ns is None \
+                else min(self.first_ns, other.first_ns)
+
+
+def batch_nbytes(b) -> int:
+    """Materialized bytes of a batch's physical arrays (dictionary pages
+    are shared, not per-batch — excluded)."""
+    return sum(int(c.data.nbytes) for c in b.columns)
+
+
+class QueryProfile:
+    """Per-query collector keyed by id(plan node).
+
+    Hot-path cost is one thread-local dict lookup plus integer adds per
+    BATCH (never per row); batches are morsel-sized, so the budget is
+    <3% on the profile_overhead bench shape.
+    """
+
+    def __init__(self):
+        self._register_lock = threading.Lock()
+        self._buckets: list[dict[int, OpStats]] = []
+        self._tl = threading.local()
+        self.t0_ns = time.perf_counter_ns()
+
+    # -- accumulation (any thread) ----------------------------------------
+
+    def _bucket(self) -> dict[int, OpStats]:
+        d = getattr(self._tl, "d", None)
+        if d is None:
+            d = self._tl.d = {}
+            with self._register_lock:
+                self._buckets.append(d)
+        return d
+
+    def stats(self, key: int) -> OpStats:
+        d = self._bucket()
+        s = d.get(key)
+        if s is None:
+            s = d[key] = OpStats()
+        return s
+
+    def add_scan_morsels(self, key: int, scheduled: int = 0,
+                         pruned: int = 0, jf_pruned: int = 0) -> None:
+        """Morsel scheduling outcome for one scan. The three counters are
+        DISJOINT (scheduled + pruned + jf_pruned = blocks considered):
+        `pruned` is zone-map-only pruning, `jf_pruned` join-filter
+        pruning, a block both would skip counts once under the join
+        filter — so roll-ups never double-count a block."""
+        s = self.stats(key)
+        s.morsels_scheduled += int(scheduled)
+        s.morsels_pruned += int(pruned)
+        s.morsels_jf_pruned += int(jf_pruned)
+
+    def add_stage(self, key: int, rows_out: int, wall_ns: int,
+                  cpu_ns: int = 0, bytes_out: int = 0) -> None:
+        """Fused-pipeline stamp: one morsel's pass through one operator
+        (the operator's own batches() never runs in the fused path)."""
+        s = self.stats(key)
+        s.rows_out += int(rows_out)
+        s.wall_ns += int(wall_ns)
+        s.cpu_ns += int(cpu_ns)
+        s.bytes_out += int(bytes_out)
+        s.batches += 1
+
+    def add_device_ns(self, key: int, ns: int) -> None:
+        self.stats(key).device_ns += int(ns)
+
+    def wrap_batches(self, node, fn, ctx) -> Iterator:
+        """Instrumented drive of a node's raw batch generator: wall time
+        accrues only while inside next() (inclusive of children, PG
+        semantics), rows/bytes per emitted batch."""
+        key = id(node)
+        self.stats(key).loops += 1
+        it = fn(node, ctx)
+        try:
+            while True:
+                t0 = time.perf_counter_ns()
+                c0 = time.thread_time_ns()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    s = self.stats(key)
+                    s.wall_ns += time.perf_counter_ns() - t0
+                    s.cpu_ns += time.thread_time_ns() - c0
+                    return
+                t1 = time.perf_counter_ns()
+                s = self.stats(key)
+                s.wall_ns += t1 - t0
+                s.cpu_ns += time.thread_time_ns() - c0
+                if s.first_ns is None:
+                    s.first_ns = s.wall_ns
+                s.rows_out += b.num_rows
+                s.batches += 1
+                s.bytes_out += batch_nbytes(b)
+                yield b
+        finally:
+            it.close()
+
+    # -- sink merge (call after execution has drained) --------------------
+
+    def merged(self) -> dict[int, OpStats]:
+        """Deterministic sink merge: per-thread buckets sum into one map.
+        Integer addition is order-free, so the result is identical for
+        any scheduling of the same work."""
+        with self._register_lock:
+            buckets = list(self._buckets)
+        out: dict[int, OpStats] = {}
+        for d in buckets:
+            for key, s in d.items():
+                agg = out.get(key)
+                if agg is None:
+                    out[key] = agg = OpStats()
+                agg.merge(s)
+        return out
+
+    def totals(self) -> OpStats:
+        """Whole-query roll-up of the prune counters (stat_statements
+        attribution); rows/time roll-ups are per-operator, not summed."""
+        t = OpStats()
+        for s in self.merged().values():
+            t.morsels_scheduled += s.morsels_scheduled
+            t.morsels_pruned += s.morsels_pruned
+            t.morsels_jf_pruned += s.morsels_jf_pruned
+            t.device_ns += s.device_ns
+        return t
+
+
+def _ms(ns: int) -> str:
+    return f"{ns / 1e6:.3f}"
+
+
+def annotate_plan(plan, profile: QueryProfile) -> list[str]:
+    """EXPLAIN ANALYZE rendering: the plan tree with PG-style
+    `(actual time=first..total rows=N loops=L)` suffixes plus prune /
+    device detail lines. Nodes the executor fused away (device offload)
+    render `(never executed)` like PG's unvisited branches."""
+    merged = profile.merged()
+
+    def walk(node, depth: int) -> list[str]:
+        pad = "  " * depth
+        s = merged.get(id(node))
+        if s is None:
+            lines = [f"{pad}{node.label()} (never executed)"]
+        else:
+            first = s.first_ns if s.first_ns is not None else s.wall_ns
+            lines = [f"{pad}{node.label()} "
+                     f"(actual time={_ms(first)}..{_ms(s.wall_ns)} "
+                     f"rows={s.rows_out} loops={max(s.loops, 1)})"]
+            detail = pad + "  "
+            if s.morsels_scheduled or s.morsels_pruned:
+                jf = (f" join_filter_pruned={s.morsels_jf_pruned}"
+                      if s.morsels_jf_pruned else "")
+                lines.append(
+                    f"{detail}Morsels: scheduled={s.morsels_scheduled} "
+                    f"zonemap_pruned={s.morsels_pruned}{jf}")
+            if s.device_ns:
+                lines.append(f"{detail}Device: time={_ms(s.device_ns)} ms")
+        for c in node.children():
+            lines.extend(walk(c, depth + 1))
+        return lines
+
+    return walk(plan, 0)

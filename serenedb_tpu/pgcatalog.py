@@ -740,7 +740,7 @@ def _pg_stat_activity(db) -> MemTable:
         ("usename", dt.VARCHAR), ("application_name", dt.VARCHAR),
         ("client_addr", dt.VARCHAR), ("backend_start", dt.VARCHAR),
         ("query_start", dt.VARCHAR), ("state", dt.VARCHAR),
-        ("query", dt.VARCHAR)], {
+        ("query_id", dt.BIGINT), ("query", dt.VARCHAR)], {
         "datid": [1] * len(sess), "datname": ["serene"] * len(sess),
         "pid": [v["pid"] for v in sess],
         "usename": [v["usename"] for v in sess],
@@ -749,6 +749,10 @@ def _pg_stat_activity(db) -> MemTable:
         "backend_start": [ts(v["backend_start"]) for v in sess],
         "query_start": [ts(v["query_start"]) for v in sess],
         "state": [v["state"] for v in sess],
+        # normalized-statement fingerprint of the session's last
+        # completed statement (sdb_stat_statements key), NULL before
+        # any profiled execution
+        "query_id": [v.get("query_id") for v in sess],
         "query": [v["query"] for v in sess]})
 
 
@@ -1285,7 +1289,33 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return metrics_table()
     if name == "sdb_log":
         return log_table()
+    if name == "sdb_stat_statements":
+        return stat_statements_table()
     return None
+
+
+def stat_statements_table() -> TableProvider:
+    """sdb_stat_statements: cumulative stats per normalized statement
+    fingerprint (obs/statements.py), PG pg_stat_statements column
+    shapes where they map. LRU-capped by serene_stat_statements_max."""
+    from .obs.statements import STATEMENTS
+    rows = STATEMENTS.snapshot()
+    return _typed("sdb_stat_statements", [
+        ("queryid", dt.BIGINT), ("query", dt.VARCHAR),
+        ("calls", dt.BIGINT), ("total_time_ms", dt.DOUBLE),
+        ("mean_time_ms", dt.DOUBLE), ("min_time_ms", dt.DOUBLE),
+        ("max_time_ms", dt.DOUBLE), ("rows", dt.BIGINT),
+        ("morsels_pruned", dt.BIGINT)], {
+        "queryid": [e["queryid"] for e in rows],
+        "query": [e["query"] for e in rows],
+        "calls": [e["calls"] for e in rows],
+        "total_time_ms": [round(e["total_ms"], 6) for e in rows],
+        "mean_time_ms": [round(e["total_ms"] / e["calls"], 6)
+                         for e in rows],
+        "min_time_ms": [round(e["min_ms"], 6) for e in rows],
+        "max_time_ms": [round(e["max_ms"], 6) for e in rows],
+        "rows": [e["rows"] for e in rows],
+        "morsels_pruned": [e["morsels_pruned"] for e in rows]})
 
 
 def metrics_table() -> TableProvider:

@@ -84,6 +84,15 @@ class Handler(BaseHTTPRequestHandler):
         if p[0] == "_cluster" and len(p) > 1 and p[1] == "health":
             self._send(200, es.cluster_health())
             return
+        if p == ["metrics"] and method == "GET":
+            # Prometheus exposition: the whole gauge registry (one
+            # consistent snapshot) + per-statement series (obs/export).
+            # Exactly /metrics — deeper paths (/metrics/_doc/1) still
+            # reach the ES API for an index of that name.
+            from ..obs.export import prometheus_text
+            self._send(200, prometheus_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return
         if p[0] == "_cat" and len(p) > 1:
             if p[1] == "indices":
                 rows = es.cat_indices()
@@ -133,7 +142,13 @@ class Handler(BaseHTTPRequestHandler):
                     body.get("scroll")))
             return
         if p[0] == "_stats":
-            self._send(200, es.stats())
+            # ES index stats, extended with the engine's observability
+            # section (gauge snapshot + sdb_stat_statements) — ES
+            # clients read _all/indices and ignore the extra keys
+            from ..obs.export import stats_json
+            payload = es.stats()
+            payload.update(stats_json())
+            self._send(200, payload)
             return
         if p[0] == "_mget" and method == "POST":
             body = self._json_body() or {}

@@ -1533,6 +1533,16 @@ class Parser:
         if t.kind is T.NUMBER:
             self.next()
             return ast.SetStmt(name, float(t.value) if "." in t.value else int(t.value))
+        if t.kind is T.OP and t.value == "-":
+            # negative numeric value (PG: SET log_min_duration... = -1)
+            self.next()
+            t2 = self.peek()
+            if t2.kind is T.NUMBER:
+                self.next()
+                return ast.SetStmt(
+                    name, -float(t2.value) if "." in t2.value
+                    else -int(t2.value))
+            raise errors.syntax("bad SET value")
         if t.kind is T.IDENT:
             self.next()
             v = t.value

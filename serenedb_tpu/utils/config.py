@@ -183,6 +183,22 @@ declare("serene_join_filter", True, bool,
         "morsels whose block statistics prove no key can match are "
         "never enqueued; requires serene_zonemap, results are "
         "identical on or off")
+declare("serene_profile", True, bool,
+        "per-operator query profiling (obs/trace.py): every statement "
+        "collects rows/time/morsel-prune spans per plan operator, feeds "
+        "sdb_stat_statements, the slow-query log and pg_stat_activity "
+        "query ids; results are bit-identical on or off (<3% overhead "
+        "budget, profile_overhead bench shape)")
+declare("serene_log_min_duration_ms", -1, int,
+        "log statements running at least this many ms to the "
+        "slow_query topic (profiled plan tree included when available); "
+        "0 logs everything, -1 disables (PG log_min_duration_statement); "
+        "requires serene_profile = on, like all of the obs subsystem")
+declare("serene_stat_statements_max", 1000, int,
+        "cap on distinct normalized statements tracked by "
+        "sdb_stat_statements; least-recently-executed entries evict "
+        "past the cap", scope=Scope.GLOBAL,
+        validator=lambda v: max(1, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

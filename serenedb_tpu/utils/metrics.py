@@ -9,7 +9,9 @@ boundaries.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
+from typing import Optional
 
 
 class Gauge:
@@ -27,6 +29,22 @@ class Gauge:
 
     def sub(self, n: int = 1) -> None:
         self.add(-n)
+
+    def add_time_ns(self, start_ns: int,
+                    now_ns: Optional[int] = None) -> int:
+        """Accumulate one elapsed interval atomically: adds
+        (now - start_ns) nanoseconds in a single locked update and
+        returns `now`, so call sites chain consecutive intervals off one
+        clock read instead of re-reading between add and next start."""
+        if now_ns is None:
+            now_ns = time.perf_counter_ns()
+        self.add(now_ns - start_ns)
+        return now_ns
+
+    def delta(self, baseline: int) -> int:
+        """Current value minus a snapshot baseline (one atomic read) —
+        the scrape-side pairing of Registry.snapshot()."""
+        return self.value - baseline
 
     @property
     def value(self) -> int:
@@ -54,6 +72,14 @@ class Registry:
 
     def all(self) -> list[Gauge]:
         return [self._gauges[k] for k in sorted(self._gauges)]
+
+    def snapshot(self) -> dict[str, int]:
+        """One point-in-time {name: value} map for scrapes and tests:
+        every gauge is read exactly once (each read atomic under its own
+        lock), so a consumer iterating the result never races the
+        per-gauge locks mid-scrape or sees a gauge twice at two
+        values."""
+        return {g.name: g.value for g in self.all()}
 
 
 REGISTRY = Registry()
@@ -96,3 +122,11 @@ ZONEMAP_STALE_REBUILDS = REGISTRY.gauge(
     "ZonemapStaleRebuilds",
     "zone-map column stats rebuilt from scratch after a non-append "
     "mutation invalidated the cached version")
+QUERIES_EXECUTED = REGISTRY.gauge(
+    "QueriesExecuted", "statements completed (success) since start")
+QUERY_TIME_NS = REGISTRY.gauge(
+    "QueryTimeNs", "cumulative ns spent executing completed statements")
+SLOW_QUERIES = REGISTRY.gauge(
+    "SlowQueries",
+    "statements that exceeded serene_log_min_duration_ms and were "
+    "written to the slow-query log")

@@ -45,6 +45,16 @@ if os.environ.get("SERENE_JOIN_FILTER"):
     _SDB_REG_JF.set_global("serene_join_filter",
                            os.environ["SERENE_JOIN_FILTER"])
 
+# scripts/verify_tier1.sh profiler parity leg: force serene_profile to
+# the given value ("on"/"off") for a whole run — the on pass proves the
+# span instrumentation observes without changing a single result bit,
+# the off pass that the engine runs clean with the collector absent.
+if os.environ.get("SERENE_PROFILE"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_PROF
+
+    _SDB_REG_PROF.set_global("serene_profile",
+                             os.environ["SERENE_PROFILE"])
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,342 @@
+"""Query observability (ISSUE 4): profiler parity, EXPLAIN ANALYZE,
+sdb_stat_statements, slow-query log, /metrics + /_stats exports."""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.obs.statements import STATEMENTS, fingerprint, normalize
+from serenedb_tpu.utils import log as sdb_log
+from serenedb_tpu.utils import metrics as sdb_metrics
+from serenedb_tpu.utils.config import REGISTRY as SETTINGS
+
+
+def _db_with_tables(n=8192):
+    """Clustered fact table + small build table: enough rows for the
+    morsel-parallel path at serene_morsel_rows=1024, ts clustered so
+    zone maps prune, build keys [0,100) so the join filter prunes."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE facts (ts BIGINT, k BIGINT, v BIGINT)")
+    rng = np.random.default_rng(7)
+    db.schemas["main"].tables["facts"].replace(Batch.from_pydict({
+        "ts": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "k": Column.from_numpy(
+            rng.integers(0, 100, n, dtype=np.int64)),
+        "v": Column.from_numpy(
+            rng.integers(0, 1000, n, dtype=np.int64))}))
+    c.execute("CREATE TABLE build (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["build"].replace(Batch.from_pydict({
+        "k": Column.from_numpy(np.arange(100, dtype=np.int64)),
+        "w": Column.from_numpy(np.arange(100, dtype=np.int64) * 10)}))
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_morsel_rows = 1024")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    return db, c
+
+
+AGG_Q = ("SELECT k, count(*), sum(v) FROM facts "
+         "WHERE ts < 2048 GROUP BY k ORDER BY k")
+JOIN_Q = ("SELECT count(*), sum(v + w) FROM facts "
+          "JOIN build ON facts.k = build.k WHERE facts.ts < 4096")
+
+
+# -- bit-identity: profiling observes, never steers -------------------------
+
+
+@pytest.mark.parametrize("query", [AGG_Q, JOIN_Q])
+def test_profile_on_off_workers_parity(query):
+    db, c = _db_with_tables()
+    results = {}
+    for prof in ("on", "off"):
+        for workers in (1, 4):
+            c.execute(f"SET serene_profile = {prof}")
+            c.execute(f"SET serene_workers = {workers}")
+            results[(prof, workers)] = c.execute(query).rows()
+    base = results[("on", 1)]
+    assert base  # non-trivial result
+    for key, rows in results.items():
+        assert rows == base, f"{key} diverged from (on, 1)"
+
+
+def test_explain_analyze_does_not_perturb():
+    db, c = _db_with_tables()
+    before = c.execute(AGG_Q).rows()
+    c.execute(f"EXPLAIN ANALYZE {AGG_Q}")
+    assert c.execute(AGG_Q).rows() == before
+
+
+# -- EXPLAIN ANALYZE --------------------------------------------------------
+
+
+def _plan_lines(c, sql):
+    return [r[0] for r in c.execute(sql).rows()]
+
+
+def _rows_of(lines, label_sub):
+    for ln in lines:
+        if label_sub in ln:
+            m = re.search(r"rows=(\d+)", ln)
+            assert m, f"no rows= on line: {ln}"
+            return int(m.group(1))
+    raise AssertionError(f"no line containing {label_sub!r} in {lines}")
+
+
+def test_explain_analyze_parallel_aggregate_exact_rows():
+    db, c = _db_with_tables()
+    c.execute("SET serene_workers = 4")
+    lines = _plan_lines(c, f"EXPLAIN ANALYZE {AGG_Q}")
+    # per-operator actual rows are exact at any worker count
+    assert _rows_of(lines, "Scan facts") == 2048
+    assert _rows_of(lines, "Aggregate") == 100
+    assert _rows_of(lines, "Sort") == 100
+    # per-operator timing fields present
+    assert all("actual time=" in ln for ln in lines
+               if ln.strip().startswith(("Scan", "Aggregate", "Sort")))
+    # zone maps pruned the ts >= 2048 blocks: 2 of 8 scheduled
+    morsels = next(ln for ln in lines if "Morsels:" in ln)
+    assert "scheduled=2" in morsels and "zonemap_pruned=6" in morsels
+    assert any(ln.startswith("Execution Time:") for ln in lines)
+
+
+def test_explain_analyze_join_shows_join_filter_pruning():
+    db, c = _db_with_tables()
+    # probe keys clustered on ts? no — the JOIN FILTER prunes on k's
+    # build range [0,100): make the probe key the clustered ts column so
+    # only the first block can hold partners
+    lines = _plan_lines(
+        c, "EXPLAIN ANALYZE SELECT count(*) FROM facts "
+           "JOIN build ON facts.ts = build.k")
+    assert _rows_of(lines, "HashJoin") == 100
+    scan_i = next(i for i, ln in enumerate(lines) if "Scan facts" in ln)
+    # the surviving probe block scans whole (range conjuncts prune
+    # blocks, never filter rows): exactly one 1024-row morsel
+    assert _rows_of(lines, "Scan facts") == 1024
+    morsels = lines[scan_i + 1]
+    assert "Morsels:" in morsels
+    assert "join_filter_pruned=7" in morsels and "scheduled=1" in morsels
+
+
+def test_explain_analyze_ignores_profile_setting():
+    db, c = _db_with_tables()
+    c.execute("SET serene_profile = off")
+    lines = _plan_lines(c, "EXPLAIN ANALYZE SELECT count(*) FROM facts")
+    assert any("actual time=" in ln for ln in lines)
+
+
+def test_explain_plain_unchanged():
+    db, c = _db_with_tables()
+    lines = _plan_lines(c, f"EXPLAIN {AGG_Q}")
+    assert not any("actual time=" in ln for ln in lines)
+
+
+# -- EXPLAIN of DML ---------------------------------------------------------
+
+
+def test_explain_dml_plain_and_analyze():
+    db, c = _db_with_tables()
+    lines = _plan_lines(c, "EXPLAIN INSERT INTO build VALUES (500, 0)")
+    assert lines[0] == "Insert on build"
+    assert any("Values (1 rows)" in ln for ln in lines)
+
+    lines = _plan_lines(
+        c, "EXPLAIN INSERT INTO build SELECT k + 1000, w FROM build")
+    assert lines[0] == "Insert on build"
+    assert any("Scan build" in ln for ln in lines)
+
+    before = c.execute("SELECT count(*) FROM build").scalar()
+    lines = _plan_lines(
+        c, "EXPLAIN ANALYZE INSERT INTO build VALUES (600, 0), (601, 0)")
+    assert "Insert on build" in lines[0]
+    assert "rows=2" in lines[0] and "actual time=" in lines[0]
+    # ANALYZE really executes the DML (PG semantics)
+    assert c.execute("SELECT count(*) FROM build").scalar() == before + 2
+
+    lines = _plan_lines(
+        c, "EXPLAIN ANALYZE UPDATE build SET w = 1 WHERE k >= 600")
+    assert "Update on build" in lines[0] and "rows=2" in lines[0]
+    lines = _plan_lines(
+        c, "EXPLAIN ANALYZE DELETE FROM build WHERE k >= 500")
+    assert "Delete on build" in lines[0] and "rows=2" in lines[0]
+    assert c.execute("SELECT count(*) FROM build").scalar() == before
+
+
+# -- statement fingerprints / sdb_stat_statements ---------------------------
+
+
+def test_normalize_collapses_literals_params_case_whitespace():
+    a = normalize("SELECT * FROM t WHERE x = 5 AND s = 'abc'")
+    b = normalize("select *\n  from T\twhere X=$1 and S = 'zzz';")
+    assert a == b == "select * from t where x = ? and s = ?"
+    assert fingerprint(a) == fingerprint(b)
+    assert normalize("SELECT 1") != normalize("SELECT 1, 2")
+
+
+def test_stat_statements_aggregation_and_view():
+    db, c = _db_with_tables()
+    STATEMENTS.reset()
+    c.execute("SELECT sum(v) FROM facts WHERE ts < 10")
+    c.execute("SELECT sum(v) FROM facts WHERE ts < 999")
+    rows = c.execute(
+        "SELECT query, calls, rows, total_time_ms, mean_time_ms "
+        "FROM sdb_stat_statements WHERE query LIKE '%sum%'").rows()
+    assert len(rows) == 1                     # literals collapsed → one entry
+    q, calls, nrows, total, mean = rows[0]
+    assert calls == 2 and nrows == 2
+    assert q == "select sum ( v ) from facts where ts < ?"
+    # view columns round to 6 decimals: mean ≈ total/2 within rounding
+    assert total > 0 and abs(mean - total / 2) < 1e-5
+
+
+def test_stat_statements_morsels_pruned_attribution():
+    db, c = _db_with_tables()
+    STATEMENTS.reset()
+    c.execute(AGG_Q)
+    row = c.execute(
+        "SELECT morsels_pruned FROM sdb_stat_statements "
+        "WHERE query LIKE '%group by%'").rows()
+    assert row and row[0][0] == 6
+
+
+def test_stat_statements_lru_eviction_at_cap():
+    db, c = _db_with_tables()
+    STATEMENTS.reset()
+    old = SETTINGS.get_global("serene_stat_statements_max")
+    SETTINGS.set_global("serene_stat_statements_max", 3)
+    try:
+        for i in range(6):
+            c.execute(f"SELECT {i} AS c{i}")   # distinct fingerprints
+        assert len(STATEMENTS) <= 3
+        queries = [e["query"] for e in STATEMENTS.snapshot()]
+        assert "select ? as c5" in queries     # most recent survives
+        assert "select ? as c0" not in queries  # oldest evicted
+    finally:
+        SETTINGS.set_global("serene_stat_statements_max", old)
+        STATEMENTS.reset()
+
+
+def test_profile_off_records_nothing():
+    db, c = _db_with_tables()
+    c.execute("SET serene_profile = off")
+    STATEMENTS.reset()
+    c.execute("SELECT 42")
+    assert len(STATEMENTS) == 0
+
+
+# -- slow-query log ---------------------------------------------------------
+
+
+def _slow_records():
+    return [r for r in sdb_log.MANAGER.records() if r.topic == "slow_query"]
+
+
+def test_slow_query_log_threshold():
+    db, c = _db_with_tables()
+    c.execute("SET serene_log_min_duration_ms = 100000")
+    n0 = len(_slow_records())
+    c.execute("SELECT count(*) FROM facts")
+    assert len(_slow_records()) == n0          # under threshold: silent
+    c.execute("SET serene_log_min_duration_ms = 0")
+    c.execute(AGG_Q)
+    recs = _slow_records()
+    assert len(recs) > n0
+    # the profiled tree rides along in the message
+    assert "Scan facts" in recs[-1].message
+    assert "actual time=" in recs[-1].message
+    c.execute("SET serene_log_min_duration_ms = -1")   # default: disabled
+    n1 = len(_slow_records())
+    c.execute("SELECT count(*) FROM facts")
+    assert len(_slow_records()) == n1
+
+
+# -- pg_stat_activity -------------------------------------------------------
+
+
+def test_pg_stat_activity_live_query_and_id():
+    db, c = _db_with_tables()
+    c.execute("SELECT count(*) FROM facts")
+    rows = c.execute(
+        "SELECT pid, state, query_id, query FROM pg_stat_activity").rows()
+    me = [r for r in rows if "pg_stat_activity" in r[3]]
+    assert me and me[0][1] == "active"
+    # query_id is the previous completed statement's fingerprint
+    assert me[0][2] == fingerprint(
+        normalize("SELECT count(*) FROM facts"))
+
+
+# -- gauge helpers ----------------------------------------------------------
+
+
+def test_gauge_add_time_ns_and_registry_snapshot():
+    g = sdb_metrics.Gauge("TestTimer")
+    import time
+    t0 = time.perf_counter_ns()
+    now = g.add_time_ns(t0)
+    assert now >= t0 and g.value == now - t0
+    base = g.value
+    g.add_time_ns(now, now + 500)
+    assert g.delta(base) == 500
+
+    snap = sdb_metrics.REGISTRY.snapshot()
+    assert isinstance(snap, dict) and "QueriesActive" in snap
+    assert set(snap) == {x.name for x in sdb_metrics.REGISTRY.all()}
+    assert all(isinstance(v, int) for v in snap.values())
+
+
+# -- HTTP exports -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from serenedb_tpu.server.http_server import HttpServer
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE m (x INT)")
+    c.execute("INSERT INTO m VALUES (1), (2), (3)")
+    c.execute("SELECT count(*) FROM m")
+    s = HttpServer(db, port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?\d+(\.\d+)?([eE][+-]?\d+)?$")
+
+
+def test_metrics_endpoint_parses_as_prometheus(srv):
+    # ensure at least one recorded statement regardless of test order
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/_sql",
+        data=json.dumps({"query": "SELECT count(*) FROM m"}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req, timeout=30).read()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+    lines = [ln for ln in body.splitlines() if ln]
+    assert lines
+    for ln in lines:
+        if ln.startswith("#"):
+            assert ln.startswith(("# HELP ", "# TYPE "))
+        else:
+            assert _PROM_LINE.match(ln), f"bad prometheus line: {ln}"
+    assert any(ln.startswith("serenedb_queries_executed") for ln in lines)
+    assert any(ln.startswith("serenedb_statement_calls{") for ln in lines)
+
+
+def test_stats_endpoint_exports_metrics_and_statements(srv):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/_stats", timeout=30) as r:
+        payload = json.loads(r.read().decode())
+    # ES sections intact, observability sections added
+    assert "_all" in payload and "indices" in payload
+    assert payload["metrics"]["QueriesActive"] >= 0
+    assert isinstance(payload["statements"], list)
