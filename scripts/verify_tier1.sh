@@ -48,7 +48,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_PROFILE=on \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc4=$?
 
+# Pass 5 is the result-cache parity leg: both cache tiers are forced ON
+# (the conftest env hook arms the serene_result_cache global) over the
+# cache suite plus the morsel/join parity suites — repeat statements
+# serve from cache in those suites, so a single stale or perturbed bit
+# fails the parity assertions loudly.
+echo "== result-cache parity pass (serene_result_cache=on) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_RESULT_CACHE=on \
+    python -m pytest tests/test_result_cache.py tests/test_parallel_exec.py \
+    tests/test_join_exec.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc5=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
-exit "$rc4"
+[ "$rc4" -ne 0 ] && exit "$rc4"
+exit "$rc5"

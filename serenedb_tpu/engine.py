@@ -587,6 +587,9 @@ class Database(TableResolver):
         if name == "sdb_stat_statements":
             from .pgcatalog import stat_statements_table
             return stat_statements_table()
+        if name == "sdb_cache":
+            from .pgcatalog import cache_table
+            return cache_table()
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 
@@ -722,6 +725,24 @@ class Database(TableResolver):
                     out.append((sname, v, "view"))
             return sorted(out)
 
+    def catalog_key_of(self, provider) -> Optional[str]:
+        """schema.table key when this provider is a user table currently
+        registered in the catalog: StoredTable `key` fast path (verified
+        against the live catalog — a dropped/replaced table must not
+        resolve), else an identity scan. Shared by the transaction
+        machinery (Connection._txn_key_of) and the result cache
+        (cache/result.py) so provider identity can never diverge
+        between them."""
+        key = getattr(provider, "key", None)      # StoredTable fast path
+        with self.lock:
+            if key is not None and self._table_by_key(key) is provider:
+                return key
+            for sname, sch in self.schemas.items():
+                for tname, t in sch.tables.items():
+                    if t is provider:
+                        return f"{sname}.{tname}"
+        return None
+
     def oid_of(self, kind: str, schema: str, name: str) -> int:
         """Stable per-process OID for a catalog object (lazily assigned).
         kind ∈ {schema, table, view, index, sequence}."""
@@ -851,6 +872,13 @@ class Connection:
         #: authenticated identity — SET ROLE can never escalate beyond it
         self.session_role = (role or SUPERUSER).lower()
         self.current_role = self.session_role
+        #: set by the result cache when the CURRENT statement was served
+        #: without executing (cache/result.py); read by the statement-end
+        #: observability hook for sdb_stat_statements cache_hits
+        self._cache_hit = False
+        #: set by _plan when view inlining ran: view identity is not in
+        #: the result-cache key, so such statements never cache
+        self._plan_inlined_views = False
         #: last executed plan + its span profile (serene_profile on):
         #: read by the statement-end observability hook for the
         #: slow-query log's annotated tree. Best effort — a suspended
@@ -907,16 +935,45 @@ class Connection:
         params = params or []
         import time as _time
         self.stmt_now_us = int(_time.time() * 1e6)  # now() stability
+        from .cache.result import RESULT_CACHE
+        self._cache_hit = False
+        probe = RESULT_CACHE.begin(self, st, params, sql_text)
         token = CURRENT_CONNECTION.set(self)
         try:
-            plan = self._plan(st, params)   # binding enforces ACLs here
+            hit = probe.fast_lookup() if probe is not None else None
+            if hit is None:
+                plan = self._plan(st, params)  # binding enforces ACLs here
+                if probe is not None:
+                    probe.prepare(plan)
+                    hit = probe.lookup()
         finally:
             CURRENT_CONNECTION.reset(token)
+        if hit is not None:
+            def run_hit(b=hit):
+                t0 = time.perf_counter_ns()
+                with self._session_scope(sql_text if sql_text is not None
+                                         else "SELECT"):
+                    yield b
+                    # re-pin the hit flag at drain time: a statement
+                    # interleaved with this suspended portal may have
+                    # overwritten the connection-level attribution
+                    self._cache_hit = True
+                    self._obs_record(sql_text, t0, b.num_rows, None, None)
+            return (hit.names, [c.type for c in hit.columns], run_hit())
         ctx = self._exec_ctx(params)
+        # a cacheable streaming statement accumulates its batches for a
+        # post-drain store — bounded: accumulation stops past the cache
+        # byte cap, exactly the point where the store would refuse it
+        store_cap = (int(self.settings._registry.get_global(
+            "serene_result_cache_mb")) << 20) \
+            if probe is not None and probe.cacheable else -1
 
         def run():
+            from .cache.result import _batch_nbytes
             t0 = time.perf_counter_ns()
             nrows = 0
+            acc: Optional[list] = [] if store_cap >= 0 else None
+            acc_bytes = 0
             with self._session_scope(sql_text if sql_text is not None
                                      else "SELECT"):
                 it = plan.batches(ctx)
@@ -928,12 +985,28 @@ class Connection:
                     try:
                         b = next(it)
                     except StopIteration:
+                        if acc is not None:
+                            out = concat_batches(acc) if acc else \
+                                Batch(list(plan.names),
+                                      [Column.from_pylist([], t)
+                                       for t in plan.types])
+                            probe.store(out)
+                        # this generator IS the miss path — re-pin the
+                        # flag in case an interleaved statement on this
+                        # connection flipped it while we were suspended
+                        self._cache_hit = False
                         self._obs_record(sql_text, t0, nrows,
                                          ctx.profile, plan)
                         return
                     finally:
                         CURRENT_CONNECTION.reset(tok)
                     nrows += b.num_rows
+                    if acc is not None:
+                        acc_bytes += _batch_nbytes(b)
+                        if acc_bytes > store_cap:
+                            acc = None
+                        else:
+                            acc.append(b)
                     yield b
 
         return plan.names, plan.types, run()
@@ -1003,6 +1076,7 @@ class Connection:
                                      else type(st).__name__):
                 self._active_profile = None
                 self._active_plan = None
+                self._cache_hit = False
                 t0 = time.perf_counter_ns()
                 res = self._dispatch(st, params, sql_text)
                 self._obs_record(sql_text, t0, _result_rows(res),
@@ -1081,7 +1155,7 @@ class Connection:
                     errors.INSUFFICIENT_PRIVILEGE,
                     f"must be superuser to run {type(st).__name__}")
         if isinstance(st, (ast.Select, ast.SetOp)):
-            batch = self._run_select(st, params)
+            batch = self._run_select(st, params, sql_text=sql_text)
             return QueryResult(batch, f"SELECT {batch.num_rows}")
         if isinstance(st, ast.CreateTable):
             return self._create_table(st, params)
@@ -1373,7 +1447,7 @@ class Connection:
         if isinstance(st, ast.Transaction):
             return self._txn(st)
         if isinstance(st, ast.Explain):
-            return self._explain(st, params)
+            return self._explain(st, params, sql_text)
         if isinstance(st, ast.VacuumStmt):
             return self._vacuum(st)
         if isinstance(st, ast.CopyStmt):
@@ -1385,10 +1459,12 @@ class Connection:
     def _plan(self, sel: ast.Select, params: list) -> PlanNode:
         from .sql.search_rewrite import rewrite_search
         planner = Planner(_ResolverShim(self.db, params, self), params)
+        self._plan_inlined_views = False
         while True:
             try:
                 return rewrite_search(planner.plan_select(sel))
             except _ViewRef as vr:
+                self._plan_inlined_views = True
                 sel = _inline_view(sel, vr.view)
 
     def _profile_enabled(self) -> bool:
@@ -1408,12 +1484,30 @@ class Connection:
             self._active_profile = ctx.profile
         return ctx
 
-    def _run_select(self, sel: ast.Select, params: list) -> Batch:
+    def _run_select(self, sel: ast.Select, params: list,
+                    sql_text: Optional[str] = None) -> Batch:
+        from .cache.result import RESULT_CACHE
+        probe = RESULT_CACHE.begin(self, sel, params, sql_text)
+        if probe is not None:
+            # plan-skipping fast path: the statement's table set was
+            # learned at an earlier store — resolve, re-check ACLs,
+            # observe publications, serve
+            hit = probe.fast_lookup()
+            if hit is not None:
+                return hit
         plan = self._plan(sel, params)
         ctx = self._exec_ctx(params)
         if ctx.profile is not None:
             self._active_plan = plan
-        return plan.execute(ctx)
+        if probe is not None:
+            probe.prepare(plan)
+            hit = probe.lookup()
+            if hit is not None:
+                return hit
+        batch = plan.execute(ctx)
+        if probe is not None:
+            probe.store(batch)
+        return batch
 
     def _obs_record(self, sql_text: Optional[str], t0_ns: int, rows: int,
                     profile, plan) -> None:
@@ -1435,7 +1529,9 @@ class Connection:
             from .obs.statements import STATEMENTS
             cap = int(self.settings.get("serene_stat_statements_max"))
             qid = STATEMENTS.record(sql_text, elapsed_ns, rows, pruned,
-                                    cap)
+                                    cap,
+                                    cache_hit=getattr(self, "_cache_hit",
+                                                      False))
             sess = self.db.sessions.get(self._session_id)
             if sess is not None:
                 sess["query_id"] = qid
@@ -1675,16 +1771,10 @@ class Connection:
 
     def _txn_key_of(self, provider) -> Optional[str]:
         """schema.table key when this provider is a user table (system
-        tables and table functions are rebuilt per query — never pinned).
-        Must be called under db.lock."""
-        key = getattr(provider, "key", None)      # StoredTable fast path
-        if key is not None and self.db._table_by_key(key) is provider:
-            return key
-        for sname, sch in self.db.schemas.items():
-            for tname, t in sch.tables.items():
-                if t is provider:
-                    return f"{sname}.{tname}"
-        return None
+        tables and table functions are rebuilt per query — never
+        pinned). Delegates to the shared catalog resolution (db.lock is
+        an RLock, so callers already holding it nest safely)."""
+        return self.db.catalog_key_of(provider)
 
     @staticmethod
     def _txn_copy(provider, batch, share_indexes: bool = False) -> MemTable:
@@ -2324,21 +2414,38 @@ class Connection:
         self.txn_failed = False
         return QueryResult(Batch([], []), "ROLLBACK")
 
-    def _explain(self, st: ast.Explain, params: list) -> QueryResult:
+    def _explain(self, st: ast.Explain, params: list,
+                 sql_text: Optional[str] = None) -> QueryResult:
         if isinstance(st.inner, (ast.Select, ast.SetOp)):
             plan = self._plan(st.inner, params)
             if not st.analyze:
                 lines = plan.explain()
             else:
                 # ANALYZE always instruments (PG semantics), independent
-                # of the serene_profile session setting
+                # of the serene_profile session setting. It also always
+                # EXECUTES — the result cache is only consulted for the
+                # `Result Cache:` report line (would this statement have
+                # been served?) and fed by the instrumented run, so
+                # EXPLAIN ANALYZE output is never a stale replay.
+                from .cache.result import RESULT_CACHE
                 from .obs.trace import QueryProfile, annotate_plan
+                probe = RESULT_CACHE.begin(self, st.inner, params,
+                                           sql_text)
+                cache_line = None
+                if probe is not None:
+                    probe.prepare(plan)
+                    if probe.cacheable:
+                        cache_line = ("Result Cache: hit" if probe.peek()
+                                      else "Result Cache: miss")
                 prof = QueryProfile()
                 t0 = time.perf_counter()
                 result = plan.execute(
                     ExecContext(self.settings, params, profile=prof))
                 elapsed = (time.perf_counter() - t0) * 1000
-                lines = annotate_plan(plan, prof) + [
+                if cache_line == "Result Cache: miss":
+                    probe.store(result)
+                lines = annotate_plan(plan, prof) + \
+                    ([cache_line] if cache_line else []) + [
                     f"Execution Time: {elapsed:.3f} ms",
                     f"Rows Returned: {result.num_rows}",
                 ]

@@ -41,18 +41,24 @@ def prometheus_text() -> str:
     if stmts:
         for series, key in (("statement_calls", "calls"),
                             ("statement_total_ms", "total_ms"),
-                            ("statement_rows", "rows")):
+                            ("statement_rows", "rows"),
+                            ("statement_cache_hits", "cache_hits")):
             pname = f"serenedb_{series}"
             lines.append(f"# TYPE {pname} counter")
             for e in stmts:
                 q = _label_escape(e["query"][:200])
                 lines.append(
                     f'{pname}{{queryid="{e["queryid"]}",query="{q}"}} '
-                    f"{e[key]}")
+                    f"{e.get(key, 0)}")
     return "\n".join(lines) + "\n"
 
 
 def stats_json() -> dict:
-    """Gauge snapshot + statement stats for the JSON `/_stats` route."""
+    """Gauge snapshot + statement stats + cache tier summaries for the
+    JSON `/_stats` route."""
+    from ..cache.fragments import FRAGMENTS
+    from ..cache.result import RESULT_CACHE
     return {"metrics": _metrics.REGISTRY.snapshot(),
-            "statements": STATEMENTS.snapshot()}
+            "statements": STATEMENTS.snapshot(),
+            "cache": {"result": RESULT_CACHE.stats(),
+                      "fragments": FRAGMENTS.stats()}}

@@ -64,7 +64,8 @@ class StatementStore:
         self._entries: OrderedDict[int, dict] = OrderedDict()
 
     def record(self, query_text: str, elapsed_ns: int, rows: int,
-               morsels_pruned: int, cap: int) -> int:
+               morsels_pruned: int, cap: int,
+               cache_hit: bool = False) -> int:
         norm = normalize(query_text)
         qid = fingerprint(norm)
         ms = elapsed_ns / 1e6
@@ -77,7 +78,8 @@ class StatementStore:
                     "queryid": qid, "query": norm, "calls": 1,
                     "total_ms": ms, "min_ms": ms, "max_ms": ms,
                     "rows": int(rows),
-                    "morsels_pruned": int(morsels_pruned)}
+                    "morsels_pruned": int(morsels_pruned),
+                    "cache_hits": int(bool(cache_hit))}
             else:
                 self._entries.move_to_end(qid)
                 e["calls"] += 1
@@ -86,6 +88,10 @@ class StatementStore:
                 e["max_ms"] = max(e["max_ms"], ms)
                 e["rows"] += int(rows)
                 e["morsels_pruned"] += int(morsels_pruned)
+                # entries recorded before the cache subsystem existed in
+                # this process lifetime may lack the key
+                e["cache_hits"] = e.get("cache_hits", 0) + \
+                    int(bool(cache_hit))
         return qid
 
     def snapshot(self) -> list[dict]:

@@ -1291,7 +1291,30 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return log_table()
     if name == "sdb_stat_statements":
         return stat_statements_table()
+    if name == "sdb_cache":
+        return cache_table()
     return None
+
+
+def cache_table() -> TableProvider:
+    """sdb_cache: one row per live cache entry across both tiers —
+    result entries carry their normalized query text and source tables,
+    fragment entries their segment + shape digest."""
+    from .cache.fragments import FRAGMENTS
+    from .cache.result import RESULT_CACHE
+    rows = RESULT_CACHE.snapshot() + FRAGMENTS.snapshot()
+    return _typed("sdb_cache", [
+        ("tier", dt.VARCHAR), ("key", dt.VARCHAR), ("query", dt.VARCHAR),
+        ("queryid", dt.BIGINT), ("bytes", dt.BIGINT), ("hits", dt.BIGINT),
+        ("rows", dt.BIGINT), ("objects", dt.VARCHAR)], {
+        "tier": [e["tier"] for e in rows],
+        "key": [e["key"] for e in rows],
+        "query": [e["query"] for e in rows],
+        "queryid": [e["queryid"] for e in rows],
+        "bytes": [e["bytes"] for e in rows],
+        "hits": [e["hits"] for e in rows],
+        "rows": [e["rows"] for e in rows],
+        "objects": [e["objects"] for e in rows]})
 
 
 def stat_statements_table() -> TableProvider:
@@ -1305,7 +1328,7 @@ def stat_statements_table() -> TableProvider:
         ("calls", dt.BIGINT), ("total_time_ms", dt.DOUBLE),
         ("mean_time_ms", dt.DOUBLE), ("min_time_ms", dt.DOUBLE),
         ("max_time_ms", dt.DOUBLE), ("rows", dt.BIGINT),
-        ("morsels_pruned", dt.BIGINT)], {
+        ("morsels_pruned", dt.BIGINT), ("cache_hits", dt.BIGINT)], {
         "queryid": [e["queryid"] for e in rows],
         "query": [e["query"] for e in rows],
         "calls": [e["calls"] for e in rows],
@@ -1315,7 +1338,8 @@ def stat_statements_table() -> TableProvider:
         "min_time_ms": [round(e["min_ms"], 6) for e in rows],
         "max_time_ms": [round(e["max_ms"], 6) for e in rows],
         "rows": [e["rows"] for e in rows],
-        "morsels_pruned": [e["morsels_pruned"] for e in rows]})
+        "morsels_pruned": [e["morsels_pruned"] for e in rows],
+        "cache_hits": [e.get("cache_hits", 0) for e in rows]})
 
 
 def metrics_table() -> TableProvider:

@@ -92,7 +92,20 @@ class SegmentSearcher:
     # -- filter evaluation (CPU doc-set algebra) --------------------------
 
     def eval_filter(self, node: QNode) -> np.ndarray:
-        """Sorted doc ids matching the query node."""
+        """Sorted doc ids matching the query node. Memoized in the
+        process-wide fragment cache (cache/fragments.py): segments are
+        immutable, so a filter doc set is valid for this object's whole
+        lifetime — the ES shard-request-cache analog. Recursive
+        sub-nodes memoize individually, so `a AND b` reuses a cached
+        `a`. Unknown node shapes and `serene_result_cache = off`
+        sessions compute straight through."""
+        from ..cache.fragments import FRAGMENTS, qnode_sig
+        sig = qnode_sig(node)
+        return FRAGMENTS.cached(
+            self, None if sig is None else ("filter", sig),
+            lambda: self._eval_filter_uncached(node))
+
+    def _eval_filter_uncached(self, node: QNode) -> np.ndarray:
         if isinstance(node, QTerm):
             tid = self.index.term_id(node.term)
             if tid < 0:
@@ -711,18 +724,38 @@ class MultiSearcher:
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
                    mesh_n: int = 0,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        from ..cache.fragments import FRAGMENTS, qnode_sig
+        sigs = tuple(qnode_sig(n) for n in nodes)
+        nsig = None if any(s is None for s in sigs) else sigs
         if len(self.segments) == 1:
             seg, base = self.segments[0]
-            out = seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n)
+            # single segment: local stats ARE the global stats — the
+            # fragment is a pure function of the segment alone
+            shape = None if nsig is None else ("topk1", nsig, k, scorer,
+                                               mesh_n)
+            out = FRAGMENTS.cached(
+                seg, shape,
+                lambda: seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n))
             return [(s, d.astype(np.int64) + base) for s, d in out]
         idf_factory = self._segment_idf_factory(nodes, scorer)
         avgdl = self.global_avgdl
+        # a segment's scored output depends on GLOBAL collection stats
+        # (idf/avgdl span every segment), which are a pure function of
+        # the segment SET — key the whole membership, so an append
+        # recomputes scores exactly as correctness requires while
+        # filter fragments (above) survive it
+        segset = tuple(FRAGMENTS.segment_uid(s) for s, _ in self.segments)
 
         def run_segment(seg_base):
             seg, _base = seg_base
-            return seg.topk_batch(nodes, k, scorer,
-                                  idf_of=idf_factory(seg),
-                                  avgdl_override=avgdl, mesh_n=mesh_n)
+            shape = None if nsig is None else ("topk", nsig, k, scorer,
+                                               mesh_n, segset)
+            return FRAGMENTS.cached(
+                seg, shape,
+                lambda: seg.topk_batch(nodes, k, scorer,
+                                       idf_of=idf_factory(seg),
+                                       avgdl_override=avgdl,
+                                       mesh_n=mesh_n))
 
         # segments are independent top-k collectors: search them on the
         # shared worker pool (reference: parallel scored collectors over
@@ -783,26 +816,36 @@ class MultiSearcher:
         directly; pure negations return zero-scored matches."""
         idf_factory = self._segment_idf_factory([node], scorer)
         avgdl = self.global_avgdl
+        from ..cache.fragments import FRAGMENTS, qnode_sig
+        sig = qnode_sig(node)
+        segset = tuple(FRAGMENTS.segment_uid(s) for s, _ in self.segments)
 
         def run_segment(seg_base):
             seg, _base = seg_base
-            idf_of = idf_factory(seg)
-            tids, req, needs_mask, empty = seg._query_shape(node)
-            if empty:
-                return (np.empty(0, dtype=np.float32),
-                        np.empty(0, dtype=np.int32))
-            if not tids:
-                match = seg.eval_filter(node)[:k]
-                return (np.zeros(len(match), dtype=np.float32),
-                        match.astype(np.int32))
-            if needs_mask:
-                match = seg.eval_filter(node)
-                sc, dd = seg._cpu_score(match, tids, k, scorer, idf_of,
-                                        avgdl)
-                keep = sc > 0.0
-                return (sc[keep][:k], dd[keep][:k])
-            return seg.cpu_topk_wand(tids, k, scorer, idf_of=idf_of,
-                                     avgdl_override=avgdl, require_all=req)
+
+            def compute():
+                idf_of = idf_factory(seg)
+                tids, req, needs_mask, empty = seg._query_shape(node)
+                if empty:
+                    return (np.empty(0, dtype=np.float32),
+                            np.empty(0, dtype=np.int32))
+                if not tids:
+                    match = seg.eval_filter(node)[:k]
+                    return (np.zeros(len(match), dtype=np.float32),
+                            match.astype(np.int32))
+                if needs_mask:
+                    match = seg.eval_filter(node)
+                    sc, dd = seg._cpu_score(match, tids, k, scorer,
+                                            idf_of, avgdl)
+                    keep = sc > 0.0
+                    return (sc[keep][:k], dd[keep][:k])
+                return seg.cpu_topk_wand(tids, k, scorer, idf_of=idf_of,
+                                         avgdl_override=avgdl,
+                                         require_all=req)
+
+            shape = None if sig is None else ("wand", sig, k, scorer,
+                                              segset)
+            return FRAGMENTS.cached(seg, shape, compute)
 
         from ..parallel.pool import get_pool, session_workers
         cap = session_workers(None)

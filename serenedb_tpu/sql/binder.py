@@ -729,13 +729,20 @@ class ExprBinder:
         return _fold_if_const(f)
 
 
-#: never constant-fold: each evaluation must run (PG volatility class)
-_VOLATILE_FUNCS = {"nextval", "setval", "random", "gen_random_uuid",
-                   "clock_timestamp", "uuid_generate_v4", "ai_embed"}
+from ..functions.volatility import (IMMUTABLE, VOLATILE,  # noqa: E402
+                                    VOLATILE_FUNCS, volatility)
+
+#: never constant-fold: each evaluation must run. Kept as a module
+#: attribute because exec/plan.py and exec/morsel.py key off membership;
+#: the classification itself lives in functions/volatility.py.
+_VOLATILE_FUNCS = VOLATILE_FUNCS
 
 
 def _fold_if_const(f: BoundFunc) -> BoundExpr:
-    if f.name in _VOLATILE_FUNCS:
+    # STABLE folds here on purpose: binding happens once per statement,
+    # so folding now() at bind time IS its statement-stability (PG
+    # evaluates stable functions once per statement too)
+    if volatility(f.name) is VOLATILE:
         return f
     if all(isinstance(a, BoundLiteral) for a in f.args):
         from ..columnar.column import Batch
@@ -765,17 +772,6 @@ _CMP_MIRROR = {"op=": "op=", "op<>": "op<>", "op!=": "op!=",
 _CMP_CANON = {"op=": "=", "op<>": "<>", "op!=": "<>", "op<": "<",
               "op<=": "<=", "op>": ">", "op>=": ">="}
 
-#: function names whose evaluation draws on shared mutable state or
-#: lazily-cached subplans — never safe to fold during analysis
-_UNFOLDABLE = _VOLATILE_FUNCS | {
-    "scalar_subquery", "array_subquery", "in_subquery", "exists",
-    "currval", "lastval", "nextval", "now", "statement_timestamp",
-    "current_timestamp", "transaction_timestamp",
-    # wall-clock reads without statement pinning: folding one at
-    # analysis time could disagree with the per-row evaluation (a scan
-    # crossing midnight must not prune blocks with the stale day)
-    "current_date", "age", "timeofday", "localtimestamp", "current_time"}
-
 _NOT_CONST = object()
 
 
@@ -788,7 +784,11 @@ def fold_constant(e: BoundExpr):
     for sub in e.walk():
         if isinstance(sub, (BoundColumn, BoundAggRef)):
             return _NOT_CONST
-        if isinstance(sub, BoundFunc) and sub.name in _UNFOLDABLE:
+        # only IMMUTABLE folds during analysis: a STABLE value folded
+        # here could disagree with the per-row evaluation (wall-clock
+        # reads, subquery expressions over lazily-cached subplans)
+        if isinstance(sub, BoundFunc) and \
+                volatility(sub.name) is not IMMUTABLE:
             return _NOT_CONST
     from ..columnar.column import Batch
     try:

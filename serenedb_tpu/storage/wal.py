@@ -37,7 +37,11 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import numpy as np
-import zstandard
+
+try:
+    import zstandard
+except ImportError:          # optional codec: zlib fallback below
+    zstandard = None
 
 from .. import errors
 from ..columnar.arrow_io import batch_to_bytes, bytes_to_batch
@@ -108,11 +112,38 @@ def _encode_ops(ops: list[WalOp]) -> bytes:
         parts.append(struct.pack("<I", len(b)))
         parts.append(b)
     raw = b"".join(parts)
-    return zstandard.ZstdCompressor(level=1).compress(raw)
+    return _compress(raw)
+
+
+#: zstd frame magic — payloads self-describe their codec (zstd frames
+#: start with this magic, zlib streams with 0x78), so a zlib-written
+#: datadir always reads back under either install; zstd-written frames
+#: fail loudly (58030) on a zlib-only install instead of decoding as
+#: garbage
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+
+
+def _compress(raw: bytes) -> bytes:
+    """zstd-1 when the optional module is present, zlib-1 otherwise.
+    Both stamp a self-identifying header (zstd's frame magic vs zlib's
+    0x78), so decode never needs out-of-band codec metadata."""
+    if zstandard is not None:
+        return zstandard.ZstdCompressor(level=1).compress(raw)
+    return zlib.compress(raw, 1)
+
+
+def _decompress(payload: bytes) -> bytes:
+    if payload[:4] == _ZSTD_MAGIC:
+        if zstandard is None:
+            raise errors.SqlError(
+                "58030", "WAL payload is zstd-compressed but the "
+                "zstandard module is not installed")
+        return zstandard.ZstdDecompressor().decompress(payload)
+    return zlib.decompress(payload)
 
 
 def _decode_record(tick: int, payload: bytes) -> CommitRecord:
-    raw = zstandard.ZstdDecompressor().decompress(payload)
+    raw = _decompress(payload)
     off = 0
     (hlen,) = struct.unpack_from("<I", raw, off)
     off += 4

@@ -199,6 +199,25 @@ declare("serene_stat_statements_max", 1000, int,
         "sdb_stat_statements; least-recently-executed entries evict "
         "past the cap", scope=Scope.GLOBAL,
         validator=lambda v: max(1, int(v)))
+declare("serene_result_cache", True, bool,
+        "multi-tier query cache (cache/): tier 1 memoizes whole results "
+        "of read-only statements whose plans touch only immutable "
+        "expressions and catalog tables, keyed by (statement digest, "
+        "parameter values, result-affecting settings digest, per-table "
+        "publication tuples) — any write bumps a publication tuple, so "
+        "a stale entry can never be returned; tier 2 caches per-segment "
+        "search filter/top-k fragments (segments are immutable). "
+        "Results are bit-identical on or off at any worker count; off "
+        "disables both lookups and stores for this session")
+declare("serene_result_cache_mb", 64, int,
+        "byte cap (MB) of the process-wide result cache; entries evict "
+        "least-recently-used past the cap and a single result larger "
+        "than the cap is never stored", scope=Scope.GLOBAL,
+        validator=lambda v: max(1, int(v)))
+declare("serene_fragment_cache_mb", 32, int,
+        "byte cap (MB) of the process-wide search fragment cache "
+        "(per-segment filter doc sets and top-k collector outputs)",
+        scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

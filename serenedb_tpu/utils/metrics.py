@@ -130,3 +130,24 @@ SLOW_QUERIES = REGISTRY.gauge(
     "SlowQueries",
     "statements that exceeded serene_log_min_duration_ms and were "
     "written to the slow-query log")
+RESULT_CACHE_HITS = REGISTRY.gauge(
+    "ResultCacheHits",
+    "statements served from the result cache without executing")
+RESULT_CACHE_MISSES = REGISTRY.gauge(
+    "ResultCacheMisses",
+    "cacheable statements that executed because no entry matched")
+RESULT_CACHE_EVICTIONS = REGISTRY.gauge(
+    "ResultCacheEvictions",
+    "result-cache entries evicted (LRU byte pressure or a superseded "
+    "publication swept)")
+RESULT_CACHE_BYTES = REGISTRY.gauge(
+    "ResultCacheBytes", "bytes currently held by the result cache")
+FRAGMENT_CACHE_HITS = REGISTRY.gauge(
+    "FragmentCacheHits",
+    "per-segment search fragments (filter doc sets / top-k outputs) "
+    "served from the fragment cache")
+FRAGMENT_CACHE_MISSES = REGISTRY.gauge(
+    "FragmentCacheMisses",
+    "per-segment search fragments computed because no entry matched")
+FRAGMENT_CACHE_BYTES = REGISTRY.gauge(
+    "FragmentCacheBytes", "bytes currently held by the fragment cache")

@@ -56,6 +56,18 @@ if os.environ.get("SERENE_PROFILE"):
                              os.environ["SERENE_PROFILE"])
 
 
+# scripts/verify_tier1.sh result-cache parity leg: force
+# serene_result_cache to the given value ("on"/"off") for a whole run —
+# the on pass proves cached statements are bit-identical to executed
+# ones across the parity suites, the off pass that the engine runs
+# clean with both cache tiers absent.
+if os.environ.get("SERENE_RESULT_CACHE"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_RC
+
+    _SDB_REG_RC.set_global("serene_result_cache",
+                           os.environ["SERENE_RESULT_CACHE"])
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -238,6 +238,10 @@ def test_join_filter_right_join_prunes_left_and_full_never():
 
 def test_join_filter_legacy_match_still_prunes_identically():
     c = _mk_clustered()
+    # asserts EXECUTION internals (prune gauges on the repeat run) —
+    # the result cache would serve the identical statement without
+    # executing, which is correct but not what this test probes
+    c.execute("SET serene_result_cache = off")
     q = ("SELECT count(*), sum(v+w) FROM p JOIN b ON p.k = b.k "
          "AND v + w > 20")
     c.execute("SET serene_join_vectorized = on")

@@ -150,6 +150,10 @@ def test_incremental_append_extends_blocks():
 
 def test_verify_mode_catches_corrupt_stats():
     c = _mk_conn(n=20_000)
+    # this test asserts EXECUTION internals (the verify re-scan must
+    # run): the result cache would legitimately serve the repeat query
+    # without executing at all, hiding the corruption probe
+    c.execute("SET serene_result_cache = off")
     c.execute("SET serene_zonemap_verify = on")
     q = "SELECT count(*), sum(v) FROM z WHERE ts < 3000"
     expect = c.execute(q).rows()    # clean stats: no error, right answer
