@@ -868,6 +868,96 @@ def bench_result_cache() -> float:
     return headline
 
 
+def bench_device_pipeline() -> float:
+    """Fused device relational pipeline (ISSUE 7 tentpole): a 1M-row
+    filter→join→agg chain through the engine, three ways — host oracle
+    (`serene_device_fused = off`), cold fused dispatch (data caches
+    cleared: key factorize + host→device upload + one dispatch), and
+    device-cached repeat (publication-keyed columns resident: one
+    dispatch, zero transfer). The build side is 200k permuted keys and
+    the probe draws from a 2x keyspace (~50% hit rate, unclustered so
+    zone maps can't prune — this measures the fused matching tier).
+    Returns the host/device-cached speedup (>1x asserted: the cached
+    repeat dispatch must beat the host path); extras carry all three
+    latencies. Results are asserted bit-identical to the host oracle."""
+    import statistics
+
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec import device_pipeline as dp
+    from serenedb_tpu.exec.tables import MemTable
+    from serenedb_tpu.utils import metrics as _metrics
+
+    rng = np.random.default_rng(53)
+    npr, nb, keyspace = 1_000_000, 200_000, 400_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE dpp (jk BIGINT, g INT, v BIGINT)")
+    c.execute("CREATE TABLE dpb (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["dpp"] = MemTable("dpp", Batch.from_pydict({
+        "jk": Column.from_numpy(
+            rng.integers(0, keyspace, npr, dtype=np.int64)),
+        "g": Column.from_numpy(rng.integers(0, 16, npr).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(-1000, 1000, npr, dtype=np.int64))}))
+    db.schemas["main"].tables["dpb"] = MemTable("dpb", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.permutation(np.arange(nb, dtype=np.int64))),
+        "w": Column.from_numpy(
+            rng.integers(0, 100, nb, dtype=np.int64))}))
+    q = ("SELECT g, count(*), sum(v), sum(w) FROM dpp "
+         "JOIN dpb ON dpp.jk = dpb.k WHERE v > 0 GROUP BY g ORDER BY g")
+
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_device_fused = off")
+    host_rows = c.execute(q).rows()
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c.execute(q)
+        samples.append(time.perf_counter() - t0)
+    host_s = statistics.median(samples)
+
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    off0 = _metrics.DEVICE_OFFLOADS.value
+    fused_rows = c.execute(q).rows()          # compile warm-up + parity
+    assert _metrics.DEVICE_OFFLOADS.value > off0, "fused path did not fire"
+    assert fused_rows == host_rows, "fused pipeline diverged from host"
+    # cold = DATA cold: publication-keyed device cache and the host-side
+    # factorize cache cleared; the compiled program persists (the same
+    # policy as device shapes: cold means upload, not recompile)
+    dp.DEVICE_CACHE.clear()
+    dp.clear_codes_cache()
+    t0 = time.perf_counter()
+    c.execute(q)
+    cold_s = time.perf_counter() - t0
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        c.execute(q)
+        samples.append(time.perf_counter() - t0)
+    cached_s = statistics.median(samples)
+
+    _EXTRA["rows"] = npr
+    _EXTRA["host_s"] = round(host_s, 4)
+    _EXTRA["cold_transfer_s"] = round(cold_s, 4)
+    _EXTRA["device_cached_s"] = round(cached_s, 4)
+    _EXTRA["cold_vs_cached"] = round(cold_s / cached_s, 2)
+    headline = host_s / cached_s
+    # the "one dispatch beats N host kernels" claim is a DEVICE claim:
+    # on the CPU jit backend (dead-tunnel fallback, tier-1's platform)
+    # a scatter-heavy XLA program can legitimately trail the optimized
+    # numpy host path, so record the honest ratio instead of failing
+    import jax
+    if jax.default_backend() != "cpu":
+        assert headline > 1.0, \
+            f"device-cached dispatch loses to host: {headline:.2f}x"
+    return headline
+
+
 SHAPES = {
     "q1": bench_q1,
     "hits": bench_hits,
@@ -880,6 +970,7 @@ SHAPES = {
     "join": bench_join,
     "profile_overhead": bench_profile_overhead,
     "result_cache": bench_result_cache,
+    "device_pipeline": bench_device_pipeline,
 }
 
 #: shapes whose ratio is a device-vs-CPU speedup and enters the headline
@@ -889,8 +980,13 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 
 #: shapes that never touch the device — they run even when the liveness
 #: probe fails (a dead tunnel must not blind the round on host numbers)
+#: device_pipeline rides along so a dead tunnel doesn't blind the round
+#: on the fused-tier numbers, but its programs DO jit: the harness forces
+#: JAX_PLATFORMS=cpu into its child when the probe failed (initializing
+#: the tunneled backend with the tunnel down is a hard hang, see
+#: _run_shape_child), and the >1x assert applies only on a real device
 HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
-               "profile_overhead", "result_cache")
+               "profile_overhead", "result_cache", "device_pipeline")
 
 
 # ------------------------------------------------------------- harness
@@ -927,12 +1023,14 @@ def _run_shape_child(name: str) -> None:
         from serenedb_tpu.utils.config import REGISTRY as _sdb_settings
         _sdb_settings.set_global("serene_result_cache", False)
         speedup = SHAPES[name]()
-        if name in HOST_SHAPES:
+        if name in HOST_SHAPES and name != "device_pipeline":
             _EXTRA["platform"] = "host"
         else:
-            # device shapes already initialized the backend, so this is a
-            # cache hit; calling it for host shapes would *initialize* the
-            # tunneled backend — a hard hang when the tunnel is down
+            # device shapes (and device_pipeline, which runs jitted
+            # programs despite riding in HOST_SHAPES) already initialized
+            # the backend, so this is a cache hit; calling it for host
+            # shapes would *initialize* the tunneled backend — a hard
+            # hang when the tunnel is down
             _EXTRA["platform"] = jax.default_backend()
         print(json.dumps({"shape": name, "speedup": round(speedup, 4),
                           "extra": _EXTRA}),
@@ -999,12 +1097,20 @@ def _git_head() -> str:
         return ""
 
 
-def _run_shape_subprocess(name: str, timeout_s: float) -> tuple[dict, str]:
-    """Run one shape in a child process; returns (record, error)."""
+def _run_shape_subprocess(name: str, timeout_s: float,
+                          force_cpu: bool = False) -> tuple[dict, str]:
+    """Run one shape in a child process; returns (record, error).
+    force_cpu pins the child to the CPU backend — required for shapes
+    that jit (device_pipeline) when the device probe failed, because
+    initializing the tunneled backend with the tunnel down is a hard
+    hang, not an error."""
+    env = None
+    if force_cpu:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--shape", name],
-            capture_output=True, text=True, timeout=timeout_s)
+            capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         # typed prefix — _infra_failure keys on it, never on stderr text
         return {}, "timeout: shape timed out (device hang mid-run?)"
@@ -1085,7 +1191,9 @@ def ledger_main(shape_names: list[str]) -> None:
             break
         # cap below main()'s lock wait so an in-flight child can't make
         # the official run miss its preemption window
-        rec, err = _run_shape_subprocess(name, 480.0)
+        rec, err = _run_shape_subprocess(
+            name, 480.0,
+            force_cpu=not alive and name == "device_pipeline")
         if not rec:
             errors[name] = err
             continue
@@ -1187,7 +1295,9 @@ def main() -> None:
         if remaining < shape_floor:
             errors[name] = "skipped: bench budget exhausted"
             continue
-        rec, err = _run_shape_subprocess(name, min(600.0, remaining))
+        rec, err = _run_shape_subprocess(
+            name, min(600.0, remaining),
+            force_cpu=not alive and name == "device_pipeline")
         if rec:
             results[name] = float(rec["speedup"])
             for ek, ev in (rec.get("extra") or {}).items():

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Tier-1 verification (ROADMAP.md) plus one structural zone-map pass.
+# Tier-1 verification (ROADMAP.md) plus structural/parity passes.
 #
 # Pass 1 is the canonical tier-1 suite. Pass 2 re-runs the zone-map and
 # morsel parity suites with SERENE_ZONEMAP_VERIFY=1 (tests/conftest.py
@@ -60,8 +60,22 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_RESULT_CACHE=on \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc5=$?
 
+# Pass 6 is the fused-device-pipeline parity leg: the fused tier is
+# forced OFF globally (the conftest env hook arms serene_device_fused)
+# over the device parity suites plus the join parity suite — proving
+# the one-dispatch tier is an optimization layer only: every result is
+# bit-identical with it dark, and the suites' own differential tests
+# still exercise both paths via their explicit session SETs.
+echo "== fused device pipeline parity pass (serene_device_fused=off) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_DEVICE_FUSED=off \
+    python -m pytest tests/test_device_pipeline.py tests/test_device_agg.py \
+    tests/test_join_exec.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc6=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
 [ "$rc4" -ne 0 ] && exit "$rc4"
-exit "$rc5"
+[ "$rc5" -ne 0 ] && exit "$rc5"
+exit "$rc6"

@@ -32,6 +32,7 @@ from __future__ import annotations
 import itertools
 import threading
 import weakref
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -110,6 +111,15 @@ class FragmentCache:
         self._lock = threading.Lock()
         self._seg_keys: dict[int, set] = {}   # uid → live keys
         self._gauge_bytes = 0
+        #: uids of dead segments awaiting reclaim. The weakref finalizer
+        #: ONLY appends here: a finalizer runs at an arbitrary
+        #: allocation/GC point — possibly on a thread that already holds
+        #: `_lock` or the LRU's lock (observed: GC inside
+        #: `_sync_bytes`'s `total_bytes` call) — so taking any lock in
+        #: it deadlocks against the very frame it interrupted.
+        #: deque.append is atomic under the GIL; the next cache
+        #: operation drains the queue with normal locking.
+        self._pending_drops: deque = deque()
 
     def _evicted(self, key, entry):
         # keep the per-segment key sets in step with LRU pressure —
@@ -147,12 +157,26 @@ class FragmentCache:
         return uid
 
     def drop_segment(self, uid: int) -> None:
-        with self._lock:
-            keys = self._seg_keys.pop(uid, None)
-        if keys:
-            for k in keys:
-                self._lru.remove(k)
-            self._sync_bytes()
+        """Weakref finalizer target — lock-free by contract (see
+        `_pending_drops`); the entries are unreachable the moment the
+        segment dies (its uid dies with it), this only defers reclaiming
+        their bytes."""
+        self._pending_drops.append(uid)
+
+    def _drain_drops(self) -> None:
+        if not self._pending_drops:   # steady state: no raise/catch tax
+            return
+        while True:
+            try:
+                uid = self._pending_drops.popleft()
+            except IndexError:
+                return
+            with self._lock:
+                keys = self._seg_keys.pop(uid, None)
+            if keys:
+                for k in keys:
+                    self._lru.remove(k)
+                self._sync_bytes()
 
     def cached(self, seg, shape: Optional[tuple], compute):
         """compute() memoized under (segment uid, shape). shape=None ⇒
@@ -160,6 +184,7 @@ class FragmentCache:
         consulted only when the session gate is on, but a fragment
         stored by one session is served to any other — fragments are
         pure functions of immutable segments."""
+        self._drain_drops()   # reclaim dead-segment bytes even when gated off
         if shape is None or not enabled():
             return compute()
         uid = self.segment_uid(seg)
@@ -180,12 +205,14 @@ class FragmentCache:
         return _copy_value(value)
 
     def clear(self):
+        self._pending_drops.clear()
         self._lru.clear()
         with self._lock:
             self._seg_keys.clear()
         self._sync_bytes()
 
     def snapshot(self) -> list[dict]:
+        self._drain_drops()
         out = []
         for key, e in self._lru.items():
             uid, shape = key
@@ -202,6 +229,7 @@ class FragmentCache:
         return out
 
     def stats(self) -> dict:
+        self._drain_drops()
         return {
             "entries": len(self._lru),
             "bytes": self._lru.total_bytes,

@@ -56,7 +56,7 @@ from .lru import BytesLRU
 #: of the key, so two sessions with different knobs never share entries
 RESULT_AFFECTING_SETTINGS = (
     "serene_device", "serene_device_min_rows", "serene_device_chunk_rows",
-    "serene_mesh", "sdb_nprobe", "sdb_rerank_factor",
+    "serene_device_fused", "serene_mesh", "sdb_nprobe", "sdb_rerank_factor",
     "sdb_scored_terms_limit", "search_path",
 )
 

@@ -1,0 +1,1268 @@
+"""Fused device-side relational execution — one dispatch per query.
+
+PAPER.md §2's core claim is "one dispatch per query, not one kernel per
+operator". The single-table half of that already exists (exec/device_agg.py
+fuses Scan→Filter→Aggregate); this module extends the discipline across the
+relational tier: a Scan→Filter→Join→Aggregate chain compiles into ONE jitted
+JAX program over device-resident columns of BOTH tables, and a filtered
+top-N (Sort+Limit over Filter→Scan) into one masked `top_k` dispatch.
+
+Join representation (the PR-3 trick, moved on device): both sides' equi-keys
+factorize host-side into ONE dense int64 code space
+(exec/morsel.combined_codes — NULL keys masked to a per-side sentinel so
+NULL never matches, every NaN occurrence its own code so NaN ≠ NaN, exactly
+the row-tuple oracle's semantics). The codes upload as int32 tiles and the
+probe happens *inside* the program as pure gathers: the build side scatters
+per-code partials (count / limb sums / min / max), every probe row gathers
+its code's partial and scatters it into the group accumulator — no pair list
+ever materializes, on host or device. The fused-kernel shape mirrors
+FLASH-MAXSIM's IO-aware late-interaction kernels and Ragged Paged
+Attention's one-program-over-resident-data design (PAPERS.md).
+
+Exactness policy (PG parity, x64 off): only integer/bool/date aggregate
+arguments compile — int sums ride the 8-bit limb decomposition of
+ops/agg.py, weighted by the per-row match count (or ONE direct int32
+scatter column when the argument is a plain column whose value bound
+times the worst-case pair count provably fits int32), and the whole
+plan is admitted only while the worst-case pair count keeps every int32
+limb accumulator exact (`MAX_PAIRS_EXACT`). Float arguments, DISTINCT, FILTER
+clauses, residual predicates and non-inner joins fall back to the host
+oracle, which stays on as the bit-identical parity reference behind
+`SET serene_device_fused = off` (the serene_join_vectorized=off pattern).
+
+Transfers: uploads go through DEVICE_CACHE, a process-wide bytes-bounded
+cache keyed by the PR-5 publication tuples (provider token, data_version,
+mutation_epoch) + column + surviving row range — a repeat query on an
+unchanged table skips host→device transfer entirely, and any write moves
+the key. Zone maps bound what uploads at all: each side's scan-level
+conjuncts shrink the transfer to the surviving block envelope
+(device_agg's `_zonemap_range` logic, applied per join side).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Batch, Column
+from ..columnar.device import (DeviceNarrowingError, LANES, pad_len,
+                               to_device_column)
+from ..ops import agg as ops_agg
+from ..sql.binder import _expr_key
+from ..sql.expr import AggSpec, BoundColumn, BoundExpr, BoundFunc
+from ..utils import log, metrics
+from ..utils.config import REGISTRY as _settings_registry
+from .device import DeviceExpr, NotCompilable, compile_expr, _PROGRAM_CACHE
+from .device_agg import MAX_GROUP_PRODUCT, MAX_INT_KEY_RANGE
+
+#: combined join-key code-space cap (dense per-code arrays live in HBM)
+MAX_CODE_SPACE = 1 << 22
+#: worst-case matched-pair bound under which every int32 limb/count
+#: scatter in the program is provably exact (255 * pairs < 2^31)
+MAX_PAIRS_EXACT = 1 << 23
+
+_AGG_FUNCS = {"count_star", "count", "sum", "min", "max", "avg"}
+
+#: expressions whose host-side evaluation draws shared mutable state or
+#: runs a subplan — pre-evaluating them over unfiltered rows would
+#: double-draw / reorder effects (same list the morsel tier excludes)
+_HOST_EVAL_UNSAFE = {
+    "scalar_subquery", "array_subquery", "in_subquery", "exists",
+    "currval", "lastval"}
+
+
+def fused_enabled(settings) -> bool:
+    try:
+        return bool(settings.get("serene_device_fused"))
+    except KeyError:  # pragma: no cover — registry always declares it
+        return False
+
+
+# -- publication-keyed device column cache ----------------------------------
+
+
+def _pub(provider, pin) -> tuple:
+    """(provider token, data_version, mutation_epoch) — the PR-5
+    publication tuple. The token is process-unique per provider object,
+    so DROP + CREATE can never alias generations."""
+    from ..cache.result import _provider_token
+    if pin is not None:
+        return (_provider_token(provider), pin[1], pin[2])
+    return (_provider_token(provider),
+            getattr(provider, "data_version", 0),
+            getattr(provider, "mutation_epoch", 0))
+
+
+class DeviceColumnCache:
+    """Process-wide cache of device-resident arrays keyed by publication
+    tuples. An entry's key embeds (token, data_version, mutation_epoch)
+    + column + row range, so invalidation is implicit: any write bumps
+    the publication and the next query keys past the stale upload. Bytes
+    are bounded by the serene_device_cache_mb global (LRU past the cap);
+    superseded generations of a token are swept eagerly on store so HBM
+    never holds two versions of one column."""
+
+    def __init__(self):
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def _cap_bytes(self) -> int:
+        try:
+            mb = int(_settings_registry.get_global("serene_device_cache_mb"))
+        except KeyError:  # pragma: no cover
+            mb = 256
+        return mb << 20
+
+    def get(self, key: tuple):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                metrics.DEVICE_CACHE_MISSES.add()
+                return None
+            self._entries.move_to_end(key)
+            metrics.DEVICE_CACHE_HITS.add()
+            return entry[0]
+
+    def put(self, key: tuple, value, nbytes: int, sweep=None) -> None:
+        """Store + LRU/byte bookkeeping. `sweep(k) -> bool` lets a
+        caller mark extra keys as superseded (e.g. code tiles whose
+        staleness comes from the PARTNER table's publication, which the
+        owner-generation rule below cannot see)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            # sweep superseded generations: same (token, name, tag) under
+            # an older publication can never be read again
+            token, name = key[0][0], key[1]
+            stale = [k for k in self._entries
+                     if (k[0][0] == token and k[1] == name and
+                         k[0] != key[0]) or
+                     (sweep is not None and k != key and sweep(k))]
+            for k in stale:
+                self._bytes -= self._entries.pop(k)[1]
+                metrics.DEVICE_CACHE_EVICTIONS.add()
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            cap = self._cap_bytes()
+            while self._bytes > cap and len(self._entries) > 1:
+                _, (_, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
+                metrics.DEVICE_CACHE_EVICTIONS.add()
+            metrics.DEVICE_CACHE_BYTES.set(self._bytes)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            metrics.DEVICE_CACHE_BYTES.set(0)
+
+    # -- typed helpers ------------------------------------------------------
+
+    def column(self, provider, pub: tuple, name: str, host_col_fn,
+               zrange: Optional[tuple]):
+        """Device tiles of one column (optionally row-sliced), cached by
+        (publication, column, range). host_col_fn() materializes the host
+        column only on miss."""
+        key = (pub, name, "col", zrange)
+        dc = self.get(key)
+        if dc is not None:
+            return dc
+        col = host_col_fn()
+        if zrange is not None:
+            col = col.slice(zrange[0], zrange[1])
+        dc = to_device_column(col)
+        nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
+            int(dc.mask.size)
+        metrics.DEVICE_BYTES.add(nbytes)
+        self.put(key, dc, nbytes)
+        return dc
+
+    def array(self, pub: tuple, name: str, tag, build_fn, sweep=None):
+        """Generic cached device array (code tiles, row masks)."""
+        key = (pub, name, "arr", tag)
+        arr = self.get(key)
+        if arr is not None:
+            return arr
+        arr = build_fn()
+        nbytes = int(arr.size * arr.dtype.itemsize)
+        metrics.DEVICE_BYTES.add(nbytes)
+        self.put(key, arr, nbytes, sweep=sweep)
+        return arr
+
+
+DEVICE_CACHE = DeviceColumnCache()
+
+#: host-side factorized join-code cache: (pub_l, pub_r, key exprs) →
+#: (codes_l, codes_r, g, worst-case pairs). Count- AND byte-bounded
+#: (int64 code arrays of large tables are real host memory); the
+#: factorize pass is O(n log n) once per publication pair and the
+#: pair-count admission check O(n) once — both amortize across repeat
+#: queries. Superseded publication pairs are swept on store.
+_CODES_CACHE: OrderedDict[tuple, tuple] = OrderedDict()
+_CODES_CACHE_MAX = 16
+_CODES_CACHE_MAX_BYTES = 256 << 20
+_codes_bytes = 0
+_codes_lock = threading.Lock()
+
+#: column admission stats, (pub, column) → (all_valid, finite_all, lo,
+#: hi) — a pure function of the publication, so cached repeats skip the
+#: O(n) host scans. Shared by fused top-N admission and the direct-sum
+#: range check.
+_COL_STATS_CACHE: OrderedDict[tuple, tuple] = OrderedDict()
+_COL_STATS_MAX = 64
+_col_stats_lock = threading.Lock()
+
+
+def clear_codes_cache() -> None:
+    """Drop every cached factorization and reset the byte accounting —
+    the two must move together or later stores evict against a phantom
+    total."""
+    global _codes_bytes
+    with _codes_lock:
+        _CODES_CACHE.clear()
+        _codes_bytes = 0
+
+
+def _rowmask_tiles(nrows: int) -> "jax.Array":
+    import jax.numpy as jnp
+    n_pad = pad_len(nrows)
+    rm = np.zeros(n_pad, dtype=bool)
+    rm[:nrows] = True
+    return jnp.asarray(rm.reshape(-1, LANES))
+
+
+# -- pipeline recognition ----------------------------------------------------
+
+
+def _split_and(e: BoundExpr) -> list[BoundExpr]:
+    """Top-level AND conjuncts (a Filter keeps only rows where the whole
+    expression is TRUE, so `a AND b` splits losslessly even under
+    three-valued logic)."""
+    if isinstance(e, BoundFunc) and e.name == "and":
+        out: list[BoundExpr] = []
+        for a in e.args:
+            out.extend(_split_and(a))
+        return out
+    return [e]
+
+
+def _unwrap_side(plan):
+    """Filter*(Scan) → (scan, [scan-schema-bound predicates]) or None."""
+    from .plan import FilterNode, ScanNode
+    preds: list[BoundExpr] = []
+    node = plan
+    while isinstance(node, FilterNode):
+        preds.append(node.pred)
+        node = node.child
+    if type(node) is not ScanNode:
+        return None
+    if node.filter is not None:
+        preds.append(node.filter)
+    return node, preds
+
+
+def _side_of(expr: BoundExpr, nl: int) -> int:
+    """0 = probe (left), 1 = build (right); raises when the expression
+    reads columns of both join sides (no per-side decomposition)."""
+    sides = set()
+    for sub in expr.walk():
+        if isinstance(sub, BoundColumn):
+            sides.add(0 if sub.index < nl else 1)
+    if len(sides) > 1:
+        raise NotCompilable("expression spans both join sides")
+    return sides.pop() if sides else 0
+
+
+def _check_host_eval_safe(exprs: list[BoundExpr]) -> None:
+    from ..sql.binder import _VOLATILE_FUNCS
+    unsafe = _VOLATILE_FUNCS | _HOST_EVAL_UNSAFE
+    for e in exprs:
+        for sub in e.walk():
+            if isinstance(sub, BoundFunc) and sub.name in unsafe:
+                raise NotCompilable(f"host-evaluated {sub.name}")
+
+
+class _Side:
+    """One join side's publication observation + host access + zone range."""
+
+    def __init__(self, scan, preds: list[BoundExpr], ctx):
+        self.scan = scan
+        self.preds = preds
+        self.provider = scan.provider
+        self.pin = self.provider.try_pin()
+        self.pub = _pub(self.provider, self.pin)
+        try:
+            self.nrows = self.pin[0].num_rows if self.pin is not None \
+                else self.provider.row_count()
+        except NotImplementedError:
+            raise NotCompilable("provider without row_count")
+        self.zrange = self._zone_range(ctx)
+
+    def host_col(self, name: str) -> Column:
+        if self.pin is not None:
+            return self.pin[0].column(name)
+        return self.provider.host_column(name)
+
+    def _zone_range(self, ctx) -> Optional[tuple[int, int]]:
+        """Surviving block envelope under this side's scan conjuncts
+        (upload shrink; interior SKIP blocks still upload). (0, 0) when
+        everything prunes — the caller short-circuits to the empty
+        result the host path would produce from the same verdicts."""
+        if not self.preds:
+            return None
+        from . import zonemap
+        block_rows = int(ctx.settings.get("serene_morsel_rows"))
+        verdicts = zonemap.block_verdicts(
+            self.provider, ctx.settings, self.preds, self.scan.columns,
+            block_rows, self.pin)
+        if verdicts is None:
+            return None
+        lo, hi = zonemap.surviving_range(verdicts, block_rows, self.nrows)
+        if hi <= lo:
+            return (0, 0)
+        if (lo, hi) == (0, self.nrows):
+            return None
+        n_blocks = len(verdicts)
+        lo_b, hi_b = lo // block_rows, (hi + block_rows - 1) // block_rows
+        metrics.ZONEMAP_PRUNED.add(n_blocks - (hi_b - lo_b))
+        metrics.ZONEMAP_SCANNED.add(hi_b - lo_b)
+        if zonemap.verify_enabled(ctx.settings):
+            full = self.pin[0] if self.pin is not None else \
+                self.provider.full_batch(self.scan.columns)
+            full = Batch(list(self.scan.columns),
+                         [full.column(c) for c in self.scan.columns])
+            spans = [(s, e) for s, e in ((0, lo), (hi, self.nrows))
+                     if e > s]
+            zonemap.verify_pruned_blocks(
+                self.preds, full, spans,
+                f"fused pipeline {self.provider.name}")
+        return lo, hi
+
+    @property
+    def lo(self) -> int:
+        return 0 if self.zrange is None else self.zrange[0]
+
+    @property
+    def n_live(self) -> int:
+        if self.zrange is None:
+            return self.nrows
+        return self.zrange[1] - self.zrange[0]
+
+
+# -- fused Scan→Filter→Join→Aggregate ---------------------------------------
+
+
+def try_device_pipeline(node, ctx) -> Optional[Batch]:
+    """Attempt one-dispatch execution of AggregateNode over an inner
+    equi-join of two scans; None → host path (the parity oracle)."""
+    from .plan import JoinNode, FilterNode
+
+    settings = ctx.settings
+    if settings.get("serene_device") == "cpu" or not fused_enabled(settings):
+        return None
+    post_preds: list[BoundExpr] = []
+    child = node.child
+    while isinstance(child, FilterNode):
+        post_preds.extend(_split_and(child.pred))
+        child = child.child
+    if type(child) is not JoinNode:
+        return None
+    join = child
+    if join.kind != "inner" or not join.left_keys or \
+            join.residual is not None or join.merge_pairs:
+        return None
+    probe_side = _unwrap_side(join.left)
+    build_side = _unwrap_side(join.right)
+    if probe_side is None or build_side is None:
+        return None
+    for spec in node.aggs:
+        if spec.func not in _AGG_FUNCS or spec.distinct or \
+                spec.filter is not None or spec.order_by:
+            return None
+    pscan = probe_side[0]
+    if settings.get("serene_device") == "auto":
+        try:
+            if pscan.provider.row_count() < \
+                    settings.get("serene_device_min_rows"):
+                return None
+        except NotImplementedError:
+            return None
+    try:
+        return _run_fused(node, join, probe_side, build_side, post_preds,
+                          ctx)
+    except (NotCompilable, DeviceNarrowingError) as e:
+        log.debug("device", f"fused pipeline fell back to CPU: {e}")
+        return None
+
+
+def _run_fused(node, join, probe_side, build_side,
+               post_preds: list[BoundExpr], ctx) -> Batch:
+    import jax.numpy as jnp
+
+    prof = getattr(ctx, "profile", None)
+
+    def clock() -> int:
+        return time.perf_counter_ns() if prof is not None else 0
+
+    pscan, ppreds = probe_side
+    bscan, bpreds = build_side
+    nl = len(join.left.names)
+    _check_host_eval_safe(list(join.left_keys) + list(join.right_keys))
+
+    t0 = clock()
+    probe = _Side(pscan, ppreds, ctx)
+    build = _Side(bscan, bpreds, ctx)
+
+    # split the post-join conjuncts by side: a pair filter that reads
+    # only probe (build) columns is exactly a probe (build) row filter
+    # under an inner join
+    post_p: list[BoundExpr] = []
+    post_b: list[BoundExpr] = []
+    for p in post_preds:
+        (post_p if _side_of(p, nl) == 0 else post_b).append(p)
+
+    # group keys: plain probe-side columns, direct-coded (dict codes /
+    # small-range ints) — build-side or computed keys fall back
+    for g in node.group_exprs:
+        if not isinstance(g, BoundColumn) or g.index >= nl:
+            raise NotCompilable("group key is not a plain probe column")
+
+    # referenced-column discovery + dictionaries (join-schema namespace:
+    # probe scan col i == join col i, build scan col i == join col nl+i;
+    # the side is derived from the index, never assumed, so a build-side
+    # string column can't pick up the probe column's dictionary)
+    dictionaries: dict[int, np.ndarray] = {}
+    join_types = list(join.types)
+
+    def note_dicts(exprs):
+        for e in exprs:
+            for sub in e.walk():
+                if isinstance(sub, BoundColumn) and sub.type.is_string:
+                    ji = sub.index
+                    if ji in dictionaries:
+                        continue
+                    if ji < nl:
+                        col = probe.host_col(pscan.columns[ji])
+                    else:
+                        col = build.host_col(bscan.columns[ji - nl])
+                    if col.dictionary is not None:
+                        dictionaries[ji] = col.dictionary
+
+    note_dicts(post_p + post_b + list(node.group_exprs) +
+               [s.arg for s in node.aggs if s.arg is not None])
+
+    # scan-level predicates compile against the scan schema; their input
+    # slots translate into the join namespace (probe scan col i == join
+    # col i, build scan col i == join col nl + i)
+    def compile_scan_preds(side: _Side, shift: int) -> list[DeviceExpr]:
+        dicts = {}
+        for e in side.preds:
+            for sub in e.walk():
+                if isinstance(sub, BoundColumn) and sub.type.is_string \
+                        and sub.index not in dicts:
+                    col = side.host_col(side.scan.columns[sub.index])
+                    if col.dictionary is not None:
+                        dicts[sub.index] = col.dictionary
+        out = []
+        for e in side.preds:
+            ce = compile_expr(e, side.scan.types, dicts)
+            ce.inputs = [i + shift for i in ce.inputs]
+            out.append(ce)
+        return out
+
+    preds_probe = compile_scan_preds(probe, 0) + \
+        [compile_expr(p, join_types, dictionaries) for p in post_p]
+    preds_build = compile_scan_preds(build, nl) + \
+        [compile_expr(p, join_types, dictionaries) for p in post_b]
+
+    # group-key plans (direct coding; the NULL group takes the last slot)
+    key_plans, group_space = _plan_group_keys(node, join_types, probe,
+                                              pscan, dictionaries)
+    group_mode = bool(node.group_exprs)
+
+    # aggregate plans: (spec, side, compiled arg | None)
+    agg_plans: list[tuple] = []
+    for spec in node.aggs:
+        if spec.func == "count_star":
+            agg_plans.append((spec, 0, None))
+            continue
+        side = _side_of(spec.arg, nl)
+        t = spec.arg.type
+        if spec.func in ("sum", "avg"):
+            if not t.is_integer:
+                raise NotCompilable(f"{spec.func} over {t} (exactness)")
+        elif spec.func in ("min", "max"):
+            if not (t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE)):
+                raise NotCompilable(f"{spec.func} over {t}")
+        agg_plans.append((spec, side,
+                          compile_expr(spec.arg, join_types, dictionaries)))
+    if prof is not None:
+        prof.add_device_ns(id(node), clock() - t0)
+
+    # join-key factorization (host, cached per publication pair along
+    # with the worst-case pair count: every int32 count/limb scatter in
+    # the program is exact below the bound)
+    t0 = clock()
+    cl, cr, g, total_pairs = _join_codes(join, probe, build)
+    if g + 2 > MAX_CODE_SPACE:
+        raise NotCompilable("join code space too large")
+    if total_pairs > MAX_PAIRS_EXACT:
+        raise NotCompilable(
+            f"{total_pairs} worst-case pairs exceed the exact-scatter "
+            f"bound")
+    if probe.zrange is not None:
+        cl = cl[probe.zrange[0]:probe.zrange[1]]
+    if build.zrange is not None:
+        cr = cr[build.zrange[0]:build.zrange[1]]
+
+    # direct-sum fast path: a plain-column sum whose |value| bound times
+    # the worst-case pair count provably fits int32 skips the 5-column
+    # limb decomposition for ONE direct scatter column (sound for every
+    # slot the probe phase can read: a gathered code's build dups are
+    # counted in total_pairs, so its partial is inside the bound too)
+    sum_modes: dict[int, str] = {}
+    for si, (spec, _side_ix, ce) in enumerate(agg_plans):
+        if spec.func not in ("sum", "avg") or ce is None:
+            continue
+        mode = "limb"
+        arg = spec.arg
+        if isinstance(arg, BoundColumn):
+            if arg.index < nl:
+                s_obj, cname = probe, pscan.columns[arg.index]
+            else:
+                s_obj, cname = build, bscan.columns[arg.index - nl]
+            _, _, lo_v, hi_v = _col_stats(s_obj, cname)
+            if lo_v is not None and max(abs(lo_v), abs(hi_v)) * \
+                    max(total_pairs, 1) < (1 << 31):
+                mode = "direct"
+        sum_modes[si] = mode
+    if prof is not None:
+        prof.add_device_ns(id(join), clock() - t0)
+
+    # empty short-circuit: no surviving rows on either side ⇒ no pairs;
+    # synthesize the zero-accumulator outputs without a dispatch
+    if probe.n_live == 0 or build.n_live == 0:
+        results = _zero_results(agg_plans, group_space, sum_modes)
+        return _finalize(node, key_plans, agg_plans, results, probe,
+                         pscan, dictionaries, group_space, group_mode,
+                         sum_modes)
+
+    # device environment: columns via the publication-keyed cache
+    needed: set[int] = set()
+    for ce in preds_probe + preds_build:
+        needed.update(ce.inputs)
+    for kp in key_plans:
+        needed.add(kp[1])
+    for spec, side, ce in agg_plans:
+        if ce is not None:
+            needed.update(ce.inputs)
+    needed = sorted(needed)
+
+    t0 = clock()
+    env_cols = {}
+    for ji in needed:
+        if ji < nl:
+            side, name, zr = probe, pscan.columns[ji], probe.zrange
+        else:
+            side, name, zr = build, bscan.columns[ji - nl], build.zrange
+        env_cols[ji] = DEVICE_CACHE.column(
+            side.provider, side.pub, name,
+            (lambda s=side, n=name: s.host_col(n)), zr)
+
+    # code tiles + row masks (sentinels baked in host-side: NULL-key /
+    # padding probe rows → g+1, build rows → g; neither ever matches).
+    # A codes entry is stale when EITHER side's publication moved: the
+    # owner-generation sweep covers this side, the sweep predicate
+    # covers entries pinned to an older generation of the partner.
+    keyset = (tuple(_expr_key(k) for k in join.left_keys),
+              tuple(_expr_key(k) for k in join.right_keys))
+
+    def _partner_stale(owner_pub, partner_pub, side_tag):
+        def pred(k):
+            return (k[0][0] == owner_pub[0] and k[1] == "__codes__" and
+                    isinstance(k[3], tuple) and len(k[3]) == 4 and
+                    k[3][3] == side_tag and k[3][1] == keyset and
+                    k[3][0][0] == partner_pub[0] and k[3][0] != partner_pub)
+        return pred
+
+    pc_dev = DEVICE_CACHE.array(
+        probe.pub, "__codes__", (build.pub, keyset, probe.zrange, "p"),
+        lambda: _code_tiles(cl, g + 1),
+        sweep=_partner_stale(probe.pub, build.pub, "p"))
+    bc_dev = DEVICE_CACHE.array(
+        build.pub, "__codes__", (probe.pub, keyset, build.zrange, "b"),
+        lambda: _code_tiles(cr, g),
+        sweep=_partner_stale(build.pub, probe.pub, "b"))
+    prow = DEVICE_CACHE.array(probe.pub, "__rowmask__",
+                              (probe.zrange,),
+                              lambda: _rowmask_tiles(probe.n_live))
+    brow = DEVICE_CACHE.array(build.pub, "__rowmask__",
+                              (build.zrange,),
+                              lambda: _rowmask_tiles(build.n_live))
+    if prof is not None:
+        prof.add_device_ns(id(pscan), clock() - t0)
+
+    # -- the single program -------------------------------------------------
+    decode_specs = [(env_cols[i].scheme, env_cols[i].offset) for i in needed]
+
+    def env_for(ce: DeviceExpr, arrays):
+        return [arrays[i] for i in ce.inputs]
+
+    space = g + 2
+
+    # CPU-backend reality: every row-scatter pass costs roughly the same
+    # serial walk regardless of target size or column count, so the
+    # program accumulates ALL add-reductions of one phase in ONE
+    # multi-column scatter — build partials land in a single
+    # (code space, C) scatter, probe group accumulators in a single
+    # (group space, C) scatter — instead of one scatter per aggregate.
+    # Only min/max need their own (non-add) scatter combinator.
+    def program(*flat):
+        arrays = {}
+        for k, ji in enumerate(needed):
+            data = flat[2 * k]
+            scheme, off = decode_specs[k]
+            if scheme != "raw":
+                data = data.astype(jnp.int32) + jnp.int32(off)
+            arrays[ji] = (data, flat[2 * k + 1])
+        base = 2 * len(needed)
+        bcodes, pcodes = flat[base], flat[base + 1]
+        bmask, pmask = flat[base + 2], flat[base + 3]
+
+        # build phase: mask, then per-code partials (one fused scatter;
+        # per-column validity gates zero the value, which scatters the
+        # same result as masking the index)
+        for ce in preds_build:
+            v, ok = ce.fn(env_for(ce, arrays))
+            b = v if v.dtype == jnp.bool_ else (v != 0)
+            bmask = jnp.logical_and(bmask, jnp.logical_and(b, ok))
+        bc = jnp.where(bmask, bcodes, jnp.int32(g))
+        bcols = [bmask.ravel().astype(jnp.int32)]       # col 0: match count
+        bstart: dict[int, int] = {}
+        bmm: dict[int, "jax.Array"] = {}
+        for si, (spec, side, ce) in enumerate(agg_plans):
+            if side != 1 or ce is None:
+                continue
+            v, ok = ce.fn(env_for(ce, arrays))
+            m = jnp.logical_and(bmask, ok)
+            mi = m.ravel().astype(jnp.int32)
+            bstart[si] = len(bcols)
+            bcols.append(mi)                             # per-agg vcnt
+            if spec.func in ("sum", "avg"):
+                if sum_modes[si] == "direct":
+                    bcols.append(v.astype(jnp.int32).ravel() * mi)
+                else:
+                    bcols.extend(_limb_cols(
+                        v.astype(jnp.int32).ravel(), mi))
+            elif spec.func in ("min", "max"):
+                bmm[si] = ops_agg.group_min_max(
+                    bcodes, m, v.astype(jnp.int32), space, spec.func)
+        bacc = jnp.zeros((space, len(bcols)), jnp.int32) \
+            .at[bc.ravel()].add(jnp.stack(bcols, axis=1))
+        bacc = bacc.at[g].set(0).at[g + 1].set(0)        # sentinel slots
+        cnt_code = bacc[:, 0]
+
+        # probe phase: mask, gather match counts, one fused scatter
+        # into the group accumulator
+        for ce in preds_probe:
+            v, ok = ce.fn(env_for(ce, arrays))
+            b = v if v.dtype == jnp.bool_ else (v != 0)
+            pmask = jnp.logical_and(pmask, jnp.logical_and(b, ok))
+        pc = jnp.where(pmask, pcodes, jnp.int32(g + 1))
+        cnt = cnt_code[pc]                       # matches per probe row
+
+        if group_mode:
+            gcodes = jnp.zeros_like(pc)
+            for kind, ji, lo_v, size in key_plans:
+                data, ok = arrays[ji]
+                if kind == "dict":
+                    c = data.astype(jnp.int32)
+                else:
+                    c = data.astype(jnp.int32) - jnp.int32(lo_v)
+                c = jnp.where(ok, c, jnp.int32(size - 1))
+                gcodes = gcodes * jnp.int32(size) + jnp.clip(c, 0, size - 1)
+        else:
+            gcodes = jnp.zeros_like(pc)
+        gc = jnp.where(pmask, gcodes, 0).ravel()
+        pmi = pmask.ravel().astype(jnp.int32)
+
+        pcols = [jnp.where(pmask, cnt, 0).ravel()]       # col 0: pairs
+        pstart: dict[int, int] = {}
+        pmm: dict[int, "jax.Array"] = {}
+        for si, (spec, side, ce) in enumerate(agg_plans):
+            if spec.func == "count_star":
+                continue                         # shared pair counts
+            if side == 0:
+                v, ok = ce.fn(env_for(ce, arrays))
+                m = jnp.logical_and(pmask, ok)
+                vpairs = jnp.where(m, cnt, 0).ravel()
+                pstart[si] = len(pcols)
+                if spec.func == "count":
+                    pcols.append(vpairs)
+                elif spec.func in ("sum", "avg"):
+                    if sum_modes[si] == "direct":
+                        pcols.append(v.astype(jnp.int32).ravel() * vpairs)
+                    else:
+                        pcols.extend(_limb_cols(
+                            v.astype(jnp.int32).ravel(), vpairs))
+                    pcols.append(vpairs)
+                else:   # min / max — a selection; pairs only gate entry
+                    pmm[si] = ops_agg.group_min_max(
+                        gcodes, jnp.logical_and(m, cnt > 0),
+                        v.astype(jnp.int32), group_space, spec.func)
+                    pcols.append(vpairs)
+            else:
+                vcnt = bacc[:, bstart[si]]
+                gathered_cnt = jnp.where(pmask, vcnt[pc], 0).ravel()
+                pstart[si] = len(pcols)
+                if spec.func == "count":
+                    pcols.append(gathered_cnt)
+                elif spec.func in ("sum", "avg"):
+                    if sum_modes[si] == "direct":
+                        partial = bacc[:, bstart[si] + 1]
+                        pcols.append(
+                            jnp.where(pmask, partial[pc], 0).ravel())
+                    else:
+                        lim = bacc[:, bstart[si] + 1:
+                                   bstart[si] + 6][pc.ravel()]
+                        lim = lim * pmi[:, None]           # (n, 5)
+                        pcols.extend([lim[:, j] for j in range(5)])
+                    pcols.append(gathered_cnt)
+                else:
+                    mmv = bmm[si][pc]
+                    m2 = jnp.logical_and(pmask, vcnt[pc] > 0)
+                    pmm[si] = ops_agg.group_min_max(
+                        gcodes, m2, mmv, group_space, spec.func)
+                    pcols.append(gathered_cnt)
+        acc = jnp.zeros((group_space, len(pcols)), jnp.int32) \
+            .at[gc].add(jnp.stack(pcols, axis=1))
+
+        # slice the fused accumulator back into the per-agg output spec
+        # (bit-identical to the one-scatter-per-aggregate layout)
+        outputs = [acc[:, 0]]
+        for si, (spec, side, ce) in enumerate(agg_plans):
+            if spec.func == "count_star":
+                continue
+            start = pstart[si]
+            if spec.func == "count":
+                outputs.append(acc[:, start])
+            elif spec.func in ("sum", "avg"):
+                if sum_modes[si] == "direct":
+                    outputs.append(acc[:, start])
+                    outputs.append(acc[:, start + 1])
+                else:
+                    outputs.append(acc[:, start:start + 5])
+                    outputs.append(acc[:, start + 5])
+            else:
+                outputs.append(pmm[si])
+                outputs.append(acc[:, start])
+        return tuple(outputs)
+
+    # program cache: publications + ranges + expression shapes key the
+    # compiled XLA executable (data-dependent constants — FoR offsets,
+    # key plans, code space — are closed over, so versions must key)
+    cache_key = ("fused", probe.pub, build.pub, probe.zrange, build.zrange,
+                 tuple(_expr_key(p) for p in ppreds),
+                 tuple(_expr_key(p) for p in bpreds),
+                 tuple(_expr_key(p) for p in post_preds), keyset,
+                 tuple((s.func, _expr_key(s.arg) if s.arg is not None
+                        else None) for s in node.aggs),
+                 tuple(_expr_key(gx) for gx in node.group_exprs))
+    jitted = _PROGRAM_CACHE.get(cache_key)
+    if jitted is None:
+        jitted = jax.jit(program)
+        _PROGRAM_CACHE[cache_key] = jitted
+
+    flat_args = []
+    for ji in needed:
+        dc = env_cols[ji]
+        flat_args.extend([dc.data, dc.mask])
+    flat_args.extend([bc_dev, pc_dev, brow, prow])
+
+    from .plan import check_cancel
+    check_cancel()
+    t0 = clock()
+    metrics.DEVICE_OFFLOADS.add()
+    results = jitted(*flat_args)
+    out = _finalize(node, key_plans, agg_plans, results, probe, pscan,
+                    dictionaries, group_space, group_mode, sum_modes)
+    if prof is not None:
+        prof.add_device_ns(id(node), clock() - t0)
+    return out
+
+
+def _mm_ident(func: str) -> int:
+    info = np.iinfo(np.int32)
+    return info.max if func == "min" else info.min
+
+
+def _limb_cols(vals, weights) -> list:
+    """Exact weighted int-sum columns: the 8-bit limb decomposition of
+    ops_agg.group_sum_int_limbs, multiplicity-weighted and returned as
+    5 per-row int32 columns [4 byte-limbs · w, (v < 0) · w] for the
+    caller's fused scatter; host recombines in int64
+    (ops_agg.combine_sum_int_limbs). Exact while 255 · Σw < 2^31 per
+    group (the MAX_PAIRS_EXACT admission bound)."""
+    import jax.numpy as jnp
+    vu = jax.lax.bitcast_convert_type(vals, jnp.uint32)
+    cols = [(jnp.right_shift(vu, 8 * limb) &
+             jnp.uint32(0xFF)).astype(jnp.int32) * weights
+            for limb in range(4)]
+    cols.append((vals < 0).astype(jnp.int32) * weights)
+    return cols
+
+
+def _code_tiles(codes: np.ndarray, sentinel: int) -> "jax.Array":
+    """Factorized join codes → int32 device tiles; padding rows take the
+    side's never-matches sentinel."""
+    import jax.numpy as jnp
+    n = len(codes)
+    n_pad = pad_len(n)
+    padded = np.full(n_pad, sentinel, dtype=np.int32)
+    padded[:n] = codes
+    return jnp.asarray(padded.reshape(-1, LANES))
+
+
+def _join_codes(join, probe: _Side, build: _Side
+                ) -> tuple[np.ndarray, np.ndarray, int, int]:
+    """PR-3 key-code factorization over BOTH sides (one shared dense
+    int64 code space), with NULL-key rows already rewritten to the
+    per-side sentinel (g for build, g+1 for probe) so NULL never
+    matches, plus the worst-case matched-pair count for the exactness
+    admission (computed over the UNSLICED sides — an upper bound of any
+    zone-sliced run, so admission stays sound). Cached per publication
+    pair — repeat queries skip both the O(n log n) factorize and the
+    O(n) pair count."""
+    from .morsel import combined_codes, rows_valid
+    keyset = (tuple(_expr_key(k) for k in join.left_keys),
+              tuple(_expr_key(k) for k in join.right_keys))
+    ck = (probe.pub, build.pub, keyset)
+    with _codes_lock:
+        hit = _CODES_CACHE.get(ck)
+        if hit is not None:
+            _CODES_CACHE.move_to_end(ck)
+            return hit
+    pbatch = probe.pin[0] if probe.pin is not None \
+        else probe.provider.full_batch(probe.scan.columns)
+    bbatch = build.pin[0] if build.pin is not None \
+        else build.provider.full_batch(build.scan.columns)
+    pbatch = Batch(list(probe.scan.columns),
+                   [pbatch.column(c) for c in probe.scan.columns])
+    bbatch = Batch(list(build.scan.columns),
+                   [bbatch.column(c) for c in build.scan.columns])
+    try:
+        lkeys = [k.eval(pbatch) for k in join.left_keys]
+        rkeys = [k.eval(bbatch) for k in join.right_keys]
+    except Exception as e:
+        # the host path evaluates keys only over filter-surviving rows;
+        # an eval error on a filtered-out row must fall back, not surface
+        raise NotCompilable(f"key eval over unfiltered rows: {e}")
+    pair = combined_codes(lkeys, rkeys)
+    if pair is None:
+        raise NotCompilable("join keys have no shared code representation")
+    cl, cr, g = pair
+    lvalid = rows_valid(lkeys)
+    rvalid = rows_valid(rkeys)
+    cl = cl.astype(np.int64)
+    cr = cr.astype(np.int64)
+    if lvalid is not None:
+        cl = np.where(lvalid, cl, g + 1)
+    if rvalid is not None:
+        cr = np.where(rvalid, cr, g)
+    total_pairs = 0
+    if len(cl) and len(cr) and g:
+        bc_counts = np.bincount(cr[cr < g], minlength=g)
+        pl = cl[cl < g]
+        total_pairs = int(bc_counts[pl].sum()) if len(pl) else 0
+    value = (cl, cr, g, total_pairs)
+    nbytes = int(cl.nbytes) + int(cr.nbytes)
+    global _codes_bytes
+    with _codes_lock:
+        # superseded generations of the same (table pair, keyset) are
+        # unreachable — publications are monotone — sweep them first
+        stale = [k for k in _CODES_CACHE
+                 if k[2] == keyset and k[0][0] == ck[0][0] and
+                 k[1][0] == ck[1][0] and k != ck]
+        for k in stale:
+            old = _CODES_CACHE.pop(k)
+            _codes_bytes -= int(old[0].nbytes) + int(old[1].nbytes)
+        while _CODES_CACHE and (
+                len(_CODES_CACHE) >= _CODES_CACHE_MAX or
+                _codes_bytes + nbytes > _CODES_CACHE_MAX_BYTES):
+            _, old = _CODES_CACHE.popitem(last=False)
+            _codes_bytes -= int(old[0].nbytes) + int(old[1].nbytes)
+        _CODES_CACHE[ck] = value
+        _codes_bytes += nbytes
+    return value
+
+
+def _plan_group_keys(node, join_types, probe: _Side, pscan, dictionaries
+                     ) -> tuple[list, int]:
+    """Direct coding of the probe-side group keys (device_agg's
+    _plan_direct_keys, join-namespace variant): dictionary codes for
+    strings, offset small-range coding for ints; the NULL group takes
+    slot size-1, matching factorize_keys' (values asc, NULL last)
+    composite order so the host oracle's group order is reproduced."""
+    key_plans = []
+    group_space = 1
+    for gx in node.group_exprs:
+        t = join_types[gx.index]
+        if t.is_string:
+            d = dictionaries.get(gx.index)
+            if d is None:
+                raise NotCompilable("string group key without dictionary")
+            size = len(d) + 1
+            key_plans.append(("dict", gx.index, 0, size))
+        elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
+            col = probe.host_col(pscan.columns[gx.index])
+            if col.data.size == 0:
+                lo, hi = 0, 0
+            else:
+                lo, hi = int(col.data.min()), int(col.data.max())
+            rng = hi - lo + 1
+            if rng > MAX_INT_KEY_RANGE:
+                raise NotCompilable("group key range too large")
+            if not (-2**31 <= lo and hi < 2**31):
+                raise NotCompilable("group key offset beyond int32")
+            size = rng + 1
+            key_plans.append(("int", gx.index, lo, size))
+        else:
+            raise NotCompilable(f"group key type {t}")
+        group_space *= size
+        if group_space > MAX_GROUP_PRODUCT:
+            raise NotCompilable("group code space too large")
+    return key_plans, group_space
+
+
+def _zero_results(agg_plans, group_space: int, sum_modes: dict) -> list:
+    """Host-side zero accumulators matching the program's output spec —
+    the no-surviving-rows short-circuit (empty table or every block
+    zone-pruned) never dispatches."""
+    out = [np.zeros(group_space, dtype=np.int32)]
+    for si, (spec, side, ce) in enumerate(agg_plans):
+        if spec.func == "count_star":
+            continue
+        if spec.func == "count":
+            out.append(np.zeros(group_space, dtype=np.int32))
+        elif spec.func in ("sum", "avg"):
+            if sum_modes[si] == "direct":
+                out.append(np.zeros(group_space, dtype=np.int32))
+            else:
+                out.append(np.zeros((group_space, 5), dtype=np.int32))
+            out.append(np.zeros(group_space, dtype=np.int32))
+        else:
+            out.append(np.full(group_space, _mm_ident(spec.func),
+                               dtype=np.int32))
+            out.append(np.zeros(group_space, dtype=np.int32))
+    return out
+
+
+def _finalize(node, key_plans, agg_plans, results, probe: _Side, pscan,
+              dictionaries, group_space: int, group_mode: bool,
+              sum_modes: dict) -> Batch:
+    """Device accumulators → result batch, bit-matching the host oracle:
+    groups emit in ascending composite-code order (= factorize_keys
+    order), int sums recombine from limbs in int64, empty groups /
+    scalar aggregates go NULL exactly where the oracle's do."""
+    ri = iter(results)
+    pair_counts = np.asarray(next(ri)).astype(np.int64)
+    if group_mode:
+        present = np.flatnonzero(pair_counts > 0)
+    else:
+        present = np.asarray([0])
+    cols: list[Column] = []
+    if group_mode:
+        sizes = [kp[3] for kp in key_plans]
+        rem = present.copy()
+        key_codes = []
+        for size in reversed(sizes):
+            key_codes.append(rem % size)
+            rem //= size
+        key_codes.reverse()
+        for pos, ((kind, ji, lo, size), kc) in \
+                enumerate(zip(key_plans, key_codes)):
+            null_mask = kc == (size - 1)
+            t = node.group_exprs[pos].type
+            if kind == "dict":
+                d = dictionaries[ji]
+                data = np.where(null_mask, 0, kc).astype(np.int32)
+                cols.append(Column(
+                    t, data, ~null_mask if null_mask.any() else None, d))
+            else:
+                data = (kc + lo).astype(t.np_dtype)
+                data = np.where(null_mask, 0, data).astype(t.np_dtype)
+                cols.append(Column(
+                    t, data, ~null_mask if null_mask.any() else None))
+    for si, (spec, side, ce) in enumerate(agg_plans):
+        cols.append(_agg_result_col(spec, ri, pair_counts, present,
+                                    group_mode,
+                                    sum_modes.get(si, "limb")))
+    return Batch(list(node.names), cols)
+
+
+def _agg_result_col(spec: AggSpec, ri, pair_counts, present,
+                    group_mode: bool, sum_mode: str = "limb") -> Column:
+    t = spec.type
+    if spec.func == "count_star":
+        if group_mode:
+            return Column(dt.BIGINT, pair_counts[present])
+        return Column.from_pylist([int(pair_counts[0])], t)
+    if spec.func == "count":
+        c = np.asarray(next(ri)).astype(np.int64)
+        if group_mode:
+            return Column(dt.BIGINT, c[present])
+        return Column.from_pylist([int(c[0])], t)
+    if spec.func in ("sum", "avg"):
+        raw = np.asarray(next(ri))
+        cnt = np.asarray(next(ri)).astype(np.int64)
+        sums = raw.astype(np.int64) if sum_mode == "direct" \
+            else ops_agg.combine_sum_int_limbs(raw)
+        if group_mode:
+            sums, cnt = sums[present], cnt[present]
+            empty = cnt == 0
+            if spec.func == "avg":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    data = np.where(empty, 0.0, sums / np.maximum(cnt, 1))
+                return Column(dt.DOUBLE, data,
+                              ~empty if empty.any() else None)
+            if t.is_integer:
+                return Column(dt.BIGINT, sums,
+                              ~empty if empty.any() else None)
+            return Column(dt.DOUBLE, sums.astype(np.float64),
+                          ~empty if empty.any() else None)
+        s, n = int(sums[0]), int(cnt[0])
+        if n == 0:
+            return Column.from_pylist([None], t)
+        if spec.func == "avg":
+            return Column.from_pylist([s / n], t)
+        return Column.from_pylist([s if t.is_integer else float(s)], t)
+    if spec.func in ("min", "max"):
+        v = np.asarray(next(ri)).astype(np.int64)
+        cnt = np.asarray(next(ri)).astype(np.int64)
+        at = spec.arg.type
+        if group_mode:
+            v, cnt = v[present], cnt[present]
+            empty = cnt == 0
+            data = np.where(empty, 0, v).astype(at.np_dtype)
+            return Column(at, data, ~empty if empty.any() else None)
+        if int(cnt[0]) == 0:
+            return Column.from_pylist([None], t)
+        out = int(v[0])
+        if at.id is dt.TypeId.BOOL:
+            out = bool(out)
+        return Column.from_pylist([out], t)
+    raise NotCompilable(spec.func)
+
+
+# -- fused filtered top-N ----------------------------------------------------
+
+
+def _col_stats(side: _Side, name: str) -> tuple:
+    """(all_valid, finite_all, lo, hi) of one column — a pure function
+    of the publication, so cached repeats skip the O(n) host scans.
+    lo/hi are None for float columns (only finiteness gates those) and
+    span EVERY slot including NULL ones (garbage under an invalid slot
+    widens the range, which can only make callers more conservative)."""
+    ck = (side.pub, name)
+    with _col_stats_lock:
+        hit = _COL_STATS_CACHE.get(ck)
+        if hit is not None:
+            _COL_STATS_CACHE.move_to_end(ck)
+            return hit
+    host = side.host_col(name)
+    all_valid = bool(host.valid_mask().all())
+    if host.data.dtype.kind == "f":
+        stats = (all_valid, bool(np.isfinite(host.data).all()), None, None)
+    elif host.data.size == 0:
+        stats = (all_valid, True, 0, 0)
+    else:
+        stats = (all_valid, True,
+                 int(host.data.min()), int(host.data.max()))
+    with _col_stats_lock:
+        while len(_COL_STATS_CACHE) >= _COL_STATS_MAX:
+            _COL_STATS_CACHE.popitem(last=False)
+        _COL_STATS_CACHE[ck] = stats
+    return stats
+
+
+def try_device_fused_topn(limit_node, ctx) -> Optional[Batch]:
+    """One-dispatch ORDER BY col LIMIT k over a FILTERED scan: the
+    compiled predicate masks filtered-out rows to the sort sentinel
+    inside the same program as `top_k`, so Filter→Sort→Limit is one
+    dispatch (device_topn covers only the unfiltered shape). None → CPU
+    lexsort oracle."""
+    from .plan import FilterNode, ProjectNode, ScanNode, SortNode
+    from .device_topn import MAX_TOPN_K
+
+    settings = ctx.settings
+    if settings.get("serene_device") == "cpu" or not fused_enabled(settings):
+        return None
+    if limit_node.limit is None:
+        return None
+    k = limit_node.limit + limit_node.offset
+    if k == 0 or k > MAX_TOPN_K:
+        return None
+    sort = limit_node.child
+    if not isinstance(sort, SortNode) or len(sort.key_indices) != 1 or \
+            sort.nulls_first[0] is not None:
+        return None
+    proj = None
+    inner = sort.child
+    if isinstance(inner, ProjectNode):
+        proj = inner
+        inner = inner.child
+    side = _unwrap_side(inner)
+    if side is None or not side[1]:
+        return None       # unfiltered shape: device_topn's territory
+    scan, preds = side
+    ki = sort.key_indices[0]
+    if proj is not None:
+        # plain column projections only: the host oracle evaluates the
+        # Project over EVERY filter-surviving row, the fused path over
+        # only the k selected ones — a computed expression that raises
+        # (100/b with a zero outside the top k) or draws state would
+        # diverge, so anything beyond column selection/reorder falls back
+        if not all(isinstance(e, BoundColumn) for e in proj.exprs):
+            return None
+        ki = proj.exprs[ki].index
+    t = scan.types[ki]
+    if not (t.is_integer or t.id in (dt.TypeId.DATE, dt.TypeId.FLOAT)):
+        return None
+    provider = scan.provider
+    if settings.get("serene_device") == "auto":
+        try:
+            if provider.row_count() < settings.get("serene_device_min_rows"):
+                return None
+        except NotImplementedError:
+            return None
+    desc = bool(sort.descs[0])
+    try:
+        prof = getattr(ctx, "profile", None)
+        t0 = time.perf_counter_ns() if prof is not None else 0
+        out = _run_fused_topn(limit_node, scan, preds, ki, desc, k, ctx,
+                              proj)
+        if prof is not None:
+            prof.add_device_ns(id(limit_node),
+                               time.perf_counter_ns() - t0)
+        return out
+    except (NotCompilable, DeviceNarrowingError) as e:
+        log.debug("device", f"fused top-N fell back to CPU: {e}")
+        return None
+
+
+def _run_fused_topn(limit_node, scan, preds, ki: int, desc: bool, k: int,
+                    ctx, proj=None) -> Optional[Batch]:
+    import jax.numpy as jnp
+    from .device_topn import _I32_MIN, _I32_MAX
+    from .plan import check_cancel
+
+    side = _Side(scan, preds, ctx)
+    if side.nrows == 0 or side.n_live == 0:
+        from .plan import empty_batch
+        if proj is not None:
+            return empty_batch(list(proj.names),
+                               [e.type for e in proj.exprs])
+        return empty_batch(list(scan.names), list(scan.types))
+    name = scan.columns[ki]
+    all_valid, finite_all, lo_v, hi_v = _col_stats(side, name)
+    if not all_valid:
+        raise NotCompilable("top-N key column has NULLs")
+    if lo_v is None:                         # float key
+        if not finite_all:
+            raise NotCompilable("top-N float key has NaN/inf")
+    else:
+        if desc and lo_v <= _I32_MIN:
+            raise NotCompilable("key touches int32 min")
+        if not desc and hi_v >= _I32_MAX:
+            raise NotCompilable("key touches int32 max")
+
+    dicts = {}
+    for e in preds:
+        for sub in e.walk():
+            if isinstance(sub, BoundColumn) and sub.type.is_string and \
+                    sub.index not in dicts:
+                col = side.host_col(scan.columns[sub.index])
+                if col.dictionary is not None:
+                    dicts[sub.index] = col.dictionary
+    compiled = [compile_expr(p, scan.types, dicts) for p in preds]
+
+    needed = sorted({ki} | {i for ce in compiled for i in ce.inputs})
+    env_cols = {
+        i: DEVICE_CACHE.column(side.provider, side.pub, scan.columns[i],
+                               (lambda s=side, n=scan.columns[i]:
+                                s.host_col(n)), side.zrange)
+        for i in needed}
+    rowmask = DEVICE_CACHE.array(side.pub, "__rowmask__", (side.zrange,),
+                                 lambda: _rowmask_tiles(side.n_live))
+    kc = env_cols[ki]
+    is_float = kc.data.dtype.kind == "f"
+    if int(kc.data.shape[0]) * LANES < k:
+        raise NotCompilable("k exceeds padded rows")
+
+    decode_specs = [(env_cols[i].scheme, env_cols[i].offset) for i in needed]
+    kpos = needed.index(ki)
+
+    cache_key = ("fusedtopn", side.pub, side.zrange, name, desc, k,
+                 tuple(_expr_key(p) for p in preds))
+    jitted = _PROGRAM_CACHE.get(cache_key)
+    if jitted is None:
+        def program(*flat):
+            arrays = {}
+            for j, i in enumerate(needed):
+                data = flat[2 * j]
+                scheme, off = decode_specs[j]
+                if scheme != "raw":
+                    data = data.astype(jnp.int32) + jnp.int32(off)
+                arrays[i] = (data, flat[2 * j + 1])
+            mask = flat[-1]
+            for ce in compiled:
+                v, ok = ce.fn([arrays[i] for i in ce.inputs])
+                b = v if v.dtype == jnp.bool_ else (v != 0)
+                mask = jnp.logical_and(mask, jnp.logical_and(b, ok))
+            v = arrays[needed[kpos]][0]
+            if is_float:
+                keys = v if desc else -v
+                sent = jnp.float32(-jnp.inf)
+            else:
+                v = v.astype(jnp.int32)
+                keys = v if desc else ~v
+                sent = jnp.int32(_I32_MIN)
+            keys = jnp.where(mask.ravel(), keys.ravel(), sent)
+            kk, ii = jax.lax.top_k(keys, k)
+            return kk, ii.astype(jnp.int32), \
+                jnp.sum(mask, dtype=jnp.int32)
+
+        jitted = jax.jit(program)
+        _PROGRAM_CACHE[cache_key] = jitted
+
+    flat_args = []
+    for i in needed:
+        dc = env_cols[i]
+        flat_args.extend([dc.data, dc.mask])
+    flat_args.append(rowmask)
+    check_cancel()
+    metrics.DEVICE_OFFLOADS.add()
+    kk, ii, nsurv = jitted(*flat_args)
+    idx = np.asarray(ii).astype(np.int64)
+    k_eff = min(k, int(np.asarray(nsurv)))
+    idx = idx[:k_eff]
+    if side.zrange is not None:
+        idx = idx + side.zrange[0]
+    idx = idx[limit_node.offset:]
+    if side.pin is not None and all(c in side.pin[0] for c in scan.columns):
+        base = Batch(list(scan.columns),
+                     [side.pin[0].column(c) for c in scan.columns])
+    else:
+        base = side.provider.full_batch(scan.columns)
+    base = base.take(idx)
+    if proj is None:
+        return base
+    return Batch(list(proj.names), [e.eval(base) for e in proj.exprs])

@@ -331,8 +331,15 @@ class LimitNode(PlanNode):
 
     def batches(self, ctx):
         if isinstance(self.child, SortNode):
-            from .device_topn import try_device_topn
-            out = try_device_topn(self, ctx)
+            # fused device top-N first: it owns the FILTERED shape
+            # (predicate masks to the sort sentinel inside the same
+            # program as top_k); the unfiltered shape stays with
+            # device_topn, and both decline overlapping territory
+            from .device_pipeline import try_device_fused_topn
+            out = try_device_fused_topn(self, ctx)
+            if out is None:
+                from .device_topn import try_device_topn
+                out = try_device_topn(self, ctx)
             if out is not None:
                 yield out
                 return
@@ -1010,6 +1017,15 @@ class AggregateNode(PlanNode):
         fast = self._try_count_fast_path(ctx)
         if fast is not None:
             yield fast
+            return
+        # fused relational pipeline first: Aggregate over an inner
+        # equi-join of two (filtered) scans runs as ONE device dispatch
+        # (exec/device_pipeline.py); single-table chains stay with
+        # try_device_aggregate below
+        from .device_pipeline import try_device_pipeline
+        result = try_device_pipeline(self, ctx)
+        if result is not None:
+            yield result
             return
         from .device_agg import try_device_aggregate
         result = try_device_aggregate(self, ctx)

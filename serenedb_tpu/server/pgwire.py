@@ -136,6 +136,32 @@ def pg_binary(value, typ: dt.SqlType) -> Optional[bytes]:
     return encode_value(value, typ)
 
 
+async def upgrade_writer_tls(writer: asyncio.StreamWriter, ctx) -> None:
+    """In-band TLS upgrade of an established stream pair.
+
+    `StreamWriter.start_tls` is 3.11+; on 3.10 run `loop.start_tls`
+    over the writer's transport/protocol directly and re-point the
+    writer, the protocol, and the reader's flow-control transport at
+    the SSL transport (exactly what 3.11's implementation does —
+    `loop.start_tls` wraps with call_connection_made=False, so none of
+    this re-runs `connection_made`)."""
+    if hasattr(writer, "start_tls"):        # 3.11+
+        await writer.start_tls(ctx)
+        return
+    await writer.drain()
+    loop = asyncio.get_running_loop()
+    transport = writer.transport
+    protocol = transport.get_protocol()
+    new_transport = await loop.start_tls(
+        transport, protocol, ctx, server_side=True)
+    writer._transport = new_transport
+    protocol._transport = new_transport
+    protocol._over_ssl = True
+    reader = getattr(protocol, "_stream_reader", None)
+    if reader is not None:
+        reader._transport = new_transport
+
+
 class Writer:
     def __init__(self, transport: asyncio.StreamWriter, db=None):
         self.t = transport
@@ -402,7 +428,7 @@ class PgSession:
                     await self.w.t.drain()
                     # in-band upgrade (reference: MaybeTls,
                     # tls_context.cpp); the stream pair survives start_tls
-                    await self.w.t.start_tls(ctx)
+                    await upgrade_writer_tls(self.w.t, ctx)
                     self.tls_active = True
                 else:
                     self.w.t.write(b"N")

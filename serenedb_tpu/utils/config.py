@@ -135,6 +135,22 @@ declare("serene_device_chunk_rows", 1 << 21, int,
         "device aggregate dispatches split into row chunks of this size "
         "so cancel/statement_timeout fire between chunks (~one chunk's "
         "latency); 0 disables chunking")
+declare("serene_device_fused", True, bool,
+        "fused device relational pipelines (exec/device_pipeline.py): "
+        "Scan→Filter→Join→Aggregate chains and filtered top-N compile "
+        "into ONE jitted device program over publication-cached HBM "
+        "columns instead of one host kernel per operator; anything the "
+        "fused compiler can't prove exact falls back to the host path, "
+        "which stays on as the bit-identical parity oracle — results "
+        "are identical on or off at any serene_workers setting")
+declare("serene_device_cache_mb", 256, int,
+        "byte cap (MB) of the process-wide device column cache "
+        "(exec/device_pipeline.DEVICE_CACHE): device-resident column "
+        "tiles and join-code uploads keyed by publication tuples, so "
+        "repeat queries over unchanged tables skip host→device "
+        "transfer entirely; least-recently-used entries evict past the "
+        "cap and superseded generations are swept eagerly on store",
+        scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
 declare("serene_mesh", 0, int,
         "shard device programs across an N-device jax mesh (0 = single "
         "device); grouped aggregates and BM25 top-k run as shard_map "

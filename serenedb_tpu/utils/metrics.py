@@ -30,6 +30,12 @@ class Gauge:
     def sub(self, n: int = 1) -> None:
         self.add(-n)
 
+    def set(self, n: int) -> None:
+        """Overwrite the level (byte-size gauges that track a cache's
+        current footprint rather than accumulate a count)."""
+        with self._lock:
+            self._value = n
+
     def add_time_ns(self, start_ns: int,
                     now_ns: Optional[int] = None) -> int:
         """Accumulate one elapsed interval atomically: adds
@@ -94,6 +100,20 @@ COMPACTION_PENDING = REGISTRY.gauge("CompactionPending", "queued compactions")
 CLEANUP_ACTIVE = REGISTRY.gauge("CleanupActive", "running cleanup tasks")
 DEVICE_OFFLOADS = REGISTRY.gauge("DeviceOffloads", "batches dispatched to TPU")
 DEVICE_BYTES = REGISTRY.gauge("DeviceBytesMoved", "bytes copied host->device")
+DEVICE_CACHE_HITS = REGISTRY.gauge(
+    "DeviceCacheHits",
+    "device column cache probes served from HBM-resident uploads "
+    "(host->device transfer skipped)")
+DEVICE_CACHE_MISSES = REGISTRY.gauge(
+    "DeviceCacheMisses",
+    "device column cache probes that had to upload from host")
+DEVICE_CACHE_EVICTIONS = REGISTRY.gauge(
+    "DeviceCacheEvictions",
+    "device column cache entries dropped (LRU past the byte cap or a "
+    "superseded publication swept on store)")
+DEVICE_CACHE_BYTES = REGISTRY.gauge(
+    "DeviceCacheBytes",
+    "current bytes held by the device column cache")
 WAL_COMMITS = REGISTRY.gauge("WalCommits", "search WAL commit records written")
 POOL_MORSELS = REGISTRY.gauge("PoolMorselsExecuted",
                               "morsel tasks executed by the worker pool")

@@ -68,6 +68,18 @@ if os.environ.get("SERENE_RESULT_CACHE"):
                            os.environ["SERENE_RESULT_CACHE"])
 
 
+# scripts/verify_tier1.sh fused-pipeline parity leg: force
+# serene_device_fused to the given value ("on"/"off") for a whole run —
+# the off pass proves the fused device tier is an optimization layer
+# only (every suite passes with it globally dark), the on pass that the
+# one-dispatch programs are bit-identical to the host oracle.
+if os.environ.get("SERENE_DEVICE_FUSED"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_DF
+
+    _SDB_REG_DF.set_global("serene_device_fused",
+                           os.environ["SERENE_DEVICE_FUSED"])
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

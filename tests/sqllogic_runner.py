@@ -49,6 +49,19 @@ class Record:
     rowsort: bool = False
 
 
+def substitute_tmpdir(sql: str, tmpdir: Optional[str]) -> str:
+    """Replace the `__TMPDIR__` placeholder in behavior-file SQL with the
+    run's scratch directory, so COPY TO/FROM and read_csv/read_parquet
+    paths land in per-test tmp instead of whatever the process CWD is
+    (historically the repo root, which collected stray artifacts)."""
+    if "__TMPDIR__" not in sql:
+        return sql
+    if tmpdir is None:
+        raise ValueError("behavior file uses __TMPDIR__ but the runner "
+                         "was not given a tmpdir")
+    return sql.replace("__TMPDIR__", str(tmpdir))
+
+
 def parse_test_file(path: str) -> list[Record]:
     with open(path) as f:
         lines = f.read().split("\n")
@@ -153,7 +166,8 @@ def compare_query(rec: Record, actual: list[str], where: str,
                         f"  actual:   {actual}")
 
 
-def run_test_file_wire(execute, path: str) -> list[str]:
+def run_test_file_wire(execute, path: str,
+                       tmpdir: Optional[str] = None) -> list[str]:
     """Run one behavior file over a LIVE pg-wire connection — the parity
     contract crosses the protocol serde it certifies (reference: the
     sqllogictest-rs harness runs every file over 4 wire protocol modes,
@@ -172,7 +186,7 @@ def run_test_file_wire(execute, path: str) -> list[str]:
             failures.append(f"{where}: recovery/connection directive in "
                             "a wire run")
             break
-        rows, err = execute(rec.sql)
+        rows, err = execute(substitute_tmpdir(rec.sql, tmpdir))
         if rec.kind == "statement":
             if rec.expect_error is None:
                 if err is not None:
@@ -193,8 +207,8 @@ def run_test_file_wire(execute, path: str) -> list[str]:
     return failures
 
 
-def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
-        list[str]:
+def run_test_file(conn, path: str, reopen=None, crash_reopen=None,
+                  tmpdir: Optional[str] = None) -> list[str]:
     """Run one file; returns a list of failure descriptions (empty = pass).
 
     `reopen()` → fresh conn after a clean close (the `restart` directive);
@@ -222,7 +236,7 @@ def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
             continue
         if rec.kind == "statement" and rec.expect_error == "__crash__":
             try:
-                conn.execute(rec.sql)
+                conn.execute(substitute_tmpdir(rec.sql, tmpdir))
                 failures.append(f"{where}: expected crash, got success")
             except FaultInjected:
                 if crash_reopen is None:
@@ -233,7 +247,7 @@ def run_test_file(conn, path: str, reopen=None, crash_reopen=None) -> \
                 failures.append(f"{where}: wanted crash fault, got {e!r}")
             continue
         try:
-            result = conn.execute(rec.sql)
+            result = conn.execute(substitute_tmpdir(rec.sql, tmpdir))
             if rec.kind == "statement" and rec.expect_error is not None:
                 failures.append(f"{where}: expected error, got success")
                 continue

@@ -94,7 +94,7 @@ def test_live_results_preferred_over_ledger(bench, capsys, monkeypatch):
                         lambda t=75.0: (True, False, ""))
     monkeypatch.setattr(
         bench, "_run_shape_subprocess",
-        lambda name, timeout_s: ({"speedup": 5.0, "extra": {}}, "")
+        lambda name, timeout_s, **kw: ({"speedup": 5.0, "extra": {}}, "")
         if name == "q1" else ({}, "boom"))
     monkeypatch.setenv("SDB_BENCH_BUDGET_S", "100000")
     out = _run_main(bench, capsys)
@@ -113,7 +113,7 @@ def test_deterministic_shape_failure_does_not_use_ledger(bench, capsys,
                         lambda t=75.0: (True, False, ""))
     monkeypatch.setattr(
         bench, "_run_shape_subprocess",
-        lambda name, timeout_s:
+        lambda name, timeout_s, **kw:
         ({}, "AssertionError: device/CPU result mismatch in Q1 bench"))
     monkeypatch.setenv("SDB_BENCH_BUDGET_S", "100000")
     out = _run_main(bench, capsys)
@@ -131,7 +131,7 @@ def test_timeout_failure_does_use_ledger(bench, capsys, monkeypatch):
                         lambda t=75.0: (True, False, ""))
     monkeypatch.setattr(
         bench, "_run_shape_subprocess",
-        lambda name, timeout_s:
+        lambda name, timeout_s, **kw:
         ({}, "timeout: shape timed out (device hang mid-run?)"))
     monkeypatch.setenv("SDB_BENCH_BUDGET_S", "100000")
     out = _run_main(bench, capsys)

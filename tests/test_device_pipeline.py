@@ -1,0 +1,323 @@
+"""Parity suite for the fused device relational pipeline (ISSUE 7).
+
+Contract under test: `serene_device_fused = on` (the default) compiles
+Scan→Filter→Join→Aggregate chains and filtered top-N into ONE jitted
+device program (exec/device_pipeline.py) whose results are BIT-IDENTICAL
+to the host oracle (`serene_device_fused = off`) across the full matrix —
+fused on/off × `serene_workers` 1/N × `serene_zonemap` on/off — including
+NULL and NaN join keys, dictionary-encoded strings, and empty /
+all-zone-pruned scans. Plus the publication-keyed device column cache:
+repeat queries hit HBM-resident uploads, any write moves the key, the
+byte cap LRU-evicts, and superseded generations are swept on store.
+"""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.utils import metrics
+from serenedb_tpu.utils.config import REGISTRY as SETTINGS
+
+
+def _mk_conn(nl=6000, nr=3000, seed=3):
+    """Two joinable tables covering every key/arg dtype the matrix
+    needs: INT keys with NULLs, dictionary TEXT, DOUBLE keys with NULLs
+    and NaNs, clustered BIGINT for zone-map pruning, int payloads."""
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE l (ik INT, sk TEXT, fk DOUBLE, ts BIGINT, "
+              "v BIGINT, bv BIGINT)")
+    c.execute("CREATE TABLE r (ik INT, sk TEXT, fk DOUBLE, w BIGINT, "
+              "bv BIGINT)")
+
+    def mk(n, null_frac, sd, payload, with_ts):
+        rng = np.random.default_rng(sd)
+        ik = rng.integers(0, 40, n).astype(np.int32)
+        ikv = rng.random(n) > null_frac
+        fk = np.round(rng.normal(size=n), 1)    # rounding ⇒ cross-side dups
+        fk[rng.random(n) < 0.05] = np.nan
+        fkv = rng.random(n) > 0.1
+        cols = {
+            "ik": Column(dt.INT, ik, ikv),
+            "sk": Column.from_numpy(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+            "fk": Column(dt.DOUBLE, fk, fkv),
+        }
+        if with_ts:
+            cols["ts"] = Column.from_numpy(np.arange(n, dtype=np.int64))
+        cols[payload] = Column.from_numpy(
+            rng.integers(-500, 500, n, dtype=np.int64))
+        # wide values: |bv|·pairs overflows the direct-scatter bound, so
+        # plain-column sums of bv exercise the limb path
+        cols["bv"] = Column.from_numpy(
+            rng.integers(-(10 ** 9), 10 ** 9, n, dtype=np.int64))
+        return Batch.from_pydict(cols)
+
+    db.schemas["main"].tables["l"] = MemTable(
+        "l", mk(nl, 0.1, seed, "v", True))
+    db.schemas["main"].tables["r"] = MemTable(
+        "r", mk(nr, 0.15, seed + 1, "w", False))
+    c.execute("SET serene_device = 'tpu'")       # force the device tier
+    c.execute("SET serene_device_fused = on")    # deterministic vs globals
+    c.execute("SET serene_result_cache = off")   # assert EXECUTION internals
+    c.execute("SET serene_morsel_rows = 1024")   # zone maps at test size
+    c.execute("SET serene_parallel_min_rows = 1024")
+    return c
+
+
+def _rows(c, q):
+    """repr-keyed capture: bit-identical comparison that still treats a
+    NaN as equal to itself (tuple == would fail NaN-bearing rows even
+    when both sides are the same bits)."""
+    return repr(c.execute(q).rows())
+
+
+FUSED_QUERIES = [
+    # scalar aggregates, both-side args, every admitted function
+    "SELECT count(*), sum(v), sum(w), min(v), max(w), avg(v) "
+    "FROM l JOIN r ON l.ik = r.ik",
+    # probe-side / build-side / both-side filters (scan-level + post-join)
+    "SELECT count(*), sum(v) FROM l JOIN r ON l.ik = r.ik WHERE v > 100",
+    "SELECT count(*), sum(w) FROM l JOIN r ON l.ik = r.ik WHERE w < 250",
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v > 0 AND w < 400",
+    # NULL int keys never match (ik has ~10-15% NULLs per side)
+    "SELECT count(*), sum(v + w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v % 2 = 0",
+    # dictionary-string join keys
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.sk = r.sk "
+    "WHERE v > 350",
+    # float keys with NaNs (NaN ≠ NaN, every occurrence its own code)
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.fk = r.fk",
+    # composite int+string key
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r "
+    "ON l.ik = r.ik AND l.sk = r.sk",
+    # grouped: dictionary-string key, int key, composite — probe side
+    "SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+    "GROUP BY l.sk ORDER BY l.sk",
+    "SELECT l.ik, count(*), min(w), max(w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v > -250 GROUP BY l.ik ORDER BY l.ik NULLS LAST",
+    "SELECT l.sk, l.ik, count(*), avg(w) FROM l JOIN r ON l.ik = r.ik "
+    "GROUP BY l.sk, l.ik ORDER BY l.sk, l.ik NULLS LAST",
+    # count(col) with NULL-bearing argument on each side
+    "SELECT count(l.ik), count(r.fk) FROM l JOIN r ON l.sk = r.sk "
+    "WHERE v > 440",
+    # wide-value plain-column sums: |bv|·pairs overflows the direct
+    # bound, forcing the limb decomposition on both sides
+    "SELECT l.sk, sum(l.bv), sum(r.bv) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v > 0 GROUP BY l.sk ORDER BY l.sk",
+    "SELECT count(*), sum(l.bv), avg(r.bv) FROM l JOIN r ON l.sk = r.sk",
+    # zone-prunable clustered predicate feeding the join
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE ts < 1500",
+    "SELECT l.sk, count(*) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE ts >= 2048 AND ts < 3072 GROUP BY l.sk ORDER BY l.sk",
+]
+
+TOPN_QUERIES = [
+    "SELECT * FROM l WHERE v > 250 ORDER BY v DESC LIMIT 7",
+    "SELECT * FROM l WHERE v > 250 ORDER BY v LIMIT 7",
+    "SELECT * FROM l WHERE sk = 'beta' AND v < 0 ORDER BY ts DESC LIMIT 5",
+    "SELECT * FROM l WHERE ts < 900 ORDER BY ts LIMIT 4 OFFSET 2",
+    # zone-prunable filter + top-N
+    "SELECT * FROM l WHERE ts >= 5000 ORDER BY v DESC LIMIT 3",
+]
+
+
+@pytest.mark.parametrize("q", FUSED_QUERIES)
+def test_fused_join_agg_parity(q):
+    c = _mk_conn()
+    c.execute("SET serene_device_fused = off")
+    c.execute("SET serene_workers = 1")
+    oracle = _rows(c, q)
+    c.execute("SET serene_device_fused = on")
+    for workers in (1, 4):
+        c.execute(f"SET serene_workers = {workers}")
+        for zm in ("on", "off"):
+            c.execute(f"SET serene_zonemap = {zm}")
+            got = _rows(c, q)
+            assert got == oracle, \
+                f"fused pipeline diverged (workers={workers}, zonemap={zm})"
+
+
+@pytest.mark.parametrize("q", TOPN_QUERIES)
+def test_fused_topn_parity(q):
+    c = _mk_conn()
+    c.execute("SET serene_device_fused = off")
+    oracle = _rows(c, q)
+    c.execute("SET serene_device_fused = on")
+    for zm in ("on", "off"):
+        c.execute(f"SET serene_zonemap = {zm}")
+        assert _rows(c, q) == oracle, f"fused top-N diverged (zonemap={zm})"
+
+
+def test_fused_topn_projection_expr_falls_back():
+    """The host oracle evaluates projection expressions over EVERY
+    filter-surviving row before sorting; the fused path selects its k
+    rows first. An expression that raises on a surviving row OUTSIDE
+    the top k must therefore raise identically in both modes — computed
+    projections decline the fused path."""
+    from serenedb_tpu import errors
+    c = _mk_conn()
+    c.execute("CREATE TABLE pz (a BIGINT, b BIGINT)")
+    c.execute("INSERT INTO pz VALUES (1, 1), (2, 1), (3, 1), (9, 0)")
+    q = "SELECT a, 100 / b FROM pz WHERE a > 0 ORDER BY a LIMIT 2"
+    for mode in ("off", "on"):
+        c.execute(f"SET serene_device_fused = {mode}")
+        with pytest.raises(errors.SqlError, match="division by zero"):
+            c.execute(q)
+    # plain column selection/reorder still compiles
+    before = metrics.DEVICE_OFFLOADS.value
+    rows = c.execute(
+        "SELECT v, ts FROM l WHERE v > 250 ORDER BY v DESC LIMIT 7").rows()
+    assert metrics.DEVICE_OFFLOADS.value == before + 1
+    c.execute("SET serene_device_fused = off")
+    assert repr(c.execute(
+        "SELECT v, ts FROM l WHERE v > 250 ORDER BY v DESC LIMIT 7"
+    ).rows()) == repr(rows)
+
+
+def test_fragment_cache_drains_dead_segments_when_gated_off():
+    """Finalizer-enqueued drops must reclaim bytes on the next cached()
+    call even when the session gate is off — the deferred-drop design
+    may not retain dead-segment arrays for the process lifetime."""
+    from serenedb_tpu.cache import fragments as fr
+    store = fr.FragmentCache()
+    seg = type("Seg", (), {})()
+    arr = np.arange(1024, dtype=np.int64)
+    store.cached(seg, ("sig", 1), lambda: arr)
+    assert store.stats()["entries"] == 1
+    uid = seg._frag_uid
+    store.drop_segment(uid)            # what the weakref finalizer does
+    # gate off: early return — but the drain must already have happened
+    store.cached(seg, None, lambda: 0)
+    with store._lock:
+        assert uid not in store._seg_keys
+    assert store._lru.get((uid, ("sig", 1))) is None
+
+
+def test_fused_path_actually_fires():
+    """The canonical join→agg and filtered top-N shapes must offload —
+    one dispatch each — not silently fall back to the host oracle."""
+    c = _mk_conn()
+    before = metrics.DEVICE_OFFLOADS.value
+    c.execute("SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r "
+              "ON l.ik = r.ik WHERE v > 0 GROUP BY l.sk ORDER BY l.sk")
+    assert metrics.DEVICE_OFFLOADS.value == before + 1
+    c.execute("SELECT * FROM l WHERE v > 250 ORDER BY v DESC LIMIT 7")
+    assert metrics.DEVICE_OFFLOADS.value == before + 2
+
+
+def test_fused_off_never_offloads():
+    c = _mk_conn()
+    c.execute("SET serene_device_fused = off")
+    c.execute("SET serene_device = 'cpu'")
+    before = metrics.DEVICE_OFFLOADS.value
+    c.execute("SELECT count(*), sum(v) FROM l JOIN r ON l.ik = r.ik")
+    c.execute("SELECT * FROM l WHERE v > 250 ORDER BY v DESC LIMIT 7")
+    assert metrics.DEVICE_OFFLOADS.value == before
+
+
+def test_empty_and_all_pruned_scans():
+    """A genuinely empty side and an all-zone-pruned side both produce
+    the host oracle's results (the zero-accumulator short-circuit)."""
+    c = _mk_conn()
+    c.execute("CREATE TABLE e (ik INT, u BIGINT)")
+    for q in [
+        "SELECT count(*), sum(v), sum(u) FROM l JOIN e ON l.ik = e.ik",
+        "SELECT count(*), sum(u) FROM e JOIN r ON e.ik = r.ik",
+        "SELECT l.sk, count(*) FROM l JOIN e ON l.ik = e.ik "
+        "GROUP BY l.sk ORDER BY l.sk",
+        # ts is clustered 0..5999: ts > 90000 prunes every block
+        "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+        "WHERE ts > 90000",
+        "SELECT * FROM l WHERE ts > 90000 ORDER BY v DESC LIMIT 5",
+    ]:
+        c.execute("SET serene_device_fused = off")
+        oracle = _rows(c, q)
+        c.execute("SET serene_device_fused = on")
+        assert _rows(c, q) == oracle, q
+
+
+def test_device_cache_hits_and_write_invalidation():
+    """Repeat queries serve columns from the device cache (no re-upload);
+    any write moves the publication tuple so the next run re-uploads and
+    sees fresh data."""
+    c = _mk_conn()
+    q = ("SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+         "WHERE v > 0")
+    first = _rows(c, q)
+    hits0 = metrics.DEVICE_CACHE_HITS.value
+    misses0 = metrics.DEVICE_CACHE_MISSES.value
+    assert _rows(c, q) == first
+    assert metrics.DEVICE_CACHE_HITS.value > hits0
+    assert metrics.DEVICE_CACHE_MISSES.value == misses0
+
+    c.execute("INSERT INTO l VALUES (1, 'alpha', 0.5, 99999, 7, 1000)")
+    misses1 = metrics.DEVICE_CACHE_MISSES.value
+    c.execute("SET serene_device_fused = off")
+    oracle = _rows(c, q)
+    c.execute("SET serene_device_fused = on")
+    fresh = _rows(c, q)
+    assert fresh == oracle
+    assert fresh != first                       # the write is visible
+    assert metrics.DEVICE_CACHE_MISSES.value > misses1
+
+
+def test_device_cache_lru_eviction_and_generation_sweep():
+    """Unit-level DeviceColumnCache: the byte cap LRU-evicts oldest
+    first, and storing a newer publication of the same column sweeps the
+    superseded generation eagerly."""
+    from serenedb_tpu.exec.device_pipeline import DeviceColumnCache
+    old_cap = SETTINGS.get_global("serene_device_cache_mb")
+    SETTINGS.set_global("serene_device_cache_mb", 1)
+    try:
+        cache = DeviceColumnCache()
+        a = np.zeros(8)
+        cache.put(((1, 0, 0), "c1", "col", None), a, 400_000)
+        cache.put(((2, 0, 0), "c2", "col", None), a, 400_000)
+        ev0 = metrics.DEVICE_CACHE_EVICTIONS.value
+        cache.put(((3, 0, 0), "c3", "col", None), a, 400_000)
+        # 1.2 MB > 1 MB cap: the oldest entry goes, newer two stay
+        assert metrics.DEVICE_CACHE_EVICTIONS.value == ev0 + 1
+        assert cache.get(((1, 0, 0), "c1", "col", None)) is None
+        assert cache.get(((2, 0, 0), "c2", "col", None)) is not None
+        assert cache.get(((3, 0, 0), "c3", "col", None)) is not None
+
+        # generation sweep: same token+column, bumped data_version
+        cache.put(((7, 1, 0), "k", "col", None), a, 1000)
+        ev1 = metrics.DEVICE_CACHE_EVICTIONS.value
+        cache.put(((7, 2, 0), "k", "col", None), a, 1000)
+        assert metrics.DEVICE_CACHE_EVICTIONS.value == ev1 + 1
+        assert cache.get(((7, 1, 0), "k", "col", None)) is None
+        assert cache.get(((7, 2, 0), "k", "col", None)) is not None
+    finally:
+        SETTINGS.set_global("serene_device_cache_mb", old_cap)
+
+
+def test_explain_analyze_attributes_device_time():
+    """EXPLAIN ANALYZE of a fused query carries per-stage Device: lines
+    (transfer + dispatch accounting from the PR 4 profiler)."""
+    c = _mk_conn()
+    q = ("SELECT l.sk, count(*), sum(v) FROM l JOIN r ON l.ik = r.ik "
+         "WHERE v > 0 GROUP BY l.sk ORDER BY l.sk")
+    plain = _rows(c, q)
+    out = "\n".join(r[0] for r in
+                    c.execute(f"EXPLAIN ANALYZE {q}").rows())
+    assert "Device: time=" in out
+    # and EXPLAIN ANALYZE itself never perturbs results
+    assert _rows(c, q) == plain
+
+
+def test_fused_respects_device_auto_min_rows():
+    """Under serene_device = auto, tables below serene_device_min_rows
+    stay on host — the fused tier must honor the same admission knob."""
+    c = _mk_conn(nl=500, nr=300)
+    c.execute("SET serene_device = 'auto'")
+    before = metrics.DEVICE_OFFLOADS.value
+    c.execute("SELECT count(*), sum(v) FROM l JOIN r ON l.ik = r.ik")
+    c.execute("SELECT * FROM l WHERE v > 0 ORDER BY v LIMIT 3")
+    assert metrics.DEVICE_OFFLOADS.value == before

@@ -336,6 +336,32 @@ def test_fragment_survives_append_not_mutation():
                               idx3.searchers["body"].segments]
 
 
+def test_fragment_finalizer_lock_free_and_deferred():
+    """drop_segment is a weakref-finalizer target: GC can run it on a
+    thread that is ALREADY inside the cache holding its lock (observed
+    as a tier-1 deadlock at sqllogic sdb/search tests), so it must only
+    enqueue — reclaim happens at the next cache operation."""
+    import threading as _threading
+
+    from serenedb_tpu.cache.fragments import FRAGMENTS
+    db, c = _mk_search()
+    c.execute("SELECT id FROM d WHERE body ## 'red' ORDER BY id")
+    done = _threading.Event()
+
+    def finalizer_while_locked():
+        FRAGMENTS.drop_segment(999_999_999)
+        done.set()
+
+    with FRAGMENTS._lock:                   # the interrupted frame
+        t = _threading.Thread(target=finalizer_while_locked, daemon=True)
+        t.start()
+        t.join(timeout=10)
+    assert done.is_set(), "drop_segment blocked on the cache lock"
+    assert 999_999_999 in list(FRAGMENTS._pending_drops)
+    FRAGMENTS._drain_drops()                # next cache op reclaims
+    assert 999_999_999 not in list(FRAGMENTS._pending_drops)
+
+
 def test_fragment_cache_disabled_with_session_switch():
     db, c = _mk_search()
     c.execute("SET serene_result_cache = off")

@@ -57,7 +57,8 @@ def test_sqllogic_memory(path, tmp_path):
     db = Database()
     try:
         with _scratch_cwd(tmp_path):
-            failures = run_test_file(db.connect(), path)
+            failures = run_test_file(db.connect(), path,
+                                     tmpdir=str(tmp_path))
         assert not failures, "\n".join(failures)
     finally:
         db.close()   # releases process-global analyzer registrations
@@ -68,7 +69,8 @@ def test_sqllogic_durable(path, tmp_path):
     db = Database(str(tmp_path / "data"))
     try:
         with _scratch_cwd(tmp_path):
-            failures = run_test_file(db.connect(), path)
+            failures = run_test_file(db.connect(), path,
+                                     tmpdir=str(tmp_path))
         assert not failures, "\n".join(failures)
     finally:
         db.close()
@@ -97,7 +99,8 @@ def test_sqllogic_recovery(path, tmp_path):
         with _scratch_cwd(tmp_path):
             failures = run_test_file(state["db"].connect(), path,
                                      reopen=reopen,
-                                     crash_reopen=crash_reopen)
+                                     crash_reopen=crash_reopen,
+                                     tmpdir=str(tmp_path))
         assert not failures, "\n".join(failures)
     finally:
         faults.set_crash_mode("exit")

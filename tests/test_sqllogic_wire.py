@@ -246,10 +246,11 @@ def _ids(files):
 
 @pytest.mark.parametrize("mode", MODES)
 @pytest.mark.parametrize("path", FILES, ids=_ids(FILES))
-def test_sqllogic_wire(path, mode, wire_db):
+def test_sqllogic_wire(path, mode, wire_db, tmp_path):
     pg = RawPg(wire_db.port)
     try:
-        failures = run_test_file_wire(WireClient(pg, mode).execute, path)
+        failures = run_test_file_wire(WireClient(pg, mode).execute, path,
+                                      tmpdir=str(tmp_path))
         assert not failures, "\n".join(failures[:8])
     finally:
         pg.close()
