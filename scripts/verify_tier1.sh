@@ -73,9 +73,23 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_DEVICE_FUSED=off \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc6=$?
 
+# Pass 7 is the search-batch parity leg: the query batcher is forced
+# OFF globally (the conftest env hook arms serene_search_batch) over the
+# search, search-batch, and ES API suites — proving batched ragged
+# serving is a dispatch-coalescing layer only: every per-query result is
+# bit-identical with serial dispatch, and the suites' own parity
+# matrices still exercise both modes via their explicit session SETs.
+echo "== search-batch parity pass (serene_search_batch=off) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_SEARCH_BATCH=off \
+    python -m pytest tests/test_search_batch.py tests/test_search.py \
+    tests/test_search_regressions.py tests/test_es_api.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc7=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
 [ "$rc4" -ne 0 ] && exit "$rc4"
 [ "$rc5" -ne 0 ] && exit "$rc5"
-exit "$rc6"
+[ "$rc6" -ne 0 ] && exit "$rc6"
+exit "$rc7"

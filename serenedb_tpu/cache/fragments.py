@@ -178,6 +178,68 @@ class FragmentCache:
                     self._lru.remove(k)
                 self._sync_bytes()
 
+    def probe(self, seg, shape: Optional[tuple]):
+        """Pure lookup: a copy of the cached value, or None. Bumps NO
+        hit/miss gauges and never stores — the batcher's pre-batch probe
+        runs ahead of the real lookup, and counting here would double-bill
+        fragments the batch dispatch re-probes. Callers that commit to a
+        probe's value report it via `count_hits`."""
+        self._drain_drops()
+        if shape is None or not enabled():
+            return None
+        hit = self._lru.get((self.segment_uid(seg), shape))
+        return None if hit is None else _copy_value(hit)
+
+    def count_hits(self, n: int) -> None:
+        """Attribute `n` fragment servings discovered via `probe`."""
+        metrics.FRAGMENT_CACHE_HITS.add(n)
+
+    def cached_batch(self, seg, shapes: list, compute_batch) -> list:
+        """Per-item memoization over ONE batched compute: probe every
+        shape, call compute_batch(miss_indices) once for the misses (it
+        must return one value per index, in order), store each under its
+        own key. This is what lets a coalesced search batch reuse — and
+        feed — the same per-query fragments as solo dispatches. shape=None
+        items always compute."""
+        self._drain_drops()
+        n = len(shapes)
+        if not enabled():
+            return compute_batch(list(range(n)))
+        uid = self.segment_uid(seg)
+        results: list = [None] * n
+        miss: list[int] = []
+        for i, shape in enumerate(shapes):
+            hit = self._lru.get((uid, shape)) if shape is not None else None
+            if hit is not None:
+                metrics.FRAGMENT_CACHE_HITS.add()
+                results[i] = _copy_value(hit)
+            else:
+                if shape is not None:
+                    metrics.FRAGMENT_CACHE_MISSES.add()
+                miss.append(i)
+        if not miss:
+            return results
+        computed = compute_batch(miss)
+        cap = int(_settings_registry.get_global(
+            "serene_fragment_cache_mb")) << 20
+        stored = False
+        for i, value in zip(miss, computed):
+            shape = shapes[i]
+            if shape is None:
+                results[i] = value
+                continue
+            key = (uid, shape)
+            if not self._lru.put(key, value, _value_nbytes(value), cap):
+                results[i] = value    # refused (over cap): sole reference
+                continue
+            with self._lock:
+                self._seg_keys.setdefault(uid, set()).add(key)
+            stored = True
+            results[i] = _copy_value(value)
+        if stored:
+            self._sync_bytes()
+        return results
+
     def cached(self, seg, shape: Optional[tuple], compute):
         """compute() memoized under (segment uid, shape). shape=None ⇒
         uncacheable query shape ⇒ straight computation. The cache is

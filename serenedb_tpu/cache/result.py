@@ -53,12 +53,18 @@ from .lru import BytesLRU
 
 #: session settings whose value changes what a result CONTAINS (device
 #: summation order, ANN probe counts, scored-term expansion caps) — part
-#: of the key, so two sessions with different knobs never share entries
+#: of the key, so two sessions with different knobs never share entries.
+#: serene_search_batch is deliberately ABSENT: the search batcher's
+#: contract is per-query bit-identity with serial dispatch (scores, doc
+#: ids, tie order — enforced by the tests/test_search_batch.py parity
+#: matrix and the verify_tier1.sh SERENE_SEARCH_BATCH=off pass), so
+#: keying on it would only split the cache between identical entries.
 RESULT_AFFECTING_SETTINGS = (
     "serene_device", "serene_device_min_rows", "serene_device_chunk_rows",
     "serene_device_fused", "serene_mesh", "sdb_nprobe", "sdb_rerank_factor",
     "sdb_scored_terms_limit", "search_path",
 )
+assert "serene_search_batch" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path

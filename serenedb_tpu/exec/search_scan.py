@@ -101,8 +101,15 @@ class SearchScanNode(PlanNode):
             full = self.provider.full_batch(self.columns)
         mesh_n = int(ctx.settings.get("serene_mesh") or 0)
         if self.topk is not None:
-            scores, docs = searcher.topk(self.qnode, self.topk, self.scorer,
-                                         mesh_n=mesh_n)
+            # all serving paths (SQL @@@/bm25 scans, ES _search/_msearch)
+            # funnel through this scan — the batcher coalesces concurrent
+            # sessions' top-k dispatches here (serene_search_batch=off
+            # dispatches serially, the parity oracle)
+            from ..search.batcher import batched_topk
+            (scores, docs), bstats = batched_topk(
+                searcher, self.qnode, self.topk, self.scorer, mesh_n,
+                ctx.settings)
+            self._stamp_batch(ctx, bstats)
             out = full.take(docs.astype(np.int64))
             if self.with_score:
                 out = Batch(list(self.names),
@@ -128,9 +135,11 @@ class SearchScanNode(PlanNode):
                                                            pin)
         out = full.take(docs.astype(np.int64))
         if self.with_score:
-            scores, sdocs = searcher.topk(self.qnode,
-                                          max(n_candidates, 1),
-                                          self.scorer, mesh_n=mesh_n)
+            from ..search.batcher import batched_topk
+            (scores, sdocs), bstats = batched_topk(
+                searcher, self.qnode, max(n_candidates, 1), self.scorer,
+                mesh_n, ctx.settings)
+            self._stamp_batch(ctx, bstats)
             smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
             smap[sdocs] = scores
             out = Batch(list(self.names),
@@ -139,6 +148,15 @@ class SearchScanNode(PlanNode):
             c = self.residual.eval(out)
             out = out.filter(c.data.astype(bool) & c.valid_mask())
         yield out
+
+    def _stamp_batch(self, ctx, bstats) -> None:
+        """Profiler attribution of one batcher round trip (None when the
+        query was served from the fragment cache or dispatched serially)."""
+        prof = getattr(ctx, "profile", None)
+        if prof is not None and bstats is not None:
+            prof.add_search_batch(id(self), queries=bstats["queries"],
+                                  window_ns=bstats["window_ns"],
+                                  scoring_ns=bstats["scoring_ns"])
 
     def _prune_docs_by_zones(self, ctx, full: Batch, docs: np.ndarray,
                              pin) -> tuple[np.ndarray, bool]:

@@ -26,7 +26,8 @@ from typing import Iterator, Optional
 #: additive per-operator counters (merge = sum; scheduling-order free)
 _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
              "loops", "morsels_scheduled", "morsels_pruned",
-             "morsels_jf_pruned", "device_ns")
+             "morsels_jf_pruned", "device_ns", "batch_queries",
+             "batch_window_ns", "batch_scoring_ns")
 
 
 class OpStats:
@@ -111,6 +112,18 @@ class QueryProfile:
 
     def add_device_ns(self, key: int, ns: int) -> None:
         self.stats(key).device_ns += int(ns)
+
+    def add_search_batch(self, key: int, queries: int, window_ns: int,
+                         scoring_ns: int) -> None:
+        """Search-batcher span for one top-k scan: how many queries its
+        dispatch carried (1 = no coalescing), how long this query waited
+        queued, and the shared scoring time of the whole dispatch — so
+        EXPLAIN ANALYZE attributes both the batching win and its latency
+        cost."""
+        s = self.stats(key)
+        s.batch_queries += int(queries)
+        s.batch_window_ns += int(window_ns)
+        s.batch_scoring_ns += int(scoring_ns)
 
     def wrap_batches(self, node, fn, ctx) -> Iterator:
         """Instrumented drive of a node's raw batch generator: wall time
@@ -202,6 +215,11 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
                     f"zonemap_pruned={s.morsels_pruned}{jf}")
             if s.device_ns:
                 lines.append(f"{detail}Device: time={_ms(s.device_ns)} ms")
+            if s.batch_queries:
+                lines.append(
+                    f"{detail}Batch: queries={s.batch_queries} "
+                    f"window={_ms(s.batch_window_ns)} ms "
+                    f"shared_scoring={_ms(s.batch_scoring_ns)} ms")
         for c in node.children():
             lines.extend(walk(c, depth + 1))
         return lines

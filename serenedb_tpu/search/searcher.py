@@ -34,6 +34,19 @@ from .segment import BLOCK, FieldIndex
 K1 = 1.2
 B = 0.75  # reference defaults: libs/iresearch/search/bm25.hpp
 
+_HOST_BACKEND: Optional[bool] = None
+
+
+def _host_backend() -> bool:
+    """True when jax runs on the host CPU backend: there the ragged
+    numpy accumulate beats a per-query score plane, while on a real
+    accelerator the plane + fused top-k stays on device and batching
+    amortizes the dispatch RTT instead."""
+    global _HOST_BACKEND
+    if _HOST_BACKEND is None:
+        _HOST_BACKEND = jax.default_backend() == "cpu"
+    return _HOST_BACKEND
+
 
 def _maxscore_split(plan) -> set:
     """Non-essential terms of a WandPlan: the ascending-maxscore prefix
@@ -358,13 +371,29 @@ class SegmentSearcher:
     # materializing (256, 8.8M) at MS-MARCO scale
     ACC_ENTRY_CAP = 128 * 1024 * 1024
 
+    #: per-query cap on ragged host-path posting entries: past this the
+    #: candidate sort/accumulate costs approach the dense plane's and the
+    #: query stays on the device dispatch
+    RAGGED_ENTRY_CAP = 1 << 18
+
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
                    idf_of=None, avgdl_override=None, mesh_n: int = 0,
+                   ragged: bool = False,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
         """Top-k (scores, doc ids) for a batch of queries in ONE device
         dispatch (amortizes dispatch latency — the QPS regime). Pure term
         disjunctions/conjunctions run fully on device; other shapes get an
-        exact-match CPU mask applied to the device scores."""
+        exact-match CPU mask applied to the device scores.
+
+        ragged=True (the batched-serving path, search/batcher.py) admits
+        pure disjunctions on the host jax backend to `_ragged_resolve`:
+        WAND-kept postings flatten into ragged (contribution, query-offset)
+        arrays, score in one tiny elementwise dispatch, and top-k on the
+        candidate sets — bit-identical to the score-plane kernel by the
+        contrib_flat contract (ops/bm25.py), an order of magnitude cheaper
+        at top-10-of-millions scale. Never taken when this store would use
+        the dense matmul path, so ragged on/off can't change a single
+        result bit there either."""
         if self.num_docs == 0:
             return [(np.empty(0, dtype=np.float32),
                      np.empty(0, dtype=np.int32))] * len(nodes)
@@ -381,13 +410,22 @@ class SegmentSearcher:
             out = []
             for i in range(0, len(nodes), max_b):
                 out.extend(self.topk_batch(nodes[i:i + max_b], k, scorer,
-                                           idf_of, avgdl_override, mesh_n))
+                                           idf_of, avgdl_override, mesh_n,
+                                           ragged))
             return out
         nd_pad = store.ndocs_pad
         shapes = [self._query_shape(n) for n in nodes]
         queries = [(np.asarray(tids, dtype=np.int64) if not empty
                     else np.empty(0, dtype=np.int64), req)
                    for tids, req, _, empty in shapes]
+        # pad the query axis to a power of two with no-op empties: the
+        # packed/mesh kernels are jitted per n_queries, and coalesced
+        # batches arrive at every size — without bucketing each new size
+        # would compile a fresh program. Empty pads scatter nothing and
+        # their accumulator rows are never read back, so real queries'
+        # bits are untouched.
+        for _ in range(bm25_ops._pow2(len(queries), 1) - len(queries)):
+            queries.append((np.empty(0, dtype=np.int64), 0))
         # block-max WAND applies to pure disjunctions whose device top-k is
         # final (no exact-match mask re-ranking a subset afterwards); the
         # LM scorers don't decompose as w·sat, so their bounds don't hold
@@ -417,7 +455,7 @@ class SegmentSearcher:
             return self._finish_batch(nodes, shapes, vals, docs, {}, k,
                                       scorer, idf_of, avgdl_override,
                                       nd_pad)
-        plans: list = [None] * len(nodes)
+        plans: list = [None] * len(queries)
         host_results: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         use_dense = (scorer not in bm25_ops.LM_SCORERS and
                      (scorer == "tfidf" or avgdl > 0.0) and
@@ -453,6 +491,18 @@ class SegmentSearcher:
                     host_results[qi] = self._cpu_score(
                         cand, tids, k, scorer, idf_of, avgdl_override)
                     queries[qi] = (np.empty(0, dtype=np.int64), 0)
+        if ragged and _host_backend() and \
+                (scorer == "tfidf" or avgdl > 0.0) and \
+                store.norms_host is not None:
+            todo = [qi for qi in range(len(shapes))
+                    if prunable[qi] and shapes[qi][0] and
+                    qi not in host_results]
+            if todo:
+                for qi, res in self._ragged_resolve(
+                        store, todo, shapes, plans, k, scorer, idf_of,
+                        avgdl).items():
+                    host_results[qi] = res
+                    queries[qi] = (np.empty(0, dtype=np.int64), 0)
         qb = bm25_ops.assemble_query_batch(store, self.num_docs, queries,
                                            self.index.doc_freq, scorer,
                                            idf_of=idf_of, plans=plans)
@@ -473,6 +523,144 @@ class SegmentSearcher:
             docs = np.zeros((nq, kk), dtype=np.int32)
         return self._finish_batch(nodes, shapes, vals, docs, host_results,
                                   k, scorer, idf_of, avgdl_override, nd_pad)
+
+    def _ragged_resolve(self, store, qis, shapes, plans, k: int,
+                        scorer: str, idf_of, avgdl,
+                        ) -> dict[int, tuple[np.ndarray, np.ndarray]]:
+        """Batched ragged host top-k for pure-disjunction queries.
+
+        Every admitted query's postings — WAND-kept block rows of heavy
+        terms plus light-term tails, exactly the entries the plane kernel
+        would scatter — flatten into one (contribution, query-offset)
+        ragged array set. ONE elementwise `contrib_flat` dispatch scores
+        all postings of all queries; accumulation then runs per query as
+        ordered slice adds over its sorted candidate set (each term
+        touches a doc at most once, so `acc[ix] += c` per slice replays
+        the scatter's per-doc f32 addition order bit-for-bit), and
+        `topk_tie_exact` makes the same (score desc, doc asc) selection
+        as lax.top_k. Queries past RAGGED_ENTRY_CAP stay on the device
+        dispatch."""
+        fi = self.index
+        per_q: list[tuple[int, list]] = []
+        flat_d, flat_t, flat_w = [], [], []
+        spans: list[list[tuple[int, int]]] = []   # per admitted query
+        pos = 0
+        for qi in qis:
+            tids = shapes[qi][0]
+            plan = plans[qi]
+            tid_arr = np.asarray(tids, dtype=np.int64)
+            if idf_of is not None:
+                idf = np.asarray(idf_of(tid_arr), dtype=np.float32)
+            else:
+                idf = bm25_ops.idf_for(scorer, self.num_docs,
+                                       fi.doc_freq[tid_arr])
+            slices = []   # (docs, tfs, w) in the kernel's (plane, term) order
+            entries = 0
+            for plane in (0, 1, 2):
+                for j, tid in enumerate(tids):
+                    tid = int(tid)
+                    s, e = int(store.offsets[tid]), int(store.offsets[tid + 1])
+                    if e <= s:
+                        continue
+                    heavy = bool(store.heavy[tid])
+                    if heavy == (plane == 2):
+                        continue   # heavy → tile planes, light → tails
+                    w = float(idf[j])
+                    if not heavy:
+                        d, t = store.flat_docs[s:e], store.flat_tfs[s:e]
+                    else:
+                        d, t = self._ragged_tile_slice(store, plan, tid,
+                                                       plane, s, e)
+                        if d is None:
+                            continue
+                    slices.append((d, t, w))
+                    entries += len(d)
+            if entries > self.RAGGED_ENTRY_CAP:
+                continue   # device plane amortizes better past the cap
+            per_q.append((qi, slices))
+            qspans = []
+            for d, t, w in slices:
+                flat_d.append(d)
+                flat_t.append(t)
+                flat_w.append(np.full(len(d), w, dtype=np.float32))
+                qspans.append((pos, pos + len(d)))
+                pos += len(d)
+            spans.append(qspans)
+        if not per_q:
+            return {}
+        dcat = np.concatenate(flat_d)
+        contribs = bm25_ops.ragged_contribs(
+            np.concatenate(flat_t), store.norms_host[dcat],
+            np.concatenate(flat_w), K1, B, avgdl, scorer)
+        out: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for (qi, slices), qspans in zip(per_q, spans):
+            if not qspans:
+                out[qi] = (np.empty(0, dtype=np.float32),
+                           np.empty(0, dtype=np.int32))
+                continue
+            # candidate set + per-slice scatter indices are a pure
+            # function of the plan's kept postings — memoized on the
+            # plan, so repeat queries pay only the f32 adds + top-k
+            plan = plans[qi]
+            pre = getattr(plan, "_ragged_accum", None) \
+                if plan is not None else None
+            if pre is None:
+                cand = np.unique(np.concatenate(
+                    [dcat[a:b] for a, b in qspans]))
+                ixs = [np.searchsorted(cand, dcat[a:b])
+                       for a, b in qspans]
+                if plan is not None:
+                    plan._ragged_accum = (cand, ixs)
+            else:
+                cand, ixs = pre
+            acc = np.zeros(len(cand), dtype=np.float32)
+            for ix, (a, b) in zip(ixs, qspans):
+                acc[ix] += contribs[a:b]
+            out[qi] = bm25_ops.topk_tie_exact(acc, cand, k)
+        return out
+
+    @staticmethod
+    def _ragged_tile_slice(store, plan, tid: int, plane: int, s: int,
+                           e: int):
+        """(docs, tfs) of one heavy term's postings surviving the plan's
+        kept-row pruning on one tile plane, or (None, None). Memoized on
+        the plan (plans are memoized per query shape, so repeat queries
+        skip the mask arithmetic) or, plan-free, on the store. Cached
+        arrays are read-only by convention — accumulation never writes
+        through them."""
+        cache = None
+        if plan is not None:
+            cache = getattr(plan, "_ragged_slices", None)
+            if cache is None:
+                cache = plan._ragged_slices = {}
+        else:
+            cache = getattr(store, "_ragged_plain", None)
+            if cache is None:
+                cache = store._ragged_plain = {}
+            if len(cache) > 4096:   # vocab-sized growth bound
+                cache.clear()
+        hit = cache.get((plane, tid))
+        if hit is not None:
+            return hit
+        b0 = int(store.block_offsets[tid])
+        rowof = b0 + np.arange(e - s, dtype=np.int64) // bm25_ops.BLOCK
+        m = store.row_plane[rowof] == plane
+        if plan is not None:
+            kept = plan.kept[tid]
+            if len(kept) == 0:
+                m = np.zeros_like(m)
+            else:
+                ix = np.searchsorted(kept, rowof)
+                np.clip(ix, 0, len(kept) - 1, out=ix)
+                m &= kept[ix] == rowof
+        if not m.any():
+            out = (None, None)
+        elif m.all():
+            out = (store.flat_docs[s:e], store.flat_tfs[s:e])
+        else:
+            out = (store.flat_docs[s:e][m], store.flat_tfs[s:e][m])
+        cache[(plane, tid)] = out
+        return out
 
     def _finish_batch(self, nodes, shapes, vals, docs, host_results, k,
                       scorer, idf_of, avgdl_override, nd_pad,
@@ -722,20 +910,31 @@ class MultiSearcher:
         return self.topk_batch([node], k, scorer, mesh_n=mesh_n)[0]
 
     def topk_batch(self, nodes: list[QNode], k: int, scorer: str = "bm25",
-                   mesh_n: int = 0,
+                   mesh_n: int = 0, ragged: bool = False,
                    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Fragments memoize PER QUERY (cache/fragments.cached_batch): a
+        coalesced batch probes each member's own (sig, k, scorer) key, the
+        misses score together in one segment dispatch, and each result
+        stores back under its own key — so a fragment computed inside any
+        batch serves the same query arriving alone later and vice versa
+        (sound because per-query results are batch-composition-independent,
+        the serving parity contract). `ragged` never keys a fragment: the
+        ragged host path is bit-identical to the device dispatch by
+        construction, same reason serene_search_batch stays out of the
+        result cache's settings digest."""
         from ..cache.fragments import FRAGMENTS, qnode_sig
-        sigs = tuple(qnode_sig(n) for n in nodes)
-        nsig = None if any(s is None for s in sigs) else sigs
+        sigs = [qnode_sig(n) for n in nodes]
         if len(self.segments) == 1:
             seg, base = self.segments[0]
             # single segment: local stats ARE the global stats — the
             # fragment is a pure function of the segment alone
-            shape = None if nsig is None else ("topk1", nsig, k, scorer,
-                                               mesh_n)
-            out = FRAGMENTS.cached(
-                seg, shape,
-                lambda: seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n))
+            shapes = [None if s is None else ("topk1", s, k, scorer, mesh_n)
+                      for s in sigs]
+            out = FRAGMENTS.cached_batch(
+                seg, shapes,
+                lambda idxs: seg.topk_batch([nodes[i] for i in idxs], k,
+                                            scorer, mesh_n=mesh_n,
+                                            ragged=ragged))
             return [(s, d.astype(np.int64) + base) for s, d in out]
         idf_factory = self._segment_idf_factory(nodes, scorer)
         avgdl = self.global_avgdl
@@ -748,14 +947,15 @@ class MultiSearcher:
 
         def run_segment(seg_base):
             seg, _base = seg_base
-            shape = None if nsig is None else ("topk", nsig, k, scorer,
-                                               mesh_n, segset)
-            return FRAGMENTS.cached(
-                seg, shape,
-                lambda: seg.topk_batch(nodes, k, scorer,
-                                       idf_of=idf_factory(seg),
-                                       avgdl_override=avgdl,
-                                       mesh_n=mesh_n))
+            shapes = [None if s is None else ("topk", s, k, scorer, mesh_n,
+                                              segset) for s in sigs]
+            return FRAGMENTS.cached_batch(
+                seg, shapes,
+                lambda idxs: seg.topk_batch([nodes[i] for i in idxs], k,
+                                            scorer,
+                                            idf_of=idf_factory(seg),
+                                            avgdl_override=avgdl,
+                                            mesh_n=mesh_n, ragged=ragged))
 
         # segments are independent top-k collectors: search them on the
         # shared worker pool (reference: parallel scored collectors over
@@ -771,6 +971,42 @@ class MultiSearcher:
         return merge_segment_topk(seg_outs,
                                   [b for _, b in self.segments],
                                   len(nodes), k)
+
+    def probe_topk(self, node: QNode, k: int, scorer: str = "bm25",
+                   mesh_n: int = 0,
+                   ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Pure fragment-cache probe: the merged top-k iff EVERY segment's
+        fragment for this query is already cached, else None — no scoring,
+        no stores. The batcher consults this BEFORE enqueueing so cache
+        hits never wait out a coalescing window or occupy a batch slot.
+        Hit gauges bump only on full success; partial probes stay silent
+        (the batch dispatch re-probes those segments and counts them
+        once)."""
+        from ..cache.fragments import FRAGMENTS, enabled, qnode_sig
+        if not enabled() or not self.segments:
+            return None
+        sig = qnode_sig(node)
+        if sig is None:
+            return None
+        if len(self.segments) == 1:
+            seg, base = self.segments[0]
+            hit = FRAGMENTS.probe(seg, ("topk1", sig, k, scorer, mesh_n))
+            if hit is None:
+                return None
+            FRAGMENTS.count_hits(1)
+            s, d = hit
+            return s, d.astype(np.int64) + base
+        segset = tuple(FRAGMENTS.segment_uid(s) for s, _ in self.segments)
+        outs = []
+        for seg, _base in self.segments:
+            hit = FRAGMENTS.probe(seg, ("topk", sig, k, scorer, mesh_n,
+                                        segset))
+            if hit is None:
+                return None
+            outs.append([hit])
+        FRAGMENTS.count_hits(len(self.segments))
+        return merge_segment_topk(outs, [b for _, b in self.segments],
+                                  1, k)[0]
 
     def _segment_idf_factory(self, nodes: list[QNode], scorer: str):
         """seg → idf_of closure over GLOBAL collection stats. One pass:

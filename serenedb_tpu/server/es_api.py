@@ -46,6 +46,23 @@ class EsApi:
         # while _index_doc_locked may re-enter via create_index
         self._lock = threading.RLock()
         self._scrolls: dict[str, dict] = {}
+        #: per-thread READ connections (see _rconn)
+        self._tl = threading.local()
+
+    def _rconn(self) -> Connection:
+        """Per-thread read connection for the search paths. Concurrent
+        _search/_msearch items run on server and worker-pool threads, and
+        a Connection carries per-statement session state (the
+        CURRENT_CONNECTION contextvar target, now() stability, cancel
+        flag) — sharing self.conn across threads would race it. Reads get
+        a thread-cached connection instead; writes keep self.conn under
+        self._lock. Thread count is bounded (pool workers + HTTP handler
+        threads), and dead threads' connections retire via their weakref
+        finalizers."""
+        conn = getattr(self._tl, "conn", None)
+        if conn is None:
+            conn = self._tl.conn = self.db.connect()
+        return conn
 
     # -- index management --------------------------------------------------
 
@@ -347,7 +364,7 @@ class EsApi:
         sql = f'SELECT count(*) FROM "{index}"'
         if where:
             sql += f" WHERE {where}"
-        n = self.conn.execute(sql).scalar()
+        n = self._rconn().execute(sql).scalar()
         return {"count": int(n),
                 "_shards": {"total": 1, "successful": 1, "failed": 0}}
 
@@ -392,30 +409,30 @@ class EsApi:
             for f, w, pred in multi_claims:
                 pass_sql = (f'SELECT "_id", bm25({_ident(f)}) '
                             f'FROM "{index}" WHERE {pred}')
-                for did, sc in self.conn.execute(pass_sql).rows():
+                for did, sc in self._rconn().execute(pass_sql).rows():
                     if sc:
                         scores[did] = scores.get(did, 0.0) + w * float(sc)
             total_sql = f'SELECT count(*) FROM "{index}"'
             if where:
                 total_sql += f" WHERE {where}"
-            total = int(self.conn.execute(total_sql).scalar())
+            total = int(self._rconn().execute(total_sql).scalar())
             page = self._multi_claim_page(index, where, scores,
                                           from_ + size)[from_:from_ + size]
             rows = []
             if page:
                 lits = ", ".join(_sql_str(d) for d in page)
-                src = dict(self.conn.execute(
+                src = dict(self._rconn().execute(
                     f'SELECT "_id", "_source" FROM "{index}" '
                     f'WHERE "_id" IN ({lits})').rows())
                 rows = [(d, src.get(d), scores.get(d, 0.0)) for d in page]
             score_col = "multi"
         else:
             sql += order + f" LIMIT {size} OFFSET {from_}"
-            rows = list(self.conn.execute(sql).rows())
+            rows = list(self._rconn().execute(sql).rows())
             total_sql = f'SELECT count(*) FROM "{index}"'
             if where:
                 total_sql += f" WHERE {where}"
-            total = int(self.conn.execute(total_sql).scalar())
+            total = int(self._rconn().execute(total_sql).scalar())
         hits = []
         max_score = 0.0
         for row in rows:
@@ -455,7 +472,7 @@ class EsApi:
                     _sql_str(d) for d in chunk)
                 if where:
                     cond = f"({where}) AND {cond}"
-                hit = {r[0] for r in self.conn.execute(
+                hit = {r[0] for r in self._rconn().execute(
                     f'SELECT "_id" FROM "{index}" WHERE {cond}').rows()}
                 out.extend(d for d in chunk if d in hit)
             return out
@@ -473,7 +490,7 @@ class EsApi:
         # over-fetch by the candidate count: every scored id that sneaks
         # into the window gets filtered back out client-side
         mid_sql += f' ORDER BY "_id" LIMIT {rest + len(scored_set)}'
-        mid = [r[0] for r in self.conn.execute(mid_sql).rows()
+        mid = [r[0] for r in self._rconn().execute(mid_sql).rows()
                if r[0] not in scored_set][:rest]
         seq = head + mid
         if len(seq) < needed and neg:
@@ -495,7 +512,7 @@ class EsApi:
         sql = (f'SELECT "_id", "_source", {dist} AS _dist FROM '
                f'{_ident(index)} '
                f"ORDER BY _dist LIMIT {cand}")
-        knn_rows = [r for r in self.conn.execute(sql).rows()
+        knn_rows = [r for r in self._rconn().execute(sql).rows()
                     if r[2] is not None]
         knn_ranked = [(r[0], r[1]) for r in knn_rows]
         if body.get("query") is None:
@@ -673,7 +690,17 @@ class EsApi:
         if len(lines) % 2:
             raise EsError(400, "parsing_exception",
                           "_msearch body must be header/body line pairs")
-        responses = []
+        # two phases: (1) parse every header/body pair serially — a
+        # malformed item becomes its own inline error response without
+        # touching its siblings; (2) execute the valid items CONCURRENTLY
+        # on the shared worker pool, so their top-k scans arrive at the
+        # search batcher together and coalesce into shared scoring
+        # dispatches (search/batcher.py). run_item swallows per-item
+        # failures into inline responses — exceptions never cross item
+        # boundaries, so a poisoned body in a coalesced batch can't fail
+        # the request or its siblings (the batcher additionally retries a
+        # failed dispatch serially per query).
+        items: list[tuple] = []   # ("q", index, query) | ("err", response)
         for i in range(0, len(lines), 2):
             try:
                 header = json.loads(lines[i]) if lines[i].strip() else {}
@@ -694,19 +721,29 @@ class EsApi:
                                       "multi-index _msearch items are not "
                                       "supported")
                     index = index[0]
-                responses.append({**self.search(str(index), query),
-                                  "status": 200})
+                items.append(("q", str(index), query))
             except json.JSONDecodeError as e:
-                responses.append({"error": {
+                items.append(("err", {"error": {
                     "type": "parsing_exception",
-                    "reason": f"invalid JSON: {e}"}, "status": 400})
+                    "reason": f"invalid JSON: {e}"}, "status": 400}))
             except EsError as e:
-                responses.append({"error": e.body()["error"],
-                                  "status": e.status})
+                items.append(("err", {"error": e.body()["error"],
+                                      "status": e.status}))
+
+        def run_item(item: tuple) -> dict:
+            if item[0] == "err":
+                return item[1]
+            try:
+                return {**self.search(item[1], item[2]), "status": 200}
+            except EsError as e:
+                return {"error": e.body()["error"], "status": e.status}
             except errors.SqlError as e:
-                responses.append({"error": {
+                return {"error": {
                     "type": "sql_exception", "reason": e.message,
-                    "sqlstate": e.sqlstate}, "status": 400})
+                    "sqlstate": e.sqlstate}, "status": 400}
+
+        from ..parallel.pool import parallel_map
+        responses = parallel_map(None, run_item, items)
         return {"took": 1, "responses": responses}
 
     def analyze(self, body: Optional[dict],

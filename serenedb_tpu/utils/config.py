@@ -234,6 +234,28 @@ declare("serene_fragment_cache_mb", 32, int,
         "byte cap (MB) of the process-wide search fragment cache "
         "(per-segment filter doc sets and top-k collector outputs)",
         scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
+declare("serene_search_batch", True, bool,
+        "batched ragged search serving (search/batcher.py): concurrent "
+        "_search/@@@ top-k queries against the same index coalesce into "
+        "ONE vectorized scoring dispatch over the shared postings, with "
+        "ragged per-query term lists and per-query WAND thresholds "
+        "preserved; per-query results are bit-identical to serial "
+        "dispatch (scores, doc ids, tie order), so this setting is "
+        "deliberately excluded from the result cache's settings digest; "
+        "off dispatches every query alone (the parity oracle). A lone "
+        "query never waits: coalescing only engages while other searches "
+        "of the same (index, k, scorer) group are in flight")
+declare("serene_search_batch_window_ms", 2.0, float,
+        "upper bound (ms) a query waits to coalesce with concurrent "
+        "arrivals when its group has other active-but-unqueued "
+        "submitters; while a dispatch is in flight arrivals simply queue "
+        "behind it (the dispatch IS the window under sustained load) and "
+        "a query alone in its group dispatches immediately",
+        scope=Scope.GLOBAL, validator=lambda v: max(0.0, float(v)))
+declare("serene_search_batch_max", 128, int,
+        "cap on queries per coalesced search scoring dispatch; overflow "
+        "queries form the next dispatch", scope=Scope.GLOBAL,
+        validator=lambda v: max(1, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

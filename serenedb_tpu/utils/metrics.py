@@ -171,3 +171,19 @@ FRAGMENT_CACHE_MISSES = REGISTRY.gauge(
     "per-segment search fragments computed because no entry matched")
 FRAGMENT_CACHE_BYTES = REGISTRY.gauge(
     "FragmentCacheBytes", "bytes currently held by the fragment cache")
+SEARCH_BATCH_DISPATCHES = REGISTRY.gauge(
+    "SearchBatchDispatches",
+    "coalesced search scoring dispatches executed by the query batcher "
+    "(each scores one or more top-k queries in one vectorized pass)")
+SEARCH_BATCH_QUERIES = REGISTRY.gauge(
+    "SearchBatchQueries",
+    "top-k queries scored through batcher dispatches (QUERIES / "
+    "DISPATCHES = mean batch size)")
+SEARCH_BATCH_WINDOW_WAIT_NS = REGISTRY.gauge(
+    "SearchBatchWindowWaitNs",
+    "cumulative ns queries spent queued in the batcher before their "
+    "dispatch started (coalescing latency cost)")
+SEARCH_BATCH_COALESCED = REGISTRY.gauge(
+    "SearchBatchCoalesced",
+    "queries that shared their scoring dispatch with at least one other "
+    "query (the batching win; singleton dispatches don't count)")

@@ -80,6 +80,25 @@ if os.environ.get("SERENE_DEVICE_FUSED"):
                            os.environ["SERENE_DEVICE_FUSED"])
 
 
+# scripts/verify_tier1.sh search-batch parity leg: force
+# serene_search_batch to the given value ("on"/"off") for a whole run —
+# the off pass proves the query batcher is a dispatch-coalescing layer
+# only (the search and ES suites are bit-identical with every query
+# dispatched serially), the on pass that coalesced scoring perturbs
+# nothing.
+if os.environ.get("SERENE_SEARCH_BATCH"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_SB
+
+    _SDB_REG_SB.set_global("serene_search_batch",
+                           os.environ["SERENE_SEARCH_BATCH"])
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running throughput tests, excluded from "
+        "the tier-1 `-m 'not slow'` runs")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
