@@ -86,10 +86,25 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu SERENE_SEARCH_BATCH=off \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc7=$?
 
+# Pass 8 is the sharded-execution parity leg: serene_shards is forced
+# to 4 globally (the conftest env hook arms the global) over the shard,
+# parallel, join, device, and search parity suites — every morsel
+# pipeline, fused device dispatch, and multi-segment search then runs
+# through per-shard pipelines with cross-shard combiners, and a single
+# diverged bit fails the suites' parity assertions loudly.
+echo "== sharded execution parity pass (serene_shards=4) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_SHARDS=4 \
+    python -m pytest tests/test_shard_exec.py tests/test_parallel_exec.py \
+    tests/test_join_exec.py tests/test_device_pipeline.py \
+    tests/test_search.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc8=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
 [ "$rc4" -ne 0 ] && exit "$rc4"
 [ "$rc5" -ne 0 ] && exit "$rc5"
 [ "$rc6" -ne 0 ] && exit "$rc6"
-exit "$rc7"
+[ "$rc7" -ne 0 ] && exit "$rc7"
+exit "$rc8"

@@ -59,12 +59,18 @@ from .lru import BytesLRU
 #: ids, tie order — enforced by the tests/test_search_batch.py parity
 #: matrix and the verify_tier1.sh SERENE_SEARCH_BATCH=off pass), so
 #: keying on it would only split the cache between identical entries.
+#: serene_shards is deliberately ABSENT for the same reason: the
+#: sharded execution tier's contract is bit-identity with shards=1 at
+#: any worker/device count (the tests/test_shard_exec.py parity matrix
+#: and the verify_tier1.sh SERENE_SHARDS=4 pass enforce it), so keying
+#: on it would only split the cache between identical entries.
 RESULT_AFFECTING_SETTINGS = (
     "serene_device", "serene_device_min_rows", "serene_device_chunk_rows",
     "serene_device_fused", "serene_mesh", "sdb_nprobe", "sdb_rerank_factor",
     "sdb_scored_terms_limit", "search_path",
 )
 assert "serene_search_batch" not in RESULT_AFFECTING_SETTINGS
+assert "serene_shards" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path

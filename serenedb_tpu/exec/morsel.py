@@ -204,7 +204,34 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                 clocks = _stage_stamp(prof, id(st), b, clocks)
         return _morsel_partials(node, b)
 
+    from . import shard as shard_mod
+    n_shards = shard_mod.shard_count(settings)
     try:
+        if n_shards > 1 and len(keep) > 1:
+            # sharded tier (exec/shard.py): ONE pipeline per shard — the
+            # same morsel plan over the shard's round-robin block set,
+            # fanned out as concurrent pool tasks. Partials re-enter the
+            # merge in GLOBAL morsel order, so the sink consumes exactly
+            # the shards=1 partial list and the combine stays the
+            # bit-identical deterministic merge.
+            groups: dict[int, list] = {}
+            for pos, item in enumerate(keep):
+                s = shard_mod.shard_of_block(item[0][0] // morsel_rows,
+                                             n_shards)
+                groups.setdefault(s, []).append((pos, item))
+            shard_lists = [groups[s] for s in sorted(groups)]
+
+            def run_shard(entries):
+                return [(pos, run_morsel(item)) for pos, item in entries]
+
+            parts = shard_mod.run_shard_tasks(settings, run_shard,
+                                              shard_lists)
+            ordered: list = [None] * len(keep)
+            for chunk in parts:
+                for pos, p in chunk:
+                    ordered[pos] = p
+            shard_mod.stamp_profile(ctx, id(node), len(shard_lists))
+            return _merge_partials(node, ordered)
         partials = parallel_map(settings, run_morsel, keep)
         return _merge_partials(node, partials)
     except _Fallback:

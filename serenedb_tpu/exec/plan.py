@@ -145,20 +145,32 @@ class ScanNode(PlanNode):
         inner/right hash join) — they prune blocks like filter conjuncts
         but never run per row: rows in surviving blocks that miss the
         range are simply non-matching probe rows. None → plain scan."""
+        from . import shard as shard_mod
         from . import zonemap
         pin = self.provider.try_pin()
         block_rows = int(ctx.settings.get("serene_morsel_rows"))
+        sharded = isinstance(join_filters, shard_mod.ShardedRanges)
         v_scan = zonemap.block_verdicts(
             self.provider, ctx.settings, [self.filter], self.columns,
             block_rows, pin) if self.filter is not None else None
-        v_join = zonemap.block_verdicts(
-            self.provider, ctx.settings, list(join_filters), self.columns,
-            block_rows, pin) if join_filters else None
+        if sharded:
+            v_join = shard_mod.sharded_verdicts(
+                self.provider, ctx.settings, join_filters, self.columns,
+                block_rows, pin)
+        else:
+            v_join = zonemap.block_verdicts(
+                self.provider, ctx.settings, list(join_filters),
+                self.columns, block_rows, pin) if join_filters else None
         verdicts = zonemap.combine_verdicts(v_scan, v_join)
         if verdicts is None:
             return None
         if v_join is not None:
             zonemap.count_join_filter(v_join)
+            if sharded:
+                shard_mod.count_shard_pruned(v_join)
+                shard_mod.stamp_profile(
+                    ctx, id(self), len(join_filters),
+                    int((v_join == zonemap.SKIP).sum()))
         zonemap.count_pruned(verdicts)
         prof = getattr(ctx, "profile", None)
         if prof is not None:
@@ -177,15 +189,24 @@ class ScanNode(PlanNode):
         else:
             full = self.provider.full_batch(self.columns)
         nrows = full.num_rows
-        exprs = ([self.filter] if self.filter is not None else []) + \
-            list(join_filters or [])
+        scan_exprs = [self.filter] if self.filter is not None else []
+        exprs = scan_exprs + (list(join_filters or [])
+                              if not sharded else [])
 
         def gen():
             if zonemap.verify_enabled(ctx.settings):
                 spans = [(b * block_rows, min((b + 1) * block_rows, nrows))
                          for b in np.flatnonzero(verdicts == zonemap.SKIP)]
-                zonemap.verify_pruned_blocks(exprs, full, spans,
-                                             f"scan {self.provider.name}")
+                if sharded:
+                    # OR semantics: a pruned block must fail EVERY build
+                    # shard's range conjunction (plus the scan filter)
+                    for grp in join_filters:
+                        zonemap.verify_pruned_blocks(
+                            scan_exprs + list(grp), full, spans,
+                            f"scan {self.provider.name}")
+                else:
+                    zonemap.verify_pruned_blocks(
+                        exprs, full, spans, f"scan {self.provider.name}")
             emitted = False
             for b, v in enumerate(verdicts):
                 check_cancel()
@@ -504,12 +525,32 @@ class JoinNode(PlanNode):
             # prune the probe scan's morsels before they are enqueued
             rb = concat_batches(list(self.right.batches(ctx)))
             if rb.num_rows:
+                from . import shard as shard_mod
                 from . import zonemap
                 rkey_cols = [k.eval(rb) for k in self.right_keys]
-                exprs = zonemap.build_key_range_exprs(
-                    self.left_keys, rkey_cols)
-                if exprs:
-                    ctx.join_filters[id(scan)] = exprs
+                # shard-to-shard sideways passing: with serene_shards >
+                # 1 the build side publishes PER-SHARD key ranges (one
+                # min/max per round-robin block group) — probe blocks in
+                # the gaps between shard ranges prune where the single
+                # global envelope could not
+                published = None
+                n_shards = shard_mod.shard_count(ctx.settings)
+                if n_shards > 1:
+                    # the build side here is a materialized subtree
+                    # batch (no provider), so the view comes straight
+                    # from the partitioning function
+                    published = shard_mod.build_shard_ranges(
+                        self.left_keys, rkey_cols,
+                        shard_mod.shard_spans(
+                            rb.num_rows,
+                            int(ctx.settings.get("serene_morsel_rows")),
+                            n_shards))
+                if published is None:
+                    exprs = zonemap.build_key_range_exprs(
+                        self.left_keys, rkey_cols)
+                    published = exprs if exprs else None
+                if published:
+                    ctx.join_filters[id(scan)] = published
                     scan_id = id(scan)
             try:
                 lb = concat_batches(list(self.left.batches(ctx)))

@@ -110,6 +110,7 @@ class SearchScanNode(PlanNode):
                 searcher, self.qnode, self.topk, self.scorer, mesh_n,
                 ctx.settings)
             self._stamp_batch(ctx, bstats)
+            self._stamp_shards(ctx, searcher)
             out = full.take(docs.astype(np.int64))
             if self.with_score:
                 out = Batch(list(self.names),
@@ -140,6 +141,7 @@ class SearchScanNode(PlanNode):
                 searcher, self.qnode, max(n_candidates, 1), self.scorer,
                 mesh_n, ctx.settings)
             self._stamp_batch(ctx, bstats)
+            self._stamp_shards(ctx, searcher)
             smap = np.zeros(max(searcher.num_docs, 1), dtype=np.float32)
             smap[sdocs] = scores
             out = Batch(list(self.names),
@@ -157,6 +159,16 @@ class SearchScanNode(PlanNode):
             prof.add_search_batch(id(self), queries=bstats["queries"],
                                   window_ns=bstats["window_ns"],
                                   scoring_ns=bstats["scoring_ns"])
+
+    def _stamp_shards(self, ctx, searcher) -> None:
+        """`Shards:` attribution for a sharded multi-segment search:
+        the segment set partitioned into min(serene_shards, segments)
+        per-shard collector groups (searcher._run_segment_shards)."""
+        from . import shard as shard_mod
+        n = shard_mod.shard_count(ctx.settings)
+        nseg = len(getattr(searcher, "segments", ()) or ())
+        if n > 1 and nseg > 1:
+            shard_mod.stamp_profile(ctx, id(self), min(n, nseg))
 
     def _prune_docs_by_zones(self, ctx, full: Batch, docs: np.ndarray,
                              pin) -> tuple[np.ndarray, bool]:

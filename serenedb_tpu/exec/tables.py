@@ -100,6 +100,21 @@ class TableProvider:
                     out[name] = entry[1]
             return out
 
+    def shard_view(self, n_shards: int, block_rows: int,
+                   nrows: Optional[int] = None
+                   ) -> list[list[tuple[int, int]]]:
+        """Deterministic hash-partitioned shard view: per-shard row
+        spans under round-robin morsel-block assignment (exec/shard.py
+        owns the partitioning function). Blocks never migrate between
+        shards, so a pure append only creates/extends TAIL blocks and
+        every other shard's zone maps / device uploads stay valid.
+        Callers pass `nrows` from their own pinned publication so the
+        view can never straddle a concurrent publish."""
+        from .shard import shard_spans
+        if nrows is None:
+            nrows = self.row_count()
+        return shard_spans(nrows, block_rows, n_shards)
+
     def device_column(self, name: str) -> DeviceColumn:
         return self.device_columns([name], self.try_pin())[name]
 

@@ -27,7 +27,8 @@ from typing import Iterator, Optional
 _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
              "loops", "morsels_scheduled", "morsels_pruned",
              "morsels_jf_pruned", "device_ns", "batch_queries",
-             "batch_window_ns", "batch_scoring_ns")
+             "batch_window_ns", "batch_scoring_ns", "shard_pipelines",
+             "shard_pruned")
 
 
 class OpStats:
@@ -125,6 +126,16 @@ class QueryProfile:
         s.batch_window_ns += int(window_ns)
         s.batch_scoring_ns += int(scoring_ns)
 
+    def add_shards(self, key: int, pipelines: int, pruned: int = 0
+                   ) -> None:
+        """Sharded-tier span for one operator: how many per-shard
+        pipelines its execution fanned out into (serene_shards > 1) and
+        how many blocks the shard-to-shard join filter pruned — the
+        `Shards:` EXPLAIN ANALYZE detail line."""
+        s = self.stats(key)
+        s.shard_pipelines += int(pipelines)
+        s.shard_pruned += int(pruned)
+
     def wrap_batches(self, node, fn, ctx) -> Iterator:
         """Instrumented drive of a node's raw batch generator: wall time
         accrues only while inside next() (inclusive of children, PG
@@ -220,6 +231,9 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
                     f"{detail}Batch: queries={s.batch_queries} "
                     f"window={_ms(s.batch_window_ns)} ms "
                     f"shared_scoring={_ms(s.batch_scoring_ns)} ms")
+            if s.shard_pipelines or s.shard_pruned:
+                lines.append(f"{detail}Shards: n={s.shard_pipelines} "
+                             f"pruned={s.shard_pruned}")
         for c in node.children():
             lines.extend(walk(c, depth + 1))
         return lines

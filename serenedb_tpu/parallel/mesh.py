@@ -33,6 +33,20 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devs[:n]), (AXIS,))
 
 
+def shard_devices(n_shards: int) -> Optional[list]:
+    """Data-axis placement for the sharded execution tier
+    (exec/shard.py): shard s's per-shard program inputs commit to
+    device s % n_devices along the mesh's shard axis, so concurrent
+    shard dispatches land on distinct devices of the same mesh a
+    shard_map program would span. None on a single-device host — the
+    shards then share the default device and fan out as worker-pool
+    tasks only."""
+    devs = jax.devices()
+    if len(devs) <= 1 or n_shards <= 1:
+        return None
+    return [devs[s % len(devs)] for s in range(n_shards)]
+
+
 def pad_to_multiple(arr, n: int, fill=0):
     """Pad the leading axis to a multiple of n (THE shard-padding helper:
     data pads with `fill`, masks with False — padded rows never count).

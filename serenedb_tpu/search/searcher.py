@@ -833,6 +833,37 @@ class SegmentSearcher:
                 docs[order].astype(np.int32))
 
 
+def _run_segment_shards(run_segment, segments: list, cap: int) -> list:
+    """Drive the per-segment collectors, one result per segment in
+    SEGMENT ORDER. With `serene_shards` > 1 the segment set partitions
+    round-robin into per-shard groups (exec/shard.py's partitioning
+    function) and each shard's group runs as ONE pool task — the
+    sharded-tier unit of work — otherwise each segment is its own task.
+    Either way the caller's single-heap merge consumes the identical
+    per-segment outputs, so results are bit-identical at any shard or
+    worker count."""
+    from ..exec import shard as shard_mod
+    from ..parallel.pool import get_pool
+    if cap <= 1 or len(segments) <= 1:
+        return [run_segment(sb) for sb in segments]
+    n_shards = shard_mod.shard_count(None)
+    if n_shards > 1:
+        groups = shard_mod.group_round_robin(
+            list(enumerate(segments)), n_shards)
+
+        def run_group(entries):
+            return [(i, run_segment(sb)) for i, sb in entries]
+
+        parts = shard_mod.run_shard_tasks(None, run_group, groups)
+        outs: list = [None] * len(segments)
+        for chunk in parts:
+            for i, out in chunk:
+                outs[i] = out
+        return outs
+    return get_pool().ensure_started().map_ordered(
+        run_segment, list(segments), cap)
+
+
 def merge_segment_topk(seg_outs: list, bases: list[int], n_queries: int,
                        k: int) -> list[tuple[np.ndarray, np.ndarray]]:
     """Single-heap merge of per-segment top-k collector outputs.
@@ -963,11 +994,7 @@ class MultiSearcher:
         # the parallelism — keep the segment loop serial then.
         from ..parallel.pool import get_pool, session_workers
         cap = 1 if mesh_n > 1 else session_workers(None)
-        if cap > 1 and len(self.segments) > 1:
-            seg_outs = get_pool().ensure_started().map_ordered(
-                run_segment, list(self.segments), cap)
-        else:
-            seg_outs = [run_segment(sb) for sb in self.segments]
+        seg_outs = _run_segment_shards(run_segment, self.segments, cap)
         return merge_segment_topk(seg_outs,
                                   [b for _, b in self.segments],
                                   len(nodes), k)
@@ -1083,13 +1110,9 @@ class MultiSearcher:
                                               segset)
             return FRAGMENTS.cached(seg, shape, compute)
 
-        from ..parallel.pool import get_pool, session_workers
+        from ..parallel.pool import session_workers
         cap = session_workers(None)
-        if cap > 1 and len(self.segments) > 1:
-            outs = get_pool().ensure_started().map_ordered(
-                run_segment, list(self.segments), cap)
-        else:
-            outs = [run_segment(sb) for sb in self.segments]
+        outs = _run_segment_shards(run_segment, self.segments, cap)
         return merge_segment_topk([[o] for o in outs],
                                   [b for _, b in self.segments], 1, k)[0]
 

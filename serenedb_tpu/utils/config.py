@@ -256,6 +256,21 @@ declare("serene_search_batch_max", 128, int,
         "cap on queries per coalesced search scoring dispatch; overflow "
         "queries form the next dispatch", scope=Scope.GLOBAL,
         validator=lambda v: max(1, int(v)))
+declare("serene_shards", 1, int,
+        "sharded execution tier (exec/shard.py): table scans partition "
+        "into N shards by round-robin morsel-block assignment and the "
+        "morsel/fused pipelines run once per shard — as concurrent "
+        "worker-pool tasks, with per-shard device programs pinned "
+        "across jax.devices() when a multi-device mesh is present — "
+        "while the deterministic merge sinks (ordered partial merge, "
+        "single-heap top-k, partial-aggregate combine) act as the "
+        "cross-shard combiners; the build side of a hash join publishes "
+        "PER-SHARD key min/max so probe blocks outside every shard's "
+        "range are pruned before any scan or device upload. Results are "
+        "bit-identical at any shard count (1 = today's unsharded "
+        "execution, the parity oracle), so this setting is deliberately "
+        "excluded from the result cache's settings digest",
+        validator=lambda v: max(1, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

@@ -187,3 +187,17 @@ SEARCH_BATCH_COALESCED = REGISTRY.gauge(
     "SearchBatchCoalesced",
     "queries that shared their scoring dispatch with at least one other "
     "query (the batching win; singleton dispatches don't count)")
+SHARD_PIPELINES = REGISTRY.gauge(
+    "ShardPipelines",
+    "per-shard pipeline executions launched by the sharded execution "
+    "tier (serene_shards > 1): each morsel group, fused device dispatch "
+    "or segment-set search run over one shard counts once")
+SHARD_MORSELS_PRUNED = REGISTRY.gauge(
+    "ShardMorselsPruned",
+    "probe-side blocks pruned by the shard-to-shard join filter: the "
+    "build side's PER-SHARD key min/max ranges proved no row of the "
+    "block can find a partner in any build shard")
+SHARD_BYTES_SKIPPED = REGISTRY.gauge(
+    "ShardBytesSkipped",
+    "host->device upload bytes skipped because per-shard pruning "
+    "proved a probe shard's blocks partner-less before any transfer")

@@ -93,6 +93,17 @@ if os.environ.get("SERENE_SEARCH_BATCH"):
                            os.environ["SERENE_SEARCH_BATCH"])
 
 
+# scripts/verify_tier1.sh sharded-execution parity leg: force
+# serene_shards to the given count (e.g. "4") for a whole run — the
+# parallel/join/device/search parity suites then execute everything
+# through the sharded tier, proving per-shard pipelines plus the
+# cross-shard combiners are bit-identical to unsharded execution.
+if os.environ.get("SERENE_SHARDS"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_SH
+
+    _SDB_REG_SH.set_global("serene_shards", os.environ["SERENE_SHARDS"])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

@@ -1,0 +1,397 @@
+"""Parity suite for the sharded execution tier (ISSUE 9).
+
+Contract under test: `serene_shards = N` partitions scans into
+round-robin morsel-block shards and runs the UNCHANGED morsel / fused
+device / segment-search pipelines once per shard, with the engine's
+deterministic merge sinks acting as cross-shard combiners — and results
+are BIT-IDENTICAL to `serene_shards = 1` (the parity oracle) across the
+whole matrix: shards 1/2/4 × workers 1/4 × zonemap on/off ×
+device_fused on/off, over joins, grouped aggregates, top-N, search
+top-k, and empty / all-pruned shards. Plus: the shard-to-shard join
+filter (per-build-shard key min/max) prunes strictly more than the
+global range on gapped key distributions, `serene_shards` stays OUT of
+the result cache's settings digest, and the Shard* gauges/EXPLAIN line
+attribute the tier's work.
+"""
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec import shard as shard_mod
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.utils import metrics
+from serenedb_tpu.utils.config import REGISTRY as SETTINGS
+
+
+def _mk_conn(nl=6000, nr=3000, seed=11):
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE l (ik INT, sk TEXT, ts BIGINT, v BIGINT)")
+    c.execute("CREATE TABLE r (ik INT, sk TEXT, w BIGINT)")
+
+    def mk(n, null_frac, sd, payload, with_ts):
+        rng = np.random.default_rng(sd)
+        ik = rng.integers(0, 40, n).astype(np.int32)
+        ikv = rng.random(n) > null_frac
+        cols = {
+            "ik": Column(dt.INT, ik, ikv),
+            "sk": Column.from_numpy(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+        }
+        if with_ts:
+            cols["ts"] = Column.from_numpy(np.arange(n, dtype=np.int64))
+        cols[payload] = Column.from_numpy(
+            rng.integers(-500, 500, n, dtype=np.int64))
+        return Batch.from_pydict(cols)
+
+    db.schemas["main"].tables["l"] = MemTable(
+        "l", mk(nl, 0.1, seed, "v", True))
+    db.schemas["main"].tables["r"] = MemTable(
+        "r", mk(nr, 0.15, seed + 1, "w", False))
+    c.execute("SET serene_result_cache = off")
+    c.execute("SET serene_morsel_rows = 1024")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    return c
+
+
+def _rows(c, q):
+    return repr(c.execute(q).rows())
+
+
+#: the parity query set: grouped aggregate over a plain scan (morsel
+#: pipeline), joins scalar + grouped (fused/host), top-N, empty and
+#: all-pruned shapes
+QUERIES = [
+    # morsel-parallel grouped aggregate (host tier)
+    "SELECT sk, count(*), sum(v), avg(v), min(v), max(v) FROM l "
+    "WHERE v > -400 GROUP BY sk ORDER BY sk",
+    # scalar aggregate over a zone-prunable clustered predicate
+    "SELECT count(*), sum(v) FROM l WHERE ts >= 1024 AND ts < 3072",
+    # joins: scalar + grouped, int and dictionary-string keys
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v > 0",
+    "SELECT l.sk, count(*), sum(v), sum(w), min(w), max(v) FROM l "
+    "JOIN r ON l.ik = r.ik GROUP BY l.sk ORDER BY l.sk",
+    "SELECT l.ik, count(*), avg(w) FROM l JOIN r ON l.sk = r.sk "
+    "WHERE v > 250 GROUP BY l.ik ORDER BY l.ik NULLS LAST",
+    # top-N over a filtered scan
+    "SELECT ts, v FROM l WHERE v > 150 ORDER BY ts DESC LIMIT 9",
+    # empty result / all-pruned shards (ts is clustered: zone maps
+    # prune every block)
+    "SELECT count(*), sum(v) FROM l WHERE ts < -1",
+    "SELECT sk, sum(v) FROM l WHERE ts < -1 GROUP BY sk ORDER BY sk",
+]
+
+
+@pytest.mark.parametrize("mode", ["host", "fused"])
+@pytest.mark.parametrize("zonemap", ["on", "off"])
+def test_shard_parity_matrix(mode, zonemap):
+    """shards 1/2/4 × workers 1/4, per (device tier, zonemap) leg —
+    every cell bit-identical to shards=1 at the same settings."""
+    c = _mk_conn()
+    if mode == "fused":
+        c.execute("SET serene_device = 'tpu'")
+        c.execute("SET serene_device_fused = on")
+    else:
+        c.execute("SET serene_device = 'cpu'")
+        c.execute("SET serene_device_fused = off")
+    c.execute(f"SET serene_zonemap = {zonemap}")
+    for q in QUERIES:
+        ref = None
+        for workers in (1, 4):
+            c.execute(f"SET serene_workers = {workers}")
+            c.execute("SET serene_shards = 1")
+            base = _rows(c, q)
+            if ref is None:
+                ref = base
+            assert base == ref, f"workers perturbed results: {q}"
+            for shards in (2, 4):
+                c.execute(f"SET serene_shards = {shards}")
+                got = _rows(c, q)
+                assert got == ref, \
+                    f"shards={shards} workers={workers} diverged: {q}"
+        c.execute("SET serene_shards = 1")
+
+
+def test_shard_pipelines_gauge_and_fanout():
+    c = _mk_conn()
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_workers = 4")
+    before = metrics.SHARD_PIPELINES.value
+    c.execute("SELECT sk, sum(v) FROM l GROUP BY sk ORDER BY sk")
+    assert metrics.SHARD_PIPELINES.value - before >= 4
+
+
+def test_fused_shard_dispatch_count():
+    """Sharded fused execution = one build dispatch + one probe
+    dispatch per non-empty shard."""
+    c = _mk_conn()
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    q = ("SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r "
+         "ON l.ik = r.ik GROUP BY l.sk ORDER BY l.sk")
+    c.execute("SET serene_shards = 1")
+    ref = _rows(c, q)
+    c.execute("SET serene_shards = 4")
+    before = metrics.DEVICE_OFFLOADS.value
+    got = _rows(c, q)
+    assert got == ref
+    assert metrics.DEVICE_OFFLOADS.value - before == 5  # build + 4 shards
+
+
+def _gapped_join_conn():
+    """Probe sorted by key (tight per-block zone ranges); build holds
+    two DISJOINT key clusters, one per morsel block — so per-shard
+    ranges leave a wide gap the single global range cannot prune."""
+    db = Database()
+    c = db.connect()
+    rng = np.random.default_rng(7)
+    n = 40000
+    pk = np.sort(rng.integers(0, 40000, n).astype(np.int64))
+    c.execute("CREATE TABLE p (k BIGINT, v BIGINT)")
+    db.schemas["main"].tables["p"] = MemTable("p", Batch.from_pydict({
+        "k": Column.from_numpy(pk),
+        "v": Column.from_numpy(rng.integers(0, 100, n, dtype=np.int64))}))
+    bk = np.concatenate([rng.integers(0, 500, 1024),
+                         rng.integers(39000, 39500, 1024)]).astype(np.int64)
+    c.execute("CREATE TABLE b (k BIGINT)")
+    db.schemas["main"].tables["b"] = MemTable("b", Batch.from_pydict({
+        "k": Column.from_numpy(bk)}))
+    c.execute("SET serene_morsel_rows = 1024")
+    c.execute("SET serene_result_cache = off")
+    return c
+
+
+def test_shard_join_filter_prunes_more_than_global():
+    c = _gapped_join_conn()
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_device_fused = off")
+    q = "SELECT count(*), sum(v) FROM p JOIN b ON p.k = b.k"
+    c.execute("SET serene_shards = 1")
+    j0 = metrics.JOIN_FILTER_PRUNED.value
+    ref = _rows(c, q)
+    global_pruned = metrics.JOIN_FILTER_PRUNED.value - j0
+    c.execute("SET serene_shards = 2")
+    j0 = metrics.JOIN_FILTER_PRUNED.value
+    s0 = metrics.SHARD_MORSELS_PRUNED.value
+    got = _rows(c, q)
+    sharded_pruned = metrics.JOIN_FILTER_PRUNED.value - j0
+    assert got == ref
+    assert sharded_pruned > global_pruned, \
+        "per-shard ranges should prune the inter-cluster gap"
+    assert metrics.SHARD_MORSELS_PRUNED.value - s0 == sharded_pruned
+
+
+def test_shard_join_filter_survives_verify_mode():
+    """serene_zonemap_verify re-scans every shard-pruned block against
+    every shard's range conjunction — a divergence would raise."""
+    c = _gapped_join_conn()
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_shards = 2")
+    q = "SELECT count(*), sum(v) FROM p JOIN b ON p.k = b.k"
+    ref = _rows(c, q)
+    prior = SETTINGS.get_global("serene_zonemap_verify")
+    SETTINGS.set_global("serene_zonemap_verify", True)
+    try:
+        assert _rows(c, q) == ref
+    finally:
+        SETTINGS.set_global("serene_zonemap_verify", prior)
+
+
+def test_fused_shard_upload_skip_bytes():
+    """The device tier skips uploads for shard-pruned probe blocks and
+    accounts the saved transfer in ShardBytesSkipped."""
+    c = _gapped_join_conn()
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    q = "SELECT count(*), sum(v) FROM p JOIN b ON p.k = b.k"
+    c.execute("SET serene_shards = 1")
+    ref = _rows(c, q)
+    c.execute("SET serene_shards = 2")
+    b0 = metrics.SHARD_BYTES_SKIPPED.value
+    assert _rows(c, q) == ref
+    assert metrics.SHARD_BYTES_SKIPPED.value > b0
+
+
+def _search_conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT)")
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    rng = np.random.default_rng(5)
+    vals = ", ".join(f"({i}, '{' '.join(rng.choice(words, 5))}')"
+                     for i in range(2000))
+    c.execute(f"INSERT INTO docs VALUES {vals}")
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    # appends create extra segments → a real multi-segment searcher
+    for j in range(4):
+        vals = ", ".join(f"({10000 + 100 * j + i}, "
+                         f"'{' '.join(rng.choice(words, 5))}')"
+                         for i in range(100))
+        c.execute(f"INSERT INTO docs VALUES {vals}")
+        c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'")
+    c.execute("SET serene_result_cache = off")
+    return db, c
+
+
+SEARCH_QUERIES = [
+    "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'alpha | beta' "
+    "ORDER BY s DESC, id LIMIT 25",
+    "SELECT id FROM docs WHERE body @@ 'alpha & beta' ORDER BY id "
+    "LIMIT 20",
+    "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'zzz_nothing' "
+    "ORDER BY s DESC LIMIT 5",
+]
+
+
+def test_search_topk_shard_parity():
+    _db, c = _search_conn()
+    for q in SEARCH_QUERIES:
+        c.execute("SET serene_shards = 1")
+        ref = _rows(c, q)
+        for shards in (2, 4):
+            c.execute(f"SET serene_shards = {shards}")
+            for workers in (1, 4):
+                c.execute(f"SET serene_workers = {workers}")
+                assert _rows(c, q) == ref, (q, shards, workers)
+        c.execute("SET serene_shards = 1")
+
+
+def test_multisearcher_shard_parity_direct():
+    """Segment-set sharding at the MultiSearcher layer: topk and
+    cpu_topk bit-identical (scores, doc ids, tie order) at any shard
+    count."""
+    db, c = _search_conn()
+    from serenedb_tpu.search.index import find_index
+    from serenedb_tpu.search.query import parse_query
+    provider = db.resolve_table(["docs"])
+    ms = find_index(provider, "body").searchers["body"]
+    assert len(ms.segments) > 2
+    node = parse_query("alpha | gamma", ms.analyzer)
+    # restore the PRIOR global afterwards — verify_tier1.sh pass 8 pins
+    # it to 4 for the whole run, and hardcoding 1 here would silently
+    # strip the forced sharding from every later test in that pass
+    prior = SETTINGS.get_global("serene_shards")
+    SETTINGS.set_global("serene_shards", 1)
+    try:
+        s1, d1 = ms.topk(node, 10)
+        c1, cd1 = ms.cpu_topk(node, 10)
+        for shards in (2, 4):
+            SETTINGS.set_global("serene_shards", shards)
+            s, d = ms.topk(node, 10)
+            cs, cd = ms.cpu_topk(node, 10)
+            assert np.array_equal(s.view(np.uint32), s1.view(np.uint32))
+            assert np.array_equal(d, d1)
+            assert np.array_equal(cs.view(np.uint32), c1.view(np.uint32))
+            assert np.array_equal(cd, cd1)
+    finally:
+        SETTINGS.set_global("serene_shards", prior)
+
+
+# -- unit tier ---------------------------------------------------------------
+
+
+def test_shard_spans_round_robin():
+    spans = shard_mod.shard_spans(10_000, 1024, 4)
+    # 10 blocks round-robin over 4 shards: 3/3/2/2, tail short block
+    assert [len(s) for s in spans] == [3, 3, 2, 2]
+    assert spans[0][0] == (0, 1024)
+    assert spans[1][0] == (1024, 2048)
+    assert spans[0][1] == (4096, 5120)
+    assert spans[1][-1] == (9216, 10_000)
+    flat = sorted(sp for s in spans for sp in s)
+    assert flat == [(i * 1024, min((i + 1) * 1024, 10_000))
+                    for i in range(10)]
+
+
+def test_shard_spans_append_only_touches_tail():
+    """Round-robin assignment pins existing blocks to their shard: an
+    append extends/creates only tail blocks, every earlier block keeps
+    its shard (the zone-map append-friendliness argument)."""
+    before = shard_mod.shard_spans(10_000, 1024, 4)
+    after = shard_mod.shard_spans(13_000, 1024, 4)
+    for s in range(4):
+        for sp in before[s]:
+            if sp[1] % 1024 != 0 and sp[1] != 10_000:
+                continue
+            full = (sp[0], min(sp[0] + 1024, 13_000))
+            assert full in after[s]
+
+
+def test_provider_shard_view():
+    t = MemTable("t", Batch.from_pydict(
+        {"a": Column.from_numpy(np.arange(5000, dtype=np.int64))}))
+    view = t.shard_view(2, 1024)
+    assert view == shard_mod.shard_spans(5000, 1024, 2)
+
+
+def test_group_round_robin():
+    assert shard_mod.group_round_robin([1, 2, 3, 4, 5], 2) == \
+        [[1, 3, 5], [2, 4]]
+    assert shard_mod.group_round_robin([1], 4) == [[1]]
+    assert shard_mod.group_round_robin([], 4) == []
+
+
+def test_serene_shards_not_result_affecting():
+    """Bit-identity is the documented contract, so the sharded tier
+    must never split the result cache (PR 8's serene_search_batch
+    pattern)."""
+    from serenedb_tpu.cache.result import RESULT_AFFECTING_SETTINGS
+    assert "serene_shards" not in RESULT_AFFECTING_SETTINGS
+
+
+def test_result_cache_shared_across_shard_settings():
+    c = _mk_conn()
+    c.execute("SET serene_result_cache = on")
+    c.execute("SET serene_device = 'cpu'")
+    q = "SELECT sk, sum(v) FROM l GROUP BY sk ORDER BY sk"
+    c.execute("SET serene_shards = 1")
+    ref = _rows(c, q)
+    h0 = metrics.RESULT_CACHE_HITS.value
+    c.execute("SET serene_shards = 4")
+    assert _rows(c, q) == ref
+    assert metrics.RESULT_CACHE_HITS.value > h0, \
+        "shards=4 must hit the entry stored under shards=1"
+
+
+def test_explain_analyze_shards_line():
+    c = _mk_conn()
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_workers = 4")
+    out = c.execute(
+        "EXPLAIN ANALYZE SELECT sk, sum(v) FROM l GROUP BY sk "
+        "ORDER BY sk").rows()
+    text = "\n".join(r[0] for r in out)
+    assert "Shards: n=" in text, text
+
+
+def test_metrics_export_shard_gauges():
+    from serenedb_tpu.obs.export import prometheus_text, stats_json
+    text = prometheus_text()
+    assert "serenedb_shard_pipelines" in text
+    assert "serenedb_shard_morsels_pruned" in text
+    assert "serenedb_shard_bytes_skipped" in text
+    snap = stats_json()["metrics"]
+    assert "ShardPipelines" in snap and "ShardBytesSkipped" in snap
+
+
+def test_sharded_write_invalidation():
+    """A write between sharded executions must surface fresh data (the
+    per-shard device caches key on publications)."""
+    c = _mk_conn()
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    c.execute("SET serene_shards = 2")
+    q = "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik"
+    first = c.execute(q).rows()
+    c.execute("INSERT INTO r VALUES (1, 'alpha', 7)")
+    second = c.execute(q).rows()
+    assert second != first, "write must invalidate sharded caches"
+    # parity against the unsharded oracle on the NEW publication
+    c.execute("SET serene_shards = 1")
+    assert c.execute(q).rows() == second
