@@ -100,6 +100,20 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_SHARDS=4 \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc8=$?
 
+# Pass 9 is the timeline-tracing parity leg: serene_trace is forced ON
+# globally (the conftest env hook arms the global) over the trace,
+# profiler, parallel, shard and search-batch suites — every statement
+# then records span timelines (pool queue waits, coalesced-batch
+# fan-out, per-shard pipelines, device phases) into the flight recorder
+# while the suites' parity matrices assert results stay bit-identical.
+echo "== timeline tracing parity pass (serene_trace=on) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_TRACE=on \
+    python -m pytest tests/test_trace.py tests/test_profile.py \
+    tests/test_parallel_exec.py tests/test_shard_exec.py \
+    tests/test_search_batch.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc9=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -107,4 +121,5 @@ rc8=$?
 [ "$rc5" -ne 0 ] && exit "$rc5"
 [ "$rc6" -ne 0 ] && exit "$rc6"
 [ "$rc7" -ne 0 ] && exit "$rc7"
-exit "$rc8"
+[ "$rc8" -ne 0 ] && exit "$rc8"
+exit "$rc9"

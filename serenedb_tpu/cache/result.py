@@ -71,6 +71,11 @@ RESULT_AFFECTING_SETTINGS = (
 )
 assert "serene_search_batch" not in RESULT_AFFECTING_SETTINGS
 assert "serene_shards" not in RESULT_AFFECTING_SETTINGS
+# tracing observes, never steers (obs/trace.py): results are
+# bit-identical with the timeline layer on or off, so a cached entry is
+# valid across either setting
+assert "serene_trace" not in RESULT_AFFECTING_SETTINGS
+assert "serene_profile" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path

@@ -55,6 +55,14 @@ class QueryResult:
         return rs[0][0] if rs else None
 
 
+#: statement types the timeline tracer skips: pure session bookkeeping
+#: with no execution work — recording their empty timelines would churn
+#: the bounded flight recorder (obs/trace.FLIGHT) out of the slow-query
+#: entries it exists to preserve
+_UNTRACED_STATEMENTS = (ast.SetStmt, ast.ShowStmt, ast.SetRole,
+                        ast.Transaction, ast.ListenStmt, ast.NotifyStmt)
+
+
 def _result_rows(res: "QueryResult") -> int:
     """Rows a statement produced/affected, for statement stats: result
     rows when any came back, else the count off the PG command tag
@@ -590,6 +598,9 @@ class Database(TableResolver):
         if name == "sdb_cache":
             from .pgcatalog import cache_table
             return cache_table()
+        if name == "sdb_trace":
+            from .pgcatalog import trace_table
+            return trace_table(args)
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 
@@ -887,6 +898,9 @@ class Connection:
         #: on it.
         self._active_profile = None
         self._active_plan = None
+        #: the executing statement's timeline trace (serene_trace on);
+        #: finalized into the flight recorder at statement end
+        self._active_trace = None
         import weakref
         with db.lock:
             db._session_seq += 1
@@ -970,44 +984,68 @@ class Connection:
 
         def run():
             from .cache.result import _batch_nbytes
+            from .obs.trace import CURRENT_TRACE, FLIGHT, QueryTrace
             t0 = time.perf_counter_ns()
             nrows = 0
             acc: Optional[list] = [] if store_cap >= 0 else None
             acc_bytes = 0
+            # streaming trace: the generator resumes on arbitrary
+            # threads, so the trace pins CURRENT_TRACE around every
+            # step (same-thread set/reset pairs) instead of holding one
+            # token across suspensions
+            trace = QueryTrace(sql_text or "SELECT") \
+                if self._trace_enabled() else None
             with self._session_scope(sql_text if sql_text is not None
                                      else "SELECT"):
                 it = plan.batches(ctx)
-                while True:
-                    # the caller may resume this generator from any
-                    # worker thread: pin the connection contextvar around
-                    # every underlying step (scalar functions read it)
-                    tok = CURRENT_CONNECTION.set(self)
-                    try:
-                        b = next(it)
-                    except StopIteration:
+                try:
+                    while True:
+                        # the caller may resume this generator from any
+                        # worker thread: pin the connection contextvar
+                        # around every underlying step (scalar functions
+                        # read it), and the trace contextvar with it
+                        tok = CURRENT_CONNECTION.set(self)
+                        tok_tr = CURRENT_TRACE.set(trace) \
+                            if trace is not None else None
+                        try:
+                            b = next(it)
+                        except StopIteration:
+                            if acc is not None:
+                                out = concat_batches(acc) if acc else \
+                                    Batch(list(plan.names),
+                                          [Column.from_pylist([], t)
+                                           for t in plan.types])
+                                probe.store(out)
+                            # this generator IS the miss path — re-pin
+                            # the flag in case an interleaved statement
+                            # on this connection flipped it while we
+                            # were suspended
+                            self._cache_hit = False
+                            entry = FLIGHT.record(trace.finish()) \
+                                if trace is not None else None
+                            trace = None
+                            self._obs_record(sql_text, t0, nrows,
+                                             ctx.profile, plan, entry)
+                            return
+                        finally:
+                            if tok_tr is not None:
+                                CURRENT_TRACE.reset(tok_tr)
+                            CURRENT_CONNECTION.reset(tok)
+                        nrows += b.num_rows
                         if acc is not None:
-                            out = concat_batches(acc) if acc else \
-                                Batch(list(plan.names),
-                                      [Column.from_pylist([], t)
-                                       for t in plan.types])
-                            probe.store(out)
-                        # this generator IS the miss path — re-pin the
-                        # flag in case an interleaved statement on this
-                        # connection flipped it while we were suspended
-                        self._cache_hit = False
-                        self._obs_record(sql_text, t0, nrows,
-                                         ctx.profile, plan)
-                        return
-                    finally:
-                        CURRENT_CONNECTION.reset(tok)
-                    nrows += b.num_rows
-                    if acc is not None:
-                        acc_bytes += _batch_nbytes(b)
-                        if acc_bytes > store_cap:
-                            acc = None
-                        else:
-                            acc.append(b)
-                    yield b
+                            acc_bytes += _batch_nbytes(b)
+                            if acc_bytes > store_cap:
+                                acc = None
+                            else:
+                                acc.append(b)
+                        yield b
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    # error/early-close paths (incl. GeneratorExit from
+                    # a dropped portal) still dump the timeline
+                    if trace is not None:
+                        FLIGHT.record(trace.finish(
+                            error=f"{type(e).__name__}: {e}"))
+                    raise
 
         return plan.names, plan.types, run()
 
@@ -1077,10 +1115,33 @@ class Connection:
                 self._active_profile = None
                 self._active_plan = None
                 self._cache_hit = False
+                # utility statements (SET/SHOW/txn control/LISTEN/...)
+                # are not traced: their zero-span timelines would churn
+                # the bounded flight recorder out of exactly the slow
+                # statements it exists to preserve — a pgwire client
+                # issuing SET per query would halve the ring's reach
+                trace = None if isinstance(st, _UNTRACED_STATEMENTS) \
+                    else self._begin_trace(
+                        sql_text if sql_text is not None
+                        else type(st).__name__)
+                if trace is None:
+                    self._active_trace = None
                 t0 = time.perf_counter_ns()
-                res = self._dispatch(st, params, sql_text)
+                try:
+                    res = self._dispatch(st, params, sql_text)
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    # error paths dump the timeline automatically: the
+                    # flight recorder keeps the failed statement's spans
+                    # for post-mortem (sdb_trace / GET /trace/<id>)
+                    self._finish_trace(trace,
+                                       error=f"{type(e).__name__}: {e}")
+                    raise
+                entry = self._finish_trace(trace)
                 self._obs_record(sql_text, t0, _result_rows(res),
-                                 self._active_profile, self._active_plan)
+                                 self._active_profile, self._active_plan,
+                                 entry,
+                                 utility=isinstance(
+                                     st, _UNTRACED_STATEMENTS))
                 return res
         finally:
             CURRENT_CONNECTION.reset(token)
@@ -1473,6 +1534,39 @@ class Connection:
         except KeyError:  # pragma: no cover — registry always declares it
             return False
 
+    def _trace_enabled(self) -> bool:
+        try:
+            return bool(self.settings.get("serene_trace"))
+        except KeyError:  # pragma: no cover — registry always declares it
+            return False
+
+    def _begin_trace(self, label: str):
+        """Start the statement's timeline trace (serene_trace on):
+        allocates the trace id and publishes it through CURRENT_TRACE so
+        pool tasks / batcher members / device dispatches stamp spans
+        into this query's timeline. Observation only — executors never
+        read the trace back."""
+        if not self._trace_enabled():
+            self._active_trace = None
+            return None
+        from .obs.trace import CURRENT_TRACE, QueryTrace
+        tr = QueryTrace(label)
+        tr._cv_token = CURRENT_TRACE.set(tr)
+        self._active_trace = tr
+        return tr
+
+    def _finish_trace(self, tr, error: Optional[str] = None):
+        """Finalize a trace into the flight recorder (success AND error
+        paths — a failed statement's timeline is exactly the one worth
+        keeping). Returns the recorded entry, or None."""
+        if tr is None:
+            return None
+        from .obs.trace import CURRENT_TRACE, FLIGHT
+        if tr._cv_token is not None:
+            CURRENT_TRACE.reset(tr._cv_token)
+            tr._cv_token = None
+        return FLIGHT.record(tr.finish(error))
+
     def _exec_ctx(self, params: list) -> ExecContext:
         """Execution context with a span collector attached when
         `serene_profile` is on (obs/trace.py); the collector observes
@@ -1487,6 +1581,9 @@ class Connection:
     def _run_select(self, sel: ast.Select, params: list,
                     sql_text: Optional[str] = None) -> Batch:
         from .cache.result import RESULT_CACHE
+        from .obs.trace import current_trace
+        tr = current_trace()
+        t_probe = time.perf_counter_ns() if tr is not None else 0
         probe = RESULT_CACHE.begin(self, sel, params, sql_text)
         if probe is not None:
             # plan-skipping fast path: the statement's table set was
@@ -1494,8 +1591,20 @@ class Connection:
             # observe publications, serve
             hit = probe.fast_lookup()
             if hit is not None:
+                if tr is not None:
+                    tr.add("cache_probe", "cache", t_probe,
+                           time.perf_counter_ns(), hit=True)
                 return hit
+        t_plan = time.perf_counter_ns() if tr is not None else 0
+        if tr is not None and t_plan - t_probe > 1000:
+            # cache digest + publication observation time: part of the
+            # statement's wall clock, attributed so plan+execute+probe
+            # spans jointly cover the timeline instead of leaving a gap
+            tr.add("cache_probe", "cache", t_probe, t_plan)
         plan = self._plan(sel, params)
+        t_exec = time.perf_counter_ns() if tr is not None else 0
+        if tr is not None:
+            tr.add("plan", "plan", t_plan, t_exec)
         ctx = self._exec_ctx(params)
         if ctx.profile is not None:
             self._active_plan = plan
@@ -1505,22 +1614,43 @@ class Connection:
             if hit is not None:
                 return hit
         batch = plan.execute(ctx)
+        if tr is not None:
+            # the timeline's execution envelope: plan-digest probe,
+            # execution and result hand-off — so cache_probe + plan +
+            # execute jointly account for the statement's wall time
+            # even when no finer-grained span fired (tiny serial
+            # queries)
+            tr.add("execute", "exec", t_exec, time.perf_counter_ns())
         if probe is not None:
             probe.store(batch)
         return batch
 
     def _obs_record(self, sql_text: Optional[str], t0_ns: int, rows: int,
-                    profile, plan) -> None:
+                    profile, plan, trace_entry=None,
+                    utility: bool = False) -> None:
         """Statement-end observability hook (begin is _session_scope):
-        query gauges, sdb_stat_statements, the slow-query log and the
-        session's pg_stat_activity query id. Everything is behind
-        `serene_profile`; failures here must never fail the statement's
-        own result path, so this is called only after success."""
+        query gauges + latency histogram, sdb_stat_statements, the
+        slow-query log and the session's pg_stat_activity query id.
+        Everything is behind `serene_profile`; failures here must never
+        fail the statement's own result path, so this is called only
+        after success. `trace_entry` is the statement's flight-recorder
+        timeline (serene_trace on) — the slow-query log attaches its
+        top-5 widest spans next to the annotated plan tree.
+
+        The latency histogram records BEFORE the serene_profile gate:
+        the pool/batch/device histograms fill regardless of that
+        setting, and query p50/p99 is half of the admission-control
+        signal pair — it must not vanish because profiling was turned
+        off. `utility` statements (SET/SHOW/txn bookkeeping) stay OUT
+        of it: a client issuing SET per query would otherwise drown the
+        percentiles in microsecond observations."""
+        elapsed_ns = time.perf_counter_ns() - t0_ns
+        if not utility:
+            metrics.QUERY_LATENCY_HIST.observe_ns(elapsed_ns)
         if not self._profile_enabled():
             return
-        now = metrics.QUERY_TIME_NS.add_time_ns(t0_ns)
+        metrics.QUERY_TIME_NS.add(elapsed_ns)
         metrics.QUERIES_EXECUTED.add()
-        elapsed_ns = now - t0_ns
         pruned = 0
         if profile is not None:
             t = profile.totals()
@@ -1543,6 +1673,9 @@ class Connection:
             if profile is not None and plan is not None:
                 from .obs.trace import annotate_plan
                 msg += "\n" + "\n".join(annotate_plan(plan, profile))
+            if trace_entry is not None:
+                from .obs.trace import format_top_spans
+                msg += "\n" + "\n".join(format_top_spans(trace_entry))
             log.info("slow_query", msg)
 
     # -- DDL/DML -----------------------------------------------------------
@@ -2416,9 +2549,19 @@ class Connection:
 
     def _explain(self, st: ast.Explain, params: list,
                  sql_text: Optional[str] = None) -> QueryResult:
+        fmt = getattr(st, "format", "text")
         if isinstance(st.inner, (ast.Select, ast.SetOp)):
             plan = self._plan(st.inner, params)
             if not st.analyze:
+                if fmt == "json":
+                    import json as _json
+
+                    from .obs.trace import annotate_plan_json
+                    lines = [_json.dumps(
+                        [{"Plan": annotate_plan_json(plan, None)}],
+                        indent=2)]
+                    b = Batch.from_pydict({"QUERY PLAN": lines})
+                    return QueryResult(b, f"SELECT {len(lines)}")
                 lines = plan.explain()
             else:
                 # ANALYZE always instruments (PG semantics), independent
@@ -2444,12 +2587,33 @@ class Connection:
                 elapsed = (time.perf_counter() - t0) * 1000
                 if cache_line == "Result Cache: miss":
                     probe.store(result)
+                if fmt == "json":
+                    # machine-readable EXPLAIN ANALYZE: the annotated
+                    # tree (rows, timings, prune counters, device/shard
+                    # keys) as one JSON document, PG's FORMAT JSON shape
+                    import json as _json
+
+                    from .obs.trace import annotate_plan_json
+                    doc: dict = {
+                        "Plan": annotate_plan_json(plan, prof),
+                        "Execution Time": round(elapsed, 3),
+                        "Rows Returned": result.num_rows,
+                    }
+                    if cache_line:
+                        doc["Result Cache"] = \
+                            cache_line.split(": ", 1)[1]
+                    lines = [_json.dumps([doc], indent=2)]
+                    b = Batch.from_pydict({"QUERY PLAN": lines})
+                    return QueryResult(b, f"SELECT {len(lines)}")
                 lines = annotate_plan(plan, prof) + \
                     ([cache_line] if cache_line else []) + [
                     f"Execution Time: {elapsed:.3f} ms",
                     f"Rows Returned: {result.num_rows}",
                 ]
         elif isinstance(st.inner, (ast.Insert, ast.Update, ast.Delete)):
+            if fmt == "json":
+                raise errors.unsupported(
+                    "EXPLAIN (FORMAT JSON) of DML statements")
             lines = self._explain_dml(st, params)
         else:
             raise errors.unsupported(

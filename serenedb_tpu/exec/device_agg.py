@@ -85,15 +85,25 @@ def try_device_aggregate(node, ctx) -> Optional[Batch]:
             return None
     try:
         prof = getattr(ctx, "profile", None)
-        if prof is None:
-            return _run(node, scan, provider, preds, ctx)
+        from ..obs.trace import current_trace
+        trace = current_trace()
         # host-vs-device attribution: everything inside _run (upload,
-        # compile-cache lookup, dispatch, readback) is device-path time,
-        # stamped on the aggregate node the offload replaced
+        # compile-cache lookup, dispatch, readback) is device-path
+        # time, stamped on the aggregate node the offload replaced.
+        # The histogram observes UNCONDITIONALLY — the device latency
+        # signal must not vanish when profiling/tracing are off (two
+        # clock reads per ms-scale offload)
         import time as _time
+
+        from ..utils import metrics as _metrics
         t0 = _time.perf_counter_ns()
         out = _run(node, scan, provider, preds, ctx)
-        prof.add_device_ns(id(node), _time.perf_counter_ns() - t0)
+        t1 = _time.perf_counter_ns()
+        if prof is not None:
+            prof.add_device_ns(id(node), t1 - t0)
+        _metrics.DEVICE_DISPATCH_HIST.observe_ns(t1 - t0)
+        if trace is not None:
+            trace.add("device_dispatch", "device", t0, t1, op="agg")
         return out
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"aggregate fell back to CPU: {e}")

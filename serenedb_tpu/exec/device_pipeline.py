@@ -41,6 +41,7 @@ conjuncts shrink the transfer to the surviving block envelope
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from collections import OrderedDict
@@ -75,6 +76,16 @@ _AGG_FUNCS = {"count_star", "count", "sum", "min", "max", "avg"}
 _HOST_EVAL_UNSAFE = {
     "scalar_subquery", "array_subquery", "in_subquery", "exists",
     "currval", "lastval"}
+
+
+def _trace_span(trace, name: str, t0_ns: int, **args) -> None:
+    """Timeline phase attribution (serene_trace): the same boundaries
+    the profiler's device_ns counters use, but with BEGIN/END stamps so
+    the factorize -> upload -> dispatch sequencing is visible. No-op
+    when tracing is off (trace is None); call sites bind the trace with
+    functools.partial so one helper serves every program shape."""
+    if trace is not None:
+        trace.add(name, "device", t0_ns, time.perf_counter_ns(), **args)
 
 
 def fused_enabled(settings) -> bool:
@@ -456,9 +467,16 @@ def _run_fused(node, join, probe_side, build_side,
     import jax.numpy as jnp
 
     prof = getattr(ctx, "profile", None)
+    from ..obs.trace import current_trace
+    trace = current_trace()
 
     def clock() -> int:
-        return time.perf_counter_ns() if prof is not None else 0
+        # always real: the phase stamps feed the unconditional device
+        # histogram, not just the prof/trace consumers (a few ns reads
+        # per ms-scale offload)
+        return time.perf_counter_ns()
+
+    tspan = functools.partial(_trace_span, trace)
 
     pscan, ppreds = probe_side
     bscan, bpreds = build_side
@@ -554,6 +572,7 @@ def _run_fused(node, join, probe_side, build_side,
                           compile_expr(spec.arg, join_types, dictionaries)))
     if prof is not None:
         prof.add_device_ns(id(node), clock() - t0)
+    tspan("device_compile", t0)
 
     # join-key factorization (host, cached per publication pair along
     # with the worst-case pair count: every int32 count/limb scatter in
@@ -594,6 +613,7 @@ def _run_fused(node, join, probe_side, build_side,
         sum_modes[si] = mode
     if prof is not None:
         prof.add_device_ns(id(join), clock() - t0)
+    tspan("device_factorize", t0)
 
     # empty short-circuit: no surviving rows on either side ⇒ no pairs;
     # synthesize the zero-accumulator outputs without a dispatch
@@ -674,6 +694,7 @@ def _run_fused(node, join, probe_side, build_side,
                               lambda: _rowmask_tiles(build.n_live))
     if prof is not None:
         prof.add_device_ns(id(pscan), clock() - t0)
+    tspan("device_upload", t0)
 
     # -- the single program -------------------------------------------------
     decode_specs = [(env_cols[i].scheme, env_cols[i].offset) for i in needed]
@@ -767,6 +788,8 @@ def _run_fused(node, join, probe_side, build_side,
                     dictionaries, group_space, group_mode, sum_modes)
     if prof is not None:
         prof.add_device_ns(id(node), clock() - t0)
+    metrics.DEVICE_DISPATCH_HIST.observe_ns(time.perf_counter_ns() - t0)
+    tspan("device_dispatch", t0)
     return out
 
 
@@ -1028,6 +1051,10 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
     from .plan import check_cancel
 
     settings = ctx.settings
+    from ..obs.trace import current_trace
+    trace = current_trace()
+    tspan = functools.partial(_trace_span, trace)
+
     keyset = (tuple(_expr_key(k) for k in join.left_keys),
               tuple(_expr_key(k) for k in join.right_keys))
     space = g + 2
@@ -1142,6 +1169,9 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
             outs = jitted_b(*flat_b)
             if prof is not None:
                 prof.add_device_ns(id(join), clock() - tb)
+            metrics.DEVICE_DISPATCH_HIST.observe_ns(
+                time.perf_counter_ns() - tb)
+            tspan("device_dispatch", tb, phase="build")
             build_state["v"] = outs
             return outs
 
@@ -1216,6 +1246,7 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
 
     def run_shard(s: int) -> list[np.ndarray]:
         check_cancel()
+        t_up = time.perf_counter_ns() if trace is not None else 0
         device = devs[s % len(devs)] if devs else None
         spans = per_shard[s]
         spans_t = tuple(spans)
@@ -1280,7 +1311,13 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
         flat.extend([pc_dev, prow])
         flat.extend(bouts)
         metrics.DEVICE_OFFLOADS.add()
-        return [np.asarray(o) for o in jitted_p(*flat)]
+        tspan("device_upload", t_up, shard=s)
+        t_d = time.perf_counter_ns()
+        outs = [np.asarray(o) for o in jitted_p(*flat)]
+        metrics.DEVICE_DISPATCH_HIST.observe_ns(
+            time.perf_counter_ns() - t_d)
+        tspan("device_dispatch", t_d, shard=s)
+        return outs
 
     shard_outs = shard_mod.run_shard_tasks(settings, run_shard, shard_ids)
     results = _combine_shard_results(agg_plans, sum_modes, shard_outs)
@@ -1639,12 +1676,20 @@ def try_device_fused_topn(limit_node, ctx) -> Optional[Batch]:
     desc = bool(sort.descs[0])
     try:
         prof = getattr(ctx, "profile", None)
-        t0 = time.perf_counter_ns() if prof is not None else 0
+        from ..obs.trace import current_trace
+        trace = current_trace()
+        t0 = time.perf_counter_ns()
         out = _run_fused_topn(limit_node, scan, preds, ki, desc, k, ctx,
                               proj)
         if prof is not None:
             prof.add_device_ns(id(limit_node),
                                time.perf_counter_ns() - t0)
+        if out is not None:
+            metrics.DEVICE_DISPATCH_HIST.observe_ns(
+                time.perf_counter_ns() - t0)
+            if trace is not None:
+                trace.add("device_dispatch", "device", t0,
+                          time.perf_counter_ns(), op="topn")
         return out
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"fused top-N fell back to CPU: {e}")

@@ -74,17 +74,26 @@ def try_device_topn(limit_node, ctx) -> Optional[Batch]:
             provider.row_count() < ctx.settings.get("serene_device_min_rows"):
         return None
     from ..columnar.device import DeviceNarrowingError
+    from ..obs.trace import current_trace
     prof = getattr(ctx, "profile", None)
+    trace = current_trace()
     try:
         import time as _time
-        t0 = _time.perf_counter_ns() if prof is not None else 0
+        t0 = _time.perf_counter_ns()
         idx = _topn_indices(provider, scan, scan.columns[col_idx],
                             bool(sort.descs[0]), k, ctx)
+        t1 = _time.perf_counter_ns()
         if prof is not None:
             # device-path time lands on the Limit node that claimed the
             # Sort pipeline (the offload replaced its whole subtree)
-            prof.add_device_ns(id(limit_node),
-                               _time.perf_counter_ns() - t0)
+            prof.add_device_ns(id(limit_node), t1 - t0)
+        if idx is not None:
+            # unconditional: the device latency signal survives
+            # profiling/tracing being off (None = declined, no dispatch)
+            from ..utils import metrics as _metrics
+            _metrics.DEVICE_DISPATCH_HIST.observe_ns(t1 - t0)
+            if trace is not None:
+                trace.add("device_dispatch", "device", t0, t1, op="topn")
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"top-N fell back to CPU: {e}")
         return None

@@ -204,8 +204,11 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                 clocks = _stage_stamp(prof, id(st), b, clocks)
         return _morsel_partials(node, b)
 
+    from ..obs.trace import current_trace
     from . import shard as shard_mod
     n_shards = shard_mod.shard_count(settings)
+    trace = current_trace()
+    t_pipe = time.perf_counter_ns() if trace is not None else 0
     try:
         if n_shards > 1 and len(keep) > 1:
             # sharded tier (exec/shard.py): ONE pipeline per shard — the
@@ -231,9 +234,18 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                 for pos, p in chunk:
                     ordered[pos] = p
             shard_mod.stamp_profile(ctx, id(node), len(shard_lists))
-            return _merge_partials(node, ordered)
+            out = _merge_partials(node, ordered)
+            if trace is not None:
+                trace.add("morsel_pipeline", "morsel", t_pipe,
+                          time.perf_counter_ns(), morsels=len(keep),
+                          shards=len(shard_lists))
+            return out
         partials = parallel_map(settings, run_morsel, keep)
-        return _merge_partials(node, partials)
+        out = _merge_partials(node, partials)
+        if trace is not None:
+            trace.add("morsel_pipeline", "morsel", t_pipe,
+                      time.perf_counter_ns(), morsels=len(keep))
+        return out
     except _Fallback:
         return None
 

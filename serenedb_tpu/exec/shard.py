@@ -88,10 +88,34 @@ def group_round_robin(items: list, n_shards: int) -> list[list]:
 def run_shard_tasks(settings, fn: Callable, shard_items: list) -> list:
     """One pipeline execution per shard on the shared worker pool,
     results in shard order (deterministic). Counts each launched shard
-    pipeline in the ShardPipelines gauge."""
+    pipeline in the ShardPipelines gauge; under `serene_trace` each
+    shard's execution is stamped as a `shard_pipeline` span (with its
+    shard index) into the query's timeline — the shard fan-out becomes
+    visible as parallel lanes in the Chrome trace."""
+    import time
+
+    from ..obs.trace import current_trace
     from ..parallel.pool import parallel_map
     metrics.SHARD_PIPELINES.add(len(shard_items))
-    return parallel_map(settings, fn, shard_items)
+    trace = current_trace()
+    if trace is None:
+        return parallel_map(settings, fn, shard_items)
+
+    def traced(pair):
+        s, item = pair
+        # the fused device path passes REAL shard ids (possibly
+        # non-contiguous after pruning, e.g. [0, 2, 3]) — label with
+        # them so the lane agrees with the device spans stamped inside;
+        # other callers pass per-shard work lists, labeled by position
+        label = item if isinstance(item, int) else s
+        t0 = time.perf_counter_ns()
+        try:
+            return fn(item)
+        finally:
+            trace.add("shard_pipeline", "shard", t0,
+                      time.perf_counter_ns(), shard=label)
+
+    return parallel_map(settings, traced, list(enumerate(shard_items)))
 
 
 class ShardedRanges(list):

@@ -1,12 +1,16 @@
-"""Query observability: per-operator profiling, statement statistics,
-metrics export (ISSUE 4).
+"""Query observability: per-operator profiling, timeline tracing,
+statement statistics, metrics export (ISSUES 4 + 10).
 
 The instrument panel for every later perf PR: `obs.trace` collects
 per-operator spans (rows, wall+CPU time, morsel prune counters, bytes,
 device time) with per-worker-thread accumulation and a deterministic
-sink merge, `obs.statements` keeps the `sdb_stat_statements` registry
-keyed by normalized query fingerprint, and `obs.export` renders the
-Prometheus `/metrics` and JSON `/_stats` payloads. Everything is gated
-by `serene_profile` (default on) and observes only — results are
-bit-identical with profiling on or off, at any worker count.
+sink merge, AND the per-query timeline layer (trace ids, timestamped
+span events in per-thread rings, the always-on flight recorder, Chrome
+trace export); `obs.statements` keeps the `sdb_stat_statements`
+registry keyed by normalized query fingerprint (with per-fingerprint
+latency percentiles); `obs.export` renders the Prometheus `/metrics`
+(gauges + latency histograms) and JSON `/_stats` payloads. Profiling
+is gated by `serene_profile`, timelines by `serene_trace` (both default
+on) and both observe only — results are bit-identical with them on or
+off, at any worker/shard count.
 """

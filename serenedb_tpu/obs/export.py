@@ -26,8 +26,11 @@ def _label_escape(v: str) -> str:
 
 def prometheus_text() -> str:
     """The whole registry as Prometheus gauges (one consistent
-    Registry.snapshot(), not per-gauge reads mid-scrape) plus per-
-    statement call/time/row series labeled by queryid."""
+    Registry.snapshot(), not per-gauge reads mid-scrape), the latency
+    histograms as classic-histogram series (cumulative `le` buckets in
+    seconds + _sum/_count — p50/p99 derivable with
+    histogram_quantile()), plus per-statement call/time/row series
+    labeled by queryid."""
     lines: list[str] = []
     snap = _metrics.REGISTRY.snapshot()
     descs = {g.name: g.description for g in _metrics.REGISTRY.all()}
@@ -37,6 +40,21 @@ def prometheus_text() -> str:
             lines.append(f"# HELP {pname} {descs[name]}")
         lines.append(f"# TYPE {pname} gauge")
         lines.append(f"{pname} {snap[name]}")
+    for h in _metrics.REGISTRY.all_histograms():
+        pname = _prom_name(h.name) + "_seconds"
+        counts, sum_ns = h.snapshot()
+        if h.description:
+            lines.append(f"# HELP {pname} {h.description}")
+        lines.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for bound_ns, c in zip(_metrics.HIST_BOUNDS_NS, counts):
+            cum += c
+            lines.append(
+                f'{pname}_bucket{{le="{bound_ns / 1e9:.6g}"}} {cum}')
+        cum += counts[-1]
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{pname}_sum {sum_ns / 1e9:.9g}")
+        lines.append(f"{pname}_count {cum}")
     stmts = STATEMENTS.snapshot()
     if stmts:
         for series, key in (("statement_calls", "calls"),
@@ -54,11 +72,16 @@ def prometheus_text() -> str:
 
 
 def stats_json() -> dict:
-    """Gauge snapshot + statement stats + cache tier summaries for the
-    JSON `/_stats` route."""
+    """Gauge snapshot + latency percentiles + statement stats + cache
+    tier summaries + flight-recorder summary for the JSON `/_stats`
+    route."""
     from ..cache.fragments import FRAGMENTS
     from ..cache.result import RESULT_CACHE
+    from .trace import FLIGHT, flight_summary
     return {"metrics": _metrics.REGISTRY.snapshot(),
+            "latency": {h.name: h.percentiles_ms()
+                        for h in _metrics.REGISTRY.all_histograms()},
             "statements": STATEMENTS.snapshot(),
             "cache": {"result": RESULT_CACHE.stats(),
-                      "fragments": FRAGMENTS.stats()}}
+                      "fragments": FRAGMENTS.stats()},
+            "traces": [flight_summary(e) for e in FLIGHT.snapshot()]}

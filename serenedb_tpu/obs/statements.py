@@ -18,6 +18,8 @@ import threading
 from collections import OrderedDict
 
 from ..sql.lexer import T, tokenize
+from ..utils.metrics import (HIST_BOUNDS_NS, hist_bucket_index,
+                             hist_quantile_ns)
 
 
 @functools.lru_cache(maxsize=512)
@@ -69,17 +71,21 @@ class StatementStore:
         norm = normalize(query_text)
         qid = fingerprint(norm)
         ms = elapsed_ns / 1e6
+        bucket = hist_bucket_index(elapsed_ns)
         with self._lock:
             e = self._entries.get(qid)
             if e is None:
                 while len(self._entries) >= max(int(cap), 1):
                     self._entries.popitem(last=False)
+                hist = [0] * (len(HIST_BOUNDS_NS) + 1)
+                hist[bucket] = 1
                 self._entries[qid] = {
                     "queryid": qid, "query": norm, "calls": 1,
                     "total_ms": ms, "min_ms": ms, "max_ms": ms,
                     "rows": int(rows),
                     "morsels_pruned": int(morsels_pruned),
-                    "cache_hits": int(bool(cache_hit))}
+                    "cache_hits": int(bool(cache_hit)),
+                    "hist": hist}
             else:
                 self._entries.move_to_end(qid)
                 e["calls"] += 1
@@ -89,15 +95,34 @@ class StatementStore:
                 e["rows"] += int(rows)
                 e["morsels_pruned"] += int(morsels_pruned)
                 # entries recorded before the cache subsystem existed in
-                # this process lifetime may lack the key
+                # this process lifetime may lack the key (same story for
+                # the latency histogram below)
                 e["cache_hits"] = e.get("cache_hits", 0) + \
                     int(bool(cache_hit))
+                hist = e.setdefault("hist",
+                                    [0] * (len(HIST_BOUNDS_NS) + 1))
+                hist[bucket] += 1
         return qid
 
     def snapshot(self) -> list[dict]:
-        """Point-in-time copy, most recently executed last."""
+        """Point-in-time copy, most recently executed last. The raw
+        per-entry latency histogram collapses into p50/p95/p99
+        milliseconds (the per-fingerprint percentiles surfaced by
+        sdb_stat_statements and /_stats)."""
         with self._lock:
-            return [dict(e) for e in self._entries.values()]
+            out = []
+            for e in self._entries.values():
+                d = dict(e)
+                hist = d.pop("hist", None)
+                if hist is not None:
+                    d["p50_ms"] = round(
+                        hist_quantile_ns(hist, 0.50) / 1e6, 3)
+                    d["p95_ms"] = round(
+                        hist_quantile_ns(hist, 0.95) / 1e6, 3)
+                    d["p99_ms"] = round(
+                        hist_quantile_ns(hist, 0.99) / 1e6, 3)
+                out.append(d)
+            return out
 
     def reset(self) -> None:
         with self._lock:

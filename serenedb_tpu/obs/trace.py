@@ -19,9 +19,14 @@ purpose — that is the work the pool did).
 
 from __future__ import annotations
 
+import contextvars
+import itertools
 import threading
 import time
+from collections import OrderedDict
 from typing import Iterator, Optional
+
+from ..utils import metrics
 
 #: additive per-operator counters (merge = sum; scheduling-order free)
 _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
@@ -196,6 +201,247 @@ class QueryProfile:
         return t
 
 
+# -- timeline tracing (serene_trace) ------------------------------------------
+#
+# The QueryProfile above answers "how much" per operator; the timeline
+# layer answers "WHEN": every query gets a trace id and timestamped span
+# events — (name, category, begin ns, end ns, thread, detail) — recorded
+# into per-thread rings (a plain-list append under the GIL, no lock on
+# the hot path after first touch, the same bucket pattern QueryProfile
+# uses), so the pool's queue waits, batcher coalescing windows, shard
+# fan-outs and device dispatch phases become one Chrome-trace-loadable
+# timeline. Spans propagate across the worker pool via the CURRENT_TRACE
+# contextvar (pool tasks copy the submitter's context), and a coalesced
+# search dispatch stamps its spans under EVERY member query's trace.
+# Like the profiler, tracing observes only — results are bit-identical
+# with it on or off at any worker/shard count.
+
+#: per-thread span ring cap: a runaway span producer degrades to
+#: counting drops instead of growing without bound
+TRACE_RING_CAP = 8192
+
+_TRACE_IDS = itertools.count(1)
+
+#: the executing statement's QueryTrace (None outside a traced
+#: statement). Pool tasks capture the submitter's context at submit
+#: time, so worker-thread spans land in the right query's timeline.
+CURRENT_TRACE: contextvars.ContextVar = contextvars.ContextVar(
+    "sdb_current_trace", default=None)
+
+
+def current_trace():
+    """The executing statement's trace, or None (tracing off / outside
+    a statement). One contextvar read — cheap enough for hot-ish paths."""
+    return CURRENT_TRACE.get()
+
+
+class _Ring:
+    __slots__ = ("tid", "thread_name", "spans", "dropped")
+
+    def __init__(self, tid: int, thread_name: str):
+        self.tid = tid
+        self.thread_name = thread_name
+        self.spans: list[tuple] = []
+        self.dropped = 0
+
+
+class QueryTrace:
+    """One query's span-event collector.
+
+    Spans are recorded at END time with explicit (begin, end)
+    perf_counter_ns stamps, so within a thread they nest properly by
+    construction (a span closes only after every span it started inside
+    it). `add` appends to the calling thread's ring; rings merge at
+    `finish()` into one begin-ordered span list with ns offsets relative
+    to the trace start."""
+
+    __slots__ = ("trace_id", "query", "t0_ns", "t0_epoch_us", "end_ns",
+                 "error", "_register_lock", "_rings", "_tl", "_cv_token")
+
+    def __init__(self, query_text: str = ""):
+        self.trace_id = next(_TRACE_IDS)
+        self.query = query_text
+        self.t0_ns = time.perf_counter_ns()
+        self.t0_epoch_us = int(time.time() * 1e6)
+        self.end_ns: Optional[int] = None
+        self.error: Optional[str] = None
+        self._register_lock = threading.Lock()
+        self._rings: list[_Ring] = []
+        self._tl = threading.local()
+        self._cv_token = None
+
+    def now(self) -> int:
+        return time.perf_counter_ns()
+
+    def add(self, name: str, cat: str, begin_ns: int, end_ns: int,
+            **detail) -> None:
+        """Record one span event from any thread. begin/end are
+        perf_counter_ns stamps (end >= begin enforced); detail keys
+        become Chrome trace `args`."""
+        r = getattr(self._tl, "r", None)
+        if r is None:
+            t = threading.current_thread()
+            r = self._tl.r = _Ring(t.ident or 0, t.name)
+            with self._register_lock:
+                self._rings.append(r)
+        if len(r.spans) >= TRACE_RING_CAP:
+            r.dropped += 1
+            return
+        r.spans.append((name, cat, begin_ns, max(end_ns, begin_ns),
+                        detail or None))
+
+    def finish(self, error: Optional[str] = None) -> dict:
+        """Close the trace: stamp the root `query` span, merge the
+        per-thread rings into one begin-ordered span list (offsets
+        relative to the trace start) and return the flight-recorder
+        entry dict."""
+        self.end_ns = time.perf_counter_ns()
+        self.error = error
+        dur = self.end_ns - self.t0_ns
+        with self._register_lock:
+            rings = list(self._rings)
+        spans = [{"name": "query", "cat": "query", "tid": 0,
+                  "thread": "query", "begin_ns": 0, "end_ns": dur,
+                  "args": {"query": self.query[:500],
+                           "trace_id": self.trace_id}}]
+        dropped = 0
+        for r in rings:
+            dropped += r.dropped
+            for name, cat, b, e, detail in r.spans:
+                spans.append({"name": name, "cat": cat, "tid": r.tid,
+                              "thread": r.thread_name,
+                              "begin_ns": b - self.t0_ns,
+                              "end_ns": e - self.t0_ns,
+                              "args": detail})
+        spans.sort(key=lambda s: (s["begin_ns"], -s["end_ns"]))
+        if dropped:
+            metrics.TRACE_SPANS_DROPPED.add(dropped)
+        # statement text truncates at entry-build time: every consumer
+        # (listing, /_stats, chrome otherData) shows <= 500 chars, and
+        # the always-on ring must not pin multi-MB INSERT literals
+        return {"trace_id": self.trace_id, "query": self.query[:500],
+                "begin_epoch_us": self.t0_epoch_us,
+                "duration_ns": dur, "error": error,
+                "spans": spans, "spans_dropped": dropped}
+
+
+class FlightRecorder:
+    """Always-on bounded ring of the last N completed query timelines
+    (`serene_flight_recorder_queries`, default 64): the slow-query log
+    and error paths read a stall's timeline AFTER the fact instead of
+    asking for a reproduction. One short lock per statement END."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, dict]" = OrderedDict()
+
+    def _cap(self) -> int:
+        from ..utils.config import REGISTRY
+        try:
+            return max(1, int(REGISTRY.get_global(
+                "serene_flight_recorder_queries")))
+        except KeyError:  # pragma: no cover — registry declares it
+            return 64
+
+    def record(self, entry: dict) -> dict:
+        cap = self._cap()
+        with self._lock:
+            self._entries[entry["trace_id"]] = entry
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)   # oldest completes out
+        metrics.TRACES_RECORDED.add()
+        return entry
+
+    def get(self, trace_id: int) -> Optional[dict]:
+        with self._lock:
+            return self._entries.get(int(trace_id))
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            if not self._entries:
+                return None
+            return next(reversed(self._entries.values()))
+
+    def snapshot(self) -> list[dict]:
+        """Newest-last entry list (shared references — treat as
+        read-only)."""
+        with self._lock:
+            return list(self._entries.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: process-wide flight recorder (one per process, like the metrics
+#: registry)
+FLIGHT = FlightRecorder()
+
+
+def flight_summary(entry: dict) -> dict:
+    """One flight entry as the compact listing dict — the single shape
+    behind the GET /trace index and /_stats.traces, so the surfaces
+    can't drift field by field."""
+    return {"trace_id": entry["trace_id"],
+            "query": entry["query"][:200],
+            "duration_ms": round(entry["duration_ns"] / 1e6, 3),
+            "spans": len(entry["spans"]),
+            "spans_dropped": entry["spans_dropped"],
+            "error": entry["error"]}
+
+
+def top_spans(entry: dict, n: int = 5) -> list[dict]:
+    """The n widest non-root spans of a recorded timeline (slow-query
+    log attachment)."""
+    inner = [s for s in entry["spans"] if s["cat"] != "query"]
+    inner.sort(key=lambda s: s["end_ns"] - s["begin_ns"], reverse=True)
+    return inner[:n]
+
+
+def format_top_spans(entry: dict, n: int = 5) -> list[str]:
+    lines = [f"timeline: trace_id={entry['trace_id']} "
+             f"duration={_ms(entry['duration_ns'])} ms "
+             f"spans={len(entry['spans'])}"]
+    for s in top_spans(entry, n):
+        det = ""
+        if s["args"]:
+            det = " " + " ".join(f"{k}={v}" for k, v in s["args"].items())
+        lines.append(
+            f"  span {s['cat']}/{s['name']} "
+            f"[{_ms(s['begin_ns'])}..{_ms(s['end_ns'])} ms] "
+            f"thread={s['thread']}{det}")
+    return lines
+
+
+def chrome_trace(entry: dict) -> dict:
+    """One flight-recorder entry as Chrome trace-event JSON (`ph: "X"`
+    complete events, ts/dur in µs relative to the query start) —
+    loadable in Perfetto / chrome://tracing as-is."""
+    events: list[dict] = []
+    tids = {0: "query"}
+    for s in entry["spans"]:
+        tids.setdefault(s["tid"], s["thread"])
+        ev = {"name": s["name"], "cat": s["cat"], "ph": "X",
+              "ts": s["begin_ns"] / 1e3,
+              "dur": (s["end_ns"] - s["begin_ns"]) / 1e3,
+              "pid": 1, "tid": s["tid"]}
+        if s["args"]:
+            ev["args"] = dict(s["args"])
+        events.append(ev)
+    meta = [{"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": f"serenedb query {entry['trace_id']}"}}]
+    for tid, tname in tids.items():
+        meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                     "tid": tid, "args": {"name": tname}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": entry["trace_id"],
+                          "query": entry["query"][:500],
+                          "begin_epoch_us": entry["begin_epoch_us"],
+                          "duration_ms": entry["duration_ns"] / 1e6,
+                          "error": entry["error"],
+                          "spans_dropped": entry["spans_dropped"]}}
+
+
 def _ms(ns: int) -> str:
     return f"{ns / 1e6:.3f}"
 
@@ -239,3 +485,49 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
         return lines
 
     return walk(plan, 0)
+
+
+def annotate_plan_json(plan, profile: Optional[QueryProfile]) -> dict:
+    """EXPLAIN (FORMAT JSON) rendering: the plan tree as a
+    machine-readable object — PG's JSON key shapes where they map
+    ("Node Type", "Actual Total Time", "Actual Rows", "Plans"), plus the
+    engine's prune / device / batch / shard detail as flat keys instead
+    of the text renderer's detail lines. profile=None renders structure
+    only (plain EXPLAIN)."""
+    merged = profile.merged() if profile is not None else {}
+
+    def walk(node) -> dict:
+        out: dict = {"Node Type": node.label()}
+        if profile is not None:
+            s = merged.get(id(node))
+            if s is None:
+                out["Never Executed"] = True
+            else:
+                first = s.first_ns if s.first_ns is not None else s.wall_ns
+                out["Actual Startup Time"] = round(first / 1e6, 3)
+                out["Actual Total Time"] = round(s.wall_ns / 1e6, 3)
+                out["Actual Rows"] = s.rows_out
+                out["Actual Loops"] = max(s.loops, 1)
+                if s.morsels_scheduled or s.morsels_pruned:
+                    out["Morsels Scheduled"] = s.morsels_scheduled
+                    out["Morsels Zonemap Pruned"] = s.morsels_pruned
+                    if s.morsels_jf_pruned:
+                        out["Morsels Join Filter Pruned"] = \
+                            s.morsels_jf_pruned
+                if s.device_ns:
+                    out["Device Time"] = round(s.device_ns / 1e6, 3)
+                if s.batch_queries:
+                    out["Batch Queries"] = s.batch_queries
+                    out["Batch Window Time"] = \
+                        round(s.batch_window_ns / 1e6, 3)
+                    out["Batch Shared Scoring Time"] = \
+                        round(s.batch_scoring_ns / 1e6, 3)
+                if s.shard_pipelines or s.shard_pruned:
+                    out["Shard Pipelines"] = s.shard_pipelines
+                    out["Shard Morsels Pruned"] = s.shard_pruned
+        kids = node.children()
+        if kids:
+            out["Plans"] = [walk(c) for c in kids]
+        return out
+
+    return walk(plan)

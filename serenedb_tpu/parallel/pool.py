@@ -33,16 +33,28 @@ from typing import Callable, Iterable, Optional, Sequence
 
 from ..utils import metrics
 
+_TRACE_VAR = None
+
+
+def _trace_var():
+    """The obs-layer CURRENT_TRACE contextvar, imported once on first
+    use (keeps pool importable without the obs package initialized)."""
+    global _TRACE_VAR
+    if _TRACE_VAR is None:
+        from ..obs.trace import CURRENT_TRACE
+        _TRACE_VAR = CURRENT_TRACE
+    return _TRACE_VAR
+
 
 class _Task:
-    __slots__ = ("fn", "args", "future", "ctx", "t_submit")
+    __slots__ = ("fn", "args", "future", "ctx", "t_submit_ns")
 
     def __init__(self, fn, args):
         self.fn = fn
         self.args = args
         self.future: Future = Future()
         self.ctx = contextvars.copy_context()
-        self.t_submit = time.perf_counter()
+        self.t_submit_ns = time.perf_counter_ns()
 
 
 class WorkerPool:
@@ -93,6 +105,7 @@ class WorkerPool:
                 raise RuntimeError("worker pool is shut down")
             self._deques[self._rr % self.size].append(task)
             self._rr += 1
+            metrics.POOL_QUEUE_DEPTH.add()
             self._cv.notify()
         if not self._threads:
             self.ensure_started()
@@ -151,16 +164,22 @@ class WorkerPool:
     # -- worker loop -------------------------------------------------------
 
     def _pop_task(self, wid: int) -> Optional[_Task]:
+        task = None
         dq = self._deques[wid]
         if dq:
-            return dq.popleft()
-        for off in range(1, self.size):
-            other = self._deques[(wid + off) % self.size]
-            if other:
-                task = other.pop()       # steal from the opposite end
-                metrics.POOL_STEALS.add()
-                return task
-        return None
+            task = dq.popleft()
+        else:
+            for off in range(1, self.size):
+                other = self._deques[(wid + off) % self.size]
+                if other:
+                    task = other.pop()   # steal from the opposite end
+                    metrics.POOL_STEALS.add()
+                    break
+        if task is not None:
+            # the task left the queue (will run or was cancelled while
+            # queued) — the live-depth gauge drops either way
+            metrics.POOL_QUEUE_DEPTH.sub()
+        return task
 
     def _worker(self, wid: int):
         self._worker_ids.add(threading.get_ident())
@@ -175,18 +194,38 @@ class WorkerPool:
             f = task.future
             if not f.set_running_or_notify_cancel():
                 continue           # cancelled while queued: drained, no run
-            t0 = time.perf_counter()
-            metrics.POOL_QUEUE_WAIT_US.add(int((t0 - task.t_submit) * 1e6))
+            t0 = time.perf_counter_ns()
+            wait_ns = t0 - task.t_submit_ns
+            metrics.POOL_QUEUE_WAIT_US.add(wait_ns // 1000)
+            metrics.POOL_TASK_WAIT_NS.add(wait_ns)
+            metrics.POOL_QUEUE_WAIT_HIST.observe_ns(wait_ns)
+            # timeline attribution: the submitter's trace rides the
+            # task's captured context — one mapping lookup per TASK
+            # (morsel-sized, never per row), two span appends when a
+            # traced statement submitted it
+            trace = task.ctx.get(_trace_var())
+            if trace is not None:
+                trace.add("queue_wait", "pool", task.t_submit_ns, t0)
+            metrics.POOL_RUNNING.add()
             try:
                 result = task.ctx.run(task.fn, *task.args)
+                exc = None
             except BaseException as e:  # noqa: BLE001 — delivered via future
-                f.set_exception(e)
+                exc = e
+            t1 = time.perf_counter_ns()
+            metrics.POOL_RUNNING.sub()
+            metrics.POOL_MORSELS.add()
+            metrics.POOL_BUSY_US.add((t1 - t0) // 1000)
+            # the task span MUST be in the ring before the future
+            # resolves: delivering the result wakes the statement
+            # thread, which may finalize the trace immediately — a span
+            # stamped after that is lost (or outlives the timeline)
+            if trace is not None:
+                trace.add("task", "pool", t0, t1)
+            if exc is not None:
+                f.set_exception(exc)
             else:
                 f.set_result(result)
-            finally:
-                metrics.POOL_MORSELS.add()
-                metrics.POOL_BUSY_US.add(
-                    int((time.perf_counter() - t0) * 1e6))
 
 
 # -- process-wide singleton -------------------------------------------------

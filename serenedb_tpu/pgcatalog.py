@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from . import errors
 from .columnar import dtypes as dt
 from .columnar.column import Batch, Column
 from .exec.tables import MemTable, TableProvider
@@ -1293,6 +1294,8 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return stat_statements_table()
     if name == "sdb_cache":
         return cache_table()
+    if name == "sdb_trace":
+        return trace_table([])
     return None
 
 
@@ -1320,14 +1323,18 @@ def cache_table() -> TableProvider:
 def stat_statements_table() -> TableProvider:
     """sdb_stat_statements: cumulative stats per normalized statement
     fingerprint (obs/statements.py), PG pg_stat_statements column
-    shapes where they map. LRU-capped by serene_stat_statements_max."""
+    shapes where they map, plus per-fingerprint latency percentiles
+    derived from the entry's log-spaced histogram sketch. LRU-capped by
+    serene_stat_statements_max."""
     from .obs.statements import STATEMENTS
     rows = STATEMENTS.snapshot()
     return _typed("sdb_stat_statements", [
         ("queryid", dt.BIGINT), ("query", dt.VARCHAR),
         ("calls", dt.BIGINT), ("total_time_ms", dt.DOUBLE),
         ("mean_time_ms", dt.DOUBLE), ("min_time_ms", dt.DOUBLE),
-        ("max_time_ms", dt.DOUBLE), ("rows", dt.BIGINT),
+        ("max_time_ms", dt.DOUBLE), ("p50_time_ms", dt.DOUBLE),
+        ("p95_time_ms", dt.DOUBLE), ("p99_time_ms", dt.DOUBLE),
+        ("rows", dt.BIGINT),
         ("morsels_pruned", dt.BIGINT), ("cache_hits", dt.BIGINT)], {
         "queryid": [e["queryid"] for e in rows],
         "query": [e["query"] for e in rows],
@@ -1337,9 +1344,58 @@ def stat_statements_table() -> TableProvider:
                          for e in rows],
         "min_time_ms": [round(e["min_ms"], 6) for e in rows],
         "max_time_ms": [round(e["max_ms"], 6) for e in rows],
+        "p50_time_ms": [e.get("p50_ms", 0.0) for e in rows],
+        "p95_time_ms": [e.get("p95_ms", 0.0) for e in rows],
+        "p99_time_ms": [e.get("p99_ms", 0.0) for e in rows],
         "rows": [e["rows"] for e in rows],
         "morsels_pruned": [e["morsels_pruned"] for e in rows],
         "cache_hits": [e.get("cache_hits", 0) for e in rows]})
+
+
+def trace_table(args: list) -> TableProvider:
+    """sdb_trace: the flight recorder as a relation. With no argument,
+    one row per recorded query timeline (newest last — the listing to
+    find a trace id). With a trace id argument, one row per span of
+    that timeline, begin-ordered; unknown ids yield an empty relation
+    (the entry may have aged out of the ring)."""
+    import json as _json
+
+    from .obs.trace import FLIGHT
+    if not args or args[0] is None:
+        entries = FLIGHT.snapshot()
+        return _typed("sdb_trace", [
+            ("trace_id", dt.BIGINT), ("query", dt.VARCHAR),
+            ("duration_ms", dt.DOUBLE), ("spans", dt.BIGINT),
+            ("spans_dropped", dt.BIGINT), ("error", dt.VARCHAR)], {
+            "trace_id": [e["trace_id"] for e in entries],
+            "query": [e["query"] for e in entries],
+            "duration_ms": [round(e["duration_ns"] / 1e6, 3)
+                            for e in entries],
+            "spans": [len(e["spans"]) for e in entries],
+            "spans_dropped": [e["spans_dropped"] for e in entries],
+            "error": [e["error"] or "" for e in entries]})
+    try:
+        tid = int(args[0])
+    except (TypeError, ValueError):
+        raise errors.SqlError(errors.INVALID_TEXT_REPRESENTATION,
+                              "sdb_trace(id) requires an integer trace id")
+    entry = FLIGHT.get(tid)
+    spans = entry["spans"] if entry is not None else []
+    return _typed("sdb_trace", [
+        ("trace_id", dt.BIGINT), ("span", dt.VARCHAR),
+        ("category", dt.VARCHAR), ("thread", dt.VARCHAR),
+        ("begin_ms", dt.DOUBLE), ("end_ms", dt.DOUBLE),
+        ("duration_ms", dt.DOUBLE), ("detail", dt.VARCHAR)], {
+        "trace_id": [tid] * len(spans),
+        "span": [s["name"] for s in spans],
+        "category": [s["cat"] for s in spans],
+        "thread": [str(s["thread"]) for s in spans],
+        "begin_ms": [round(s["begin_ns"] / 1e6, 4) for s in spans],
+        "end_ms": [round(s["end_ns"] / 1e6, 4) for s in spans],
+        "duration_ms": [round((s["end_ns"] - s["begin_ns"]) / 1e6, 4)
+                        for s in spans],
+        "detail": [_json.dumps(s["args"]) if s["args"] else ""
+                   for s in spans]})
 
 
 def metrics_table() -> TableProvider:

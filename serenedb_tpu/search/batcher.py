@@ -38,6 +38,7 @@ caller (with its own context/cancellation), never its batch siblings.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Optional
@@ -45,10 +46,15 @@ from typing import Optional
 from ..utils import metrics
 from ..utils.config import REGISTRY as _settings_registry
 
+#: process-wide dispatch sequence: every coalesced dispatch gets one id,
+#: stamped into each member's batch_dispatch span so a timeline reader
+#: (or test) can see WHICH queries shared a scoring pass
+_DISPATCH_SEQ = itertools.count(1)
+
 
 class _Entry:
     __slots__ = ("node", "done", "retry", "result", "n_batch",
-                 "window_ns", "scoring_ns", "t_submit_ns")
+                 "window_ns", "scoring_ns", "t_submit_ns", "trace")
 
     def __init__(self, node):
         self.node = node
@@ -59,6 +65,12 @@ class _Entry:
         self.window_ns = 0
         self.scoring_ns = 0
         self.t_submit_ns = time.perf_counter_ns()
+        # the submitter's timeline (None when tracing is off): a
+        # coalesced dispatch stamps its window/scoring spans under
+        # EVERY member query's trace, so each member's timeline shows
+        # both the wait it paid and the shared dispatch it rode
+        from ..obs.trace import current_trace
+        self.trace = current_trace()
 
 
 class _Group:
@@ -172,6 +184,7 @@ class SearchBatcher:
             outs = None   # members retry serially; the bad one re-raises
         t1 = time.perf_counter_ns()
         wait_ns = 0
+        seq = next(_DISPATCH_SEQ) if outs is not None else 0
         with self._lock:
             g.dispatching = False
             for i, x in enumerate(batch):
@@ -181,6 +194,18 @@ class SearchBatcher:
                     x.window_ns = max(t0 - x.t_submit_ns, 0)
                     x.scoring_ns = t1 - t0
                     wait_ns += x.window_ns
+                    if x.trace is not None:
+                        # per-member timeline: how long THIS query
+                        # waited queued, then the shared scoring
+                        # dispatch it rode. Stamped from the
+                        # dispatching thread BEFORE x.done releases the
+                        # member — its statement cannot finalize its
+                        # trace until these spans are in the rings
+                        if x.window_ns:
+                            x.trace.add("batch_wait", "search",
+                                        x.t_submit_ns, t0)
+                        x.trace.add("batch_dispatch", "search", t0, t1,
+                                    queries=len(batch), dispatch=seq)
                     x.done = True
                 else:
                     x.retry = True
@@ -191,6 +216,8 @@ class SearchBatcher:
             metrics.SEARCH_BATCH_WINDOW_WAIT_NS.add(wait_ns)
             if len(batch) > 1:
                 metrics.SEARCH_BATCH_COALESCED.add(len(batch))
+            for x in batch:
+                metrics.SEARCH_BATCH_WINDOW_HIST.observe_ns(x.window_ns)
 
 
 #: process-wide batcher (searcher groups are process-wide objects)

@@ -84,6 +84,31 @@ class Handler(BaseHTTPRequestHandler):
         if p[0] == "_cluster" and len(p) > 1 and p[1] == "health":
             self._send(200, es.cluster_health())
             return
+        if p[0] == "trace" and method == "GET" and \
+                (len(p) == 1 or
+                 (len(p) == 2 and (p[1] == "last" or p[1].isdigit()))):
+            # flight-recorder timelines as Chrome trace-event JSON:
+            # /trace lists recorded entries, /trace/<id> (or
+            # /trace/last) returns one timeline loadable in Perfetto /
+            # chrome://tracing. Deliberately NARROW (exact /trace, or a
+            # numeric/last second segment, GET only) so an ES index
+            # named "trace" keeps its whole /trace/_search, /trace/_doc
+            # ... API surface — the same tradeoff as /metrics above.
+            from ..obs.trace import FLIGHT, chrome_trace, flight_summary
+            if len(p) == 1:
+                self._send(200, [flight_summary(e)
+                                 for e in FLIGHT.snapshot()])
+                return
+            entry = FLIGHT.last() if p[1] == "last" \
+                else FLIGHT.get(int(p[1]))
+            if entry is None:
+                raise EsError(404, "resource_not_found_exception",
+                              f"no recorded trace [{p[1]}] (the "
+                              "flight recorder keeps the last "
+                              "serene_flight_recorder_queries "
+                              "completed queries)")
+            self._send(200, chrome_trace(entry))
+            return
         if p == ["metrics"] and method == "GET":
             # Prometheus exposition: the whole gauge registry (one
             # consistent snapshot) + per-statement series (obs/export).

@@ -396,6 +396,7 @@ class Transaction(Statement):
 class Explain(Statement):
     inner: Statement
     analyze: bool = False
+    format: str = "text"              # 'text' | 'json' (PG FORMAT option)
 
 
 @dataclass

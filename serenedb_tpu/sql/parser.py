@@ -83,6 +83,19 @@ class Parser:
         self.next()
         return t.value
 
+    def _explain_bool_opt(self) -> bool:
+        """Optional boolean value of an EXPLAIN list option (PG: a bare
+        option means ON; ON/OFF/TRUE/FALSE/1/0 are accepted values)."""
+        t = self.peek()
+        if t.kind is T.IDENT and t.value.upper() in (
+                "ON", "OFF", "TRUE", "FALSE"):
+            self.next()
+            return t.value.upper() in ("ON", "TRUE")
+        if t.kind is T.NUMBER and t.value in ("0", "1"):
+            self.next()
+            return t.value == "1"
+        return True
+
     # -- entry points ------------------------------------------------------
 
     def parse_statements(self) -> list[ast.Statement]:
@@ -155,8 +168,33 @@ class Parser:
             return ast.Transaction("release", self.ident())
         if self.at_kw("EXPLAIN"):
             self.next()
-            analyze = self.accept_kw("ANALYZE")
-            return ast.Explain(self.parse_statement(), analyze)
+            analyze = False
+            fmt = "text"
+            if self.accept_op("("):
+                # PG option-list form: EXPLAIN (ANALYZE [ON|OFF],
+                # FORMAT {TEXT|JSON}, ...) — boolean options take an
+                # optional value, FORMAT takes a required one
+                while True:
+                    opt = self.ident().lower()
+                    if opt == "format":
+                        fmt = self.ident().lower()
+                        if fmt not in ("text", "json"):
+                            raise errors.unsupported(
+                                f"EXPLAIN format {fmt.upper()}")
+                    elif opt in ("analyze", "analyse"):
+                        analyze = self._explain_bool_opt()
+                    elif opt in ("verbose", "costs", "timing",
+                                 "summary", "buffers"):
+                        self._explain_bool_opt()   # accepted, no-op
+                    else:
+                        raise errors.syntax(
+                            f'unrecognized EXPLAIN option "{opt}"')
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                analyze = self.accept_kw("ANALYZE")
+            return ast.Explain(self.parse_statement(), analyze, fmt)
         if self.at_kw("ALTER"):
             return self.parse_alter()
         if self.at_kw("GRANT", "REVOKE"):

@@ -205,6 +205,23 @@ declare("serene_profile", True, bool,
         "sdb_stat_statements, the slow-query log and pg_stat_activity "
         "query ids; results are bit-identical on or off (<3% overhead "
         "budget, profile_overhead bench shape)")
+declare("serene_trace", True, bool,
+        "query timeline tracing (obs/trace.py): every statement gets a "
+        "trace id and timestamped span events — worker-pool queue waits, "
+        "morsel pipeline fan-out, search-batcher coalescing windows, "
+        "per-shard pipelines and device factorize/upload/dispatch "
+        "phases — recorded into lock-free per-thread rings, finalized "
+        "into the flight recorder ring, and served as Chrome "
+        "trace-event JSON via sdb_trace(id) and GET /trace/<id>. "
+        "Observation only: results are bit-identical on or off at any "
+        "worker/shard count (<3% overhead budget, trace_overhead bench "
+        "shape)")
+declare("serene_flight_recorder_queries", 64, int,
+        "size of the always-on flight recorder: the last N completed "
+        "query timelines are kept in a bounded ring so the slow-query "
+        "log and error paths can dump a stall's timeline after the "
+        "fact; oldest entries evict past the cap",
+        scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
 declare("serene_log_min_duration_ms", -1, int,
         "log statements running at least this many ms to the "
         "slow_query topic (profiled plan tree included when available); "

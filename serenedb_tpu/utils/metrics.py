@@ -8,10 +8,9 @@ boundaries.
 
 from __future__ import annotations
 
+import bisect
 import threading
-import time
 from contextlib import contextmanager
-from typing import Optional
 
 
 class Gauge:
@@ -36,17 +35,6 @@ class Gauge:
         with self._lock:
             self._value = n
 
-    def add_time_ns(self, start_ns: int,
-                    now_ns: Optional[int] = None) -> int:
-        """Accumulate one elapsed interval atomically: adds
-        (now - start_ns) nanoseconds in a single locked update and
-        returns `now`, so call sites chain consecutive intervals off one
-        clock read instead of re-reading between add and next start."""
-        if now_ns is None:
-            now_ns = time.perf_counter_ns()
-        self.add(now_ns - start_ns)
-        return now_ns
-
     def delta(self, baseline: int) -> int:
         """Current value minus a snapshot baseline (one atomic read) —
         the scrape-side pairing of Registry.snapshot()."""
@@ -66,9 +54,102 @@ class Gauge:
             self.sub(n)
 
 
+#: log-spaced histogram bucket upper bounds in NANOSECONDS: powers of two
+#: from 1 µs to ~137 s (28 buckets) plus the implicit +Inf overflow slot.
+#: Log spacing keeps relative quantile error bounded (one octave) across
+#: six decades of latency with a fixed, tiny footprint — the Prometheus
+#: classic-histogram shape, shared by the process-wide `Histogram` gauges
+#: and the per-fingerprint latency sketches in obs/statements.py.
+HIST_BOUNDS_NS: tuple[int, ...] = tuple(1000 * (1 << k) for k in range(28))
+
+
+def hist_bucket_index(ns: int) -> int:
+    """Bucket slot for one observation: the first bound >= ns, or the
+    +Inf slot (len(HIST_BOUNDS_NS)) past the last finite bound."""
+    return bisect.bisect_left(HIST_BOUNDS_NS, max(int(ns), 0))
+
+
+def hist_quantile_ns(counts, q: float) -> float:
+    """Quantile estimate from bucket counts (len = len(HIST_BOUNDS_NS)+1)
+    by linear interpolation inside the target bucket — the same estimate
+    Prometheus' histogram_quantile() would derive from the exported
+    buckets, so /_stats and a real Prometheus agree. Observations in the
+    +Inf bucket clamp to the largest finite bound. Returns ns (0.0 when
+    the histogram is empty)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            if i >= len(HIST_BOUNDS_NS):      # +Inf bucket: clamp
+                return float(HIST_BOUNDS_NS[-1])
+            lo = float(HIST_BOUNDS_NS[i - 1]) if i else 0.0
+            hi = float(HIST_BOUNDS_NS[i])
+            return lo + (hi - lo) * ((target - cum) / c)
+        cum += c
+    return float(HIST_BOUNDS_NS[-1])
+
+
+class Histogram:
+    """Fixed log-spaced-bucket latency histogram (Prometheus classic
+    histogram semantics: cumulative `le` buckets + sum + count).
+
+    Observed at task/statement boundaries only — one bisect over 28
+    bounds plus one locked triple update per observation, never per row —
+    so p50/p95/p99 become derivable from `/metrics` and `/_stats`
+    without any per-request allocation."""
+
+    __slots__ = ("name", "description", "_counts", "_sum_ns", "_lock")
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._counts = [0] * (len(HIST_BOUNDS_NS) + 1)
+        self._sum_ns = 0
+        self._lock = threading.Lock()
+
+    def observe_ns(self, ns: int) -> None:
+        i = hist_bucket_index(ns)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum_ns += max(int(ns), 0)
+
+    def snapshot(self) -> tuple[list[int], int]:
+        """(per-bucket counts, sum ns) under one lock acquisition."""
+        with self._lock:
+            return list(self._counts), self._sum_ns
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def quantile_ns(self, q: float) -> float:
+        counts, _ = self.snapshot()
+        return hist_quantile_ns(counts, q)
+
+    def percentiles_ms(self) -> dict:
+        """{count, p50_ms, p95_ms, p99_ms} for the /_stats JSON."""
+        counts, _ = self.snapshot()
+        return {"count": sum(counts),
+                "p50_ms": round(hist_quantile_ns(counts, 0.50) / 1e6, 3),
+                "p95_ms": round(hist_quantile_ns(counts, 0.95) / 1e6, 3),
+                "p99_ms": round(hist_quantile_ns(counts, 0.99) / 1e6, 3)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(HIST_BOUNDS_NS) + 1)
+            self._sum_ns = 0
+
+
 class Registry:
     def __init__(self):
         self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
 
     def gauge(self, name: str, description: str = "") -> Gauge:
         g = self._gauges.get(name)
@@ -76,8 +157,17 @@ class Registry:
             g = self._gauges[name] = Gauge(name, description)
         return g
 
+    def histogram(self, name: str, description: str = "") -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram(name, description)
+        return h
+
     def all(self) -> list[Gauge]:
         return [self._gauges[k] for k in sorted(self._gauges)]
+
+    def all_histograms(self) -> list[Histogram]:
+        return [self._hists[k] for k in sorted(self._hists)]
 
     def snapshot(self) -> dict[str, int]:
         """One point-in-time {name: value} map for scrapes and tests:
@@ -201,3 +291,41 @@ SHARD_BYTES_SKIPPED = REGISTRY.gauge(
     "ShardBytesSkipped",
     "host->device upload bytes skipped because per-shard pruning "
     "proved a probe shard's blocks partner-less before any transfer")
+POOL_QUEUE_DEPTH = REGISTRY.gauge(
+    "PoolQueueDepth",
+    "tasks currently queued in the worker pool (submitted, not yet "
+    "picked up) — the live backpressure signal admission control reads")
+POOL_RUNNING = REGISTRY.gauge(
+    "PoolRunningTasks",
+    "tasks currently executing on worker-pool threads")
+POOL_TASK_WAIT_NS = REGISTRY.gauge(
+    "PoolTaskWaitNs",
+    "cumulative ns tasks spent queued before a worker picked them up "
+    "(the ns-precision sibling of PoolQueueWaitUs)")
+TRACES_RECORDED = REGISTRY.gauge(
+    "TracesRecorded",
+    "query timelines finalized into the flight recorder since start")
+TRACE_SPANS_DROPPED = REGISTRY.gauge(
+    "TraceSpansDropped",
+    "span events dropped because a per-thread trace ring hit its cap "
+    "(the timeline stays bounded; widest spans are still present)")
+
+#: latency histograms (log-spaced buckets; Prometheus histogram series
+#: in /metrics, p50/p95/p99 in /_stats). Observed at statement / task /
+#: dispatch boundaries only.
+QUERY_LATENCY_HIST = REGISTRY.histogram(
+    "QueryLatency",
+    "end-to-end statement latency (success paths)")
+POOL_QUEUE_WAIT_HIST = REGISTRY.histogram(
+    "PoolQueueWait",
+    "per-task worker-pool queue wait (submit -> pickup)")
+SEARCH_BATCH_WINDOW_HIST = REGISTRY.histogram(
+    "SearchBatchWindow",
+    "per-query search-batcher coalescing wait (submit -> dispatch "
+    "start)")
+DEVICE_DISPATCH_HIST = REGISTRY.histogram(
+    "DeviceDispatch",
+    "per-offload device execution time: the fused pipeline observes "
+    "the dispatch section (post-upload; first call includes jit "
+    "compile), device aggregates and top-N observe the whole offload "
+    "(upload + compile-cache lookup + dispatch + readback)")

@@ -104,6 +104,17 @@ if os.environ.get("SERENE_SHARDS"):
     _SDB_REG_SH.set_global("serene_shards", os.environ["SERENE_SHARDS"])
 
 
+# scripts/verify_tier1.sh timeline-tracing parity leg: force
+# serene_trace to the given value ("on"/"off") for a whole run — the on
+# pass proves span recording (pool queue waits, batcher fan-out, shard
+# pipelines, device phases) observes without changing a single result
+# bit, the off pass that the engine runs clean with the tracer absent.
+if os.environ.get("SERENE_TRACE"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_TR
+
+    _SDB_REG_TR.set_global("serene_trace", os.environ["SERENE_TRACE"])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

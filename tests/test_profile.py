@@ -272,14 +272,10 @@ def test_pg_stat_activity_live_query_and_id():
 # -- gauge helpers ----------------------------------------------------------
 
 
-def test_gauge_add_time_ns_and_registry_snapshot():
+def test_gauge_delta_and_registry_snapshot():
     g = sdb_metrics.Gauge("TestTimer")
-    import time
-    t0 = time.perf_counter_ns()
-    now = g.add_time_ns(t0)
-    assert now >= t0 and g.value == now - t0
     base = g.value
-    g.add_time_ns(now, now + 500)
+    g.add(500)
     assert g.delta(base) == 500
 
     snap = sdb_metrics.REGISTRY.snapshot()
