@@ -114,6 +114,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_TRACE=on \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc9=$?
 
+# Pass 10 is the multichip in-program-combine parity leg: the sharded
+# tier is forced to 4 shards WITH serene_shard_combine=device (the
+# conftest env hook arms both globals) over the multichip, shard,
+# device and search parity suites — every sharded fused join/aggregate
+# then runs as ONE shard_map collective dispatch (psum/pmin/pmax in
+# HBM) and every sharded search merge as an in-program all_gather hop,
+# and a single diverged bit fails the suites' parity assertions loudly.
+echo "== multichip in-program combine parity pass (serene_shard_combine=device) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_SHARDS=4 \
+    SERENE_SHARD_COMBINE=device \
+    python -m pytest tests/test_multichip.py tests/test_shard_exec.py \
+    tests/test_device_pipeline.py tests/test_search.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc10=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -122,4 +137,5 @@ rc9=$?
 [ "$rc6" -ne 0 ] && exit "$rc6"
 [ "$rc7" -ne 0 ] && exit "$rc7"
 [ "$rc8" -ne 0 ] && exit "$rc8"
-exit "$rc9"
+[ "$rc9" -ne 0 ] && exit "$rc9"
+exit "$rc10"

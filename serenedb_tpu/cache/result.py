@@ -71,6 +71,14 @@ RESULT_AFFECTING_SETTINGS = (
 )
 assert "serene_search_batch" not in RESULT_AFFECTING_SETTINGS
 assert "serene_shards" not in RESULT_AFFECTING_SETTINGS
+# serene_shard_combine picks WHERE the cross-shard combine runs (one
+# in-program shard_map dispatch with psum/pmin/pmax vs per-shard
+# dispatches with the host integer combine) — every accumulator is an
+# integer add or min/max selection, exact in any reduction order, so
+# device and host combines are bit-identical by construction (the
+# tests/test_multichip.py parity matrix and the verify_tier1.sh
+# SERENE_SHARD_COMBINE=device pass enforce it)
+assert "serene_shard_combine" not in RESULT_AFFECTING_SETTINGS
 # tracing observes, never steers (obs/trace.py): results are
 # bit-identical with the timeline layer on or off, so a cached entry is
 # valid across either setting

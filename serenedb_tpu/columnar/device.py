@@ -86,18 +86,62 @@ class DeviceNarrowingError(ValueError):
     violation, not an optimization."""
 
 
+def _narrow_exact(arr: np.ndarray, n: int) -> np.ndarray:
+    """int64 → int32 when provably exact (TPU x64 is off); raises
+    DeviceNarrowingError otherwise — shared by tile conversion paths."""
+    if arr.dtype == np.dtype(np.int64):
+        if n == 0 or (np.abs(arr, dtype=np.float64).max(initial=0.0) < 2**31):
+            return arr.astype(np.int32)
+        raise DeviceNarrowingError(
+            "int64 column with |values| >= 2^31: no exact device "
+            "representation")
+    return arr
+
+
+#: raw-scheme host dtype per source dtype (the numpy mirror of
+#: _DEVICE_DTYPE, for tiles built host-side before a stacked upload)
+_HOST_TILE_DTYPE = {
+    np.dtype(np.bool_): np.int8,
+    np.dtype(np.int8): np.int8,
+    np.dtype(np.int16): np.int32,
+    np.dtype(np.int32): np.int32,
+    np.dtype(np.float32): np.float32,
+    np.dtype(np.float64): np.float32,
+}
+
+
+def host_tile_arrays(col: Column, rows_pad: int, scheme: str = "raw",
+                     offset: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """HOST-side tile arrays of one column padded to exactly `rows_pad`
+    rows: (data (rows_pad/LANES, LANES), mask bool same shape). The
+    sharded tier's stacked collective programs need an IDENTICAL
+    dtype/offset for every shard slice of a column, so the caller
+    decides the frame-of-reference scheme ONCE (from whole-column
+    stats) and passes it in — 'for8'/'for16' store value - offset as
+    uint8/uint16 (the to_device_column compression, decoded in-kernel
+    with one widen + add), 'raw' ships the device dtype unchanged."""
+    n = len(col)
+    assert rows_pad % LANES == 0 and rows_pad >= n
+    arr = _narrow_exact(col.data, n)
+    if scheme == "for8":
+        arr = (arr.astype(np.int64) - offset).astype(np.uint8)
+        np_dt = np.uint8
+    elif scheme == "for16":
+        arr = (arr.astype(np.int64) - offset).astype(np.uint16)
+        np_dt = np.uint16
+    else:
+        np_dt = _HOST_TILE_DTYPE.get(arr.dtype, np.float32)
+    padded = np.zeros(rows_pad, dtype=np_dt)
+    padded[:n] = arr.astype(np_dt, copy=False)
+    mask = np.zeros(rows_pad, dtype=bool)
+    mask[:n] = col.valid_mask()
+    return padded.reshape(-1, LANES), mask.reshape(-1, LANES)
+
+
 def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColumn:
     n = len(col)
     n_pad = pad_len(n, pad_multiple)
-    arr = col.data
-    if arr.dtype == np.dtype(np.int64):
-        # exact only when it fits in int32 (TPU x64 is off)
-        if n == 0 or (np.abs(arr, dtype=np.float64).max(initial=0.0) < 2**31):
-            arr = arr.astype(np.int32)
-        else:
-            raise DeviceNarrowingError(
-                "int64 column with |values| >= 2^31: no exact device "
-                "representation")
+    arr = _narrow_exact(col.data, n)
     dev_dt = _DEVICE_DTYPE.get(arr.dtype, jnp.float32)
     scheme, offset = "raw", 0
     if arr.dtype.kind == "i" and arr.dtype.itemsize > 1 and n:
@@ -118,6 +162,11 @@ def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColum
     mask[:n] = col.valid_mask()
     data2d = jnp.asarray(padded.reshape(-1, LANES), dtype=dev_dt)
     mask2d = jnp.asarray(mask.reshape(-1, LANES))
+    # every device path funnels through this upload — note that the
+    # backend is up so serene_shard_combine=auto's PASSIVE device-count
+    # probe (parallel/mesh.py) works even across jax-internal drift
+    from ..parallel import mesh as _mesh
+    _mesh.note_backend_initialized()
     return DeviceColumn(col.type, data2d, mask2d, n, scheme, offset)
 
 

@@ -557,27 +557,14 @@ def _mesh_wrap(program, mesh_n: int, combines: list, n_inputs: int):
     with psum/pmin/pmax over ICI, per-row partial outputs stay sharded
     (reference analog: morsel-parallel pipelines re-expressed as XLA
     collectives — SURVEY.md §2.11/§5.7)."""
-    import functools as _ft
-
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import AXIS, make_mesh
-    mesh = make_mesh(mesh_n)
+    from ..parallel.mesh import AXIS, apply_axis_combines, data_mesh
+    mesh = data_mesh(mesh_n)
 
     def core(*flat):
-        outs = program(*flat)
-        merged = []
-        for o, c in zip(outs, combines):
-            if c == "sum":
-                merged.append(jax.lax.psum(o, AXIS))
-            elif c == "min":
-                merged.append(jax.lax.pmin(o, AXIS))
-            elif c == "max":
-                merged.append(jax.lax.pmax(o, AXIS))
-            else:
-                merged.append(o)
-        return tuple(merged)
+        return apply_axis_combines(program(*flat), combines)
 
     in_specs = tuple(P(AXIS, None) for _ in range(n_inputs))
     out_specs = tuple(P() if c in ("sum", "min", "max")

@@ -1244,6 +1244,21 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
             sweep=_partner_stale_pred(build.pub, probe.pub, dev_tag,
                                       keyset, name="__bacc__"))
 
+    # in-program cross-shard combine (serene_shard_combine=device): the
+    # sharded probe executes as ONE shard_map-partitioned dispatch with
+    # psum/pmin/pmax collectives reducing the integer accumulators in
+    # HBM — the host sees only the final combined result. The build
+    # outputs ride the SAME publication cache (mesh-replicated), so the
+    # steady state is exactly one dispatch; a cold cache adds only the
+    # one build dispatch, never the N per-shard probes.
+    if shard_mod.combine_mode(settings) == "device":
+        return _run_fused_collective(
+            node, probe, build, pscan, preds_probe,
+            key_plans, group_space, group_mode, agg_plans, sum_modes,
+            cl, g, dictionaries, shape_sig, ctx, prof, clock,
+            per_shard, shard_ids, pruned, keyset, needed_p,
+            _build_outs_for, bstart, bmm_sis, tspan)
+
     def run_shard(s: int) -> list[np.ndarray]:
         check_cancel()
         t_up = time.perf_counter_ns() if trace is not None else 0
@@ -1326,6 +1341,241 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
                     dictionaries, group_space, group_mode, sum_modes)
     if prof is not None:
         prof.add_device_ns(id(node), clock() - t0)
+    return out
+
+
+# -- in-program collective combine (serene_shard_combine=device) ------------
+#
+# The sharded fused join/aggregate as ONE shard_map-partitioned program
+# over the parallel/mesh.py data axis: the surviving shard spans'
+# tiles concatenate and split evenly across a leading mesh axis
+# committed with a NamedSharding (the ragged tail pads with masked
+# rows that never count — integer adds and min/max selections are
+# exact over ANY row partition, so balanced re-slicing keeps
+# bit-identity), the publication-cached build outputs enter
+# mesh-REPLICATED, and the cross-shard reduction happens IN HBM —
+# every probe-phase group accumulator reduces with a psum/pmin/pmax
+# round before the (replicated) outputs return. The single dispatch is
+# bit-identical to both the per-shard host combine and the shards=1
+# program. Replaces PR 9's N probe dispatches + the numpy combine with
+# ONE dispatch whose output is already the global answer (the build
+# dispatch runs only on a publication-cache miss, exactly as in the
+# host-combine path).
+
+
+def _collective_out_kinds(agg_plans) -> list[str]:
+    """Per-output cross-shard combine kinds mirroring _probe_phase's
+    output order (the device_agg._out_combines sibling): every add
+    accumulator psums (limb and direct sums alike — both are int32
+    adds), min/max partials pmin/pmax."""
+    kinds = ["sum"]                          # pair counts
+    for si, (spec, _side, _ce) in enumerate(agg_plans):
+        if spec.func == "count_star":
+            continue
+        if spec.func == "count":
+            kinds.append("sum")
+        elif spec.func in ("sum", "avg"):
+            kinds.extend(["sum", "sum"])     # value (limb/direct) + vcnt
+        else:
+            kinds.extend([spec.func, "sum"])  # mm partial + vcnt
+    return kinds
+
+
+def _run_fused_collective(node, probe: _Side, build: _Side, pscan,
+                          preds_probe,
+                          key_plans, group_space: int, group_mode: bool,
+                          agg_plans, sum_modes: dict, cl: np.ndarray,
+                          g: int, dictionaries,
+                          shape_sig: tuple, ctx, prof, clock,
+                          per_shard: dict, shard_ids: list,
+                          pruned: int, keyset, needed_p,
+                          build_outs_for, bstart: dict, bmm_sis: list,
+                          tspan) -> Batch:
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..columnar.device import host_tile_arrays
+    from ..parallel import mesh as mesh_mod
+    from . import shard as shard_mod
+    from .plan import check_cancel
+    from .shard import _concat_spans
+
+    plo = probe.lo
+    S = len(shard_ids)
+    mesh = mesh_mod.data_mesh(S)
+    M = mesh.shape[mesh_mod.AXIS]
+    # the surviving shard spans concatenate (ascending shard, ascending
+    # span — deterministic) and split EVENLY across the mesh axis:
+    # integer adds and min/max selections are exact over ANY row
+    # partition, so re-slicing for balance keeps bit-identity while
+    # ragged shards cost < M·BLOCK_ROWS padding rows instead of padding
+    # every shard to the widest one
+    all_spans = [sp for s in shard_ids for sp in per_shard[s]]
+    n_rows = sum(e - a for a, e in all_spans)
+    t_slice = pad_len(-(-n_rows // M)) // LANES   # tiles per mesh slice
+    rows_pad = M * t_slice * LANES
+    spans_sig = tuple((s, tuple(per_shard[s])) for s in shard_ids)
+    stack_tag = ("collstack", spans_sig, M, t_slice)
+    sh3 = mesh_mod.data_sharding(mesh, 3)
+
+    # -- shard-sharded inputs, publication-cached -------------------------
+    t0 = clock()
+
+    def _for_spec(ji: int) -> tuple[str, int]:
+        """Frame-of-reference scheme for one stacked column, decided
+        ONCE from whole-column stats (cached per publication) so every
+        mesh slice encodes with the same offset — range-fitting int
+        tiles ship as uint8/uint16 deltas and decode in-kernel, the
+        to_device_column compression restated for the stacked layout.
+        Eligibility comes from the SCAN SCHEMA (dictionary strings ride
+        int32 codes; never materializes a host column — _col_stats is
+        publication-cached, so the warm path stays zero-host-work)."""
+        t = pscan.types[ji]
+        if t.is_string:
+            kind, size = "i", 4              # dictionary codes
+        else:
+            try:
+                nd = np.dtype(t.np_dtype)
+            except Exception:  # pragma: no cover — exotic type ⇒ raw
+                return "raw", 0
+            kind, size = nd.kind, nd.itemsize
+        if kind != "i" or size <= 1:
+            return "raw", 0
+        try:
+            _av, _fin, lo_v, hi_v = _col_stats(probe, pscan.columns[ji])
+        except Exception:  # noqa: BLE001 — unstatable column ⇒ raw
+            return "raw", 0
+        if lo_v is None or not (-2**31 <= lo_v and hi_v < 2**31):
+            return "raw", 0
+        rng = hi_v - lo_v
+        if rng < (1 << 8):
+            return "for8", lo_v
+        if rng < (1 << 16):
+            return "for16", lo_v
+        return "raw", 0
+
+    decode_p = {ji: _for_spec(ji) for ji in needed_p}
+
+    def _stack_probe_col(name: str, scheme: str, offset: int):
+        def mk():
+            d2, m2 = host_tile_arrays(
+                _concat_spans(probe.host_col(name), all_spans), rows_pad,
+                scheme, offset)
+            return (jax.device_put(
+                        d2.reshape(M, t_slice, LANES), sh3),
+                    jax.device_put(
+                        m2.reshape(M, t_slice, LANES), sh3))
+        return DEVICE_CACHE.tuple_arrays(probe.pub, name, stack_tag, mk)
+
+    env_p = {ji: _stack_probe_col(pscan.columns[ji], *decode_p[ji])
+             for ji in needed_p}
+
+    def _stack_codes():
+        padded = np.full(rows_pad, g + 1, dtype=np.int32)
+        rows = np.concatenate(
+            [cl[a - plo:b - plo] for a, b in all_spans])
+        padded[:len(rows)] = rows
+        return jax.device_put(padded.reshape(M, t_slice, LANES), sh3)
+
+    pc_dev = DEVICE_CACHE.array(
+        probe.pub, "__codes__", (build.pub, keyset, stack_tag, "pcoll"),
+        _stack_codes,
+        sweep=_partner_stale_pred(probe.pub, build.pub, "pcoll", keyset))
+
+    def _stack_rowmask():
+        m = np.zeros(rows_pad, dtype=bool)
+        m[:n_rows] = True
+        return jax.device_put(m.reshape(M, t_slice, LANES), sh3)
+
+    prow = DEVICE_CACHE.array(probe.pub, "__rowmask__", stack_tag,
+                              _stack_rowmask)
+
+    # build outputs: the SAME publication-cached dispatch products the
+    # host-combine path consumes, committed mesh-REPLICATED (every
+    # device reads the full per-code partials) — a repeat query enters
+    # the collective dispatch with zero build work and zero transfer
+    rep_sh = NamedSharding(mesh, P())
+    bouts = build_outs_for(rep_sh, f"coll{M}")
+    if prof is not None:
+        prof.add_device_ns(id(pscan), clock() - t0)
+    tspan("device_upload", t0, shards=S)
+
+    # -- the single collective program ------------------------------------
+    out_kinds = _collective_out_kinds(agg_plans)
+    np_cols = len(needed_p)
+
+    # the traced program depends only on (slice shape, mesh width,
+    # publications [which pin decode schemes/code space/layout], key
+    # set, expression shapes) — NOT on which spans survived pruning:
+    # span-dependent values all enter as runtime inputs, so two
+    # queries with different pruning patterns but equal t_slice reuse
+    # one compiled executable (spans_sig keys only the DATA caches)
+    cache_key = ("fcollective", probe.pub, build.pub,
+                 t_slice, M, keyset) + shape_sig
+    jitted = _PROGRAM_CACHE.get(cache_key)
+    if jitted is None:
+        def collective(*flat):
+            # local probe slice: (1, t_slice, L) tiles → one row block
+            # (the mesh slice is just a row subset; the group scatter
+            # is the same int add in any order)
+            arrays = {}
+            for k2, ji in enumerate(needed_p):
+                d, m = flat[2 * k2], flat[2 * k2 + 1]
+                d = d.reshape(-1, d.shape[-1])
+                scheme, off = decode_p[ji]
+                if scheme != "raw":
+                    d = d.astype(jnp.int32) + jnp.int32(off)
+                arrays[ji] = (d, m.reshape(-1, m.shape[-1]))
+            base = 2 * np_cols
+            pcodes = flat[base].reshape(-1, flat[base].shape[-1])
+            pmask = flat[base + 1].reshape(-1, flat[base + 1].shape[-1])
+            bacc = flat[base + 2]
+            bmm = {si: flat[base + 3 + j] for j, si in enumerate(bmm_sis)}
+
+            # probe phase: THE shared body (bit-identity contract in
+            # one place), then the cross-shard psum/pmin/pmax combine
+            outs = _probe_phase(arrays, pcodes, pmask, bacc, bmm,
+                                preds_probe, key_plans, group_mode,
+                                group_space, agg_plans, sum_modes,
+                                bstart, g)
+            return mesh_mod.apply_axis_combines(outs, out_kinds,
+                                                fuse_sums=True)
+
+        in_specs = tuple([P(mesh_mod.AXIS, None, None)] * (2 * np_cols)
+                         + [P(mesh_mod.AXIS, None, None)] * 2
+                         + [P()] * (1 + len(bmm_sis)))
+        out_specs = tuple(P() for _ in out_kinds)
+        # check_rep off: replication of the post-psum outputs holds by
+        # construction but the checker can't infer it through the
+        # scatter/gather bodies
+        jitted = jax.jit(shard_map(
+            collective, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, check_rep=False))
+        _PROGRAM_CACHE[cache_key] = jitted
+
+    flat_args: list = []
+    for ji in needed_p:
+        flat_args.extend(env_p[ji])
+    flat_args.extend([pc_dev, prow])
+    flat_args.extend(bouts)
+
+    check_cancel()
+    t_d = time.perf_counter_ns()
+    metrics.DEVICE_OFFLOADS.add()
+    metrics.COLLECTIVE_DISPATCHES.add()
+    # the shard workloads still execute — as lanes of one program
+    metrics.SHARD_PIPELINES.add(S)
+    results = [np.asarray(o) for o in jitted(*flat_args)]
+    dt = time.perf_counter_ns() - t_d
+    metrics.COLLECTIVE_COMBINE_NS.add(dt)
+    metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)
+    tspan("collective_dispatch", t_d, shards=S, mesh=M)
+    shard_mod.stamp_profile(ctx, id(node), S, pruned, collective=True)
+    out = _finalize(node, key_plans, agg_plans, results, probe, pscan,
+                    dictionaries, group_space, group_mode, sum_modes)
+    if prof is not None:
+        prof.add_device_ns(id(node), time.perf_counter_ns() - t_d)
     return out
 
 

@@ -184,8 +184,8 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
             from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
 
-            from ..parallel.mesh import AXIS, make_mesh
-            mesh = make_mesh(mesh_n)
+            from ..parallel.mesh import AXIS, data_mesh
+            mesh = data_mesh(mesh_n)
 
             def core(data, mask):
                 keys = keys_of(data, mask)

@@ -54,6 +54,36 @@ def shard_count(settings=None) -> int:
     return max(1, n)
 
 
+def combine_mode(settings=None) -> str:
+    """Resolved `serene_shard_combine`: 'device' or 'host'. 'auto'
+    resolves to device when the process sees more than one jax device
+    (the mesh data axis has real width), else host — so a single-chip
+    box defaults to the PR 9 per-shard-dispatch path and a multi-device
+    mesh gets the one-dispatch psum combine. The auto probe is PASSIVE:
+    it never initializes the jax backend (a pure-host sharded search
+    must stay jax-free, and initializing a tunneled device backend
+    during a tunnel outage is a hard hang), so before the first real
+    device dispatch of the process auto conservatively reads host.
+    Same settings-resolution pattern as shard_count(None)."""
+    if settings is None:
+        from ..engine import CURRENT_CONNECTION
+        conn = CURRENT_CONNECTION.get()
+        if conn is not None:
+            settings = conn.settings
+    try:
+        if settings is not None:
+            mode = str(settings.get("serene_shard_combine"))
+        else:
+            from ..utils.config import REGISTRY
+            mode = str(REGISTRY.get_global("serene_shard_combine"))
+    except KeyError:  # pragma: no cover — registry always declares it
+        mode = "auto"
+    if mode == "auto":
+        from ..parallel.mesh import device_count_if_initialized
+        return "device" if device_count_if_initialized() > 1 else "host"
+    return mode
+
+
 def shard_of_block(block: int, n_shards: int) -> int:
     """Round-robin block→shard assignment (THE partitioning function)."""
     return block % n_shards
@@ -213,16 +243,20 @@ def count_shard_pruned(verdicts, nbytes_per_row: int = 0,
         metrics.SHARD_BYTES_SKIPPED.add(rows * nbytes_per_row)
 
 
-def stamp_profile(ctx, key: int, pipelines: int, pruned: int = 0) -> None:
-    """Per-shard span stamp for EXPLAIN ANALYZE's `Shards:` line."""
+def stamp_profile(ctx, key: int, pipelines: int, pruned: int = 0,
+                  collective: bool = False) -> None:
+    """Per-shard span stamp for EXPLAIN ANALYZE's `Shards:` line.
+    `collective=True` marks the shards as combined in-program (one
+    shard_map dispatch, psum/pmin/pmax) — rendered as combine=device."""
     prof = getattr(ctx, "profile", None)
     if prof is not None:
-        prof.add_shards(key, pipelines, pruned)
+        prof.add_shards(key, pipelines, pruned,
+                        pipelines if collective else 0)
 
 
 __all__ = [
-    "shard_count", "shard_of_block", "shard_spans", "group_round_robin",
-    "run_shard_tasks", "ShardedRanges", "build_shard_ranges",
-    "sharded_verdicts", "verify_sharded_pruned", "count_shard_pruned",
-    "stamp_profile",
+    "shard_count", "combine_mode", "shard_of_block", "shard_spans",
+    "group_round_robin", "run_shard_tasks", "ShardedRanges",
+    "build_shard_ranges", "sharded_verdicts", "verify_sharded_pruned",
+    "count_shard_pruned", "stamp_profile",
 ]

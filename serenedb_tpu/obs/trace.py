@@ -33,7 +33,7 @@ _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
              "loops", "morsels_scheduled", "morsels_pruned",
              "morsels_jf_pruned", "device_ns", "batch_queries",
              "batch_window_ns", "batch_scoring_ns", "shard_pipelines",
-             "shard_pruned")
+             "shard_pruned", "shard_collective")
 
 
 class OpStats:
@@ -131,15 +131,20 @@ class QueryProfile:
         s.batch_window_ns += int(window_ns)
         s.batch_scoring_ns += int(scoring_ns)
 
-    def add_shards(self, key: int, pipelines: int, pruned: int = 0
-                   ) -> None:
+    def add_shards(self, key: int, pipelines: int, pruned: int = 0,
+                   collective: int = 0) -> None:
         """Sharded-tier span for one operator: how many per-shard
-        pipelines its execution fanned out into (serene_shards > 1) and
-        how many blocks the shard-to-shard join filter pruned — the
-        `Shards:` EXPLAIN ANALYZE detail line."""
+        pipelines its execution fanned out into (serene_shards > 1),
+        how many blocks the shard-to-shard join filter pruned, and how
+        many of the pipelines were combined IN-PROGRAM by a collective
+        shard_map dispatch (serene_shard_combine=device) — the
+        `Shards:` EXPLAIN ANALYZE detail line's n=/pruned=/combine=.
+        All three are additive ints, so the order-free sink merge
+        applies unchanged."""
         s = self.stats(key)
         s.shard_pipelines += int(pipelines)
         s.shard_pruned += int(pruned)
+        s.shard_collective += int(collective)
 
     def wrap_batches(self, node, fn, ctx) -> Iterator:
         """Instrumented drive of a node's raw batch generator: wall time
@@ -478,8 +483,10 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
                     f"window={_ms(s.batch_window_ns)} ms "
                     f"shared_scoring={_ms(s.batch_scoring_ns)} ms")
             if s.shard_pipelines or s.shard_pruned:
+                combine = "device" if s.shard_collective else "host"
                 lines.append(f"{detail}Shards: n={s.shard_pipelines} "
-                             f"pruned={s.shard_pruned}")
+                             f"pruned={s.shard_pruned} "
+                             f"combine={combine}")
         for c in node.children():
             lines.extend(walk(c, depth + 1))
         return lines
@@ -525,6 +532,8 @@ def annotate_plan_json(plan, profile: Optional[QueryProfile]) -> dict:
                 if s.shard_pipelines or s.shard_pruned:
                     out["Shard Pipelines"] = s.shard_pipelines
                     out["Shard Morsels Pruned"] = s.shard_pruned
+                    out["Shard Combine"] = \
+                        "device" if s.shard_collective else "host"
         kids = node.children()
         if kids:
             out["Plans"] = [walk(c) for c in kids]

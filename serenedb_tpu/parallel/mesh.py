@@ -26,11 +26,115 @@ from ..columnar.device import LANES
 
 AXIS = "shard"
 
+#: process-wide cache of data-axis meshes by device count — Mesh
+#: construction is cheap but identity-stable meshes keep shard_map
+#: program caches (keyed on the jitted callable) from re-tracing
+_MESH_CACHE: dict[int, Mesh] = {}
+
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
+    note_backend_initialized()
     n = n_devices or len(devs)
     return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+#: engine-owned "a device dispatch already initialized the backend"
+#: flag, noted at the upload/mesh choke points — the drift-proof
+#: fallback for device_count_if_initialized if a jax upgrade moves the
+#: introspection API (without it, auto would silently read host
+#: forever on a multi-chip box)
+_BACKEND_NOTED = False
+
+
+def note_backend_initialized() -> None:
+    global _BACKEND_NOTED
+    _BACKEND_NOTED = True
+
+
+def device_count_if_initialized() -> int:
+    """Number of jax devices IF a backend is already initialized in
+    this process, else 0 — NEVER triggers backend initialization.
+    Passive callers (the sharded search merge deciding whether a device
+    combine is even worth it) must not be the ones to pay backend init:
+    on a box whose device backend is a tunneled TPU, initialization
+    during a tunnel outage is a hard hang, and a pure-host query path
+    should stay jax-free. Probes xla_bridge.backends_are_initialized()
+    (falling back to the engine-noted flag on jax-internal drift)."""
+    if not _BACKEND_NOTED:
+        try:
+            from jax._src import xla_bridge
+            if not xla_bridge.backends_are_initialized():
+                return 0
+        except Exception:  # noqa: BLE001 — private-API drift: trust
+            return 0       # only the engine-noted flag (False here)
+    return len(jax.devices())
+
+
+def data_mesh(n_shards: int) -> Mesh:
+    """THE data-axis mesh of the sharded execution tier's in-program
+    combine (serene_shard_combine=device): one axis named `shard` over
+    min(n_shards, device count) devices — shards beyond the device
+    count stack on the leading axis and reduce locally before the
+    psum/pmin/pmax hop. Cached per width so repeat queries reuse the
+    identical Mesh object."""
+    n = max(1, min(int(n_shards), len(jax.devices())))
+    mesh = _MESH_CACHE.get(n)
+    if mesh is None:
+        mesh = _MESH_CACHE[n] = make_mesh(n)
+    return mesh
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """NamedSharding splitting the LEADING axis over the mesh's shard
+    axis (the stacked-shards layout): committed inputs land one shard
+    group per device, so the collective dispatch never re-shuffles."""
+    return NamedSharding(mesh, P(AXIS, *([None] * (ndim - 1))))
+
+
+def apply_axis_combines(outs: tuple, kinds: list, fuse_sums: bool = False):
+    """Cross-shard reduction of a program's per-device outputs over the
+    mesh axis, by kind: 'sum' → psum (counts, int limb stacks, direct
+    int sums), 'min'/'max' → pmin/pmax (selection partials), 'rows' →
+    left sharded (per-row outputs the out_spec concatenates). Integer
+    adds and min/max selections are exact in ANY reduction order, so
+    the collective result is bit-identical to the host-side combine —
+    the sharded tier's parity contract. Shared by the fused collective
+    pipeline (exec/device_pipeline.py) and the mesh-wrapped device
+    aggregate (exec/device_agg.py).
+
+    `fuse_sums` batches every same-dtype/same-leading-dim 'sum' output
+    into ONE psum (flatten trailing dims, concatenate, reduce, split):
+    each all-reduce is a cross-device rendezvous, so N tiny psums cost
+    N synchronizations where one fused psum costs one — element-wise
+    identical either way (psum is independent per element)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+    fused: dict[int, object] = {}
+    if fuse_sums:
+        sums = [(i, o) for i, (o, kind) in enumerate(zip(outs, kinds))
+                if kind == "sum"]
+        if len(sums) > 1 and len({o.dtype for _, o in sums}) == 1 and \
+                len({o.shape[0] for _, o in sums}) == 1:
+            flat = [o.reshape(o.shape[0], -1) for _, o in sums]
+            red = lax.psum(jnp.concatenate(flat, axis=1), AXIS)
+            at = 0
+            for (i, o), f in zip(sums, flat):
+                fused[i] = red[:, at:at + f.shape[1]].reshape(o.shape)
+                at += f.shape[1]
+    combined: list = []
+    for i, (o, kind) in enumerate(zip(outs, kinds)):
+        if i in fused:
+            combined.append(fused[i])
+        elif kind == "sum":
+            combined.append(lax.psum(o, AXIS))
+        elif kind == "min":
+            combined.append(lax.pmin(o, AXIS))
+        elif kind == "max":
+            combined.append(lax.pmax(o, AXIS))
+        else:                               # 'rows': stays sharded
+            combined.append(o)
+    return tuple(combined)
 
 
 def shard_devices(n_shards: int) -> Optional[list]:

@@ -241,7 +241,12 @@ def default_workers() -> int:
 def get_pool() -> WorkerPool:
     """The process-wide shared pool, sized from the `serene_workers`
     GLOBAL at first use (sessions cap their own parallelism per query via
-    the session-scope value; the pool itself is shared and fixed)."""
+    the session-scope value; the pool itself is shared and fixed).
+    Floor of 2: a single-thread pool would silently disable every
+    parallel tier even for sessions that raise their own
+    serene_workers — on a 1-core host the GIL-releasing numpy morsel
+    work still overlaps, and sessions that want inline execution say
+    `SET serene_workers = 1`, which bypasses the pool entirely."""
     global _POOL
     pool = _POOL
     if pool is not None:
@@ -253,7 +258,7 @@ def get_pool() -> WorkerPool:
                 size = int(REGISTRY.get_global("serene_workers"))
             except KeyError:
                 size = default_workers()
-            _POOL = WorkerPool(size)
+            _POOL = WorkerPool(max(2, size))
         return _POOL
 
 

@@ -887,6 +887,174 @@ def merge_segment_topk(seg_outs: list, bases: list[int], n_queries: int,
     return results
 
 
+def _combine_topk(seg_outs: list, bases: list[int], n_queries: int,
+                  k: int) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Cross-segment top-k combine dispatcher: the host single-heap
+    merge (the parity oracle), or — when the sharded tier is active
+    with `serene_shard_combine` resolving to device — an IN-PROGRAM
+    merge: each shard's candidate set reduces with an exact per-shard
+    top-k inside one shard_map program and the shards meet in a single
+    `all_gather` hop (exec/shard.py's round-robin segment grouping).
+    Selection is a pure (score desc, doc asc) order on the candidate
+    union, so both combines pick the identical entries in the identical
+    order — bit-identity by construction, asserted by the
+    tests/test_multichip.py parity matrix."""
+    from ..exec import shard as shard_mod
+    if len(seg_outs) > 1 and k > 0 and n_queries > 0:
+        n_shards = shard_mod.shard_count(None)
+        if n_shards > 1 and shard_mod.combine_mode(None) == "device":
+            out = _device_merge_topk(seg_outs, bases, n_queries, k,
+                                     n_shards)
+            if out is not None:
+                return out
+    return merge_segment_topk(seg_outs, bases, n_queries, k)
+
+
+#: compiled shard_map merge programs keyed by (padded candidate width,
+#: padded k, padded query count, mesh width) — pow2 padding keeps the
+#: compile-shape population bounded under varied query mixes
+_MERGE_PROGRAMS: dict = {}
+
+#: padding doc sentinel: sorts after every real doc at equal score and
+#: is trimmed host-side; real global doc ids must stay below it
+_PAD_DOC = (1 << 31) - 1
+
+
+def _merge_program(mesh, lp: int, kp: int, qp: int):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS
+    m_width = mesh.shape[AXIS]
+    key = (lp, kp, qp, m_width)
+    prog = _MERGE_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    kcut = min(kp, lp)
+
+    def srt(kk, dd, ss):
+        return jax.lax.sort((kk, dd, ss), num_keys=2)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(AXIS, None, None)),
+        out_specs=(P(), P()), check_rep=False)
+    def step(sc, dc):
+        # per-(shard, query) exact top-k: lexicographic two-key sort on
+        # (score desc, doc asc). `+ 0.0` canonicalizes -0.0 so equal
+        # scores tie exactly like the host heap's float compare; the
+        # original score bits travel as a passenger operand. The whole
+        # query batch merges in THIS one dispatch (vmap over the query
+        # axis), the many-queries-per-dispatch discipline of the
+        # batched serving tier.
+        keys = -(sc + 0.0)
+        k2, d2, s2 = jax.vmap(jax.vmap(srt))(keys, dc, sc)
+        k2, d2, s2 = (k2[:, :, :kcut], d2[:, :, :kcut], s2[:, :, :kcut])
+        # ONE all_gather hop: every device sees every shard's top-k
+        k2 = jax.lax.all_gather(k2, AXIS, tiled=True)
+        d2 = jax.lax.all_gather(d2, AXIS, tiled=True)
+        s2 = jax.lax.all_gather(s2, AXIS, tiled=True)
+        # (S, Q, kcut) → per query one final exact selection
+        k2 = jnp.moveaxis(k2, 0, 1).reshape(qp, -1)
+        d2 = jnp.moveaxis(d2, 0, 1).reshape(qp, -1)
+        s2 = jnp.moveaxis(s2, 0, 1).reshape(qp, -1)
+        _, dfin, sfin = jax.vmap(srt)(k2, d2, s2)
+        return sfin[:, :kp], dfin[:, :kp]
+
+    prog = jax.jit(step)
+    _MERGE_PROGRAMS[key] = prog
+    return prog
+
+
+def _device_merge_topk(seg_outs: list, bases: list[int], n_queries: int,
+                       k: int, n_shards: int):
+    """In-program sharded top-k merge — the WHOLE query batch in one
+    collective dispatch (queries stack on a vmapped axis, pow2-padded);
+    None → caller falls back to the host heap (doc ids past int32, NaN
+    scores, degenerate grouping, no candidates at all)."""
+    import time
+
+    import jax
+
+    from ..exec import shard as shard_mod
+    from ..obs.trace import current_trace
+    from ..parallel import mesh as mesh_mod
+    from ..utils import metrics
+
+    groups = shard_mod.group_round_robin(
+        list(range(len(seg_outs))), n_shards)
+    if len(groups) <= 1:
+        return None
+    # admission: every global doc id must fit below the int32 padding
+    # sentinel, and scores must be NaN-free (NaN breaks the sort/heap
+    # order equivalence)
+    for out, base in zip(seg_outs, bases):
+        for sc, dd in out:
+            if len(dd) and int(np.asarray(dd).max()) + base >= _PAD_DOC:
+                return None
+            if len(sc) and np.isnan(np.asarray(sc)).any():
+                return None
+    S = len(groups)
+    mesh = mesh_mod.data_mesh(S)
+    m_width = mesh.shape[mesh_mod.AXIS]
+    s_pad = -(-S // m_width) * m_width
+    # per-(shard, query) candidate lists, one shared padded width
+    cands: list[list[tuple[np.ndarray, np.ndarray]]] = []
+    lmax = 0
+    for idxs in groups:
+        row = []
+        for qi in range(n_queries):
+            sc = np.concatenate(
+                [np.asarray(seg_outs[si][qi][0], dtype=np.float32)
+                 for si in idxs])
+            dd = np.concatenate(
+                [np.asarray(seg_outs[si][qi][1]).astype(np.int64) +
+                 bases[si] for si in idxs])
+            lmax = max(lmax, len(sc))
+            row.append((sc, dd))
+        cands.append(row)
+    if lmax == 0:
+        return [(np.empty(0, dtype=np.float32),
+                 np.empty(0, dtype=np.int64))] * n_queries
+    lp = 1 << (lmax - 1).bit_length()
+    kp = 1 << (max(k, 1) - 1).bit_length()
+    qp = 1 << (max(n_queries, 1) - 1).bit_length()
+    scores = np.full((s_pad, qp, lp), -np.inf, dtype=np.float32)
+    docs = np.full((s_pad, qp, lp), _PAD_DOC, dtype=np.int32)
+    for i, row in enumerate(cands):
+        for qi, (sc, dd) in enumerate(row):
+            scores[i, qi, :len(sc)] = sc
+            docs[i, qi, :len(dd)] = dd.astype(np.int32)
+    jitted = _merge_program(mesh, lp, kp, qp)
+    sh = mesh_mod.data_sharding(mesh, 3)
+    t_d = time.perf_counter_ns()
+    metrics.DEVICE_OFFLOADS.add()
+    metrics.COLLECTIVE_DISPATCHES.add()
+    ss, dd2 = jitted(jax.device_put(scores, sh),
+                     jax.device_put(docs, sh))
+    ss = np.asarray(ss)
+    dd2 = np.asarray(dd2)
+    dt = time.perf_counter_ns() - t_d
+    metrics.COLLECTIVE_COMBINE_NS.add(dt)
+    metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)
+    trace = current_trace()
+    if trace is not None:
+        trace.add("collective_dispatch", "device", t_d,
+                  time.perf_counter_ns(), shards=S, op="topk_merge",
+                  queries=n_queries)
+    results = []
+    for qi in range(n_queries):
+        sq, dq = ss[qi][:k], dd2[qi][:k]
+        real = dq != _PAD_DOC
+        results.append((sq[real].astype(np.float32),
+                        dq[real].astype(np.int64)))
+    return results
+
+
 class MultiSearcher:
     """Searches across immutable segments of one column (reference:
     DirectoryReader over segment readers, SURVEY.md §2.7). Doc ids are
@@ -995,9 +1163,9 @@ class MultiSearcher:
         from ..parallel.pool import get_pool, session_workers
         cap = 1 if mesh_n > 1 else session_workers(None)
         seg_outs = _run_segment_shards(run_segment, self.segments, cap)
-        return merge_segment_topk(seg_outs,
-                                  [b for _, b in self.segments],
-                                  len(nodes), k)
+        return _combine_topk(seg_outs,
+                             [b for _, b in self.segments],
+                             len(nodes), k)
 
     def probe_topk(self, node: QNode, k: int, scorer: str = "bm25",
                    mesh_n: int = 0,
@@ -1113,8 +1281,8 @@ class MultiSearcher:
         from ..parallel.pool import session_workers
         cap = session_workers(None)
         outs = _run_segment_shards(run_segment, self.segments, cap)
-        return merge_segment_topk([[o] for o in outs],
-                                  [b for _, b in self.segments], 1, k)[0]
+        return _combine_topk([[o] for o in outs],
+                             [b for _, b in self.segments], 1, k)[0]
 
 
 @dataclass

@@ -288,6 +288,29 @@ declare("serene_shards", 1, int,
         "execution, the parity oracle), so this setting is deliberately "
         "excluded from the result cache's settings digest",
         validator=lambda v: max(1, int(v)))
+def _validate_shard_combine(v):
+    v = str(v).strip().lower()
+    if v not in ("auto", "device", "host"):
+        raise ValueError(
+            f"invalid serene_shard_combine: {v!r} (auto|device|host)")
+    return v
+
+
+declare("serene_shard_combine", "auto", str,
+        "where the sharded tier's cross-shard combine runs when "
+        "serene_shards > 1: 'device' executes the fused join/aggregate "
+        "as ONE shard_map-partitioned program over the mesh data axis "
+        "with psum/pmin/pmax collectives reducing the integer "
+        "accumulators in HBM (and merges sharded search top-k with an "
+        "in-program per-shard sort + one all_gather hop); 'host' keeps "
+        "the per-shard dispatches with the exact host-side integer "
+        "combine (the PR 9 oracle); 'auto' resolves to device when the "
+        "process sees more than one jax device, else host. Every "
+        "accumulator is an integer add or a min/max selection, so the "
+        "combine is exact in any reduction order and results are "
+        "BIT-identical across all three values — this setting is "
+        "deliberately excluded from the result cache's settings digest",
+        validator=_validate_shard_combine)
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

@@ -291,6 +291,19 @@ SHARD_BYTES_SKIPPED = REGISTRY.gauge(
     "ShardBytesSkipped",
     "host->device upload bytes skipped because per-shard pruning "
     "proved a probe shard's blocks partner-less before any transfer")
+COLLECTIVE_DISPATCHES = REGISTRY.gauge(
+    "CollectiveDispatches",
+    "shard_map-partitioned collective dispatches executed by the "
+    "sharded tier with serene_shard_combine=device: each fused "
+    "join/aggregate (psum/pmin/pmax cross-shard reduction) or search "
+    "top-k merge (per-shard sort + all_gather) over the mesh data axis "
+    "counts once — the single dispatch that replaces build+N probe "
+    "dispatches plus the host-side numpy combine")
+COLLECTIVE_COMBINE_NS = REGISTRY.gauge(
+    "CollectiveCombineNs",
+    "cumulative ns spent inside collective shard-combine dispatches "
+    "(the in-program psum/pmin/pmax/all_gather sections, wall time of "
+    "the whole one-dispatch program)")
 POOL_QUEUE_DEPTH = REGISTRY.gauge(
     "PoolQueueDepth",
     "tasks currently queued in the worker pool (submitted, not yet "

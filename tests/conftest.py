@@ -104,6 +104,20 @@ if os.environ.get("SERENE_SHARDS"):
     _SDB_REG_SH.set_global("serene_shards", os.environ["SERENE_SHARDS"])
 
 
+# scripts/verify_tier1.sh multichip parity leg: force
+# serene_shard_combine to the given value ("device"/"host"/"auto") for
+# a whole run — combined with SERENE_SHARDS=4 the device pass executes
+# every sharded fused pipeline as ONE shard_map collective dispatch and
+# every sharded search merge as an in-program all_gather hop, proving
+# the in-program combine is bit-identical to the host combine across
+# the parity suites.
+if os.environ.get("SERENE_SHARD_COMBINE"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_SC
+
+    _SDB_REG_SC.set_global("serene_shard_combine",
+                           os.environ["SERENE_SHARD_COMBINE"])
+
+
 # scripts/verify_tier1.sh timeline-tracing parity leg: force
 # serene_trace to the given value ("on"/"off") for a whole run — the on
 # pass proves span recording (pool queue waits, batcher fan-out, shard

@@ -204,11 +204,21 @@ def test_fused_path_actually_fires():
     not silently fall back to the host oracle. Under the sharded tier
     (verify_tier1.sh pass 8 forces SERENE_SHARDS=4 globally) the fused
     join is one build dispatch plus one probe dispatch per non-empty
-    shard; top-N stays a single dispatch either way."""
+    shard with the host combine, and ONE collective shard_map dispatch
+    with serene_shard_combine resolving to device; top-N stays a single
+    dispatch either way."""
+    from serenedb_tpu.exec import shard as shard_mod
     c = _mk_conn()
     shards = int(SETTINGS.get_global("serene_shards"))
     n_blocks = -(-6000 // 1024)            # _mk_conn's probe block count
-    exp_join = 1 if shards <= 1 else 1 + min(shards, n_blocks)
+    if shards <= 1:
+        exp_join = 1
+    elif shard_mod.combine_mode(None) == "device":
+        # cold publication: one build dispatch + ONE collective (the
+        # warm repeat is exactly 1, proven in tests/test_multichip.py)
+        exp_join = 2
+    else:
+        exp_join = 1 + min(shards, n_blocks)
     before = metrics.DEVICE_OFFLOADS.value
     c.execute("SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r "
               "ON l.ik = r.ik WHERE v > 0 GROUP BY l.sk ORDER BY l.sk")

@@ -1,0 +1,408 @@
+"""In-program multi-chip execution (ISSUE 12): shard_map/psum combines.
+
+Contract under test: with `serene_shard_combine = device` the sharded
+fused join/aggregate executes as ONE shard_map-partitioned program over
+the parallel/mesh.py data axis — psum/pmin/pmax collectives reduce the
+integer accumulators/limb stacks/min-max partials in HBM and the host
+sees only the final combined result (proven by dispatch count) — and
+sharded search top-k merges with an in-program per-shard top-k plus one
+all_gather hop. Every accumulator is an integer add or a min/max
+selection, exact in any reduction order, so results are BIT-IDENTICAL
+to the host-side combine (`= host`, the PR 9 oracle) and to shards=1
+across the whole matrix: combine device/host × shards 1/2/4 × workers
+1/4 × zonemap on/off, including ragged last shards, empty/all-pruned
+shards, and multi-segment search (engine-level + MultiSearcher-direct).
+`serene_shard_combine` stays OUT of the result cache's settings digest
+(bit-identity is the contract), and the Collective* gauges / `Shards:
+combine=` EXPLAIN line attribute the tier's work.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar import dtypes as dt
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec import shard as shard_mod
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.utils import metrics
+from serenedb_tpu.utils.config import REGISTRY as SETTINGS
+
+
+def _mk_conn(nl=6000, nr=3000, seed=11):
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE l (ik INT, sk TEXT, ts BIGINT, v BIGINT)")
+    c.execute("CREATE TABLE r (ik INT, sk TEXT, w BIGINT)")
+
+    def mk(n, null_frac, sd, payload, with_ts):
+        rng = np.random.default_rng(sd)
+        ik = rng.integers(0, 40, n).astype(np.int32)
+        ikv = rng.random(n) > null_frac
+        cols = {
+            "ik": Column(dt.INT, ik, ikv),
+            "sk": Column.from_numpy(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+        }
+        if with_ts:
+            cols["ts"] = Column.from_numpy(np.arange(n, dtype=np.int64))
+        cols[payload] = Column.from_numpy(
+            rng.integers(-500, 500, n, dtype=np.int64))
+        return Batch.from_pydict(cols)
+
+    db.schemas["main"].tables["l"] = MemTable(
+        "l", mk(nl, 0.1, seed, "v", True))
+    db.schemas["main"].tables["r"] = MemTable(
+        "r", mk(nr, 0.15, seed + 1, "w", False))
+    c.execute("SET serene_result_cache = off")
+    c.execute("SET serene_morsel_rows = 1024")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    return c
+
+
+def _rows(c, q):
+    return repr(c.execute(q).rows())
+
+
+JOIN_Q = ("SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r "
+          "ON l.ik = r.ik GROUP BY l.sk ORDER BY l.sk")
+
+#: grouped/scalar aggregates, joins (incl. min/max + avg limb paths),
+#: top-N, empty and all-pruned shapes — every cell of the matrix must
+#: be bit-identical to shards=1
+QUERIES = [
+    # morsel/device grouped aggregate (single table)
+    "SELECT sk, count(*), sum(v), min(v), max(v) FROM l "
+    "WHERE v > -400 GROUP BY sk ORDER BY sk",
+    # joins: scalar + grouped; min/max partials ride pmin/pmax, avg and
+    # sum exercise the limb/direct psum paths
+    "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik "
+    "WHERE v > 0",
+    "SELECT l.sk, count(*), sum(v), sum(w), min(w), max(v) FROM l "
+    "JOIN r ON l.ik = r.ik GROUP BY l.sk ORDER BY l.sk",
+    "SELECT l.ik, count(*), avg(w) FROM l JOIN r ON l.sk = r.sk "
+    "WHERE v > 250 GROUP BY l.ik ORDER BY l.ik NULLS LAST",
+    # top-N over a filtered scan
+    "SELECT ts, v FROM l WHERE v > 150 ORDER BY ts DESC LIMIT 9",
+    # empty result / all-pruned shards
+    "SELECT count(*), sum(v) FROM l WHERE ts < -1",
+]
+
+
+@pytest.mark.parametrize("zonemap", ["on", "off"])
+@pytest.mark.parametrize("combine", ["device", "host"])
+def test_multichip_parity_matrix(combine, zonemap):
+    """combine device/host × shards 1/2/4 × workers 1/4 per zonemap
+    leg — every cell bit-identical to shards=1 at the same settings."""
+    c = _mk_conn()
+    c.execute(f"SET serene_zonemap = {zonemap}")
+    c.execute(f"SET serene_shard_combine = {combine}")
+    for q in QUERIES:
+        ref = None
+        for workers in (1, 4):
+            c.execute(f"SET serene_workers = {workers}")
+            c.execute("SET serene_shards = 1")
+            base = _rows(c, q)
+            if ref is None:
+                ref = base
+            assert base == ref, f"workers perturbed results: {q}"
+            for shards in (2, 4):
+                c.execute(f"SET serene_shards = {shards}")
+                got = _rows(c, q)
+                assert got == ref, \
+                    f"combine={combine} shards={shards} " \
+                    f"workers={workers} diverged: {q}"
+        c.execute("SET serene_shards = 1")
+
+
+def test_collective_single_dispatch():
+    """THE dispatch-count proof: with the device combine the whole
+    sharded fused join/agg is ONE dispatch (host sees only the final
+    combined result) — not build + N probe dispatches."""
+    c = _mk_conn()
+    c.execute("SET serene_shards = 1")
+    ref = _rows(c, JOIN_Q)
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = device")
+    _rows(c, JOIN_Q)                      # warm compile + upload caches
+    before = metrics.DEVICE_OFFLOADS.value
+    cb = metrics.COLLECTIVE_DISPATCHES.value
+    ns0 = metrics.COLLECTIVE_COMBINE_NS.value
+    assert _rows(c, JOIN_Q) == ref
+    assert metrics.DEVICE_OFFLOADS.value - before == 1, \
+        "device combine must be ONE dispatch, not build+N"
+    assert metrics.COLLECTIVE_DISPATCHES.value - cb == 1
+    assert metrics.COLLECTIVE_COMBINE_NS.value > ns0
+    # the host combine on the same query really is build+N (the shape
+    # the collective dispatch replaces); build output is cached, so
+    # expect the N probe dispatches at minimum
+    c.execute("SET serene_shard_combine = host")
+    before = metrics.DEVICE_OFFLOADS.value
+    assert _rows(c, JOIN_Q) == ref
+    assert metrics.DEVICE_OFFLOADS.value - before >= 4
+
+
+def test_collective_ragged_last_shard():
+    """A row count that leaves the last block (and thus the last
+    shard's span set) short exercises pad_to_multiple masking: padded
+    rows must never count."""
+    c = _mk_conn(nl=4097, nr=1500, seed=23)
+    c.execute("SET serene_shards = 1")
+    ref = _rows(c, JOIN_Q)
+    c.execute("SET serene_shards = 4")
+    for combine in ("device", "host"):
+        c.execute(f"SET serene_shard_combine = {combine}")
+        assert _rows(c, JOIN_Q) == ref, combine
+
+
+def test_collective_empty_and_all_pruned():
+    c = _mk_conn()
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = device")
+    for q in ("SELECT count(*), sum(v) FROM l WHERE ts < -1",
+              "SELECT sk, sum(v) FROM l WHERE ts < -1 GROUP BY sk "
+              "ORDER BY sk",
+              "SELECT count(*), sum(v), sum(w) FROM l JOIN r "
+              "ON l.ik = r.ik WHERE ts < -1"):
+        c.execute("SET serene_shards = 1")
+        ref = _rows(c, q)
+        c.execute("SET serene_shards = 4")
+        assert _rows(c, q) == ref, q
+
+
+def test_collective_write_invalidation():
+    """A write between collective executions must surface fresh data:
+    the mesh-sharded stacked uploads key on publications."""
+    c = _mk_conn()
+    c.execute("SET serene_shards = 2")
+    c.execute("SET serene_shard_combine = device")
+    q = "SELECT count(*), sum(v), sum(w) FROM l JOIN r ON l.ik = r.ik"
+    first = c.execute(q).rows()
+    c.execute("INSERT INTO r VALUES (1, 'alpha', 7)")
+    second = c.execute(q).rows()
+    assert second != first, "write must invalidate mesh-sharded caches"
+    c.execute("SET serene_shards = 1")
+    assert c.execute(q).rows() == second
+
+
+# -- search: in-program per-shard top-k + all_gather merge -------------------
+
+
+def _search_conn():
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT)")
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+    rng = np.random.default_rng(5)
+    vals = ", ".join(f"({i}, '{' '.join(rng.choice(words, 5))}')"
+                     for i in range(2000))
+    c.execute(f"INSERT INTO docs VALUES {vals}")
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    for j in range(4):            # appends → a real multi-segment set
+        vals = ", ".join(f"({10000 + 100 * j + i}, "
+                         f"'{' '.join(rng.choice(words, 5))}')"
+                         for i in range(100))
+        c.execute(f"INSERT INTO docs VALUES {vals}")
+        c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'")
+    c.execute("SET serene_result_cache = off")
+    return db, c
+
+
+SEARCH_QUERIES = [
+    "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'alpha | beta' "
+    "ORDER BY s DESC, id LIMIT 25",
+    "SELECT id FROM docs WHERE body @@ 'alpha & beta' ORDER BY id "
+    "LIMIT 20",
+    "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'zzz_nothing' "
+    "ORDER BY s DESC LIMIT 5",
+]
+
+
+def test_search_topk_combine_parity_engine():
+    _db, c = _search_conn()
+    for q in SEARCH_QUERIES:
+        c.execute("SET serene_shards = 1")
+        ref = _rows(c, q)
+        for shards in (2, 4):
+            for combine in ("device", "host"):
+                c.execute(f"SET serene_shards = {shards}")
+                c.execute(f"SET serene_shard_combine = {combine}")
+                for workers in (1, 4):
+                    c.execute(f"SET serene_workers = {workers}")
+                    assert _rows(c, q) == ref, (q, shards, combine,
+                                                workers)
+        c.execute("SET serene_shards = 1")
+
+
+def test_multisearcher_combine_parity_direct():
+    """MultiSearcher layer: topk and cpu_topk bit-identical (scores,
+    doc ids, tie order) under the in-program merge, and the merge
+    really dispatches a collective."""
+    db, c = _search_conn()
+    from serenedb_tpu.search.index import find_index
+    from serenedb_tpu.search.query import parse_query
+    provider = db.resolve_table(["docs"])
+    ms = find_index(provider, "body").searchers["body"]
+    assert len(ms.segments) > 2
+    node = parse_query("alpha | gamma", ms.analyzer)
+    prior_sh = SETTINGS.get_global("serene_shards")
+    prior_cb = SETTINGS.get_global("serene_shard_combine")
+    try:
+        SETTINGS.set_global("serene_shards", 1)
+        s1, d1 = ms.topk(node, 10)
+        c1, cd1 = ms.cpu_topk(node, 10)
+        for shards in (2, 4):
+            SETTINGS.set_global("serene_shards", shards)
+            for combine in ("device", "host"):
+                SETTINGS.set_global("serene_shard_combine", combine)
+                before = metrics.COLLECTIVE_DISPATCHES.value
+                s, d = ms.topk(node, 10)
+                cs, cd = ms.cpu_topk(node, 10)
+                assert np.array_equal(s.view(np.uint32),
+                                      s1.view(np.uint32))
+                assert np.array_equal(d, d1)
+                assert np.array_equal(cs.view(np.uint32),
+                                      c1.view(np.uint32))
+                assert np.array_equal(cd, cd1)
+                got = metrics.COLLECTIVE_DISPATCHES.value - before
+                if combine == "device":
+                    assert got >= 1, "device combine must dispatch"
+                else:
+                    assert got == 0, "host combine must not dispatch"
+    finally:
+        SETTINGS.set_global("serene_shards", prior_sh)
+        SETTINGS.set_global("serene_shard_combine", prior_cb)
+
+
+def test_device_merge_tie_order_exact():
+    """Crafted score ties across shards (incl. a -0.0 vs 0.0 pair):
+    the in-program two-key sort must reproduce the heap merge's
+    (score desc, doc asc) order bit for bit."""
+    from serenedb_tpu.search.searcher import (_device_merge_topk,
+                                              merge_segment_topk)
+    rng = np.random.default_rng(3)
+    seg_outs, bases = [], []
+    base = 0
+    for si in range(5):
+        n = int(rng.integers(3, 9))
+        sc = rng.choice(np.asarray(
+            [2.5, 2.5, 1.25, 0.0, -0.0, 3.75], dtype=np.float32), n)
+        dd = np.sort(rng.choice(50, n, replace=False)).astype(np.int64)
+        seg_outs.append([(sc, dd)])
+        bases.append(base)
+        base += 50
+    ref = merge_segment_topk(seg_outs, bases, 1, 7)
+    got = _device_merge_topk(seg_outs, bases, 1, 7, 3)
+    assert got is not None
+    assert np.array_equal(got[0][1], ref[0][1])
+    assert np.array_equal(got[0][0].view(np.uint32),
+                          ref[0][0].view(np.uint32))
+
+
+def test_device_merge_inadmissible_falls_back():
+    """Doc ids at/above the int32 padding sentinel refuse the device
+    merge (None → host heap)."""
+    from serenedb_tpu.search.searcher import _device_merge_topk
+    seg_outs = [[(np.asarray([1.0], np.float32),
+                  np.asarray([2**31 - 1], np.int64))],
+                [(np.asarray([2.0], np.float32),
+                  np.asarray([3], np.int64))]]
+    assert _device_merge_topk(seg_outs, [0, 0], 1, 5, 2) is None
+
+
+# -- settings / observability satellites -------------------------------------
+
+
+def test_combine_mode_resolution():
+    import jax
+    prior = SETTINGS.get_global("serene_shard_combine")
+    try:
+        SETTINGS.set_global("serene_shard_combine", "auto")
+        expect = "device" if len(jax.devices()) > 1 else "host"
+        assert shard_mod.combine_mode(None) == expect
+        SETTINGS.set_global("serene_shard_combine", "host")
+        assert shard_mod.combine_mode(None) == "host"
+        SETTINGS.set_global("serene_shard_combine", "device")
+        assert shard_mod.combine_mode(None) == "device"
+        with pytest.raises(Exception):
+            SETTINGS.set_global("serene_shard_combine", "bogus")
+    finally:
+        SETTINGS.set_global("serene_shard_combine", prior)
+
+
+def test_shard_combine_not_result_affecting():
+    """Bit-identity is the documented contract, so the combine location
+    must never split the result cache (the serene_shards pattern)."""
+    from serenedb_tpu.cache.result import RESULT_AFFECTING_SETTINGS
+    assert "serene_shard_combine" not in RESULT_AFFECTING_SETTINGS
+
+
+def test_result_cache_shared_across_combine_settings():
+    c = _mk_conn()
+    c.execute("SET serene_result_cache = on")
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = host")
+    ref = _rows(c, JOIN_Q)
+    h0 = metrics.RESULT_CACHE_HITS.value
+    c.execute("SET serene_shard_combine = device")
+    assert _rows(c, JOIN_Q) == ref
+    assert metrics.RESULT_CACHE_HITS.value > h0, \
+        "combine=device must hit the entry stored under combine=host"
+
+
+def test_explain_analyze_combine_line():
+    c = _mk_conn()
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = device")
+    out = c.execute(f"EXPLAIN ANALYZE {JOIN_Q}").rows()
+    text = "\n".join(r[0] for r in out)
+    assert "combine=device" in text, text
+    c.execute("SET serene_shard_combine = host")
+    out = c.execute(f"EXPLAIN ANALYZE {JOIN_Q}").rows()
+    text = "\n".join(r[0] for r in out)
+    assert "combine=host" in text, text
+
+
+def test_explain_json_combine_key():
+    c = _mk_conn()
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = device")
+    out = c.execute(f"EXPLAIN (ANALYZE, FORMAT JSON) {JOIN_Q}").rows()
+    doc = json.loads(out[0][0])
+
+    def walk(node):
+        yield node
+        for kid in node.get("Plans", []):
+            yield from walk(kid)
+
+    nodes = list(walk(doc[0]["Plan"]))
+    assert any(n.get("Shard Combine") == "device" for n in nodes), \
+        "Shard Combine key missing from JSON plan"
+
+
+def test_collective_trace_span():
+    c = _mk_conn()
+    c.execute("SET serene_trace = on")
+    c.execute("SET serene_shards = 4")
+    c.execute("SET serene_shard_combine = device")
+    c.execute(JOIN_Q)
+    from serenedb_tpu.obs.trace import FLIGHT
+    entry = FLIGHT.get(c._active_trace.trace_id)
+    names = [s["name"] for s in entry["spans"]]
+    assert "collective_dispatch" in names, names
+    assert "shard_pipeline" not in names, \
+        "the collective dispatch subsumes the per-shard device lanes"
+
+
+def test_metrics_export_collective_gauges():
+    from serenedb_tpu.obs.export import prometheus_text, stats_json
+    text = prometheus_text()
+    assert "serenedb_collective_dispatches" in text
+    assert "serenedb_collective_combine_ns" in text
+    snap = stats_json()["metrics"]
+    assert "CollectiveDispatches" in snap
+    assert "CollectiveCombineNs" in snap

@@ -127,11 +127,14 @@ def test_shard_pipelines_gauge_and_fanout():
 
 
 def test_fused_shard_dispatch_count():
-    """Sharded fused execution = one build dispatch + one probe
-    dispatch per non-empty shard."""
+    """Sharded fused execution with the HOST combine = one build
+    dispatch + one probe dispatch per non-empty shard (the PR 9 shape;
+    the device-combine single dispatch is proven in
+    tests/test_multichip.py)."""
     c = _mk_conn()
     c.execute("SET serene_device = 'tpu'")
     c.execute("SET serene_device_fused = on")
+    c.execute("SET serene_shard_combine = host")
     q = ("SELECT l.sk, count(*), sum(v), sum(w) FROM l JOIN r "
          "ON l.ik = r.ik GROUP BY l.sk ORDER BY l.sk")
     c.execute("SET serene_shards = 1")

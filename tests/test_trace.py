@@ -139,7 +139,10 @@ def test_span_tree_well_formed_sharded_device():
     cats = {s["cat"] for s in entry["spans"]}
     assert "device" in cats, f"no device spans in {cats}"
     names = [s["name"] for s in entry["spans"]]
-    assert "device_dispatch" in names
+    # the sharded fused join dispatches per shard (host combine:
+    # device_dispatch lanes) or as ONE shard_map program
+    # (serene_shard_combine=device: a collective_dispatch span)
+    assert "device_dispatch" in names or "collective_dispatch" in names
     assert "shard_pipeline" in names or "device_upload" in names
 
 
@@ -183,7 +186,7 @@ def test_trace_coverage_at_workers_shards():
         assert cov >= 0.95, \
             f"span coverage {cov:.3f} < 0.95 for {entry['query']}"
     assert any(s["name"] == "queue_wait" for s in entry_agg["spans"])
-    assert any(s["name"] == "device_dispatch"
+    assert any(s["name"] in ("device_dispatch", "collective_dispatch")
                for s in entry_dev["spans"])
 
 
