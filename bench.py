@@ -847,6 +847,116 @@ def bench_trace_overhead() -> float:
     return t_off_total / t_on_total
 
 
+def bench_mem_overhead() -> float:
+    """Memory-accounting overhead budget (ISSUE 13, <3%): the host_agg
+    filtered parallel aggregate plus the vectorized join at 1M rows,
+    with `serene_mem_account` on vs off (profile/trace stay at their
+    defaults in both modes — this isolates the ACCOUNTING delta:
+    per-statement accountant setup + ACTIVE registration, per-batch /
+    per-morsel charge+release pairs, statement-end totals). Results are
+    asserted bit-identical and the end-to-end alternating-pairs medians
+    are recorded per shape — but like trace_overhead (the PR 5/PR 10
+    noise lesson), a single-digit-percent delta drowns in this host's
+    serial drift end to end, so the ASSERTED number is a direct
+    decomposition: the measured cost of one accounted statement's
+    actual charge/release traffic (setup + register + 4x the observed
+    event count + merge/totals + retire), divided by the query's
+    off-mode median. Returns t_off/t_on (≈1.0; 0.97 ⇔ 3% overhead)."""
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+
+    rng = np.random.default_rng(31)
+    n = 1_000_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE po (k INT, v BIGINT)")
+    c.execute("CREATE TABLE pb (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["po"] = MemTable("po", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 1000, n).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(-(10 ** 6), 10 ** 6, n, dtype=np.int64))}))
+    db.schemas["main"].tables["pb"] = MemTable("pb", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.permutation(np.arange(n, dtype=np.int64))),
+        "w": Column.from_numpy(
+            rng.integers(0, 100, n, dtype=np.int64))}))
+    c.execute("SET serene_device = 'cpu'")
+    queries = {
+        "host_agg": ("SELECT k, count(*), sum(v) FROM po "
+                     "WHERE v % 7 <> 0 GROUP BY k"),
+        "join": ("SELECT count(*), sum(v + w) FROM po "
+                 "JOIN pb ON po.v = pb.k"),
+    }
+    import statistics
+
+    from serenedb_tpu.obs.resources import ACTIVE, MemoryAccountant
+    from serenedb_tpu.utils import metrics as _metrics
+    pairs = 7
+    detail: dict[str, dict] = {}
+    t_on_total = t_off_total = 0.0
+    max_events = 1
+    for name, q in queries.items():
+        rows = {}
+        samples: dict[str, list[float]] = {"on": [], "off": []}
+        for mode in ("on", "off"):          # warm both paths + capture
+            c.execute(f"SET serene_mem_account = {mode}")
+            ev0 = _metrics.MEM_ACCOUNT_EVENTS.value
+            rows[mode] = c.execute(q).rows()
+            if mode == "on":
+                # the query's REAL charge/release traffic feeds the
+                # direct probe below
+                events = _metrics.MEM_ACCOUNT_EVENTS.delta(ev0)
+                max_events = max(max_events, events)
+        assert rows["on"] == rows["off"], f"accounting perturbed {name}"
+        for _ in range(pairs):
+            for mode in ("off", "on"):
+                c.execute(f"SET serene_mem_account = {mode}")
+                t0 = time.perf_counter()
+                c.execute(q)
+                samples[mode].append(time.perf_counter() - t0)
+        med = {p: statistics.median(s) for p, s in samples.items()}
+        overhead = med["on"] / med["off"] - 1.0
+        detail[name] = {"on_s": round(med["on"], 5),
+                        "off_s": round(med["off"], 5),
+                        "e2e_overhead_pct": round(overhead * 100, 2)}
+        t_on_total += med["on"]
+        t_off_total += med["off"]
+    # direct decomposition: one accounted statement costs (accountant
+    # setup + ACTIVE register + charge/release traffic + merge/totals +
+    # retire); probe it at 4x the widest observed event count and
+    # charge it against the FASTEST query's off-mode median (the worst
+    # case for a fixed per-statement cost)
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        acct = MemoryAccountant("bench probe", pid=0)
+        ACTIVE.register(acct)
+        for i in range(2 * max_events):     # 2x charge+release = 4x events
+            acct.charge(i & 15, 4096)
+            acct.release(i & 15, 4096)
+        acct.add_progress(rows=1024, nbytes=8192, morsels=1)
+        acct.merged()
+        acct.totals()
+        acct.event_count()
+        ACTIVE.retire(acct)
+    per_stmt_s = (time.perf_counter() - t0) / reps
+    fastest_off = min(d["off_s"] for d in detail.values())
+    direct = per_stmt_s / fastest_off
+    _EXTRA["rows"] = n
+    _EXTRA["detail"] = detail
+    _EXTRA["per_statement_account_ms"] = round(per_stmt_s * 1e3, 4)
+    _EXTRA["probe_events"] = 4 * max_events
+    _EXTRA["overhead_pct"] = round(direct * 100, 3)
+    _EXTRA["e2e_overhead_pct"] = round(
+        (t_on_total / t_off_total - 1.0) * 100, 2)
+    assert direct < 0.03, \
+        f"accounting overhead over budget: {direct * 100:.2f}% (>3%)"
+    return t_off_total / t_on_total
+
+
 def bench_result_cache() -> float:
     """Multi-tier query cache (ISSUE 5 tentpole): the host_agg filtered
     aggregate and the vectorized join at 1M rows through the engine with
@@ -1497,6 +1607,7 @@ SHAPES = {
     "join": bench_join,
     "profile_overhead": bench_profile_overhead,
     "trace_overhead": bench_trace_overhead,
+    "mem_overhead": bench_mem_overhead,
     "result_cache": bench_result_cache,
     "device_pipeline": bench_device_pipeline,
     "search_batch": bench_search_batch,
@@ -1517,9 +1628,9 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 #: the tunneled backend with the tunnel down is a hard hang, see
 #: _run_shape_child), and the >1x assert applies only on a real device
 HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
-               "profile_overhead", "trace_overhead", "result_cache",
-               "device_pipeline", "search_batch", "shard_exec",
-               "multichip")
+               "profile_overhead", "trace_overhead", "mem_overhead",
+               "result_cache", "device_pipeline", "search_batch",
+               "shard_exec", "multichip")
 
 #: host shapes that nevertheless run jitted programs — with the device
 #: probe down their children must pin JAX_PLATFORMS=cpu, because

@@ -129,6 +129,19 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_SHARDS=4 \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc10=$?
 
+# Pass 11 is the memory-accounting parity leg: serene_mem_account is
+# forced ON globally (the conftest env hook arms the global) over the
+# resources, profiler, parallel and shard parity suites — every
+# statement then charges live/peak bytes at its materialization sites
+# and registers live progress rows while the suites' parity matrices
+# assert results stay bit-identical at any worker/shard count.
+echo "== memory accounting parity pass (serene_mem_account=on) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_MEM_ACCOUNT=on \
+    python -m pytest tests/test_resources.py tests/test_profile.py \
+    tests/test_parallel_exec.py tests/test_shard_exec.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc11=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -138,4 +151,5 @@ rc10=$?
 [ "$rc7" -ne 0 ] && exit "$rc7"
 [ "$rc8" -ne 0 ] && exit "$rc8"
 [ "$rc9" -ne 0 ] && exit "$rc9"
-exit "$rc10"
+[ "$rc10" -ne 0 ] && exit "$rc10"
+exit "$rc11"

@@ -84,6 +84,10 @@ assert "serene_shard_combine" not in RESULT_AFFECTING_SETTINGS
 # valid across either setting
 assert "serene_trace" not in RESULT_AFFECTING_SETTINGS
 assert "serene_profile" not in RESULT_AFFECTING_SETTINGS
+# memory accounting observes too (obs/resources.py): charge/release
+# events never steer execution, so a cached entry is valid whether the
+# statement that stored it was accounted or not
+assert "serene_mem_account" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path
@@ -607,8 +611,10 @@ class _Probe:
             batch, label, qid, tuple(t[0] for t in pairs),
             tuple((kind, key) for kind, key, _p in self.providers),
             [weakref.ref(t[1]) for t in pairs])
-        ok = self.cache.put(self._full_key(self.pubs), entry,
-                            _batch_nbytes(batch))
+        nbytes = _batch_nbytes(batch)
+        from ..obs.resources import charge_cache_store
+        charge_cache_store(nbytes)
+        ok = self.cache.put(self._full_key(self.pubs), entry, nbytes)
         if ok:
             self.cache.remember_tables(self.stmt_hash, entry.sources)
         return ok

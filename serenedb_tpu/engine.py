@@ -601,6 +601,9 @@ class Database(TableResolver):
         if name == "sdb_trace":
             from .pgcatalog import trace_table
             return trace_table(args)
+        if name == "sdb_query_progress":
+            from .pgcatalog import query_progress_table
+            return query_progress_table()
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 
@@ -901,6 +904,10 @@ class Connection:
         #: the executing statement's timeline trace (serene_trace on);
         #: finalized into the flight recorder at statement end
         self._active_trace = None
+        #: the executing statement's memory accountant
+        #: (serene_mem_account on; obs/resources.py) — read by the
+        #: statement-end observability hook for peak-bytes attribution
+        self._active_mem = None
         import weakref
         with db.lock:
             db._session_seq += 1
@@ -908,7 +915,8 @@ class Connection:
             db.sessions[self._session_id] = {
                 "pid": self._session_id, "usename": self.session_role,
                 "application_name": "", "state": "idle", "query": "",
-                "backend_start": time.time(), "query_start": None}
+                "backend_start": time.time(), "query_start": None,
+                "wait_event_type": None, "wait_event": None}
         weakref.finalize(self, db.sessions.pop, self._session_id, None)
 
     # -- public API --------------------------------------------------------
@@ -974,6 +982,16 @@ class Connection:
                     self._cache_hit = True
                     self._obs_record(sql_text, t0, b.num_rows, None, None)
             return (hit.names, [c.type for c in hit.columns], run_hit())
+        # streaming memory accounting: the accountant is created here
+        # (so the plan's operator wrappers see it on the context) but —
+        # like the trace — its contextvar pins per generator step, and
+        # its ACTIVE progress row registers at first resume and retires
+        # on every exit path
+        from .obs.resources import MemoryAccountant
+        acct = MemoryAccountant(sql_text or "SELECT",
+                                pid=self._session_id) \
+            if self._mem_enabled() else None
+        self._active_mem = acct
         ctx = self._exec_ctx(params)
         # a cacheable streaming statement accumulates its batches for a
         # post-drain store — bounded: accumulation stops past the cache
@@ -984,6 +1002,7 @@ class Connection:
 
         def run():
             from .cache.result import _batch_nbytes
+            from .obs.resources import ACTIVE, CURRENT_MEM
             from .obs.trace import CURRENT_TRACE, FLIGHT, QueryTrace
             t0 = time.perf_counter_ns()
             nrows = 0
@@ -995,6 +1014,8 @@ class Connection:
             # token across suspensions
             trace = QueryTrace(sql_text or "SELECT") \
                 if self._trace_enabled() else None
+            if acct is not None:
+                ACTIVE.register(acct)
             with self._session_scope(sql_text if sql_text is not None
                                      else "SELECT"):
                 it = plan.batches(ctx)
@@ -1003,10 +1024,13 @@ class Connection:
                         # the caller may resume this generator from any
                         # worker thread: pin the connection contextvar
                         # around every underlying step (scalar functions
-                        # read it), and the trace contextvar with it
+                        # read it), and the trace + accountant
+                        # contextvars with it
                         tok = CURRENT_CONNECTION.set(self)
                         tok_tr = CURRENT_TRACE.set(trace) \
                             if trace is not None else None
+                        tok_mem = CURRENT_MEM.set(acct) \
+                            if acct is not None else None
                         try:
                             b = next(it)
                         except StopIteration:
@@ -1021,13 +1045,22 @@ class Connection:
                             # on this connection flipped it while we
                             # were suspended
                             self._cache_hit = False
-                            entry = FLIGHT.record(trace.finish()) \
-                                if trace is not None else None
+                            entry = None
+                            if trace is not None:
+                                entry = trace.finish()
+                                if acct is not None:
+                                    entry["peak_bytes"] = \
+                                        acct.totals()[1]
+                                entry = FLIGHT.record(entry)
                             trace = None
+                            ACTIVE.retire(acct)
                             self._obs_record(sql_text, t0, nrows,
-                                             ctx.profile, plan, entry)
+                                             ctx.profile, plan, entry,
+                                             mem=acct)
                             return
                         finally:
+                            if tok_mem is not None:
+                                CURRENT_MEM.reset(tok_mem)
                             if tok_tr is not None:
                                 CURRENT_TRACE.reset(tok_tr)
                             CURRENT_CONNECTION.reset(tok)
@@ -1041,10 +1074,15 @@ class Connection:
                         yield b
                 except BaseException as e:  # noqa: BLE001 — re-raised
                     # error/early-close paths (incl. GeneratorExit from
-                    # a dropped portal) still dump the timeline
+                    # a dropped portal) still dump the timeline and
+                    # retire the progress row
+                    ACTIVE.retire(acct)
                     if trace is not None:
-                        FLIGHT.record(trace.finish(
-                            error=f"{type(e).__name__}: {e}"))
+                        entry = trace.finish(
+                            error=f"{type(e).__name__}: {e}")
+                        if acct is not None:
+                            entry["peak_bytes"] = acct.totals()[1]
+                        FLIGHT.record(entry)
                     raise
 
         return plan.names, plan.types, run()
@@ -1120,12 +1158,19 @@ class Connection:
                 # the bounded flight recorder out of exactly the slow
                 # statements it exists to preserve — a pgwire client
                 # issuing SET per query would halve the ring's reach
-                trace = None if isinstance(st, _UNTRACED_STATEMENTS) \
-                    else self._begin_trace(
-                        sql_text if sql_text is not None
-                        else type(st).__name__)
+                label = sql_text if sql_text is not None \
+                    else type(st).__name__
+                utility = isinstance(st, _UNTRACED_STATEMENTS)
+                trace = None if utility else self._begin_trace(label)
                 if trace is None:
                     self._active_trace = None
+                # memory accounting + live progress share the trace's
+                # utility gate: SET/SHOW bookkeeping materializes
+                # nothing worth accounting and would churn the
+                # progress registry
+                acct = None if utility else self._begin_mem(label)
+                if acct is None:
+                    self._active_mem = None
                 t0 = time.perf_counter_ns()
                 try:
                     res = self._dispatch(st, params, sql_text)
@@ -1135,13 +1180,13 @@ class Connection:
                     # for post-mortem (sdb_trace / GET /trace/<id>)
                     self._finish_trace(trace,
                                        error=f"{type(e).__name__}: {e}")
+                    self._finish_mem(acct)
                     raise
                 entry = self._finish_trace(trace)
+                self._finish_mem(acct)
                 self._obs_record(sql_text, t0, _result_rows(res),
                                  self._active_profile, self._active_plan,
-                                 entry,
-                                 utility=isinstance(
-                                     st, _UNTRACED_STATEMENTS))
+                                 entry, utility=utility, mem=acct)
                 return res
         finally:
             CURRENT_CONNECTION.reset(token)
@@ -1201,6 +1246,10 @@ class Connection:
             if sess is not None:
                 sess["state"] = ("idle in transaction"
                                  if self.in_txn else "idle")
+                # an abandoned wait (error inside a waiting section)
+                # must not linger as this session's live wait event
+                sess["wait_event_type"] = None
+                sess["wait_event"] = None
 
     # -- dispatch ----------------------------------------------------------
 
@@ -1540,6 +1589,43 @@ class Connection:
         except KeyError:  # pragma: no cover — registry always declares it
             return False
 
+    def _mem_enabled(self) -> bool:
+        try:
+            return bool(self.settings.get("serene_mem_account"))
+        except KeyError:  # pragma: no cover — registry always declares it
+            return False
+
+    def _begin_mem(self, label: str):
+        """Start the statement's memory accounting + live progress row
+        (serene_mem_account on): allocates the accountant, registers it
+        in the ACTIVE query registry (sdb_query_progress / GET
+        /progress) and publishes it through CURRENT_MEM so pool tasks,
+        device uploads and cache stores charge this query's account.
+        Observation only — executors never read the accountant back."""
+        if not self._mem_enabled():
+            self._active_mem = None
+            return None
+        from .obs.resources import ACTIVE, CURRENT_MEM, MemoryAccountant
+        acct = MemoryAccountant(label, pid=self._session_id)
+        acct._cv_token = CURRENT_MEM.set(acct)
+        ACTIVE.register(acct)
+        self._active_mem = acct
+        return acct
+
+    def _finish_mem(self, acct) -> None:
+        """Retire the statement's accounting: progress row leaves the
+        ACTIVE registry (success AND error paths — a failed statement
+        must not linger as a phantom running query) and the contextvar
+        resets. The accountant object stays readable for statement-end
+        attribution (_obs_record, flight-recorder peak stamp)."""
+        if acct is None:
+            return
+        from .obs.resources import ACTIVE, CURRENT_MEM
+        if acct._cv_token is not None:
+            CURRENT_MEM.reset(acct._cv_token)
+            acct._cv_token = None
+        ACTIVE.retire(acct)
+
     def _begin_trace(self, label: str):
         """Start the statement's timeline trace (serene_trace on):
         allocates the trace id and publishes it through CURRENT_TRACE so
@@ -1565,7 +1651,14 @@ class Connection:
         if tr._cv_token is not None:
             CURRENT_TRACE.reset(tr._cv_token)
             tr._cv_token = None
-        return FLIGHT.record(tr.finish(error))
+        entry = tr.finish(error)
+        # accounted peak rides the flight-recorder entry so a
+        # memory-heavy query is findable after the fact (sdb_trace
+        # listing, GET /trace, /_stats.traces)
+        acct = self._active_mem
+        if acct is not None:
+            entry["peak_bytes"] = acct.totals()[1]
+        return FLIGHT.record(entry)
 
     def _exec_ctx(self, params: list) -> ExecContext:
         """Execution context with a span collector attached when
@@ -1576,6 +1669,10 @@ class Connection:
             from .obs.trace import QueryProfile
             ctx.profile = QueryProfile()
             self._active_profile = ctx.profile
+        # the statement-level accountant (begun next to the trace)
+        # rides the context so operator wrappers charge without a
+        # contextvar read per batch
+        ctx.mem = self._active_mem
         return ctx
 
     def _run_select(self, sel: ast.Select, params: list,
@@ -1627,7 +1724,7 @@ class Connection:
 
     def _obs_record(self, sql_text: Optional[str], t0_ns: int, rows: int,
                     profile, plan, trace_entry=None,
-                    utility: bool = False) -> None:
+                    utility: bool = False, mem=None) -> None:
         """Statement-end observability hook (begin is _session_scope):
         query gauges + latency histogram, sdb_stat_statements, the
         slow-query log and the session's pg_stat_activity query id.
@@ -1647,6 +1744,15 @@ class Connection:
         elapsed_ns = time.perf_counter_ns() - t0_ns
         if not utility:
             metrics.QUERY_LATENCY_HIST.observe_ns(elapsed_ns)
+        mem_peak = mem_live = 0
+        if mem is not None:
+            # the peak histogram records BEFORE the serene_profile gate
+            # for the same reason the latency histogram does: the
+            # memory axis is its own setting and half of the
+            # admission-control signal pair
+            mem_live, mem_peak = mem.totals()
+            metrics.QUERY_PEAK_BYTES_HIST.observe_ns(mem_peak)
+            metrics.MEM_ACCOUNT_EVENTS.add(mem.event_count())
         if not self._profile_enabled():
             return
         metrics.QUERY_TIME_NS.add(elapsed_ns)
@@ -1661,7 +1767,8 @@ class Connection:
             qid = STATEMENTS.record(sql_text, elapsed_ns, rows, pruned,
                                     cap,
                                     cache_hit=getattr(self, "_cache_hit",
-                                                      False))
+                                                      False),
+                                    peak_bytes=mem_peak)
             sess = self.db.sessions.get(self._session_id)
             if sess is not None:
                 sess["query_id"] = qid
@@ -1670,9 +1777,14 @@ class Connection:
             metrics.SLOW_QUERIES.add()
             msg = (f"duration: {elapsed_ns / 1e6:.3f} ms  "
                    f"statement: {sql_text or '<internal>'}")
+            if mem is not None:
+                from .obs.resources import fmt_kb
+                msg += (f"\nmemory: peak={fmt_kb(mem_peak)} "
+                        f"live={fmt_kb(max(mem_live, 0))}")
             if profile is not None and plan is not None:
                 from .obs.trace import annotate_plan
-                msg += "\n" + "\n".join(annotate_plan(plan, profile))
+                msg += "\n" + "\n".join(annotate_plan(plan, profile,
+                                                      mem))
             if trace_entry is not None:
                 from .obs.trace import format_top_spans
                 msg += "\n" + "\n".join(format_top_spans(trace_entry))
@@ -2581,9 +2693,19 @@ class Connection:
                         cache_line = ("Result Cache: hit" if probe.peek()
                                       else "Result Cache: miss")
                 prof = QueryProfile()
+                # ANALYZE always accounts memory too (same PG-style
+                # always-instrument rule as the profiler): the inner
+                # plan gets its own accountant so the Memory lines key
+                # on THIS plan's nodes — the statement-level accountant
+                # (begun by execute_statement for the EXPLAIN wrapper)
+                # keeps feeding stat_statements/progress
+                from .obs.resources import MemoryAccountant
+                macct = MemoryAccountant(sql_text or "EXPLAIN",
+                                         pid=self._session_id)
                 t0 = time.perf_counter()
                 result = plan.execute(
-                    ExecContext(self.settings, params, profile=prof))
+                    ExecContext(self.settings, params, profile=prof,
+                                mem=macct))
                 elapsed = (time.perf_counter() - t0) * 1000
                 if cache_line == "Result Cache: miss":
                     probe.store(result)
@@ -2595,9 +2717,10 @@ class Connection:
 
                     from .obs.trace import annotate_plan_json
                     doc: dict = {
-                        "Plan": annotate_plan_json(plan, prof),
+                        "Plan": annotate_plan_json(plan, prof, macct),
                         "Execution Time": round(elapsed, 3),
                         "Rows Returned": result.num_rows,
+                        "Peak Memory Bytes": macct.totals()[1],
                     }
                     if cache_line:
                         doc["Result Cache"] = \
@@ -2605,9 +2728,11 @@ class Connection:
                     lines = [_json.dumps([doc], indent=2)]
                     b = Batch.from_pydict({"QUERY PLAN": lines})
                     return QueryResult(b, f"SELECT {len(lines)}")
-                lines = annotate_plan(plan, prof) + \
+                from .obs.resources import fmt_kb
+                lines = annotate_plan(plan, prof, macct) + \
                     ([cache_line] if cache_line else []) + [
                     f"Execution Time: {elapsed:.3f} ms",
+                    f"Peak Memory: {fmt_kb(macct.totals()[1])}",
                     f"Rows Returned: {result.num_rows}",
                 ]
         elif isinstance(st.inner, (ast.Insert, ast.Update, ast.Delete)):

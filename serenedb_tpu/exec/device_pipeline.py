@@ -110,6 +110,15 @@ def _pub(provider, pin) -> tuple:
             getattr(provider, "mutation_epoch", 0))
 
 
+def _charge_upload(nbytes: int) -> None:
+    """Per-query attribution of a host→device transfer: the statement
+    that caused the upload records the bytes in its accounted peak
+    (obs/resources; no-op when `serene_mem_account` is off or the
+    upload happens outside a statement)."""
+    from ..obs.resources import charge_device_upload
+    charge_device_upload(nbytes)
+
+
 class DeviceColumnCache:
     """Process-wide cache of device-resident arrays keyed by publication
     tuples. An entry's key embeds (token, data_version, mutation_epoch)
@@ -193,6 +202,7 @@ class DeviceColumnCache:
         nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
             int(dc.mask.size)
         metrics.DEVICE_BYTES.add(nbytes)
+        _charge_upload(nbytes)
         self.put(key, dc, nbytes)
         return dc
 
@@ -211,6 +221,7 @@ class DeviceColumnCache:
             arr = jax.device_put(arr, device)
         nbytes = int(arr.size * arr.dtype.itemsize)
         metrics.DEVICE_BYTES.add(nbytes)
+        _charge_upload(nbytes)
         self.put(key, arr, nbytes, sweep=sweep)
         return arr
 
@@ -226,6 +237,7 @@ class DeviceColumnCache:
         val = tuple(build_fn())
         nbytes = sum(int(a.size * a.dtype.itemsize) for a in val)
         metrics.DEVICE_BYTES.add(nbytes)
+        _charge_upload(nbytes)
         self.put(key, val, nbytes, sweep=sweep)
         return val
 
@@ -248,6 +260,7 @@ class DeviceColumnCache:
         nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
             int(dc.mask.size)
         metrics.DEVICE_BYTES.add(nbytes)
+        _charge_upload(nbytes)
         self.put(key, dc, nbytes)
         return dc
 
@@ -1566,7 +1579,9 @@ def _run_fused_collective(node, probe: _Side, build: _Side, pscan,
     metrics.COLLECTIVE_DISPATCHES.add()
     # the shard workloads still execute — as lanes of one program
     metrics.SHARD_PIPELINES.add(S)
-    results = [np.asarray(o) for o in jitted(*flat_args)]
+    from ..obs.resources import wait_scope
+    with wait_scope("Device", "CollectiveCombine"):
+        results = [np.asarray(o) for o in jitted(*flat_args)]
     dt = time.perf_counter_ns() - t_d
     metrics.COLLECTIVE_COMBINE_NS.add(dt)
     metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)

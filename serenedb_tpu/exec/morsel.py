@@ -152,6 +152,10 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
     if prof is not None:
         prof.add_scan_morsels(id(scan), scheduled=len(keep),
                               pruned=len(spans) - len(keep))
+    mem = getattr(ctx, "mem", None)
+    if mem is not None:
+        mem.add_morsels_scheduled(len(keep))
+        mem.set_op(scan.label())
 
     # late materialization: only columns the scan-bound expressions
     # actually read are fetched before morsels run; the rest never
@@ -183,6 +187,14 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
         span, verdict = item
         check_cancel()
         b = full.slice(span[0], span[1])
+        in_rows = b.num_rows
+        in_bytes = batch_nbytes(b) if mem is not None else 0
+        if in_bytes:
+            # the morsel's working slice is this worker's live set for
+            # the duration of the task (the slice views the pinned
+            # batch, but filter/project stages materialize copies of
+            # the same order of bytes — the slice size is the charge)
+            mem.charge(id(scan), in_bytes)
         all_match = verdict == zonemap.ALL
         clocks = _stage_clocks() if prof is not None else None
         if scan.filter is not None and not all_match:
@@ -202,7 +214,14 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                 b = Batch(list(st.names), [e.eval(b) for e in st.exprs])
             if clocks is not None:
                 clocks = _stage_stamp(prof, id(st), b, clocks)
-        return _morsel_partials(node, b)
+        p = _morsel_partials(node, b)
+        if mem is not None:
+            # the partial outlives the task (released by the merge
+            # sink); the input slice retires with it
+            mem.charge(id(node), batch_nbytes(p))
+            mem.release(id(scan), in_bytes)
+            mem.add_progress(rows=in_rows, nbytes=in_bytes, morsels=1)
+        return p
 
     from ..obs.trace import current_trace
     from . import shard as shard_mod
@@ -235,6 +254,9 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
                     ordered[pos] = p
             shard_mod.stamp_profile(ctx, id(node), len(shard_lists))
             out = _merge_partials(node, ordered)
+            if mem is not None:
+                mem.release(id(node),
+                            sum(batch_nbytes(p) for p in ordered))
             if trace is not None:
                 trace.add("morsel_pipeline", "morsel", t_pipe,
                           time.perf_counter_ns(), morsels=len(keep),
@@ -242,6 +264,9 @@ def try_parallel_aggregate(node, ctx) -> Optional[Batch]:
             return out
         partials = parallel_map(settings, run_morsel, keep)
         out = _merge_partials(node, partials)
+        if mem is not None:
+            mem.release(id(node),
+                        sum(batch_nbytes(p) for p in partials))
         if trace is not None:
             trace.add("morsel_pipeline", "morsel", t_pipe,
                       time.perf_counter_ns(), morsels=len(keep))

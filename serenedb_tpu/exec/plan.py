@@ -36,6 +36,11 @@ class ExecContext:
     #: Observation only: executors stamp rows/time/prune counters into
     #: it but never read it back, so a profile can't perturb results.
     profile: object = None
+    #: per-query memory accountant (obs/resources.MemoryAccountant) or
+    #: None. Same observe-only contract as `profile`: executors charge
+    #: live/peak bytes and progress counters into it but never read it
+    #: back, so accounting can't perturb results.
+    mem: object = None
 
 
 def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
@@ -46,18 +51,24 @@ def empty_batch(names: list[str], types: list[dt.SqlType]) -> Batch:
 
 
 def _profiled_batches(fn):
-    """Wrap one node class's raw batch generator with the span collector.
-    With no profile on the context this is a single attribute check that
-    returns the raw generator — zero extra frames during iteration, so
-    `serene_profile = off` costs nothing in the hot loop."""
+    """Wrap one node class's raw batch generator with the span collector
+    and/or the memory accountant. With neither on the context this is
+    two attribute checks that return the raw generator — zero extra
+    frames during iteration, so `serene_profile = off` +
+    `serene_mem_account = off` costs nothing in the hot loop."""
     import functools
 
     @functools.wraps(fn)
     def wrapper(self, ctx):
         prof = getattr(ctx, "profile", None)
-        if prof is None:
+        mem = getattr(ctx, "mem", None)
+        if prof is None and mem is None:
             return fn(self, ctx)
-        return prof.wrap_batches(self, fn, ctx)
+        gen = prof.wrap_batches(self, fn, ctx) if prof is not None \
+            else fn(self, ctx)
+        if mem is not None:
+            gen = mem.wrap_batches(self, gen)
+        return gen
 
     wrapper._obs_wrapped = True
     wrapper._obs_raw = fn
@@ -425,6 +436,21 @@ class SortNode(PlanNode):
 
     def batches(self, ctx):
         full = concat_batches(list(self.child.batches(ctx)))
+        mem = getattr(ctx, "mem", None)
+        sort_bytes = 0
+        if mem is not None:
+            # the materialized sort buffer (input copy + key ranks are
+            # the same order of bytes; the input batch is the charge)
+            from ..obs.trace import batch_nbytes
+            sort_bytes = batch_nbytes(full)
+            mem.charge(id(self), sort_bytes)
+        try:
+            yield from self._sorted(full)
+        finally:
+            if sort_bytes:
+                mem.release(id(self), sort_bytes)
+
+    def _sorted(self, full):
         if full.num_rows <= 1:
             yield full
             return
@@ -512,6 +538,16 @@ class JoinNode(PlanNode):
         return [self.left, self.right]
 
     def batches(self, ctx):
+        from ..obs.trace import batch_nbytes
+        mem = getattr(ctx, "mem", None)
+        held = 0          # input/pair bytes charged to this node
+
+        def hold(n):
+            nonlocal held
+            if mem is not None and n:
+                mem.charge(id(self), n)
+                held += n
+
         scan = self._join_filter_target(ctx)
         scan_id = None
         rkey_cols = None
@@ -557,7 +593,14 @@ class JoinNode(PlanNode):
             finally:
                 if scan_id is not None:
                     ctx.join_filters.pop(scan_id, None)
+        # memory accounting: the materialized build + probe sides are
+        # this operator's dominant buffers; the candidate pair index
+        # arrays join them below. Charged here, released when the
+        # output batch has been consumed (generator close).
+        hold(batch_nbytes(rb))
+        hold(batch_nbytes(lb))
         li, ri = self._match_inner(lb, rb, ctx, rkey_cols)
+        hold(int(li.nbytes) + int(ri.nbytes))
         # ON-clause residual applies to *candidate pairs* (outer-join
         # semantics: a pair failing the residual is unmatched, the left row
         # survives null-extended — PG LEFT JOIN ... ON a AND b)
@@ -587,7 +630,11 @@ class JoinNode(PlanNode):
                 for lk, rk in self.merge_pairs:
                     lcols[lk] = _merge_using_columns(
                         lcols[lk], rcols[rk], right_only)
-        yield Batch(list(self.names), lcols + rcols)
+        try:
+            yield Batch(list(self.names), lcols + rcols)
+        finally:
+            if mem is not None and held:
+                mem.release(id(self), held)
 
     def _join_filter_target(self, ctx) -> Optional["ScanNode"]:
         """The probe-side scan the build key range could prune, when the

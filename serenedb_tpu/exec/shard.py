@@ -124,9 +124,15 @@ def run_shard_tasks(settings, fn: Callable, shard_items: list) -> list:
     visible as parallel lanes in the Chrome trace."""
     import time
 
+    from ..obs.resources import current_accountant
     from ..obs.trace import current_trace
     from ..parallel.pool import parallel_map
     metrics.SHARD_PIPELINES.add(len(shard_items))
+    acct = current_accountant()
+    if acct is not None:
+        # live progress: the statement is now fanning out per-shard
+        # pipelines (sdb_query_progress current-operator label)
+        acct.set_op(f"ShardFanout n={len(shard_items)}")
     trace = current_trace()
     if trace is None:
         return parallel_map(settings, fn, shard_items)

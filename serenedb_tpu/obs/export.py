@@ -31,6 +31,8 @@ def prometheus_text() -> str:
     seconds + _sum/_count — p50/p99 derivable with
     histogram_quantile()), plus per-statement call/time/row series
     labeled by queryid."""
+    from .resources import sample_process_gauges
+    sample_process_gauges()
     lines: list[str] = []
     snap = _metrics.REGISTRY.snapshot()
     descs = {g.name: g.description for g in _metrics.REGISTRY.all()}
@@ -41,19 +43,24 @@ def prometheus_text() -> str:
         lines.append(f"# TYPE {pname} gauge")
         lines.append(f"{pname} {snap[name]}")
     for h in _metrics.REGISTRY.all_histograms():
-        pname = _prom_name(h.name) + "_seconds"
-        counts, sum_ns = h.snapshot()
+        # latency histograms observe ns and export as seconds; byte
+        # histograms observe bytes and export raw (the shared
+        # log-spaced bounds read as 1 kB..137 GB there)
+        seconds = h.unit == "s"
+        pname = _prom_name(h.name) + ("_seconds" if seconds else "")
+        scale = 1e9 if seconds else 1.0
+        counts, sum_raw = h.snapshot()
         if h.description:
             lines.append(f"# HELP {pname} {h.description}")
         lines.append(f"# TYPE {pname} histogram")
         cum = 0
-        for bound_ns, c in zip(_metrics.HIST_BOUNDS_NS, counts):
+        for bound, c in zip(_metrics.HIST_BOUNDS_NS, counts):
             cum += c
             lines.append(
-                f'{pname}_bucket{{le="{bound_ns / 1e9:.6g}"}} {cum}')
+                f'{pname}_bucket{{le="{bound / scale:.6g}"}} {cum}')
         cum += counts[-1]
         lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{pname}_sum {sum_ns / 1e9:.9g}")
+        lines.append(f"{pname}_sum {sum_raw / scale:.9g}")
         lines.append(f"{pname}_count {cum}")
     stmts = STATEMENTS.snapshot()
     if stmts:
@@ -71,17 +78,43 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def _bytes_percentiles(h) -> dict:
+    """{count, p50/p95/p99_bytes} for a byte-unit histogram."""
+    counts, _ = h.snapshot()
+    q = _metrics.hist_quantile_ns
+    return {"count": sum(counts),
+            "p50_bytes": int(q(counts, 0.50)),
+            "p95_bytes": int(q(counts, 0.95)),
+            "p99_bytes": int(q(counts, 0.99))}
+
+
 def stats_json() -> dict:
     """Gauge snapshot + latency percentiles + statement stats + cache
-    tier summaries + flight-recorder summary for the JSON `/_stats`
-    route."""
+    tier summaries + flight-recorder summary + the memory section
+    (query-peak percentiles, process RSS/uptime/GC, live query
+    progress) for the JSON `/_stats` route."""
     from ..cache.fragments import FRAGMENTS
     from ..cache.result import RESULT_CACHE
+    from .resources import ACTIVE, read_rss_bytes, sample_process_gauges
     from .trace import FLIGHT, flight_summary
-    return {"metrics": _metrics.REGISTRY.snapshot(),
+    sample_process_gauges()
+    snap = _metrics.REGISTRY.snapshot()
+    return {"metrics": snap,
             "latency": {h.name: h.percentiles_ms()
-                        for h in _metrics.REGISTRY.all_histograms()},
+                        for h in _metrics.REGISTRY.all_histograms()
+                        if h.unit == "s"},
             "statements": STATEMENTS.snapshot(),
             "cache": {"result": RESULT_CACHE.stats(),
                       "fragments": FRAGMENTS.stats()},
-            "traces": [flight_summary(e) for e in FLIGHT.snapshot()]}
+            "traces": [flight_summary(e) for e in FLIGHT.snapshot()],
+            "memory": {
+                "query_peak": _bytes_percentiles(
+                    _metrics.QUERY_PEAK_BYTES_HIST),
+                "process": {
+                    "rss_bytes": read_rss_bytes(),
+                    "uptime_seconds": snap.get("ProcessUptimeSeconds", 0),
+                    "gc_collections": [
+                        snap.get("GcGen0Collections", 0),
+                        snap.get("GcGen1Collections", 0),
+                        snap.get("GcGen2Collections", 0)]},
+                "progress": ACTIVE.snapshot()}}

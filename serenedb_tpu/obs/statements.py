@@ -67,11 +67,12 @@ class StatementStore:
 
     def record(self, query_text: str, elapsed_ns: int, rows: int,
                morsels_pruned: int, cap: int,
-               cache_hit: bool = False) -> int:
+               cache_hit: bool = False, peak_bytes: int = 0) -> int:
         norm = normalize(query_text)
         qid = fingerprint(norm)
         ms = elapsed_ns / 1e6
         bucket = hist_bucket_index(elapsed_ns)
+        peak = max(int(peak_bytes), 0)
         with self._lock:
             e = self._entries.get(qid)
             if e is None:
@@ -85,6 +86,8 @@ class StatementStore:
                     "rows": int(rows),
                     "morsels_pruned": int(morsels_pruned),
                     "cache_hits": int(bool(cache_hit)),
+                    "peak_mem_bytes": peak,
+                    "last_peak_mem_bytes": peak,
                     "hist": hist}
             else:
                 self._entries.move_to_end(qid)
@@ -96,9 +99,12 @@ class StatementStore:
                 e["morsels_pruned"] += int(morsels_pruned)
                 # entries recorded before the cache subsystem existed in
                 # this process lifetime may lack the key (same story for
-                # the latency histogram below)
+                # the latency histogram and peak-memory columns below)
                 e["cache_hits"] = e.get("cache_hits", 0) + \
                     int(bool(cache_hit))
+                e["peak_mem_bytes"] = max(e.get("peak_mem_bytes", 0),
+                                          peak)
+                e["last_peak_mem_bytes"] = peak
                 hist = e.setdefault("hist",
                                     [0] * (len(HIST_BOUNDS_NS) + 1))
                 hist[bucket] += 1

@@ -327,7 +327,11 @@ class QueryTrace:
         return {"trace_id": self.trace_id, "query": self.query[:500],
                 "begin_epoch_us": self.t0_epoch_us,
                 "duration_ns": dur, "error": error,
-                "spans": spans, "spans_dropped": dropped}
+                "spans": spans, "spans_dropped": dropped,
+                # stamped by the statement-end hook when
+                # serene_mem_account ran (engine._finish_trace /
+                # execute_streaming): the query's accounted peak bytes
+                "peak_bytes": None}
 
 
 class FlightRecorder:
@@ -392,6 +396,7 @@ def flight_summary(entry: dict) -> dict:
             "duration_ms": round(entry["duration_ns"] / 1e6, 3),
             "spans": len(entry["spans"]),
             "spans_dropped": entry["spans_dropped"],
+            "peak_bytes": entry.get("peak_bytes"),
             "error": entry["error"]}
 
 
@@ -444,6 +449,7 @@ def chrome_trace(entry: dict) -> dict:
                           "begin_epoch_us": entry["begin_epoch_us"],
                           "duration_ms": entry["duration_ns"] / 1e6,
                           "error": entry["error"],
+                          "peak_bytes": entry.get("peak_bytes"),
                           "spans_dropped": entry["spans_dropped"]}}
 
 
@@ -451,18 +457,31 @@ def _ms(ns: int) -> str:
     return f"{ns / 1e6:.3f}"
 
 
-def annotate_plan(plan, profile: QueryProfile) -> list[str]:
+def annotate_plan(plan, profile: QueryProfile, mem=None) -> list[str]:
     """EXPLAIN ANALYZE rendering: the plan tree with PG-style
     `(actual time=first..total rows=N loops=L)` suffixes plus prune /
-    device detail lines. Nodes the executor fused away (device offload)
-    render `(never executed)` like PG's unvisited branches."""
+    device detail lines, and per-operator `Memory: peak=… live=…`
+    lines when a MemoryAccountant ran (serene_mem_account). Nodes the
+    executor fused away (device offload) render `(never executed)`
+    like PG's unvisited branches."""
+    from .resources import fmt_kb
     merged = profile.merged()
+    mem_merged = mem.merged() if mem is not None else {}
+
+    def mem_line(pad: str, node) -> list[str]:
+        m = mem_merged.get(id(node))
+        if m is None:
+            return []
+        live, peak = m
+        return [f"{pad}Memory: peak={fmt_kb(peak)} "
+                f"live={fmt_kb(max(live, 0))}"]
 
     def walk(node, depth: int) -> list[str]:
         pad = "  " * depth
         s = merged.get(id(node))
         if s is None:
             lines = [f"{pad}{node.label()} (never executed)"]
+            lines.extend(mem_line(pad + "  ", node))
         else:
             first = s.first_ns if s.first_ns is not None else s.wall_ns
             lines = [f"{pad}{node.label()} "
@@ -487,6 +506,7 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
                 lines.append(f"{detail}Shards: n={s.shard_pipelines} "
                              f"pruned={s.shard_pruned} "
                              f"combine={combine}")
+            lines.extend(mem_line(detail, node))
         for c in node.children():
             lines.extend(walk(c, depth + 1))
         return lines
@@ -494,14 +514,23 @@ def annotate_plan(plan, profile: QueryProfile) -> list[str]:
     return walk(plan, 0)
 
 
-def annotate_plan_json(plan, profile: Optional[QueryProfile]) -> dict:
+def annotate_plan_json(plan, profile: Optional[QueryProfile],
+                       mem=None) -> dict:
     """EXPLAIN (FORMAT JSON) rendering: the plan tree as a
     machine-readable object — PG's JSON key shapes where they map
     ("Node Type", "Actual Total Time", "Actual Rows", "Plans"), plus the
     engine's prune / device / batch / shard detail as flat keys instead
-    of the text renderer's detail lines. profile=None renders structure
-    only (plain EXPLAIN)."""
+    of the text renderer's detail lines, and per-operator "Peak Memory
+    Bytes" / "Live Memory Bytes" when a MemoryAccountant ran.
+    profile=None renders structure only (plain EXPLAIN)."""
     merged = profile.merged() if profile is not None else {}
+    mem_merged = mem.merged() if mem is not None else {}
+
+    def stamp_mem(out: dict, node) -> None:
+        m = mem_merged.get(id(node))
+        if m is not None:
+            out["Peak Memory Bytes"] = m[1]
+            out["Live Memory Bytes"] = max(m[0], 0)
 
     def walk(node) -> dict:
         out: dict = {"Node Type": node.label()}
@@ -509,6 +538,7 @@ def annotate_plan_json(plan, profile: Optional[QueryProfile]) -> dict:
             s = merged.get(id(node))
             if s is None:
                 out["Never Executed"] = True
+                stamp_mem(out, node)
             else:
                 first = s.first_ns if s.first_ns is not None else s.wall_ns
                 out["Actual Startup Time"] = round(first / 1e6, 3)
@@ -534,6 +564,7 @@ def annotate_plan_json(plan, profile: Optional[QueryProfile]) -> dict:
                     out["Shard Morsels Pruned"] = s.shard_pruned
                     out["Shard Combine"] = \
                         "device" if s.shard_collective else "host"
+                stamp_mem(out, node)
         kids = node.children()
         if kids:
             out["Plans"] = [walk(c) for c in kids]

@@ -146,7 +146,16 @@ class WorkerPool:
         while drained < submitted:
             f = futs[drained]
             try:
-                results[drained] = f.result()
+                if not f.done():
+                    # live wait-event feed for pg_stat_activity: the
+                    # session blocks here while its morsel tasks queue
+                    # or run — the live counterpart of the queue_wait
+                    # span the worker stamps retrospectively
+                    from ..obs.resources import wait_scope
+                    with wait_scope("IPC", "PoolTaskWait"):
+                        results[drained] = f.result()
+                else:
+                    results[drained] = f.result()
             except CancelledError:
                 pass  # cancelled after an earlier failure: already drained
             except BaseException as e:  # noqa: BLE001 — re-raised below

@@ -741,6 +741,7 @@ def _pg_stat_activity(db) -> MemTable:
         ("usename", dt.VARCHAR), ("application_name", dt.VARCHAR),
         ("client_addr", dt.VARCHAR), ("backend_start", dt.VARCHAR),
         ("query_start", dt.VARCHAR), ("state", dt.VARCHAR),
+        ("wait_event_type", dt.VARCHAR), ("wait_event", dt.VARCHAR),
         ("query_id", dt.BIGINT), ("query", dt.VARCHAR)], {
         "datid": [1] * len(sess), "datname": ["serene"] * len(sess),
         "pid": [v["pid"] for v in sess],
@@ -750,6 +751,12 @@ def _pg_stat_activity(db) -> MemTable:
         "backend_start": [ts(v["backend_start"]) for v in sess],
         "query_start": [ts(v["query_start"]) for v in sess],
         "state": [v["state"] for v in sess],
+        # live wait feed (obs/resources.wait_scope): what an ACTIVE
+        # session is blocked on right now — worker-pool task waits,
+        # search-batch coalescing, collective combines; NULL when
+        # running on-CPU or idle (PG semantics)
+        "wait_event_type": [v.get("wait_event_type") for v in sess],
+        "wait_event": [v.get("wait_event") for v in sess],
         # normalized-statement fingerprint of the session's last
         # completed statement (sdb_stat_statements key), NULL before
         # any profiled execution
@@ -1296,6 +1303,8 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return cache_table()
     if name == "sdb_trace":
         return trace_table([])
+    if name == "sdb_query_progress":
+        return query_progress_table()
     return None
 
 
@@ -1335,7 +1344,9 @@ def stat_statements_table() -> TableProvider:
         ("max_time_ms", dt.DOUBLE), ("p50_time_ms", dt.DOUBLE),
         ("p95_time_ms", dt.DOUBLE), ("p99_time_ms", dt.DOUBLE),
         ("rows", dt.BIGINT),
-        ("morsels_pruned", dt.BIGINT), ("cache_hits", dt.BIGINT)], {
+        ("morsels_pruned", dt.BIGINT), ("cache_hits", dt.BIGINT),
+        ("peak_mem_bytes", dt.BIGINT),
+        ("last_peak_mem_bytes", dt.BIGINT)], {
         "queryid": [e["queryid"] for e in rows],
         "query": [e["query"] for e in rows],
         "calls": [e["calls"] for e in rows],
@@ -1349,7 +1360,12 @@ def stat_statements_table() -> TableProvider:
         "p99_time_ms": [e.get("p99_ms", 0.0) for e in rows],
         "rows": [e["rows"] for e in rows],
         "morsels_pruned": [e["morsels_pruned"] for e in rows],
-        "cache_hits": [e.get("cache_hits", 0) for e in rows]})
+        "cache_hits": [e.get("cache_hits", 0) for e in rows],
+        # max / most-recent accounted peak bytes across this
+        # fingerprint's calls (0 when serene_mem_account was off)
+        "peak_mem_bytes": [e.get("peak_mem_bytes", 0) for e in rows],
+        "last_peak_mem_bytes": [e.get("last_peak_mem_bytes", 0)
+                                for e in rows]})
 
 
 def trace_table(args: list) -> TableProvider:
@@ -1366,13 +1382,18 @@ def trace_table(args: list) -> TableProvider:
         return _typed("sdb_trace", [
             ("trace_id", dt.BIGINT), ("query", dt.VARCHAR),
             ("duration_ms", dt.DOUBLE), ("spans", dt.BIGINT),
-            ("spans_dropped", dt.BIGINT), ("error", dt.VARCHAR)], {
+            ("spans_dropped", dt.BIGINT), ("peak_bytes", dt.BIGINT),
+            ("error", dt.VARCHAR)], {
             "trace_id": [e["trace_id"] for e in entries],
             "query": [e["query"] for e in entries],
             "duration_ms": [round(e["duration_ns"] / 1e6, 3)
                             for e in entries],
             "spans": [len(e["spans"]) for e in entries],
             "spans_dropped": [e["spans_dropped"] for e in entries],
+            # accounted peak memory of the statement (NULL when
+            # serene_mem_account was off for it) — a memory-heavy
+            # query is findable in the recorder after the fact
+            "peak_bytes": [e.get("peak_bytes") for e in entries],
             "error": [e["error"] or "" for e in entries]})
     try:
         tid = int(args[0])
@@ -1398,7 +1419,38 @@ def trace_table(args: list) -> TableProvider:
                    for s in spans]})
 
 
+def query_progress_table() -> TableProvider:
+    """sdb_query_progress: one row per RUNNING statement — its current
+    operator, morsels scheduled/completed, rows and bytes processed so
+    far, live/peak accounted bytes and elapsed time (the
+    pg_stat_progress_* analog for query execution, fed live from the
+    obs/resources ACTIVE registry; requires serene_mem_account). The
+    statement reading this view is itself running, so it appears in
+    its own output (PG pg_stat_activity semantics)."""
+    from .obs.resources import ACTIVE
+    rows = ACTIVE.snapshot()
+    return _typed("sdb_query_progress", [
+        ("pid", dt.INT), ("query_id", dt.BIGINT), ("query", dt.VARCHAR),
+        ("operator", dt.VARCHAR), ("morsels_scheduled", dt.BIGINT),
+        ("morsels_done", dt.BIGINT), ("rows", dt.BIGINT),
+        ("bytes", dt.BIGINT), ("live_bytes", dt.BIGINT),
+        ("peak_bytes", dt.BIGINT), ("elapsed_ms", dt.DOUBLE)], {
+        "pid": [r["pid"] for r in rows],
+        "query_id": [r["query_id"] for r in rows],
+        "query": [r["query"] for r in rows],
+        "operator": [r["operator"] for r in rows],
+        "morsels_scheduled": [r["morsels_scheduled"] for r in rows],
+        "morsels_done": [r["morsels_done"] for r in rows],
+        "rows": [r["rows"] for r in rows],
+        "bytes": [r["bytes"] for r in rows],
+        "live_bytes": [r["live_bytes"] for r in rows],
+        "peak_bytes": [r["peak_bytes"] for r in rows],
+        "elapsed_ms": [r["elapsed_ms"] for r in rows]})
+
+
 def metrics_table() -> TableProvider:
+    from .obs.resources import sample_process_gauges
+    sample_process_gauges()
     gs = _metrics.REGISTRY.all()
     return MemTable("sdb_metrics", Batch.from_pydict({
         "metric": [g.name for g in gs],

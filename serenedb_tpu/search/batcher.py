@@ -133,11 +133,17 @@ class SearchBatcher:
                         g.dispatching = True
                         break
                     # bounded waits only: re-check conditions even if a
-                    # wakeup is lost, and honor the window deadline
-                    if g.dispatching:
-                        g.cv.wait(0.25)
-                    else:
-                        g.cv.wait(min(max(deadline - now, 0.0002), 0.05))
+                    # wakeup is lost, and honor the window deadline.
+                    # The wait publishes live into the session's
+                    # pg_stat_activity row (the batch_wait span's live
+                    # counterpart).
+                    from ..obs.resources import wait_scope
+                    with wait_scope("IPC", "SearchBatchWait"):
+                        if g.dispatching:
+                            g.cv.wait(0.25)
+                        else:
+                            g.cv.wait(
+                                min(max(deadline - now, 0.0002), 0.05))
             finally:
                 if batch is None:
                     self._release(key, g)

@@ -1034,10 +1034,12 @@ def _device_merge_topk(seg_outs: list, bases: list[int], n_queries: int,
     t_d = time.perf_counter_ns()
     metrics.DEVICE_OFFLOADS.add()
     metrics.COLLECTIVE_DISPATCHES.add()
-    ss, dd2 = jitted(jax.device_put(scores, sh),
-                     jax.device_put(docs, sh))
-    ss = np.asarray(ss)
-    dd2 = np.asarray(dd2)
+    from ..obs.resources import wait_scope
+    with wait_scope("Device", "CollectiveCombine"):
+        ss, dd2 = jitted(jax.device_put(scores, sh),
+                         jax.device_put(docs, sh))
+        ss = np.asarray(ss)
+        dd2 = np.asarray(dd2)
     dt = time.perf_counter_ns() - t_d
     metrics.COLLECTIVE_COMBINE_NS.add(dt)
     metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)

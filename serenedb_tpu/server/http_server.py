@@ -109,6 +109,15 @@ class Handler(BaseHTTPRequestHandler):
                               "completed queries)")
             self._send(200, chrome_trace(entry))
             return
+        if p == ["progress"] and method == "GET":
+            # live query progress (sdb_query_progress as JSON): one
+            # object per running statement with its current operator,
+            # morsel/row/byte counters and accounted live/peak bytes.
+            # Exactly GET /progress — deeper paths still reach the ES
+            # API for an index of that name (the /metrics tradeoff).
+            from ..obs.resources import ACTIVE
+            self._send(200, ACTIVE.snapshot())
+            return
         if p == ["metrics"] and method == "GET":
             # Prometheus exposition: the whole gauge registry (one
             # consistent snapshot) + per-statement series (obs/export).

@@ -71,6 +71,11 @@ class MaintenanceManager:
         """One maintenance pass; returns True if any work was done."""
         if getattr(self.db, "_crashed", False):
             return False  # abandoned db must not checkpoint post-"kill"
+        # process-level gauges (RSS/uptime/GC) ride the existing ticker
+        # so sdb_metrics stays fresh between scrapes; the /metrics and
+        # /_stats renderers also sample at scrape time
+        from ..obs.resources import sample_process_gauges
+        sample_process_gauges()
         did = self._refresh_pass()
         did = self._checkpoint_pass() or did
         did = self._drop_gc_pass() or did

@@ -216,6 +216,18 @@ declare("serene_trace", True, bool,
         "Observation only: results are bit-identical on or off at any "
         "worker/shard count (<3% overhead budget, trace_overhead bench "
         "shape)")
+declare("serene_mem_account", True, bool,
+        "per-query resource accounting (obs/resources.py): every "
+        "statement charges live/peak bytes at its materialization "
+        "sites (operator batches, join build sides, sort buffers, "
+        "morsel partials, device uploads, cache stores), feeds "
+        "per-operator Memory lines in EXPLAIN ANALYZE, peak_mem "
+        "columns in sdb_stat_statements, the QueryPeakBytes histogram, "
+        "and registers live progress rows for sdb_query_progress() / "
+        "GET /progress. Observation only: results are bit-identical "
+        "on or off at any worker/shard count (<3% overhead budget, "
+        "mem_overhead bench shape) — the prerequisite the "
+        "admission-control / serene_work_mem roadmap item builds on")
 declare("serene_flight_recorder_queries", 64, int,
         "size of the always-on flight recorder: the last N completed "
         "query timelines are kept in a bounded ring so the slow-query "

@@ -95,19 +95,26 @@ def hist_quantile_ns(counts, q: float) -> float:
 
 
 class Histogram:
-    """Fixed log-spaced-bucket latency histogram (Prometheus classic
-    histogram semantics: cumulative `le` buckets + sum + count).
+    """Fixed log-spaced-bucket histogram (Prometheus classic histogram
+    semantics: cumulative `le` buckets + sum + count).
 
     Observed at task/statement boundaries only — one bisect over 28
     bounds plus one locked triple update per observation, never per row —
     so p50/p95/p99 become derivable from `/metrics` and `/_stats`
-    without any per-request allocation."""
+    without any per-request allocation.
 
-    __slots__ = ("name", "description", "_counts", "_sum_ns", "_lock")
+    `unit` is "s" (observations in NANOSECONDS, exported as seconds —
+    the latency histograms) or "bytes" (observations in bytes, exported
+    raw — the memory histograms). The log-spaced bounds read naturally
+    in both: 1 µs..137 s, or 1 kB..137 GB."""
 
-    def __init__(self, name: str, description: str = ""):
+    __slots__ = ("name", "description", "unit", "_counts", "_sum_ns",
+                 "_lock")
+
+    def __init__(self, name: str, description: str = "", unit: str = "s"):
         self.name = name
         self.description = description
+        self.unit = unit
         self._counts = [0] * (len(HIST_BOUNDS_NS) + 1)
         self._sum_ns = 0
         self._lock = threading.Lock()
@@ -157,10 +164,11 @@ class Registry:
             g = self._gauges[name] = Gauge(name, description)
         return g
 
-    def histogram(self, name: str, description: str = "") -> Histogram:
+    def histogram(self, name: str, description: str = "",
+                  unit: str = "s") -> Histogram:
         h = self._hists.get(name)
         if h is None:
-            h = self._hists[name] = Histogram(name, description)
+            h = self._hists[name] = Histogram(name, description, unit)
         return h
 
     def all(self) -> list[Gauge]:
@@ -322,6 +330,24 @@ TRACE_SPANS_DROPPED = REGISTRY.gauge(
     "TraceSpansDropped",
     "span events dropped because a per-thread trace ring hit its cap "
     "(the timeline stays bounded; widest spans are still present)")
+MEM_ACCOUNT_EVENTS = REGISTRY.gauge(
+    "MemAccountEvents",
+    "charge/release events recorded by per-query memory accounting "
+    "(serene_mem_account) — the direct-decomposition input for the "
+    "mem_overhead bench shape")
+PROCESS_RSS_BYTES = REGISTRY.gauge(
+    "ProcessRssBytes",
+    "resident set size of this process (/proc/self/statm), sampled at "
+    "scrape time and by the maintenance ticker")
+PROCESS_UPTIME_SECONDS = REGISTRY.gauge(
+    "ProcessUptimeSeconds",
+    "seconds since this process initialized the metrics registry")
+GC_GEN0_COLLECTIONS = REGISTRY.gauge(
+    "GcGen0Collections", "CPython gc generation-0 collections")
+GC_GEN1_COLLECTIONS = REGISTRY.gauge(
+    "GcGen1Collections", "CPython gc generation-1 collections")
+GC_GEN2_COLLECTIONS = REGISTRY.gauge(
+    "GcGen2Collections", "CPython gc generation-2 collections")
 
 #: latency histograms (log-spaced buckets; Prometheus histogram series
 #: in /metrics, p50/p95/p99 in /_stats). Observed at statement / task /
@@ -342,3 +368,9 @@ DEVICE_DISPATCH_HIST = REGISTRY.histogram(
     "the dispatch section (post-upload; first call includes jit "
     "compile), device aggregates and top-N observe the whole offload "
     "(upload + compile-cache lookup + dispatch + readback)")
+QUERY_PEAK_BYTES_HIST = REGISTRY.histogram(
+    "QueryPeakBytes",
+    "per-statement accounted peak memory (serene_mem_account): the "
+    "sum of per-thread peak live bytes charged at materialization "
+    "sites — an upper bound on the statement's true simultaneous peak",
+    unit="bytes")

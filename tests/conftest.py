@@ -129,6 +129,19 @@ if os.environ.get("SERENE_TRACE"):
     _SDB_REG_TR.set_global("serene_trace", os.environ["SERENE_TRACE"])
 
 
+# scripts/verify_tier1.sh memory-accounting parity leg: force
+# serene_mem_account to the given value ("on"/"off") for a whole run —
+# the on pass proves per-query live/peak byte accounting + progress
+# registration observe without changing a single result bit at any
+# worker/shard count, the off pass that the engine runs clean with the
+# accountant absent.
+if os.environ.get("SERENE_MEM_ACCOUNT"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_MA
+
+    _SDB_REG_MA.set_global("serene_mem_account",
+                           os.environ["SERENE_MEM_ACCOUNT"])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

@@ -1,0 +1,470 @@
+"""Resource observability (ISSUE 13): per-query memory accounting,
+wait events, live query progress.
+
+The contract under test is the same one serene_profile/serene_trace
+carry: accounting OBSERVES, never steers — results are bit-identical
+with it on or off at any worker/shard count — while the resource axis
+becomes visible everywhere it should: per-operator Memory lines in
+EXPLAIN ANALYZE (text + FORMAT JSON), peak_mem columns in
+sdb_stat_statements, the QueryPeakBytes histogram in /metrics and
+/_stats.memory, peak_bytes on flight-recorder entries, PG-style
+wait_event columns in pg_stat_activity, and advancing
+sdb_query_progress() rows for running statements.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.columnar.column import Batch, Column
+from serenedb_tpu.engine import Database
+from serenedb_tpu.exec.tables import MemTable
+from serenedb_tpu.obs.resources import (ACTIVE, CURRENT_MEM,
+                                        MemoryAccountant, read_rss_bytes,
+                                        sample_process_gauges, wait_scope)
+from serenedb_tpu.utils import metrics
+
+
+def _db(n=40_000, seed=11):
+    rng = np.random.default_rng(seed)
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE facts (k INT, v BIGINT)")
+    c.execute("CREATE TABLE dims (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["facts"] = MemTable("facts", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 50, n).astype(np.int32)),
+        "v": Column.from_numpy(rng.integers(0, n, n, dtype=np.int64))}))
+    db.schemas["main"].tables["dims"] = MemTable("dims", Batch.from_pydict({
+        "k": Column.from_numpy(np.arange(n, dtype=np.int64)),
+        "w": Column.from_numpy(rng.integers(0, 9, n, dtype=np.int64))}))
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_morsel_rows = 4096")
+    c.execute("SET serene_parallel_min_rows = 1024")
+    # session-pinned so the suite is invariant to the global the
+    # verify_tier1.sh env hooks may have forced either way
+    c.execute("SET serene_mem_account = on")
+    return db, c
+
+
+AGG_Q = ("SELECT k, count(*), sum(v) FROM facts WHERE v % 3 <> 0 "
+         "GROUP BY k ORDER BY k")
+JOIN_Q = ("SELECT count(*), sum(v + w) FROM facts "
+          "JOIN dims ON facts.v = dims.k")
+
+
+# -- parity matrix -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+@pytest.mark.parametrize("shards", [1, 4])
+def test_parity_matrix_agg_join(workers, shards):
+    """Results are bit-identical with accounting on/off at any
+    worker/shard count — the observe-only contract."""
+    db, c = _db()
+    c.execute(f"SET serene_workers = {workers}")
+    c.execute(f"SET serene_shards = {shards}")
+    got = {}
+    for mode in ("on", "off"):
+        c.execute(f"SET serene_mem_account = {mode}")
+        got[mode] = (c.execute(AGG_Q).rows(), c.execute(JOIN_Q).rows())
+    assert got["on"] == got["off"]
+
+
+def test_mem_account_not_result_affecting():
+    """The setting must never split the result cache: accounting
+    cannot change what a result CONTAINS."""
+    from serenedb_tpu.cache.result import RESULT_AFFECTING_SETTINGS
+    assert "serene_mem_account" not in RESULT_AFFECTING_SETTINGS
+
+
+# -- peak-bytes sanity -------------------------------------------------------
+
+
+def test_join_peak_bounds_build_side_1m():
+    """The accounted peak of a 1M-row hash join bounds the measured
+    build-side array bytes from above and stays within 2x of the total
+    arrays the join demonstrably materializes (build + probe + pair
+    indices + output/partials slack)."""
+    rng = np.random.default_rng(31)
+    n = 1_000_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE po (k INT, v BIGINT)")
+    c.execute("CREATE TABLE pb (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["po"] = MemTable("po", Batch.from_pydict({
+        "k": Column.from_numpy(rng.integers(0, 1000, n).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.permutation(np.arange(n, dtype=np.int64)))}))
+    db.schemas["main"].tables["pb"] = MemTable("pb", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.permutation(np.arange(n, dtype=np.int64))),
+        "w": Column.from_numpy(rng.integers(0, 100, n, dtype=np.int64))}))
+    c.execute("SET serene_device = 'cpu'")
+    c.execute("SET serene_mem_account = on")
+    q = "SELECT count(*), sum(v + w) FROM po JOIN pb ON po.v = pb.k"
+    c.execute(q)
+    rows = c.execute(
+        "SELECT last_peak_mem_bytes FROM sdb_stat_statements "
+        "WHERE query LIKE '%from po join pb%'").rows()
+    assert rows, "statement not recorded"
+    peak = rows[0][0]
+    build_bytes = 16 * n            # pb: two int64 columns
+    probe_bytes = 12 * n            # po: int32 + int64
+    pair_bytes = 2 * 8 * n          # li/ri int64 index arrays
+    assert peak >= build_bytes, (peak, build_bytes)
+    # generous-but-meaningful cap: everything the join materializes,
+    # doubled (morsel partials, merged dictionaries, output)
+    cap = 2 * (build_bytes + probe_bytes + pair_bytes + (1 << 20))
+    assert peak <= cap, (peak, cap)
+
+
+def test_accountant_merged_peak_is_upper_bound():
+    """Unit property: Σ per-thread peaks >= the true simultaneous
+    total, and per-key live returns to zero on balanced traffic."""
+    acct = MemoryAccountant("unit")
+    stop = threading.Barrier(3)
+
+    def worker():
+        stop.wait()
+        for _ in range(200):
+            acct.charge("op", 1000)
+            acct.release("op", 1000)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    stop.wait()
+    for t in ts:
+        t.join()
+    live, peak = acct.totals()
+    assert live == 0
+    assert 1000 <= peak <= 2000     # each thread's peak is exactly 1000
+    m = acct.merged()
+    assert m["op"][0] == 0 and m["op"][1] >= 1000
+    assert acct.event_count() == 800
+
+
+# -- wait events -------------------------------------------------------------
+
+
+def test_wait_event_visible_during_pool_saturated_query():
+    """A statement blocked on worker-pool tasks publishes a non-null
+    wait_event into its pg_stat_activity row while it waits, and the
+    row is clean again after completion."""
+    from serenedb_tpu.engine import CURRENT_CONNECTION
+    from serenedb_tpu.parallel.pool import get_pool
+    db = Database()
+    c = db.connect()
+    sess = db.sessions[c._session_id]
+    seen = []
+    done = threading.Event()
+
+    def blocked():
+        tok = CURRENT_CONNECTION.set(c)
+        try:
+            pool = get_pool().ensure_started()
+            futs = [pool.submit(time.sleep, 0.15) for _ in range(4)]
+            for f in futs:
+                if not f.done():
+                    with wait_scope("IPC", "PoolTaskWait"):
+                        f.result()
+                else:
+                    f.result()
+        finally:
+            CURRENT_CONNECTION.reset(tok)
+            done.set()
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    while not done.is_set():
+        ev = (sess.get("wait_event_type"), sess.get("wait_event"))
+        if ev[0] is not None:
+            seen.append(ev)
+        time.sleep(0.002)
+    t.join()
+    assert ("IPC", "PoolTaskWait") in seen
+    assert sess.get("wait_event_type") is None
+    assert sess.get("wait_event") is None
+
+
+def test_wait_event_via_sql_during_saturated_pool():
+    """Acceptance shape: a REAL statement whose morsel tasks queue
+    behind a saturated pool shows a non-null wait_event in
+    pg_stat_activity (read via SQL from another connection) while it
+    waits, and advancing sdb_query_progress() counters."""
+    from serenedb_tpu.parallel.pool import get_pool
+    db, c = _db(n=200_000, seed=5)
+    c.execute("SET serene_workers = 4")
+    observer = db.connect()
+    pool = get_pool().ensure_started()
+    # occupy every worker so the query's morsel tasks must queue
+    blockers = [pool.submit(time.sleep, 0.3) for _ in range(pool.size)]
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (c.execute(AGG_Q), done.set()))
+    t.start()
+    waits, progressed = [], []
+    while not done.is_set():
+        rows = observer.execute(
+            "SELECT wait_event_type, wait_event FROM pg_stat_activity "
+            f"WHERE pid = {c._session_id}").rows()
+        if rows and rows[0][0] is not None:
+            waits.append(rows[0])
+        for r in ACTIVE.snapshot():
+            if "facts" in r["query"]:
+                progressed.append(r["morsels_done"])
+        time.sleep(0.002)
+    t.join()
+    for f in blockers:
+        f.result()
+    assert ("IPC", "PoolTaskWait") in waits
+    assert progressed and max(progressed) >= 1
+
+
+def test_wait_scope_nests_and_restores():
+    db = Database()
+    c = db.connect()
+    from serenedb_tpu.engine import CURRENT_CONNECTION
+    sess = db.sessions[c._session_id]
+    tok = CURRENT_CONNECTION.set(c)
+    try:
+        with wait_scope("IPC", "Outer"):
+            assert sess["wait_event"] == "Outer"
+            with wait_scope("Device", "Inner"):
+                assert sess["wait_event_type"] == "Device"
+                assert sess["wait_event"] == "Inner"
+            assert sess["wait_event"] == "Outer"
+        assert sess["wait_event"] is None
+    finally:
+        CURRENT_CONNECTION.reset(tok)
+
+
+def test_pg_stat_activity_wait_columns_null_when_running():
+    db, c = _db()
+    rows = c.execute(
+        "SELECT pid, state, wait_event_type, wait_event "
+        "FROM pg_stat_activity").rows()
+    me = [r for r in rows if r[1] == "active"]
+    assert me and me[0][2] is None and me[0][3] is None
+
+
+# -- live query progress -----------------------------------------------------
+
+
+def test_progress_rows_monotone_and_retired():
+    """A running aggregate's progress counters only grow while it
+    executes, and its row leaves the registry on completion."""
+    db, c = _db(n=300_000, seed=3)
+    c.execute("SET serene_workers = 4")
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            c.execute(AGG_Q)
+        except Exception as e:       # pragma: no cover — surfaced below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    samples = []
+    while not done.is_set():
+        for r in ACTIVE.snapshot():
+            if "facts" in r["query"]:
+                samples.append((r["morsels_done"], r["rows"], r["bytes"]))
+        time.sleep(0.001)
+    t.join()
+    assert not err, err
+    assert samples, "statement finished before any progress sample"
+    for a, b in zip(samples, samples[1:]):
+        assert b[0] >= a[0] and b[1] >= a[1] and b[2] >= a[2]
+    assert samples[-1][0] >= 1      # morsels really advanced
+    # retired on completion: no phantom running query remains
+    assert not [r for r in ACTIVE.snapshot() if "facts" in r["query"]]
+
+
+def test_progress_retired_on_error():
+    db, c = _db()
+    with pytest.raises(Exception):
+        c.execute("SELECT 1 / 0 FROM facts")
+    assert not [r for r in ACTIVE.snapshot() if "facts" in r["query"]]
+
+
+def test_sdb_query_progress_relation_lists_self():
+    """The observing statement is itself a running statement (PG
+    pg_stat_activity semantics) — the relation and the table function
+    both resolve and carry the full column set."""
+    db, c = _db()
+    rows = c.execute(
+        "SELECT pid, query, operator, morsels_scheduled, morsels_done, "
+        "rows, bytes, live_bytes, peak_bytes, elapsed_ms "
+        "FROM sdb_query_progress()").rows()
+    assert rows and any("sdb_query_progress" in r[1] for r in rows)
+    rows2 = c.execute(
+        "SELECT pid FROM sdb_query_progress").rows()
+    assert rows2
+
+
+def test_streaming_statement_registers_and_retires_progress():
+    from serenedb_tpu.sql import parser
+    db, c = _db()
+    st = parser.parse("SELECT k, v FROM facts")[0]
+    names, types, gen = c.execute_streaming(
+        st, sql_text="SELECT k, v FROM facts")
+    first = next(gen)
+    assert first.num_rows
+    assert any("facts" in r["query"] for r in ACTIVE.snapshot())
+    for _ in gen:
+        pass
+    assert not [r for r in ACTIVE.snapshot() if "facts" in r["query"]]
+
+
+# -- surfaces ----------------------------------------------------------------
+
+
+def test_explain_analyze_memory_lines_text_and_json():
+    import json
+    db, c = _db()
+    txt = "\n".join(r[0] for r in c.execute(
+        f"EXPLAIN ANALYZE {JOIN_Q}").rows())
+    assert "Memory: peak=" in txt
+    assert "Peak Memory:" in txt
+    doc = json.loads(c.execute(
+        f"EXPLAIN (ANALYZE, FORMAT JSON) {JOIN_Q}").rows()[0][0])[0]
+    assert doc["Peak Memory Bytes"] > 0
+
+    def any_node(d):
+        if d.get("Peak Memory Bytes", 0) > 0:
+            return True
+        return any(any_node(k) for k in d.get("Plans", []))
+
+    assert any_node(doc["Plan"])
+
+
+def test_stat_statements_peak_columns_and_max_semantics():
+    from serenedb_tpu.obs.statements import STATEMENTS
+    db, c = _db()
+    c.execute(JOIN_Q)
+    rows = c.execute(
+        "SELECT peak_mem_bytes, last_peak_mem_bytes "
+        "FROM sdb_stat_statements WHERE query LIKE '%join dims%'").rows()
+    assert rows and rows[0][0] > 0
+    assert rows[0][0] >= rows[0][1]
+    # direct store semantics: peak_mem_bytes is the max across calls
+    STATEMENTS.record("SELECT x FROM peakprobe_tbl", 1000, 1, 0, 100,
+                      peak_bytes=500)
+    STATEMENTS.record("SELECT x FROM peakprobe_tbl", 1000, 1, 0, 100,
+                      peak_bytes=200)
+    e = [x for x in STATEMENTS.snapshot()
+         if "peakprobe_tbl" in x["query"]][-1]
+    assert e["peak_mem_bytes"] == 500
+    assert e["last_peak_mem_bytes"] == 200
+
+
+def test_query_peak_histogram_in_metrics_and_stats():
+    from serenedb_tpu.obs.export import prometheus_text, stats_json
+    db, c = _db()
+    base = metrics.QUERY_PEAK_BYTES_HIST.count
+    c.execute(JOIN_Q)
+    assert metrics.QUERY_PEAK_BYTES_HIST.count > base
+    text = prometheus_text()
+    # byte-unit histogram: raw-byte buckets, no _seconds suffix
+    assert "serenedb_query_peak_bytes_bucket" in text
+    assert "serenedb_query_peak_bytes_seconds" not in text
+    sj = stats_json()
+    assert sj["memory"]["query_peak"]["count"] > 0
+    assert sj["memory"]["query_peak"]["p99_bytes"] > 0
+    # byte histograms stay OUT of the latency percentile section
+    assert "QueryPeakBytes" not in sj["latency"]
+    assert isinstance(sj["memory"]["progress"], list)
+
+
+def test_flight_recorder_entries_carry_peak_bytes():
+    db, c = _db()
+    c.execute(JOIN_Q)
+    rows = c.execute(
+        "SELECT query, peak_bytes FROM sdb_trace").rows()
+    mine = [r for r in rows if "JOIN dims" in r[0]]
+    assert mine and mine[-1][1] > 0
+    from serenedb_tpu.obs.trace import FLIGHT, flight_summary
+    entry = FLIGHT.last()
+    assert "peak_bytes" in flight_summary(entry)
+
+
+def test_slow_query_log_attaches_memory():
+    from serenedb_tpu.utils import log
+    db, c = _db()
+    c.execute("SET serene_log_min_duration_ms = 0")
+    c.execute(AGG_Q)
+    recs = [r for r in log.MANAGER.records() if r.topic == "slow_query"]
+    assert recs
+    msg = recs[-1].message
+    assert "memory: peak=" in msg
+
+
+def test_mem_account_off_disables_surfaces():
+    from serenedb_tpu.obs.statements import fingerprint, normalize
+    db, c = _db()
+    c.execute("SET serene_mem_account = off")
+    c.execute(JOIN_Q)
+    qid = fingerprint(normalize(JOIN_Q))
+    rows = c.execute(
+        "SELECT last_peak_mem_bytes FROM sdb_stat_statements "
+        f"WHERE queryid = {qid}").rows()
+    assert rows and rows[0][0] == 0
+    txt = "\n".join(r[0] for r in c.execute(
+        "EXPLAIN ANALYZE SELECT 1").rows())
+    # EXPLAIN ANALYZE always instruments (PG semantics), even with the
+    # session setting off — same rule as the profiler
+    assert "Peak Memory:" in txt
+
+
+# -- process-level gauges ----------------------------------------------------
+
+
+def test_process_gauges_sampled():
+    sample_process_gauges()
+    assert read_rss_bytes() > 0              # linux CI: procfs present
+    assert metrics.PROCESS_RSS_BYTES.value > 0
+    assert metrics.PROCESS_UPTIME_SECONDS.value >= 0
+    assert metrics.GC_GEN0_COLLECTIONS.value >= 0
+
+
+def test_process_gauges_via_sdb_metrics_and_http_progress():
+    db, c = _db()
+    rows = dict(c.execute(
+        "SELECT metric, value FROM sdb_metrics "
+        "WHERE metric LIKE 'Process%'").rows())
+    assert rows.get("ProcessRssBytes", 0) > 0
+    # GET /progress serves the live registry
+    from serenedb_tpu.server.http_server import HttpServer
+    import json as _json
+    import urllib.request
+    srv = HttpServer(db, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/progress", timeout=10) as r:
+            payload = _json.loads(r.read())
+        assert isinstance(payload, list)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/_stats", timeout=10) as r:
+            stats = _json.loads(r.read())
+        assert stats["memory"]["process"]["rss_bytes"] > 0
+    finally:
+        srv.stop()
+
+
+# -- contextvar hygiene ------------------------------------------------------
+
+
+def test_current_mem_clean_after_statements():
+    db, c = _db()
+    c.execute(AGG_Q)
+    assert CURRENT_MEM.get() is None
+    with pytest.raises(Exception):
+        c.execute("SELECT nope FROM facts")
+    assert CURRENT_MEM.get() is None
