@@ -45,6 +45,7 @@ import os
 import re
 import subprocess
 import sys
+import threading
 import time
 
 METRIC = ("geomean device-vs-CPU speedup (ClickBench Q1 agg, ClickBench "
@@ -957,6 +958,170 @@ def bench_mem_overhead() -> float:
     return t_off_total / t_on_total
 
 
+def bench_concurrency() -> float:
+    """Workload governor (ISSUE 14): p50/p99 latency of SMALL dashboard
+    queries while heavy scans run, fair-share + admission off vs on.
+
+    Three heavy aggregate statements loop continuously over 2M rows
+    (each keeps its map_ordered window of morsel tasks in the shared
+    pool queue) while a fourth session runs 30 small aggregates; per
+    small query the flight-recorder timeline yields its WIDEST pool
+    queue-wait span. ASSERTED (the PR 5/PR 10 noise discipline: claim
+    the decomposition, record the end to end): results bit-identical
+    off vs on, and the small queries' p99 queue-wait DROPS with fair
+    share on — under FIFO a small morsel provably waits behind every
+    heavy morsel already queued, under stride picking it overtakes
+    them. End-to-end p50/p99 latencies are recorded in the extra
+    payload, not asserted. Returns wait_p99_off / wait_p99_on."""
+    import statistics
+
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+    from serenedb_tpu.obs.trace import FLIGHT
+    from serenedb_tpu.utils.config import REGISTRY
+
+    rng = np.random.default_rng(23)
+    n_heavy, n_small = 2_000_000, 30_000
+    # an 8-worker pool regardless of host cores (set BEFORE first
+    # get_pool()): the fair-share story is about deep per-statement
+    # backlogs, and map_ordered windows in-flight tasks at
+    # min(serene_workers, pool size) — a 2-worker floor pool on a
+    # small box would cap every heavy statement at 2 queued morsels
+    # and hide the starvation this shape measures
+    REGISTRY.set_global("serene_workers", 8)
+    db = Database()
+    boot = db.connect()
+    boot.execute("CREATE TABLE hv (k INT, v BIGINT)")
+    boot.execute("CREATE TABLE sm (k INT, v BIGINT)")
+    db.schemas["main"].tables["hv"] = MemTable("hv", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.integers(0, 1000, n_heavy).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(0, n_heavy, n_heavy, dtype=np.int64))}))
+    db.schemas["main"].tables["sm"] = MemTable("sm", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.integers(0, 50, n_small).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(0, n_small, n_small, dtype=np.int64))}))
+
+    HEAVY_Q = ("SELECT k, count(*), sum(v) FROM hv WHERE v % 7 <> 0 "
+               "GROUP BY k")
+    SMALL_Q = ("SELECT k, count(*), sum(v) FROM sm WHERE v % 3 <> 0 "
+               "GROUP BY k ORDER BY k")
+
+    def connect(morsel_rows):
+        cc = db.connect()
+        cc.execute("SET serene_device = 'cpu'")
+        cc.execute(f"SET serene_morsel_rows = {morsel_rows}")
+        cc.execute("SET serene_parallel_min_rows = 1024")
+        cc.execute("SET serene_workers = 8")
+        return cc
+
+    quiet = connect(4096)
+    oracle_small = quiet.execute(SMALL_Q).rows()
+    oracle_heavy = quiet.execute(HEAVY_Q).rows()
+
+    samples = 30
+
+    def measure(governor_on: bool, mode: str):
+        REGISTRY.set_global("serene_fair_share", governor_on)
+        REGISTRY.set_global("serene_max_concurrent_statements",
+                            8 if governor_on else 0)
+        stop = threading.Event()
+        heavy_rows = []
+        heavy_errs = []
+
+        def heavy_loop():
+            # a dead heavy thread would let the A/B measure ZERO
+            # contention and ledger a vacuous ratio — surface the
+            # first failure instead of letting the excepthook eat it
+            try:
+                hc = connect(65536)     # ~30 multi-ms morsels per pass
+                while not stop.is_set():
+                    heavy_rows.append(hc.execute(HEAVY_Q).rows())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                heavy_errs.append(e)
+
+        threads = [threading.Thread(target=heavy_loop) for _ in range(3)]
+        for t in threads:
+            t.start()
+        sc = connect(4096)
+        sc.execute("SET serene_trace = on")
+        # the dashboard session rides a high fair-share weight: its
+        # morsels take ~10 picks per heavy-tag pick instead of an
+        # equal 1-in-4 share (3 heavy tags dilute equal weights); a
+        # no-op under FIFO, which is exactly the A/B this shape runs
+        sc.execute("SET serene_priority = 1000")
+        lat, waits = [], []
+        rows = None
+        try:
+            time.sleep(0.2)             # heavy loops reach steady state
+            for i in range(samples):
+                marker = f"conc_{mode}_{i}"
+                t0 = time.perf_counter()
+                rows = sc.execute(
+                    SMALL_Q.replace("GROUP BY",
+                                    f"/* {marker} */ GROUP BY")).rows()
+                lat.append(time.perf_counter() - t0)
+                entry = next(e for e in reversed(FLIGHT.snapshot())
+                             if marker in e["query"])
+                spans = [s["end_ns"] - s["begin_ns"]
+                         for s in entry["spans"]
+                         if s["name"] == "queue_wait" and
+                         s["cat"] == "pool"]
+                waits.append(max(spans) / 1e9 if spans else 0.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        if heavy_errs:
+            raise heavy_errs[0]
+        assert heavy_rows, f"no heavy statements completed ({mode})"
+        assert rows == oracle_small, f"small-query parity broke ({mode})"
+        assert all(r == oracle_heavy for r in heavy_rows), \
+            f"heavy-query parity broke ({mode})"
+        return lat, waits, len(heavy_rows)
+
+    def pcts(xs):
+        s = sorted(xs)
+        return (statistics.median(s), s[min(len(s) - 1,
+                                            int(0.99 * len(s)))])
+
+    try:
+        lat_off, wait_off, heavy_off = measure(False, "off")
+        lat_on, wait_on, heavy_on = measure(True, "on")
+    finally:
+        REGISTRY.set_global("serene_fair_share", True)
+        REGISTRY.set_global("serene_max_concurrent_statements", 0)
+    lat_p50_off, lat_p99_off = pcts(lat_off)
+    lat_p50_on, lat_p99_on = pcts(lat_on)
+    wait_p50_off, wait_p99_off = pcts(wait_off)
+    wait_p50_on, wait_p99_on = pcts(wait_on)
+    _EXTRA["heavy_rows"] = n_heavy
+    _EXTRA["small_rows"] = n_small
+    _EXTRA["samples"] = samples
+    _EXTRA["heavy_statements"] = {"off": heavy_off, "on": heavy_on}
+    _EXTRA["small_latency_ms"] = {
+        "off": {"p50": round(lat_p50_off * 1e3, 2),
+                "p99": round(lat_p99_off * 1e3, 2)},
+        "on": {"p50": round(lat_p50_on * 1e3, 2),
+               "p99": round(lat_p99_on * 1e3, 2)}}
+    _EXTRA["small_queue_wait_ms"] = {
+        "off": {"p50": round(wait_p50_off * 1e3, 2),
+                "p99": round(wait_p99_off * 1e3, 2)},
+        "on": {"p50": round(wait_p50_on * 1e3, 2),
+               "p99": round(wait_p99_on * 1e3, 2)}}
+    _EXTRA["parity"] = "identical"
+    # the asserted decomposition: fair share bounds the widest wait
+    assert wait_p99_on < wait_p99_off, \
+        f"p99 queue wait did not drop: off={wait_p99_off:.4f}s " \
+        f"on={wait_p99_on:.4f}s"
+    return wait_p99_off / max(wait_p99_on, 1e-9)
+
+
 def bench_result_cache() -> float:
     """Multi-tier query cache (ISSUE 5 tentpole): the host_agg filtered
     aggregate and the vectorized join at 1M rows through the engine with
@@ -1608,6 +1773,7 @@ SHAPES = {
     "profile_overhead": bench_profile_overhead,
     "trace_overhead": bench_trace_overhead,
     "mem_overhead": bench_mem_overhead,
+    "concurrency": bench_concurrency,
     "result_cache": bench_result_cache,
     "device_pipeline": bench_device_pipeline,
     "search_batch": bench_search_batch,
@@ -1629,8 +1795,8 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 #: _run_shape_child), and the >1x assert applies only on a real device
 HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
                "profile_overhead", "trace_overhead", "mem_overhead",
-               "result_cache", "device_pipeline", "search_batch",
-               "shard_exec", "multichip")
+               "concurrency", "result_cache", "device_pipeline",
+               "search_batch", "shard_exec", "multichip")
 
 #: host shapes that nevertheless run jitted programs — with the device
 #: probe down their children must pin JAX_PLATFORMS=cpu, because

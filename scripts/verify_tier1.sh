@@ -142,6 +142,24 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_MEM_ACCOUNT=on \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc11=$?
 
+# Pass 12 is the workload-governor parity leg: admission control is
+# armed suite-wide (SERENE_MAX_CONCURRENT_STATEMENTS=8 — every
+# non-exempt statement takes or queues for a governor slot) with a
+# generous global SERENE_WORK_MEM ceiling (2GB — the budget check runs
+# against every accounted statement without firing) and fair-share
+# picking forced on, over the admission, parallel, shard and resources
+# suites — proving the governor steers WHEN statements run, never what
+# they return: a single diverged bit fails the parity assertions
+# loudly.
+echo "== workload governor parity pass (admission armed suite-wide) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    SERENE_MAX_CONCURRENT_STATEMENTS=8 SERENE_WORK_MEM=2GB \
+    SERENE_FAIR_SHARE=on \
+    python -m pytest tests/test_admission.py tests/test_parallel_exec.py \
+    tests/test_shard_exec.py tests/test_resources.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc12=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -152,4 +170,5 @@ rc11=$?
 [ "$rc8" -ne 0 ] && exit "$rc8"
 [ "$rc9" -ne 0 ] && exit "$rc9"
 [ "$rc10" -ne 0 ] && exit "$rc10"
-exit "$rc11"
+[ "$rc11" -ne 0 ] && exit "$rc11"
+exit "$rc12"

@@ -17,6 +17,7 @@ Layer map (mirrors SURVEY.md §1, re-expressed for TPU):
   catalog/   versioned snapshot catalog, RBAC, persistence
   columnar/  column batch ABI (the HBM-friendly data layout)
   parallel/  device-mesh sharding of scans/aggregates/scoring
+  sched/     workload governor: admission control, statement identity
   utils/     config, logging, metrics, fault injection, ticks
 """
 

@@ -88,6 +88,18 @@ assert "serene_profile" not in RESULT_AFFECTING_SETTINGS
 # events never steer execution, so a cached entry is valid whether the
 # statement that stored it was accounted or not
 assert "serene_mem_account" not in RESULT_AFFECTING_SETTINGS
+# the workload governor (sched/governor.py) steers WHEN statements run,
+# never what they return: admission order, fair-share picking and
+# priorities change scheduling only (the deterministic merge sinks
+# guarantee bit-identity), and the budget/timeout settings produce
+# ERRORS, not results — an aborted statement stores nothing, so no
+# cached entry can ever encode a budget's effect
+assert "serene_max_concurrent_statements" not in RESULT_AFFECTING_SETTINGS
+assert "serene_admission_queue_depth" not in RESULT_AFFECTING_SETTINGS
+assert "serene_fair_share" not in RESULT_AFFECTING_SETTINGS
+assert "serene_priority" not in RESULT_AFFECTING_SETTINGS
+assert "serene_work_mem" not in RESULT_AFFECTING_SETTINGS
+assert "serene_statement_timeout_ms" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path

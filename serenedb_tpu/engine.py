@@ -604,6 +604,9 @@ class Database(TableResolver):
         if name == "sdb_query_progress":
             from .pgcatalog import query_progress_table
             return query_progress_table()
+        if name == "sdb_admission":
+            from .pgcatalog import admission_table
+            return admission_table()
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 
@@ -908,6 +911,16 @@ class Connection:
         #: (serene_mem_account on; obs/resources.py) — read by the
         #: statement-end observability hook for peak-bytes attribution
         self._active_mem = None
+        #: workload governor state (sched/governor.py): admission slots
+        #: this connection currently holds (nested statements on a
+        #: slot-holding connection bypass admission — a session cannot
+        #: deadlock itself), the executing statement's enforced
+        #: serene_work_mem ceiling in bytes (0 = unlimited), and its
+        #: fair-share scheduling identity (tag, serene_priority weight)
+        #: read by the worker pool at task-submit time
+        self._admission_held = 0
+        self._work_mem_limit = 0
+        self._sched = None
         import weakref
         with db.lock:
             db._session_seq += 1
@@ -1018,8 +1031,16 @@ class Connection:
                 ACTIVE.register(acct)
             with self._session_scope(sql_text if sql_text is not None
                                      else "SELECT"):
-                it = plan.batches(ctx)
+                from .sched.governor import GOVERNOR, admission_exempt
+                ticket = None
                 try:
+                    # admission gates the first step, not portal OPEN:
+                    # the slot is taken when execution actually starts
+                    # and held until the portal drains or drops
+                    if GOVERNOR.enabled() and not admission_exempt(st):
+                        ticket = GOVERNOR.admit(self, sql_text or "SELECT",
+                                                trace)
+                    it = plan.batches(ctx)
                     while True:
                         # the caller may resume this generator from any
                         # worker thread: pin the connection contextvar
@@ -1084,6 +1105,10 @@ class Connection:
                             entry["peak_bytes"] = acct.totals()[1]
                         FLIGHT.record(entry)
                     raise
+                finally:
+                    # slot returns on EVERY exit: drained, errored, or
+                    # a dropped portal's GeneratorExit
+                    GOVERNOR.release(ticket)
 
         return plan.names, plan.types, run()
 
@@ -1172,7 +1197,20 @@ class Connection:
                 if acct is None:
                     self._active_mem = None
                 t0 = time.perf_counter_ns()
+                ticket = None
                 try:
+                    # workload governor admission (sched/governor.py):
+                    # utility statements and catalog-only introspection
+                    # bypass; everything else may queue (state 'queued',
+                    # Admission/AdmissionQueue wait event, queue_wait
+                    # trace span) or reject with 53300. t0 precedes the
+                    # gate so queue time counts in end-to-end latency —
+                    # the number the concurrency bench decomposes.
+                    if not utility:
+                        from .sched.governor import (GOVERNOR,
+                                                     admission_exempt)
+                        if GOVERNOR.enabled() and not admission_exempt(st):
+                            ticket = GOVERNOR.admit(self, label, trace)
                     res = self._dispatch(st, params, sql_text)
                 except BaseException as e:  # noqa: BLE001 — re-raised
                     # error paths dump the timeline automatically: the
@@ -1182,6 +1220,10 @@ class Connection:
                                        error=f"{type(e).__name__}: {e}")
                     self._finish_mem(acct)
                     raise
+                finally:
+                    if ticket is not None:
+                        from .sched.governor import GOVERNOR
+                        GOVERNOR.release(ticket)
                 entry = self._finish_trace(trace)
                 self._finish_mem(acct)
                 self._obs_record(sql_text, t0, _result_rows(res),
@@ -1207,13 +1249,35 @@ class Connection:
         if self._cancel_event.is_set():
             self._cancel_event.clear()
             raise errors.SqlError(
-                "57014", "canceling statement due to user request")
+                errors.QUERY_CANCELED,
+                "canceling statement due to user request")
         deadline = getattr(self, "_deadline", None)
         if deadline is not None:
             if time.monotonic() > deadline:
                 self._deadline = None
                 raise errors.SqlError(
-                    "57014", "canceling statement due to statement timeout")
+                    errors.QUERY_CANCELED,
+                    "canceling statement due to statement timeout")
+        # serene_work_mem enforcement (sched/governor.py contract):
+        # the budget rides the SAME cooperative drain as cancel and
+        # timeout, checked against the accountant's live bytes — free
+        # when no ceiling is set (one attribute read), one bucket sum
+        # per batch boundary when one is
+        limit = self._work_mem_limit
+        if limit:
+            acct = self._active_mem
+            if acct is not None:
+                live = acct.totals()[0]
+                if live > limit:
+                    self._work_mem_limit = 0   # abort once, not per morsel
+                    from .obs.resources import fmt_kb
+                    raise errors.SqlError(
+                        errors.OUT_OF_MEMORY,
+                        "out of memory: statement live bytes "
+                        f"({fmt_kb(live)}) exceed serene_work_mem "
+                        f"({fmt_kb(limit)})",
+                        hint="raise serene_work_mem or reduce the "
+                             "statement's working set")
 
     @contextlib.contextmanager
     def _session_scope(self, label: str):
@@ -1221,12 +1285,24 @@ class Connection:
         marking shared by the materializing and streaming paths."""
         self._cancel_event.clear()   # cancel targets the CURRENT statement
         timeout_ms = int(self.settings.get("statement_timeout") or 0)
+        # serene_statement_timeout_ms rides the same deadline/drain; the
+        # LOWER positive value wins when both are set
+        g_ms = int(self.settings.get("serene_statement_timeout_ms") or 0)
+        if g_ms > 0 and (timeout_ms <= 0 or g_ms < timeout_ms):
+            timeout_ms = g_ms
         # save/restore: a statement interleaved with a SUSPENDED streaming
         # portal (extended protocol) must not clobber the portal's
-        # deadline — scopes nest, each restores what it found
+        # deadline — scopes nest, each restores what it found (same for
+        # the work-mem ceiling and the fair-share scheduling identity)
         prev_deadline = getattr(self, "_deadline", None)
         self._deadline = (time.monotonic() + timeout_ms / 1000.0
                           if timeout_ms > 0 else None)
+        prev_work_mem = self._work_mem_limit
+        self._work_mem_limit = int(self.settings.get("serene_work_mem") or 0)
+        prev_sched = self._sched
+        from .sched.governor import next_stmt_tag
+        self._sched = (next_stmt_tag(),
+                       int(self.settings.get("serene_priority") or 100))
         sess = self.db.sessions.get(self._session_id)
         if sess is not None:
             sess["state"] = "active"
@@ -1243,6 +1319,8 @@ class Connection:
             raise
         finally:
             self._deadline = prev_deadline
+            self._work_mem_limit = prev_work_mem
+            self._sched = prev_sched
             if sess is not None:
                 sess["state"] = ("idle in transaction"
                                  if self.in_txn else "idle")

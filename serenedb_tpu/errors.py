@@ -35,6 +35,13 @@ INSUFFICIENT_PRIVILEGE = "42501"
 UNDEFINED_OBJECT = "42704"
 IN_FAILED_TRANSACTION = "25P02"
 INVALID_REGULAR_EXPRESSION = "2201B"
+QUERY_CANCELED = "57014"
+# workload governor (sched/governor.py): PG's class-53 "insufficient
+# resources" codes — 53300 for an admission queue at capacity (PG uses
+# it for too_many_connections; same resource, statement granularity),
+# 53200 for a statement aborted over its serene_work_mem budget
+TOO_MANY_CONNECTIONS = "53300"
+OUT_OF_MEMORY = "53200"
 
 
 def syntax(msg: str) -> SqlError:

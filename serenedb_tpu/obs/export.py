@@ -95,11 +95,15 @@ def stats_json() -> dict:
     progress) for the JSON `/_stats` route."""
     from ..cache.fragments import FRAGMENTS
     from ..cache.result import RESULT_CACHE
+    from ..sched.governor import GOVERNOR
     from .resources import ACTIVE, read_rss_bytes, sample_process_gauges
     from .trace import FLIGHT, flight_summary
     sample_process_gauges()
     snap = _metrics.REGISTRY.snapshot()
     return {"metrics": snap,
+            # workload governor: live running/queued counts + limits +
+            # cumulative admission totals (sched/governor.py)
+            "admission": GOVERNOR.snapshot(),
             "latency": {h.name: h.percentiles_ms()
                         for h in _metrics.REGISTRY.all_histograms()
                         if h.unit == "s"},

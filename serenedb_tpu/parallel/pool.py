@@ -7,18 +7,33 @@ share ONE pool instead of spawning per-query threads and oversubscribing
 the host — the same policy here: a lazily-started singleton sized by the
 `serene_workers` global (default = CPU count).
 
-Scheduling is a work-stealing design scaled to morsel granularity: each
-worker owns a deque, submissions land round-robin, and an idle worker
-steals from the opposite end of a sibling's deque. Tasks capture the
-submitter's contextvars (`contextvars.copy_context`), so executor-level
-facilities keyed on the current connection — cooperative cancellation
-(`plan.check_cancel`), statement-stable `now()` — keep working on worker
-threads exactly as they do inline.
+Scheduling has two modes. With `serene_fair_share` OFF it is the
+original work-stealing design scaled to morsel granularity: each worker
+owns a deque, submissions land round-robin, and an idle worker steals
+from the opposite end of a sibling's deque — global FIFO, so one heavy
+statement's backlog runs entirely before every later statement's first
+task. With `serene_fair_share` ON (the default) tagged tasks instead
+land in per-STATEMENT queues and workers pick by stride scheduling:
+each statement holds a pass value advanced by `stride = SCALE /
+serene_priority` per task run, and the picker takes the head of the
+lowest-pass queue — so a dashboard query arriving behind a 6M-row
+aggregate waits ~one morsel, not the whole backlog, and a weight-2w
+statement gets twice the pool share of a weight-w one. A newly arrived
+statement joins at the current minimum pass (it inherits no credit and
+owes no debt). Tasks capture the submitter's contextvars
+(`contextvars.copy_context`), so executor-level facilities keyed on the
+current connection — cooperative cancellation (`plan.check_cancel`),
+statement-stable `now()` — keep working on worker threads exactly as
+they do inline; the scheduling tag rides the same captured context
+(sched.CURRENT_SCHED override, else the connection's per-statement
+`_sched` pair).
 
-Determinism contract: the pool never reorders RESULTS. `map_ordered`
-returns results in submission order and raises the lowest-index failure
-after every submitted task has drained, so a cancelled/failed query can
-never leave orphan morsels behind to poison a later query.
+Determinism contract: the pool never reorders RESULTS, in either mode.
+`map_ordered` returns results in submission order and raises the
+lowest-index failure after every submitted task has drained, so a
+cancelled/failed query can never leave orphan morsels behind to poison
+a later query. Fair-share picking therefore changes WHEN morsels run,
+never what a query returns (ARCHITECTURE.md §25).
 """
 
 from __future__ import annotations
@@ -34,6 +49,8 @@ from typing import Callable, Iterable, Optional, Sequence
 from ..utils import metrics
 
 _TRACE_VAR = None
+_SCHED_VAR = None
+_CONN_VAR = None
 
 
 def _trace_var():
@@ -46,8 +63,37 @@ def _trace_var():
     return _TRACE_VAR
 
 
+def _sched_var():
+    """The sched-layer CURRENT_SCHED override contextvar (lazy for the
+    same import-order reason as _trace_var)."""
+    global _SCHED_VAR
+    if _SCHED_VAR is None:
+        from ..sched.governor import CURRENT_SCHED
+        _SCHED_VAR = CURRENT_SCHED
+    return _SCHED_VAR
+
+
+def _conn_var():
+    global _CONN_VAR
+    if _CONN_VAR is None:
+        from ..engine import CURRENT_CONNECTION
+        _CONN_VAR = CURRENT_CONNECTION
+    return _CONN_VAR
+
+
+def fair_share_enabled() -> bool:
+    """The `serene_fair_share` global, read at submit time so a toggle
+    applies to new submissions immediately (queued tasks drain from
+    whichever structure they landed in)."""
+    from ..utils.config import REGISTRY
+    try:
+        return bool(REGISTRY.get_global("serene_fair_share"))
+    except KeyError:                    # pragma: no cover — always declared
+        return False
+
+
 class _Task:
-    __slots__ = ("fn", "args", "future", "ctx", "t_submit_ns")
+    __slots__ = ("fn", "args", "future", "ctx", "t_submit_ns", "seq")
 
     def __init__(self, fn, args):
         self.fn = fn
@@ -55,6 +101,37 @@ class _Task:
         self.future: Future = Future()
         self.ctx = contextvars.copy_context()
         self.t_submit_ns = time.perf_counter_ns()
+        self.seq = 0                    # global submit order (set by pool)
+
+    def sched(self) -> Optional[tuple]:
+        """(tag, weight) scheduling identity from the captured context:
+        the explicit CURRENT_SCHED override wins, else the submitting
+        connection's per-statement `_sched` pair, else None (untagged —
+        FIFO like before)."""
+        s = self.ctx.get(_sched_var())
+        if s is not None:
+            return s
+        conn = self.ctx.get(_conn_var())
+        if conn is not None:
+            return getattr(conn, "_sched", None)
+        return None
+
+
+#: stride scale: weights are clamped to 1..10000 (serene_priority), so
+#: strides span SCALE/10000 .. SCALE with integer math throughout
+_STRIDE_SCALE = 10_000_000
+
+
+class _FairQueue:
+    """One statement's queued tasks + stride state (guarded by the
+    pool's lock)."""
+
+    __slots__ = ("tasks", "pass_", "stride")
+
+    def __init__(self, pass_: int, weight: int):
+        self.tasks: collections.deque = collections.deque()
+        self.pass_ = pass_
+        self.stride = _STRIDE_SCALE // max(1, min(10000, int(weight)))
 
 
 class WorkerPool:
@@ -69,7 +146,15 @@ class WorkerPool:
         self._threads: list[threading.Thread] = []
         self._worker_ids: set[int] = set()
         self._rr = 0
+        self._seq = 0
         self._shutdown = False
+        # fair-share state (serene_fair_share): per-statement-tag task
+        # queues + stride bookkeeping, all under the pool lock. Tags
+        # leave the dict the moment their queue drains; a returning tag
+        # re-joins at the floor (the last dispatched pass), so pausing
+        # between morsel windows accrues neither credit nor debt.
+        self._fair: dict[object, _FairQueue] = {}
+        self._fair_floor = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -100,16 +185,64 @@ class WorkerPool:
 
     def submit(self, fn: Callable, *args) -> Future:
         task = _Task(fn, args)
+        sched = task.sched() if fair_share_enabled() else None
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("worker pool is shut down")
-            self._deques[self._rr % self.size].append(task)
-            self._rr += 1
+            self._seq += 1
+            task.seq = self._seq
+            if sched is not None:
+                self._fair_push(task, sched[0], sched[1])
+            else:
+                self._deques[self._rr % self.size].append(task)
+                self._rr += 1
             metrics.POOL_QUEUE_DEPTH.add()
             self._cv.notify()
         if not self._threads:
             self.ensure_started()
         return task.future
+
+    # -- fair-share structure (all under self._lock) -----------------------
+
+    def _fair_push(self, task: _Task, tag, weight) -> None:
+        q = self._fair.get(tag)
+        if q is None:
+            # join at the current minimum pass: the newcomer's next pick
+            # competes on equal terms — no banked credit from having
+            # been absent, no debt from others' progress
+            base = min((fq.pass_ for fq in self._fair.values()),
+                       default=self._fair_floor)
+            q = self._fair[tag] = _FairQueue(base, weight)
+        q.tasks.append(task)
+
+    def _pop_fair(self) -> Optional[_Task]:
+        """Stride pick: head of the lowest-pass queue (ties broken by
+        the head task's global submit order — deterministic, and exact
+        FIFO when every weight is equal and passes tie). Counts a
+        preemption whenever the pick is NOT the FIFO-oldest queued
+        task — each one is an interleave plain FIFO would not have
+        done."""
+        if not self._fair:
+            return None
+        best = None
+        best_key = None
+        fifo_seq = None
+        for tag, q in self._fair.items():
+            head_seq = q.tasks[0].seq
+            key = (q.pass_, head_seq)
+            if best_key is None or key < best_key:
+                best_key, best = key, tag
+            if fifo_seq is None or head_seq < fifo_seq:
+                fifo_seq = head_seq
+        q = self._fair[best]
+        task = q.tasks.popleft()
+        q.pass_ += q.stride
+        self._fair_floor = q.pass_
+        if not q.tasks:
+            del self._fair[best]
+        if task.seq != fifo_seq:
+            metrics.SCHED_PREEMPTIONS.add()
+        return task
 
     def map_ordered(self, fn: Callable, items: Sequence,
                     parallelism: Optional[int] = None) -> list:
@@ -184,6 +317,12 @@ class WorkerPool:
                     task = other.pop()   # steal from the opposite end
                     metrics.POOL_STEALS.add()
                     break
+        if task is None:
+            # fair-share tier: tagged tasks live in per-statement
+            # queues picked by stride, not in the worker deques (the
+            # deques keep serving untagged/legacy submissions, and
+            # drain a mid-toggle backlog either way)
+            task = self._pop_fair()
         if task is not None:
             # the task left the queue (will run or was cancelled while
             # queued) — the live-depth gauge drops either way

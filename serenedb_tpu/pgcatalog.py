@@ -1305,6 +1305,8 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return trace_table([])
     if name == "sdb_query_progress":
         return query_progress_table()
+    if name == "sdb_admission":
+        return admission_table()
     return None
 
 
@@ -1446,6 +1448,29 @@ def query_progress_table() -> TableProvider:
         "live_bytes": [r["live_bytes"] for r in rows],
         "peak_bytes": [r["peak_bytes"] for r in rows],
         "elapsed_ms": [r["elapsed_ms"] for r in rows]})
+
+
+def admission_table() -> TableProvider:
+    """sdb_admission: the workload governor's one-row live view —
+    statements running vs queued against the configured limits plus
+    cumulative admission totals (sched/governor.py). An sdb_* relation
+    on purpose: reads of it are admission-EXEMPT, so an operator can
+    inspect a saturated governor without queueing behind it."""
+    from .sched.governor import GOVERNOR
+    s = GOVERNOR.snapshot()
+    return _typed("sdb_admission", [
+        ("running", dt.BIGINT), ("queued", dt.BIGINT),
+        ("max_concurrent_statements", dt.BIGINT),
+        ("queue_depth", dt.BIGINT), ("queued_total", dt.BIGINT),
+        ("rejected_total", dt.BIGINT), ("wait_ns_total", dt.BIGINT),
+        ("preemptions_total", dt.BIGINT)], {
+        "running": [s["running"]], "queued": [s["queued"]],
+        "max_concurrent_statements": [s["max_concurrent_statements"]],
+        "queue_depth": [s["queue_depth"]],
+        "queued_total": [s["queued_total"]],
+        "rejected_total": [s["rejected_total"]],
+        "wait_ns_total": [s["wait_ns_total"]],
+        "preemptions_total": [s["preemptions_total"]]})
 
 
 def metrics_table() -> TableProvider:

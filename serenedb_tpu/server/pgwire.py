@@ -719,7 +719,7 @@ class PgSession:
                     raise ConnectionResetError
                 # 'H'/'S' flush/sync during copy: ignore
             if failed is not None:
-                raise errors.SqlError("57014",
+                raise errors.SqlError(errors.QUERY_CANCELED,
                                       f"COPY from stdin failed: {failed}")
             data = b"".join(chunks)
             res = await loop.run_in_executor(

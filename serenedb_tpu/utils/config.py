@@ -12,6 +12,7 @@ hold sparse overrides over the global store.
 from __future__ import annotations
 
 import enum
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -22,6 +23,37 @@ class Scope(enum.Enum):
     GLOBAL = "global"     # process-wide only
 
 
+#: binary factors for PG-style memory-size literals ('64MB', '512kB');
+#: PG's guc memory units are binary too (1MB = 1024kB)
+_MEM_UNIT_FACTORS = {"b": 1, "kb": 1 << 10, "mb": 1 << 20,
+                     "gb": 1 << 30, "tb": 1 << 40}
+_MEM_RE = re.compile(r"^\s*(\d+)\s*([a-zA-Z]*)\s*$")
+
+
+def parse_memory_bytes(value: Any) -> int:
+    """PG-style memory-size parsing for byte-denominated settings
+    (`SET serene_work_mem = '64MB'`): a plain integer is BYTES (every
+    number the accounting layer reports is bytes, so the two compare
+    without a unit hop), a string may carry a B/kB/MB/GB/TB suffix
+    with binary factors. Rejects negatives (the regex) and unknown
+    units loudly."""
+    if isinstance(value, bool):
+        raise ValueError(f"invalid memory value: {value!r}")
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _MEM_RE.match(str(value))
+    if not m:
+        raise ValueError(f"invalid memory value: {value!r}")
+    n, unit = m.groups()
+    if not unit:
+        return int(n)
+    factor = _MEM_UNIT_FACTORS.get(unit.lower())
+    if factor is None:
+        raise ValueError(
+            f"invalid memory unit in {value!r} (use B, kB, MB, GB or TB)")
+    return int(n) * factor
+
+
 @dataclass
 class Setting:
     name: str
@@ -30,9 +62,14 @@ class Setting:
     scope: Scope = Scope.SESSION
     description: str = ""
     validator: Optional[Callable[[Any], Any]] = None
+    #: byte-denominated setting: coerce accepts PG-style unit strings
+    #: ('64MB') as well as plain integers (bytes)
+    memory: bool = False
 
     def coerce(self, value: Any) -> Any:
-        if self.type is bool and isinstance(value, str):
+        if self.memory:
+            value = parse_memory_bytes(value)
+        elif self.type is bool and isinstance(value, str):
             v = value.strip().lower()
             if v in ("on", "true", "1", "yes"):
                 value = True
@@ -82,9 +119,11 @@ REGISTRY = SettingsRegistry()
 
 def declare(name: str, default: Any, typ: type, description: str = "",
             scope: Scope = Scope.SESSION,
-            validator: Optional[Callable] = None) -> Setting:
+            validator: Optional[Callable] = None,
+            memory: bool = False) -> Setting:
     return REGISTRY.register(
-        Setting(name.lower(), default, typ, scope, description, validator))
+        Setting(name.lower(), default, typ, scope, description, validator,
+                memory))
 
 
 class SessionSettings:
@@ -323,6 +362,58 @@ declare("serene_shard_combine", "auto", str,
         "BIT-identical across all three values — this setting is "
         "deliberately excluded from the result cache's settings digest",
         validator=_validate_shard_combine)
+# -- workload governor (sched/governor.py) ----------------------------------
+
+declare("serene_max_concurrent_statements", 0, int,
+        "admission control (sched/governor.py): max statements EXECUTING "
+        "process-wide; further statements wait in a bounded FIFO "
+        "admission queue (pg_stat_activity state 'queued', wait event "
+        "Admission/AdmissionQueue, queue time as a queue_wait trace "
+        "span) until a running statement finishes. 0 disables admission "
+        "entirely. Utility statements (SET/SHOW/txn control) and "
+        "catalog-only introspection reads (pg_*/sdb_*/"
+        "information_schema) are exempt, so an overloaded server can "
+        "still be diagnosed. Scheduling only — results are bit-identical "
+        "at any limit", scope=Scope.GLOBAL,
+        validator=lambda v: max(0, int(v)))
+declare("serene_admission_queue_depth", 64, int,
+        "bound on the admission queue: statements arriving when "
+        "serene_max_concurrent_statements are running AND this many are "
+        "already queued are rejected immediately with SQLSTATE 53300 "
+        "(backpressure instead of an unbounded convoy)",
+        scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
+declare("serene_fair_share", True, bool,
+        "fair-share morsel scheduling (parallel/pool.py): the shared "
+        "worker pool picks queued tasks by per-statement stride "
+        "scheduling (weights from serene_priority) instead of global "
+        "FIFO, so a heavy scan's morsels INTERLEAVE with, rather than "
+        "run entirely before, every later statement's — a dashboard "
+        "query's tasks wait ~one morsel, not the heavy query's whole "
+        "backlog. Scheduling only: the deterministic merge sinks make "
+        "results bit-identical with it on or off (ARCHITECTURE.md §25)",
+        scope=Scope.GLOBAL)
+declare("serene_priority", 100, int,
+        "this session's fair-share weight (1..10000, default 100): a "
+        "statement with weight 2w is picked twice as often as one with "
+        "weight w while both have queued tasks (stride scheduling, "
+        "higher = more worker-pool share); has no effect on results, "
+        "only on scheduling order",
+        validator=lambda v: min(10000, max(1, int(v))))
+declare("serene_work_mem", 0, int,
+        "per-statement memory ceiling in BYTES (PG-style unit strings "
+        "accepted: '64MB', '1GB'); when the statement's accounted live "
+        "bytes (serene_mem_account, obs/resources.py) exceed it, the "
+        "statement aborts with SQLSTATE 53200 at the next cooperative "
+        "cancellation point — the same drain cancel and "
+        "statement_timeout use, so no partial state survives. 0 "
+        "disables; enforcement requires serene_mem_account = on",
+        memory=True, validator=lambda v: max(0, int(v)))
+declare("serene_statement_timeout_ms", 0, int,
+        "engine-level statement timeout (ms; 0 disables): combines with "
+        "the PG-compatible statement_timeout setting (the LOWER positive "
+        "value wins) and fires through the same cooperative cancellation "
+        "drain (SQLSTATE 57014), including while a statement is QUEUED "
+        "for admission", validator=lambda v: max(0, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

@@ -323,6 +323,26 @@ POOL_TASK_WAIT_NS = REGISTRY.gauge(
     "PoolTaskWaitNs",
     "cumulative ns tasks spent queued before a worker picked them up "
     "(the ns-precision sibling of PoolQueueWaitUs)")
+ADMISSION_QUEUED = REGISTRY.gauge(
+    "AdmissionQueued",
+    "statements that had to WAIT in the admission queue before "
+    "executing (cumulative; sched/governor.py)")
+ADMISSION_REJECTED = REGISTRY.gauge(
+    "AdmissionRejected",
+    "statements rejected with SQLSTATE 53300 because the admission "
+    "queue was already serene_admission_queue_depth deep")
+ADMISSION_WAIT_NS = REGISTRY.gauge(
+    "AdmissionWaitNs",
+    "cumulative ns statements spent queued for admission before "
+    "starting (the statement-level sibling of PoolTaskWaitNs)")
+ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
+    "AdmissionQueueDepth",
+    "statements currently waiting in the admission queue (live)")
+SCHED_PREEMPTIONS = REGISTRY.gauge(
+    "SchedPreemptions",
+    "fair-share pool picks that ran a later-submitted statement's task "
+    "ahead of the FIFO-oldest queued task (each one is an interleave "
+    "plain FIFO would not have done; serene_fair_share)")
 TRACES_RECORDED = REGISTRY.gauge(
     "TracesRecorded",
     "query timelines finalized into the flight recorder since start")

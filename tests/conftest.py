@@ -142,6 +142,25 @@ if os.environ.get("SERENE_MEM_ACCOUNT"):
                            os.environ["SERENE_MEM_ACCOUNT"])
 
 
+# scripts/verify_tier1.sh workload-governor parity leg: arm the
+# admission gate suite-wide (e.g. "8" — every non-exempt statement then
+# takes/queues for a governor slot), a generous global serene_work_mem
+# ceiling (e.g. "2GB" — the budget check runs against every accounted
+# statement without ever firing) and/or fair-share picking, proving the
+# governor steers scheduling only: the admission/parallel/shard/
+# resources suites must stay bit-identical with it armed.
+_GOVERNOR_ENV_HOOKS = {
+    "SERENE_MAX_CONCURRENT_STATEMENTS": "serene_max_concurrent_statements",
+    "SERENE_WORK_MEM": "serene_work_mem",
+    "SERENE_FAIR_SHARE": "serene_fair_share",
+}
+for _env, _setting in _GOVERNOR_ENV_HOOKS.items():
+    if os.environ.get(_env):
+        from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_GOV
+
+        _SDB_REG_GOV.set_global(_setting, os.environ[_env])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

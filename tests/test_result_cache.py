@@ -425,14 +425,19 @@ def test_streaming_path_hits_and_stores():
 
 def test_sweep_reclaims_superseded_generations():
     db, c = _mk(n=100)
-    q = "SELECT count(*) FROM t"
+    # a table name unique to THIS test: the process-wide cache may
+    # still hold entries for other suites' tables named `t` whose
+    # normalized text would collide with the label counted below
+    c.execute("CREATE TABLE sweep_gen_t (k INT)")
+    c.execute("INSERT INTO sweep_gen_t VALUES (7)")
+    q = "SELECT count(*) FROM sweep_gen_t"
     c.execute(q)
-    c.execute("INSERT INTO t VALUES (1, 1, 'x')")
+    c.execute("INSERT INTO sweep_gen_t VALUES (1)")
     c.execute(q)
     # two generations of the same statement live until the lazy sweep
     assert RESULT_CACHE.sweep() >= 1
     labels = [e["query"] for e in RESULT_CACHE.snapshot()]
-    assert labels.count("select count ( * ) from t") == 1
+    assert labels.count("select count ( * ) from sweep_gen_t") == 1
 
 
 def test_prometheus_and_stats_export_cache_sections():
