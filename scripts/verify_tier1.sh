@@ -160,6 +160,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc12=$?
 
+# Pass 13 is the device-telemetry parity leg: telemetry is forced ON
+# with the compiled-program LRU capped at 4 entries (the conftest env
+# hooks arm both globals) over the device-observability, device,
+# multichip, shard and trace suites — the tiny cap exercises program
+# eviction + re-compile on practically every suite query, proving the
+# bounded compile ledger changes WHEN programs compile, never a result
+# bit, while the telemetry ledgers record suite-wide.
+echo "== device telemetry parity pass (telemetry on, program cache capped at 4) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_DEVICE_TELEMETRY=on \
+    SERENE_PROGRAM_CACHE_ENTRIES=4 \
+    python -m pytest tests/test_device_obs.py tests/test_device_pipeline.py \
+    tests/test_device_agg.py tests/test_multichip.py \
+    tests/test_shard_exec.py tests/test_trace.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc13=$?
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -171,4 +187,5 @@ rc12=$?
 [ "$rc9" -ne 0 ] && exit "$rc9"
 [ "$rc10" -ne 0 ] && exit "$rc10"
 [ "$rc11" -ne 0 ] && exit "$rc11"
-exit "$rc12"
+[ "$rc12" -ne 0 ] && exit "$rc12"
+exit "$rc13"

@@ -94,6 +94,12 @@ assert "serene_mem_account" not in RESULT_AFFECTING_SETTINGS
 # guarantee bit-identity), and the budget/timeout settings produce
 # ERRORS, not results — an aborted statement stores nothing, so no
 # cached entry can ever encode a budget's effect
+# device telemetry observes too (obs/device.py): the compile ledger /
+# transfer accounting never change which program runs, and the bounded
+# program LRU can only cause a re-compile of the SAME program — results
+# are bit-identical with telemetry on or off at any cache cap
+assert "serene_device_telemetry" not in RESULT_AFFECTING_SETTINGS
+assert "serene_program_cache_entries" not in RESULT_AFFECTING_SETTINGS
 assert "serene_max_concurrent_statements" not in RESULT_AFFECTING_SETTINGS
 assert "serene_admission_queue_depth" not in RESULT_AFFECTING_SETTINGS
 assert "serene_fair_share" not in RESULT_AFFECTING_SETTINGS

@@ -160,11 +160,22 @@ def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColum
     padded[:n] = arr
     mask = np.zeros(n_pad, dtype=bool)
     mask[:n] = col.valid_mask()
+    import time as _time
+
+    from ..obs import device as _obsdev
+    t0 = _time.perf_counter_ns() if _obsdev.enabled() else 0
     data2d = jnp.asarray(padded.reshape(-1, LANES), dtype=dev_dt)
     mask2d = jnp.asarray(mask.reshape(-1, LANES))
-    # every device path funnels through this upload — note that the
-    # backend is up so serene_shard_combine=auto's PASSIVE device-count
-    # probe (parallel/mesh.py) works even across jax-internal drift
+    if t0:
+        # every device path funnels through this upload: per-device
+        # transfer byte/time attribution happens exactly once, here
+        _obsdev.note_upload(
+            int(data2d.size * data2d.dtype.itemsize) + int(mask2d.size),
+            _obsdev.array_device_ids(data2d),
+            _time.perf_counter_ns() - t0)
+    # note that the backend is up so serene_shard_combine=auto's PASSIVE
+    # device-count probe (parallel/mesh.py) works even across
+    # jax-internal drift
     from ..parallel import mesh as _mesh
     _mesh.note_backend_initialized()
     return DeviceColumn(col.type, data2d, mask2d, n, scheme, offset)

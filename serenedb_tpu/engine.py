@@ -607,6 +607,15 @@ class Database(TableResolver):
         if name == "sdb_admission":
             from .pgcatalog import admission_table
             return admission_table()
+        if name == "sdb_device":
+            from .pgcatalog import device_table
+            return device_table()
+        if name == "sdb_programs":
+            from .pgcatalog import programs_table
+            return programs_table()
+        if name == "sdb_device_cache":
+            from .pgcatalog import device_cache_table
+            return device_cache_table()
         raise errors.SqlError(errors.UNDEFINED_FUNCTION,
                               f"table function {name} does not exist")
 

@@ -14,15 +14,13 @@ device, matching the CPU oracle in sql/expr.py.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import DeviceColumn
-from ..sql.binder import _expr_key
 from ..sql.expr import (BoundColumn, BoundExpr, BoundFunc, BoundLiteral)
 
 _NUMERIC_IDS = {dt.TypeId.BOOL, dt.TypeId.TINYINT, dt.TypeId.SMALLINT,
@@ -254,20 +252,9 @@ def _unify(va, vb):
     return va, vb
 
 
-# -- jitted program cache --------------------------------------------------
-
-_PROGRAM_CACHE: dict = {}
-
-
-def cached_jit(key: tuple, builder: Callable):
-    """Per-(provider, query-shape) jit cache so repeated queries reuse the
-    compiled XLA program (first TPU compile is ~seconds; steady-state is the
-    benchmark regime)."""
-    prog = _PROGRAM_CACHE.get(key)
-    if prog is None:
-        prog = _PROGRAM_CACHE[key] = jax.jit(builder)
-    return prog
-
-
-def expr_cache_key(provider, expr: Optional[BoundExpr]) -> tuple:
-    return (id(provider), _expr_key(expr) if expr is not None else "<none>")
+# The per-(provider, query-shape) jitted program cache that used to
+# live here (an unbounded module dict — one leaked executable per novel
+# query shape for process lifetime) is now the obs/device.py compile
+# ledger: a BOUNDED LRU (serene_program_cache_entries) with per-family
+# compile/hit/miss accounting. Call sites go through
+# obs.device.compiled(family, key, builder).

@@ -312,17 +312,19 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
            tuple(_expr_key(g) for g in node.group_exprs),
            tuple((s.func, s.distinct, _expr_key(s.arg))
                  for s in node.aggs), mesh_n, zrange)
-    from .device import _PROGRAM_CACHE
-    jitted = _PROGRAM_CACHE.get(key)
-    if jitted is None:
+    from ..obs import device as obs_device
+
+    def build():
         if mesh_n > 1:
             combines = _out_combines(node, agg_plans, group_mode)
-            jitted = _mesh_wrap(program, mesh_n, combines,
-                                n_inputs=2 * len(needed) +
-                                (1 if fact is not None else 0) + 1)
-        else:
-            jitted = jax.jit(program)
-        _PROGRAM_CACHE[key] = jitted
+            return _mesh_wrap(program, mesh_n, combines,
+                              n_inputs=2 * len(needed) +
+                              (1 if fact is not None else 0) + 1)
+        return program
+
+    jitted = obs_device.compiled("device_agg", key, build,
+                                 profile=getattr(ctx, "profile", None),
+                                 node_key=id(node))
 
     flat_args = []
     for i in needed:
@@ -365,7 +367,7 @@ def _run(node, scan, provider: TableProvider, preds: list[BoundExpr], ctx) -> Ba
         results = _chunked_dispatch(jitted, flat_args, rowmask_arr,
                                     chunk_tiles, combines, mesh_n)
     else:
-        results = jitted(*flat_args, rowmask_arr)
+        results = obs_device.fetch_all(jitted(*flat_args, rowmask_arr))
 
     if group_mode:
         return _build_group_batch(node, key_plans, agg_plans, results,
@@ -512,6 +514,8 @@ def _chunked_dispatch(jitted, flat_args, rowmask_arr, chunk_tiles: int,
     chunks share one compiled shape (the tail pads with empty rows)."""
     from .plan import check_cancel
     import jax.numpy as jnp
+
+    from ..obs import device as obs_device
     n_tiles = int(rowmask_arr.shape[0])
     acc = None
     for start in range(0, n_tiles, chunk_tiles):
@@ -526,8 +530,8 @@ def _chunked_dispatch(jitted, flat_args, rowmask_arr, chunk_tiles: int,
                 part = jnp.pad(part, widths)
             return part
 
-        outs = jitted(*[cut(a) for a in flat_args], cut(rowmask_arr))
-        outs = [np.asarray(o) for o in outs]
+        outs = obs_device.fetch_all(
+            jitted(*[cut(a) for a in flat_args], cut(rowmask_arr)))
         def widen(o, c):
             if c != "sum":
                 return o
@@ -556,7 +560,8 @@ def _mesh_wrap(program, mesh_n: int, combines: list, n_inputs: int):
     mesh: row-block inputs shard on the leading axis, reductions merge
     with psum/pmin/pmax over ICI, per-row partial outputs stay sharded
     (reference analog: morsel-parallel pipelines re-expressed as XLA
-    collectives — SURVEY.md §2.11/§5.7)."""
+    collectives — SURVEY.md §2.11/§5.7). Returns the un-jitted wrapped
+    callable — the obs/device compile ledger owns the jit."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -569,8 +574,8 @@ def _mesh_wrap(program, mesh_n: int, combines: list, n_inputs: int):
     in_specs = tuple(P(AXIS, None) for _ in range(n_inputs))
     out_specs = tuple(P() if c in ("sum", "min", "max")
                       else P(AXIS, None) for c in combines)
-    return jax.jit(shard_map(core, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs))
+    return shard_map(core, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)
 
 
 def _plan_direct_keys(node, scan, host_col, col_names, dictionaries):

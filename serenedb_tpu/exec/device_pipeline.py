@@ -55,11 +55,12 @@ from ..columnar.column import Batch, Column
 from ..columnar.device import (DeviceColumn, DeviceNarrowingError, LANES,
                                pad_len, to_device_column)
 from ..ops import agg as ops_agg
+from ..obs import device as obs_device
 from ..sql.binder import _expr_key
 from ..sql.expr import AggSpec, BoundColumn, BoundExpr, BoundFunc
 from ..utils import log, metrics
 from ..utils.config import REGISTRY as _settings_registry
-from .device import DeviceExpr, NotCompilable, compile_expr, _PROGRAM_CACHE
+from .device import DeviceExpr, NotCompilable, compile_expr
 from .device_agg import MAX_GROUP_PRODUCT, MAX_INT_KEY_RANGE
 
 #: combined join-key code-space cap (dense per-code arrays live in HBM)
@@ -129,7 +130,8 @@ class DeviceColumnCache:
     never holds two versions of one column."""
 
     def __init__(self):
-        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        #: key -> [value, nbytes, device ids, hits, last-touch epoch s]
+        self._entries: OrderedDict[tuple, list] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
 
@@ -147,6 +149,8 @@ class DeviceColumnCache:
                 metrics.DEVICE_CACHE_MISSES.add()
                 return None
             self._entries.move_to_end(key)
+            entry[3] += 1
+            entry[4] = time.time()
             metrics.DEVICE_CACHE_HITS.add()
             return entry[0]
 
@@ -155,6 +159,8 @@ class DeviceColumnCache:
         caller mark extra keys as superseded (e.g. code tiles whose
         staleness comes from the PARTNER table's publication, which the
         owner-generation rule below cannot see)."""
+        dev_ids = obs_device.value_device_ids(value) \
+            if obs_device.enabled() else ()
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -169,12 +175,12 @@ class DeviceColumnCache:
             for k in stale:
                 self._bytes -= self._entries.pop(k)[1]
                 metrics.DEVICE_CACHE_EVICTIONS.add()
-            self._entries[key] = (value, nbytes)
+            self._entries[key] = [value, nbytes, dev_ids, 0, time.time()]
             self._bytes += nbytes
             cap = self._cap_bytes()
             while self._bytes > cap and len(self._entries) > 1:
-                _, (_, nb) = self._entries.popitem(last=False)
-                self._bytes -= nb
+                _, e = self._entries.popitem(last=False)
+                self._bytes -= e[1]
                 metrics.DEVICE_CACHE_EVICTIONS.add()
             metrics.DEVICE_CACHE_BYTES.set(self._bytes)
 
@@ -184,6 +190,45 @@ class DeviceColumnCache:
             self._bytes = 0
             metrics.DEVICE_CACHE_BYTES.set(0)
 
+    # -- telemetry surfaces (obs/device.py) ---------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "cap_bytes": self._cap_bytes()}
+
+    def device_bytes(self) -> dict[int, int]:
+        """HBM occupancy estimate per device id: each entry's bytes
+        split across the devices holding it (mesh-sharded commits land
+        on several). Entries stored with telemetry off carry no
+        placement and attribute to the default device 0."""
+        out: dict[int, int] = {}
+        with self._lock:
+            for e in self._entries.values():
+                ids = e[2] or (0,)
+                share = len(ids)
+                for i in ids:
+                    out[i] = out.get(i, 0) + e[1] // share
+        return out
+
+    def snapshot(self) -> list[dict]:
+        """One row per live entry — the sdb_device_cache() body: which
+        publication/column occupies HBM, how big, on which devices, how
+        recently touched."""
+        now = time.time()
+        with self._lock:
+            rows = []
+            for (pub, name, kind, tag), e in self._entries.items():
+                rows.append({
+                    "token": pub[0], "data_version": pub[1],
+                    "mutation_epoch": pub[2], "column": name,
+                    "kind": kind, "tag": repr(tag)[:120],
+                    "bytes": e[1],
+                    "devices": ",".join(str(i) for i in e[2]),
+                    "hits": e[3],
+                    "idle_ms": round((now - e[4]) * 1e3, 1)})
+        return rows
+
     # -- typed helpers ------------------------------------------------------
 
     def column(self, provider, pub: tuple, name: str, host_col_fn,
@@ -191,6 +236,7 @@ class DeviceColumnCache:
         """Device tiles of one column (optionally row-sliced), cached by
         (publication, column, range). host_col_fn() materializes the host
         column only on miss."""
+        obs_device.note_provider(pub[0], getattr(provider, "name", ""))
         key = (pub, name, "col", zrange)
         dc = self.get(key)
         if dc is not None:
@@ -198,7 +244,7 @@ class DeviceColumnCache:
         col = host_col_fn()
         if zrange is not None:
             col = col.slice(zrange[0], zrange[1])
-        dc = to_device_column(col)
+        dc = to_device_column(col)      # upload accounted at the funnel
         nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
             int(dc.mask.size)
         metrics.DEVICE_BYTES.add(nbytes)
@@ -216,11 +262,14 @@ class DeviceColumnCache:
         arr = self.get(key)
         if arr is not None:
             return arr
+        t0 = time.perf_counter_ns()
         arr = build_fn()
         if device is not None:
             arr = jax.device_put(arr, device)
         nbytes = int(arr.size * arr.dtype.itemsize)
         metrics.DEVICE_BYTES.add(nbytes)
+        obs_device.note_upload(nbytes, obs_device.array_device_ids(arr),
+                               time.perf_counter_ns() - t0)
         _charge_upload(nbytes)
         self.put(key, arr, nbytes, sweep=sweep)
         return arr
@@ -234,9 +283,12 @@ class DeviceColumnCache:
         val = self.get(key)
         if val is not None:
             return val
+        t0 = time.perf_counter_ns()
         val = tuple(build_fn())
         nbytes = sum(int(a.size * a.dtype.itemsize) for a in val)
         metrics.DEVICE_BYTES.add(nbytes)
+        obs_device.note_upload(nbytes, obs_device.value_device_ids(val),
+                               time.perf_counter_ns() - t0)
         _charge_upload(nbytes)
         self.put(key, val, nbytes, sweep=sweep)
         return val
@@ -247,6 +299,7 @@ class DeviceColumnCache:
         (round-robin block set — exec/shard.py's partitioning), cached
         by (publication, column, shard spans). The host concat runs only
         on miss; `device` pins the upload to the shard's mesh device."""
+        obs_device.note_provider(pub[0], getattr(provider, "name", ""))
         key = (pub, name, "col", ("shard", shard_tag, tuple(spans)))
         dc = self.get(key)
         if dc is not None:
@@ -254,9 +307,20 @@ class DeviceColumnCache:
         from .shard import _concat_spans
         dc = to_device_column(_concat_spans(host_col_fn(), spans))
         if device is not None:
+            # the funnel above attributed the upload to the default
+            # device; the pin to the shard's mesh device is a SECOND
+            # transfer — account it against the device the tiles
+            # actually land on, so sdb_device()'s per-device rows stay
+            # consistent with where hbm_bytes_est places the entry
+            t0 = time.perf_counter_ns()
             dc = DeviceColumn(dc.type, jax.device_put(dc.data, device),
                               jax.device_put(dc.mask, device), dc.length,
                               dc.scheme, dc.offset, dc.wide)
+            obs_device.note_upload(
+                int(dc.data.size * dc.data.dtype.itemsize) +
+                int(dc.mask.size),
+                obs_device.array_device_ids(dc.data),
+                time.perf_counter_ns() - t0)
         nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
             int(dc.mask.size)
         metrics.DEVICE_BYTES.add(nbytes)
@@ -781,10 +845,8 @@ def _run_fused(node, join, probe_side, build_side,
     # key plans, code space — are closed over, so versions must key)
     cache_key = ("fused", probe.pub, build.pub, probe.zrange,
                  build.zrange, keyset) + shape_sig
-    jitted = _PROGRAM_CACHE.get(cache_key)
-    if jitted is None:
-        jitted = jax.jit(program)
-        _PROGRAM_CACHE[cache_key] = jitted
+    jitted = obs_device.compiled("fused", cache_key, lambda: program,
+                                 profile=prof, node_key=id(node))
 
     flat_args = []
     for ji in needed:
@@ -796,7 +858,7 @@ def _run_fused(node, join, probe_side, build_side,
     check_cancel()
     t0 = clock()
     metrics.DEVICE_OFFLOADS.add()
-    results = jitted(*flat_args)
+    results = obs_device.fetch_all(jitted(*flat_args))
     out = _finalize(node, key_plans, agg_plans, results, probe, pscan,
                     dictionaries, group_space, group_mode, sum_modes)
     if prof is not None:
@@ -1192,9 +1254,6 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
         decode_b = [(env_b[i].scheme, env_b[i].offset) for i in needed_b]
         bkey = ("fshardb", probe.pub, build.pub, build.zrange,
                 keyset) + shape_sig
-        jitted_b = _PROGRAM_CACHE.get(bkey)
-        if jitted_b is not None:
-            return jitted_b
 
         def build_program(*flat):
             arrays = {}
@@ -1234,9 +1293,9 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
             bacc = bacc.at[g].set(0).at[g + 1].set(0)
             return (bacc, *bmm_out)
 
-        jitted_b = jax.jit(build_program)
-        _PROGRAM_CACHE[bkey] = jitted_b
-        return jitted_b
+        return obs_device.compiled("fused_build", bkey,
+                                   lambda: build_program,
+                                   profile=prof, node_key=id(node))
 
     # -- probe phase: one dispatch per shard, pinned across the mesh ------
     devs = mesh_mod.shard_devices(n_shards)
@@ -1303,30 +1362,30 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
         decode_p = [(env_p[i].scheme, env_p[i].offset) for i in needed_p]
         pkey = ("fshardp", probe.pub, build.pub, spans_t, stag,
                 keyset) + shape_sig
-        jitted_p = _PROGRAM_CACHE.get(pkey)
-        if jitted_p is None:
-            def probe_program(*flat):
-                arrays = {}
-                for k2, ji in enumerate(needed_p):
-                    data = flat[2 * k2]
-                    scheme, off = decode_p[k2]
-                    if scheme != "raw":
-                        data = data.astype(jnp.int32) + jnp.int32(off)
-                    arrays[ji] = (data, flat[2 * k2 + 1])
-                base = 2 * len(needed_p)
-                pcodes, pmask = flat[base], flat[base + 1]
-                bacc = flat[base + 2]
-                bmm = {si: flat[base + 3 + j]
-                       for j, si in enumerate(bmm_sis)}
-                # ONE probe-phase body shared with the single-dispatch
-                # program — the bit-identity contract lives in one place
-                return _probe_phase(arrays, pcodes, pmask, bacc, bmm,
-                                    preds_probe, key_plans, group_mode,
-                                    group_space, agg_plans, sum_modes,
-                                    bstart, g)
 
-            jitted_p = jax.jit(probe_program)
-            _PROGRAM_CACHE[pkey] = jitted_p
+        def probe_program(*flat):
+            arrays = {}
+            for k2, ji in enumerate(needed_p):
+                data = flat[2 * k2]
+                scheme, off = decode_p[k2]
+                if scheme != "raw":
+                    data = data.astype(jnp.int32) + jnp.int32(off)
+                arrays[ji] = (data, flat[2 * k2 + 1])
+            base = 2 * len(needed_p)
+            pcodes, pmask = flat[base], flat[base + 1]
+            bacc = flat[base + 2]
+            bmm = {si: flat[base + 3 + j]
+                   for j, si in enumerate(bmm_sis)}
+            # ONE probe-phase body shared with the single-dispatch
+            # program — the bit-identity contract lives in one place
+            return _probe_phase(arrays, pcodes, pmask, bacc, bmm,
+                                preds_probe, key_plans, group_mode,
+                                group_space, agg_plans, sum_modes,
+                                bstart, g)
+
+        jitted_p = obs_device.compiled("fused_probe", pkey,
+                                       lambda: probe_program,
+                                       profile=prof, node_key=id(node))
 
         # cache the committed build outputs per PHYSICAL device (two
         # shards mapped onto one device share a single copy)
@@ -1341,7 +1400,7 @@ def _run_fused_sharded(node, join, probe: _Side, build: _Side, pscan,
         metrics.DEVICE_OFFLOADS.add()
         tspan("device_upload", t_up, shard=s)
         t_d = time.perf_counter_ns()
-        outs = [np.asarray(o) for o in jitted_p(*flat)]
+        outs = obs_device.fetch_all(jitted_p(*flat))
         metrics.DEVICE_DISPATCH_HIST.observe_ns(
             time.perf_counter_ns() - t_d)
         tspan("device_dispatch", t_d, shard=s)
@@ -1526,8 +1585,8 @@ def _run_fused_collective(node, probe: _Side, build: _Side, pscan,
     # one compiled executable (spans_sig keys only the DATA caches)
     cache_key = ("fcollective", probe.pub, build.pub,
                  t_slice, M, keyset) + shape_sig
-    jitted = _PROGRAM_CACHE.get(cache_key)
-    if jitted is None:
+
+    def build_collective():
         def collective(*flat):
             # local probe slice: (1, t_slice, L) tiles → one row block
             # (the mesh slice is just a row subset; the group scatter
@@ -1562,10 +1621,13 @@ def _run_fused_collective(node, probe: _Side, build: _Side, pscan,
         # check_rep off: replication of the post-psum outputs holds by
         # construction but the checker can't infer it through the
         # scatter/gather bodies
-        jitted = jax.jit(shard_map(
+        return shard_map(
             collective, mesh=mesh, in_specs=in_specs,
-            out_specs=out_specs, check_rep=False))
-        _PROGRAM_CACHE[cache_key] = jitted
+            out_specs=out_specs, check_rep=False)
+
+    jitted = obs_device.compiled("fused_collective", cache_key,
+                                 build_collective, profile=prof,
+                                 node_key=id(node))
 
     flat_args: list = []
     for ji in needed_p:
@@ -1581,7 +1643,7 @@ def _run_fused_collective(node, probe: _Side, build: _Side, pscan,
     metrics.SHARD_PIPELINES.add(S)
     from ..obs.resources import wait_scope
     with wait_scope("Device", "CollectiveCombine"):
-        results = [np.asarray(o) for o in jitted(*flat_args)]
+        results = obs_device.fetch_all(jitted(*flat_args))
     dt = time.perf_counter_ns() - t_d
     metrics.COLLECTIVE_COMBINE_NS.add(dt)
     metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)
@@ -2015,36 +2077,37 @@ def _run_fused_topn(limit_node, scan, preds, ki: int, desc: bool, k: int,
 
     cache_key = ("fusedtopn", side.pub, side.zrange, name, desc, k,
                  tuple(_expr_key(p) for p in preds))
-    jitted = _PROGRAM_CACHE.get(cache_key)
-    if jitted is None:
-        def program(*flat):
-            arrays = {}
-            for j, i in enumerate(needed):
-                data = flat[2 * j]
-                scheme, off = decode_specs[j]
-                if scheme != "raw":
-                    data = data.astype(jnp.int32) + jnp.int32(off)
-                arrays[i] = (data, flat[2 * j + 1])
-            mask = flat[-1]
-            for ce in compiled:
-                v, ok = ce.fn([arrays[i] for i in ce.inputs])
-                b = v if v.dtype == jnp.bool_ else (v != 0)
-                mask = jnp.logical_and(mask, jnp.logical_and(b, ok))
-            v = arrays[needed[kpos]][0]
-            if is_float:
-                keys = v if desc else -v
-                sent = jnp.float32(-jnp.inf)
-            else:
-                v = v.astype(jnp.int32)
-                keys = v if desc else ~v
-                sent = jnp.int32(_I32_MIN)
-            keys = jnp.where(mask.ravel(), keys.ravel(), sent)
-            kk, ii = jax.lax.top_k(keys, k)
-            return kk, ii.astype(jnp.int32), \
-                jnp.sum(mask, dtype=jnp.int32)
 
-        jitted = jax.jit(program)
-        _PROGRAM_CACHE[cache_key] = jitted
+    def program(*flat):
+        arrays = {}
+        for j, i in enumerate(needed):
+            data = flat[2 * j]
+            scheme, off = decode_specs[j]
+            if scheme != "raw":
+                data = data.astype(jnp.int32) + jnp.int32(off)
+            arrays[i] = (data, flat[2 * j + 1])
+        mask = flat[-1]
+        for ce in compiled:
+            v, ok = ce.fn([arrays[i] for i in ce.inputs])
+            b = v if v.dtype == jnp.bool_ else (v != 0)
+            mask = jnp.logical_and(mask, jnp.logical_and(b, ok))
+        v = arrays[needed[kpos]][0]
+        if is_float:
+            keys = v if desc else -v
+            sent = jnp.float32(-jnp.inf)
+        else:
+            v = v.astype(jnp.int32)
+            keys = v if desc else ~v
+            sent = jnp.int32(_I32_MIN)
+        keys = jnp.where(mask.ravel(), keys.ravel(), sent)
+        kk, ii = jax.lax.top_k(keys, k)
+        return kk, ii.astype(jnp.int32), \
+            jnp.sum(mask, dtype=jnp.int32)
+
+    jitted = obs_device.compiled("fused_topn", cache_key,
+                                 lambda: program,
+                                 profile=getattr(ctx, "profile", None),
+                                 node_key=id(limit_node))
 
     flat_args = []
     for i in needed:
@@ -2053,9 +2116,9 @@ def _run_fused_topn(limit_node, scan, preds, ki: int, desc: bool, k: int,
     flat_args.append(rowmask)
     check_cancel()
     metrics.DEVICE_OFFLOADS.add()
-    kk, ii, nsurv = jitted(*flat_args)
-    idx = np.asarray(ii).astype(np.int64)
-    k_eff = min(k, int(np.asarray(nsurv)))
+    kk, ii, nsurv = obs_device.fetch_all(jitted(*flat_args))
+    idx = ii.astype(np.int64)
+    k_eff = min(k, int(nsurv))
     idx = idx[:k_eff]
     if side.zrange is not None:
         idx = idx + side.zrange[0]

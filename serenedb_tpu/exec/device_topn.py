@@ -81,7 +81,8 @@ def try_device_topn(limit_node, ctx) -> Optional[Batch]:
         import time as _time
         t0 = _time.perf_counter_ns()
         idx = _topn_indices(provider, scan, scan.columns[col_idx],
-                            bool(sort.descs[0]), k, ctx)
+                            bool(sort.descs[0]), k, ctx,
+                            prof_key=id(limit_node))
         t1 = _time.perf_counter_ns()
         if prof is not None:
             # device-path time lands on the Limit node that claimed the
@@ -108,7 +109,8 @@ def try_device_topn(limit_node, ctx) -> Optional[Batch]:
 
 
 def _topn_indices(provider: TableProvider, scan, col_name: str,
-                  desc: bool, k: int, ctx) -> Optional[np.ndarray]:
+                  desc: bool, k: int, ctx,
+                  prof_key=None) -> Optional[np.ndarray]:
     import jax
     import jax.numpy as jnp
 
@@ -150,12 +152,10 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
     zrange = zonemap.topn_block_range(provider, ctx.settings, col_name,
                                       block_rows, desc, k, pin)
 
-    from .device import _PROGRAM_CACHE
     # the range keys the program: a sliced upload's frame-of-reference
     # scheme can differ from the whole column's
     cache_key = ("topn", id(provider), dev_ver, col_name, desc, k, mesh_n,
                  zrange)
-    jitted = _PROGRAM_CACHE.get(cache_key)
     if zrange is None:
         dc = provider.device_columns([col_name], pin)[col_name]
     else:
@@ -164,7 +164,7 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
                                    zrange)[col_name]
     is_float = dc.data.dtype.kind == "f"
 
-    if jitted is None:
+    def build():
         scheme, offset = dc.scheme, dc.offset
 
         def keys_of(data, mask):
@@ -195,17 +195,21 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
                     jnp.int32(shard_rows)
                 return kk, ii.astype(jnp.int32) + base
 
-            jitted = jax.jit(shard_map(
+            return shard_map(
                 core, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
-                out_specs=(P(AXIS), P(AXIS))))
-        else:
-            def prog(data, mask):
-                keys = keys_of(data, mask)
-                kk, ii = jax.lax.top_k(keys, k)
-                return kk, ii.astype(jnp.int32)
+                out_specs=(P(AXIS), P(AXIS)))
 
-            jitted = jax.jit(prog)
-        _PROGRAM_CACHE[cache_key] = jitted
+        def prog(data, mask):
+            keys = keys_of(data, mask)
+            kk, ii = jax.lax.top_k(keys, k)
+            return kk, ii.astype(jnp.int32)
+
+        return prog
+
+    from ..obs import device as obs_device
+    jitted = obs_device.compiled("device_topn", cache_key, build,
+                                 profile=getattr(ctx, "profile", None),
+                                 node_key=prof_key)
 
     data, mask = dc.data, dc.mask
     if mesh_n > 1:
@@ -215,9 +219,8 @@ def _topn_indices(provider: TableProvider, scan, col_name: str,
     if data.shape[0] * data.shape[1] < k * max(mesh_n, 1):
         # top_k k exceeds the (per-shard) domain — tiny table, CPU wins
         raise NotCompilable("k exceeds per-shard rows")
-    kk, ii = jitted(data, mask)
-    kk = np.asarray(kk)
-    ii = np.asarray(ii).astype(np.int64)
+    kk, ii = obs_device.fetch_all(jitted(data, mask))
+    ii = ii.astype(np.int64)
     if mesh_n > 1:
         # merge the per-shard candidate lists: global top-k of N*k.
         # Candidates from under-filled shards carry the padding sentinel

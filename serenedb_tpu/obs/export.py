@@ -96,6 +96,7 @@ def stats_json() -> dict:
     from ..cache.fragments import FRAGMENTS
     from ..cache.result import RESULT_CACHE
     from ..sched.governor import GOVERNOR
+    from . import device as _device
     from .resources import ACTIVE, read_rss_bytes, sample_process_gauges
     from .trace import FLIGHT, flight_summary
     sample_process_gauges()
@@ -104,6 +105,9 @@ def stats_json() -> dict:
             # workload governor: live running/queued counts + limits +
             # cumulative admission totals (sched/governor.py)
             "admission": GOVERNOR.snapshot(),
+            # device telemetry: per-device dispatch/transfer/HBM rows,
+            # the compile ledger, cache summaries (obs/device.py)
+            "device": _device.stats_section(),
             "latency": {h.name: h.percentiles_ms()
                         for h in _metrics.REGISTRY.all_histograms()
                         if h.unit == "s"},

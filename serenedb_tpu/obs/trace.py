@@ -33,7 +33,8 @@ _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
              "loops", "morsels_scheduled", "morsels_pruned",
              "morsels_jf_pruned", "device_ns", "batch_queries",
              "batch_window_ns", "batch_scoring_ns", "shard_pipelines",
-             "shard_pruned", "shard_collective")
+             "shard_pruned", "shard_collective",
+             "device_prog_hits", "device_prog_misses")
 
 
 class OpStats:
@@ -495,7 +496,15 @@ def annotate_plan(plan, profile: QueryProfile, mem=None) -> list[str]:
                     f"{detail}Morsels: scheduled={s.morsels_scheduled} "
                     f"zonemap_pruned={s.morsels_pruned}{jf}")
             if s.device_ns:
-                lines.append(f"{detail}Device: time={_ms(s.device_ns)} ms")
+                comp = ""
+                if s.device_prog_hits or s.device_prog_misses:
+                    # any miss means this execution paid (at least one)
+                    # XLA compile; all-hit means every program came
+                    # from the ledger warm (obs/device.py)
+                    comp = " compile=" + \
+                        ("miss" if s.device_prog_misses else "hit")
+                lines.append(
+                    f"{detail}Device: time={_ms(s.device_ns)} ms{comp}")
             if s.batch_queries:
                 lines.append(
                     f"{detail}Batch: queries={s.batch_queries} "
@@ -553,6 +562,9 @@ def annotate_plan_json(plan, profile: Optional[QueryProfile],
                             s.morsels_jf_pruned
                 if s.device_ns:
                     out["Device Time"] = round(s.device_ns / 1e6, 3)
+                    if s.device_prog_hits or s.device_prog_misses:
+                        out["Device Compile"] = \
+                            "miss" if s.device_prog_misses else "hit"
                 if s.batch_queries:
                     out["Batch Queries"] = s.batch_queries
                     out["Batch Window Time"] = \

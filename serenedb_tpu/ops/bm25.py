@@ -725,38 +725,46 @@ def _score_topk(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
     return vals, docs
 
 
-@functools.lru_cache(maxsize=32)
 def _mesh_score_fn(mesh_n: int, ndocs_pad: int, k: int, n_queries: int,
                    scorer: str, k1: float, b: float):
-    """Mesh-sharded scoring program (cached per shape): posting-row
-    sections shard across devices, each shard accumulates its slice with
-    the SAME kernel as the single-device path, score planes psum over
-    ICI, one top-k on the merged plane (reference analog: parallel
-    per-segment top-k collectors, SURVEY.md §2.11 — re-expressed as XLA
-    collectives; see also parallel/mesh.py)."""
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+    """Mesh-sharded scoring program (cached per shape in the obs/device
+    compile ledger — no local memo, so the bounded program LRU really
+    owns these executables): posting-row sections shard across devices,
+    each shard accumulates its slice with the SAME kernel as the
+    single-device path, score planes psum over ICI, one top-k on the
+    merged plane (reference analog: parallel per-segment top-k
+    collectors, SURVEY.md §2.11 — re-expressed as XLA collectives; see
+    also parallel/mesh.py)."""
+    def build():
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import AXIS, make_mesh
-    mesh = make_mesh(mesh_n)
+        from ..parallel.mesh import AXIS, make_mesh
+        mesh = make_mesh(mesh_n)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=((P(),) * 6 + (P(), ) +            # store + avgdl
-                  (P(AXIS),) * 10),                 # posting-row sections
-        out_specs=(P(), P()))
-    def step(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs, norms,
-             avgdl, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
-             tail_docs, tail_tfs, tail_w, tail_qid):
-        scores, _ = _accumulate_scores(
-            block_base, block_gaps, block_tfs8, raw_docs, raw_tfs, norms,
-            row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
-            tail_docs, tail_tfs, tail_w, tail_qid, ndocs_pad, n_queries,
-            False, k1, b, avgdl, scorer)
-        scores = jax.lax.psum(scores, AXIS)
-        return jax.lax.top_k(scores, k)
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=((P(),) * 6 + (P(), ) +        # store + avgdl
+                      (P(AXIS),) * 10),             # posting-row sections
+            out_specs=(P(), P()))
+        def step(block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
+                 norms, avgdl, row_idx, row_w, row_qid, raw_idx, raw_w,
+                 raw_qid, tail_docs, tail_tfs, tail_w, tail_qid):
+            scores, _ = _accumulate_scores(
+                block_base, block_gaps, block_tfs8, raw_docs, raw_tfs,
+                norms, row_idx, row_w, row_qid, raw_idx, raw_w, raw_qid,
+                tail_docs, tail_tfs, tail_w, tail_qid, ndocs_pad,
+                n_queries, False, k1, b, avgdl, scorer)
+            scores = jax.lax.psum(scores, AXIS)
+            return jax.lax.top_k(scores, k)
 
-    return jax.jit(step)
+        return step
+
+    from ..obs import device as obs_device
+    return obs_device.compiled(
+        "bm25_mesh",
+        (mesh_n, ndocs_pad, k, n_queries, scorer, k1, b),
+        build)
 
 
 def score_topk_mesh(store, qb: "QueryBatch", ndocs_pad: int, k: int,

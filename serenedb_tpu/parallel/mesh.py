@@ -26,6 +26,21 @@ from ..columnar.device import LANES
 
 AXIS = "shard"
 
+
+def _mesh_key(mesh: "Mesh") -> tuple:
+    """Compile-ledger key component for a mesh: its device ids (two
+    meshes over the same devices trace to the same program).
+
+    The step builders below key on the mesh (+ scalar params) only, not
+    on input shapes — one ledger entry holds a SHAPE-POLYMORPHIC jit
+    wrapper whose internal per-shape executables accumulate like the
+    module-level @jax.jit kernels in ops/ (jit's own cache), and the
+    retraces are invisible to the compile ledger. Acceptable for these
+    test/bench/dryrun-facing builders (the engine's query-path programs
+    all key on full shape signatures); evicting the wrapper still frees
+    every shape variant at once."""
+    return tuple(d.id for d in mesh.devices.flat)
+
 #: process-wide cache of data-axis meshes by device count — Mesh
 #: construction is cheap but identity-stable meshes keep shard_map
 #: program caches (keyed on the jitted callable) from re-tracing
@@ -195,7 +210,9 @@ def sharded_agg_step(mesh: Mesh):
                               jnp.sum(loh, axis=1, dtype=jnp.int32)], axis=1)
         return jax.lax.psum(cnt, AXIS), partials
 
-    return jax.jit(step)
+    from ..obs import device as obs_device
+    return obs_device.compiled("mesh_agg", (_mesh_key(mesh),),
+                               lambda: step)
 
 
 def combine_agg_partials(partials: np.ndarray) -> int:
@@ -229,7 +246,10 @@ def sharded_bm25_topk(mesh: Mesh, ndocs_pad: int, k: int,
         scores = jax.lax.psum(local, AXIS)
         return tuple(jax.lax.top_k(scores, k))
 
-    return jax.jit(step)
+    from ..obs import device as obs_device
+    return obs_device.compiled(
+        "mesh_bm25_topk", (_mesh_key(mesh), ndocs_pad, k, k1, b),
+        lambda: step)
 
 
 def sharded_query_step(mesh: Mesh, num_groups: int):
@@ -263,4 +283,7 @@ def sharded_query_step(mesh: Mesh, num_groups: int):
         scores = jax.lax.psum(local, AXIS)
         return counts, sums, scores
 
-    return jax.jit(step)
+    from ..obs import device as obs_device
+    return obs_device.compiled("mesh_query",
+                               (_mesh_key(mesh), num_groups),
+                               lambda: step)

@@ -1307,7 +1307,97 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return query_progress_table()
     if name == "sdb_admission":
         return admission_table()
+    if name == "sdb_device":
+        return device_table()
+    if name == "sdb_programs":
+        return programs_table()
+    if name == "sdb_device_cache":
+        return device_cache_table()
     return None
+
+
+def device_table() -> TableProvider:
+    """sdb_device: one row per physical jax device — dispatches
+    executed, transfer bytes/time host→device and device→host, and the
+    HBM live-bytes estimate (device column cache occupancy split per
+    holding device). The device telemetry ledger (obs/device.py,
+    serene_device_telemetry); empty counters when telemetry is off."""
+    from .obs.device import device_rows
+    rows = device_rows()
+    return _typed("sdb_device", [
+        ("device", dt.INT), ("platform", dt.VARCHAR),
+        ("kind", dt.VARCHAR), ("dispatches", dt.BIGINT),
+        ("bytes_up", dt.BIGINT), ("transfers_up", dt.BIGINT),
+        ("up_ms", dt.DOUBLE), ("bytes_down", dt.BIGINT),
+        ("transfers_down", dt.BIGINT), ("down_ms", dt.DOUBLE),
+        ("hbm_bytes_est", dt.BIGINT)], {
+        "device": [r["device"] for r in rows],
+        "platform": [r["platform"] for r in rows],
+        "kind": [r["kind"] for r in rows],
+        "dispatches": [r["dispatches"] for r in rows],
+        "bytes_up": [r["bytes_up"] for r in rows],
+        "transfers_up": [r["transfers_up"] for r in rows],
+        "up_ms": [r["up_ms"] for r in rows],
+        "bytes_down": [r["bytes_down"] for r in rows],
+        "transfers_down": [r["transfers_down"] for r in rows],
+        "down_ms": [r["down_ms"] for r in rows],
+        "hbm_bytes_est": [r["hbm_bytes_est"] for r in rows]})
+
+
+def programs_table() -> TableProvider:
+    """sdb_programs: the XLA compile ledger — one row per program
+    family (fused / fused_build / fused_probe / fused_collective /
+    fused_topn / device_agg / device_topn / mesh_* / search programs)
+    with live entry counts, cumulative compiles, cache hit/miss totals,
+    LRU evictions, recompile-storm count, and compile wall time
+    (first-dispatch trace)."""
+    from .obs.device import PROGRAMS
+    rows = PROGRAMS.snapshot()
+    return _typed("sdb_programs", [
+        ("family", dt.VARCHAR), ("entries", dt.BIGINT),
+        ("compiles", dt.BIGINT), ("hits", dt.BIGINT),
+        ("misses", dt.BIGINT), ("evictions", dt.BIGINT),
+        ("storms", dt.BIGINT), ("compile_ms_total", dt.DOUBLE),
+        ("compile_ms_mean", dt.DOUBLE), ("last_compile_ms", dt.DOUBLE)], {
+        "family": [r["family"] for r in rows],
+        "entries": [r["entries"] for r in rows],
+        "compiles": [r["compiles"] for r in rows],
+        "hits": [r["hits"] for r in rows],
+        "misses": [r["misses"] for r in rows],
+        "evictions": [r["evictions"] for r in rows],
+        "storms": [r["storms"] for r in rows],
+        "compile_ms_total": [r["compile_ms_total"] for r in rows],
+        "compile_ms_mean": [r["compile_ms_mean"] for r in rows],
+        "last_compile_ms": [r["last_compile_ms"] for r in rows]})
+
+
+def device_cache_table() -> TableProvider:
+    """sdb_device_cache: one row per live DEVICE_CACHE entry — which
+    publication (table/version/epoch) and column occupies HBM, the
+    entry kind (col = column tiles, arr = code/rowmask/build-output
+    arrays), bytes, holding devices, hit count and idle time. The
+    per-publication occupancy view the paged-postings roadmap item
+    tunes against."""
+    from .obs.device import device_cache_rows
+    rows = device_cache_rows()
+    return _typed("sdb_device_cache", [
+        ("table_name", dt.VARCHAR), ("token", dt.BIGINT),
+        ("data_version", dt.BIGINT), ("mutation_epoch", dt.BIGINT),
+        ("column_name", dt.VARCHAR), ("kind", dt.VARCHAR),
+        ("tag", dt.VARCHAR), ("bytes", dt.BIGINT),
+        ("devices", dt.VARCHAR), ("hits", dt.BIGINT),
+        ("idle_ms", dt.DOUBLE)], {
+        "table_name": [r["table"] for r in rows],
+        "token": [r["token"] for r in rows],
+        "data_version": [r["data_version"] for r in rows],
+        "mutation_epoch": [r["mutation_epoch"] for r in rows],
+        "column_name": [r["column"] for r in rows],
+        "kind": [r["kind"] for r in rows],
+        "tag": [r["tag"] for r in rows],
+        "bytes": [r["bytes"] for r in rows],
+        "devices": [r["devices"] for r in rows],
+        "hits": [r["hits"] for r in rows],
+        "idle_ms": [r["idle_ms"] for r in rows]})
 
 
 def cache_table() -> TableProvider:

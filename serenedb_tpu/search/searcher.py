@@ -910,10 +910,10 @@ def _combine_topk(seg_outs: list, bases: list[int], n_queries: int,
     return merge_segment_topk(seg_outs, bases, n_queries, k)
 
 
-#: compiled shard_map merge programs keyed by (padded candidate width,
-#: padded k, padded query count, mesh width) — pow2 padding keeps the
-#: compile-shape population bounded under varied query mixes
-_MERGE_PROGRAMS: dict = {}
+# compiled shard_map merge programs live in the obs/device compile
+# ledger keyed by (padded candidate width, padded k, padded query
+# count, mesh width) — pow2 padding keeps the compile-shape population
+# bounded under varied query mixes, the ledger LRU bounds it hard
 
 #: padding doc sentinel: sorts after every real doc at equal score and
 #: is trimmed host-side; real global doc ids must stay below it
@@ -931,9 +931,6 @@ def _merge_program(mesh, lp: int, kp: int, qp: int):
     from ..parallel.mesh import AXIS
     m_width = mesh.shape[AXIS]
     key = (lp, kp, qp, m_width)
-    prog = _MERGE_PROGRAMS.get(key)
-    if prog is not None:
-        return prog
     kcut = min(kp, lp)
 
     def srt(kk, dd, ss):
@@ -965,9 +962,8 @@ def _merge_program(mesh, lp: int, kp: int, qp: int):
         _, dfin, sfin = jax.vmap(srt)(k2, d2, s2)
         return sfin[:, :kp], dfin[:, :kp]
 
-    prog = jax.jit(step)
-    _MERGE_PROGRAMS[key] = prog
-    return prog
+    from ..obs import device as obs_device
+    return obs_device.compiled("search_merge", key, lambda: step)
 
 
 def _device_merge_topk(seg_outs: list, bases: list[int], n_queries: int,
@@ -1034,12 +1030,14 @@ def _device_merge_topk(seg_outs: list, bases: list[int], n_queries: int,
     t_d = time.perf_counter_ns()
     metrics.DEVICE_OFFLOADS.add()
     metrics.COLLECTIVE_DISPATCHES.add()
+    from ..obs import device as obs_device
     from ..obs.resources import wait_scope
     with wait_scope("Device", "CollectiveCombine"):
-        ss, dd2 = jitted(jax.device_put(scores, sh),
-                         jax.device_put(docs, sh))
-        ss = np.asarray(ss)
-        dd2 = np.asarray(dd2)
+        # the candidate planes bypass DEVICE_CACHE (per-dispatch data):
+        # commit() keeps their transfer bytes in the device ledger
+        ss, dd2 = obs_device.fetch_all(
+            jitted(obs_device.commit(scores, sh),
+                   obs_device.commit(docs, sh)))
     dt = time.perf_counter_ns() - t_d
     metrics.COLLECTIVE_COMBINE_NS.add(dt)
     metrics.DEVICE_DISPATCH_HIST.observe_ns(dt)

@@ -109,6 +109,15 @@ class Handler(BaseHTTPRequestHandler):
                               "completed queries)")
             self._send(200, chrome_trace(entry))
             return
+        if p == ["device"] and method == "GET":
+            # device telemetry (obs/device.py): per-device dispatch /
+            # transfer / HBM-estimate rows, the XLA compile ledger and
+            # cache summaries. Exactly GET /device — deeper paths still
+            # reach the ES API for an index of that name (the /metrics
+            # tradeoff).
+            from ..obs.device import stats_section
+            self._send(200, stats_section())
+            return
         if p == ["progress"] and method == "GET":
             # live query progress (sdb_query_progress as JSON): one
             # object per running statement with its current operator,

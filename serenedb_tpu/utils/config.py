@@ -190,6 +190,29 @@ declare("serene_device_cache_mb", 256, int,
         "transfer entirely; least-recently-used entries evict past the "
         "cap and superseded generations are swept eagerly on store",
         scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
+declare("serene_device_telemetry", True, bool,
+        "device telemetry (obs/device.py): the XLA compile ledger "
+        "(per-program-family compile counts/wall time, program-cache "
+        "hit/miss gauges, recompile-storm warnings), host<->device "
+        "transfer byte/time accounting and per-device dispatch counts "
+        "+ HBM occupancy estimates, surfaced via sdb_device()/"
+        "sdb_programs()/sdb_device_cache(), GET /device, /_stats and "
+        "/metrics, plus device_compile trace spans and the EXPLAIN "
+        "ANALYZE Device: compile=hit|miss key. Observation only: "
+        "telemetry never changes which program runs — results are "
+        "bit-identical on or off at any worker/shard/combine setting "
+        "(<3% overhead budget, device_observe bench shape)",
+        scope=Scope.GLOBAL)
+declare("serene_program_cache_entries", 256, int,
+        "entry cap of the process-wide compiled-program LRU "
+        "(obs/device.py PROGRAMS — the _PROGRAM_CACHE successor): "
+        "every jitted device program (fused pipelines, device "
+        "aggregates/top-N, mesh/search programs) lives here keyed by "
+        "(family, shape); least-recently-used executables evict past "
+        "the cap instead of leaking one per novel query shape for "
+        "process lifetime, and an evicted shape simply re-compiles on "
+        "next use", scope=Scope.GLOBAL,
+        validator=lambda v: max(1, int(v)))
 declare("serene_mesh", 0, int,
         "shard device programs across an N-device jax mesh (0 = single "
         "device); grouped aggregates and BM25 top-k run as shard_map "

@@ -212,6 +212,39 @@ DEVICE_CACHE_EVICTIONS = REGISTRY.gauge(
 DEVICE_CACHE_BYTES = REGISTRY.gauge(
     "DeviceCacheBytes",
     "current bytes held by the device column cache")
+DEVICE_PROGRAMS_COMPILED = REGISTRY.gauge(
+    "DeviceProgramsCompiled",
+    "jitted device programs built by the compile ledger "
+    "(obs/device.py) — each is one XLA trace+compile on first dispatch")
+DEVICE_PROGRAM_HITS = REGISTRY.gauge(
+    "DeviceProgramCacheHits",
+    "compile-ledger probes served by an already-compiled program "
+    "(no retrace, no recompile)")
+DEVICE_PROGRAM_MISSES = REGISTRY.gauge(
+    "DeviceProgramCacheMisses",
+    "compile-ledger probes that had to build a new program")
+DEVICE_PROGRAM_EVICTIONS = REGISTRY.gauge(
+    "DeviceProgramCacheEvictions",
+    "compiled programs dropped by the bounded program LRU "
+    "(serene_program_cache_entries); an evicted shape re-compiles on "
+    "next use")
+DEVICE_PROGRAM_ENTRIES = REGISTRY.gauge(
+    "DeviceProgramCacheEntries",
+    "compiled programs currently held by the program LRU (live)")
+DEVICE_RECOMPILE_STORMS = REGISTRY.gauge(
+    "DeviceRecompileStorms",
+    "recompile-storm warnings fired: one program family compiled more "
+    "than RECOMPILE_STORM_PER_MIN new shapes within a minute — repeat "
+    "queries are not reusing cached executables")
+DEVICE_TRANSFERS_UP = REGISTRY.gauge(
+    "DeviceTransfersUp",
+    "host->device transfers recorded by the device telemetry ledger "
+    "(column uploads, code/rowmask tiles, stacked mesh commits, "
+    "cached build-output commits)")
+DEVICE_FETCH_BYTES = REGISTRY.gauge(
+    "DeviceBytesFetched",
+    "bytes copied device->host fetching program outputs (the "
+    "readback sibling of DeviceBytesMoved)")
 WAL_COMMITS = REGISTRY.gauge("WalCommits", "search WAL commit records written")
 POOL_MORSELS = REGISTRY.gauge("PoolMorselsExecuted",
                               "morsel tasks executed by the worker pool")
@@ -388,6 +421,11 @@ DEVICE_DISPATCH_HIST = REGISTRY.histogram(
     "the dispatch section (post-upload; first call includes jit "
     "compile), device aggregates and top-N observe the whole offload "
     "(upload + compile-cache lookup + dispatch + readback)")
+DEVICE_COMPILE_HIST = REGISTRY.histogram(
+    "DeviceCompile",
+    "first-dispatch latency of each jitted device program (XLA "
+    "trace + compile + the first execution — the compile-stall a "
+    "cold query pays; warm dispatches land in DeviceDispatch)")
 QUERY_PEAK_BYTES_HIST = REGISTRY.histogram(
     "QueryPeakBytes",
     "per-statement accounted peak memory (serene_mem_account): the "

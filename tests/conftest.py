@@ -142,6 +142,25 @@ if os.environ.get("SERENE_MEM_ACCOUNT"):
                            os.environ["SERENE_MEM_ACCOUNT"])
 
 
+# scripts/verify_tier1.sh device-telemetry parity leg: force
+# serene_device_telemetry to the given value ("on"/"off") and/or cap
+# the compiled-program LRU at a tiny SERENE_PROGRAM_CACHE_ENTRIES
+# (e.g. "4") for a whole run — the capped pass exercises program
+# eviction + re-compile on every suite query, proving the bounded
+# ledger changes WHEN programs compile, never what they compute.
+if os.environ.get("SERENE_DEVICE_TELEMETRY"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_DT
+
+    _SDB_REG_DT.set_global("serene_device_telemetry",
+                           os.environ["SERENE_DEVICE_TELEMETRY"])
+
+if os.environ.get("SERENE_PROGRAM_CACHE_ENTRIES"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_PC
+
+    _SDB_REG_PC.set_global("serene_program_cache_entries",
+                           os.environ["SERENE_PROGRAM_CACHE_ENTRIES"])
+
+
 # scripts/verify_tier1.sh workload-governor parity leg: arm the
 # admission gate suite-wide (e.g. "8" — every non-exempt statement then
 # takes/queues for a governor slot), a generous global serene_work_mem
