@@ -1441,6 +1441,95 @@ def bench_search_batch() -> float:
     return headline
 
 
+def bench_paged_search() -> float:
+    """Device-resident paged postings (ISSUE 16 tentpole): QPS of
+    repeated coalesced ragged top-10 dispatches over the 1M-doc
+    synthetic corpus at 1/8/64 queries per coalesced batch, page-
+    resident (`serene_posting_pool = on`: warm batches score as ONE
+    jitted gather-and-accumulate program over the pool's HBM page
+    tables, uploading zero posting bytes) vs the host ragged path
+    (`= off`, the parity oracle). Per-query results are asserted
+    BIT-identical between the modes. Returns the 64-batch QPS ratio —
+    recorded honestly on the CPU backend (the jitted gather competes
+    with a numpy accumulate over host RAM there); on a real device the
+    resident path must win (>1x asserted), because the oracle re-reads
+    every posting from host memory per dispatch."""
+    import statistics as _stats
+
+    import jax
+    import numpy as np
+
+    from serenedb_tpu.search.analysis import get_analyzer
+    from serenedb_tpu.search.posting_pool import POOL
+    from serenedb_tpu.search.query import parse_query
+    from serenedb_tpu.search.searcher import SegmentSearcher
+    from serenedb_tpu.utils import metrics as _metrics
+    from serenedb_tpu.utils.config import REGISTRY as _settings
+
+    an = get_analyzer("simple")
+    n_docs = 1_000_000
+    fi = _synth_posting_index(n_docs, 30_000, 12_000_000, 7)
+    seg = SegmentSearcher(fi, an, n_docs)
+    terms = [f"w{100 + 13 * i:07d}" for i in range(128)]
+    nodes = [parse_query(f"{terms[2 * i]} | {terms[2 * i + 1]}", an)
+             for i in range(64)]
+
+    def run_level(batch: int, on: bool, reps: int):
+        _settings.set_global("serene_posting_pool", on)
+        results = []
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            results = []
+            for i in range(0, len(nodes), batch):
+                results.extend(seg.topk_batch(nodes[i:i + batch], 10,
+                                              ragged=True))
+        dt = time.perf_counter() - t0
+        return reps * len(nodes) / dt, results
+
+    old = _settings.get_global("serene_posting_pool")
+    try:
+        # warm every bucket both modes touch: pool page residency +
+        # batch descriptor memos + program compiles per batch size
+        for batch in (1, 8, 64):
+            run_level(batch, True, 1)
+            run_level(batch, False, 1)
+        d0 = _metrics.POSTING_POOL_DEVICE_QUERIES.value
+        detail: dict[str, dict] = {}
+        headline = None
+        for batch in (1, 8, 64):
+            on_s, off_s = [], []
+            res_on = res_off = None
+            for _ in range(2):    # alternating pairs + medians
+                qps_on, res_on = run_level(batch, True, 1)
+                qps_off, res_off = run_level(batch, False, 1)
+                on_s.append(qps_on)
+                off_s.append(qps_off)
+            for qi, (a, b) in enumerate(zip(res_on, res_off)):
+                assert np.array_equal(a[0].view(np.uint32),
+                                      b[0].view(np.uint32)) and \
+                    np.array_equal(a[1], b[1]), \
+                    f"pool result diverged from host ragged at " \
+                    f"batch={batch} query={qi}"
+            qps_on = _stats.median(on_s)
+            qps_off = _stats.median(off_s)
+            detail[str(batch)] = {"qps_resident": round(qps_on, 1),
+                                  "qps_host": round(qps_off, 1),
+                                  "ratio": round(qps_on / qps_off, 2)}
+            if batch == 64:
+                headline = qps_on / qps_off
+        assert _metrics.POSTING_POOL_DEVICE_QUERIES.value > d0, \
+            "pool tier never engaged — bench measured host vs host"
+        _EXTRA["detail"] = detail
+        _EXTRA["rows"] = n_docs
+        _EXTRA["pool"] = POOL.stats()
+    finally:
+        _settings.set_global("serene_posting_pool", old)
+    if jax.default_backend() != "cpu":
+        assert headline > 1.0, \
+            f"resident paged scoring loses to host ragged: {headline:.2f}x"
+    return headline
+
+
 def bench_shard_exec() -> float:
     """Sharded execution tier (ISSUE 9 tentpole): the 1M-row
     filter→join→agg chain through the engine at `serene_shards` 1/2/4 —
@@ -1918,6 +2007,7 @@ SHAPES = {
     "device_pipeline": bench_device_pipeline,
     "device_observe": bench_device_observe,
     "search_batch": bench_search_batch,
+    "paged_search": bench_paged_search,
     "shard_exec": bench_shard_exec,
     "multichip": bench_multichip,
 }
@@ -1937,14 +2027,14 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
                "profile_overhead", "trace_overhead", "mem_overhead",
                "concurrency", "result_cache", "device_pipeline",
-               "device_observe", "search_batch", "shard_exec",
-               "multichip")
+               "device_observe", "search_batch", "paged_search",
+               "shard_exec", "multichip")
 
 #: host shapes that nevertheless run jitted programs — with the device
 #: probe down their children must pin JAX_PLATFORMS=cpu, because
 #: initializing the tunneled backend with the tunnel dead is a hard hang
 JIT_HOST_SHAPES = ("device_pipeline", "device_observe", "search_batch",
-                   "shard_exec", "multichip")
+                   "paged_search", "shard_exec", "multichip")
 
 #: shapes that measure the in-program multi-chip combine: their child
 #: always runs on a 4-device VIRTUAL cpu mesh

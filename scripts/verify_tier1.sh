@@ -176,6 +176,45 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_DEVICE_TELEMETRY=on \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc13=$?
 
+# Pass 14 is the posting-pool stress leg: the device-resident paged
+# posting tier is forced ON with the page budget pinned at a tiny 16
+# pages (the conftest env hooks arm both globals) over the search,
+# search-batch, posting-pool and device-observability suites — the
+# starved budget forces partial residency and mid-stream LRU eviction
+# on practically every ragged search, proving the pool changes WHERE
+# postings are scored (HBM page tables vs host flatten), never a
+# result bit, while its gauges/relations record suite-wide.
+echo "== posting pool stress pass (pool on, 16-page budget) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_POSTING_POOL=on \
+    SERENE_POSTING_PAGES=16 \
+    python -m pytest tests/test_search.py tests/test_search_batch.py \
+    tests/test_posting_pool.py tests/test_device_obs.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc14=$?
+
+# Structural grep lint: every jit compilation in the engine must route
+# through the PR 15 compile ledger (obs/device.compiled) so the program
+# cache stays bounded and observable — a bare jax.jit( call site
+# anywhere outside obs/device.py (or the ops/ kernel modules, which
+# pre-date the ledger and are wrapped at their call sites) regresses
+# the invariant. The posting pool's gather-accumulate programs are the
+# newest client; assert they compile through the ledger.
+echo "== compile-ledger grep lint =="
+rc15=0
+if grep -rn "jax\.jit(" serenedb_tpu/ \
+        --include='*.py' \
+        | grep -v "^serenedb_tpu/obs/device.py:" \
+        | grep -v "^serenedb_tpu/ops/" \
+        | grep -v "#.*jax\.jit("; then
+    echo "FAIL: bare jax.jit( outside obs/device.py and ops/ kernels"
+    rc15=1
+fi
+if ! grep -q 'obs_device\.compiled(\s*$\|obs_device\.compiled(' \
+        serenedb_tpu/search/posting_pool.py; then
+    echo "FAIL: posting_pool.py does not compile through obs.device.compiled"
+    rc15=1
+fi
+
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
 [ "$rc3" -ne 0 ] && exit "$rc3"
@@ -188,4 +227,6 @@ rc13=$?
 [ "$rc10" -ne 0 ] && exit "$rc10"
 [ "$rc11" -ne 0 ] && exit "$rc11"
 [ "$rc12" -ne 0 ] && exit "$rc12"
-exit "$rc13"
+[ "$rc13" -ne 0 ] && exit "$rc13"
+[ "$rc14" -ne 0 ] && exit "$rc14"
+exit "$rc15"

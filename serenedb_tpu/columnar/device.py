@@ -181,6 +181,24 @@ def to_device_column(col: Column, pad_multiple: int = BLOCK_ROWS) -> DeviceColum
     return DeviceColumn(col.type, data2d, mask2d, n, scheme, offset)
 
 
+def commit_host_array(arr: np.ndarray):
+    """Upload one raw host array through the accounted choke point —
+    the non-Column sibling of to_device_column for device subsystems
+    that ship bare numpy payloads (the posting pool's staged pages and
+    batch descriptor tables). Same ledger contract: per-device transfer
+    byte/time attribution happens exactly once, here."""
+    import time as _time
+
+    from ..obs import device as _obsdev
+    t0 = _time.perf_counter_ns() if _obsdev.enabled() else 0
+    dev = jnp.asarray(arr)
+    if t0:
+        _obsdev.note_upload(int(dev.size * dev.dtype.itemsize),
+                            _obsdev.array_device_ids(dev),
+                            _time.perf_counter_ns() - t0)
+    return dev
+
+
 def to_device_batch(batch: Batch, columns: Optional[list[str]] = None) -> dict:
     names = columns if columns is not None else batch.names
     return {name: to_device_column(batch.column(name)) for name in names}

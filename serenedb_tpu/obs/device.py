@@ -502,7 +502,13 @@ def device_rows() -> list[dict]:
     has seen."""
     from ..exec.device_pipeline import DEVICE_CACHE
     from ..parallel import mesh as mesh_mod
+    from ..search.posting_pool import POOL
     cache_bytes = DEVICE_CACHE.device_bytes()
+    pool_bytes = POOL.device_bytes()
+    for i, n in pool_bytes.items():
+        # the posting pool's paged region is HBM-live alongside the
+        # column cache — one estimate covers both tenants
+        cache_bytes[i] = cache_bytes.get(i, 0) + n
     snap = LEDGER.snapshot()
     devs = {}
     if mesh_mod.device_count_if_initialized():
@@ -543,8 +549,10 @@ def stats_section() -> dict:
     """The `/_stats` / `GET /device` JSON payload: per-device ledger
     rows, the compile ledger, and the program/column cache summaries."""
     from ..exec.device_pipeline import DEVICE_CACHE
+    from ..search.posting_pool import POOL
     return {"devices": device_rows(),
             "programs": PROGRAMS.snapshot(),
             "program_cache": {"entries": PROGRAMS.entries(),
                               "cap": _cap()},
-            "column_cache": DEVICE_CACHE.stats()}
+            "column_cache": DEVICE_CACHE.stats(),
+            "posting_pool": POOL.stats()}

@@ -283,7 +283,11 @@ class QueryTrace:
             **detail) -> None:
         """Record one span event from any thread. begin/end are
         perf_counter_ns stamps (end >= begin enforced); detail keys
-        become Chrome trace `args`."""
+        become Chrome trace `args`. Span names are free-form; the
+        "device" category carries device_compile, collective_dispatch
+        and the posting pool's posting_upload (staged page writes) /
+        posting_dispatch (batched gather-accumulate scoring) spans,
+        "search" the batcher's batch_wait / batch_dispatch pair."""
         r = getattr(self._tl, "r", None)
         if r is None:
             t = threading.current_thread()

@@ -972,12 +972,13 @@ def assemble_dense_weights(v_pad: int,
 # XLA's simplifier, which is why this stays a jitted kernel). Only bm25 and
 # tfidf decompose this way — LM scorers never take the ragged path.
 
-@functools.partial(jax.jit, static_argnames=("scorer",))
-def contrib_flat(tfs: jax.Array, dls: jax.Array, w: jax.Array, k1: float,
-                 b: float, avgdl: float,
-                 scorer: str = "bm25") -> jax.Array:
-    """Per-posting score contribution w·sat(tf, dl) over flat arrays.
-    Padding entries (tf=0, w=0) contribute exactly 0.0."""
+def contrib_expr(tfs: jax.Array, dls: jax.Array, w: jax.Array, k1,
+                 b, avgdl, scorer: str = "bm25") -> jax.Array:
+    """THE shared contribution expression tree — traced identically by
+    `contrib_flat` (the host ragged path) and the posting-pool device
+    program (search/posting_pool.py), so XLA applies the same algebraic
+    simplification in both and their f32 contribution bits agree with
+    each other and with the plane kernel's."""
     avg = jnp.maximum(jnp.float32(avgdl), 1e-9)
     tfsf = tfs.astype(jnp.float32)
     if scorer == "tfidf":
@@ -985,6 +986,15 @@ def contrib_flat(tfs: jax.Array, dls: jax.Array, w: jax.Array, k1: float,
     dl = dls.astype(jnp.float32)
     denom = tfsf + k1 * (1.0 - b + b * dl / avg)
     return w * (k1 + 1.0) * tfsf / jnp.maximum(denom, 1e-9)
+
+
+@functools.partial(jax.jit, static_argnames=("scorer",))
+def contrib_flat(tfs: jax.Array, dls: jax.Array, w: jax.Array, k1: float,
+                 b: float, avgdl: float,
+                 scorer: str = "bm25") -> jax.Array:
+    """Per-posting score contribution w·sat(tf, dl) over flat arrays.
+    Padding entries (tf=0, w=0) contribute exactly 0.0."""
+    return contrib_expr(tfs, dls, w, k1, b, avgdl, scorer)
 
 
 def ragged_contribs(tfs: np.ndarray, dls: np.ndarray, w: np.ndarray,

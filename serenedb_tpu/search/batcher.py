@@ -9,7 +9,11 @@ GPUSparse's parallel inverted indices): queries arriving within a short
 window fold into one `MultiSearcher.topk_batch` call, which scores them
 in a single vectorized pass per segment over the shared postings/norms
 (ragged per-query term lists — search/searcher._ragged_resolve on the
-host backend, the batched plane kernel on devices).
+host backend, the batched plane kernel on devices). With
+`serene_posting_pool` on, the coalesced dispatch is the one that never
+leaves the device: page-resident batches score as ONE jitted
+gather-and-accumulate program over the pool's HBM page tables
+(search/posting_pool.py), and a warm repeat uploads zero posting bytes.
 
 Coalescing is group-commit shaped, so an idle system never waits:
 

@@ -17,7 +17,7 @@ included); NOT-subtrees and phrase adjacency affect *matching* only.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,7 @@ import numpy as np
 
 from ..columnar.device import pad_len
 from ..ops import bm25 as bm25_ops
+from . import posting_pool
 from .analysis import Analyzer
 from .automaton import intersect_sorted, levenshtein_nfa
 from .query import (QAnd, QFuzzy, QNode, QNot, QNothing, QOr, QPhrase,
@@ -46,6 +47,23 @@ def _host_backend() -> bool:
     if _HOST_BACKEND is None:
         _HOST_BACKEND = jax.default_backend() == "cpu"
     return _HOST_BACKEND
+
+
+class _RaggedSlice(NamedTuple):
+    """One (plane, term) slice of an admitted ragged query, in the
+    plane kernel's flatten order: the KEPT postings (docs/tfs), the
+    term weight, and enough provenance — term id, full posting range,
+    within-term kept positions — for the posting pool to key pages and
+    build page-table gather slots. `idx` is None when every posting of
+    the term survives (light tails, unpruned heavy planes)."""
+
+    docs: np.ndarray
+    tfs: np.ndarray
+    w: float
+    tid: int
+    s: int
+    e: int
+    idx: Optional[np.ndarray]
 
 
 def _maxscore_split(plan) -> set:
@@ -524,10 +542,60 @@ class SegmentSearcher:
         return self._finish_batch(nodes, shapes, vals, docs, host_results,
                                   k, scorer, idf_of, avgdl_override, nd_pad)
 
+    #: byte budget for the ragged memo caches hung off plans and stores
+    #: (_ragged_slices masked copies, _ragged_accum candidate tables,
+    #: the posting pool's batch descriptor memo): past this EVERY memo
+    #: clears — the bounded-cache discipline PR 15 applied to programs,
+    #: here for the one-entry-per-novel-query-shape growth class
+    RAGGED_MEMO_BYTES_CAP = 64 << 20
+
+    @staticmethod
+    def _ragged_memo_charge(store, nbytes: int) -> None:
+        """Account freshly-memoized ragged bytes against the store's
+        running total; crossing the cap clears every ragged memo (they
+        are pure recomputable functions of plan + store, so clearing is
+        always safe — the next query repays the arithmetic once)."""
+        total = getattr(store, "_ragged_memo_bytes", 0) + int(nbytes)
+        if total > SegmentSearcher.RAGGED_MEMO_BYTES_CAP:
+            for plan in getattr(store, "_plan_cache", {}).values():
+                if plan is None:
+                    continue
+                for attr in ("_ragged_slices", "_ragged_accum"):
+                    if hasattr(plan, attr):
+                        delattr(plan, attr)
+            cache = getattr(store, "_ragged_plain", None)
+            if cache:
+                cache.clear()
+            memo = getattr(store, "_pool_batch_memo", None)
+            if memo:
+                memo.clear()
+            total = int(nbytes)
+        store._ragged_memo_bytes = total
+
+    def _ragged_candidates(self, store, plan, slices):
+        """Sorted candidate-doc union + per-slice scatter indices for
+        one admitted query — a pure function of the plan's kept
+        postings, memoized on the plan so repeat queries pay only the
+        f32 adds + top-k. Shared VERBATIM by the host accumulate and
+        the posting pool's device descriptors, so their per-doc scatter
+        targets cannot diverge."""
+        pre = getattr(plan, "_ragged_accum", None) \
+            if plan is not None else None
+        if pre is not None:
+            return pre
+        cand = np.unique(np.concatenate([sl.docs for sl in slices]))
+        ixs = [np.searchsorted(cand, sl.docs).astype(np.int32)
+               for sl in slices]
+        if plan is not None:
+            plan._ragged_accum = (cand, ixs)
+            self._ragged_memo_charge(
+                store, cand.nbytes + sum(ix.nbytes for ix in ixs))
+        return cand, ixs
+
     def _ragged_resolve(self, store, qis, shapes, plans, k: int,
                         scorer: str, idf_of, avgdl,
                         ) -> dict[int, tuple[np.ndarray, np.ndarray]]:
-        """Batched ragged host top-k for pure-disjunction queries.
+        """Batched ragged top-k for pure-disjunction queries.
 
         Every admitted query's postings — WAND-kept block rows of heavy
         terms plus light-term tails, exactly the entries the plane kernel
@@ -539,12 +607,19 @@ class SegmentSearcher:
         the scatter's per-doc f32 addition order bit-for-bit), and
         `topk_tie_exact` makes the same (score desc, doc asc) selection
         as lax.top_k. Queries past RAGGED_ENTRY_CAP stay on the device
-        dispatch."""
+        dispatch.
+
+        Device tier (serene_posting_pool, search/posting_pool.py):
+        queries whose terms are page-resident in the pool's HBM region
+        never flatten on the host at all — one jitted gather-and-
+        accumulate program over page tables scores them with the SAME
+        contrib expression tree and candidate tables, so the host path
+        here remains the bit-identical parity oracle. Partial residency
+        scores the resident slice PREFIX on device and adds the suffix
+        slices below in the same order — an identical f32 addition
+        sequence."""
         fi = self.index
-        per_q: list[tuple[int, list]] = []
-        flat_d, flat_t, flat_w = [], [], []
-        spans: list[list[tuple[int, int]]] = []   # per admitted query
-        pos = 0
+        per_q: list[tuple[int, object, list]] = []
         for qi in qis:
             tids = shapes[qi][0]
             plan = plans[qi]
@@ -554,7 +629,7 @@ class SegmentSearcher:
             else:
                 idf = bm25_ops.idf_for(scorer, self.num_docs,
                                        fi.doc_freq[tid_arr])
-            slices = []   # (docs, tfs, w) in the kernel's (plane, term) order
+            slices: list[_RaggedSlice] = []
             entries = 0
             for plane in (0, 1, 2):
                 for j, tid in enumerate(tids):
@@ -567,54 +642,68 @@ class SegmentSearcher:
                         continue   # heavy → tile planes, light → tails
                     w = float(idf[j])
                     if not heavy:
-                        d, t = store.flat_docs[s:e], store.flat_tfs[s:e]
+                        d, t, idx = (store.flat_docs[s:e],
+                                     store.flat_tfs[s:e], None)
                     else:
-                        d, t = self._ragged_tile_slice(store, plan, tid,
-                                                       plane, s, e)
+                        d, t, idx = self._ragged_tile_slice(store, plan,
+                                                            tid, plane, s, e)
                         if d is None:
                             continue
-                    slices.append((d, t, w))
+                    slices.append(_RaggedSlice(d, t, w, tid, s, e, idx))
                     entries += len(d)
             if entries > self.RAGGED_ENTRY_CAP:
                 continue   # device plane amortizes better past the cap
-            per_q.append((qi, slices))
-            qspans = []
-            for d, t, w in slices:
-                flat_d.append(d)
-                flat_t.append(t)
-                flat_w.append(np.full(len(d), w, dtype=np.float32))
-                qspans.append((pos, pos + len(d)))
-                pos += len(d)
-            spans.append(qspans)
+            per_q.append((qi, plan, slices))
         if not per_q:
             return {}
-        dcat = np.concatenate(flat_d)
-        contribs = bm25_ops.ragged_contribs(
-            np.concatenate(flat_t), store.norms_host[dcat],
-            np.concatenate(flat_w), K1, B, avgdl, scorer)
         out: dict[int, tuple[np.ndarray, np.ndarray]] = {}
-        for (qi, slices), qspans in zip(per_q, spans):
-            if not qspans:
+        pool_hits: dict = {}
+        if posting_pool.enabled():
+            pool_hits = posting_pool.POOL.score_queries(
+                self, store, per_q, k, scorer, avgdl, K1, B,
+                self._ragged_candidates)
+        flat_d, flat_t, flat_w = [], [], []
+        work = []   # (qi, spans, slice scatter ixs, device acc0, cand)
+        pos = 0
+        for qi, plan, slices in per_q:
+            hit = pool_hits.get(qi)
+            if hit is not None and hit[0] == "full":
+                out[qi] = (hit[1], hit[2])
+                continue
+            if not slices:
                 out[qi] = (np.empty(0, dtype=np.float32),
                            np.empty(0, dtype=np.int32))
                 continue
-            # candidate set + per-slice scatter indices are a pure
-            # function of the plan's kept postings — memoized on the
-            # plan, so repeat queries pay only the f32 adds + top-k
-            plan = plans[qi]
-            pre = getattr(plan, "_ragged_accum", None) \
-                if plan is not None else None
-            if pre is None:
-                cand = np.unique(np.concatenate(
-                    [dcat[a:b] for a, b in qspans]))
-                ixs = [np.searchsorted(cand, dcat[a:b])
-                       for a, b in qspans]
-                if plan is not None:
-                    plan._ragged_accum = (cand, ixs)
+            cand, ixs = self._ragged_candidates(store, plan, slices)
+            if hit is not None:
+                # partial residency: the device already accumulated the
+                # resident slice prefix — continue from its accumulator
+                acc0, n0 = hit[1], hit[2]
+                use, use_ix = slices[n0:], ixs[n0:]
             else:
-                cand, ixs = pre
-            acc = np.zeros(len(cand), dtype=np.float32)
-            for ix, (a, b) in zip(ixs, qspans):
+                acc0, use, use_ix = None, slices, ixs
+            spans = []
+            for sl in use:
+                flat_d.append(sl.docs)
+                flat_t.append(sl.tfs)
+                flat_w.append(np.full(len(sl.docs), sl.w,
+                                      dtype=np.float32))
+                spans.append((pos, pos + len(sl.docs)))
+                pos += len(sl.docs)
+            work.append((qi, spans, use_ix, acc0, cand))
+        if not work:
+            return out
+        if flat_d:
+            dcat = np.concatenate(flat_d)
+            contribs = bm25_ops.ragged_contribs(
+                np.concatenate(flat_t), store.norms_host[dcat],
+                np.concatenate(flat_w), K1, B, avgdl, scorer)
+        else:
+            contribs = np.empty(0, dtype=np.float32)
+        for qi, spans, use_ix, acc0, cand in work:
+            acc = acc0 if acc0 is not None \
+                else np.zeros(len(cand), dtype=np.float32)
+            for ix, (a, b) in zip(use_ix, spans):
                 acc[ix] += contribs[a:b]
             out[qi] = bm25_ops.topk_tie_exact(acc, cand, k)
         return out
@@ -622,12 +711,16 @@ class SegmentSearcher:
     @staticmethod
     def _ragged_tile_slice(store, plan, tid: int, plane: int, s: int,
                            e: int):
-        """(docs, tfs) of one heavy term's postings surviving the plan's
-        kept-row pruning on one tile plane, or (None, None). Memoized on
-        the plan (plans are memoized per query shape, so repeat queries
-        skip the mask arithmetic) or, plan-free, on the store. Cached
-        arrays are read-only by convention — accumulation never writes
-        through them."""
+        """(docs, tfs, kept_positions) of one heavy term's postings
+        surviving the plan's kept-row pruning on one tile plane, or
+        (None, None, None). kept_positions is None when every posting
+        survives (the slice IS the full term range), else the
+        within-term indices of the survivors — the posting pool expands
+        them into page-table gather slots. Memoized on the plan (plans
+        are memoized per query shape, so repeat queries skip the mask
+        arithmetic) or, plan-free, on the store; masked copies charge
+        RAGGED_MEMO_BYTES_CAP. Cached arrays are read-only by
+        convention — accumulation never writes through them."""
         cache = None
         if plan is not None:
             cache = getattr(plan, "_ragged_slices", None)
@@ -654,11 +747,14 @@ class SegmentSearcher:
                 np.clip(ix, 0, len(kept) - 1, out=ix)
                 m &= kept[ix] == rowof
         if not m.any():
-            out = (None, None)
+            out = (None, None, None)
         elif m.all():
-            out = (store.flat_docs[s:e], store.flat_tfs[s:e])
+            out = (store.flat_docs[s:e], store.flat_tfs[s:e], None)
         else:
-            out = (store.flat_docs[s:e][m], store.flat_tfs[s:e][m])
+            idx = np.flatnonzero(m)
+            out = (store.flat_docs[s:e][m], store.flat_tfs[s:e][m], idx)
+            SegmentSearcher._ragged_memo_charge(
+                store, out[0].nbytes + out[1].nbytes + idx.nbytes)
         cache[(plane, tid)] = out
         return out
 

@@ -190,6 +190,27 @@ declare("serene_device_cache_mb", 256, int,
         "transfer entirely; least-recently-used entries evict past the "
         "cap and superseded generations are swept eagerly on store",
         scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
+declare("serene_posting_pool", True, bool,
+        "device-resident paged posting pool (search/posting_pool.py): "
+        "the batched ragged search path uploads each (segment, term) "
+        "posting list ONCE into a paged HBM region and scores "
+        "page-resident coalesced batches as one jitted gather-and-"
+        "accumulate program over page tables — zero host→device "
+        "posting bytes on the warm path. Misses fall back per query to "
+        "the host ragged path and partial residency merges host tails "
+        "deterministically, so results are BIT-IDENTICAL on or off at "
+        "any worker/shard/cache setting (off = the parity oracle) and "
+        "the setting stays out of the result cache's settings digest",
+        scope=Scope.GLOBAL)
+declare("serene_posting_pages", 4096, int,
+        "page budget of the posting pool's device region (pages of "
+        "1024 postings; docs+tfs = 8 KiB/page, so the default 4096 is "
+        "32 MiB of HBM). The region never exceeds the "
+        "serene_device_cache_mb byte cap — the pool is carved out of "
+        "the device-cache budget, not added to it. Least-recently-used "
+        "terms evict past the budget; size from sdb_posting_pool() "
+        "occupancy/hit rows",
+        scope=Scope.GLOBAL, validator=lambda v: max(8, int(v)))
 declare("serene_device_telemetry", True, bool,
         "device telemetry (obs/device.py): the XLA compile ledger "
         "(per-program-family compile counts/wall time, program-cache "

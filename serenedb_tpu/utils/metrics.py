@@ -318,6 +318,36 @@ SEARCH_BATCH_COALESCED = REGISTRY.gauge(
     "SearchBatchCoalesced",
     "queries that shared their scoring dispatch with at least one other "
     "query (the batching win; singleton dispatches don't count)")
+POSTING_POOL_HITS = REGISTRY.gauge(
+    "PostingPoolHits",
+    "posting-pool term lookups served by pages already resident in the "
+    "device region (search/posting_pool.py) — each hit is one term's "
+    "postings the batched ragged path did NOT re-upload")
+POSTING_POOL_MISSES = REGISTRY.gauge(
+    "PostingPoolMisses",
+    "posting-pool term lookups that allocated and wrote fresh pages "
+    "(first touch of a (segment, term) key, or re-entry after eviction)")
+POSTING_POOL_EVICTIONS = REGISTRY.gauge(
+    "PostingPoolEvictions",
+    "resident terms evicted LRU from the posting pool to make room "
+    "under the serene_posting_pages budget")
+POSTING_POOL_PAGES_USED = REGISTRY.gauge(
+    "PostingPoolPagesUsed",
+    "pages of the device posting region currently holding resident "
+    "terms (live; budget is serene_posting_pages)")
+POSTING_POOL_BYTES = REGISTRY.gauge(
+    "PostingPoolBytes",
+    "bytes of the device posting region currently occupied by resident "
+    "terms (live; PagesUsed x page size x docs+tfs)")
+POSTING_POOL_DEVICE_QUERIES = REGISTRY.gauge(
+    "PostingPoolDeviceQueries",
+    "batched ragged queries scored fully on device because every slice "
+    "was page-resident (final top-k left the device sorted)")
+POSTING_POOL_PARTIAL = REGISTRY.gauge(
+    "PostingPoolPartialQueries",
+    "batched ragged queries whose resident prefix scored on device "
+    "with the host merging the non-resident tail slices (deterministic "
+    "same-order f32 adds — bit-identical to the all-host path)")
 SHARD_PIPELINES = REGISTRY.gauge(
     "ShardPipelines",
     "per-shard pipeline executions launched by the sharded execution "

@@ -1,0 +1,425 @@
+"""Device-resident paged postings (ISSUE 16, search/posting_pool.py).
+
+Contract under test: `serene_posting_pool` (default on) moves WHERE
+ragged-admitted postings are scored — page-resident coalesced batches
+run as ONE jitted gather-and-segment-accumulate program over the pool's
+HBM page tables — but never a result bit: every cell of the pool on/off
+× workers × shards × cache matrix is bit-identical to the host ragged
+oracle, including partial residency (device prefix + host suffix merge)
+and LRU eviction mid-stream under a starved page budget. The transfer
+ledger proves the perf claim: a warm repeat of a coalesced batch
+uploads ZERO host→device posting bytes and performs exactly ONE
+dispatch. Observability: pool gauges, `sdb_posting_pool()` rows keyed
+by publication, the `GET /device` posting_pool section, and quiet
+DeviceRecompileStorms across batch sizes.
+"""
+
+import json
+import threading
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.obs import device as obs_device
+from serenedb_tpu.ops import bm25 as bm25_ops
+from serenedb_tpu.search import posting_pool
+from serenedb_tpu.search.analysis import get_analyzer
+from serenedb_tpu.search.batcher import SearchBatcher
+from serenedb_tpu.search.posting_pool import POOL
+from serenedb_tpu.search.query import parse_query
+from serenedb_tpu.search.searcher import SegmentSearcher
+from serenedb_tpu.search.segment import build_field_index
+from serenedb_tpu.utils import faults, metrics
+from serenedb_tpu.utils.config import REGISTRY as SETTINGS
+
+WORDS = ("apple banana cherry quick brown fox jumps over lazy dog search "
+         "engine database index query term").split()
+
+
+class _global:
+    """Set a GLOBAL setting for the scope, restore on exit."""
+
+    def __init__(self, name, value):
+        self.name, self.value = name, value
+
+    def __enter__(self):
+        self.old = SETTINGS.get_global(self.name)
+        SETTINGS.set_global(self.name, self.value)
+
+    def __exit__(self, *exc):
+        SETTINGS.set_global(self.name, self.old)
+        return False
+
+
+@pytest.fixture(autouse=True)
+def _ragged_regime(monkeypatch):
+    """Force the packed-plane regime (no dense matmul) so the ragged
+    resolver — and with it the posting pool — actually fires on these
+    small corpora, and start every test from an empty pool region."""
+    monkeypatch.setattr(bm25_ops, "DENSE_HBM_BUDGET", 0)
+    POOL.clear()
+    yield
+    POOL.clear()
+
+
+def _make_db(n=600, seed=7):
+    rng = np.random.default_rng(seed)
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT)")
+    vals = []
+    for i in range(n):
+        if i % 97 == 0:
+            vals.append(f"({i}, NULL)")
+        elif i % 13 == 0:
+            vals.append(f"({i}, 'apple banana apple')")   # tie-heavy
+        else:
+            body = " ".join(rng.choice(WORDS, rng.integers(3, 24)))
+            vals.append(f"({i}, '{body}')")
+    c.execute("INSERT INTO docs VALUES " + ", ".join(vals))
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    return db
+
+
+@pytest.fixture(scope="module")
+def db():
+    return _make_db()
+
+
+#: the PR 8 parity query set (tests/test_search_batch.py) plus two
+#: large-limit disjunctions — k past the MaxScore sparse path, so these
+#: are the queries that actually reach the ragged resolver and the pool
+QUERIES = [
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple' "
+     "ORDER BY s DESC LIMIT 10"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple & banana' "
+     "ORDER BY s DESC LIMIT 10"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body ## 'quick brown' "
+     "ORDER BY s DESC LIMIT 10"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple | dog' "
+     "AND id < 300 ORDER BY s DESC, id LIMIT 10"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'banana' "
+     "ORDER BY s DESC LIMIT 10"),
+    ("SELECT id FROM docs WHERE body @@ 'zzzznothing' "
+     "ORDER BY bm25(body) DESC LIMIT 5"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'quick & fox' "
+     "ORDER BY s DESC LIMIT 5000"),
+    ("SELECT id, tfidf(body) AS s FROM docs WHERE body @@ 'cherry | dog' "
+     "ORDER BY s DESC LIMIT 10"),
+    ("SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'apple | dog' "
+     "ORDER BY s DESC, id LIMIT 5000"),
+    ("SELECT id, bm25(body) AS s FROM docs "
+     "WHERE body @@ 'banana | fox | engine' ORDER BY s DESC, id LIMIT 5000"),
+]
+
+
+def _seg(n=700, seed=11, vocab=WORDS):
+    an = get_analyzer("text")
+    rng = np.random.default_rng(seed)
+    docs = [" ".join(rng.choice(vocab, rng.integers(3, 24)))
+            for _ in range(n)]
+    fi = build_field_index(docs, an)
+    return SegmentSearcher(fi, an, len(docs)), an
+
+
+def _bits_equal(a, b):
+    return (np.array_equal(a[0].view(np.uint32), b[0].view(np.uint32))
+            and np.array_equal(a[1], b[1]))
+
+
+# -- parity ---------------------------------------------------------------
+
+
+def test_parity_matrix_pool(db):
+    """pool on/off × workers 1/4 × shards 1/4 × result cache on/off:
+    every combination returns the pool-off serial oracle's exact rows
+    (scores included — engine rows surface the f32 bits)."""
+    oc = db.connect()
+    oc.execute("SET serene_result_cache = off")
+    oc.execute("SET serene_workers = 1")
+    with _global("serene_posting_pool", False):
+        oracle = {q: oc.execute(q).rows() for q in QUERIES}
+    for pool in (True, False):
+        with _global("serene_posting_pool", pool):
+            for workers in (1, 4):
+                for shards in (1, 4):
+                    for cache in ("on", "off"):
+                        c = db.connect()
+                        c.execute(f"SET serene_workers = {workers}")
+                        c.execute(f"SET serene_shards = {shards}")
+                        c.execute(f"SET serene_result_cache = {cache}")
+                        for q in QUERIES:
+                            got = c.execute(q).rows()
+                            assert got == oracle[q], \
+                                (pool, workers, shards, cache, q)
+    # the on-cells actually exercised the device tier
+    assert metrics.POSTING_POOL_DEVICE_QUERIES.value > 0
+
+
+def test_searcher_parity_and_warm_hits():
+    """Searcher-level: pool on vs off bit parity on cold AND warm
+    dispatches; the warm repeat serves every slice from resident pages
+    (hits only, no new misses)."""
+    seg, an = _seg()
+    nodes = [parse_query(q, an)
+             for q in ("apple | dog", "banana | fox | dog",
+                       "cherry | term | engine", "apple")]
+    with _global("serene_posting_pool", False):
+        ref = seg.topk_batch(nodes, 5000, ragged=True)
+    cold = seg.topk_batch(nodes, 5000, ragged=True)
+    m0 = metrics.POSTING_POOL_MISSES.value
+    warm = seg.topk_batch(nodes, 5000, ragged=True)
+    assert metrics.POSTING_POOL_MISSES.value == m0   # all resident
+    for i in range(len(nodes)):
+        assert _bits_equal(cold[i], ref[i]), i
+        assert _bits_equal(warm[i], ref[i]), i
+
+
+def test_partial_residency_and_eviction_mid_stream():
+    """A starved page budget forces partial residency (device scores
+    the resident slice prefix, the host merges the suffix) and LRU
+    eviction between queries — results stay bit-identical to the
+    pool-off oracle throughout the stream."""
+    seg, an = _seg(n=3000, seed=5)
+    qs = ["apple | banana | cherry | quick | brown | fox",
+          "dog | fox | lazy | brown | jumps | over",
+          "search | engine | database | index | query | term",
+          "apple | dog",
+          "query | term | jumps | over | lazy | cherry"]
+    nodes = [parse_query(q, an) for q in qs]
+    with _global("serene_posting_pool", False):
+        ref = [seg.topk_batch([n], 5000, ragged=True)[0] for n in nodes]
+    with _global("serene_posting_pages", 8):
+        e0 = metrics.POSTING_POOL_EVICTIONS.value
+        p0 = metrics.POSTING_POOL_PARTIAL.value
+        for rep in range(2):     # second sweep re-faults evicted terms
+            for i, n in enumerate(nodes):
+                got = seg.topk_batch([n], 5000, ragged=True)[0]
+                assert _bits_equal(got, ref[i]), (rep, qs[i])
+        assert metrics.POSTING_POOL_EVICTIONS.value > e0
+        assert metrics.POSTING_POOL_PARTIAL.value > p0
+        assert POOL.stats()["pages_used"] <= 8
+
+
+# -- the perf claim: warm repeats never leave HBM -------------------------
+
+
+def test_warm_repeat_zero_upload_one_dispatch():
+    """Transfer-ledger proof of the tentpole: a warm repeat of the same
+    coalesced batch moves ZERO host→device bytes and performs exactly
+    ONE device dispatch (the batched gather-accumulate program)."""
+    seg, an = _seg()
+    nodes = [parse_query(q, an)
+             for q in ("apple | dog", "banana | fox | dog",
+                       "cherry | term | engine")]
+    out1 = seg.topk_batch(nodes, 5000, ragged=True)   # faults pages in
+    seg.topk_batch(nodes, 5000, ragged=True)          # warms batch memo
+
+    def _sums():
+        snap = obs_device.LEDGER.snapshot().values()
+        return (sum(s["bytes_up"] for s in snap),
+                sum(s["dispatches"] for s in snap))
+    up0, disp0 = _sums()
+    out3 = seg.topk_batch(nodes, 5000, ragged=True)
+    up1, disp1 = _sums()
+    assert up1 - up0 == 0, "warm repeat uploaded posting bytes"
+    assert disp1 - disp0 == 1, "warm repeat was not a single dispatch"
+    for i in range(len(nodes)):
+        assert _bits_equal(out3[i], out1[i]), i
+
+
+def test_no_recompile_storm_across_batch_sizes():
+    """Coalesced batches arrive at every size; the pow2-padded program
+    axes keep the compile ledger quiet (no DeviceRecompileStorms).
+    Starts from a cleared ledger — the storm window is per-family and
+    minutes wide, so compiles from unrelated suite tests would prime
+    it."""
+    obs_device.PROGRAMS.clear()
+    seg, an = _seg()
+    nodes = [parse_query(q, an)
+             for q in ("apple | dog", "banana | fox", "cherry | term",
+                       "apple | engine", "dog | lazy | fox")]
+    s0 = metrics.DEVICE_RECOMPILE_STORMS.value
+    for size in (1, 2, 3, 4, 5):
+        seg.topk_batch(nodes[:size], 5000, ragged=True)
+    assert metrics.DEVICE_RECOMPILE_STORMS.value == s0
+
+
+# -- bounded memos (satellite 1) ------------------------------------------
+
+
+def test_ragged_memo_charge_clears_past_cap(monkeypatch):
+    """Crossing RAGGED_MEMO_BYTES_CAP clears every ragged memo —
+    plan slices, candidate tables, plain-store slices, and the pool's
+    batch descriptor memo — then restarts the byte count."""
+    monkeypatch.setattr(SegmentSearcher, "RAGGED_MEMO_BYTES_CAP", 100)
+    plan = types.SimpleNamespace(_ragged_slices={"x": 1},
+                                 _ragged_accum=("c", ["i"]))
+    store = types.SimpleNamespace(
+        _plan_cache={"k": plan, "none": None},
+        _ragged_plain={(2, 7): ("d", "t", None)},
+        _pool_batch_memo={"mk": {"si": 1}})
+    SegmentSearcher._ragged_memo_charge(store, 60)
+    assert store._ragged_memo_bytes == 60
+    assert hasattr(plan, "_ragged_accum")          # under cap: kept
+    SegmentSearcher._ragged_memo_charge(store, 60)
+    assert store._ragged_memo_bytes == 60          # reset to new charge
+    assert not hasattr(plan, "_ragged_accum")
+    assert not hasattr(plan, "_ragged_slices")
+    assert store._ragged_plain == {}
+    assert store._pool_batch_memo == {}
+
+
+def test_ragged_memo_bounded_in_flight(monkeypatch):
+    """Integration bound: under a small cap, a stream of novel query
+    shapes keeps the accounted memo bytes at/below the cap and the pool
+    batch memo at/below its entry cap."""
+    monkeypatch.setattr(SegmentSearcher, "RAGGED_MEMO_BYTES_CAP", 32 << 10)
+    seg, an = _seg()
+    terms = ["apple", "banana", "cherry", "dog", "fox", "term",
+             "engine", "lazy", "quick", "brown", "search", "index"]
+    store = seg._device_store()
+    for i in range(len(terms) - 1):
+        node = parse_query(f"{terms[i]} | {terms[i + 1]}", an)
+        seg.topk_batch([node], 5000, ragged=True)
+        assert getattr(store, "_ragged_memo_bytes", 0) <= 32 << 10
+        memo = getattr(store, "_pool_batch_memo", {})
+        assert len(memo) <= posting_pool._BATCH_MEMO_CAP
+
+
+# -- error isolation under the device tier (satellite 3) ------------------
+
+
+class _PoisonWrap:
+    """Real scoring, except batches containing the poison node raise —
+    the batcher must serial-retry every member on its own thread."""
+
+    def __init__(self, seg, poison):
+        self.seg, self.poison = seg, poison
+
+    def topk_batch(self, nodes, k, scorer="bm25", mesh_n=0, ragged=False):
+        if any(n is self.poison for n in nodes):
+            raise ValueError("poisoned query")
+        return self.seg.topk_batch(nodes, k, scorer, mesh_n=mesh_n,
+                                   ragged=ragged)
+
+    def topk(self, node, k, scorer="bm25", mesh_n=0):
+        return self.topk_batch([node], k, scorer, mesh_n)[0]
+
+    def probe_topk(self, node, k, scorer="bm25", mesh_n=0):
+        return None
+
+
+def test_batcher_poison_isolated_under_device_tier(db):
+    """A poisoned query coalesced with pool-served siblings fails ONLY
+    its own caller; every sibling's serial retry returns the oracle's
+    exact bits."""
+    seg, an = _seg()
+    good = [parse_query(q, an)
+            for q in ("apple | dog", "banana | fox | dog")]
+    poison = parse_query("cherry | term", an)
+    ref = [seg.topk_batch([n], 5000, ragged=True)[0] for n in good]
+    wrap = _PoisonWrap(seg, poison)
+    b = SearchBatcher()
+    results, errors = {}, {}
+    bar = threading.Barrier(3)
+
+    def run(node, slot):
+        bar.wait(timeout=30)
+        try:
+            results[slot] = b.submit(wrap, node, 5000, "bm25", 0, 0.5, 128)
+        except ValueError as e:
+            errors[slot] = e
+    ts = [threading.Thread(target=run, args=(n, i))
+          for i, n in enumerate(good + [poison])]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert set(errors) == {2}, "poison must fail exactly its own caller"
+    for i in range(2):
+        out, _stats = results[i]
+        assert _bits_equal(out, ref[i]), i
+
+
+def test_pool_dispatch_fault_falls_back_serially():
+    """An armed posting_pool_dispatch fault poisons the coalesced device
+    dispatch; the batcher's serial retry (host oracle path) still hands
+    every caller bit-exact results — the pool can never fail a query."""
+    seg, an = _seg()
+    nodes = [parse_query(q, an)
+             for q in ("apple | dog", "banana | fox | dog",
+                       "cherry | term")]
+    ref = []
+    for n in nodes:
+        with _global("serene_posting_pool", False):
+            ref.append(seg.topk_batch([n], 5000, ragged=True)[0])
+    faults.arm_from_spec("posting_pool_dispatch")
+    b = SearchBatcher()
+    results = {}
+    bar = threading.Barrier(len(nodes))
+
+    def run(node, slot):
+        bar.wait(timeout=30)
+        results[slot] = b.submit(seg, node, 5000, "bm25", 0, 0.5, 128)
+    ts = [threading.Thread(target=run, args=(n, i))
+          for i, n in enumerate(nodes)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert len(results) == len(nodes)
+    for i in range(len(nodes)):
+        out, _stats = results[i]
+        assert _bits_equal(out, ref[i]), i
+
+
+# -- observability surfaces (satellite 2) ---------------------------------
+
+
+def test_sql_and_http_surfaces(db):
+    """sdb_posting_pool() rows resolve the publication and count the
+    resident pages; sdb_device() folds the region into hbm_bytes_est;
+    GET /device and /_stats carry the posting_pool section."""
+    c = db.connect()
+    c.execute("SET serene_result_cache = off")
+    c.execute("SELECT id, bm25(body) AS s FROM docs "
+              "WHERE body @@ 'apple | dog' ORDER BY s DESC, id LIMIT 5000")
+    rows = c.execute(
+        "SELECT table_name, token, data_version, mutation_epoch, segment, "
+        "terms, pages, bytes, hits FROM sdb_posting_pool").rows()
+    assert rows, "pool-engaging query must leave resident pages"
+    assert any(r[0] == "docs" and r[5] > 0 and r[6] > 0 for r in rows), rows
+    st = obs_device.stats_section()
+    assert st["posting_pool"]["pages_used"] > 0
+    assert st["posting_pool"]["resident_terms"] > 0
+    pool_hbm = sum(POOL.device_bytes().values())
+    dev = c.execute("SELECT sum(hbm_bytes_est) FROM sdb_device").rows()
+    assert dev[0][0] >= pool_hbm > 0
+    from serenedb_tpu.server.http_server import HttpServer
+    srv = HttpServer(c.db)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        payload = json.load(urllib.request.urlopen(base + "/device"))
+        assert payload["posting_pool"]["pages_used"] > 0
+        stats = json.load(urllib.request.urlopen(base + "/_stats"))
+        assert "posting_pool" in stats["device"]
+    finally:
+        srv.stop()
+
+
+def test_pool_off_stays_dark(db):
+    """With serene_posting_pool=off nothing touches the pool: no pages,
+    no gauges moving — the host ragged path runs alone."""
+    with _global("serene_posting_pool", False):
+        d0 = metrics.POSTING_POOL_DEVICE_QUERIES.value
+        m0 = metrics.POSTING_POOL_MISSES.value
+        c = db.connect()
+        c.execute("SET serene_result_cache = off")
+        c.execute("SELECT id, bm25(body) AS s FROM docs "
+                  "WHERE body @@ 'apple | dog' "
+                  "ORDER BY s DESC, id LIMIT 5000")
+        assert metrics.POSTING_POOL_DEVICE_QUERIES.value == d0
+        assert metrics.POSTING_POOL_MISSES.value == m0
+        assert c.execute("SELECT count(*) FROM sdb_posting_pool").rows() \
+            == [(0,)]
