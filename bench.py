@@ -1335,6 +1335,140 @@ def bench_device_pipeline() -> float:
     return headline
 
 
+def bench_fused_admission() -> float:
+    """Fused-tier admission widening (ISSUE 17 tentpole): the
+    join-bearing slice of the sqllogic corpus runs twice — with the
+    PR-7 admission walls restored (`serene_device_fused_ext = off`)
+    and with extended admission on (string/FILTER/DISTINCT aggregates,
+    outer joins, residual join predicates, chained agg→top-N) — and
+    the admitted fraction of fused-eligible join→agg plans is read
+    from the compile ledger's `fused`/`fused_chain` lookups vs the
+    per-reason decline counters (the same numbers `sdb_device()`
+    serves). Parity is implicit: every corpus file's expected output
+    IS the host oracle's. A chained leg then proves whole-query
+    residency: the warm repeat of ORDER BY count(*) LIMIT over a fused
+    aggregate must move ZERO host→device bytes — the stage-1
+    accumulators hand off to the top-N program inside HBM. Returns
+    admitted_after / admitted_before (>1 ⇔ walls demolished)."""
+    import glob as _glob
+
+    import numpy as np
+
+    from serenedb_tpu.columnar.column import Batch, Column
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.exec.tables import MemTable
+    from serenedb_tpu.obs import device as obs_device
+    from serenedb_tpu.utils import metrics as _metrics
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tests.sqllogic_runner import run_test_file
+
+    root = os.path.join(here, "tests", "sqllogic")
+    files = sorted(
+        _glob.glob(os.path.join(root, "*.test"))
+        + _glob.glob(os.path.join(root, "any", "**", "*.test"),
+                     recursive=True)
+        + _glob.glob(os.path.join(root, "sdb", "**", "*.test"),
+                     recursive=True))
+    corpus = []
+    for path in files:
+        with open(path) as f:
+            if "JOIN" in f.read():
+                corpus.append(path)
+
+    def counts() -> tuple[int, int]:
+        fams = {p["family"]: p
+                for p in obs_device.stats_section()["programs"]}
+        admits = 0
+        for fam in ("fused", "fused_chain"):
+            f = fams.get(fam, {})
+            admits += int(f.get("hits", 0)) + int(f.get("misses", 0))
+        return admits, sum(obs_device.fused_declines().values())
+
+    def run_corpus(ext_on: bool) -> tuple[int, int, float, int]:
+        import tempfile
+        a0, d0 = counts()
+        fails = 0
+        cwd = os.getcwd()
+        for path in corpus:
+            db = Database()
+            try:
+                with tempfile.TemporaryDirectory() as tmp:
+                    os.chdir(tmp)   # relative COPY paths land here
+                    conn = db.connect()
+                    conn.execute("SET serene_device = 'tpu'")
+                    conn.execute("SET serene_device_fused = on")
+                    conn.execute("SET serene_device_fused_ext = "
+                                 + ("on" if ext_on else "off"))
+                    fails += len(run_test_file(conn, path, tmpdir=tmp))
+            finally:
+                os.chdir(cwd)
+                db.close()
+        a1, d1 = counts()
+        admits, declines = a1 - a0, d1 - d0
+        return admits, declines, admits / max(1, admits + declines), fails
+
+    adm_b, dec_b, frac_b, fail_b = run_corpus(ext_on=False)
+    adm_a, dec_a, frac_a, fail_a = run_corpus(ext_on=True)
+    assert fail_b == 0 and fail_a == 0, \
+        f"sqllogic corpus diverged under fused tier: {fail_b}/{fail_a}"
+    assert adm_a > adm_b, \
+        f"extended admission did not widen the tier: {adm_b} → {adm_a}"
+
+    # chained leg: fused agg → top-N with the handoff in HBM
+    rng = np.random.default_rng(71)
+    npr, nb, keyspace = 200_000, 50_000, 100_000
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE fap (jk BIGINT, g INT, v BIGINT)")
+    c.execute("CREATE TABLE fab (k BIGINT, w BIGINT)")
+    db.schemas["main"].tables["fap"] = MemTable("fap", Batch.from_pydict({
+        "jk": Column.from_numpy(
+            rng.integers(0, keyspace, npr, dtype=np.int64)),
+        "g": Column.from_numpy(rng.integers(0, 64, npr).astype(np.int32)),
+        "v": Column.from_numpy(
+            rng.integers(-1000, 1000, npr, dtype=np.int64))}))
+    db.schemas["main"].tables["fab"] = MemTable("fab", Batch.from_pydict({
+        "k": Column.from_numpy(
+            rng.permutation(np.arange(nb, dtype=np.int64))),
+        "w": Column.from_numpy(
+            rng.integers(0, 100, nb, dtype=np.int64))}))
+    q = ("SELECT g, count(*) AS n, sum(v) FROM fap JOIN fab "
+         "ON fap.jk = fab.k GROUP BY g ORDER BY n DESC LIMIT 5")
+    c.execute("SET serene_device = 'tpu'")
+    c.execute("SET serene_device_fused = on")
+    chain0 = _metrics.REGISTRY.gauge("DeviceChainedStages").value
+    c.execute("SET serene_device_fused = off")
+    host = c.execute(q).rows()
+    c.execute("SET serene_device_fused = on")
+    dev = c.execute(q).rows()             # cold: uploads + two compiles
+    assert dev == host, "chained agg→top-N diverged from host"
+    assert _metrics.REGISTRY.gauge("DeviceChainedStages").value > chain0, \
+        "chained device path did not fire"
+    ups0 = _metrics.DEVICE_TRANSFERS_UP.value
+    t0 = time.perf_counter()
+    warm = c.execute(q).rows()            # warm: both stages in HBM
+    warm_s = time.perf_counter() - t0
+    assert warm == host
+    ups1 = _metrics.DEVICE_TRANSFERS_UP.value
+    assert ups1 == ups0, \
+        f"warm chained repeat moved host→device bytes ({ups1 - ups0})"
+    db.close()
+
+    _EXTRA["corpus_files"] = len(corpus)
+    _EXTRA["admitted_before"] = adm_b
+    _EXTRA["declined_before"] = dec_b
+    _EXTRA["admitted_frac_before"] = round(frac_b, 4)
+    _EXTRA["admitted_after"] = adm_a
+    _EXTRA["declined_after"] = dec_a
+    _EXTRA["admitted_frac_after"] = round(frac_a, 4)
+    _EXTRA["chained_warm_s"] = round(warm_s, 4)
+    _EXTRA["chained_warm_uploads"] = int(ups1 - ups0)
+    _EXTRA["parity"] = "identical"
+    return frac_a / max(frac_b, 1e-9) if frac_b else float(adm_a)
+
+
 def bench_search_batch() -> float:
     """Batched ragged search serving (ISSUE 8 tentpole): aggregate QPS of
     concurrent 2-term top-10 searches over the 1M-doc synthetic corpus,
@@ -2005,6 +2139,7 @@ SHAPES = {
     "concurrency": bench_concurrency,
     "result_cache": bench_result_cache,
     "device_pipeline": bench_device_pipeline,
+    "fused_admission": bench_fused_admission,
     "device_observe": bench_device_observe,
     "search_batch": bench_search_batch,
     "paged_search": bench_paged_search,
@@ -2027,14 +2162,15 @@ HEADLINE_SHAPES = ("q1", "hits", "bm25", "bm25_1m", "bm25_8m")
 HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
                "profile_overhead", "trace_overhead", "mem_overhead",
                "concurrency", "result_cache", "device_pipeline",
-               "device_observe", "search_batch", "paged_search",
-               "shard_exec", "multichip")
+               "fused_admission", "device_observe", "search_batch",
+               "paged_search", "shard_exec", "multichip")
 
 #: host shapes that nevertheless run jitted programs — with the device
 #: probe down their children must pin JAX_PLATFORMS=cpu, because
 #: initializing the tunneled backend with the tunnel dead is a hard hang
-JIT_HOST_SHAPES = ("device_pipeline", "device_observe", "search_batch",
-                   "paged_search", "shard_exec", "multichip")
+JIT_HOST_SHAPES = ("device_pipeline", "fused_admission", "device_observe",
+                   "search_batch", "paged_search", "shard_exec",
+                   "multichip")
 
 #: shapes that measure the in-program multi-chip combine: their child
 #: always runs on a 4-device VIRTUAL cpu mesh

@@ -192,6 +192,29 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_POSTING_POOL=on \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc14=$?
 
+# Pass 16 is the fused-admission parity leg, two runs over the new
+# admission/chaining suites: (a) the whole fused tier forced OFF
+# globally — every widened shape (string/FILTER/DISTINCT aggregates,
+# outer joins, residual predicates, chained agg→top-N) answers from
+# the host oracle and the suites' differential assertions still
+# exercise both paths via their explicit session SETs; (b) the tier ON
+# with SERENE_DEVICE_FUSED_EXT=off — the PR-7 admission walls
+# restored, proving the widening is strictly additive: old shapes
+# still admit, new shapes decline cleanly to bit-identical host runs.
+echo "== fused admission parity pass (fused off / ext off) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_DEVICE_FUSED=off \
+    python -m pytest tests/test_fused_admission.py \
+    tests/test_device_pipeline.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc16=$?
+if [ "$rc16" -eq 0 ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_DEVICE_FUSED_EXT=off \
+        python -m pytest tests/test_fused_admission.py \
+        tests/test_device_pipeline.py -q \
+        -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+    rc16=$?
+fi
+
 # Structural grep lint: every jit compilation in the engine must route
 # through the PR 15 compile ledger (obs/device.compiled) so the program
 # cache stays bounded and observable — a bare jax.jit( call site
@@ -214,6 +237,15 @@ if ! grep -q 'obs_device\.compiled(\s*$\|obs_device\.compiled(' \
     echo "FAIL: posting_pool.py does not compile through obs.device.compiled"
     rc15=1
 fi
+# PR 17's widened fused tier: the chained agg→top-N stage-2 builder is
+# the newest program family — it must compile (and donate the stage-1
+# buffers) through the ledger, never via a bare jit
+if ! grep -q '"fused_chain"' serenedb_tpu/exec/device_pipeline.py || \
+        ! grep -q 'obs_device\.compiled(' \
+            serenedb_tpu/exec/device_pipeline.py; then
+    echo "FAIL: chained fused top-N does not compile through obs.device.compiled"
+    rc15=1
+fi
 
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
@@ -229,4 +261,5 @@ fi
 [ "$rc12" -ne 0 ] && exit "$rc12"
 [ "$rc13" -ne 0 ] && exit "$rc13"
 [ "$rc14" -ne 0 ] && exit "$rc14"
+[ "$rc16" -ne 0 ] && exit "$rc16"
 exit "$rc15"

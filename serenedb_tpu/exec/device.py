@@ -32,16 +32,29 @@ _ARITH = {"op+", "op-", "op*", "op/", "op%"}
 
 
 class NotCompilable(Exception):
-    pass
+    """Expression/plan shape the device compiler declines — the caller
+    falls back to the host path. `reason` is a short category slug for
+    the per-reason decline gauges (never query text)."""
+
+    def __init__(self, msg: str = "", reason: str = "not_compilable"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 class DeviceExpr:
     """Compiled closure producing (value, valid) given the env of device
     columns; env maps scan-column index → DeviceColumn."""
 
-    def __init__(self, fn: Callable, inputs: list[int]):
+    def __init__(self, fn: Callable, inputs: list[int],
+                 consts: tuple = ()):
         self.fn = fn          # (list of (data, mask)) -> (value, valid)
         self.inputs = inputs  # scan column indices, order matches fn args
+        #: every DATA-DEPENDENT constant the closure bakes into its trace
+        #: (today: the dictionary-code thresholds of string comparisons).
+        #: A program cache key that drops the publication tuple MUST
+        #: include these, or a stale executable could serve a new
+        #: dictionary generation with the old thresholds.
+        self.consts = consts
 
 
 def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
@@ -54,6 +67,7 @@ def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
     """
     inputs: list[int] = []
     index_of: dict[int, int] = {}
+    consts: list = []
 
     def slot(col_index: int) -> int:
         if col_index not in index_of:
@@ -193,6 +207,7 @@ def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
         hi = int(np.searchsorted(ds, s, side="right"))
         exact = lo < len(ds) and ds[lo] == s
         sl = slot(col.index)
+        consts.append((col.index, op, lo, hi, exact))
 
         def fn(env, _sl=sl, _op=op, _lo=lo, _hi=hi, _exact=exact):
             codes, ok = env[_sl]
@@ -230,7 +245,7 @@ def compile_expr(expr: BoundExpr, col_types: list[dt.SqlType],
         return fn
 
     top = rec(expr)
-    return DeviceExpr(top, inputs)
+    return DeviceExpr(top, inputs, tuple(consts))
 
 
 def _m(ok):

@@ -96,6 +96,36 @@ def fused_enabled(settings) -> bool:
         return False
 
 
+def fused_ext_enabled(settings) -> bool:
+    """PR 17 extended admission (strings/DISTINCT/FILTER/residual/outer
+    joins + chained stage handoff); off restores the PR 7 walls."""
+    try:
+        return bool(settings.get("serene_device_fused_ext"))
+    except KeyError:  # pragma: no cover
+        return False
+
+
+def _pow2_rows(n: int) -> int:
+    """pow2 row bucket (floor BLOCK_ROWS): every upload in the fused
+    path pads to this, so the number of DISTINCT traced shapes per
+    program family grows O(log rows) instead of O(rows / BLOCK_ROWS) —
+    the admission-wall removals multiply program axes, and without the
+    bucketing that product would storm the compile ledger."""
+    b = 1024
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _pow2_int(n: int, floor: int = 8) -> int:
+    """pow2 bucket for non-row axes (DISTINCT value spaces): same
+    compile-storm rationale as _pow2_rows, smaller floor."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
 # -- publication-keyed device column cache ----------------------------------
 
 
@@ -135,12 +165,35 @@ class DeviceColumnCache:
         self._bytes = 0
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _trade_on() -> bool:
+        try:
+            return bool(_settings_registry.get_global(
+                "serene_device_cache_trade"))
+        except KeyError:  # pragma: no cover
+            return False
+
     def _cap_bytes(self) -> int:
+        """Byte cap of THIS side of the device budget. With the
+        pressure trade on, the cap is the serene_device_cache_mb
+        envelope minus the posting pool's LIVE page bytes, floored at a
+        quarter of the envelope — the pool's residency squeezes the
+        column cache instead of a static carve-out, and vice versa via
+        shed_colder. Consults the pool's lock, so call it OUTSIDE
+        self._lock (the only cross-lock order is cache-unlocked →
+        pool; the pool never calls into this cache)."""
         try:
             mb = int(_settings_registry.get_global("serene_device_cache_mb"))
         except KeyError:  # pragma: no cover
             mb = 256
-        return mb << 20
+        env = mb << 20
+        if self._trade_on():
+            try:
+                from ..search.posting_pool import POOL
+                return max(env // 4, env - POOL.live_bytes())
+            except Exception:  # noqa: BLE001 — sizing only, never fatal
+                pass
+        return env
 
     def get(self, key: tuple):
         with self._lock:
@@ -161,6 +214,7 @@ class DeviceColumnCache:
         owner-generation rule below cannot see)."""
         dev_ids = obs_device.value_device_ids(value) \
             if obs_device.enabled() else ()
+        cap = self._cap_bytes()        # pool consult happens pre-lock
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -177,7 +231,26 @@ class DeviceColumnCache:
                 metrics.DEVICE_CACHE_EVICTIONS.add()
             self._entries[key] = [value, nbytes, dev_ids, 0, time.time()]
             self._bytes += nbytes
-            cap = self._cap_bytes()
+            over = self._bytes - cap
+            tail_idle_s = None
+            if over > 0:
+                for e in self._entries.values():
+                    tail_idle_s = time.time() - e[4]
+                    break
+        if over > 0 and self._trade_on() and tail_idle_s is not None:
+            # pressure trade: before shedding our own tail, offer the
+            # eviction to the posting pool's tail if it is COLDER (idle
+            # longer) — freed pages raise this cache's cap directly
+            try:
+                from ..search.posting_pool import POOL
+                pool_idle = POOL.tail_idle_ns()
+                if pool_idle is not None and \
+                        pool_idle > tail_idle_s * 1e9 and \
+                        POOL.shed_colder(int(tail_idle_s * 1e9), over):
+                    cap = self._cap_bytes()
+            except Exception:  # noqa: BLE001 — sizing only, never fatal
+                pass
+        with self._lock:
             while self._bytes > cap and len(self._entries) > 1:
                 _, e = self._entries.popitem(last=False)
                 self._bytes -= e[1]
@@ -193,9 +266,10 @@ class DeviceColumnCache:
     # -- telemetry surfaces (obs/device.py) ---------------------------------
 
     def stats(self) -> dict:
+        cap = self._cap_bytes()        # pool consult happens pre-lock
         with self._lock:
             return {"entries": len(self._entries), "bytes": self._bytes,
-                    "cap_bytes": self._cap_bytes()}
+                    "cap_bytes": cap}
 
     def device_bytes(self) -> dict[int, int]:
         """HBM occupancy estimate per device id: each entry's bytes
@@ -232,19 +306,25 @@ class DeviceColumnCache:
     # -- typed helpers ------------------------------------------------------
 
     def column(self, provider, pub: tuple, name: str, host_col_fn,
-               zrange: Optional[tuple]):
+               zrange: Optional[tuple], pad: Optional[int] = None):
         """Device tiles of one column (optionally row-sliced), cached by
         (publication, column, range). host_col_fn() materializes the host
-        column only on miss."""
+        column only on miss. `pad` pads rows to that multiple (the fused
+        tier's pow2 bucket) and keys a DISTINCT entry, so other tiers'
+        cached shapes are untouched."""
         obs_device.note_provider(pub[0], getattr(provider, "name", ""))
-        key = (pub, name, "col", zrange)
+        key = (pub, name, "col", zrange if pad is None
+               else (zrange, "pad", pad))
         dc = self.get(key)
         if dc is not None:
             return dc
         col = host_col_fn()
         if zrange is not None:
             col = col.slice(zrange[0], zrange[1])
-        dc = to_device_column(col)      # upload accounted at the funnel
+        if pad is None:
+            dc = to_device_column(col)  # upload accounted at the funnel
+        else:
+            dc = to_device_column(col, pad_multiple=pad)
         nbytes = int(dc.data.size * dc.data.dtype.itemsize) + \
             int(dc.mask.size)
         metrics.DEVICE_BYTES.add(nbytes)
@@ -362,9 +442,9 @@ def clear_codes_cache() -> None:
         _codes_bytes = 0
 
 
-def _rowmask_tiles(nrows: int) -> "jax.Array":
+def _rowmask_tiles(nrows: int, pad: Optional[int] = None) -> "jax.Array":
     import jax.numpy as jnp
-    n_pad = pad_len(nrows)
+    n_pad = pad_len(nrows) if pad is None else pad_len(nrows, pad)
     rm = np.zeros(n_pad, dtype=bool)
     rm[:nrows] = True
     return jnp.asarray(rm.reshape(-1, LANES))
@@ -496,14 +576,29 @@ class _Side:
 # -- fused Scan→Filter→Join→Aggregate ---------------------------------------
 
 
-def try_device_pipeline(node, ctx) -> Optional[Batch]:
-    """Attempt one-dispatch execution of AggregateNode over an inner
-    equi-join of two scans; None → host path (the parity oracle)."""
+#: join kinds the fused tier executes (outer kinds behind
+#: serene_device_fused_ext, single-dispatch only)
+_JOIN_KINDS = ("inner", "left", "right", "full")
+
+#: DISTINCT is a no-op for these (host _DISTINCT_INVARIANT ∩ _AGG_FUNCS)
+_DISTINCT_DROP = {"min", "max"}
+
+
+def _note_decline(reason: str, ctx, node) -> None:
+    obs_device.note_fused_decline(
+        reason, profile=getattr(ctx, "profile", None), node_key=id(node))
+
+
+def _admit_pipeline(node, ctx, decline):
+    """Shape recognition + admission walls shared by the aggregate hook
+    (try_device_pipeline) and the chained top-N hook. Returns
+    (join, probe_side, build_side, post_preds) or None — every None
+    taken AFTER the shape is recognizably a join pipeline went through
+    `decline` first."""
     from .plan import JoinNode, FilterNode
 
     settings = ctx.settings
-    if settings.get("serene_device") == "cpu" or not fused_enabled(settings):
-        return None
+    ext = fused_ext_enabled(settings)
     post_preds: list[BoundExpr] = []
     child = node.child
     while isinstance(child, FilterNode):
@@ -512,17 +607,35 @@ def try_device_pipeline(node, ctx) -> Optional[Batch]:
     if type(child) is not JoinNode:
         return None
     join = child
-    if join.kind != "inner" or not join.left_keys or \
-            join.residual is not None or join.merge_pairs:
-        return None
+
+    if not join.left_keys:
+        return decline("cross_join")
+    if join.merge_pairs:
+        return decline("merge_pairs")
+    if join.kind not in _JOIN_KINDS:
+        return decline("join_kind")
+    if join.kind != "inner" and not ext:
+        return decline("outer_join")
+    if join.residual is not None:
+        # an inner join's residual is exactly a post-join pair filter;
+        # under outer kinds it changes which rows null-extend, which
+        # the pre-filter decomposition cannot express
+        if not ext or join.kind != "inner":
+            return decline("residual")
+        post_preds = post_preds + _split_and(join.residual)
     probe_side = _unwrap_side(join.left)
     build_side = _unwrap_side(join.right)
     if probe_side is None or build_side is None:
-        return None
+        return decline("side_shape")
     for spec in node.aggs:
-        if spec.func not in _AGG_FUNCS or spec.distinct or \
-                spec.filter is not None or spec.order_by:
-            return None
+        if spec.func not in _AGG_FUNCS:
+            return decline("agg_func")
+        if spec.order_by:
+            return decline("agg_order_by")
+        if spec.distinct and not ext:
+            return decline("distinct")
+        if spec.filter is not None and not ext:
+            return decline("agg_filter")
     pscan = probe_side[0]
     if settings.get("serene_device") == "auto":
         try:
@@ -531,16 +644,43 @@ def try_device_pipeline(node, ctx) -> Optional[Batch]:
                 return None
         except NotImplementedError:
             return None
+    return join, probe_side, build_side, post_preds
+
+
+def try_device_pipeline(node, ctx) -> Optional[Batch]:
+    """Attempt one-dispatch execution of AggregateNode over an
+    equi-join of two scans; None → host path (the parity oracle).
+    Every None taken AFTER the shape is recognizably a join pipeline
+    records a per-reason decline (obs_device.note_fused_decline) so a
+    fallback is diagnosable from EXPLAIN ANALYZE / metrics."""
+    settings = ctx.settings
+    if settings.get("serene_device") == "cpu" or not fused_enabled(settings):
+        return None
+
+    def decline(reason: str) -> None:
+        _note_decline(reason, ctx, node)
+        return None
+
+    admitted = _admit_pipeline(node, ctx, decline)
+    if admitted is None:
+        return None
+    join, probe_side, build_side, post_preds = admitted
     try:
         return _run_fused(node, join, probe_side, build_side, post_preds,
                           ctx)
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"fused pipeline fell back to CPU: {e}")
-        return None
+        return decline(getattr(e, "reason", "not_compilable"))
 
 
 def _run_fused(node, join, probe_side, build_side,
-               post_preds: list[BoundExpr], ctx) -> Batch:
+               post_preds: list[BoundExpr], ctx, fetch: bool = True):
+    """Execute the fused pipeline. fetch=True (default) fetches program
+    outputs and finalizes to a host Batch. fetch=False is the chained-
+    stage entry: it returns (device_outputs, finalize_ctx) WITHOUT any
+    device→host readback, so a downstream fused stage (top-N) can
+    consume the accumulators in HBM — the sharded/collective branches
+    are skipped in that mode (single dispatch is always bit-identical)."""
     import jax.numpy as jnp
 
     prof = getattr(ctx, "profile", None)
@@ -566,11 +706,27 @@ def _run_fused(node, join, probe_side, build_side,
 
     # split the post-join conjuncts by side: a pair filter that reads
     # only probe (build) columns is exactly a probe (build) row filter
-    # under an inner join
+    # under an inner join — and under an OUTER join only on the side
+    # that never null-extends (a post filter on the null-extended side
+    # would drop rows the pre-filter instead turns into new
+    # null-extensions, so those decline)
     post_p: list[BoundExpr] = []
     post_b: list[BoundExpr] = []
     for p in post_preds:
-        (post_p if _side_of(p, nl) == 0 else post_b).append(p)
+        try:
+            sd = _side_of(p, nl)
+        except NotCompilable:
+            raise NotCompilable("post-join predicate spans both sides",
+                                "post_pred_cross_side")
+        (post_p if sd == 0 else post_b).append(p)
+    outer_left = join.kind in ("left", "full")    # probe rows null-extend
+    outer_right = join.kind in ("right", "full")  # build rows null-extend
+    if outer_left and post_b:
+        raise NotCompilable("post filter on null-extended build side",
+                            "outer_post_filter")
+    if outer_right and post_p:
+        raise NotCompilable("post filter on null-extended probe side",
+                            "outer_post_filter")
 
     # group keys: plain probe-side columns, direct-coded (dict codes /
     # small-range ints) — build-side or computed keys fall back
@@ -600,7 +756,8 @@ def _run_fused(node, join, probe_side, build_side,
                         dictionaries[ji] = col.dictionary
 
     note_dicts(post_p + post_b + list(node.group_exprs) +
-               [s.arg for s in node.aggs if s.arg is not None])
+               [s.arg for s in node.aggs if s.arg is not None] +
+               [s.filter for s in node.aggs if s.filter is not None])
 
     # scan-level predicates compile against the scan schema; their input
     # slots translate into the join namespace (probe scan col i == join
@@ -631,22 +788,94 @@ def _run_fused(node, join, probe_side, build_side,
                                               pscan, dictionaries)
     group_mode = bool(node.group_exprs)
 
-    # aggregate plans: (spec, side, compiled arg | None)
+    # aggregate plans: (spec, side, compiled arg | None), plus the PR 17
+    # sidecars — per-agg FILTER masks (same side as the arg; an extra
+    # predicate ANDed into the value-validity mask), count_star FILTER
+    # as its own accumulator column on the filter's side, and DISTINCT
+    # presence-grid plans over plain probe-side columns
+    ext = fused_ext_enabled(ctx.settings)
     agg_plans: list[tuple] = []
-    for spec in node.aggs:
+    agg_filters: dict[int, DeviceExpr] = {}
+    star_filter: dict[int, int] = {}       # si → side of the filter
+    distinct_sis: list[int] = []
+    for si, spec in enumerate(node.aggs):
+        fe = None
+        fside = 0
+        if spec.filter is not None:
+            _check_host_eval_safe([spec.filter])
+            fside = _side_of(spec.filter, nl)
+            fe = compile_expr(spec.filter, join_types, dictionaries)
         if spec.func == "count_star":
-            agg_plans.append((spec, 0, None))
+            if fe is not None:
+                star_filter[si] = fside
+                agg_filters[si] = fe
+                agg_plans.append((spec, fside, None))
+            else:
+                agg_plans.append((spec, 0, None))
             continue
         side = _side_of(spec.arg, nl)
+        if fe is not None:
+            if fside != side:
+                raise NotCompilable(
+                    "FILTER predicate on the other join side",
+                    "filter_cross_side")
+            agg_filters[si] = fe
         t = spec.arg.type
+        if spec.distinct and spec.func not in _DISTINCT_DROP:
+            distinct_sis.append(si)
         if spec.func in ("sum", "avg"):
             if not t.is_integer:
-                raise NotCompilable(f"{spec.func} over {t} (exactness)")
+                raise NotCompilable(f"{spec.func} over {t} (exactness)",
+                                    "agg_type")
         elif spec.func in ("min", "max"):
-            if not (t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE)):
-                raise NotCompilable(f"{spec.func} over {t}")
+            if not (t.is_integer or
+                    t.id in (dt.TypeId.BOOL, dt.TypeId.DATE)):
+                # sorted dictionaries give strings a total order on
+                # int32 codes: min/max over codes, decode at finalize
+                if not (ext and t.is_string and
+                        isinstance(spec.arg, BoundColumn) and
+                        dictionaries.get(spec.arg.index) is not None):
+                    raise NotCompilable(f"{spec.func} over {t}",
+                                        "agg_type")
         agg_plans.append((spec, side,
                           compile_expr(spec.arg, join_types, dictionaries)))
+
+    # DISTINCT (count/sum/avg): a (group, value) presence grid over the
+    # probe side's direct-coded values — count = nonzero presences per
+    # group, sum = Σ value · present recombined host-side in int64.
+    # Build-side args have no per-output-row value representation in
+    # the probe-phase scatter, so they decline.
+    distinct_plans: dict[int, tuple] = {}
+    for si in distinct_sis:
+        spec, side, ce = agg_plans[si]
+        if side != 0 or not isinstance(spec.arg, BoundColumn):
+            raise NotCompilable("DISTINCT arg is not a plain probe column",
+                                "distinct_arg")
+        ji = spec.arg.index
+        t = spec.arg.type
+        if t.is_string:
+            d = dictionaries.get(ji)
+            if d is None:
+                raise NotCompilable("DISTINCT string without dictionary",
+                                    "distinct_arg")
+            dkind, lo_v, vspace = "dict", 0, len(d)
+        elif t.is_integer or t.id in (dt.TypeId.BOOL, dt.TypeId.DATE):
+            _, _, lo_v, hi_v = _col_stats(probe, pscan.columns[ji])
+            if lo_v is None:
+                raise NotCompilable("DISTINCT value range unknown",
+                                    "distinct_space")
+            rng = hi_v - lo_v + 1
+            if rng > MAX_INT_KEY_RANGE:
+                raise NotCompilable("DISTINCT value range too large",
+                                    "distinct_space")
+            dkind, vspace = "int", rng
+        else:
+            raise NotCompilable(f"DISTINCT over {t}", "distinct_arg")
+        vspace = _pow2_int(max(vspace, 1))  # pow2-bucket the new axis
+        if group_space * vspace > MAX_GROUP_PRODUCT:
+            raise NotCompilable("DISTINCT presence grid too large",
+                                "distinct_space")
+        distinct_plans[si] = (dkind, ji, int(lo_v), vspace)
     if prof is not None:
         prof.add_device_ns(id(node), clock() - t0)
     tspan("device_compile", t0)
@@ -658,9 +887,14 @@ def _run_fused(node, join, probe_side, build_side,
     cl, cr, g, total_pairs = _join_codes(join, probe, build)
     if g + 2 > MAX_CODE_SPACE:
         raise NotCompilable("join code space too large")
-    if total_pairs > MAX_PAIRS_EXACT:
+    # outer kinds add up to one output row per null-extended input row
+    # on top of the inner pairs — the scatter-exactness bound covers
+    # the worst case of BOTH
+    eff_pairs = total_pairs + (probe.nrows if outer_left else 0) + \
+        (build.nrows if outer_right else 0)
+    if eff_pairs > MAX_PAIRS_EXACT:
         raise NotCompilable(
-            f"{total_pairs} worst-case pairs exceed the exact-scatter "
+            f"{eff_pairs} worst-case pairs exceed the exact-scatter "
             f"bound")
     if probe.zrange is not None:
         cl = cl[probe.zrange[0]:probe.zrange[1]]
@@ -673,12 +907,20 @@ def _run_fused(node, join, probe_side, build_side,
     # slot the probe phase can read: a gathered code's build dups are
     # counted in total_pairs, so its partial is inside the bound too)
     sum_modes: dict[int, str] = {}
-    for si, (spec, _side_ix, ce) in enumerate(agg_plans):
+    for si, (spec, side_ix, ce) in enumerate(agg_plans):
         if spec.func not in ("sum", "avg") or ce is None:
             continue
+        if si in distinct_plans:
+            continue                 # presence-grid path, no value col
         mode = "limb"
+        # outer joins weight rows by max(cnt, 1) (probe side) or add
+        # the unmatched-build null-group reduction (build side) — the
+        # direct bound below only covers inner pair counts, so the
+        # affected side rides the always-exact limb decomposition
+        outer_forced = (side_ix == 0 and outer_left) or \
+            (side_ix == 1 and outer_right)
         arg = spec.arg
-        if isinstance(arg, BoundColumn):
+        if isinstance(arg, BoundColumn) and not outer_forced:
             if arg.index < nl:
                 s_obj, cname = probe, pscan.columns[arg.index]
             else:
@@ -692,13 +934,23 @@ def _run_fused(node, join, probe_side, build_side,
         prof.add_device_ns(id(join), clock() - t0)
     tspan("device_factorize", t0)
 
-    # empty short-circuit: no surviving rows on either side ⇒ no pairs;
-    # synthesize the zero-accumulator outputs without a dispatch
+    # empty short-circuit: zero output rows only when NEITHER side can
+    # null-extend past the empty one; an outer kind whose non-empty
+    # side survives would need the null-extension rows, which the
+    # synthesized zero accumulators cannot express — decline
     if probe.n_live == 0 or build.n_live == 0:
-        results = _zero_results(agg_plans, group_space, sum_modes)
+        empty_ok = (probe.n_live == 0 and build.n_live == 0) or \
+            (probe.n_live == 0 and not outer_right) or \
+            (build.n_live == 0 and not outer_left)
+        if not empty_ok:
+            raise NotCompilable("outer join with an empty side",
+                                "outer_empty")
+        results = _zero_results(agg_plans, group_space, sum_modes,
+                                star_filter, distinct_plans)
         return _finalize(node, key_plans, agg_plans, results, probe,
                          pscan, dictionaries, group_space, group_mode,
-                         sum_modes)
+                         sum_modes, star_filter=star_filter,
+                         distinct_plans=distinct_plans)
 
     #: everything the compiled program's shape depends on besides the
     #: publications/ranges — shared by the single-dispatch and sharded
@@ -707,9 +959,11 @@ def _run_fused(node, join, probe_side, build_side,
                  tuple(_expr_key(p) for p in bpreds),
                  tuple(_expr_key(p) for p in post_preds),
                  tuple((s.func, _expr_key(s.arg) if s.arg is not None
+                        else None, bool(s.distinct),
+                        _expr_key(s.filter) if s.filter is not None
                         else None) for s in node.aggs),
                  tuple(_expr_key(gx) for gx in node.group_exprs),
-                 tuple(sorted(sum_modes.items())))
+                 tuple(sorted(sum_modes.items())), join.kind)
 
     # sharded tier: run the same fused program once per probe shard
     # (round-robin block partitions) with the build phase hoisted into
@@ -718,7 +972,14 @@ def _run_fused(node, join, probe_side, build_side,
     from . import shard as shard_mod
     n_shards = shard_mod.shard_count(ctx.settings)
     block_rows = int(ctx.settings.get("serene_morsel_rows"))
-    if n_shards > 1 and probe.n_live > block_rows:
+    # outer kinds, FILTER masks and DISTINCT grids run single-dispatch
+    # only: per-shard probe partitions would double-count unmatched
+    # rows (LEFT's max(cnt,1) weight is not additive across shards) and
+    # presence grids don't combine by addition; chained (fetch=False)
+    # callers need the outputs of ONE program in HBM
+    plain = (join.kind == "inner" and not agg_filters and
+             not star_filter and not distinct_plans)
+    if fetch and plain and n_shards > 1 and probe.n_live > block_rows:
         return _run_fused_sharded(
             node, join, probe, build, pscan, bscan, nl, preds_probe,
             preds_build, key_plans, group_space, group_mode, agg_plans,
@@ -734,18 +995,31 @@ def _run_fused(node, join, probe_side, build_side,
     for spec, side, ce in agg_plans:
         if ce is not None:
             needed.update(ce.inputs)
+    for fe in agg_filters.values():
+        needed.update(fe.inputs)
+    for (_dk, d_ji, _lo, _vs) in distinct_plans.values():
+        needed.add(d_ji)
     needed = sorted(needed)
 
+    # pow2 row buckets: every upload (columns, code tiles, row masks)
+    # pads to the same per-side bucket, so the traced program shape is a
+    # function of the BUCKET, not the exact surviving row count — the
+    # extended admission multiplies program axes and O(log rows) buckets
+    # keep that product off the recompile-storm detector
+    p_pad = _pow2_rows(probe.n_live)
+    b_pad = _pow2_rows(build.n_live)
     t0 = clock()
     env_cols = {}
     for ji in needed:
         if ji < nl:
-            side, name, zr = probe, pscan.columns[ji], probe.zrange
+            side, name, zr, pad = probe, pscan.columns[ji], \
+                probe.zrange, p_pad
         else:
-            side, name, zr = build, bscan.columns[ji - nl], build.zrange
+            side, name, zr, pad = build, bscan.columns[ji - nl], \
+                build.zrange, b_pad
         env_cols[ji] = DEVICE_CACHE.column(
             side.provider, side.pub, name,
-            (lambda s=side, n=name: s.host_col(n)), zr)
+            (lambda s=side, n=name: s.host_col(n)), zr, pad=pad)
 
     # code tiles + row masks (sentinels baked in host-side: NULL-key /
     # padding probe rows → g+1, build rows → g; neither ever matches).
@@ -756,19 +1030,21 @@ def _run_fused(node, join, probe_side, build_side,
               tuple(_expr_key(k) for k in join.right_keys))
 
     pc_dev = DEVICE_CACHE.array(
-        probe.pub, "__codes__", (build.pub, keyset, probe.zrange, "p"),
-        lambda: _code_tiles(cl, g + 1),
+        probe.pub, "__codes__",
+        (build.pub, keyset, (probe.zrange, "pad", p_pad), "p"),
+        lambda: _code_tiles(cl, g + 1, pad=p_pad),
         sweep=_partner_stale_pred(probe.pub, build.pub, "p", keyset))
     bc_dev = DEVICE_CACHE.array(
-        build.pub, "__codes__", (probe.pub, keyset, build.zrange, "b"),
-        lambda: _code_tiles(cr, g),
+        build.pub, "__codes__",
+        (probe.pub, keyset, (build.zrange, "pad", b_pad), "b"),
+        lambda: _code_tiles(cr, g, pad=b_pad),
         sweep=_partner_stale_pred(build.pub, probe.pub, "b", keyset))
     prow = DEVICE_CACHE.array(probe.pub, "__rowmask__",
-                              (probe.zrange,),
-                              lambda: _rowmask_tiles(probe.n_live))
+                              (probe.zrange, "pad", p_pad),
+                              lambda: _rowmask_tiles(probe.n_live, p_pad))
     brow = DEVICE_CACHE.array(build.pub, "__rowmask__",
-                              (build.zrange,),
-                              lambda: _rowmask_tiles(build.n_live))
+                              (build.zrange, "pad", b_pad),
+                              lambda: _rowmask_tiles(build.n_live, b_pad))
     if prof is not None:
         prof.add_device_ns(id(pscan), clock() - t0)
     tspan("device_upload", t0)
@@ -788,7 +1064,9 @@ def _run_fused(node, join, probe_side, build_side,
     # (code space, C) scatter, probe group accumulators in a single
     # (group space, C) scatter — instead of one scatter per aggregate.
     # Only min/max need their own (non-add) scatter combinator.
-    bstart, _bmm_sis = _build_layout(agg_plans, sum_modes)
+    bstart, _bmm_sis = _build_layout(
+        agg_plans, sum_modes,
+        star_sides={si for si, sd in star_filter.items() if sd == 1})
 
     def program(*flat):
         arrays = {}
@@ -812,11 +1090,30 @@ def _run_fused(node, join, probe_side, build_side,
         bc = jnp.where(bmask, bcodes, jnp.int32(g))
         bcols = [bmask.ravel().astype(jnp.int32)]       # col 0: match count
         bmm: dict[int, "jax.Array"] = {}
+
+        def ftrue(si, base_m):
+            """AND the agg's FILTER predicate (TRUE only — SQL drops
+            FALSE and NULL alike) into a validity mask."""
+            fe = agg_filters.get(si)
+            if fe is None:
+                return base_m
+            fv, fok = fe.fn(env_for(fe, arrays))
+            fb = fv if fv.dtype == jnp.bool_ else (fv != 0)
+            return jnp.logical_and(base_m, jnp.logical_and(fb, fok))
+
         for si, (spec, side, ce) in enumerate(agg_plans):
-            if side != 1 or ce is None:
+            if spec.func == "count_star":
+                if si in star_filter and side == 1:
+                    # count_star FILTER on the build side: its own
+                    # per-code satisfied-row count
+                    m = ftrue(si, bmask)
+                    assert bstart[si] == len(bcols)
+                    bcols.append(m.ravel().astype(jnp.int32))
+                continue
+            if side != 1 or ce is None or si in distinct_plans:
                 continue
             v, ok = ce.fn(env_for(ce, arrays))
-            m = jnp.logical_and(bmask, ok)
+            m = ftrue(si, jnp.logical_and(bmask, ok))
             mi = m.ravel().astype(jnp.int32)
             assert bstart[si] == len(bcols)      # trace-time layout check
             bcols.append(mi)                             # per-agg vcnt
@@ -838,13 +1135,30 @@ def _run_fused(node, join, probe_side, build_side,
         # into the group accumulator
         return _probe_phase(arrays, pcodes, pmask, bacc, bmm,
                             preds_probe, key_plans, group_mode,
-                            group_space, agg_plans, sum_modes, bstart, g)
+                            group_space, agg_plans, sum_modes, bstart, g,
+                            join_kind=join.kind, agg_filters=agg_filters,
+                            star_filter=star_filter,
+                            distinct_plans=distinct_plans,
+                            right_ext=((bcodes, bmask) if outer_right
+                                       else None))
 
-    # program cache: publications + ranges + expression shapes key the
-    # compiled XLA executable (data-dependent constants — FoR offsets,
-    # key plans, code space — are closed over, so versions must key)
-    cache_key = ("fused", probe.pub, build.pub, probe.zrange,
-                 build.zrange, keyset) + shape_sig
+    # program cache: PUBLICATION-FREE. Every data-dependent constant the
+    # trace closes over is keyed explicitly — decode schemes/offsets,
+    # key plans (lo offsets), code/group spaces, pow2 row buckets,
+    # DISTINCT grids, and the compiled expressions' baked constants
+    # (string-comparison code thresholds) via DeviceExpr.consts — so
+    # repeat queries across publications/tables reuse ONE executable
+    # whenever the traced shape is genuinely identical, instead of
+    # recompiling per publication bump
+    consts_sig = tuple(ce.consts for ce in preds_probe + preds_build) + \
+        tuple(ce.consts for _s, _sd, ce in agg_plans
+              if ce is not None) + \
+        tuple(agg_filters[si].consts for si in sorted(agg_filters))
+    cache_key = ("fused", join.kind, tuple(needed), tuple(decode_specs),
+                 space, group_space, tuple(key_plans),
+                 tuple(sorted(star_filter.items())),
+                 tuple(sorted(distinct_plans.items())),
+                 p_pad, b_pad, consts_sig) + shape_sig
     jitted = obs_device.compiled("fused", cache_key, lambda: program,
                                  profile=prof, node_key=id(node))
 
@@ -858,9 +1172,27 @@ def _run_fused(node, join, probe_side, build_side,
     check_cancel()
     t0 = clock()
     metrics.DEVICE_OFFLOADS.add()
-    results = obs_device.fetch_all(jitted(*flat_args))
+    outs = jitted(*flat_args)
+    if not fetch:
+        # chained handoff: accumulators STAY in HBM — the downstream
+        # fused stage consumes them directly; zero device→host bytes
+        # move here (the transfer ledger is the proof)
+        fin = {"node": node, "key_plans": key_plans,
+               "agg_plans": agg_plans, "probe": probe, "pscan": pscan,
+               "dictionaries": dictionaries, "group_space": group_space,
+               "group_mode": group_mode, "sum_modes": sum_modes,
+               "star_filter": star_filter,
+               "distinct_plans": distinct_plans,
+               "stage1_key": cache_key}
+        if prof is not None:
+            prof.add_device_ns(id(node), clock() - t0)
+        tspan("device_dispatch", t0)
+        return outs, fin
+    results = obs_device.fetch_all(outs)
     out = _finalize(node, key_plans, agg_plans, results, probe, pscan,
-                    dictionaries, group_space, group_mode, sum_modes)
+                    dictionaries, group_space, group_mode, sum_modes,
+                    star_filter=star_filter,
+                    distinct_plans=distinct_plans)
     if prof is not None:
         prof.add_device_ns(id(node), clock() - t0)
     metrics.DEVICE_DISPATCH_HIST.observe_ns(time.perf_counter_ns() - t0)
@@ -868,22 +1200,30 @@ def _run_fused(node, join, probe_side, build_side,
     return out
 
 
-def _build_layout(agg_plans, sum_modes: dict) -> tuple[dict, list]:
+def _build_layout(agg_plans, sum_modes: dict,
+                  star_sides=frozenset()) -> tuple[dict, list]:
     """Host-side mirror of the build accumulator's column layout, shared
     by every program shape (single-dispatch and sharded build/probe):
     col 0 = match count; per build-side agg: vcnt, then 1 direct / 5
     limb value columns for sum/avg; min/max partials ride separate
-    outputs in ascending-si order."""
+    outputs in ascending-si order. `star_sides` marks count_star aggs
+    whose FILTER lives on the build side — each takes one satisfied-row
+    count column."""
     bstart: dict[int, int] = {}
     bmm_sis: list[int] = []
     ncols = 1
     for si, (spec, side, ce) in enumerate(agg_plans):
+        if spec.func == "count_star":
+            if si in star_sides:
+                bstart[si] = ncols
+                ncols += 1
+            continue
         if side != 1 or ce is None:
             continue
         bstart[si] = ncols
         ncols += 1
         if spec.func in ("sum", "avg"):
-            ncols += 1 if sum_modes[si] == "direct" else 5
+            ncols += 1 if sum_modes.get(si) == "direct" else 5
         elif spec.func in ("min", "max"):
             bmm_sis.append(si)
     return bstart, bmm_sis
@@ -891,14 +1231,29 @@ def _build_layout(agg_plans, sum_modes: dict) -> tuple[dict, list]:
 
 def _probe_phase(arrays, pcodes, pmask, bacc, bmm, preds_probe,
                  key_plans, group_mode: bool, group_space: int,
-                 agg_plans, sum_modes: dict, bstart: dict, g: int):
+                 agg_plans, sum_modes: dict, bstart: dict, g: int,
+                 join_kind: str = "inner", agg_filters=None,
+                 star_filter=None, distinct_plans=None, right_ext=None):
     """THE probe phase, traced into both program shapes — the single
     fused dispatch computes `bacc`/`bmm` in-program, the sharded probe
     programs take them as inputs; one body keeps the two shapes'
     bit-identity contract in one place. Masks rows through the compiled
     probe predicates, gathers per-code build partials, and lands every
-    add-reduction in ONE (group space, C) scatter."""
+    add-reduction in ONE (group space, C) scatter.
+
+    PR 17 extensions (single-dispatch callers only): LEFT/FULL weight
+    each surviving probe row by max(matches, 1) so unmatched rows emit
+    their null-extended output row; RIGHT/FULL take `right_ext =
+    (bcodes, bmask-after-preds)` and reduce the unmatched build rows
+    into the all-NULL-key group slot; per-agg FILTER masks AND into
+    value validity; DISTINCT plans scatter a (group × value) presence
+    grid each."""
     import jax.numpy as jnp
+
+    agg_filters = agg_filters or {}
+    star_filter = star_filter or {}
+    distinct_plans = distinct_plans or {}
+    outer_left = join_kind in ("left", "full")
 
     cnt_code = bacc[:, 0]
     for ce in preds_probe:
@@ -907,6 +1262,9 @@ def _probe_phase(arrays, pcodes, pmask, bacc, bmm, preds_probe,
         pmask = jnp.logical_and(pmask, jnp.logical_and(b, ok))
     pc = jnp.where(pmask, pcodes, jnp.int32(g + 1))
     cnt = cnt_code[pc]                       # matches per probe row
+    # output rows per surviving probe row: LEFT/FULL keep unmatched
+    # probe rows as one null-extended row each
+    w = jnp.maximum(cnt, 1) if outer_left else cnt
 
     if group_mode:
         gcodes = jnp.zeros_like(pc)
@@ -923,16 +1281,51 @@ def _probe_phase(arrays, pcodes, pmask, bacc, bmm, preds_probe,
     gc = jnp.where(pmask, gcodes, 0).ravel()
     pmi = pmask.ravel().astype(jnp.int32)
 
-    pcols = [jnp.where(pmask, cnt, 0).ravel()]       # col 0: pairs
+    def ftrue(si, base_m):
+        """AND the agg's FILTER predicate (TRUE only) into a mask."""
+        fe = agg_filters.get(si)
+        if fe is None:
+            return base_m
+        fv, fok = fe.fn([arrays[i] for i in fe.inputs])
+        fb = fv if fv.dtype == jnp.bool_ else (fv != 0)
+        return jnp.logical_and(base_m, jnp.logical_and(fb, fok))
+
+    pcols = [jnp.where(pmask, w, 0).ravel()]         # col 0: output rows
     pstart: dict[int, int] = {}
     pmm: dict[int, "jax.Array"] = {}
+    grids: dict[int, "jax.Array"] = {}
     for si, (spec, side, ce) in enumerate(agg_plans):
         if spec.func == "count_star":
-            continue                         # shared pair counts
+            if si not in star_filter:
+                continue                     # shared output-row counts
+            pstart[si] = len(pcols)
+            if side == 0:
+                m = ftrue(si, pmask)
+                pcols.append(jnp.where(m, w, 0).ravel())
+            else:
+                vcnt = bacc[:, bstart[si]]
+                pcols.append(jnp.where(pmask, vcnt[pc], 0).ravel())
+            continue
+        if si in distinct_plans:
+            # presence grid: one cell per (group, value); host counts /
+            # sums the present cells exactly
+            dkind, ji, lo_v, vspace = distinct_plans[si]
+            data, ok = arrays[ji]
+            if dkind == "dict":
+                c = data.astype(jnp.int32)
+            else:
+                c = data.astype(jnp.int32) - jnp.int32(lo_v)
+            m = ftrue(si, jnp.logical_and(pmask, ok))
+            m = jnp.logical_and(m, w > 0)
+            cell = gcodes * jnp.int32(vspace) + jnp.clip(c, 0, vspace - 1)
+            cell = jnp.where(m, cell, 0).ravel()
+            grids[si] = jnp.zeros(group_space * vspace, jnp.int32) \
+                .at[cell].add(m.ravel().astype(jnp.int32))
+            continue
         if side == 0:
             v, ok = ce.fn([arrays[i] for i in ce.inputs])
-            m = jnp.logical_and(pmask, ok)
-            vpairs = jnp.where(m, cnt, 0).ravel()
+            m = ftrue(si, jnp.logical_and(pmask, ok))
+            vpairs = jnp.where(m, w, 0).ravel()
             pstart[si] = len(pcols)
             if spec.func == "count":
                 pcols.append(vpairs)
@@ -945,7 +1338,7 @@ def _probe_phase(arrays, pcodes, pmask, bacc, bmm, preds_probe,
                 pcols.append(vpairs)
             else:   # min / max — a selection; pairs only gate entry
                 pmm[si] = ops_agg.group_min_max(
-                    gcodes, jnp.logical_and(m, cnt > 0),
+                    gcodes, jnp.logical_and(m, w > 0),
                     v.astype(jnp.int32), group_space, spec.func)
                 pcols.append(vpairs)
         else:
@@ -974,11 +1367,67 @@ def _probe_phase(arrays, pcodes, pmask, bacc, bmm, preds_probe,
     acc = jnp.zeros((group_space, len(pcols)), jnp.int32) \
         .at[gc].add(jnp.stack(pcols, axis=1))
 
+    if right_ext is not None:
+        # RIGHT/FULL: build rows surviving the build predicates whose
+        # code matches ZERO surviving probe rows null-extend — their
+        # probe side is all NULL, so every reduction lands in the
+        # all-NULL composite group slot (SQL groups NULLs together, so
+        # colliding with a real all-NULL-key probe group is correct)
+        bcodes_r, bmask_r = right_ext
+        bc_r = jnp.where(bmask_r, bcodes_r, jnp.int32(g)).ravel()
+        pcc = jnp.zeros(g + 2, jnp.int32).at[pc.ravel()].add(pmi)
+        # pcc[g] == 0 always (probe codes are < g or the g+1 sentinel),
+        # so NULL-key build rows — host-rewritten to g — count as
+        # unmatched here exactly as the oracle's NULL-never-matches rule
+        ub = jnp.logical_and(bmask_r.ravel(), pcc[bc_r] == 0)
+        null_gc = group_space - 1 if group_mode else 0
+        acc = acc.at[null_gc, 0].add(
+            jnp.sum(ub, dtype=jnp.int32))
+        for si, (spec, side, ce) in enumerate(agg_plans):
+            if spec.func == "count_star":
+                if si in star_filter and side == 1:
+                    m = ftrue(si, bmask_r)
+                    mu = jnp.logical_and(m.ravel(), ub)
+                    acc = acc.at[null_gc, pstart[si]].add(
+                        jnp.sum(mu, dtype=jnp.int32))
+                continue
+            if side != 1 or si in distinct_plans:
+                continue   # null-extended probe values aggregate to none
+            v, ok = ce.fn([arrays[i] for i in ce.inputs])
+            m = ftrue(si, jnp.logical_and(bmask_r, ok))
+            mu = jnp.logical_and(m.ravel(), ub)
+            mui = mu.astype(jnp.int32)
+            nmu = jnp.sum(mui, dtype=jnp.int32)
+            start = pstart[si]
+            if spec.func == "count":
+                acc = acc.at[null_gc, start].add(nmu)
+            elif spec.func in ("sum", "avg"):
+                # sum_modes forces limb for build-side sums under
+                # RIGHT/FULL, so the layout here is always 5 limbs + cnt
+                for j, lcol in enumerate(_limb_cols(
+                        v.astype(jnp.int32).ravel(), mui)):
+                    acc = acc.at[null_gc, start + j].add(
+                        jnp.sum(lcol, dtype=jnp.int32))
+                acc = acc.at[null_gc, start + 5].add(nmu)
+            else:       # min / max
+                ident = jnp.int32(_mm_ident(spec.func))
+                red = jnp.where(mu, v.astype(jnp.int32).ravel(), ident)
+                red = jnp.min(red) if spec.func == "min" else jnp.max(red)
+                upd = pmm[si].at[null_gc]
+                pmm[si] = upd.min(red) if spec.func == "min" \
+                    else upd.max(red)
+                acc = acc.at[null_gc, start].add(nmu)
+
     # slice the fused accumulator back into the per-agg output spec
     # (bit-identical to the one-scatter-per-aggregate layout)
     outputs = [acc[:, 0]]
     for si, (spec, side, ce) in enumerate(agg_plans):
         if spec.func == "count_star":
+            if si in star_filter:
+                outputs.append(acc[:, pstart[si]])
+            continue
+        if si in distinct_plans:
+            outputs.append(grids[si])
             continue
         start = pstart[si]
         if spec.func == "count":
@@ -1677,12 +2126,14 @@ def _limb_cols(vals, weights) -> list:
     return cols
 
 
-def _code_tiles(codes: np.ndarray, sentinel: int) -> "jax.Array":
+def _code_tiles(codes: np.ndarray, sentinel: int,
+                pad: Optional[int] = None) -> "jax.Array":
     """Factorized join codes → int32 device tiles; padding rows take the
-    side's never-matches sentinel."""
+    side's never-matches sentinel. `pad` rounds rows up to that multiple
+    (the fused tier's pow2 bucket)."""
     import jax.numpy as jnp
     n = len(codes)
-    n_pad = pad_len(n)
+    n_pad = pad_len(n) if pad is None else pad_len(n, pad)
     padded = np.full(n_pad, sentinel, dtype=np.int32)
     padded[:n] = codes
     return jnp.asarray(padded.reshape(-1, LANES))
@@ -1799,13 +2250,22 @@ def _plan_group_keys(node, join_types, probe: _Side, pscan, dictionaries
     return key_plans, group_space
 
 
-def _zero_results(agg_plans, group_space: int, sum_modes: dict) -> list:
+def _zero_results(agg_plans, group_space: int, sum_modes: dict,
+                  star_filter=None, distinct_plans=None) -> list:
     """Host-side zero accumulators matching the program's output spec —
     the no-surviving-rows short-circuit (empty table or every block
     zone-pruned) never dispatches."""
+    star_filter = star_filter or {}
+    distinct_plans = distinct_plans or {}
     out = [np.zeros(group_space, dtype=np.int32)]
     for si, (spec, side, ce) in enumerate(agg_plans):
         if spec.func == "count_star":
+            if si in star_filter:
+                out.append(np.zeros(group_space, dtype=np.int32))
+            continue
+        if si in distinct_plans:
+            vspace = distinct_plans[si][3]
+            out.append(np.zeros(group_space * vspace, dtype=np.int32))
             continue
         if spec.func == "count":
             out.append(np.zeros(group_space, dtype=np.int32))
@@ -1824,21 +2284,33 @@ def _zero_results(agg_plans, group_space: int, sum_modes: dict) -> list:
 
 def _finalize(node, key_plans, agg_plans, results, probe: _Side, pscan,
               dictionaries, group_space: int, group_mode: bool,
-              sum_modes: dict) -> Batch:
+              sum_modes: dict, star_filter=None, distinct_plans=None,
+              slots=None) -> Batch:
     """Device accumulators → result batch, bit-matching the host oracle:
     groups emit in ascending composite-code order (= factorize_keys
     order), int sums recombine from limbs in int64, empty groups /
-    scalar aggregates go NULL exactly where the oracle's do."""
+    scalar aggregates go NULL exactly where the oracle's do.
+
+    `slots=(codes, row_lo, row_hi)` is the chained-top-N entry: results
+    arrive pre-gathered to the selected group rows (stage 2's top_k
+    indices), `codes` holds those rows' composite group codes, and only
+    rows [row_lo, row_hi) emit (host-side OFFSET/LIMIT slice)."""
+    star_filter = star_filter or {}
+    distinct_plans = distinct_plans or {}
     ri = iter(results)
     pair_counts = np.asarray(next(ri)).astype(np.int64)
-    if group_mode:
+    if slots is not None:
+        slot_codes, row_lo, row_hi = slots
+        present = np.arange(row_lo, row_hi)
+    elif group_mode:
         present = np.flatnonzero(pair_counts > 0)
     else:
         present = np.asarray([0])
     cols: list[Column] = []
     if group_mode:
         sizes = [kp[3] for kp in key_plans]
-        rem = present.copy()
+        rem = slot_codes[row_lo:row_hi].copy() if slots is not None \
+            else present.copy()
         key_codes = []
         for size in reversed(sizes):
             key_codes.append(rem % size)
@@ -1859,14 +2331,67 @@ def _finalize(node, key_plans, agg_plans, results, probe: _Side, pscan,
                 cols.append(Column(
                     t, data, ~null_mask if null_mask.any() else None))
     for si, (spec, side, ce) in enumerate(agg_plans):
+        if si in distinct_plans:
+            cols.append(_distinct_result_col(
+                spec, np.asarray(next(ri)), distinct_plans[si],
+                group_space, group_mode, present))
+            continue
+        if spec.func == "count_star" and si in star_filter:
+            c = np.asarray(next(ri)).astype(np.int64)
+            if group_mode:
+                cols.append(Column(dt.BIGINT, c[present]))
+            else:
+                cols.append(Column.from_pylist([int(c[0])], spec.type))
+            continue
         cols.append(_agg_result_col(spec, ri, pair_counts, present,
                                     group_mode,
-                                    sum_modes.get(si, "limb")))
+                                    sum_modes.get(si, "limb"),
+                                    dictionaries))
     return Batch(list(node.names), cols)
 
 
+def _distinct_result_col(spec: AggSpec, grid: np.ndarray, dplan,
+                         group_space: int, group_mode: bool,
+                         present) -> Column:
+    """Presence grid → count/sum/avg DISTINCT, exactly: a cell is
+    present iff ≥ 1 surviving (group, value) occurrence scattered into
+    it; counts are presences per group, sums recombine value · present
+    in int64 (values are the direct-coded axis, so the grid IS the
+    distinct value set)."""
+    _dkind, _ji, lo_v, vspace = dplan
+    if grid.ndim == 1:
+        grid = grid.reshape(group_space, vspace)
+    pres = grid[present] > 0                   # (rows, vspace)
+    cnt = pres.sum(axis=1).astype(np.int64)
+    if spec.func == "count":
+        if group_mode:
+            return Column(dt.BIGINT, cnt)
+        return Column.from_pylist([int(cnt[0])], spec.type)
+    vals = (np.int64(lo_v) + np.arange(vspace, dtype=np.int64))
+    sums = (pres * vals).sum(axis=1)
+    t = spec.type
+    if group_mode:
+        empty = cnt == 0
+        if spec.func == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                data = np.where(empty, 0.0, sums / np.maximum(cnt, 1))
+            return Column(dt.DOUBLE, data, ~empty if empty.any() else None)
+        if t.is_integer:
+            return Column(dt.BIGINT, sums,
+                          ~empty if empty.any() else None)
+        return Column(dt.DOUBLE, sums.astype(np.float64),
+                      ~empty if empty.any() else None)
+    s, n = int(sums[0]), int(cnt[0])
+    if n == 0:
+        return Column.from_pylist([None], t)
+    if spec.func == "avg":
+        return Column.from_pylist([s / n], t)
+    return Column.from_pylist([s if t.is_integer else float(s)], t)
+
+
 def _agg_result_col(spec: AggSpec, ri, pair_counts, present,
-                    group_mode: bool, sum_mode: str = "limb") -> Column:
+                    group_mode: bool, sum_mode: str = "limb",
+                    dictionaries=None) -> Column:
     t = spec.type
     if spec.func == "count_star":
         if group_mode:
@@ -1905,6 +2430,19 @@ def _agg_result_col(spec: AggSpec, ri, pair_counts, present,
         v = np.asarray(next(ri)).astype(np.int64)
         cnt = np.asarray(next(ri)).astype(np.int64)
         at = spec.arg.type
+        if at.is_string:
+            # min/max ran over sorted-dictionary codes (code order ==
+            # string order); decode back through the dictionary
+            d = (dictionaries or {}).get(spec.arg.index)
+            if group_mode:
+                v, cnt = v[present], cnt[present]
+                empty = cnt == 0
+                codes = np.where(empty, 0, v).astype(np.int32)
+                return Column(at, codes,
+                              ~empty if empty.any() else None, d)
+            if int(cnt[0]) == 0:
+                return Column.from_pylist([None], t)
+            return Column.from_pylist([str(d[int(v[0])])], t)
         if group_mode:
             v, cnt = v[present], cnt[present]
             empty = cnt == 0
@@ -1964,9 +2502,16 @@ def try_device_fused_topn(limit_node, ctx) -> Optional[Batch]:
         return None
     if limit_node.limit is None:
         return None
-    k = limit_node.limit + limit_node.offset
-    if k == 0 or k > MAX_TOPN_K:
+
+    def decline(reason: str) -> None:
+        _note_decline(reason, ctx, limit_node)
         return None
+
+    k = limit_node.limit + limit_node.offset
+    if k == 0:
+        return None
+    if k > MAX_TOPN_K:
+        return decline("topn_k")
     sort = limit_node.child
     if not isinstance(sort, SortNode) or len(sort.key_indices) != 1 or \
             sort.nulls_first[0] is not None:
@@ -1988,11 +2533,11 @@ def try_device_fused_topn(limit_node, ctx) -> Optional[Batch]:
         # (100/b with a zero outside the top k) or draws state would
         # diverge, so anything beyond column selection/reorder falls back
         if not all(isinstance(e, BoundColumn) for e in proj.exprs):
-            return None
+            return decline("topn_project")
         ki = proj.exprs[ki].index
     t = scan.types[ki]
     if not (t.is_integer or t.id in (dt.TypeId.DATE, dt.TypeId.FLOAT)):
-        return None
+        return decline("topn_key_type")
     provider = scan.provider
     if settings.get("serene_device") == "auto":
         try:
@@ -2020,7 +2565,7 @@ def try_device_fused_topn(limit_node, ctx) -> Optional[Batch]:
         return out
     except (NotCompilable, DeviceNarrowingError) as e:
         log.debug("device", f"fused top-N fell back to CPU: {e}")
-        return None
+        return decline(getattr(e, "reason", "not_compilable"))
 
 
 def _run_fused_topn(limit_node, scan, preds, ki: int, desc: bool, k: int,
@@ -2132,3 +2677,181 @@ def _run_fused_topn(limit_node, scan, preds, ki: int, desc: bool, k: int,
     if proj is None:
         return base
     return Batch(list(proj.names), [e.eval(base) for e in proj.exprs])
+
+
+# -- chained device-resident stages: fused agg → fused top-N -----------------
+
+
+def _stage1_out_slots(agg_plans, star_filter, distinct_plans
+                      ) -> dict[int, int]:
+    """agg index → its FIRST slot in the stage-1 output tuple (mirrors
+    _probe_phase's output ordering exactly)."""
+    slots: dict[int, int] = {}
+    pos = 1
+    for si, (spec, _side, _ce) in enumerate(agg_plans):
+        if spec.func == "count_star":
+            if si in star_filter:
+                slots[si] = pos
+                pos += 1
+            continue
+        slots[si] = pos
+        if si in distinct_plans or spec.func == "count":
+            pos += 1
+        else:
+            pos += 2                      # sum/avg and min/max: 2 slots
+    return slots
+
+
+def try_device_chained_topn(limit_node, ctx) -> Optional[Batch]:
+    """Whole-query device residency: Limit(Sort(Project?(Aggregate)))
+    over a fused-admissible join runs as TWO chained dispatches — the
+    stage-1 group accumulators NEVER leave HBM. Stage 2 (jitted with
+    donate_argnums over the stage-1 outputs, so XLA reuses their
+    buffers) masks absent groups to the sort sentinel, top_k-selects
+    the k requested group slots, and gathers every accumulator down to
+    those k rows; the host fetches only the k-row tail. Sort keys are
+    group-key columns (composite-code order == value order: sorted
+    dictionaries / offset ints, NULL slot last ⇒ PG's default asc
+    NULLS LAST / desc NULLS FIRST exactly) or count-family aggregates;
+    min/max/sum keys decline (their device identities have no
+    NULL-consistent total order to hand top_k). None → host path."""
+    import jax.numpy as jnp
+    from .device_topn import _I32_MIN
+    from .plan import AggregateNode, ProjectNode, SortNode, check_cancel
+
+    settings = ctx.settings
+    if settings.get("serene_device") == "cpu" or \
+            not fused_enabled(settings) or \
+            not fused_ext_enabled(settings):
+        return None
+    if limit_node.limit is None or limit_node.limit == 0:
+        return None
+    k = limit_node.limit + limit_node.offset
+    sort = limit_node.child
+    if not isinstance(sort, SortNode) or len(sort.key_indices) != 1 or \
+            sort.nulls_first[0] is not None:
+        return None
+    proj = None
+    agg = sort.child
+    if isinstance(proj_c := agg, ProjectNode):
+        proj = proj_c
+        agg = proj_c.child
+    if not isinstance(agg, AggregateNode):
+        return None
+    if proj is not None and not all(isinstance(e, BoundColumn)
+                                    for e in proj.exprs):
+        return None
+    if not agg.group_exprs:
+        return None               # scalar aggregate: one row, host-trivial
+
+    def decline(reason: str) -> None:
+        _note_decline(reason, ctx, limit_node)
+        return None
+
+    sel = sort.key_indices[0]
+    if proj is not None:
+        sel = proj.exprs[sel].index
+    ng = len(agg.group_exprs)
+    if sel >= ng:
+        spec = agg.aggs[sel - ng]
+        if spec.func not in ("count_star", "count") or spec.distinct:
+            return decline("chain_sort_key")
+    admitted = _admit_pipeline(agg, ctx, decline)
+    if admitted is None:
+        return None
+    join, probe_side, build_side, post_preds = admitted
+    try:
+        res = _run_fused(agg, join, probe_side, build_side, post_preds,
+                         ctx, fetch=False)
+    except (NotCompilable, DeviceNarrowingError) as e:
+        log.debug("device", f"chained fused top-N fell back to CPU: {e}")
+        return decline(getattr(e, "reason", "not_compilable"))
+    if isinstance(res, Batch):
+        return None               # empty short-circuit: host path, cheap
+    outs, fin = res
+    desc = bool(sort.descs[0])
+    group_space = fin["group_space"]
+    key_plans = fin["key_plans"]
+    agg_plans = fin["agg_plans"]
+    sum_modes = fin["sum_modes"]
+    star_filter = fin["star_filter"]
+    distinct_plans = fin["distinct_plans"]
+    k_pad = min(_pow2_int(k, floor=8), group_space)
+
+    if sel >= ng:
+        si = sel - ng
+        if agg_plans[si][0].func == "count_star" and \
+                si not in star_filter:
+            sort_mode = ("agg", 0)        # shared output-row counts
+        else:
+            sort_mode = ("agg", _stage1_out_slots(
+                agg_plans, star_filter, distinct_plans)[si])
+    else:
+        sizes = [kp[3] for kp in key_plans]
+        stride = 1
+        for s2 in sizes[sel + 1:]:
+            stride *= s2
+        sort_mode = ("gkey", stride, sizes[sel])
+
+    ckey = ("fused_chain", fin["stage1_key"], sort_mode, desc, k_pad,
+            group_space)
+
+    def build_stage2():
+        def stage2(*souts):
+            present = souts[0] > 0
+            if sort_mode[0] == "agg":
+                v = souts[sort_mode[1]].astype(jnp.int32)
+            else:
+                idx = jnp.arange(group_space, dtype=jnp.int32)
+                v = (idx // jnp.int32(sort_mode[1])) % \
+                    jnp.int32(sort_mode[2])
+            # asc rides ~v: monotone-decreasing, exact on int32 (codes
+            # < 2^21 and counts ≤ 2^23 keep ~v clear of the sentinel);
+            # ties take the lowest slot = ascending composite code =
+            # the host oracle's stable sort order
+            sv = v if desc else ~v
+            sv = jnp.where(present, sv, jnp.int32(_I32_MIN))
+            _kk, ii = jax.lax.top_k(sv, k_pad)
+            picked = []
+            for o in souts:
+                if o.ndim == 1 and o.shape[0] != group_space:
+                    o = o.reshape(group_space, -1)  # DISTINCT grid
+                picked.append(o[ii])
+            return (ii.astype(jnp.int32),
+                    jnp.sum(present, dtype=jnp.int32), *picked)
+        return stage2
+
+    prof = getattr(ctx, "profile", None)
+    # donate the stage-1 accumulators: XLA reuses their HBM for the
+    # gathered outputs (donation is a no-op warning on the CPU backend)
+    donate = tuple(range(len(outs))) \
+        if jax.default_backend() != "cpu" else None
+    jitted2 = obs_device.compiled("fused_chain", ckey, build_stage2,
+                                  profile=prof,
+                                  node_key=id(limit_node),
+                                  donate_argnums=donate)
+    check_cancel()
+    t0 = time.perf_counter_ns()
+    metrics.DEVICE_OFFLOADS.add()
+    metrics.REGISTRY.gauge(
+        "DeviceChainedStages",
+        "Fused agg→top-N chains executed with the intermediate "
+        "accumulators handed off in HBM").add()
+    fetched = obs_device.fetch_all(jitted2(*outs))
+    ii_np = np.asarray(fetched[0]).astype(np.int64)
+    npres = int(fetched[1])
+    k_eff = min(k, npres)
+    row_lo = min(limit_node.offset, k_eff)
+    out = _finalize(agg, key_plans, agg_plans, list(fetched[2:]),
+                    fin["probe"], fin["pscan"], fin["dictionaries"],
+                    group_space, True, sum_modes,
+                    star_filter=star_filter,
+                    distinct_plans=distinct_plans,
+                    slots=(ii_np, row_lo, k_eff))
+    if proj is not None:
+        out = Batch(list(proj.names),
+                    [out.columns[e.index] for e in proj.exprs])
+    if prof is not None:
+        prof.add_device_ns(id(limit_node), time.perf_counter_ns() - t0)
+    metrics.DEVICE_DISPATCH_HIST.observe_ns(time.perf_counter_ns() - t0)
+    return out

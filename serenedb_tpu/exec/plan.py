@@ -363,12 +363,18 @@ class LimitNode(PlanNode):
 
     def batches(self, ctx):
         if isinstance(self.child, SortNode):
-            # fused device top-N first: it owns the FILTERED shape
-            # (predicate masks to the sort sentinel inside the same
-            # program as top_k); the unfiltered shape stays with
-            # device_topn, and both decline overlapping territory
-            from .device_pipeline import try_device_fused_topn
-            out = try_device_fused_topn(self, ctx)
+            # chained device residency first: a fused aggregate under
+            # the sort runs agg → top-N as two dispatches with the
+            # accumulators handed off in HBM. Then fused top-N (owns
+            # the FILTERED scan shape: predicate masks to the sort
+            # sentinel inside the same program as top_k); the
+            # unfiltered shape stays with device_topn, and all three
+            # decline overlapping territory
+            from .device_pipeline import (try_device_chained_topn,
+                                          try_device_fused_topn)
+            out = try_device_chained_topn(self, ctx)
+            if out is None:
+                out = try_device_fused_topn(self, ctx)
             if out is None:
                 from .device_topn import try_device_topn
                 out = try_device_topn(self, ctx)

@@ -345,7 +345,8 @@ class ProgramLedger:
         return f
 
     def get(self, family: str, key: tuple, builder: Callable,
-            profile=None, node_key=None) -> CompiledProgram:
+            profile=None, node_key=None,
+            donate_argnums=None) -> CompiledProgram:
         on = enabled()
         full = (family, key)
         with self._lock:
@@ -362,7 +363,16 @@ class ProgramLedger:
         # builder may construct meshes/shard_maps; a racing duplicate
         # build is wasted work, never wrong (the loser is discarded)
         import jax
-        prog = CompiledProgram(jax.jit(builder()), family)
+        if donate_argnums:
+            # chained-stage handoff: the caller proves the donated
+            # buffers are dead after this dispatch (stage-1 outputs
+            # consumed exactly once), so XLA may alias them into the
+            # stage-2 outputs — zero-copy HBM reuse between stages
+            fn = jax.jit(builder(),
+                         donate_argnums=tuple(donate_argnums))
+        else:
+            fn = jax.jit(builder())
+        prog = CompiledProgram(fn, family)
         with self._lock:
             cur = self._progs.get(full)
             if cur is not None:
@@ -478,15 +488,48 @@ PROGRAMS = ProgramLedger()
 
 
 def compiled(family: str, key: tuple, builder: Callable, *,
-             profile=None, node_key=None) -> CompiledProgram:
+             profile=None, node_key=None,
+             donate_argnums=None) -> CompiledProgram:
     """THE jit entry point (acceptance grep: no bare `jax.jit(` outside
     this module). `builder` is a zero-arg callable returning the python
     callable to jit (a traced program body, or a shard_map-wrapped
     one); it runs only on a ledger miss. `profile`/`node_key` stamp the
     hit/miss onto the plan operator so EXPLAIN ANALYZE's `Device:` line
-    can say `compile=hit|miss`."""
+    can say `compile=hit|miss`. `donate_argnums` forwards to jax.jit
+    for chained-stage buffer handoff (and keys the cached executable
+    implicitly: callers pass it consistently per cache key)."""
     return PROGRAMS.get(family, key, builder, profile=profile,
-                        node_key=node_key)
+                        node_key=node_key, donate_argnums=donate_argnums)
+
+
+# -- fused-tier decline accounting -------------------------------------------
+
+#: reason slug → count of fused-tier declines (queries that fell back
+#: to the host path and why) — the satellite-1 diagnosis surface
+_FUSED_DECLINES: dict[str, int] = {}
+_fused_declines_lock = threading.Lock()
+
+
+def note_fused_decline(reason: str, profile=None, node_key=None) -> None:
+    """One fused-tier fallback: count it per reason slug (bounded
+    vocabulary — call sites pass short category strings, never query
+    text), bump the per-reason `DeviceFusedDeclines_<reason>` gauge,
+    and stamp the reason onto the plan operator so EXPLAIN ANALYZE's
+    `Device:` line can say `declined=<reason>`."""
+    reason = str(reason)[:64]
+    with _fused_declines_lock:
+        _FUSED_DECLINES[reason] = _FUSED_DECLINES.get(reason, 0) + 1
+    metrics.REGISTRY.gauge(
+        f"DeviceFusedDeclines_{reason}",
+        "fused device pipeline declines for this reason (query fell "
+        "back to the host path)").add()
+    if profile is not None and node_key is not None:
+        profile.stats(node_key).device_declined = reason
+
+
+def fused_declines() -> dict[str, int]:
+    with _fused_declines_lock:
+        return dict(sorted(_FUSED_DECLINES.items()))
 
 
 # -- surfaces -----------------------------------------------------------------
@@ -555,4 +598,5 @@ def stats_section() -> dict:
             "program_cache": {"entries": PROGRAMS.entries(),
                               "cap": _cap()},
             "column_cache": DEVICE_CACHE.stats(),
-            "posting_pool": POOL.stats()}
+            "posting_pool": POOL.stats(),
+            "fused_declines": fused_declines()}

@@ -40,7 +40,7 @@ _COUNTERS = ("wall_ns", "cpu_ns", "rows_out", "batches", "bytes_out",
 class OpStats:
     """One operator's accumulated span counters (one bucket's view)."""
 
-    __slots__ = _COUNTERS + ("first_ns",)
+    __slots__ = _COUNTERS + ("first_ns", "device_declined")
 
     def __init__(self):
         for f in _COUNTERS:
@@ -48,6 +48,9 @@ class OpStats:
         #: the operator's accumulated wall ns at its FIRST emitted batch
         #: (PG "startup time"; merge = min, thread-order free)
         self.first_ns: Optional[int] = None
+        #: fused-tier decline reason slug (non-additive: one execution
+        #: declines for one reason; merge keeps any observed value)
+        self.device_declined: Optional[str] = None
 
     def merge(self, other: "OpStats") -> None:
         for f in _COUNTERS:
@@ -55,6 +58,8 @@ class OpStats:
         if other.first_ns is not None:
             self.first_ns = other.first_ns if self.first_ns is None \
                 else min(self.first_ns, other.first_ns)
+        if other.device_declined is not None:
+            self.device_declined = other.device_declined
 
 
 def batch_nbytes(b) -> int:
@@ -499,7 +504,7 @@ def annotate_plan(plan, profile: QueryProfile, mem=None) -> list[str]:
                 lines.append(
                     f"{detail}Morsels: scheduled={s.morsels_scheduled} "
                     f"zonemap_pruned={s.morsels_pruned}{jf}")
-            if s.device_ns:
+            if s.device_ns or s.device_declined:
                 comp = ""
                 if s.device_prog_hits or s.device_prog_misses:
                     # any miss means this execution paid (at least one)
@@ -507,8 +512,11 @@ def annotate_plan(plan, profile: QueryProfile, mem=None) -> list[str]:
                     # from the ledger warm (obs/device.py)
                     comp = " compile=" + \
                         ("miss" if s.device_prog_misses else "hit")
+                dec = (f" declined={s.device_declined}"
+                       if s.device_declined else "")
                 lines.append(
-                    f"{detail}Device: time={_ms(s.device_ns)} ms{comp}")
+                    f"{detail}Device: time={_ms(s.device_ns)} "
+                    f"ms{comp}{dec}")
             if s.batch_queries:
                 lines.append(
                     f"{detail}Batch: queries={s.batch_queries} "
@@ -569,6 +577,8 @@ def annotate_plan_json(plan, profile: Optional[QueryProfile],
                     if s.device_prog_hits or s.device_prog_misses:
                         out["Device Compile"] = \
                             "miss" if s.device_prog_misses else "hit"
+                if s.device_declined:
+                    out["Device Declined"] = s.device_declined
                 if s.batch_queries:
                     out["Batch Queries"] = s.batch_queries
                     out["Batch Window Time"] = \

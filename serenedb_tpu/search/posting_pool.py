@@ -580,6 +580,57 @@ class PostingPool:
         rows.sort(key=lambda r: (r["token"], r["segment"]))
         return rows
 
+    # -- budget trade with the device column cache (§19) -------------------
+
+    def live_bytes(self) -> int:
+        """HBM bytes of LIVE (allocated) pages — the pool's claim on the
+        shared serene_device_cache_mb envelope. Free pages of the region
+        don't count: they cost HBM but the trade is about who gets to
+        KEEP data resident, and an idle region re-shrinks only on a
+        budget change (rebuilds drop every entry, so resizing per query
+        would thrash)."""
+        with self._lock:
+            if self._docs is None:
+                return 0
+            return (self._n_pages - len(self._free)) * PAGE * 8
+
+    def tail_idle_ns(self) -> Optional[int]:
+        """Idle time of the LRU tail entry (the next eviction victim),
+        or None when the pool is empty."""
+        with self._lock:
+            for e in self._entries.values():
+                return time.perf_counter_ns() - e.last_ns
+            return None
+
+    def shed_colder(self, idle_ns: int, need_bytes: int) -> int:
+        """Evict LRU-tail entries that have sat idle LONGER than
+        `idle_ns` until `need_bytes` of pages are freed; stops at the
+        first tail entry warmer than the threshold. Returns bytes
+        freed. Called by the column cache when IT is over cap and the
+        pool's tail is colder than its own — lock order is strictly
+        cache-side-unlocked → pool, so this can never deadlock against
+        a concurrent score/alloc holding the pool lock."""
+        freed = 0
+        with self._lock:
+            now = time.perf_counter_ns()
+            while freed < need_bytes:
+                victim = None
+                for key, e in self._entries.items():
+                    if now - e.last_ns > idle_ns:
+                        victim = key
+                    break           # LRU head only: warmer head ends it
+                if victim is None:
+                    break
+                e = self._entries.pop(victim)
+                self._free.extend(e.slots.tolist())
+                freed += len(e.slots) * PAGE * 8
+                metrics.POSTING_POOL_EVICTIONS.add()
+            if freed and self._n_pages:
+                used = self._n_pages - len(self._free)
+                metrics.POSTING_POOL_PAGES_USED.set(used)
+                metrics.POSTING_POOL_BYTES.set(used * PAGE * 8)
+        return freed
+
     def stats(self) -> dict:
         """The `/_stats` / `GET /device` posting_pool section."""
         with self._lock:

@@ -182,6 +182,28 @@ declare("serene_device_fused", True, bool,
         "fused compiler can't prove exact falls back to the host path, "
         "which stays on as the bit-identical parity oracle — results "
         "are identical on or off at any serene_workers setting")
+declare("serene_device_fused_ext", True, bool,
+        "extended fused-tier admission (PR 17): string aggregates via "
+        "dictionary codes, FILTER aggregates as extra scatter masks, "
+        "DISTINCT aggregates as presence grids, side-decomposable "
+        "residual join predicates, LEFT/RIGHT/FULL outer joins, and "
+        "the chained fused-aggregate→top-N device handoff. Off "
+        "restores the PR 7 admission walls (those shapes decline to "
+        "the host path) — the before/after lever of the "
+        "fused_admission bench shape; results are bit-identical on or "
+        "off because the host path is the oracle for every shape")
+declare("serene_device_cache_trade", True, bool,
+        "pressure-based budget trade between the device column cache "
+        "(§19) and the posting pool (§27) inside the one "
+        "serene_device_cache_mb envelope: the column cache's byte cap "
+        "is the envelope minus the pool's LIVE page bytes (floored at "
+        "a quarter of the envelope), so pool residency squeezes the "
+        "cache instead of a static carve-out; and when the cache must "
+        "evict, it first sheds the POOL's tail if that tail is colder "
+        "(idle longer), which raises its own cap back. Off restores "
+        "the static carve-out (serene_posting_pages bounds the pool; "
+        "the column cache ignores pool occupancy)",
+        scope=Scope.GLOBAL)
 declare("serene_device_cache_mb", 256, int,
         "byte cap (MB) of the process-wide device column cache "
         "(exec/device_pipeline.DEVICE_CACHE): device-resident column "

@@ -80,6 +80,19 @@ if os.environ.get("SERENE_DEVICE_FUSED"):
                            os.environ["SERENE_DEVICE_FUSED"])
 
 
+# scripts/verify_tier1.sh fused-admission parity leg: force
+# serene_device_fused_ext to the given value ("on"/"off") for a whole
+# run — the off pass restores the PR-7 admission walls (string/FILTER/
+# DISTINCT aggregates, outer joins, residual predicates and the
+# chained agg→top-N all fall back to the host oracle), proving the
+# widened tier is an optimization layer only.
+if os.environ.get("SERENE_DEVICE_FUSED_EXT"):
+    from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_DFX
+
+    _SDB_REG_DFX.set_global("serene_device_fused_ext",
+                            os.environ["SERENE_DEVICE_FUSED_EXT"])
+
+
 # scripts/verify_tier1.sh search-batch parity leg: force
 # serene_search_batch to the given value ("on"/"off") for a whole run —
 # the off pass proves the query batcher is a dispatch-coalescing layer
