@@ -446,22 +446,35 @@ def bench_bm25_8m() -> float:
 
 
 def bench_ingest() -> float:
-    """Parallel-ingest throughput (reference ParallelSink analog:
-    server/connector/duckdb_physical_search_insert.h — per-thread sink
-    writers): build an inverted index over ~200MB of synthetic text with
-    the native indexer at 1 thread vs all cores. Returns the scaling
-    ratio (mt/1t); MB/s for both in extras. Asserts real scaling when
-    the machine has >=2 cores, and 1t/mt parity always."""
+    """Production streaming-ingest shape (ISSUE 18): (a) raw parallel
+    analysis MB/s vs the serial oracle, bit-identity asserted; (b)
+    sustained END-TO-END engine ingest — MB/s + docs/s under 1/4/8
+    concurrent writers WITH concurrent readers against a durable db
+    (WAL group commit + the maintenance ticker live), read p99 during
+    ingest recorded per writer count; (c) read p99 under background vs
+    foreground segment maintenance — the headline HTAP number; (d)
+    relational + search results bit-identical with parallel ingest
+    on/off. Returns the raw-analysis speedup (parallel/serial); the
+    scaling assert fires only on multi-core hosts (the PR 5/10 noise
+    lesson), everything else is recorded in extras."""
+    import tempfile
+    import threading
+
     import numpy as np
 
-    from serenedb_tpu.native import build_field_index_native, load
+    from serenedb_tpu.engine import Database
+    from serenedb_tpu.search.analysis import get_analyzer
+    from serenedb_tpu.search.segment import (build_field_index,
+                                             build_field_index_auto)
+    from serenedb_tpu.utils.config import REGISTRY
 
-    if load() is None:
-        raise RuntimeError("native indexer unavailable")
     n_cores = os.cpu_count() or 1
+    _EXTRA["threads"] = n_cores
+
+    # ---- (a) raw parallel analysis vs the serial oracle --------------
     rng = np.random.default_rng(7)
     vocab = np.asarray([f"w{i}" for i in range(50_000)], dtype=object)
-    n_docs = 100_000
+    n_docs = 60_000
     lens = rng.integers(40, 160, n_docs)
     zipf = rng.zipf(1.2, size=int(lens.sum())) % len(vocab)
     bounds = np.concatenate([[0], np.cumsum(lens)])
@@ -469,24 +482,151 @@ def bench_ingest() -> float:
     docs = [" ".join(words[bounds[i]:bounds[i + 1]]) for i in range(n_docs)]
     del words, zipf
     mb = sum(len(d) for d in docs) / (1 << 20)
+    an = get_analyzer("simple")
 
+    REGISTRY.set_global("serene_parallel_ingest", False)
     t0 = time.perf_counter()
-    fi_1 = build_field_index_native(docs, n_threads=1)
-    t_1 = time.perf_counter() - t0
+    fi_ser = build_field_index(list(docs), an)
+    t_ser = time.perf_counter() - t0
+    REGISTRY.set_global("serene_parallel_ingest", True)
+    REGISTRY.set_global("serene_workers", n_cores)
     t0 = time.perf_counter()
-    fi_mt = build_field_index_native(docs, n_threads=n_cores)
-    t_mt = time.perf_counter() - t0
-    assert list(fi_1.terms[:100]) == list(fi_mt.terms[:100])
-    assert fi_1.total_tokens == fi_mt.total_tokens
+    fi_par = build_field_index_auto(list(docs), an)
+    t_par = time.perf_counter() - t0
+    # bit-identity: the deterministic merge must reproduce the serial
+    # build exactly, not just approximately
     import numpy.testing as npt
-    npt.assert_array_equal(fi_1.post_docs, fi_mt.post_docs)
-    npt.assert_array_equal(fi_1.norms, fi_mt.norms)
-
+    assert [str(t) for t in fi_ser.terms] == [str(t) for t in fi_par.terms]
+    for f in ("doc_freq", "offsets", "post_docs", "post_tfs",
+              "pos_offsets", "positions", "norms", "block_max_tf",
+              "block_offsets"):
+        npt.assert_array_equal(getattr(fi_ser, f), getattr(fi_par, f), f)
+    assert fi_ser.total_tokens == fi_par.total_tokens
     _EXTRA["mb"] = round(mb, 1)
-    _EXTRA["threads"] = n_cores
-    _EXTRA["mbps_1t"] = round(mb / t_1, 1)
-    _EXTRA["mbps_mt"] = round(mb / t_mt, 1)
-    ratio = t_1 / t_mt
+    _EXTRA["mbps_1t"] = round(mb / t_ser, 1)
+    _EXTRA["mbps_mt"] = round(mb / t_par, 1)
+    del fi_ser, fi_par
+
+    # ---- (b) end-to-end writers × readers against a durable db -------
+    body = [" ".join(f"w{int(x)}" for x in rng.integers(0, 3000, 14))
+            for _ in range(400)]
+
+    def _stream(db, n_writers, total_docs, batch=50):
+        """Insert total_docs across n_writers threads while 2 readers
+        hammer search queries; returns (seconds, read latencies ms)."""
+        stmts = []
+        for s in range(0, total_docs, batch):
+            vals = ", ".join(
+                f"({s + j}, '{body[(s + j) % len(body)]}')"
+                for j in range(min(batch, total_docs - s)))
+            stmts.append(f"INSERT INTO docs VALUES {vals}")
+        nbytes = sum(len(body[i % len(body)]) for i in range(total_docs))
+        cursor = {"i": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+        lat_ms, errs = [], []
+
+        def writer():
+            c = db.connect()
+            try:
+                while True:
+                    with lock:
+                        i = cursor["i"]
+                        cursor["i"] += 1
+                    if i >= len(stmts):
+                        return
+                    c.execute(stmts[i])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        def reader():
+            c = db.connect()
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                c.execute("SELECT count(*) FROM docs WHERE body @@ 'w1'")
+                c.execute("SELECT id, bm25(body) AS s FROM docs "
+                          "WHERE body @@ 'w7' ORDER BY s DESC, id LIMIT 10")
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        rs = [threading.Thread(target=reader, daemon=True)
+              for _ in range(2)]
+        ws = [threading.Thread(target=writer) for _ in range(n_writers)]
+        t0 = time.perf_counter()
+        for t in rs + ws:
+            t.start()
+        for t in ws:
+            t.join()
+        dt = time.perf_counter() - t0
+        stop.set()
+        for t in rs:
+            t.join(timeout=30)
+        if errs:
+            raise errs[0]
+        return dt, nbytes, lat_ms
+
+    def _fresh_db(tmp, tag):
+        d = Database(os.path.join(tmp, tag))
+        c = d.connect()
+        c.execute("CREATE TABLE docs (id INT, body TEXT)")
+        c.execute(f"INSERT INTO docs VALUES (-1, '{body[0]}')")
+        c.execute("CREATE INDEX ON docs USING inverted (body)")
+        return d
+
+    curve = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for w in (1, 4, 8):
+            db = _fresh_db(tmp, f"w{w}")
+            dt, nbytes, lat = _stream(db, w, 3000)
+            curve[str(w)] = {
+                "docs_per_s": round(3000 / dt, 1),
+                "mbps": round(nbytes / (1 << 20) / dt, 2),
+                "read_p99_ms": round(float(np.percentile(lat, 99)), 2)
+                if lat else None,
+                "reads": len(lat)}
+            db.close()
+
+        # ---- (c) read p99: background vs foreground maintenance ------
+        p99 = {}
+        for mode, bg in (("bg", True), ("fg", False)):
+            REGISTRY.set_global("serene_background_merge", bg)
+            REGISTRY.set_global("serene_max_segments", 4)
+            db = _fresh_db(tmp, mode)
+            _, _, lat = _stream(db, 4, 3000)
+            p99[mode] = round(float(np.percentile(lat, 99)), 2) \
+                if lat else None
+            db.close()
+        REGISTRY.set_global("serene_background_merge", True)
+        REGISTRY.set_global("serene_max_segments", 8)
+    _EXTRA["writers_curve"] = curve
+    _EXTRA["read_p99_bg_ms"] = p99["bg"]
+    _EXTRA["read_p99_fg_ms"] = p99["fg"]
+
+    # ---- (d) end-to-end parity: parallel ingest on vs off ------------
+    REGISTRY.set_global("serene_ingest_chunk_docs", 64)
+    states = {}
+    for on in (False, True):
+        REGISTRY.set_global("serene_parallel_ingest", on)
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE docs (id INT, body TEXT)")
+        for s in range(0, 2000, 100):
+            vals = ", ".join(f"({s + j}, '{body[(s + j) % len(body)]}')"
+                             for j in range(100))
+            c.execute(f"INSERT INTO docs VALUES {vals}")
+        c.execute("CREATE INDEX ON docs USING inverted (body)")
+        states[on] = (
+            c.execute("SELECT count(*) FROM docs WHERE body @@ 'w1'"
+                      ).scalar(),
+            c.execute("SELECT id, bm25(body) AS s FROM docs "
+                      "WHERE body @@ 'w7' ORDER BY s DESC, id LIMIT 20"
+                      ).rows(),
+            c.execute("SELECT id % 7, count(*) FROM docs "
+                      "WHERE body @@ 'w2 | w3' GROUP BY id % 7 "
+                      "ORDER BY 1").rows())
+    assert states[False] == states[True], "parallel-ingest parity broke"
+    REGISTRY.set_global("serene_ingest_chunk_docs", 4096)
+
+    ratio = t_ser / t_par
     if n_cores >= 2:
         assert ratio > 1.3, \
             f"parallel ingest does not scale: {ratio:.2f}x on {n_cores} cores"

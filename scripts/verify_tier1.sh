@@ -215,6 +215,24 @@ if [ "$rc16" -eq 0 ]; then
     rc16=$?
 fi
 
+# Pass 17 is the streaming-ingest parity leg: parallel analysis is
+# forced ON with the segment-merge ladder pinned at a tiny cap of 3
+# (the conftest env hooks arm serene_parallel_ingest and
+# serene_max_segments) over the storage, segment, search, ES API and
+# ingest-stream suites — every index build then chunk-splits across
+# the worker pool and practically every append walks the tiered merge
+# ladder, proving the parallel analysis merge and the background
+# maintenance tiers are publish-mechanics only: a single diverged
+# result bit fails the suites' parity assertions loudly.
+echo "== streaming ingest parity pass (parallel ingest on, 3-segment cap) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_PARALLEL_INGEST=on \
+    SERENE_INGEST_CHUNK_DOCS=64 SERENE_MAX_SEGMENTS=3 \
+    python -m pytest tests/test_storage.py tests/test_segments.py \
+    tests/test_search.py tests/test_es_api.py \
+    tests/test_ingest_stream.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc17=$?
+
 # Structural grep lint: every jit compilation in the engine must route
 # through the PR 15 compile ledger (obs/device.compiled) so the program
 # cache stays bounded and observable — a bare jax.jit( call site
@@ -262,4 +280,5 @@ fi
 [ "$rc13" -ne 0 ] && exit "$rc13"
 [ "$rc14" -ne 0 ] && exit "$rc14"
 [ "$rc16" -ne 0 ] && exit "$rc16"
+[ "$rc17" -ne 0 ] && exit "$rc17"
 exit "$rc15"

@@ -106,6 +106,18 @@ assert "serene_fair_share" not in RESULT_AFFECTING_SETTINGS
 assert "serene_priority" not in RESULT_AFFECTING_SETTINGS
 assert "serene_work_mem" not in RESULT_AFFECTING_SETTINGS
 assert "serene_statement_timeout_ms" not in RESULT_AFFECTING_SETTINGS
+# the streaming-ingest tier is bit-identical by contract: the parallel
+# analysis merge reproduces the serial segment byte for byte, group-commit
+# windows only coalesce WHEN publications land (every statement still
+# fsyncs before returning), and background vs foreground maintenance only
+# changes the segment LAYOUT — scores use global collection stats, so any
+# layout returns identical results (tests/test_ingest_stream.py parity
+# matrix and the verify_tier1.sh pass 17 enforce all three)
+assert "serene_parallel_ingest" not in RESULT_AFFECTING_SETTINGS
+assert "serene_ingest_chunk_docs" not in RESULT_AFFECTING_SETTINGS
+assert "serene_group_commit" not in RESULT_AFFECTING_SETTINGS
+assert "serene_background_merge" not in RESULT_AFFECTING_SETTINGS
+assert "serene_max_segments" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for
 #: the plan-skipping fast path

@@ -61,8 +61,8 @@ def write_parquet_snapshot(path: str, batch: Batch) -> None:
 
 def read_parquet_snapshot(path: str) -> Batch:
     import pyarrow.parquet as pq
-    from ..exec.tables import _arrow_to_column
-    tbl = pq.read_table(path)
+    from ..exec.tables import columns_parallel
+    tbl = pq.read_table(path, use_threads=False)
     names = list(tbl.schema.names)
-    cols = [_arrow_to_column(tbl.column(n)) for n in names]
-    return Batch(names, cols)
+    cols = columns_parallel(tbl, names)
+    return Batch(names, [cols[n] for n in names])

@@ -3178,13 +3178,15 @@ class Connection:
                 self._wal_commit(table, [("insert", aligned, None)])
                 _append_rows(table, aligned)
                 pk_extend(table, enc, n_before, base_ver)
+                self._ingest_observe(table, aligned)
                 return aligned
             # give way to any mutator waiting to quiesce this table —
             # without this gate a sustained insert stream starves it
             while getattr(table, "_quiesce_waiters", 0):
                 table.pub_cond.wait(timeout=5)
             table._inflight = getattr(table, "_inflight", 0) + 1
-            entry = {"tick": None, "done": False}
+            entry = {"tick": None, "done": False, "ready": False,
+                     "batch": None}
             if not hasattr(table, "_pub_entries"):
                 table._pub_entries = []
             table._pub_entries.append(entry)
@@ -3202,15 +3204,41 @@ class Connection:
             self._wal_commit(table, [("insert", aligned, None)],
                              on_tick=lambda t: entry.__setitem__("tick", t))
             with table.write_lock:
-                while any(e is not entry and not e["done"]
-                          and e["tick"] is not None
-                          and entry["tick"] is not None
-                          and e["tick"] < entry["tick"]
-                          for e in table._pub_entries):
-                    table.pub_cond.wait(timeout=5)
-                _append_rows(table, aligned)
-                entry["done"] = True
+                if entry["tick"] is None:
+                    # no WAL behind this table (in-memory db, txn working
+                    # copy): sequence publishes by arrival under the write
+                    # lock instead of by WAL tick. A table never mixes the
+                    # two domains — it either always logs or never does.
+                    table._pub_seq = getattr(table, "_pub_seq", 0) + 1
+                    entry["tick"] = table._pub_seq
+                entry["batch"] = aligned
+                entry["ready"] = True
                 table.pub_cond.notify_all()
+                if _group_commit_enabled():
+                    # coalesced publication: the lowest-ticked committed
+                    # entry publishes EVERY contiguous-by-tick ready entry
+                    # in one append (one version bump / cache invalidation
+                    # per window); followers wake marked done
+                    while not entry["done"]:
+                        run = _publish_run(table, entry)
+                        if run is None:
+                            table.pub_cond.wait(timeout=5)
+                            continue
+                        table.append_batches([e["batch"] for e in run])
+                        for e in run:
+                            e["done"] = True
+                            e["batch"] = None
+                        table.pub_cond.notify_all()
+                else:
+                    while any(e is not entry and not e["done"]
+                              and e["tick"] is not None
+                              and entry["tick"] is not None
+                              and e["tick"] < entry["tick"]
+                              for e in table._pub_entries):
+                        table.pub_cond.wait(timeout=5)
+                    _append_rows(table, aligned)
+                    entry["done"] = True
+                    table.pub_cond.notify_all()
         finally:
             with table.write_lock:
                 entry["done"] = True
@@ -3220,7 +3248,28 @@ class Connection:
                     pass
                 table._inflight -= 1
                 table.pub_cond.notify_all()
+        self._ingest_observe(table, aligned)
         return aligned
+
+    def _ingest_observe(self, table: MemTable, aligned: Batch) -> None:
+        """Write-path accounting + background-maintenance wakeup: count
+        the appended rows/bytes and, when the table carries indexes, wake
+        the maintenance ticker so the delta range becomes a segment off
+        the query path (the append 'enqueues' its delta implicitly —
+        [indexed_rows, n_rows) of every stale index)."""
+        metrics.INGEST_BATCHES.add()
+        metrics.INGEST_DOCS.add(aligned.num_rows)
+        nbytes = 0
+        for col in aligned.columns:
+            nbytes += int(col.data.nbytes)
+            if col.validity is not None:
+                nbytes += int(col.validity.nbytes)
+            if col.dictionary is not None:
+                nbytes += sum(len(str(s)) for s in col.dictionary)
+        metrics.INGEST_BYTES.add(nbytes)
+        mm = self.db.maintenance
+        if mm is not None and getattr(table, "indexes", None):
+            mm.notify_append()
 
     def _wal_commit(self, table: MemTable, ops: list[tuple], on_tick=None):
         """Durably log (kind, batch, rows) ops for a stored table before the
@@ -3236,6 +3285,37 @@ class Connection:
         wal_ops = [WalOp(table.key, kind, batch, rows)
                    for kind, batch, rows in ops]
         self.db.store.commit(wal_ops, on_tick=on_tick)
+
+
+def _group_commit_enabled() -> bool:
+    from .utils.config import REGISTRY
+    try:
+        return bool(REGISTRY.get_global("serene_group_commit"))
+    except KeyError:
+        return True
+
+
+def _publish_run(table: MemTable, entry: dict):
+    """The group-commit publication window leader election (called under
+    the table's write_lock): returns the tick-ordered run of committed
+    entries THIS entry must publish — itself plus every later contiguous
+    ready entry — or None when a lower-ticked commit is still pending
+    (that commit's thread leads, and may publish this entry too).
+    Correctness leans on the WAL queue-lock invariant: tick order ==
+    enqueue order, and an entry with tick None will be assigned a LATER
+    tick than every entry already ticked, so it can never belong before
+    this run."""
+    pend = [e for e in table._pub_entries
+            if not e["done"] and e["tick"] is not None]
+    pend.sort(key=lambda e: e["tick"])
+    if not pend or pend[0] is not entry:
+        return None
+    run = []
+    for e in pend:
+        if not e["ready"]:
+            break
+        run.append(e)
+    return run
 
 
 def _apply_ops(table: MemTable, ops: list[tuple]) -> None:
@@ -3440,14 +3520,18 @@ def _append_rows(table: MemTable, aligned: Batch) -> None:
 def _refresh_indexes(db: Database, table: MemTable) -> None:
     """Refresh any index whose data_version is stale (the refresh leg of
     the reference's RefreshLoop, task.cpp:237-343): appends publish a new
-    segment, mutations trigger the rebuild/merge leg."""
-    from .search.index import _repair, refresh_index
+    segment, mutations trigger the rebuild leg, and segment tiers at the
+    cap run the merge ladder — this is the maintenance/VACUUM entry, so
+    compaction happens HERE (merge=True), off the query path."""
+    from .search.index import _repair, needs_merge, refresh_index
     for name, idx in list(getattr(table, "indexes", {}).items()):
-        if idx.data_version != table.data_version:
+        stale = idx.data_version != table.data_version
+        if stale or needs_merge(idx):
             # shares the per-provider rebuild lock + pre-build version stamp
             # with the read-repair path so concurrent repairs can't race
             _repair(table, name, idx,
-                    lambda cur: refresh_index(table, cur))
+                    lambda cur: refresh_index(table, cur),
+                    force=not stale)
 
 
 def _coerce(col: Column, target: dt.SqlType) -> Column:

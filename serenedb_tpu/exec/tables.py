@@ -234,13 +234,23 @@ class MemTable(TableProvider):
     def append_batch(self, aligned: Batch):
         """Append rows (schema-aligned) without changing existing row
         identity — search indexes stay valid for the old rows."""
+        self.append_batches([aligned])
+
+    def append_batches(self, aligned_list: list):
+        """Append several schema-aligned batches in ONE publication — the
+        group-commit window's in-memory half: one column concat, one
+        data_version bump, one device-cache clear, so per-table
+        invalidation (result cache keys, device uploads) is paid per
+        WINDOW, not per statement. Callers order the batches by WAL tick;
+        the concat preserves that order, so replayed state matches."""
         from ..columnar.column import concat_batches
         batch = self._batch
         cols = []
         for i, name in enumerate(self.column_names):
             merged = concat_batches(
-                [Batch([name], [batch.columns[i]]),
-                 Batch([name], [aligned.columns[i]])]).columns[0]
+                [Batch([name], [batch.columns[i]])] +
+                [Batch([name], [a.columns[i]])
+                 for a in aligned_list]).columns[0]
             cols.append(merged)
         self.replace(Batch(list(self.column_names), cols),
                      rows_preserved=True)
@@ -292,6 +302,29 @@ def _arrow_to_column(arr) -> Column:
     return Column(dt.type_of_numpy(data.dtype), data, null_mask)
 
 
+def columns_parallel(tbl, names: list) -> dict:
+    """{name: Column} conversions of a pyarrow Table's columns, fanned
+    out over the shared worker pool when `serene_parallel_ingest` is on.
+
+    History: PR 1 serialized ALL parquet column work because pyarrow's
+    INTERNAL thread pool segfaulted after a write on another daemon
+    thread. The crash lived in pyarrow's own pool (use_threads=True),
+    which the file READ still avoids; each conversion here runs
+    single-threaded pyarrow compute (combine_chunks / cast /
+    dictionary_encode) on one of OUR workers, which the regression test
+    in tests/test_ingest_stream.py drives through the original
+    write-on-daemon-thread-then-read scenario. Off (or a single column)
+    falls back to the serial loop — the parity oracle."""
+    names = list(names)
+    from ..search.segment import _ingest_setting
+    if len(names) > 1 and _ingest_setting(None, "serene_parallel_ingest"):
+        from ..parallel.pool import parallel_map
+        cols = parallel_map(
+            None, lambda n: _arrow_to_column(tbl.column(n)), names)
+        return dict(zip(names, cols))
+    return {n: _arrow_to_column(tbl.column(n)) for n in names}
+
+
 class ParquetTable(TableProvider):
     """Zero-ETL parquet scan (reference analog: view-over-parquet fast path,
     index_source_view_file.*, examples/demo0/demo.sql)."""
@@ -321,20 +354,17 @@ class ParquetTable(TableProvider):
         with self._lock:
             to_read = [c for c in cols if c not in self._columns]
             if to_read:
-                # use_threads=False: pyarrow's internal CPU pool segfaults when a
-                # write happened on another (daemon) server thread earlier in
-                # this process; single-threaded decode is safe and the column
-                # cache amortizes it (see test_filesource server drive).
-                # Column BUILDING stays serial for the same reason:
-                # _arrow_to_column runs pyarrow compute (combine_chunks,
-                # cast, dictionary_encode) that may touch the same native
-                # pool — handing it to worker threads would reintroduce
-                # exactly the multithreaded-pyarrow state this workaround
-                # exists to avoid. Ingest parallelism lives in the COPY
-                # text/csv chunk parser instead (engine._parse_chunked).
+                # use_threads=False: pyarrow's INTERNAL CPU pool segfaults
+                # when a write happened on another (daemon) server thread
+                # earlier in this process; single-threaded file decode is
+                # safe and the column cache amortizes it (see
+                # test_filesource server drive). Column BUILDING fans out
+                # over OUR worker pool instead (columns_parallel) — each
+                # worker runs single-threaded pyarrow compute, which does
+                # not wake pyarrow's pool; serene_parallel_ingest=off
+                # restores the fully serial loop.
                 tbl = self._pf.read(columns=to_read, use_threads=False)
-                for cname in to_read:
-                    self._columns[cname] = _arrow_to_column(tbl.column(cname))
+                self._columns.update(columns_parallel(tbl, to_read))
             return Batch(list(cols), [self._columns[c] for c in cols])
 
 

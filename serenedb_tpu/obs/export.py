@@ -111,6 +111,17 @@ def stats_json() -> dict:
             "latency": {h.name: h.percentiles_ms()
                         for h in _metrics.REGISTRY.all_histograms()
                         if h.unit == "s"},
+            # write path: append/segment/fsync counters + the group-commit
+            # amortization signals (commits per fsync, fsync latency)
+            "ingest": {
+                "docs": snap.get("IngestDocs", 0),
+                "bytes": snap.get("IngestBytes", 0),
+                "batches": snap.get("IngestBatches", 0),
+                "segment_builds": snap.get("SegmentBuilds", 0),
+                "segment_merges": snap.get("SegmentMerges", 0),
+                "wal_commits": snap.get("WalCommits", 0),
+                "wal_fsyncs": snap.get("WalFsyncs", 0),
+                "wal_fsync": _metrics.WAL_FSYNC_HIST.percentiles_ms()},
             "statements": STATEMENTS.snapshot(),
             "cache": {"result": RESULT_CACHE.stats(),
                       "fragments": FRAGMENTS.stats()},

@@ -7,14 +7,45 @@ table contents; the storage layer adds incremental segments + WAL.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 
 import numpy as np
 
 from .. import errors
+from ..utils import log, metrics
 from .analysis import get_analyzer
 from .searcher import MultiSearcher, SearchIndex, SegmentSearcher
-from .segment import build_field_index
+# build_field_index stays re-exported: callers that want the serial
+# oracle unconditionally (tests, parity harnesses) import it from here.
+from .segment import build_field_index  # noqa: F401
+from .segment import build_field_index_auto
+
+
+@contextlib.contextmanager
+def _span(name: str, **detail):
+    """Record a segment_build/segment_merge span on the executing
+    statement's timeline (read-repair inside a query) when one exists;
+    maintenance-thread builds run outside any trace and skip it."""
+    from ..obs.trace import current_trace
+    tr = current_trace()
+    if tr is None:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        tr.add(name, "ingest", t0, time.perf_counter_ns(), **detail)
+
+
+def _build_field(texts, an, settings=None):
+    """One field-segment build: the parallel-chunk builder (bit-identical
+    to serial), counted and traced."""
+    metrics.SEGMENT_BUILDS.add()
+    with _span("segment_build", docs=len(texts)):
+        return build_field_index_auto(texts, an, settings)
 
 
 class BtreeIndex:
@@ -67,16 +98,18 @@ def _index_lock(provider) -> threading.Lock:
     return lk
 
 
-def _repair(provider, name, idx, rebuild):
+def _repair(provider, name, idx, rebuild, force=False):
     """Read-repair `idx` under the provider's rebuild lock. The version is
     captured BEFORE the data is read: if a concurrent fast-path publish
     lands mid-build the new index carries the older stamp, so the next
     reader repairs again instead of trusting an index that may be missing
     the published rows (an index with EXTRA rows is harmless — those rows
-    exist in the table)."""
+    exist in the table). `force` rebuilds even at a current version — the
+    maintenance ticker's merge-ladder leg compacts segment tiers whose
+    data is perfectly fresh."""
     with _index_lock(provider):
         cur = provider.indexes.get(name, idx)
-        if cur.data_version == provider.data_version:
+        if cur.data_version == provider.data_version and not force:
             return cur
         ver = provider.data_version
         new = rebuild(cur)
@@ -128,7 +161,7 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
                     f'inverted index requires a text column, "{col_name}" '
                     f"is {col.type}")
             texts = col.to_pylist()
-            fi = build_field_index(texts, an)
+            fi = _build_field(texts, an)
             ms = MultiSearcher(an)
             ms.add_segment(SegmentSearcher(fi, an, len(texts)), 0)
             searchers[col_name] = ms
@@ -138,28 +171,80 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
                        indexed_rows=n_rows)
 
 
-MAX_SEGMENTS = 8   # compaction threshold: full rebuild merges the tier
+MAX_SEGMENTS = 8   # default merge-ladder threshold (serene_max_segments)
 
 
-def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
+def _max_segments() -> int:
+    from ..utils.config import REGISTRY
+    try:
+        return max(2, int(REGISTRY.get_global("serene_max_segments")))
+    except KeyError:
+        return MAX_SEGMENTS
+
+
+def _background_merge() -> bool:
+    from ..utils.config import REGISTRY
+    try:
+        return bool(REGISTRY.get_global("serene_background_merge"))
+    except KeyError:
+        return True
+
+
+def _merge_tier(provider, col_name, an, segs: list, cap: int) -> list:
+    """Tiered merge ladder over one field's [(SegmentSearcher, base)] list:
+    while at/over the cap, rebuild the SMALLEST adjacent pair into one
+    segment re-read from the provider's columnstore — O(run docs) per
+    merge, never a full rebuild. Same epoch is a precondition (appends
+    only), so stored rows [base, base+docs) still hold each segment's
+    text."""
+    segs = list(segs)
+    col = None
+    while len(segs) >= cap:
+        sizes = [s.num_docs + segs[i + 1][0].num_docs
+                 for i, (s, _) in enumerate(segs[:-1])]
+        i = int(np.argmin(sizes))
+        lo_base = segs[i][1]
+        n_docs = segs[i][0].num_docs + segs[i + 1][0].num_docs
+        if col is None:
+            col = provider.full_batch([col_name]).column(col_name)
+        texts = col.slice(lo_base, lo_base + n_docs).to_pylist()
+        metrics.SEGMENT_MERGES.add()
+        with _span("segment_merge", docs=n_docs, segments=2):
+            fi = _build_field(texts, an)
+        segs[i:i + 2] = [(SegmentSearcher(fi, an, n_docs), lo_base)]
+    return segs
+
+
+def refresh_index(provider, idx, *,
+                  merge: bool = True) -> "SearchIndex | BtreeIndex":
     """Refresh one index (reference RefreshLoop leg). Inverted indexes:
     - rows appended since the last refresh → ONE new segment over the delta
       (O(new docs), the real-time path)
-    - row mutations (delete/update/truncate) or too many segments → full
-      rebuild (the compaction/merge leg)."""
+    - row mutations (delete/update/truncate) → full rebuild, with the
+      reason logged (a silent compaction storm is undiagnosable)
+    - at/over the segment cap → the tiered merge ladder compacts the
+      smallest adjacent runs (replacing the old full-rebuild cliff).
+      `merge=False` skips the ladder — the query-path read-repair leg
+      under background maintenance, which pays only the bounded delta
+      tail and leaves compaction to the maintenance ticker."""
     if idx.using != "inverted":
         return build_index_for_table(provider, idx.columns, idx.using,
                                      idx.options)
     same_epoch = idx.mutation_epoch == getattr(provider, "mutation_epoch", 0)
     n_rows = provider.row_count()
-    n_segments = max((len(ms.segments)
-                      for ms in idx.searchers.values()), default=1)
-    if not same_epoch or n_rows < idx.indexed_rows or \
-            n_segments >= MAX_SEGMENTS:
+    if not same_epoch or n_rows < idx.indexed_rows:
+        reason = ("mutation epoch advanced (delete/update/truncate)"
+                  if not same_epoch else
+                  f"row count shrank ({n_rows} < {idx.indexed_rows}) "
+                  "without an epoch bump (truncate/rollback)")
+        log.info("maintenance",
+                 f"full index rebuild on \"{provider.name}\" "
+                 f"({', '.join(idx.columns)}): {reason}")
         return build_index_for_table(provider, idx.columns, idx.using,
                                      idx.options)
     col_toks = idx.options.get("column_tokenizers", {}) or {}
     base = idx.indexed_rows
+    cap = _max_segments()
     # build-new-then-swap: assemble fresh MultiSearchers (reusing the old
     # immutable SegmentSearcher objects) and return a NEW SearchIndex the
     # caller publishes with one assignment — in-flight queries keep their
@@ -167,20 +252,35 @@ def refresh_index(provider, idx) -> "SearchIndex | BtreeIndex":
     new_searchers = {}
     for col_name in idx.columns:
         an = get_analyzer(col_toks.get(col_name, idx.analyzer_name))
-        ms = MultiSearcher(an)
-        for seg, seg_base in idx.searchers[col_name].segments:
-            ms.add_segment(seg, seg_base)
+        segs = list(idx.searchers[col_name].segments)
         if n_rows > base:
             col = provider.full_batch([col_name]).column(col_name)
             delta = col.slice(base, n_rows).to_pylist()  # O(new docs)
-            fi = build_field_index(delta, an)
-            ms.add_segment(SegmentSearcher(fi, an, len(delta)), base)
+            fi = _build_field(delta, an)
+            segs.append((SegmentSearcher(fi, an, len(delta)), base))
+        if merge and len(segs) >= cap:
+            segs = _merge_tier(provider, col_name, an, segs, cap)
+        ms = MultiSearcher(an)
+        for seg, seg_base in segs:
+            ms.add_segment(seg, seg_base)
         new_searchers[col_name] = ms
     return SearchIndex(list(idx.columns), idx.using, dict(idx.options),
                        idx.analyzer_name, new_searchers,
                        provider.data_version,
                        mutation_epoch=idx.mutation_epoch,
                        indexed_rows=n_rows)
+
+
+def needs_merge(idx) -> bool:
+    """True when an inverted index's segment tier is at/over the merge
+    ladder's cap — the maintenance ticker's compaction trigger (data may
+    be perfectly fresh; the ladder is about read amplification, not
+    staleness)."""
+    if getattr(idx, "using", "") != "inverted":
+        return False
+    searchers = getattr(idx, "searchers", None) or {}
+    return max((len(ms.segments) for ms in searchers.values()),
+               default=0) >= _max_segments()
 
 
 def find_index(provider, column: str):
@@ -192,8 +292,13 @@ def find_index(provider, column: str):
     for name, idx in getattr(provider, "indexes", {}).items():
         if idx.using == "inverted" and column in idx.columns:
             if idx.data_version != provider.data_version:
+                # under background maintenance the query path pays only
+                # the bounded delta-tail build; the merge ladder runs on
+                # the maintenance ticker (refresh_index merge=True there)
+                fg = not _background_merge()
                 idx = _repair(provider, name, idx,
-                              lambda cur: refresh_index(provider, cur))
+                              lambda cur: refresh_index(provider, cur,
+                                                        merge=fg))
             return idx
     return None
 

@@ -34,6 +34,10 @@ class MaintenanceManager:
         self._slots = threading.Semaphore(MAX_SLOTS)
         self._checkpointed_version: dict[str, int] = {}
         self._last_checkpoint = time.monotonic()
+        #: set by the write path after an append publishes: the ticker
+        #: wakes immediately (instead of riding out its idle backoff) to
+        #: build the enqueued delta segments off the query path
+        self._wake = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -52,9 +56,16 @@ class MaintenanceManager:
 
     # -- loops -------------------------------------------------------------
 
+    def notify_append(self):
+        """Wake the ticker: an append just published, so a delta range is
+        waiting to become a segment (one lock-free Event.set — cheap
+        enough for the per-statement write path)."""
+        self._wake.set()
+
     def _loop(self):
         idle = self.refresh_interval
         while not self._stop.is_set():
+            self._wake.clear()
             did_work = False
             try:
                 did_work = self.run_once()
@@ -65,7 +76,11 @@ class MaintenanceManager:
             else:
                 # idle stretch ×1.5 capped at 5× (reference task.cpp:85-95)
                 idle = min(idle * 1.5, self.refresh_interval * 5)
-            self._stop.wait(idle)
+            if self._stop.is_set():
+                break
+            # appends cut the idle wait short so delta segments build
+            # promptly in the background, narrowing the tail queries pay
+            self._wake.wait(idle)
 
     def run_once(self) -> bool:
         """One maintenance pass; returns True if any work was done."""
@@ -94,13 +109,14 @@ class MaintenanceManager:
 
     def _refresh_pass(self) -> bool:
         from ..engine import _refresh_indexes
+        from ..search.index import needs_merge
         did = False
         with self.db.lock:
             tables = [t for s in self.db.schemas.values()
                       for t in s.tables.values()]
         for t in tables:
             idxs = getattr(t, "indexes", {})
-            if any(ix.data_version != t.data_version
+            if any(ix.data_version != t.data_version or needs_merge(ix)
                    for ix in idxs.values()):
                 with self._slots:
                     with metrics.REFRESH_ACTIVE.scoped():

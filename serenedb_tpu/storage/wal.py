@@ -32,6 +32,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -57,6 +58,17 @@ _HDR = struct.Struct("<II")
 # silently skip the delete and resurrect rows, exactly what versioning
 # is for.
 SEGMENT_MAGIC = b"SDBWAL\x00\x03"
+
+
+def _group_commit() -> bool:
+    """serene_group_commit global: widens the leader's write window with a
+    bounded queue re-drain before the fsync (off = one drain per fsync,
+    the parity oracle for recovery tests)."""
+    from ..utils.config import REGISTRY
+    try:
+        return bool(REGISTRY.get_global("serene_group_commit"))
+    except KeyError:
+        return True
 
 
 @dataclass
@@ -287,8 +299,34 @@ class SearchDbWal:
                         self._fh.write(frame)
                         self._bytes += len(frame)
                         max_tick = max(max_tick, e.tick)
+                    # group-commit window: re-drain the queue for commits
+                    # that enqueued while this leader was writing, so they
+                    # ride THIS fsync instead of forcing their own. Bounded
+                    # passes keep leader latency predictable; the rollback
+                    # below covers every frame written since start_bytes,
+                    # drained entries are failed with the batch on error.
+                    if _group_commit():
+                        for _ in range(4):
+                            with self._pending_lock:
+                                extra, self._pending = self._pending, []
+                            if not extra:
+                                break
+                            for e in extra:
+                                tb = struct.pack("<Q", e.tick)
+                                frame = _HDR.pack(
+                                    len(e.payload),
+                                    zlib.crc32(tb + e.payload)) \
+                                    + tb + e.payload
+                                self._fh.write(frame)
+                                self._bytes += len(frame)
+                                max_tick = max(max_tick, e.tick)
+                                batch.append(e)
+                    t0 = time.perf_counter_ns()
                     self._fh.flush()
                     os.fsync(self._fh.fileno())
+                    metrics.WAL_FSYNCS.add()
+                    metrics.WAL_FSYNC_HIST.observe_ns(
+                        time.perf_counter_ns() - t0)
                     self._seg_max_tick[self._gen] = max(
                         self._seg_max_tick.get(self._gen, 0), max_tick)
                 except BaseException as exc:

@@ -480,6 +480,52 @@ declare("serene_statement_timeout_ms", 0, int,
         "value wins) and fires through the same cooperative cancellation "
         "drain (SQLSTATE 57014), including while a statement is QUEUED "
         "for admission", validator=lambda v: max(0, int(v)))
+# -- streaming ingest (write path) ------------------------------------------
+
+declare("serene_parallel_ingest", True, bool,
+        "parallel write-path analysis: segment builds chunk-split their "
+        "document batches across the shared worker pool (per-chunk "
+        "tokenization + postings build, merged with a deterministic "
+        "base-row-ordered concat) and parquet column decoding builds "
+        "columns concurrently; the merged segment is BIT-IDENTICAL to "
+        "the serial build — postings order, norms, WAND block metadata "
+        "and every score — so this setting stays out of the result "
+        "cache's settings digest; off runs the serial single-pass "
+        "builder (the parity oracle)")
+declare("serene_ingest_chunk_docs", 4096, int,
+        "documents per analysis chunk for parallel segment builds; a "
+        "corpus smaller than two chunks builds serially (chunk setup "
+        "costs more than it buys). The chunk split is fixed-size and "
+        "independent of worker count, so the merged postings are "
+        "identical at any parallelism", validator=lambda v: max(64, int(v)))
+declare("serene_group_commit", True, bool,
+        "ingest-side group-commit windows: the WAL leader re-drains the "
+        "commit queue for late arrivals before its single fsync, and "
+        "concurrent fast-path INSERTs of one table coalesce their "
+        "in-memory publications into ONE batch concat + version bump "
+        "per window (per-table cache invalidation per WINDOW, not per "
+        "statement). Durability and replay order are unchanged — every "
+        "frame is fsynced before its statement returns, publishes stay "
+        "sequenced by WAL tick — so results are bit-identical on or "
+        "off; off restores one publish per statement (the parity "
+        "oracle)", scope=Scope.GLOBAL)
+declare("serene_background_merge", True, bool,
+        "background segment maintenance: query-path read-repair of a "
+        "stale inverted index only builds the bounded delta tail (the "
+        "rows appended since the last refresh) and never pays "
+        "compaction; the maintenance ticker — woken by appends — runs "
+        "the tiered merge ladder off the query path, publishing via "
+        "the same build-new-then-swap snapshot. Scores use global "
+        "collection stats, so results are bit-identical at ANY segment "
+        "layout; off restores foreground compaction at the segment cap "
+        "(the parity oracle)", scope=Scope.GLOBAL)
+declare("serene_max_segments", 8, int,
+        "per-field segment-count threshold of the tiered merge ladder: "
+        "at or above it, maintenance (or foreground refresh with "
+        "serene_background_merge off) merges the smallest adjacent run "
+        "of segments — O(run docs), not a full rebuild — until back "
+        "under the cap. Lower values merge more eagerly",
+        scope=Scope.GLOBAL, validator=lambda v: max(2, int(v)))
 declare("serene_zonemap_verify", False, bool,
         "debug assert mode: re-scan every zone-map-pruned block with "
         "the real predicate and fail the query loudly if any row "

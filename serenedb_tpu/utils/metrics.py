@@ -246,6 +246,22 @@ DEVICE_FETCH_BYTES = REGISTRY.gauge(
     "bytes copied device->host fetching program outputs (the "
     "readback sibling of DeviceBytesMoved)")
 WAL_COMMITS = REGISTRY.gauge("WalCommits", "search WAL commit records written")
+WAL_FSYNCS = REGISTRY.gauge(
+    "WalFsyncs", "WAL group-commit fsync calls (commits per fsync = "
+    "WalCommits / WalFsyncs — the group-commit amortization ratio)")
+INGEST_DOCS = REGISTRY.gauge(
+    "IngestDocs", "rows appended through the write path (INSERT/COPY)")
+INGEST_BYTES = REGISTRY.gauge(
+    "IngestBytes", "columnar bytes appended through the write path")
+INGEST_BATCHES = REGISTRY.gauge(
+    "IngestBatches", "write-path append batches (statements or COPY "
+    "chunks; IngestDocs / IngestBatches = mean batch size)")
+SEGMENT_BUILDS = REGISTRY.gauge(
+    "SegmentBuilds", "inverted-index field segments built (initial "
+    "builds + delta tails)")
+SEGMENT_MERGES = REGISTRY.gauge(
+    "SegmentMerges", "tiered segment merges (adjacent runs compacted "
+    "into one segment)")
 POOL_MORSELS = REGISTRY.gauge("PoolMorselsExecuted",
                               "morsel tasks executed by the worker pool")
 POOL_QUEUE_WAIT_US = REGISTRY.gauge("PoolQueueWaitUs",
@@ -456,6 +472,10 @@ DEVICE_COMPILE_HIST = REGISTRY.histogram(
     "first-dispatch latency of each jitted device program (XLA "
     "trace + compile + the first execution — the compile-stall a "
     "cold query pays; warm dispatches land in DeviceDispatch)")
+WAL_FSYNC_HIST = REGISTRY.histogram(
+    "WalFsync",
+    "WAL group-commit flush+fsync latency (one observation per fsync, "
+    "however many commit frames it covered)")
 QUERY_PEAK_BYTES_HIST = REGISTRY.histogram(
     "QueryPeakBytes",
     "per-statement accounted peak memory (serene_mem_account): the "

@@ -212,6 +212,29 @@ if os.environ.get("SERENE_POSTING_PAGES"):
                             os.environ["SERENE_POSTING_PAGES"])
 
 
+# scripts/verify_tier1.sh streaming-ingest parity leg: force the
+# write-path knobs to the given values for a whole run —
+# SERENE_PARALLEL_INGEST=on (with a small SERENE_INGEST_CHUNK_DOCS so
+# modest suite corpora actually chunk-split) proves the parallel
+# analysis merge is bit-identical to the serial oracle suite-wide; a
+# tiny SERENE_MAX_SEGMENTS walks the tiered merge ladder on practically
+# every append; SERENE_BACKGROUND_MERGE/SERENE_GROUP_COMMIT flip the
+# maintenance placement and fsync coalescing without a result-bit
+# anywhere.
+_INGEST_ENV_HOOKS = {
+    "SERENE_PARALLEL_INGEST": "serene_parallel_ingest",
+    "SERENE_INGEST_CHUNK_DOCS": "serene_ingest_chunk_docs",
+    "SERENE_MAX_SEGMENTS": "serene_max_segments",
+    "SERENE_BACKGROUND_MERGE": "serene_background_merge",
+    "SERENE_GROUP_COMMIT": "serene_group_commit",
+}
+for _env, _setting in _INGEST_ENV_HOOKS.items():
+    if os.environ.get(_env):
+        from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_ING
+
+        _SDB_REG_ING.set_global(_setting, os.environ[_env])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

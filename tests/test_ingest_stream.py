@@ -1,0 +1,401 @@
+"""Streaming-ingest path: parallel analysis parity (bit-identical to the
+serial oracle at any worker count), group-commit windows (coalesced fsync +
+coalesced publish, recovery-complete), background segment maintenance
+(bounded tiers off the query path), parallel parquet column building, and
+the write-path observability surface."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.search.analysis import get_analyzer
+from serenedb_tpu.search.segment import (build_field_index,
+                                         build_field_index_auto)
+from serenedb_tpu.utils.config import REGISTRY
+
+
+class _globals:
+    """Set registry globals for one test, restoring previous values on
+    exit (same contract as tests/test_admission.py: the process-wide
+    ingest knobs must be left exactly as the verify_tier1.sh env hooks
+    set them)."""
+
+    def __init__(self, **kv):
+        self.kv = kv
+        self.prev = {}
+
+    def __enter__(self):
+        for k, v in self.kv.items():
+            self.prev[k] = REGISTRY.get_global(k)
+            REGISTRY.set_global(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self.prev.items():
+            REGISTRY.set_global(k, v)
+        return False
+
+
+def _corpus(n, seed=3):
+    rng = np.random.default_rng(seed)
+    vocab = ["alpha", "beta", "gamma", "delta", "omega", "Sigma", "nu",
+             "stream", "ingest", "merge", "segment", "wal", "fsync"]
+    docs = []
+    for i in range(n):
+        if i % 17 == 5:
+            docs.append(None)            # NULL rows must keep norms aligned
+            continue
+        k = int(rng.integers(1, 12))
+        docs.append(" ".join(rng.choice(vocab, size=k)))
+    return docs
+
+
+def _assert_field_index_equal(a, b):
+    assert [str(t) for t in a.terms] == [str(t) for t in b.terms]
+    for name in ("doc_freq", "offsets", "post_docs", "post_tfs",
+                 "pos_offsets", "positions", "norms", "block_max_tf",
+                 "block_offsets"):
+        av, bv = getattr(a, name), getattr(b, name)
+        assert av.dtype == bv.dtype, name
+        assert np.array_equal(av, bv), name
+    assert a.total_tokens == b.total_tokens
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+@pytest.mark.parametrize("analyzer", ["text", "simple"])
+def test_parallel_analysis_bit_identical(workers, analyzer):
+    """The tentpole parity contract: chunk-split analysis + deterministic
+    merge is BIT-IDENTICAL to the serial build — on/off × workers 1/4,
+    python and native (ascii+simple) chunk builders alike."""
+    an = get_analyzer(analyzer)
+    docs = _corpus(700)
+    with _globals(serene_parallel_ingest=False):
+        serial = build_field_index(list(docs), an)
+    for on in (True, False):
+        with _globals(serene_parallel_ingest=on,
+                      serene_ingest_chunk_docs=64,
+                      serene_workers=workers):
+            out = build_field_index_auto(list(docs), an)
+        _assert_field_index_equal(out, serial)
+
+
+def test_parallel_merge_handles_empty_and_tiny_chunks():
+    """Chunks that tokenize to nothing (all NULL / empty) must merge
+    cleanly — the norms still land, term-less parts contribute nothing."""
+    an = get_analyzer("text")
+    docs = [None] * 70 + ["alpha beta"] * 70 + [""] * 70
+    serial = build_field_index(list(docs), an)
+    with _globals(serene_parallel_ingest=True,
+                  serene_ingest_chunk_docs=64, serene_workers=4):
+        out = build_field_index_auto(list(docs), an)
+    _assert_field_index_equal(out, serial)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+@pytest.mark.parametrize("workers", [1, 4])
+def test_readers_during_ingest_parity(parallel, workers):
+    """Readers racing a sustained ingest stream must only ever observe
+    fully-published states: hit counts grow monotonically, and the final
+    index state is identical across the on/off × workers matrix."""
+    with _globals(serene_parallel_ingest=parallel,
+                  serene_ingest_chunk_docs=64,
+                  serene_workers=workers):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE docs (id INT, body TEXT)")
+        c.execute("INSERT INTO docs VALUES (0, 'alpha seed')")
+        c.execute("CREATE INDEX ON docs USING inverted (body)")
+        stop = threading.Event()
+        counts, errors = [], []
+
+        def reader():
+            rc = db.connect()
+            while not stop.is_set():
+                try:
+                    counts.append(rc.execute(
+                        "SELECT count(*) FROM docs WHERE body @@ 'alpha'"
+                    ).scalar())
+                except Exception as e:   # pragma: no cover - fails test
+                    errors.append(e)
+                    return
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        wc = db.connect()
+        for i in range(1, 41):
+            wc.execute(f"INSERT INTO docs VALUES ({i}, 'alpha doc {i}'), "
+                       f"({i + 1000}, 'filler {i}')")
+        stop.set()
+        rt.join(timeout=30)
+        assert not errors
+        # monotone: a reader can never see a count regress (no partial
+        # or torn segment publish)
+        assert counts == sorted(counts)
+        assert c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'"
+                         ).scalar() == 41
+        rows = wc.execute(
+            "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'alpha' "
+            "ORDER BY s DESC, id LIMIT 5").rows()
+        assert len(rows) == 5
+
+
+@pytest.mark.parametrize("group_commit", [True, False])
+def test_concurrent_inserts_publish_all_rows(group_commit):
+    """Coalesced publication (group-commit windows) must lose nothing and
+    publish in tick order — every row from every writer lands exactly
+    once, with the off pass as the serial-publish oracle."""
+    with _globals(serene_group_commit=group_commit):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE t (w INT, i INT)")
+        errs = []
+
+        def writer(w):
+            conn = db.connect()
+            try:
+                for i in range(25):
+                    conn.execute(f"INSERT INTO t VALUES ({w}, {i})")
+            except Exception as e:       # pragma: no cover - fails test
+                errs.append(e)
+
+        ths = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert not errs
+        assert c.execute("SELECT count(*) FROM t").scalar() == 100
+        rows = c.execute("SELECT w, count(*) FROM t GROUP BY w "
+                         "ORDER BY w").rows()
+        assert rows == [(w, 25) for w in range(4)]
+
+
+@pytest.mark.parametrize("group_commit", [True, False])
+def test_wal_recovery_across_group_commit_windows(tmp_path, group_commit):
+    """Every commit of every window must replay after a restart: the
+    shared-fsync frames are just frames to recovery, and a window's
+    boundary can fall anywhere in the writer interleaving."""
+    d = str(tmp_path / f"data-{group_commit}")
+    from serenedb_tpu.utils import metrics as _m
+    with _globals(serene_group_commit=group_commit):
+        db = Database(d)
+        c = db.connect()
+        c.execute("CREATE TABLE t (w INT, i INT)")
+        fsyncs0 = _m.REGISTRY.snapshot().get("WalFsyncs", 0)
+        errs = []
+
+        def writer(w):
+            conn = db.connect()
+            try:
+                for i in range(15):
+                    conn.execute(f"INSERT INTO t VALUES ({w}, {i})")
+            except Exception as e:       # pragma: no cover - fails test
+                errs.append(e)
+
+        ths = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert not errs
+        assert _m.REGISTRY.snapshot().get("WalFsyncs", 0) > fsyncs0
+        db.close()
+
+        db2 = Database(d)
+        c2 = db2.connect()
+        assert c2.execute("SELECT count(*) FROM t").scalar() == 60
+        rows = c2.execute("SELECT w, count(*) FROM t GROUP BY w "
+                          "ORDER BY w").rows()
+        assert rows == [(w, 15) for w in range(4)]
+        db2.close()
+
+
+def test_parquet_parallel_columns_match_serial(tmp_path):
+    """Concurrent column building must decode byte-identical columns to
+    the serial fallback (PR 1's workaround, revisited)."""
+    from serenedb_tpu.columnar.arrow_io import (read_parquet_snapshot,
+                                                write_parquet_snapshot)
+    from serenedb_tpu.columnar.column import Batch
+    rng = np.random.default_rng(11)
+    n = 4000
+    b = Batch.from_pydict({
+        "i": [int(x) if x % 7 else None for x in rng.integers(0, 1e6, n)],
+        "f": [float(x) for x in rng.random(n)],
+        "s": [None if x % 13 == 0 else f"doc-{x % 97}"
+              for x in rng.integers(0, 1e6, n)],
+        "b": [bool(x % 2) for x in rng.integers(0, 2, n)],
+    })
+    p = str(tmp_path / "snap.parquet")
+    write_parquet_snapshot(p, b)
+    with _globals(serene_parallel_ingest=True, serene_workers=4):
+        par = read_parquet_snapshot(p)
+    with _globals(serene_parallel_ingest=False):
+        ser = read_parquet_snapshot(p)
+    assert par.to_pydict() == ser.to_pydict() == b.to_pydict()
+
+
+PYARROW_DAEMON_SCRIPT = r"""
+import sys, threading
+sys.path.insert(0, {repo!r})
+from serenedb_tpu.columnar.arrow_io import (read_parquet_snapshot,
+                                            write_parquet_snapshot)
+from serenedb_tpu.columnar.column import Batch
+from serenedb_tpu.utils.config import REGISTRY
+path = {path!r}
+b = Batch.from_pydict({{"s": [f"w {{i % 31}}" for i in range(20000)],
+                       "i": list(range(20000))}})
+# the original crash recipe: a parquet WRITE on another daemon thread,
+# then column work afterwards on the main thread
+t = threading.Thread(target=write_parquet_snapshot, args=(path, b),
+                     daemon=True)
+t.start(); t.join()
+REGISTRY.set_global("serene_parallel_ingest", True)
+REGISTRY.set_global("serene_workers", 4)
+out = read_parquet_snapshot(path)
+assert out.to_pydict() == b.to_pydict()
+from serenedb_tpu.exec.tables import ParquetTable
+pt = ParquetTable(path)
+assert pt.full_batch().num_rows == 20000
+print("PARQUET-OK")
+"""
+
+
+def test_pyarrow_write_on_daemon_thread_then_parallel_read(tmp_path):
+    """The PR 1 segfault scenario, re-driven against the parallel column
+    builder: write on a daemon thread, then fan column conversions out
+    over OUR pool. pyarrow's internal pool stays dark (file reads remain
+    use_threads=False), so the process must exit 0 — a segfault here is
+    the regression. Subprocess-isolated so a crash fails one test, not
+    the run."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = PYARROW_DAEMON_SCRIPT.format(
+        repo=repo, path=str(tmp_path / "t.parquet"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, (p.returncode, p.stdout[-2000:],
+                               p.stderr[-2000:])
+    assert "PARQUET-OK" in p.stdout
+
+
+def _segments_of(db, table="docs", col="body"):
+    t = db.schemas["main"].tables[table]
+    idx = next(iter(t.indexes.values()))
+    return t, idx, idx.searchers[col].segments
+
+
+def test_background_merge_keeps_query_path_delta_only():
+    """With background maintenance on, the read-repair leg builds ONLY the
+    bounded delta tail (segments may exceed the cap between ticks); one
+    maintenance pass then compacts the tier below the cap without changing
+    a single result."""
+    from serenedb_tpu.storage.maintenance import MaintenanceManager
+    with _globals(serene_background_merge=True, serene_max_segments=3):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE docs (id INT, body TEXT)")
+        c.execute("INSERT INTO docs VALUES (0, 'alpha base')")
+        c.execute("CREATE INDEX ON docs USING inverted (body)")
+        for i in range(1, 7):
+            c.execute(f"INSERT INTO docs VALUES ({i}, 'alpha doc {i}')")
+            c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'")
+        _, _, segs = _segments_of(db)
+        assert len(segs) > 3          # queries paid no merge work
+        before = c.execute(
+            "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'alpha' "
+            "ORDER BY s DESC, id").rows()
+        mm = MaintenanceManager(db)
+        assert mm.run_once() is True   # needs_merge fires the ladder
+        _, idx, segs = _segments_of(db)
+        assert len(segs) < 3
+        after = c.execute(
+            "SELECT id, bm25(body) AS s FROM docs WHERE body @@ 'alpha' "
+            "ORDER BY s DESC, id").rows()
+        assert [r[0] for r in after] == [r[0] for r in before]
+        np.testing.assert_allclose([r[1] for r in after],
+                                   [r[1] for r in before],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_foreground_merge_when_background_off():
+    """serene_background_merge=off restores the old behavior: the query
+    path itself runs the ladder, so readers never see a tier at the cap."""
+    with _globals(serene_background_merge=False, serene_max_segments=3):
+        db = Database()
+        c = db.connect()
+        c.execute("CREATE TABLE docs (id INT, body TEXT)")
+        c.execute("INSERT INTO docs VALUES (0, 'alpha base')")
+        c.execute("CREATE INDEX ON docs USING inverted (body)")
+        for i in range(1, 9):
+            c.execute(f"INSERT INTO docs VALUES ({i}, 'alpha doc {i}')")
+            c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'")
+        _, _, segs = _segments_of(db)
+        assert len(segs) < 3
+        assert c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'"
+                         ).scalar() == 9
+
+
+def test_full_rebuild_reason_is_logged():
+    """The silent full-rebuild cliff is gone: when a mutation forces one,
+    the maintenance topic records WHICH trigger (epoch bump vs shrink)."""
+    from serenedb_tpu.utils import log
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT)")
+    c.execute("INSERT INTO docs VALUES (1, 'alpha'), (2, 'beta')")
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    n0 = len(log.MANAGER.records())
+    c.execute("DELETE FROM docs WHERE id = 1")
+    assert c.execute("SELECT count(*) FROM docs WHERE body @@ 'beta'"
+                     ).scalar() == 1
+    msgs = [r.message for r in log.MANAGER.records()[n0:]
+            if r.topic == "maintenance"]
+    assert any("full index rebuild" in m and "epoch advanced" in m
+               for m in msgs), msgs
+
+
+def test_ingest_metrics_and_stats_surface(tmp_path):
+    """Ingest{Docs,Bytes,Batches}, SegmentBuilds and the WalFsync
+    histogram move with the write path, and /_stats carries the ingest
+    section."""
+    from serenedb_tpu.obs.export import prometheus_text, stats_json
+    from serenedb_tpu.utils import metrics as _m
+    s0 = _m.REGISTRY.snapshot()
+    db = Database(str(tmp_path / "data"))
+    c = db.connect()
+    c.execute("CREATE TABLE docs (id INT, body TEXT)")
+    c.execute("INSERT INTO docs VALUES (1, 'alpha one'), (2, 'beta two')")
+    c.execute("CREATE INDEX ON docs USING inverted (body)")
+    c.execute("INSERT INTO docs VALUES (3, 'alpha three')")
+    c.execute("SELECT count(*) FROM docs WHERE body @@ 'alpha'")
+    s1 = _m.REGISTRY.snapshot()
+    assert s1.get("IngestDocs", 0) - s0.get("IngestDocs", 0) == 3
+    assert s1.get("IngestBatches", 0) - s0.get("IngestBatches", 0) == 2
+    assert s1.get("IngestBytes", 0) > s0.get("IngestBytes", 0)
+    assert s1.get("SegmentBuilds", 0) > s0.get("SegmentBuilds", 0)
+    assert s1.get("WalFsyncs", 0) > s0.get("WalFsyncs", 0)
+    ingest = stats_json()["ingest"]
+    for key in ("docs", "bytes", "batches", "segment_builds",
+                "segment_merges", "wal_commits", "wal_fsyncs"):
+        assert key in ingest
+    assert ingest["wal_fsync"]["count"] > 0
+    text = prometheus_text()
+    assert "serenedb_ingest_docs" in text
+    assert "serenedb_wal_fsync_seconds_bucket" in text
+    db.close()
+
+
+def test_ingest_settings_do_not_key_result_cache():
+    """The five ingest knobs are publish-mechanics only — flipping them
+    must not fragment the result cache key space (parity asserted by this
+    suite's matrix; cache/result.py carries the static assert)."""
+    from serenedb_tpu.cache.result import RESULT_AFFECTING_SETTINGS
+    for s in ("serene_parallel_ingest", "serene_ingest_chunk_docs",
+              "serene_group_commit", "serene_background_merge",
+              "serene_max_segments"):
+        assert s not in RESULT_AFFECTING_SETTINGS
