@@ -1804,6 +1804,122 @@ def bench_paged_search() -> float:
     return headline
 
 
+def bench_vector_search() -> float:
+    """Vector retrieval subsystem (ISSUE 19 tentpole): knn top-10 QPS
+    over a 100k x 256-d clustered corpus at 1/8/64 queries per
+    coalesced dispatch — IVF cluster-probe (`nprobe = 8` of 64 lists:
+    one jitted program gathers only the probed clusters' pages from the
+    HBM region and exact-rescores the candidates) vs the device
+    brute-force oracle (same program body, one all-rows list). The
+    corpus is grid-quantized (entries k/16 with every squared-distance
+    chain exact in f32 — see ops/vector.host_dist), so the probe path
+    at `nprobe = lists` is asserted BIT-identical to the oracle: the
+    probe tier is the exact path restricted to a candidate set, not an
+    approximation of it. Returns the 64-batch probe/brute QPS ratio
+    (work scales with probed clusters, so the probe path must win) and
+    records recall@10 at the production nprobe in the detail."""
+    import statistics as _stats
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from serenedb_tpu.ops import vector as vops
+    from serenedb_tpu.search.ivf import IvfIndex, VecSegment
+    from serenedb_tpu.search.vector_store import VPOOL
+    from serenedb_tpu.utils import metrics as _metrics
+    from serenedb_tpu.utils.config import REGISTRY as _settings
+
+    rng = np.random.default_rng(7)
+    n, dim, lists, nprobe, kk = 100_000, 256, 64, 8, 10
+    # clustered grid corpus: centers k/16 (|k|<48) + noise k/16 (|k|<16)
+    # keeps every coordinate a multiple of 2^-4 with |v| < 4 — products
+    # are multiples of 2^-8 bounded by 16, and 256-dim sums stay far
+    # under 2^24 such units, so device and host distance bits agree
+    # regardless of FMA grouping
+    centers = rng.integers(-48, 48, (lists, dim)).astype(np.float32)
+    noise = rng.integers(-16, 16, (n, dim)).astype(np.float32)
+    mat = (centers[rng.integers(0, lists, n)] + noise) / np.float32(16.0)
+    # build the index straight from the matrix (100k INSERTs would
+    # bench the ingest path, not the probe path)
+    init = vops.init_centroids(mat, lists)
+    cents = np.asarray(vops.kmeans_fit(
+        jnp.asarray(vops.pad_rows(mat)), jnp.asarray(init), lists, 4))
+    codes = np.asarray(vops.assign_clusters(
+        jnp.asarray(vops.pad_rows(mat)), jnp.asarray(cents)))[:n]
+    idx = IvfIndex(
+        column="v", dim=dim, lists=lists, metric="l2", centroids=cents,
+        segs=[VecSegment(mat, np.arange(n, dtype=np.int64), codes, lists)],
+        num_rows=n, data_version=1)
+    queries = (centers[rng.integers(0, lists, 64)]
+               + rng.integers(-16, 16, (64, dim))) / np.float32(16.0)
+
+    def run_level(batch: int, probe, reps: int):
+        outs = []
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            outs = []
+            for i in range(0, len(queries), batch):
+                qs = queries[i:i + batch]
+                if probe is None:
+                    outs.append(idx.brute_search(qs, kk))
+                else:
+                    outs.append(idx.search(qs, kk, probe))
+        dt = time.perf_counter() - t0
+        return reps * len(queries) / dt, outs
+
+    headline = None
+    detail: dict[str, dict] = {}
+    d0 = _metrics.VECTOR_SEARCH_DISPATCHES.value
+    # 100k x 256-d = 6250 pages: widen the page budget past the 64 MiB
+    # default so the probe path measures HBM-resident, not cold-upload
+    old_pages = _settings.get_global("serene_vector_pages")
+    _settings.set_global("serene_vector_pages", 8192)
+    # full-probe parity gate: nprobe=lists probes every cluster, so the
+    # probe program and the brute oracle must agree to the bit
+    dq, rq = idx.search(queries, kk, lists)
+    db, rb = idx.brute_search(queries, kk)
+    assert np.array_equal(dq.view(np.uint32), db.view(np.uint32)) and \
+        np.array_equal(rq, rb.astype(np.int64)), \
+        "nprobe=lists diverged from the device brute-force oracle"
+    brute_top = [set(rb[i][np.isfinite(db[i])].tolist())
+                 for i in range(len(queries))]
+    d8, r8 = idx.search(queries, kk, nprobe)
+    got = sum(len(set(r8[i][np.isfinite(d8[i])].tolist()) & brute_top[i])
+              for i in range(len(queries)))
+    recall = got / max(sum(len(s) for s in brute_top), 1)
+    assert recall >= 0.3, f"recall@10 collapsed: {recall:.2f}"
+    for batch in (1, 8, 64):
+        run_level(batch, nprobe, 1)    # warm compiles per batch size
+        run_level(batch, None, 1)
+        probe_s, brute_s = [], []
+        for _ in range(2):    # alternating pairs + medians
+            qps_p, _ = run_level(batch, nprobe, 1)
+            qps_b, _ = run_level(batch, None, 1)
+            probe_s.append(qps_p)
+            brute_s.append(qps_b)
+        qps_p = _stats.median(probe_s)
+        qps_b = _stats.median(brute_s)
+        detail[str(batch)] = {"qps_probe": round(qps_p, 1),
+                              "qps_brute": round(qps_b, 1),
+                              "ratio": round(qps_p / qps_b, 2)}
+        if batch == 64:
+            headline = qps_p / qps_b
+    assert _metrics.VECTOR_SEARCH_DISPATCHES.value > d0, \
+        "vector tier never dispatched — bench measured nothing"
+    _EXTRA["detail"] = detail
+    _EXTRA["rows"] = n
+    _EXTRA["recall_at_10"] = round(recall, 4)
+    _EXTRA["pool"] = VPOOL.stats()
+    assert _EXTRA["pool"]["pages_used"] > 0, \
+        "corpus never went HBM-resident — bench measured the cold path"
+    _settings.set_global("serene_vector_pages", old_pages)
+    VPOOL.clear()
+    assert headline > 1.0, \
+        f"cluster probe loses to brute force: {headline:.2f}x"
+    return headline
+
+
 def bench_shard_exec() -> float:
     """Sharded execution tier (ISSUE 9 tentpole): the 1M-row
     filter→join→agg chain through the engine at `serene_shards` 1/2/4 —
@@ -2283,6 +2399,7 @@ SHAPES = {
     "device_observe": bench_device_observe,
     "search_batch": bench_search_batch,
     "paged_search": bench_paged_search,
+    "vector_search": bench_vector_search,
     "shard_exec": bench_shard_exec,
     "multichip": bench_multichip,
 }
@@ -2303,14 +2420,14 @@ HOST_SHAPES = ("ingest", "host_agg", "filter_scan", "join",
                "profile_overhead", "trace_overhead", "mem_overhead",
                "concurrency", "result_cache", "device_pipeline",
                "fused_admission", "device_observe", "search_batch",
-               "paged_search", "shard_exec", "multichip")
+               "paged_search", "vector_search", "shard_exec", "multichip")
 
 #: host shapes that nevertheless run jitted programs — with the device
 #: probe down their children must pin JAX_PLATFORMS=cpu, because
 #: initializing the tunneled backend with the tunnel dead is a hard hang
 JIT_HOST_SHAPES = ("device_pipeline", "fused_admission", "device_observe",
-                   "search_batch", "paged_search", "shard_exec",
-                   "multichip")
+                   "search_batch", "paged_search", "vector_search",
+                   "shard_exec", "multichip")
 
 #: shapes that measure the in-program multi-chip combine: their child
 #: always runs on a 4-device VIRTUAL cpu mesh

@@ -233,6 +233,31 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_PARALLEL_INGEST=on \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 rc17=$?
 
+# Pass 18 is the vector-retrieval leg, two runs over the vector/search
+# serving suites: (a) the paged vector pool forced ON with the page
+# budget starved at 16 pages — practically every knn/MaxSim dispatch
+# then walks partial residency, cold-path fallback and LRU eviction,
+# proving the pool changes WHERE vectors are scored (HBM region vs
+# per-call upload), never a result bit; (b) serene_nprobe pinned at
+# 4096 — every probe search degenerates to a full-cluster scan, so the
+# suites' brute-force parity oracles must match bit-for-bit, proving
+# the cluster-probe path IS the exact path restricted to a candidate
+# set, not an approximation of it.
+echo "== vector retrieval pass (pool starved at 16 pages / full probe) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_VECTOR_POOL=on \
+    SERENE_VECTOR_PAGES=16 \
+    python -m pytest tests/test_vector_store.py tests/test_vector.py \
+    tests/test_search.py tests/test_es_api.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc18=$?
+if [ "$rc18" -eq 0 ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_NPROBE=4096 \
+        python -m pytest tests/test_vector_store.py tests/test_vector.py \
+        tests/test_es_api.py -q \
+        -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+    rc18=$?
+fi
+
 # Structural grep lint: every jit compilation in the engine must route
 # through the PR 15 compile ledger (obs/device.compiled) so the program
 # cache stays bounded and observable — a bare jax.jit( call site
@@ -264,6 +289,23 @@ if ! grep -q '"fused_chain"' serenedb_tpu/exec/device_pipeline.py || \
     echo "FAIL: chained fused top-N does not compile through obs.device.compiled"
     rc15=1
 fi
+# PR 19's vector subsystem: unlike the older ops/ kernels, ops/vector.py
+# post-dates the ledger — it gets NO bare-jit exemption, and both it and
+# the paged vector store must compile every program family through the
+# ledger so probe/rescore/MaxSim programs show up in the bounded cache.
+if grep -n "jax\.jit(" serenedb_tpu/ops/vector.py \
+        | grep -v "#.*jax\.jit("; then
+    echo "FAIL: bare jax.jit( in ops/vector.py — vector kernels must use the ledger"
+    rc15=1
+fi
+if ! grep -q 'obs_device\.compiled(' serenedb_tpu/ops/vector.py; then
+    echo "FAIL: ops/vector.py does not compile through obs.device.compiled"
+    rc15=1
+fi
+if ! grep -q 'obs_device\.compiled(' serenedb_tpu/search/vector_store.py; then
+    echo "FAIL: vector_store.py does not compile through obs.device.compiled"
+    rc15=1
+fi
 
 [ "$rc" -ne 0 ] && exit "$rc"
 [ "$rc2" -ne 0 ] && exit "$rc2"
@@ -281,4 +323,5 @@ fi
 [ "$rc14" -ne 0 ] && exit "$rc14"
 [ "$rc16" -ne 0 ] && exit "$rc16"
 [ "$rc17" -ne 0 ] && exit "$rc17"
+[ "$rc18" -ne 0 ] && exit "$rc18"
 exit "$rc15"

@@ -68,6 +68,11 @@ RESULT_AFFECTING_SETTINGS = (
     "serene_device", "serene_device_min_rows", "serene_device_chunk_rows",
     "serene_device_fused", "serene_mesh", "sdb_nprobe", "sdb_rerank_factor",
     "sdb_scored_terms_limit", "search_path",
+    # serene_nprobe (and its compat alias sdb_nprobe above) changes
+    # which rows a knn RETURNS — more probes, higher recall; and
+    # serene_maxsim switches vec_maxsim between f32 device scoring and
+    # the f64 host oracle, which can reorder near-tied docs
+    "serene_nprobe", "serene_maxsim",
 )
 assert "serene_search_batch" not in RESULT_AFFECTING_SETTINGS
 assert "serene_shards" not in RESULT_AFFECTING_SETTINGS
@@ -117,6 +122,17 @@ assert "serene_parallel_ingest" not in RESULT_AFFECTING_SETTINGS
 assert "serene_ingest_chunk_docs" not in RESULT_AFFECTING_SETTINGS
 assert "serene_group_commit" not in RESULT_AFFECTING_SETTINGS
 assert "serene_background_merge" not in RESULT_AFFECTING_SETTINGS
+# the vector pool only moves WHERE the probe program reads vectors from
+# (paged HBM region vs a per-call cold commit of the same cluster-major
+# layout); the distance chain is association-fixed in the graph, so
+# resident and cold dispatches are bit-identical at any page budget
+# (tests/test_vector_store.py pool on/off parity and the verify_tier1.sh
+# pass 18 starvation leg enforce it) — unlike serene_nprobe/serene_maxsim
+# above, which DO change results and ARE in the digest
+assert "serene_vector_pool" not in RESULT_AFFECTING_SETTINGS
+assert "serene_vector_pages" not in RESULT_AFFECTING_SETTINGS
+assert "serene_nprobe" in RESULT_AFFECTING_SETTINGS
+assert "serene_maxsim" in RESULT_AFFECTING_SETTINGS
 assert "serene_max_segments" not in RESULT_AFFECTING_SETTINGS
 
 #: remember the table set of at most this many distinct statements for

@@ -190,7 +190,9 @@ class DeviceColumnCache:
         if self._trade_on():
             try:
                 from ..search.posting_pool import POOL
-                return max(env // 4, env - POOL.live_bytes())
+                from ..search.vector_store import VPOOL
+                return max(env // 4,
+                           env - POOL.live_bytes() - VPOOL.live_bytes())
             except Exception:  # noqa: BLE001 — sizing only, never fatal
                 pass
         return env
@@ -239,14 +241,23 @@ class DeviceColumnCache:
                     break
         if over > 0 and self._trade_on() and tail_idle_s is not None:
             # pressure trade: before shedding our own tail, offer the
-            # eviction to the posting pool's tail if it is COLDER (idle
-            # longer) — freed pages raise this cache's cap directly
+            # eviction to the COLDEST pool tail (posting pages or vector
+            # pages) if it is idler than ours — freed pages raise this
+            # cache's cap directly
             try:
                 from ..search.posting_pool import POOL
-                pool_idle = POOL.tail_idle_ns()
-                if pool_idle is not None and \
-                        pool_idle > tail_idle_s * 1e9 and \
-                        POOL.shed_colder(int(tail_idle_s * 1e9), over):
+                from ..search.vector_store import VPOOL
+                pools = sorted(
+                    ((idle, p) for p in (POOL, VPOOL)
+                     for idle in (p.tail_idle_ns(),) if idle is not None),
+                    reverse=True)
+                shed = False
+                for pool_idle, p in pools:
+                    if pool_idle > tail_idle_s * 1e9 and \
+                            p.shed_colder(int(tail_idle_s * 1e9), over):
+                        shed = True
+                        break
+                if shed:
                     cap = self._cap_bytes()
             except Exception:  # noqa: BLE001 — sizing only, never fatal
                 pass

@@ -250,21 +250,98 @@ class IvfScanNode(PlanNode):
     def batches(self, ctx):
         from .plan import check_cancel
         check_cancel()
+        from ..search import vector_store
         from ..search.ivf import find_ivf_index
         idx = find_ivf_index(self.provider, self.vector_column)
         if idx is None:
             raise RuntimeError("ivf index disappeared under the plan")
-        nprobe = int(ctx.settings.get("sdb_nprobe"))
+        pin = self.provider.try_pin()
+        # stamp the publication identity onto the index so vector-pool
+        # pages written for its segments report which table/version they
+        # serve (sdb_vector_pool rows)
+        vector_store.note_publication(idx, self.provider, pin)
+        nprobe = vector_store.effective_nprobe(ctx.settings)
         rerank = int(ctx.settings.get("sdb_rerank_factor"))
-        dists, rows = idx.search(self.query_vec[None, :], self.topk, nprobe,
-                                 rerank_factor=rerank)
-        d, r = dists[0], rows[0]
-        keep = np.isfinite(d)
-        d, r = d[keep], r[keep]
+        mesh_n = int(ctx.settings.get("serene_mesh") or 0)
+        # knn dispatches coalesce through the same batcher as BM25 —
+        # the probe knobs ride in the scorer string, so queries with
+        # different (k, nprobe, rerank) never share a stacked dispatch
+        from ..search.batcher import batched_topk
+        (dists, rows), bstats = batched_topk(
+            idx, np.ascontiguousarray(self.query_vec, np.float32),
+            self.topk, f"knn:{nprobe}:{rerank}", mesh_n, ctx.settings)
+        prof = getattr(ctx, "profile", None)
+        if prof is not None and bstats is not None:
+            prof.add_search_batch(id(self), queries=bstats["queries"],
+                                  window_ns=bstats["window_ns"],
+                                  scoring_ns=bstats["scoring_ns"])
+        keep = np.isfinite(dists)
+        d, r = dists[keep], rows[keep]
         full = self.provider.full_batch(self.columns)
         out = full.take(r.astype(np.int64))
         yield Batch(list(self.names),
                     out.columns + [Column(dt.DOUBLE, d.astype(np.float64))])
+
+
+class MaxSimScanNode(PlanNode):
+    """Late-interaction top-k scan: rows in DESCENDING MaxSim-score
+    order + a `#msim` column. Docs without tokens (NULL / empty) never
+    match. `serene_maxsim = off` serves the exact float64 host oracle
+    instead of the device program (FLASH-MAXSIM's reference check)."""
+
+    SCORE_COL = "#msim"
+
+    def __init__(self, provider: TableProvider, columns: list[str],
+                 alias: str, vector_column: str, query_toks, topk: int):
+        self.provider = provider
+        self.columns = columns
+        self.alias = alias
+        self.vector_column = vector_column
+        self.query_toks = query_toks
+        self.topk = topk
+        self.names = list(columns) + [self.SCORE_COL]
+        self.types = [provider.type_of(c) for c in columns] + [dt.DOUBLE]
+
+    def children(self):
+        return []
+
+    def label(self):
+        return (f"MaxSimScan {self.provider.name}.{self.vector_column} "
+                f"k={self.topk}")
+
+    def batches(self, ctx):
+        from .plan import check_cancel
+        check_cancel()
+        from ..search import vector_store
+        from ..search.ivf import find_maxsim_index
+        idx = find_maxsim_index(self.provider, self.vector_column)
+        if idx is None:
+            raise RuntimeError("maxsim index disappeared under the plan")
+        pin = self.provider.try_pin()
+        vector_store.note_publication(idx, self.provider, pin)
+        q = np.ascontiguousarray(self.query_toks, np.float32)
+        if vector_store.maxsim_device(ctx.settings):
+            mesh_n = int(ctx.settings.get("serene_mesh") or 0)
+            from ..search.batcher import batched_topk
+            (keys, rows), bstats = batched_topk(
+                idx, q, self.topk, "maxsim", mesh_n, ctx.settings)
+            prof = getattr(ctx, "profile", None)
+            if prof is not None and bstats is not None:
+                prof.add_search_batch(id(self), queries=bstats["queries"],
+                                      window_ns=bstats["window_ns"],
+                                      scoring_ns=bstats["scoring_ns"])
+            keep = np.isfinite(keys)
+            scores = -keys[keep].astype(np.float64)
+            r = rows[keep]
+        else:
+            hs = idx.host_scores(q)
+            order = np.lexsort((idx.doc_rows, -hs))[:self.topk]
+            scores = hs[order]
+            r = idx.doc_rows[order]
+        full = self.provider.full_batch(self.columns)
+        out = full.take(r.astype(np.int64))
+        yield Batch(list(self.names),
+                    out.columns + [Column(dt.DOUBLE, scores)])
 
 
 class BtreeScanNode(PlanNode):

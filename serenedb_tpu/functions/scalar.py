@@ -1894,6 +1894,37 @@ _REGISTRY["vec_ip"] = _make_vec_fn("ip")
 _REGISTRY["vec_cos"] = _make_vec_fn("cos")
 
 
+@register("vec_maxsim")
+def _vec_maxsim(ts):
+    """ColBERT-style late interaction between two token matrices
+    ('[[...], ...]'): Σ_s max_t <q_s, d_t>, float64 (the exact host
+    oracle the device MaxSim program is checked against). A doc or
+    query without tokens scores NULL."""
+    def impl(cols, n):
+        from ..search.ivf import parse_multi_vector
+        a = string_values(cols[0])
+        b = string_values(cols[1])
+        valid = propagate_nulls(cols)
+        out = np.zeros(n, dtype=np.float64)
+        nulls = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue
+            x = parse_multi_vector(a[i])
+            y = parse_multi_vector(b[i])
+            if x is None or y is None:
+                nulls[i] = True
+                continue
+            if x.shape[1] != y.shape[1]:
+                raise errors.SqlError(
+                    errors.DATATYPE_MISMATCH,
+                    f"vector dims differ: {x.shape[1]} vs {y.shape[1]}")
+            sim = y.astype(np.float64) @ x.astype(np.float64).T
+            out[i] = float(sim.max(axis=1).sum())
+        return _result(dt.DOUBLE, out, cols, extra_invalid=nulls)
+    return FunctionResolution(dt.DOUBLE, impl)
+
+
 @register("vec_dims")
 def _vec_dims(ts):
     def impl(cols, n):

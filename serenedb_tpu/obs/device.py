@@ -546,12 +546,14 @@ def device_rows() -> list[dict]:
     from ..exec.device_pipeline import DEVICE_CACHE
     from ..parallel import mesh as mesh_mod
     from ..search.posting_pool import POOL
+    from ..search.vector_store import VPOOL
     cache_bytes = DEVICE_CACHE.device_bytes()
-    pool_bytes = POOL.device_bytes()
-    for i, n in pool_bytes.items():
-        # the posting pool's paged region is HBM-live alongside the
-        # column cache — one estimate covers both tenants
-        cache_bytes[i] = cache_bytes.get(i, 0) + n
+    for pool in (POOL, VPOOL):
+        # the posting pool's and vector pool's paged regions are
+        # HBM-live alongside the column cache — one estimate covers
+        # every tenant
+        for i, n in pool.device_bytes().items():
+            cache_bytes[i] = cache_bytes.get(i, 0) + n
     snap = LEDGER.snapshot()
     devs = {}
     if mesh_mod.device_count_if_initialized():
@@ -593,10 +595,12 @@ def stats_section() -> dict:
     rows, the compile ledger, and the program/column cache summaries."""
     from ..exec.device_pipeline import DEVICE_CACHE
     from ..search.posting_pool import POOL
+    from ..search.vector_store import VPOOL
     return {"devices": device_rows(),
             "programs": PROGRAMS.snapshot(),
             "program_cache": {"entries": PROGRAMS.entries(),
                               "cap": _cap()},
             "column_cache": DEVICE_CACHE.stats(),
             "posting_pool": POOL.stats(),
+            "vector_pool": VPOOL.stats(),
             "fused_declines": fused_declines()}

@@ -485,7 +485,7 @@ def _pg_index(db) -> MemTable:
 def _pg_am(db) -> MemTable:
     ams = [(2, "btree"), (403, "btree"), (405, "hash"), (783, "gist"),
            (2742, "gin"), (4000, "spgist"), (9001, "inverted"),
-           (9002, "ivf")]
+           (9002, "ivf"), (9003, "maxsim")]
     return _typed("pg_am", [
         ("oid", dt.OID), ("amname", dt.VARCHAR), ("amhandler", dt.OID),
         ("amtype", dt.VARCHAR)], {

@@ -131,7 +131,8 @@ def find_btree_index(provider, column: str):
 
 
 def build_index_for_table(provider, columns, using, options) -> SearchIndex:
-    if using not in ("inverted", "btree", "secondary", "ivf", "geo"):
+    if using not in ("inverted", "btree", "secondary", "ivf", "maxsim",
+                     "geo"):
         raise errors.unsupported(f"index type {using}")
     if using in ("btree", "secondary"):
         if len(columns) != 1:
@@ -148,6 +149,11 @@ def build_index_for_table(provider, columns, using, options) -> SearchIndex:
         if len(columns) != 1:
             raise errors.unsupported("ivf index over multiple columns")
         return build_ivf_index(provider, columns[0], options)
+    if using == "maxsim":
+        from .ivf import build_maxsim_index
+        if len(columns) != 1:
+            raise errors.unsupported("maxsim index over multiple columns")
+        return build_maxsim_index(provider, columns[0], options)
     searchers = {}
     n_rows = provider.row_count()
     col_toks = options.get("column_tokenizers", {}) or {}
@@ -227,6 +233,12 @@ def refresh_index(provider, idx, *,
       `merge=False` skips the ladder — the query-path read-repair leg
       under background maintenance, which pays only the bounded delta
       tail and leaves compaction to the maintenance ticker."""
+    if idx.using == "ivf":
+        # IVF has its own incremental leg: a pure append assigns only
+        # the tail rows to the existing centroids (one new cluster-major
+        # segment); everything else re-clusters with the reason logged
+        from .ivf import refresh_ivf_index
+        return refresh_ivf_index(provider, idx)
     if idx.using != "inverted":
         return build_index_for_table(provider, idx.columns, idx.using,
                                      idx.options)

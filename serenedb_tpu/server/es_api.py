@@ -512,8 +512,17 @@ class EsApi:
         sql = (f'SELECT "_id", "_source", {dist} AS _dist FROM '
                f'{_ident(index)} '
                f"ORDER BY _dist LIMIT {cand}")
-        knn_rows = [r for r in self._rconn().execute(sql).rows()
-                    if r[2] is not None]
+        nprobe = knn.get("nprobe")
+        conn = self._rconn()
+        if nprobe is not None:
+            conn.execute(f"SET serene_nprobe = {int(nprobe)}")
+        try:
+            knn_rows = [r for r in conn.execute(sql).rows()
+                        if r[2] is not None]
+        finally:
+            if nprobe is not None:
+                # 0 = back to the sdb_nprobe / built-in default chain
+                conn.execute("SET serene_nprobe = 0")
         knn_ranked = [(r[0], r[1]) for r in knn_rows]
         if body.get("query") is None:
             hits = []

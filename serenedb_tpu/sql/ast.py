@@ -269,7 +269,7 @@ class CreateIndex(Statement):
     name: Optional[str]
     table: list[str]
     columns: list[str]
-    using: str = "inverted"           # 'inverted' | 'btree' | 'ivf'
+    using: str = "inverted"    # 'inverted' | 'btree' | 'ivf' | 'maxsim' | ...
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
     column_tokenizers: dict = field(default_factory=dict)

@@ -98,6 +98,9 @@ def _match_topk(plan: PlanNode) -> Optional[PlanNode]:
     key_expr = proj.exprs[sort.key_indices[0]]
     if not sort.descs[0]:
         return _match_ann_topk(plan, limit, sort, proj, key_expr)
+    claimed = _match_maxsim_topk(plan, limit, sort, proj, key_expr)
+    if claimed is not None:
+        return claimed
     if not (isinstance(key_expr, BoundFunc) and
             key_expr.name in _SCORER_FUNCS and key_expr.args and
             isinstance(key_expr.args[0], BoundColumn)):
@@ -162,6 +165,57 @@ def _match_ann_topk(plan: PlanNode, limit, sort, proj,
                     isinstance(e.args[1], BoundLiteral) and \
                     e.args[1].value == lit.value:
                 return dist_ref
+            e.args = [rec(a) for a in e.args]
+        return e
+
+    for i in range(len(proj.exprs)):
+        proj.exprs[i] = rec(proj.exprs[i])
+    proj.child = node
+    return plan
+
+
+def _match_maxsim_topk(plan: PlanNode, limit, sort, proj,
+                       key_expr) -> Optional[PlanNode]:
+    """ORDER BY vec_maxsim(col, 'literal') DESC LIMIT k over a
+    maxsim-indexed column → MaxSimScanNode. The SortNode stays in the
+    plan — its stable re-sort over #msim preserves the device's
+    (score desc, doc asc) tie order for free."""
+    from ..exec.search_scan import MaxSimScanNode
+    from ..search.ivf import find_maxsim_index, parse_multi_vector
+    from .expr import BoundLiteral
+    if not (isinstance(key_expr, BoundFunc) and
+            key_expr.name == "vec_maxsim" and len(key_expr.args) == 2):
+        return None
+    col, lit = key_expr.args
+    if not (isinstance(col, BoundColumn) and
+            isinstance(lit, BoundLiteral) and isinstance(lit.value, str)):
+        return None
+    if not isinstance(proj.child, ScanNode):
+        return None
+    scan = proj.child
+    if scan.filter is not None:
+        return None  # predicate + late-interaction composition later
+    vec_col = scan.columns[col.index]
+    idx = find_maxsim_index(scan.provider, vec_col)
+    if idx is None:
+        return None
+    qtoks = parse_multi_vector(lit.value, idx.dim)
+    if qtoks is None:
+        return None  # empty query scores every doc 0 — not claimable
+    k = limit.limit + limit.offset
+    node = MaxSimScanNode(scan.provider, scan.columns, scan.alias,
+                          vec_col, qtoks, k)
+    score_ref = BoundColumn(len(node.columns), dt.DOUBLE,
+                            MaxSimScanNode.SCORE_COL)
+
+    def rec(e: BoundExpr) -> BoundExpr:
+        if isinstance(e, BoundFunc):
+            if e.name == "vec_maxsim" and len(e.args) == 2 and \
+                    isinstance(e.args[0], BoundColumn) and \
+                    e.args[0].index == col.index and \
+                    isinstance(e.args[1], BoundLiteral) and \
+                    e.args[1].value == lit.value:
+                return score_ref
             e.args = [rec(a) for a in e.args]
         return e
 

@@ -233,6 +233,39 @@ declare("serene_posting_pages", 4096, int,
         "terms evict past the budget; size from sdb_posting_pool() "
         "occupancy/hit rows",
         scope=Scope.GLOBAL, validator=lambda v: max(8, int(v)))
+declare("serene_vector_pool", True, bool,
+        "device-resident paged vector pool (search/vector_store.py): "
+        "IVF and MaxSim indexes upload their cluster-major vector "
+        "segments ONCE into a paged HBM region (16 KiB pages, LRU by "
+        "segment) and warm coalesced knn batches run as ONE jitted "
+        "centroid-probe → slotmap-gather → exact-rescore → top-k "
+        "program with zero host→device vector bytes. Off (or under "
+        "page starvation) every dispatch falls back to a per-call "
+        "committed cold region running the SAME program, so results "
+        "are bit-identical on or off and the setting stays out of the "
+        "result cache's settings digest",
+        scope=Scope.GLOBAL)
+declare("serene_vector_pages", 4096, int,
+        "page budget of the vector pool's device region (pages of "
+        "4096 f32 = 16 KiB, so the default 4096 is 64 MiB of HBM). "
+        "The region never exceeds the serene_device_cache_mb byte cap "
+        "— the pool is carved out of the device-cache budget, not "
+        "added to it. Whole segments evict LRU past the budget; size "
+        "from sdb_vector_pool() residency/hit rows",
+        scope=Scope.GLOBAL, validator=lambda v: max(4, int(v)))
+declare("serene_nprobe", 0, int,
+        "IVF clusters probed per vector query; 0 defers to the "
+        "compat alias sdb_nprobe. More probes = higher recall and "
+        "more work (nprobe = lists is exact brute force, the parity "
+        "oracle). RESULT-AFFECTING: changes which rows a knn returns, "
+        "so it is part of the result cache's settings digest",
+        validator=lambda v: max(0, int(v)))
+declare("serene_maxsim", True, bool,
+        "serve vec_maxsim() late-interaction scoring on the device "
+        "(dimension-tiled token-matrix MaxSim over the vector pool); "
+        "off = exact float64 host oracle. RESULT-AFFECTING: device "
+        "scores are f32, the host oracle is f64, so near-tied docs "
+        "can order differently — part of the settings digest")
 declare("serene_device_telemetry", True, bool,
         "device telemetry (obs/device.py): the XLA compile ledger "
         "(per-program-family compile counts/wall time, program-cache "

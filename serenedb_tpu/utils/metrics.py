@@ -364,6 +364,36 @@ POSTING_POOL_PARTIAL = REGISTRY.gauge(
     "batched ragged queries whose resident prefix scored on device "
     "with the host merging the non-resident tail slices (deterministic "
     "same-order f32 adds — bit-identical to the all-host path)")
+VECTOR_SEARCH_QUERIES = REGISTRY.gauge(
+    "VectorSearchQueries",
+    "knn / MaxSim queries scored by the vector subsystem "
+    "(search/vector_store.py) — each member of a coalesced batch "
+    "counts once")
+VECTOR_SEARCH_DISPATCHES = REGISTRY.gauge(
+    "VectorSearchDispatches",
+    "jitted vector programs dispatched (probe, brute-oracle and MaxSim "
+    "batches each count one; a warm coalesced batch is exactly one)")
+VECTOR_PROBED_CLUSTERS = REGISTRY.gauge(
+    "VectorProbedClusters",
+    "IVF cluster lists probed across all vector queries (queries x "
+    "effective nprobe) — the work that scales with nprobe, not N")
+VECTOR_BYTES_RESIDENT = REGISTRY.gauge(
+    "VectorBytesResident",
+    "bytes of the device vector region currently occupied by resident "
+    "segments (live pages x page size; budget is serene_vector_pages)")
+VECTOR_POOL_HITS = REGISTRY.gauge(
+    "VectorPoolHits",
+    "vector-pool segment lookups served by pages already resident in "
+    "the device region — a hit means the batch re-scored vectors "
+    "without re-uploading them")
+VECTOR_POOL_MISSES = REGISTRY.gauge(
+    "VectorPoolMisses",
+    "vector-pool segment lookups that allocated and wrote fresh pages "
+    "(first touch of a segment, or re-entry after eviction)")
+VECTOR_POOL_EVICTIONS = REGISTRY.gauge(
+    "VectorPoolEvictions",
+    "resident vector segments evicted LRU from the vector pool to make "
+    "room under the serene_vector_pages budget")
 SHARD_PIPELINES = REGISTRY.gauge(
     "ShardPipelines",
     "per-shard pipeline executions launched by the sharded execution "

@@ -235,6 +235,29 @@ for _env, _setting in _INGEST_ENV_HOOKS.items():
         _SDB_REG_ING.set_global(_setting, os.environ[_env])
 
 
+# scripts/verify_tier1.sh vector-retrieval leg: force the paged vector
+# pool to the given value ("on"/"off") and/or starve its page budget at
+# a tiny SERENE_VECTOR_PAGES (e.g. "16") for a whole run — the starved
+# pass forces cold-path fallback and LRU eviction on practically every
+# knn/MaxSim dispatch, proving the pool changes WHERE vectors are
+# scored (resident HBM region vs per-call upload), never a result bit.
+# SERENE_NPROBE pins the probe width suite-wide (e.g. "4096" = every
+# probe search degenerates to a full-cluster scan, so the brute-force
+# parity oracles must match bit-for-bit); SERENE_MAXSIM flips the
+# MaxSim scorer between the device program and the f64 host oracle.
+_VECTOR_ENV_HOOKS = {
+    "SERENE_VECTOR_POOL": "serene_vector_pool",
+    "SERENE_VECTOR_PAGES": "serene_vector_pages",
+    "SERENE_NPROBE": "serene_nprobe",
+    "SERENE_MAXSIM": "serene_maxsim",
+}
+for _env, _setting in _VECTOR_ENV_HOOKS.items():
+    if os.environ.get(_env):
+        from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_VEC
+
+        _SDB_REG_VEC.set_global(_setting, os.environ[_env])
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running throughput tests, excluded from "

@@ -74,7 +74,10 @@ def test_ivf_recall_with_small_nprobe():
     assert hits >= 15  # nprobe=4/16 recall@1 well above chance
 
 
-def test_ivf_index_stale_falls_back():
+def test_ivf_index_append_keeps_serving():
+    # pure appends no longer orphan the index: read-repair assigns the
+    # tail rows to the existing centroids incrementally and the scan
+    # keeps serving — including the appended row
     db = Database()
     c = db.connect()
     make_vec_table(c, n=50, d=4)
@@ -82,10 +85,27 @@ def test_ivf_index_stale_falls_back():
     c.execute("INSERT INTO vt VALUES (999, '[0,0,0,0]')")
     ex = c.execute("EXPLAIN SELECT id FROM vt ORDER BY v <-> '[0,0,0,0]' "
                    "LIMIT 1").rows()
-    assert not any("IvfScan" in r[0] for r in ex)  # stale → CPU oracle
+    assert any("IvfScan" in r[0] for r in ex)
     got = c.execute("SELECT id FROM vt ORDER BY v <-> '[0,0,0,0]' "
                     "LIMIT 1").rows()
     assert got[0][0] == 999
+
+
+def test_ivf_index_destructive_mutation_falls_back():
+    # UPDATE/DELETE advance the mutation epoch: the stale index is
+    # orphaned (rebuild reason logged, maintenance rebuilds later) and
+    # the query answers from the CPU oracle meanwhile
+    db = Database()
+    c = db.connect()
+    make_vec_table(c, n=50, d=4)
+    c.execute("CREATE INDEX ON vt USING ivf (v)")
+    c.execute("DELETE FROM vt WHERE id = 7")
+    ex = c.execute("EXPLAIN SELECT id FROM vt ORDER BY v <-> '[0,0,0,0]' "
+                   "LIMIT 60").rows()
+    assert not any("IvfScan" in r[0] for r in ex)
+    got = c.execute("SELECT id FROM vt ORDER BY v <-> '[0,0,0,0]' "
+                    "LIMIT 60").rows()
+    assert 7 not in [r[0] for r in got] and len(got) == 49
 
 
 def test_null_vectors_skipped():
@@ -170,6 +190,27 @@ def test_es_knn_uses_ivf_pushdown(es_srv):
         "knn": {"field": "vec", "query_vector": [0, 0], "k": 6},
         "from": 2, "size": 2})
     assert [h["_id"] for h in body["hits"]["hits"]] == ["2", "3"]
+
+
+def test_es_knn_nprobe_knob(es_srv):
+    # the knn DSL's optional "nprobe" pins serene_nprobe for the one
+    # statement (restored after), overriding the session default
+    from tests.test_es_api import req
+    req(es_srv, "PUT", "/np", {"mappings": {"properties": {
+        "vec": {"type": "dense_vector", "dims": 2}}}})
+    for i in range(12):
+        req(es_srv, "PUT", f"/np/_doc/{i}", {"vec": [i, 0]})
+    req(es_srv, "POST", "/np/_refresh")
+    status, body = req(es_srv, "POST", "/np/_search", {
+        "knn": {"field": "vec", "query_vector": [0, 0], "k": 4,
+                "nprobe": 64}})
+    assert status == 200
+    assert [h["_id"] for h in body["hits"]["hits"]] == \
+        ["0", "1", "2", "3"]
+    # and a plain knn afterwards still behaves (the SET was restored)
+    status, body = req(es_srv, "POST", "/np/_search", {
+        "knn": {"field": "vec", "query_vector": [11, 0], "k": 1}})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["11"]
 
 
 def test_sq8_quantized_index_recall_and_rerank():

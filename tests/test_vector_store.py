@@ -1,0 +1,400 @@
+"""Vector retrieval parity suite (ISSUE 19 tentpole).
+
+Contract under test: the IVF cluster-probe at `nprobe = lists` is
+BIT-identical to the brute-force oracle — device and host — because the
+probe tier is the exact path restricted to a candidate set, not an
+approximation of it. The parity corpora are grid-quantized (entries
+k/2^g with every product and partial sum exactly representable in f32),
+which makes the distance bits independent of the backend's FMA grouping
+(see ops/vector.host_dist); on such data every path — probe, brute,
+pool-resident, pool-cold, starved — must agree to the bit, and the
+MaxSim device scorer must agree with the f64 host oracle exactly.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serenedb_tpu.engine import Database
+from serenedb_tpu.obs.device import LEDGER
+from serenedb_tpu.ops import vector as vops
+from serenedb_tpu.search.ivf import IvfIndex, MaxSimIndex, VecSegment
+from serenedb_tpu.search.vector_store import VPOOL
+from serenedb_tpu.utils import metrics
+from serenedb_tpu.utils.config import REGISTRY
+
+
+def grid(rng, shape, lo=-64, hi=64, denom=16.0):
+    """Grid-quantized f32 array: entries k/denom — exact chain
+    arithmetic in f32 for the sizes used here."""
+    return rng.integers(lo, hi, shape).astype(np.float32) / \
+        np.float32(denom)
+
+
+def build_idx(mat, lists, metric="l2", centroids=None):
+    n, d = mat.shape
+    if centroids is None:
+        init = vops.init_centroids(mat, lists)
+        centroids = np.asarray(vops.kmeans_fit(
+            jnp.asarray(vops.pad_rows(mat)), jnp.asarray(init), lists,
+            4))
+    centroids = np.ascontiguousarray(centroids, np.float32)
+    codes = np.asarray(vops.assign_clusters(
+        jnp.asarray(vops.pad_rows(mat)), jnp.asarray(centroids)))[:n]
+    return IvfIndex(
+        column="v", dim=d, lists=lists, metric=metric,
+        centroids=centroids,
+        segs=[VecSegment(mat, np.arange(n, dtype=np.int64), codes,
+                         lists)],
+        num_rows=n, data_version=1)
+
+
+def host_topk(idx, queries, k, member=None):
+    """Numpy oracle: host_dist bits + (dist asc, row asc) tie order.
+    `member` optionally restricts to a logical-position mask (the
+    probed-clusters candidate set)."""
+    lay = idx.layout()
+    mat = idx.host_logical()[:lay["ntot"]]
+    rowids = lay["rowids"].astype(np.int64)
+    if member is not None:
+        mat, rowids = mat[member], rowids[member]
+    ds, rs = [], []
+    for q in np.asarray(queries, np.float32):
+        dd = vops.host_dist(mat, q, idx.metric)
+        order = np.lexsort((rowids, dd))[:k]
+        ds.append(dd[order].astype(np.float32))
+        rs.append(rowids[order])
+    return np.stack(ds), np.stack(rs)
+
+
+def bits_equal(a, b):
+    return np.array_equal(np.asarray(a, np.float32).view(np.uint32),
+                          np.asarray(b, np.float32).view(np.uint32))
+
+
+# -- device vs host parity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["l2", "ip", "cos"])
+def test_full_probe_bitexact_vs_host_oracle(metric, rng):
+    mat = grid(rng, (300, 16))
+    # duplicated vectors: identical distances must surface in row-asc
+    # order (the exact tie contract)
+    mat[37] = mat[11]
+    mat[205] = mat[11]
+    idx = build_idx(mat, lists=8, metric=metric)
+    qs = grid(rng, (9, 16))
+    qs[3] = mat[11]
+    d, r = idx.search(qs, 10, idx.lists)
+    hd, hr = host_topk(idx, qs, 10)
+    assert bits_equal(d, hd)
+    assert np.array_equal(r, hr)
+    tied = [row for row in r[3] if row in (11, 37, 205)]
+    assert tied == [11, 37, 205]
+
+
+def test_device_brute_oracle_bitexact(rng):
+    mat = grid(rng, (257, 16))
+    idx = build_idx(mat, lists=8)
+    qs = grid(rng, (5, 16))
+    db, rb = idx.brute_search(qs, 10)
+    hd, hr = host_topk(idx, qs, 10)
+    assert bits_equal(db, hd)
+    assert np.array_equal(rb.astype(np.int64), hr)
+    # and the probe program at nprobe=lists returns the same bits
+    dp, rp = idx.search(qs, 10, idx.lists)
+    assert bits_equal(dp, db) and np.array_equal(rp, rb.astype(np.int64))
+
+
+def test_partial_probe_matches_restricted_oracle(rng):
+    # grid CENTROIDS (sampled corpus rows) make the cluster selection
+    # itself replicable on the host: top-nprobe centroid distances are
+    # exact, ties break toward the lower cluster index — so the full
+    # result must equal the oracle restricted to the probed clusters
+    mat = grid(rng, (400, 16))
+    lists, nprobe, k = 16, 4, 12
+    cents = mat[rng.choice(400, lists, replace=False)].copy()
+    idx = build_idx(mat, lists=lists, centroids=cents)
+    lay = idx.layout()
+    qs = grid(rng, (6, 16))
+    d, r = idx.search(qs, k, nprobe)
+    pos_cluster = np.repeat(np.arange(lists),
+                            lay["counts"].astype(np.int64))
+    for qi in range(len(qs)):
+        cd = vops.host_dist(cents, qs[qi], idx.metric)
+        probed = np.lexsort((np.arange(lists), cd))[:nprobe]
+        member = np.isin(pos_cluster, probed)
+        hd, hr = host_topk(idx, qs[qi:qi + 1], k, member=member)
+        live = np.isfinite(hd[0])
+        assert bits_equal(d[qi][live], hd[0][live])
+        assert np.array_equal(r[qi][live], hr[0][live])
+
+
+def test_multi_segment_layout_parity(rng):
+    # two published segments (the incremental-append shape): the
+    # cluster-major logical layout must stitch them without changing a
+    # bit vs the single-segment oracle
+    base = grid(rng, (200, 8))
+    tail = grid(rng, (60, 8))
+    lists = 8
+    cents = base[rng.choice(200, lists, replace=False)].copy()
+    idx = build_idx(base, lists=lists, centroids=cents)
+    codes_t = np.asarray(vops.assign_clusters(
+        jnp.asarray(vops.pad_rows(tail)), jnp.asarray(cents)))[:60]
+    idx2 = IvfIndex(
+        column="v", dim=8, lists=lists, metric="l2", centroids=cents,
+        segs=idx.segs + [VecSegment(
+            tail, np.arange(200, 260, dtype=np.int64), codes_t, lists)],
+        num_rows=260, data_version=2)
+    qs = grid(rng, (4, 8))
+    d, r = idx2.search(qs, 10, lists)
+    hd, hr = host_topk(idx2, qs, 10)
+    assert bits_equal(d, hd) and np.array_equal(r, hr)
+
+
+# -- pool residency -----------------------------------------------------------
+
+
+def _with_pool(value, pages=None):
+    olds = (REGISTRY.get_global("serene_vector_pool"),
+            REGISTRY.get_global("serene_vector_pages"))
+    REGISTRY.set_global("serene_vector_pool", value)
+    if pages is not None:
+        REGISTRY.set_global("serene_vector_pages", pages)
+    return olds
+
+
+def _restore_pool(olds):
+    REGISTRY.set_global("serene_vector_pool", olds[0])
+    REGISTRY.set_global("serene_vector_pages", olds[1])
+    VPOOL.clear()
+
+
+def test_pool_on_off_and_starved_bit_parity(rng):
+    # 500 x 64-d rows need 8 pages (64 rows/page) — over the 4-page
+    # starvation budget below, so that leg exercises the cold path
+    mat = grid(rng, (500, 64))
+    idx = build_idx(mat, lists=8)
+    qs = grid(rng, (7, 64))
+    olds = _with_pool(True)
+    try:
+        VPOOL.clear()
+        d_on, r_on = idx.search(qs, 10, 4)
+        assert VPOOL.stats()["pages_used"] > 0
+        REGISTRY.set_global("serene_vector_pool", False)
+        VPOOL.clear()
+        d_off, r_off = idx.search(qs, 10, 4)
+        # starved: a 4-page budget can't hold the segment → cold path
+        REGISTRY.set_global("serene_vector_pool", True)
+        REGISTRY.set_global("serene_vector_pages", 4)
+        VPOOL.clear()
+        d_st, r_st = idx.search(qs, 10, 4)
+        assert VPOOL.stats()["pages_used"] == 0
+        assert bits_equal(d_on, d_off) and np.array_equal(r_on, r_off)
+        assert bits_equal(d_on, d_st) and np.array_equal(r_on, r_st)
+    finally:
+        _restore_pool(olds)
+
+
+def test_warm_batch_one_dispatch_zero_vector_upload(rng):
+    # the acceptance gate: a warm coalesced knn batch is ONE device
+    # dispatch and uploads no vector bytes — only the (tiny) padded
+    # query block crosses the bus
+    mat = grid(rng, (512, 16))
+    idx = build_idx(mat, lists=8)
+    qs = grid(rng, (4, 16))
+    olds = _with_pool(True)
+    try:
+        VPOOL.clear()
+        idx.search(qs, 10, 4)    # residency + compile + map memos
+        idx.search(qs, 10, 4)
+        before = LEDGER.snapshot()
+        d, r = idx.search(qs, 10, 4)
+        after = LEDGER.snapshot()
+        disp = sum(s["dispatches"] for s in after.values()) - \
+            sum(s["dispatches"] for s in before.values())
+        up = sum(s["bytes_up"] for s in after.values()) - \
+            sum(s["bytes_up"] for s in before.values())
+        assert disp == 1
+        q_block = 4 * 16 * 4    # qp x dp x f32 — far below one page
+        assert up <= q_block, \
+            f"warm knn uploaded {up} bytes (query block is {q_block})"
+        # still a correct answer, not just a cheap dispatch: every
+        # returned candidate is exactly rescored (host-bit distances)
+        for qi in range(len(qs)):
+            live = np.isfinite(d[qi])
+            hd = vops.host_dist(mat[r[qi][live]], qs[qi], "l2")
+            assert bits_equal(d[qi][live], hd)
+    finally:
+        _restore_pool(olds)
+
+
+def test_vector_metrics_and_stats_surface(rng):
+    mat = grid(rng, (128, 16))
+    idx = build_idx(mat, lists=4)
+    olds = _with_pool(True)
+    try:
+        VPOOL.clear()
+        q0 = metrics.VECTOR_SEARCH_QUERIES.value
+        d0 = metrics.VECTOR_SEARCH_DISPATCHES.value
+        idx.search(grid(rng, (3, 16)), 5, 2)
+        assert metrics.VECTOR_SEARCH_QUERIES.value == q0 + 3
+        assert metrics.VECTOR_SEARCH_DISPATCHES.value == d0 + 1
+        assert metrics.VECTOR_BYTES_RESIDENT.value > 0
+        from serenedb_tpu.obs import device as obs_device
+        sec = obs_device.stats_section()
+        assert "vector_pool" in sec and \
+            sec["vector_pool"]["pages_used"] > 0
+    finally:
+        _restore_pool(olds)
+
+
+# -- MaxSim -------------------------------------------------------------------
+
+
+def build_maxsim(rng, ndocs=40, dim=8):
+    toks, codes, tok_rows = [], [], []
+    for di in range(ndocs):
+        t = rng.integers(1, 5)
+        toks.append(grid(rng, (t, dim), lo=-16, hi=16, denom=4.0))
+        codes.append(np.full(t, di, np.int32))
+        tok_rows.append(np.full(t, di, np.int32))
+    vals = np.concatenate(toks, axis=0)
+    seg = VecSegment(vals, np.concatenate(tok_rows),
+                     np.concatenate(codes), ndocs)
+    return MaxSimIndex(
+        column="v", dim=dim, segs=[seg],
+        doc_rows=np.arange(ndocs, dtype=np.int32), num_rows=ndocs,
+        data_version=1)
+
+
+def test_maxsim_device_matches_f64_host_oracle(rng):
+    idx = build_maxsim(rng)
+    q = grid(rng, (3, 8), lo=-16, hi=16, denom=4.0)
+    scores, rows = idx.search(q, 10)
+    hs = idx.host_scores(q)
+    order = np.lexsort((idx.doc_rows, -hs))[:10]
+    live = np.isfinite(scores)
+    assert np.array_equal(rows[live],
+                          idx.doc_rows[order][:live.sum()])
+    # grid tokens: the f32 device score IS the f64 oracle value
+    assert np.array_equal(scores[live].astype(np.float64), hs[order])
+
+
+def test_maxsim_batch_matches_single(rng):
+    idx = build_maxsim(rng, ndocs=25)
+    qs = [grid(rng, (s, 8), lo=-16, hi=16, denom=4.0)
+          for s in (2, 4, 3)]
+    outs = idx.topk_batch(qs, 6, "maxsim")
+    for q, (keys, rows) in zip(qs, outs):
+        s1, r1 = idx.search(np.asarray(q), 6)
+        live = np.isfinite(keys)
+        assert bits_equal(-keys[live], s1[:live.sum()])
+        assert np.array_equal(rows[live], r1[:live.sum()])
+
+
+# -- engine-level matrix ------------------------------------------------------
+
+
+def _grid_sql_table(c, rng, n=240, d=8, lists=8):
+    vecs = grid(rng, (n, d))
+    c.execute("CREATE TABLE gv (id INT, v TEXT)")
+    rows = ", ".join(
+        f"({i}, '{json.dumps([float(x) for x in vecs[i]])}')"
+        for i in range(n))
+    c.execute(f"INSERT INTO gv VALUES {rows}")
+    c.execute(f"CREATE INDEX ON gv USING ivf (v) WITH (lists = {lists})")
+    return vecs
+
+
+@pytest.mark.parametrize("workers", [1, 4])
+@pytest.mark.parametrize("shards", [1, 4])
+@pytest.mark.parametrize("batcher", ["on", "off"])
+def test_knn_sql_matrix_bit_identical(workers, shards, batcher, rng):
+    # grid corpus through SQL: every worker/shard/batcher combination
+    # must return the same rows and the same distance bits as the
+    # full-scan oracle (nprobe = lists → exact)
+    db = Database()
+    c = db.connect()
+    vecs = _grid_sql_table(c, rng)
+    qs = json.dumps([float(x) for x in vecs[17]])
+    c.execute(f"SET serene_workers = {workers}")
+    c.execute(f"SET serene_shards = {shards}")
+    c.execute(f"SET serene_search_batch = {batcher}")
+    c.execute("SET serene_nprobe = 8")
+    ex = c.execute(
+        f"EXPLAIN SELECT id FROM gv ORDER BY v <-> '{qs}' LIMIT 7"
+    ).rows()
+    assert any("IvfScan" in r[0] for r in ex)
+    got = c.execute(
+        f"SELECT id, v <-> '{qs}' AS d FROM gv ORDER BY d LIMIT 7"
+    ).rows()
+    ref = c.execute(
+        f"SELECT id, d FROM (SELECT id, v <-> '{qs}' AS d FROM gv) s "
+        "ORDER BY d, id LIMIT 7").rows()
+    assert got == ref
+    assert got[0][0] == 17
+
+
+def test_serene_nprobe_is_result_affecting():
+    from serenedb_tpu.cache.result import RESULT_AFFECTING_SETTINGS
+    assert "serene_nprobe" in RESULT_AFFECTING_SETTINGS
+    assert "serene_maxsim" in RESULT_AFFECTING_SETTINGS
+    assert "serene_vector_pool" not in RESULT_AFFECTING_SETTINGS
+    assert "serene_vector_pages" not in RESULT_AFFECTING_SETTINGS
+
+
+def test_maxsim_sql_device_vs_host_oracle(rng):
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE ms (id INT, v TEXT)")
+    rows = []
+    for i in range(30):
+        toks = grid(rng, (int(rng.integers(1, 4)), 4),
+                    lo=-16, hi=16, denom=4.0)
+        rows.append(f"({i}, '{json.dumps([[float(x) for x in t] for t in toks])}')")
+    c.execute(f"INSERT INTO ms VALUES {', '.join(rows)}")
+    c.execute("CREATE INDEX ON ms USING maxsim (v)")
+    q = grid(np.random.default_rng(3), (2, 4), lo=-16, hi=16, denom=4.0)
+    qs = json.dumps([[float(x) for x in t] for t in q])
+    ex = c.execute(
+        f"EXPLAIN SELECT id FROM ms ORDER BY vec_maxsim(v, '{qs}') DESC "
+        "LIMIT 5").rows()
+    assert any("MaxSimScan" in r[0] for r in ex)
+    dev = c.execute(
+        f"SELECT id, vec_maxsim(v, '{qs}') AS s FROM ms "
+        "ORDER BY s DESC LIMIT 5").rows()
+    # the scalar-function oracle (subquery defeats the pushdown)
+    ref = c.execute(
+        f"SELECT id, s FROM (SELECT id, vec_maxsim(v, '{qs}') AS s "
+        "FROM ms) t ORDER BY s DESC, id LIMIT 5").rows()
+    assert dev == ref
+    # host-oracle serving path (serene_maxsim = off): same rows
+    c.execute("SET serene_maxsim = off")
+    host = c.execute(
+        f"SELECT id, vec_maxsim(v, '{qs}') AS s FROM ms "
+        "ORDER BY s DESC LIMIT 5").rows()
+    assert host == dev
+
+
+def test_maxsim_index_append_invalidation(rng):
+    db = Database()
+    c = db.connect()
+    c.execute("CREATE TABLE mi (id INT, v TEXT)")
+    c.execute("INSERT INTO mi VALUES "
+              "(1, '[[1,0],[0,1]]'), (2, '[[0.5,0.5]]')")
+    c.execute("CREATE INDEX ON mi USING maxsim (v)")
+    ex = c.execute("EXPLAIN SELECT id FROM mi ORDER BY "
+                   "vec_maxsim(v, '[[1,0]]') DESC LIMIT 2").rows()
+    assert any("MaxSimScan" in r[0] for r in ex)
+    # any write invalidates the maxsim index (exact data_version match
+    # only) — the query answers from the scalar function path
+    c.execute("INSERT INTO mi VALUES (3, '[[1,1]]')")
+    ex = c.execute("EXPLAIN SELECT id FROM mi ORDER BY "
+                   "vec_maxsim(v, '[[1,0]]') DESC LIMIT 3").rows()
+    assert not any("MaxSimScan" in r[0] for r in ex)
+    got = c.execute("SELECT id FROM mi ORDER BY "
+                    "vec_maxsim(v, '[[1,0]]') DESC LIMIT 3").rows()
+    assert len(got) == 3
