@@ -258,6 +258,26 @@ if [ "$rc18" -eq 0 ]; then
     rc18=$?
 fi
 
+echo "== front-door serving pass (socket admission forced at 8 connections) =="
+# PR 20's asyncio front door: the pgwire/HTTP/ES suites plus the new
+# transport suite all run with serene_max_connections=8 FORCED, so every
+# keep-alive leak or unreleased gate slot in any suite turns into a hard
+# 429/53300 failure within eight connections instead of surviving unseen
+timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_MAX_CONNECTIONS=8 \
+    python -m pytest tests/test_frontdoor.py tests/test_pgwire.py \
+    tests/test_es_api.py tests/test_admission.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc19=$?
+if [ "$rc19" -eq 0 ]; then
+    # parity leg: the same serving suites with the front door OFF (the
+    # legacy thread-per-connection oracle kept for one release) — the
+    # route tables are shared, so divergence here is a transport bug
+    timeout -k 10 600 env JAX_PLATFORMS=cpu SERENE_FRONTDOOR=off \
+        python -m pytest tests/test_pgwire.py tests/test_es_api.py -q \
+        -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+    rc19=$?
+fi
+
 # Structural grep lint: every jit compilation in the engine must route
 # through the PR 15 compile ledger (obs/device.compiled) so the program
 # cache stays bounded and observable — a bare jax.jit( call site
@@ -324,4 +344,5 @@ fi
 [ "$rc16" -ne 0 ] && exit "$rc16"
 [ "$rc17" -ne 0 ] && exit "$rc17"
 [ "$rc18" -ne 0 ] && exit "$rc18"
+[ "$rc19" -ne 0 ] && exit "$rc19"
 exit "$rc15"

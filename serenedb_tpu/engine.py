@@ -607,6 +607,9 @@ class Database(TableResolver):
         if name == "sdb_admission":
             from .pgcatalog import admission_table
             return admission_table()
+        if name == "sdb_connections":
+            from .pgcatalog import connections_table
+            return connections_table()
         if name == "sdb_device":
             from .pgcatalog import device_table
             return device_table()

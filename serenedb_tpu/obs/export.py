@@ -101,10 +101,16 @@ def stats_json() -> dict:
     from .trace import FLIGHT, flight_summary
     sample_process_gauges()
     snap = _metrics.REGISTRY.snapshot()
+    from ..sched.governor import CONNGATE
     return {"metrics": snap,
             # workload governor: live running/queued counts + limits +
             # cumulative admission totals (sched/governor.py)
             "admission": GOVERNOR.snapshot(),
+            # socket layer: open/idle/active connection counts against
+            # serene_max_connections, accept-gate rejections,
+            # pause-reading events and buffered write bytes
+            # (sched/governor.py ConnectionGate; server/frontdoor.py)
+            "connections": CONNGATE.snapshot(),
             # device telemetry: per-device dispatch/transfer/HBM rows,
             # the compile ledger, cache summaries (obs/device.py)
             "device": _device.stats_section(),

@@ -405,6 +405,10 @@ def sample_process_gauges() -> None:
         metrics.PROCESS_RSS_BYTES.set(rss)
     metrics.PROCESS_UPTIME_SECONDS.set(
         int(time.monotonic() - _PROCESS_T0))
+    # socket write buffers (slow readers) across open front-door
+    # connections — sampled here so /metrics and /_stats read fresh
+    from ..sched.governor import CONNGATE
+    CONNGATE.buffered_bytes()
     try:
         stats = gc.get_stats()
         gauges = (metrics.GC_GEN0_COLLECTIONS,

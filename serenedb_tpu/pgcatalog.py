@@ -1307,6 +1307,8 @@ def system_table(db, parts: list[str]) -> Optional[TableProvider]:
         return query_progress_table()
     if name == "sdb_admission":
         return admission_table()
+    if name == "sdb_connections":
+        return connections_table()
     if name == "sdb_device":
         return device_table()
     if name == "sdb_programs":
@@ -1590,6 +1592,31 @@ def admission_table() -> TableProvider:
         "rejected_total": [s["rejected_total"]],
         "wait_ns_total": [s["wait_ns_total"]],
         "preemptions_total": [s["preemptions_total"]]})
+
+
+def connections_table() -> TableProvider:
+    """sdb_connections: one row per open front-door socket — the
+    pg_stat_activity analog for the SOCKET layer (sched/governor.py
+    ConnectionGate). pid is a process-unique virtual backend id,
+    protocol the frontend (pg | http), state the coarse machine
+    (active ⇄ idle), idle_s the seconds since the last byte arrived
+    on an idle connection. An sdb_* relation on purpose: reads are
+    admission-exempt, so an operator can inspect a saturated front
+    door without queueing behind it."""
+    from .sched.governor import CONNGATE
+    rows = CONNGATE.rows()
+    return _typed("sdb_connections", [
+        ("pid", dt.BIGINT), ("protocol", dt.VARCHAR),
+        ("state", dt.VARCHAR), ("idle_s", dt.DOUBLE),
+        ("peer", dt.VARCHAR), ("connected_s", dt.DOUBLE),
+        ("buffered_bytes", dt.BIGINT)], {
+        "pid": [r["pid"] for r in rows],
+        "protocol": [r["protocol"] for r in rows],
+        "state": [r["state"] for r in rows],
+        "idle_s": [r["idle_s"] for r in rows],
+        "peer": [r["peer"] for r in rows],
+        "connected_s": [r["connected_s"] for r in rows],
+        "buffered_bytes": [r["buffered_bytes"] for r in rows]})
 
 
 def metrics_table() -> TableProvider:

@@ -312,3 +312,168 @@ def admission_exempt(st) -> bool:
         return True
 
     return walk(st)
+
+
+# -- socket-level admission ---------------------------------------------------
+
+
+class ConnInfo:
+    """One open front-door socket (server/frontdoor.py, server/pgwire.py)
+    as the connection gate tracks it: a virtual pid (process-unique,
+    monotonically assigned — the sdb_connections analog of a backend
+    pid), the protocol frontend, a coarse state machine
+    (active ⇄ idle), and activity timestamps for idle_s."""
+
+    __slots__ = ("pid", "protocol", "peer", "state", "connected_ns",
+                 "last_ns", "buffered")
+
+    def __init__(self, pid: int, protocol: str, peer: str):
+        self.pid = pid
+        self.protocol = protocol
+        self.peer = peer
+        self.state = "active"        # accept/handshake counts as active
+        self.connected_ns = time.monotonic_ns()
+        self.last_ns = self.connected_ns
+        #: callable -> bytes currently sitting in this connection's
+        #: transport write buffer (set by the owning frontend; sampled
+        #: for the SocketBytesBuffered gauge and sdb_connections)
+        self.buffered = None
+
+
+class ConnectionGate:
+    """Admission control at the SOCKET, the layer below the statement
+    governor above: `serene_max_connections` caps how many sockets the
+    front door holds open across BOTH protocols, and an accept past the
+    cap is rejected before a single byte of the session is parsed
+    (pgwire: a clean 53300 ErrorResponse; HTTP: 429 + Retry-After).
+    The statement governor then arbitrates what the admitted
+    connections may RUN — two gates, one backpressure story.
+
+    Also the socket layer's observability spine: the
+    Connections{Open,Idle,Active,Rejected} gauges, the AcceptQueueWait
+    histogram, `/_stats.connections` and the `sdb_connections()`
+    relation all read from here."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: dict[int, ConnInfo] = {}
+        self._pids = itertools.count(1)
+        self._pauses = 0
+
+    @staticmethod
+    def limit() -> int:
+        from ..utils.config import REGISTRY
+        return int(REGISTRY.get_global("serene_max_connections") or 0)
+
+    def try_admit(self, protocol: str, peer,
+                  accept_ns: Optional[int] = None) -> Optional[ConnInfo]:
+        """Admit one socket or return None (caller sends the protocol's
+        rejection packet and closes). accept_ns is the monotonic stamp
+        taken when the OS handed us the socket — the gap to now is the
+        event-loop accept backlog (AcceptQueueWait)."""
+        if accept_ns is not None:
+            metrics.ACCEPT_QUEUE_WAIT_HIST.observe_ns(
+                max(0, time.monotonic_ns() - accept_ns))
+        if isinstance(peer, tuple):
+            peer = f"{peer[0]}:{peer[1]}"
+        limit = self.limit()
+        with self._lock:
+            if limit and len(self._conns) >= limit:
+                metrics.CONNECTIONS_REJECTED.add(1)
+                return None
+            info = ConnInfo(next(self._pids), protocol, str(peer or ""))
+            self._conns[info.pid] = info
+        metrics.CONNECTIONS_OPEN.add(1)
+        metrics.CONNECTIONS_ACTIVE.add(1)
+        return info
+
+    def set_state(self, info: ConnInfo, state: str) -> None:
+        """active ⇄ idle transition; maintains the live gauges and the
+        idle_s clock (touch on every transition)."""
+        if info.state == state:
+            return
+        if info.state == "idle":
+            metrics.CONNECTIONS_IDLE.sub(1)
+        elif info.state == "active":
+            metrics.CONNECTIONS_ACTIVE.sub(1)
+        info.state = state
+        info.last_ns = time.monotonic_ns()
+        if state == "idle":
+            metrics.CONNECTIONS_IDLE.add(1)
+        else:
+            metrics.CONNECTIONS_ACTIVE.add(1)
+
+    def note_pause(self) -> None:
+        """A frontend paused reading on a slow-writer connection."""
+        with self._lock:
+            self._pauses += 1
+
+    def release(self, info: Optional[ConnInfo]) -> None:
+        if info is None:
+            return
+        with self._lock:
+            if self._conns.pop(info.pid, None) is None:
+                return
+        metrics.CONNECTIONS_OPEN.sub(1)
+        if info.state == "idle":
+            metrics.CONNECTIONS_IDLE.sub(1)
+        else:
+            metrics.CONNECTIONS_ACTIVE.sub(1)
+
+    # -- introspection ----------------------------------------------------
+
+    def buffered_bytes(self) -> int:
+        """Sum of transport write-buffer bytes across open connections
+        (sampled — feeds the SocketBytesBuffered gauge at scrape)."""
+        total = 0
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            fn = c.buffered
+            if fn is not None:
+                try:
+                    total += int(fn())
+                except Exception:  # noqa: BLE001 — transport closing
+                    pass
+        metrics.SOCKET_BYTES_BUFFERED.set(total)
+        return total
+
+    def rows(self) -> list[dict]:
+        """sdb_connections(): one row per open front-door socket —
+        the pg_stat_activity analog for the socket layer."""
+        now = time.monotonic_ns()
+        out = []
+        with self._lock:
+            conns = sorted(self._conns.values(), key=lambda c: c.pid)
+        for c in conns:
+            buffered = 0
+            if c.buffered is not None:
+                try:
+                    buffered = int(c.buffered())
+                except Exception:  # noqa: BLE001
+                    pass
+            out.append({
+                "pid": c.pid, "protocol": c.protocol, "state": c.state,
+                "idle_s": round((now - c.last_ns) / 1e9, 3)
+                if c.state == "idle" else 0.0,
+                "peer": c.peer,
+                "connected_s": round((now - c.connected_ns) / 1e9, 3),
+                "buffered_bytes": buffered})
+        return out
+
+    def snapshot(self) -> dict:
+        """The `/_stats.connections` section."""
+        with self._lock:
+            open_ = len(self._conns)
+            idle = sum(1 for c in self._conns.values()
+                       if c.state == "idle")
+            pauses = self._pauses
+        return {"open": open_, "idle": idle, "active": open_ - idle,
+                "max_connections": self.limit(),
+                "rejected_total": metrics.CONNECTIONS_REJECTED.value,
+                "pause_reads_total": pauses,
+                "buffered_bytes": self.buffered_bytes()}
+
+
+#: process-wide socket gate (one per process, like GOVERNOR above)
+CONNGATE = ConnectionGate()

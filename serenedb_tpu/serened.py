@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import signal
 import sys
 
@@ -60,17 +61,60 @@ def main(argv=None):
     if bool(args.tls_cert) != bool(args.tls_key):
         ap.error("--tls-cert and --tls-key must be given together")
 
+    # environment-driven configuration: any registered setting may be
+    # seeded at boot via its SHOUTING name (SERENE_MAX_CONNECTIONS=100,
+    # SERENE_DEVICE=cpu, ...) — the standard server-deployment surface
+    # for GLOBAL-scope knobs, which have no SQL-level setter
+    from .utils.config import REGISTRY as settings
+    for name in settings.names():
+        env_val = os.environ.get(name.upper())
+        if env_val is not None:
+            try:
+                settings.set_global(name, env_val)
+            except ValueError as e:
+                ap.error(f"{name.upper()}: {e}")
+
     log.MANAGER.stdout = True
     db = Database(args.datadir)
-    http = HttpServer(db, args.host, args.http_port)
-    http.start()
     pg = PgServer(db, args.host, args.pg_port, args.password,
                   tls_cert=args.tls_cert, tls_key=args.tls_key,
                   hba_conf=args.hba_config,
                   proxy_protocol=args.proxy_protocol,
                   listen=args.listen)
 
-    async def run():
+    if bool(settings.get_global("serene_frontdoor")):
+        # the front door: BOTH protocols on the process's one event
+        # loop, pgwire's session pool shared as the HTTP engine-boundary
+        # executor, one ordered drain on shutdown (server/frontdoor.py)
+        from .server.frontdoor import FrontDoor
+        front = FrontDoor(db, args.host, http_port=args.http_port, pg=pg)
+
+        async def run():
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            await front.start_async()
+            print(f"serened ready: pg={pg.port} http={front.port}",
+                  flush=True)
+            await stop.wait()
+            # teardown order mirrors the reference: listeners drain,
+            # sessions reaped, then the store closes
+            await front.stop_async()
+
+        try:
+            asyncio.run(run())
+        finally:
+            db.close()
+            log.info("serened", "shutdown complete")
+        return
+
+    # legacy split lifecycle (serene_frontdoor = off, one release):
+    # HTTP on its own thread-per-connection server, pg on the main loop
+    http = HttpServer(db, args.host, args.http_port)
+    http.start()
+
+    async def run_legacy():
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -79,11 +123,10 @@ def main(argv=None):
         print(f"serened ready: pg={pg.port} http={http.port}",
               flush=True)
         await stop.wait()
-        # teardown order mirrors the reference: listeners → loops → store
         await pg.stop()
 
     try:
-        asyncio.run(run())
+        asyncio.run(run_legacy())
     finally:
         http.stop()
         db.close()

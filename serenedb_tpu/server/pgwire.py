@@ -18,6 +18,7 @@ import functools
 import os
 import secrets
 import struct
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -306,7 +307,7 @@ def _close_portal_stream(portal: Optional["Portal"]) -> None:
 
 class PgSession:
     def __init__(self, server: "PgServer", reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter):
+                 writer: asyncio.StreamWriter, gate_info=None):
         self.server = server
         self.reader = reader
         self.w = Writer(writer, db=server.db)
@@ -317,19 +318,47 @@ class PgSession:
         self.secret = secrets.randbits(31)
         self.ignore_till_sync = False
         self.tls_active = False
+        #: the connection gate's record for this socket (None when the
+        #: session is driven outside the accept path, e.g. tests)
+        self.gate_info = gate_info
 
     # -- startup -----------------------------------------------------------
+
+    def _set_gate(self, state: str) -> None:
+        if self.gate_info is not None:
+            from ..sched.governor import CONNGATE
+            CONNGATE.set_state(self.gate_info, state)
+
+    @staticmethod
+    def _idle_conn_timeout() -> Optional[float]:
+        from ..utils.config import REGISTRY as _settings
+        t = float(_settings.get_global("serene_idle_conn_timeout_s") or 0.0)
+        return t if t > 0 else None
+
+    async def _handshake(self) -> bool:
+        if not await self._consume_proxy_preface():
+            return False
+        return await self._startup()
 
     async def run(self):
         with metrics.PG_CONNECTIONS.scoped():
             try:
-                if not await self._consume_proxy_preface():
-                    return
-                if not await self._startup():
+                # the whole handshake honors the idle timeout: a
+                # half-open client (SYN, then silence) is reaped without
+                # ever burning a pool slot
+                t = self._idle_conn_timeout()
+                if t:
+                    ok = await asyncio.wait_for(self._handshake(), t)
+                else:
+                    ok = await self._handshake()
+                if not ok:
                     return
                 await self._command_loop()
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 pass
+            except asyncio.TimeoutError:
+                log.info("pg", "idle connection reaped "
+                         "(serene_idle_conn_timeout_s)")
             finally:
                 self.server.unregister_cancel(self.pid, self.secret)
                 for p in self.portals.values():
@@ -627,13 +656,24 @@ class PgSession:
     async def _command_loop(self):
         while True:
             self._idle = True
+            self._set_gate("idle")
             # close the missed-wakeup window: anything enqueued before
             # _idle flipped is delivered here; later arrivals take the
             # hook path
             self._drain_notifications()
             await self.w.flush()
-            kind, payload = await self._read_msg()
+            t = self._idle_conn_timeout()
+            if t:
+                # reap abandoned sessions between commands; propagates
+                # to run()'s TimeoutError handler which closes the
+                # transport (a statement in flight is never interrupted
+                # — the timeout only guards this idle read)
+                kind, payload = await asyncio.wait_for(
+                    self._read_msg(), t)
+            else:
+                kind, payload = await self._read_msg()
             self._idle = False
+            self._set_gate("active")
             if kind == b"X":
                 return
             if self.ignore_till_sync and kind not in (b"S",):
@@ -1166,7 +1206,8 @@ class PgServer:
                  tls_key: Optional[str] = None,
                  hba_conf: Optional[str] = None,
                  proxy_protocol: str = "off",
-                 listen: Optional[list[str]] = None):
+                 listen: Optional[list[str]] = None,
+                 pool=None):
         self.db = db
         #: extra listener specs (tcp://… / unix://…) beyond host:port
         #: (reference: listen_spec.h multi-spec --listen)
@@ -1194,9 +1235,17 @@ class PgServer:
             self.set_hba(hba_conf)
         self._cancel_keys: dict[tuple[int, int], PgSession] = {}
         self._server: Optional[asyncio.AbstractServer] = None
-        import concurrent.futures
-        self.pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(4, (os.cpu_count() or 4)))
+        # the session executor (the engine boundary): when the front
+        # door hosts this server it passes its shared pool so BOTH
+        # protocols draw on one bounded executor
+        if pool is not None:
+            self.pool = pool
+            self._owns_pool = False
+        else:
+            import concurrent.futures
+            self.pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(4, (os.cpu_count() or 4)))
+            self._owns_pool = True
 
     def set_hba(self, conf: str) -> None:
         """Install pg_hba rules from conf text or a file path (runtime
@@ -1223,14 +1272,39 @@ class PgServer:
         log.info("pg", f"cancel request for {pid}/{key}")
         session.conn.request_cancel()
 
-    async def _client(self, reader, writer):
+    def _accept(self, reader, writer):
+        # sync accept callback (runs inside connection_made): stamp NOW
+        # so the accept→serve gap feeds the AcceptQueueWait histogram
+        return self._client(reader, writer, time.monotonic_ns())
+
+    async def _client(self, reader, writer, accept_ns=None):
+        from ..sched.governor import CONNGATE
+        info = CONNGATE.try_admit(
+            "pg", writer.get_extra_info("peername"), accept_ns)
+        if info is None:
+            # socket-level admission: a clean 53300 ErrorResponse before
+            # reading — let alone parsing — a single byte of the session
+            w = Writer(writer)
+            w.error(errors.SqlError(
+                errors.TOO_MANY_CONNECTIONS,
+                "sorry, too many clients already",
+                hint="raise serene_max_connections or close idle "
+                     "connections"))
+            try:
+                await w.flush()
+            except (ConnectionResetError, RuntimeError):
+                pass
+            writer.close()
+            return
         conns = getattr(self, "_live_writers", None)
         if conns is None:
             conns = self._live_writers = set()
         conns.add(writer)
+        info.buffered = writer.transport.get_write_buffer_size
         try:
-            await PgSession(self, reader, writer).run()
+            await PgSession(self, reader, writer, gate_info=info).run()
         finally:
+            CONNGATE.release(info)
             conns.discard(writer)
 
     async def start(self):
@@ -1243,7 +1317,7 @@ class PgServer:
         from ..parallel.pool import get_pool
         get_pool().ensure_started()
         self._server = await asyncio.start_server(
-            self._client, self.host, self.port)
+            self._accept, self.host, self.port)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         log.info("pg", f"listening on {addr[0]}:{addr[1]}")
@@ -1254,11 +1328,11 @@ class PgServer:
             if spec.kind == "unix":
                 _remove_stale_unix_socket(spec.path)
                 srv = await asyncio.start_unix_server(
-                    self._client, path=spec.path)
+                    self._accept, path=spec.path)
                 self._unix_paths.append(spec.path)
             else:
                 srv = await asyncio.start_server(
-                    self._client, spec.host, spec.port)
+                    self._accept, spec.host, spec.port)
             self._extra_servers.append(srv)
             log.info("pg", f"listening on {spec}")
 
@@ -1284,7 +1358,8 @@ class PgServer:
                 os.unlink(path)
             except OSError:
                 pass
-        self.pool.shutdown(wait=False)
+        if getattr(self, "_owns_pool", True):
+            self.pool.shutdown(wait=False)
 
     def run_forever(self):
         async def main():

@@ -481,6 +481,38 @@ declare("serene_admission_queue_depth", 64, int,
         "already queued are rejected immediately with SQLSTATE 53300 "
         "(backpressure instead of an unbounded convoy)",
         scope=Scope.GLOBAL, validator=lambda v: max(1, int(v)))
+declare("serene_max_connections", 0, int,
+        "socket-level admission (sched/governor.py ConnectionGate): max "
+        "sockets open across BOTH front-door protocols; a connection "
+        "past the limit is rejected at accept — pgwire clients get a "
+        "clean 53300 error packet, HTTP clients a 429 with Retry-After "
+        "— before a single byte of the session is parsed, so overload "
+        "never reaches the engine. 0 = unlimited. The statement-level "
+        "sibling is serene_max_concurrent_statements",
+        scope=Scope.GLOBAL, validator=lambda v: max(0, int(v)))
+declare("serene_frontdoor", True, bool,
+        "serve HTTP/ES on the unified asyncio front door "
+        "(server/frontdoor.py: one event loop for both protocols, "
+        "connections as tasks not threads, socket-level admission, "
+        "pause-reading backpressure, idle reaping). off = the legacy "
+        "thread-per-connection ThreadingHTTPServer, kept one release "
+        "as the bit-identity parity oracle (both paths share the same "
+        "request->response route table)", scope=Scope.GLOBAL)
+declare("serene_idle_conn_timeout_s", 0.0, float,
+        "reap front-door connections (both protocols) that have sent "
+        "no bytes for this many seconds — half-open clients and "
+        "abandoned keep-alive sessions release their socket (and "
+        "serene_max_connections slot) instead of holding it forever. "
+        "0 disables. Applies while a connection is idle or mid-"
+        "handshake, never to a statement in flight",
+        scope=Scope.GLOBAL, validator=lambda v: max(0.0, float(v)))
+declare("serene_conn_write_high_kb", 256, int,
+        "per-connection transport write-buffer high-water mark in KiB "
+        "(server/frontdoor.py): past it the session stops reading "
+        "(transport.pause_reading) and stops producing until the "
+        "client drains below the low-water mark, so a stalled reader "
+        "never buffers unbounded result bytes",
+        scope=Scope.GLOBAL, validator=lambda v: max(16, int(v)))
 declare("serene_fair_share", True, bool,
         "fair-share morsel scheduling (parallel/pool.py): the shared "
         "worker pool picks queued tasks by per-statement stride "

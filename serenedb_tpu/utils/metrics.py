@@ -447,6 +447,29 @@ ADMISSION_WAIT_NS = REGISTRY.gauge(
 ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
     "AdmissionQueueDepth",
     "statements currently waiting in the admission queue (live)")
+CONNECTIONS_OPEN = REGISTRY.gauge(
+    "ConnectionsOpen",
+    "sockets currently open on the serving front door, both protocols "
+    "(sched/governor.py ConnectionGate; server/frontdoor.py)")
+CONNECTIONS_IDLE = REGISTRY.gauge(
+    "ConnectionsIdle",
+    "front-door connections waiting for the client's next request / "
+    "command (live)")
+CONNECTIONS_ACTIVE = REGISTRY.gauge(
+    "ConnectionsActive",
+    "front-door connections with a request or handshake in flight "
+    "(live)")
+CONNECTIONS_REJECTED = REGISTRY.gauge(
+    "ConnectionsRejected",
+    "connections rejected at the accept gate because "
+    "serene_max_connections sockets were already open (cumulative; "
+    "pgwire clients get a clean 53300 error packet, HTTP clients a "
+    "429, both before a single byte of the session is parsed)")
+SOCKET_BYTES_BUFFERED = REGISTRY.gauge(
+    "SocketBytesBuffered",
+    "bytes sitting in front-door transport write buffers (slow "
+    "readers), sampled at scrape time; bounded per connection by "
+    "serene_conn_write_high_kb + pause_reading")
 SCHED_PREEMPTIONS = REGISTRY.gauge(
     "SchedPreemptions",
     "fair-share pool picks that ran a later-submitted statement's task "
@@ -487,6 +510,11 @@ QUERY_LATENCY_HIST = REGISTRY.histogram(
 POOL_QUEUE_WAIT_HIST = REGISTRY.histogram(
     "PoolQueueWait",
     "per-task worker-pool queue wait (submit -> pickup)")
+ACCEPT_QUEUE_WAIT_HIST = REGISTRY.histogram(
+    "AcceptQueueWait",
+    "per-connection wait between the OS handing the front door a "
+    "socket and the session coroutine starting to serve it (event-loop "
+    "accept backlog; server/frontdoor.py)")
 SEARCH_BATCH_WINDOW_HIST = REGISTRY.histogram(
     "SearchBatchWindow",
     "per-query search-batcher coalescing wait (submit -> dispatch "

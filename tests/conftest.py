@@ -181,6 +181,23 @@ if os.environ.get("SERENE_PROGRAM_CACHE_ENTRIES"):
 # statement without ever firing) and/or fair-share picking, proving the
 # governor steers scheduling only: the admission/parallel/shard/
 # resources suites must stay bit-identical with it armed.
+# scripts/verify_tier1.sh pass 19 (front door): run the serving suites
+# with the socket accept gate forced tiny (SERENE_MAX_CONNECTIONS=8 —
+# the rejection path exercised suite-wide), or the asyncio tier swapped
+# for the legacy ThreadingHTTPServer parity oracle
+# (SERENE_FRONTDOOR=off), or idle reaping pinned on
+_FRONTDOOR_ENV_HOOKS = {
+    "SERENE_FRONTDOOR": "serene_frontdoor",
+    "SERENE_MAX_CONNECTIONS": "serene_max_connections",
+    "SERENE_IDLE_CONN_TIMEOUT_S": "serene_idle_conn_timeout_s",
+}
+for _env, _setting in _FRONTDOOR_ENV_HOOKS.items():
+    if os.environ.get(_env):
+        from serenedb_tpu.utils.config import REGISTRY as _SDB_REG_FD
+
+        _SDB_REG_FD.set_global(_setting, os.environ[_env])
+
+
 _GOVERNOR_ENV_HOOKS = {
     "SERENE_MAX_CONCURRENT_STATEMENTS": "serene_max_concurrent_statements",
     "SERENE_WORK_MEM": "serene_work_mem",
